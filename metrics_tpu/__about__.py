@@ -1,0 +1,8 @@
+__version__ = "0.1.0"
+__author__ = "metrics-tpu contributors"
+__license__ = "Apache-2.0"
+__docs__ = (
+    "TPU-native metrics framework: a distributed metric-state engine on JAX/XLA "
+    "with mesh-axis collectives, plus functional metric kernels across "
+    "classification, regression, retrieval, image, audio and NLP domains."
+)

@@ -1,0 +1,30 @@
+"""metrics_tpu: a TPU-native distributed metrics framework on JAX/XLA.
+
+Capability parity with TorchMetrics v0.4.0 (the reference), re-designed for
+TPU: metric state is a pytree threaded through jitted programs, cross-device
+sync compiles to XLA collectives (psum/all_gather) over named mesh axes, and
+every functional kernel is a pure, static-shape jnp program that fuses into
+the surrounding training step.
+"""
+import logging as __logging
+import os
+
+from metrics_tpu.__about__ import __version__  # noqa: F401
+
+_logger = __logging.getLogger("metrics_tpu")
+_logger.addHandler(__logging.StreamHandler())
+_logger.setLevel(__logging.INFO)
+
+_PACKAGE_ROOT = os.path.dirname(__file__)
+PROJECT_ROOT = os.path.dirname(_PACKAGE_ROOT)
+
+from metrics_tpu.average import AverageMeter  # noqa: F401 E402
+from metrics_tpu.collections import MetricCollection  # noqa: F401 E402
+from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401 E402
+
+__all__ = [
+    "AverageMeter",
+    "CompositionalMetric",
+    "Metric",
+    "MetricCollection",
+]

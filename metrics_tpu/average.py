@@ -1,0 +1,60 @@
+"""Weighted running mean of a stream of values.
+
+Parity: reference ``torchmetrics/average.py`` (``AverageMeter`` with
+sum-reduced ``value``/``weight`` states and broadcasted weights).
+"""
+from typing import Any, Callable, Optional, Union
+
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Array, Metric
+
+
+class AverageMeter(Metric):
+    """Computes the (weighted) average of a stream of values.
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AverageMeter
+        >>> avg = AverageMeter()
+        >>> avg.update(3)
+        >>> avg.update(1)
+        >>> float(avg.compute())
+        2.0
+
+        >>> avg = AverageMeter()
+        >>> values = jnp.array([1., 2.])
+        >>> weights = jnp.array([3., 1.])
+        >>> float(avg(values, weights))
+        1.25
+    """
+
+    is_differentiable = True
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("value", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("weight", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, value: Union[Array, float], weight: Union[Array, float] = 1.0) -> None:
+        """Accumulate observations ``value`` with per-observation ``weight``
+        (broadcast to ``value``'s shape)."""
+        value = jnp.asarray(value, dtype=jnp.float32)
+        weight = jnp.broadcast_to(jnp.asarray(weight, dtype=jnp.float32), value.shape)
+        self.value = self.value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.value / self.weight

@@ -1,0 +1,380 @@
+"""Input canonicalization & validation for classification/retrieval metrics.
+
+Capability parity with the reference's ``torchmetrics/utilities/checks.py``
+(case inference at ``checks.py:54-113``, the override matrix of
+``multiclass``/``top_k``/``num_classes`` at ``checks.py:312-451``, retrieval
+checks at ``checks.py:503-583``) with a TPU-first split:
+
+* **Shape/dtype case inference** uses only static information (ndim, dtype,
+  shapes) and is therefore trace-safe.
+* **Value-dependent validation** (non-negative targets, label ranges, binary
+  targets for float preds) reads data values and cannot run inside a traced
+  XLA program; it runs on the host when inputs are concrete and is skipped
+  under tracing (``jit``/``vmap``/``shard_map``), where configuration must be
+  made explicit (e.g. ``num_classes``).
+* **Transforms** (threshold / top-k / one-hot / reshape) are pure static-shape
+  jnp ops that fuse into the surrounding XLA program.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.data import Array, _is_traced, is_floating_point, select_topk, to_onehot
+from metrics_tpu.utilities.enums import DataType
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Raise if predictions and targets differ in shape."""
+    if preds.shape != target.shape:
+        raise RuntimeError("Predictions and targets are expected to have the same shape")
+
+
+def _basic_input_validation(preds: Array, target: Array, threshold: float, multiclass: Optional[bool]) -> None:
+    """Value/dtype checks that need no case information. Host-side (eager only)."""
+    if is_floating_point(target):
+        raise ValueError("The `target` has to be an integer tensor.")
+
+    preds_float = is_floating_point(preds)
+
+    if not _is_traced(preds, target):
+        target_np = np.asarray(target)
+        if target_np.size and target_np.min() < 0:
+            raise ValueError("The `target` has to be a non-negative tensor.")
+        preds_np = np.asarray(preds)
+        if not preds_float and preds_np.size and preds_np.min() < 0:
+            raise ValueError("If `preds` are integers, they have to be non-negative.")
+        if multiclass is False and target_np.size and target_np.max() > 1:
+            raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+        if multiclass is False and not preds_float and preds_np.size and preds_np.max() > 1:
+            raise ValueError(
+                "If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1."
+            )
+
+    if not preds.shape[0] == target.shape[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[DataType, int]:
+    """Infer the input case from shapes/dtypes (static info only; trace-safe).
+
+    Returns the case and the implied number of classes (C dim for multi-class,
+    flattened extra dims for multi-label).
+    """
+    preds_float = is_floating_point(preds)
+
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        if preds_float and not _is_traced(target) and np.asarray(target).size and np.asarray(target).max() > 1:
+            raise ValueError(
+                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+            )
+        if preds.ndim == 1:
+            case = DataType.BINARY if preds_float else DataType.MULTICLASS
+        else:
+            case = DataType.MULTILABEL if preds_float else DataType.MULTIDIM_MULTICLASS
+        implied_classes = int(np.prod(preds.shape[1:])) if preds.ndim > 1 else 1
+
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        implied_classes = preds.shape[1]
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+
+    return case, implied_classes
+
+
+def _check_num_classes_binary(num_classes: int, multiclass: Optional[bool]) -> None:
+    """Consistency of ``num_classes`` with binary data."""
+    if num_classes > 2:
+        raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+    if num_classes == 2 and not multiclass:
+        raise ValueError(
+            "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+            " Set it to True if you want to transform binary data to multi-class format."
+        )
+    if num_classes == 1 and multiclass:
+        raise ValueError(
+            "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+            " Either set `multiclass=None`(default) or set `num_classes=2`"
+            " to transform binary data to multi-class format."
+        )
+
+
+def _check_num_classes_mc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multiclass: Optional[bool],
+    implied_classes: int,
+) -> None:
+    """Consistency of ``num_classes`` with (multi-dim) multi-class data."""
+    if num_classes == 1 and multiclass is not False:
+        raise ValueError(
+            "You have set `num_classes=1`, but predictions are integers."
+            " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+            " to binary/multi-label, set `multiclass=False`."
+        )
+    if num_classes > 1:
+        if multiclass is False and implied_classes != num_classes:
+            raise ValueError(
+                "You have set `multiclass=False`, but the implied number of classes "
+                " (from shape of inputs) does not match `num_classes`. If you are trying to"
+                " transform multi-dim multi-class data with 2 classes to multi-label, `num_classes`"
+                " should be either None or the product of the size of extra dimensions (...)."
+                " See Input Types in Metrics documentation."
+            )
+        if not _is_traced(preds, target):
+            if np.asarray(target).size and num_classes <= np.asarray(target).max():
+                raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+            if not is_floating_point(preds) and np.asarray(preds).size and num_classes <= np.asarray(preds).max():
+                raise ValueError("The highest label in `preds` should be smaller than `num_classes`.")
+        if preds.shape != target.shape and num_classes != implied_classes:
+            raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+
+
+def _check_num_classes_ml(num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
+    """Consistency of ``num_classes`` with multi-label data."""
+    if multiclass and num_classes != 2:
+        raise ValueError(
+            "Your have set `multiclass=True`, but `num_classes` is not equal to 2."
+            " If you are trying to transform multi-label data to 2 class multi-dimensional"
+            " multi-class, you should set `num_classes` to either 2 or None."
+        )
+    if not multiclass and num_classes != implied_classes:
+        raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+
+def _check_top_k(
+    top_k: int, case: DataType, implied_classes: int, multiclass: Optional[bool], preds_float: bool
+) -> None:
+    if case == DataType.BINARY:
+        raise ValueError("You can not use `top_k` parameter with binary data.")
+    if not isinstance(top_k, int) or top_k <= 0:
+        raise ValueError("The `top_k` has to be an integer larger than 0.")
+    if not preds_float:
+        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+    if multiclass is False:
+        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+    if case == DataType.MULTILABEL and multiclass:
+        raise ValueError(
+            "If you want to transform multi-label data to 2 class multi-dimensional"
+            "multi-class data using `multiclass=True`, you can not use `top_k`."
+        )
+    if top_k >= implied_classes:
+        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _check_classification_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+) -> DataType:
+    """Full input validation; returns the inferred case.
+
+    Value-dependent pieces run on the host for concrete inputs and are skipped
+    under tracing.
+    """
+    _basic_input_validation(preds, target, threshold, multiclass)
+
+    case, implied_classes = _check_shape_and_type_consistency(preds, target)
+
+    if preds.shape != target.shape:
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if not _is_traced(target) and np.asarray(target).size and np.asarray(target).max() >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+
+    if num_classes:
+        if case == DataType.BINARY:
+            _check_num_classes_binary(num_classes, multiclass)
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            _check_num_classes_mc(preds, target, num_classes, multiclass, implied_classes)
+        elif case == DataType.MULTILABEL:
+            _check_num_classes_ml(num_classes, multiclass, implied_classes)
+
+    if top_k is not None:
+        _check_top_k(top_k, case, implied_classes, multiclass, is_floating_point(preds))
+
+    return case
+
+
+def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Drop all size-1 dimensions except the leading sample dimension."""
+    if preds.shape[0] == 1:
+        preds = jnp.expand_dims(jnp.squeeze(preds), 0)
+        target = jnp.expand_dims(jnp.squeeze(target), 0)
+    else:
+        preds, target = jnp.squeeze(preds), jnp.squeeze(target)
+    return preds, target
+
+
+def _input_format_classification(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Tuple[Array, Array, DataType]:
+    """Canonicalize every classification input into binary int tensors.
+
+    Output is always ``(N, C)`` or ``(N, C, X)`` int32 plus the inferred case,
+    following the same case/override semantics as the reference
+    (``checks.py:312-451``):
+
+    * binary / multi-label: probabilities thresholded (or top-k for
+      multi-label); ``multiclass=True`` expands to a 2-class one-hot.
+    * (multi-dim) multi-class: targets one-hot; float preds top-k one-hot;
+      ``multiclass=False`` squashes 2-class data down to the positive column.
+    * all extra dims are flattened into ``X``; size-1 dims (except N) squeezed.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+
+    preds, target = _input_squeeze(preds, target)
+
+    # half-precision inputs are canonicalized through f32 (cheap; outputs are int)
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+
+    case = _check_classification_inputs(
+        preds, target, threshold=threshold, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+    )
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32)
+        num_classes = num_classes if not multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or multiclass:
+        if is_floating_point(preds):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            if not num_classes:
+                if _is_traced(preds, target):
+                    raise ValueError(
+                        "`num_classes` must be given explicitly when canonicalizing label "
+                        "predictions inside a traced (jit/shard_map) program."
+                    )
+                num_classes = int(max(np.asarray(preds).max(), np.asarray(target).max())) + 1
+            preds = to_onehot(preds, max(2, num_classes))
+
+        target = to_onehot(target, max(2, int(num_classes) if num_classes else 2))
+
+        if multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and multiclass is not False) or multiclass:
+        target = target.reshape(target.shape[0], target.shape[1], -1)
+        preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+    else:
+        target = target.reshape(target.shape[0], -1)
+        preds = preds.reshape(preds.shape[0], -1)
+
+    # drop the trailing singleton the reshapes above create for flat MC/binary data
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = jnp.squeeze(preds, -1), jnp.squeeze(target, -1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _input_format_classification_one_hot(
+    num_classes: int,
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> Tuple[Array, Array]:
+    """Legacy one-hot formatter: returns ``(num_classes, -1)`` binary tensors."""
+    if preds.ndim not in (target.ndim, target.ndim + 1):
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+
+    if preds.ndim == target.ndim + 1:
+        preds = jnp.argmax(preds, axis=1)
+
+    if preds.ndim == target.ndim and jnp.issubdtype(preds.dtype, jnp.integer) and num_classes > 1 and not multilabel:
+        preds = to_onehot(preds, num_classes=num_classes)
+        target = to_onehot(target, num_classes=num_classes)
+    elif preds.ndim == target.ndim and is_floating_point(preds):
+        preds = (preds >= threshold).astype(jnp.int32)
+
+    if preds.ndim > 1:
+        preds = jnp.swapaxes(preds, 0, 1)
+        target = jnp.swapaxes(target, 0, 1)
+
+    return preds.reshape(num_classes, -1), target.reshape(num_classes, -1)
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+) -> Tuple[Array, Array]:
+    """Validate and flatten a (preds, target) retrieval pair -> (f32, int32)."""
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.ndim == 0 or preds.size == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    if not is_floating_point(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not _is_traced(target):
+        t = np.asarray(target)
+        if (not allow_non_binary_target and t.max() > 1) or t.min() < 0:
+            raise ValueError("`target` must contain `binary` values")
+    return preds.astype(jnp.float32).reshape(-1), target.astype(jnp.int32).reshape(-1)
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Validate and flatten an (indexes, preds, target) triple -> (int32, f32, int32)."""
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if indexes.ndim == 0 or indexes.size == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if not is_floating_point(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    if not _is_traced(target):
+        t = np.asarray(target)
+        if (not allow_non_binary_target and t.max() > 1) or t.min() < 0:
+            raise ValueError("`target` must contain `binary` values")
+    return (
+        indexes.astype(jnp.int32).reshape(-1),
+        preds.astype(jnp.float32).reshape(-1),
+        target.astype(jnp.int32).reshape(-1),
+    )

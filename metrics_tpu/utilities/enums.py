@@ -1,0 +1,57 @@
+"""String enums used across the library.
+
+Capability parity with the reference's ``torchmetrics/utilities/enums.py``
+(``EnumStr``/``DataType``/``AverageMethod``/``MDMCAverageMethod``), re-written
+for this framework: comparisons are case-insensitive and tolerate raw strings
+or ``None`` so user-facing kwargs stay plain strings.
+"""
+from enum import Enum
+from typing import Optional, Union
+
+
+class EnumStr(str, Enum):
+    """A ``str``-valued Enum with case-insensitive lookup and comparison."""
+
+    @classmethod
+    def from_str(cls, value: str) -> Optional["EnumStr"]:
+        try:
+            return cls[str(value).replace(" ", "_").replace("-", "_").upper()]
+        except KeyError:
+            return None
+
+    def __eq__(self, other: Union[str, "EnumStr", None]) -> bool:
+        if other is None:
+            return False
+        return self.value.lower() == str(other).lower()
+
+    def __ne__(self, other) -> bool:
+        return not self.__eq__(other)
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+
+class DataType(EnumStr):
+    """The four canonical classification input cases."""
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Class-averaging modes for classification metrics."""
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """How the extra sample dimension is handled for multi-dim multi-class inputs."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"

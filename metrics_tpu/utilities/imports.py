@@ -1,0 +1,48 @@
+"""Package-availability and version gates.
+
+Parity with the reference's ``torchmetrics/utilities/imports.py``
+(``_module_available``/``_compare_version`` and feature flags) adapted to the
+JAX ecosystem: the optional integrations here are flax (NN feature
+extractors), scipy/sklearn (test-time oracles) and torch (weight porting).
+"""
+import operator
+from importlib import import_module
+from importlib.util import find_spec
+from typing import Callable
+
+from packaging.version import Version
+
+
+def _module_available(module_path: str) -> bool:
+    """Return ``True`` if the (possibly nested) module can be imported."""
+    parts = module_path.split(".")
+    try:
+        for i in range(len(parts)):
+            if find_spec(".".join(parts[: i + 1])) is None:
+                return False
+    except (AttributeError, ImportError, ModuleNotFoundError, ValueError):
+        return False
+    return True
+
+
+def _compare_version(package: str, op: Callable, version: str) -> bool:
+    """Compare an installed package's version against ``version`` with ``op``."""
+    if not _module_available(package):
+        return False
+    try:
+        pkg = import_module(package)
+        pkg_version = Version(getattr(pkg, "__version__", "0.0.0"))
+    except (ModuleNotFoundError, ImportError, TypeError):
+        return False
+    return op(pkg_version, Version(version))
+
+
+_JAX_AVAILABLE: bool = _module_available("jax")
+_FLAX_AVAILABLE: bool = _module_available("flax")
+_OPTAX_AVAILABLE: bool = _module_available("optax")
+_SCIPY_AVAILABLE: bool = _module_available("scipy")
+_SKLEARN_AVAILABLE: bool = _module_available("sklearn")
+_TORCH_AVAILABLE: bool = _module_available("torch")
+_TORCHVISION_AVAILABLE: bool = _module_available("torchvision")
+_NLTK_AVAILABLE: bool = _module_available("nltk")
+_JAX_GREATER_EQUAL_0_4: bool = _compare_version("jax", operator.ge, "0.4.0")

@@ -1,0 +1,55 @@
+"""Rank-zero-gated logging/warning helpers.
+
+Parity with the reference's ``torchmetrics/utilities/prints.py`` — but rank
+detection is JAX-native: ``jax.process_index()`` when the distributed runtime
+is initialized, with the ``LOCAL_RANK``/``GLOBAL_RANK`` env vars as fallback
+so launchers that pre-set them behave identically.
+"""
+import logging
+import os
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("metrics_tpu")
+
+
+def _detect_rank() -> int:
+    for env_key in ("GLOBAL_RANK", "RANK", "LOCAL_RANK"):
+        if env_key in os.environ:
+            return int(os.environ[env_key])
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax always importable in practice
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Decorator: run ``fn`` only on global rank zero."""
+
+    @wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        if getattr(rank_zero_only, "rank", _detect_rank()) == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped
+
+
+def _warn(message: str, *args: Any, **kwargs: Any) -> None:
+    warnings.warn(message, *args, **kwargs)
+
+
+def _info(message: str, *args: Any, **kwargs: Any) -> None:
+    log.info(message, *args, **kwargs)
+
+
+def _debug(message: str, *args: Any, **kwargs: Any) -> None:
+    log.debug(message, *args, **kwargs)
+
+
+rank_zero_warn = rank_zero_only(partial(_warn, stacklevel=5))
+rank_zero_info = rank_zero_only(_info)
+rank_zero_debug = rank_zero_only(_debug)

@@ -1,0 +1,136 @@
+"""Bootstrapped confidence intervals for any metric.
+
+Capability parity with the reference's ``torchmetrics/wrappers/bootstrapping.py``
+(``BootStrapper``: N deep-copies of a base metric, inputs resampled along dim 0
+per copy with Poisson(1) counts or multinomial draws; compute stacks the child
+values into mean/std/quantile/raw). Randomness is JAX-native: an explicit PRNG
+key is held on the wrapper and split per update, so runs are reproducible from
+``seed`` rather than from hidden global RNG state.
+"""
+from copy import deepcopy
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Array, ArrayTypes, Metric
+from metrics_tpu.utilities.data import apply_to_collection
+
+
+def _bootstrap_sampler(
+    size: int,
+    rng_key: Array,
+    sampling_strategy: str = "poisson",
+) -> Array:
+    """Index array that resamples ``size`` rows with replacement.
+
+    ``'poisson'``: each row is repeated n ~ Poisson(1) times (approximates the
+    true bootstrap for large N); ``'multinomial'``: ``size`` uniform draws with
+    replacement.
+    """
+    if sampling_strategy == "poisson":
+        counts = jax.random.poisson(rng_key, 1.0, (size,))
+        return jnp.repeat(jnp.arange(size), counts, total_repeat_length=None)
+    if sampling_strategy == "multinomial":
+        return jax.random.randint(rng_key, (size,), 0, size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(Metric):
+    """Wrap a metric to estimate the bootstrap distribution of its value.
+
+    Example::
+
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.wrappers import BootStrapper
+        >>> bootstrap = BootStrapper(Accuracy(), num_bootstraps=20, seed=123)
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> bootstrap.update(jax.random.randint(k1, (20,), 0, 5), jax.random.randint(k2, (20,), 0, 5))
+        >>> sorted(bootstrap.compute().keys())
+        ['mean', 'std']
+    """
+
+    _fusable = False  # children own the state; forward uses the reference protocol
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: int = 0,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
+            )
+
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but recieved {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng_key = jax.random.PRNGKey(seed)
+
+    def _next_key(self) -> Array:
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update every child copy on an independently resampled batch."""
+        args_sizes = apply_to_collection(args, ArrayTypes, len)
+        kwargs_sizes = list(apply_to_collection(kwargs, ArrayTypes, len))
+        if len(args_sizes) > 0:
+            size = args_sizes[0]
+        elif len(kwargs_sizes) > 0:
+            size = kwargs_sizes[0]
+        else:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self._next_key(), sampling_strategy=self.sampling_strategy)
+            new_args = apply_to_collection(args, ArrayTypes, jnp.take, sample_idx, axis=0)
+            new_kwargs = apply_to_collection(kwargs, ArrayTypes, jnp.take, sample_idx, axis=0)
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Dict of the requested bootstrap statistics (mean/std/quantile/raw)."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = jnp.mean(computed_vals, axis=0)
+        if self.std:
+            output_dict["std"] = jnp.std(computed_vals, axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        for m in self.metrics:
+            m.persistent(mode)

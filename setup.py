@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+import os
+
+from setuptools import find_packages, setup
+
+_PATH_ROOT = os.path.dirname(__file__)
+
+
+def _load_about() -> dict:
+    about: dict = {}
+    with open(os.path.join(_PATH_ROOT, "metrics_tpu", "__about__.py")) as fh:
+        exec(fh.read(), about)
+    return about
+
+
+_about = _load_about()
+
+setup(
+    name="metrics-tpu",
+    version=_about["__version__"],
+    description=_about["__docs__"],
+    license=_about["__license__"],
+    packages=find_packages(exclude=["tests", "tests.*"]),
+    python_requires=">=3.9",
+    install_requires=["numpy", "jax", "packaging"],
+    extras_require={
+        "image": ["flax"],
+        "test": ["pytest", "scikit-learn", "scipy", "torch"],
+    },
+)

@@ -1,0 +1,41 @@
+"""AverageMeter tests — port of ``tests/bases/test_average.py``."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import AverageMeter
+from tests.helpers.testers import sharded_compute
+
+
+def test_average_simple():
+    avg = AverageMeter()
+    avg.update(3)
+    avg.update(1)
+    np.testing.assert_allclose(np.asarray(avg.compute()), 2.0)
+
+
+def test_average_weighted():
+    avg = AverageMeter()
+    values = jnp.asarray([1.0, 2.0])
+    weights = jnp.asarray([3.0, 1.0])
+    out = avg(values, weights)
+    np.testing.assert_allclose(np.asarray(out), 1.25)
+
+
+def test_average_vector():
+    avg = AverageMeter()
+    out = avg(jnp.asarray([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_average_distributed(world):
+    ranks = [AverageMeter() for _ in range(world)]
+    rng = np.random.default_rng(42)
+    values = rng.normal(size=(world, 5))
+    weights = rng.uniform(0.1, 1.0, size=(world, 5))
+    for r in range(world):
+        ranks[r].update(jnp.asarray(values[r]), jnp.asarray(weights[r]))
+    out = sharded_compute(ranks[0], ranks)
+    expected = (values * weights).sum() / weights.sum()
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)

@@ -1,0 +1,98 @@
+"""MetricCollection semantics — port of ``tests/bases/test_collections.py``."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MetricCollection
+from tests.helpers.testers import DummyMetricDiff, DummyMetricSum
+
+
+def test_metric_collection():
+    collection = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+
+    collection.update(5)
+    results = collection.compute()
+    assert np.asarray(results["DummyMetricSum"]) == 5
+    assert np.asarray(results["DummyMetricDiff"]) == -5
+
+    collection.reset()
+    results = collection.compute()
+    assert np.asarray(results["DummyMetricSum"]) == 0
+    assert np.asarray(results["DummyMetricDiff"]) == 0
+
+
+def test_construction_from_dict():
+    collection = MetricCollection({"b_diff": DummyMetricDiff(), "a_sum": DummyMetricSum()})
+    # deterministic sorted insertion order
+    assert list(collection.keys()) == ["a_sum", "b_diff"]
+
+
+def test_duplicate_names_raise():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([DummyMetricSum(), DummyMetricSum()])
+
+
+def test_non_metric_raises():
+    with pytest.raises(ValueError):
+        MetricCollection([DummyMetricSum(), 5])
+    with pytest.raises(ValueError):
+        MetricCollection({"a": 5})
+
+
+def test_collection_forward_filters_kwargs():
+    collection = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    out = collection(x=5, y=3)
+    assert np.asarray(out["DummyMetricSum"]) == 5
+    assert np.asarray(out["DummyMetricDiff"]) == -3
+
+
+def test_clone_with_prefix_postfix():
+    collection = MetricCollection([DummyMetricSum()])
+    pre = collection.clone(prefix="train_")
+    post = collection.clone(postfix="_val")
+    pre.update(2)
+    post.update(2)
+    assert list(pre.compute().keys()) == ["train_DummyMetricSum"]
+    assert list(post.compute().keys()) == ["DummyMetricSum_val"]
+    # base keys unchanged
+    assert list(collection.keys()) == ["DummyMetricSum"]
+
+
+def test_collection_state_dict_roundtrip():
+    collection = MetricCollection([DummyMetricSum()])
+    collection.persistent(True)
+    collection.update(3)
+    sd = collection.state_dict()
+    assert np.asarray(sd["DummyMetricSum.x"]) == 3
+
+    fresh = MetricCollection([DummyMetricSum()])
+    fresh.persistent(True)
+    fresh.load_state_dict(sd)
+    assert np.asarray(fresh.compute()["DummyMetricSum"]) == 3
+
+
+def test_collection_pure_api():
+    collection = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    state = collection.init_state()
+    state = collection.apply_update(state, 5)
+    state = collection.apply_update(state, 2)
+    out = collection.apply_compute(state)
+    assert np.asarray(out["DummyMetricSum"]) == 7
+    assert np.asarray(out["DummyMetricDiff"]) == -7
+
+
+def test_collection_apply_forward():
+    collection = MetricCollection([DummyMetricSum()])
+    state = collection.init_state()
+    state, vals = collection.apply_forward(state, 4)
+    assert np.asarray(vals["DummyMetricSum"]) == 4
+    state, vals = collection.apply_forward(state, 2)
+    assert np.asarray(vals["DummyMetricSum"]) == 2
+    assert np.asarray(collection.apply_compute(state)["DummyMetricSum"]) == 6
+
+
+def test_collection_len_iter_contains():
+    collection = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    assert len(collection) == 2
+    assert "DummyMetricSum" in collection
+    assert set(iter(collection)) == {"DummyMetricSum", "DummyMetricDiff"}

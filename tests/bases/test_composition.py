@@ -1,0 +1,165 @@
+"""Metric arithmetic tests — the 36 lazy-composition operators.
+
+Port of the behavioral spec of the reference's ``tests/bases/test_composition.py``.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.metric import CompositionalMetric, Metric
+
+
+class DummyMetric(Metric):
+
+    def __init__(self, val_to_return):
+        super().__init__()
+        self.add_state("_num_updates", jnp.zeros(()), dist_reduce_fx="sum")
+        self._val_to_return = val_to_return
+
+    def update(self, *args, **kwargs) -> None:
+        self._num_updates = self._num_updates + 1
+
+    def compute(self):
+        return jnp.asarray(self._val_to_return)
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"],
+    [(DummyMetric(2), 4), (2, 4), (2.0, 4.0), (jnp.asarray(2), 4)],
+)
+def test_metrics_add(second_operand, expected_result):
+    first = DummyMetric(2)
+    final_add = first + second_operand
+    final_radd = second_operand + first
+    assert isinstance(final_add, CompositionalMetric)
+    assert isinstance(final_radd, CompositionalMetric)
+    final_add.update()
+    final_radd.update()
+    np.testing.assert_allclose(np.asarray(final_add.compute()), expected_result)
+    np.testing.assert_allclose(np.asarray(final_radd.compute()), expected_result)
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"],
+    [(DummyMetric(3), 6), (3, 6), (3.0, 6.0)],
+)
+def test_metrics_mul(second_operand, expected_result):
+    first = DummyMetric(2)
+    final_mul = first * second_operand
+    final_rmul = second_operand * first
+    final_mul.update()
+    final_rmul.update()
+    np.testing.assert_allclose(np.asarray(final_mul.compute()), expected_result)
+    np.testing.assert_allclose(np.asarray(final_rmul.compute()), expected_result)
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"],
+    [(DummyMetric(3), -1), (3, -1), (3.0, -1.0)],
+)
+def test_metrics_sub(second_operand, expected_result):
+    first = DummyMetric(2)
+    final_sub = first - second_operand
+    final_sub.update()
+    np.testing.assert_allclose(np.asarray(final_sub.compute()), expected_result)
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"],
+    [(DummyMetric(3), 2 / 3), (3, 2 / 3), (3.0, 2 / 3)],
+)
+def test_metrics_truediv(second_operand, expected_result):
+    first = DummyMetric(2)
+    final_div = first / second_operand
+    final_div.update()
+    np.testing.assert_allclose(np.asarray(final_div.compute()), expected_result, rtol=1e-6)
+
+
+def test_metrics_rsub_rtruediv():
+    first = DummyMetric(2)
+    final_rsub = 5 - first
+    final_rdiv = 6 / first
+    final_rsub.update()
+    final_rdiv.update()
+    np.testing.assert_allclose(np.asarray(final_rsub.compute()), 3)
+    np.testing.assert_allclose(np.asarray(final_rdiv.compute()), 3.0)
+
+
+def test_metrics_floordiv_mod_pow():
+    first = DummyMetric(5)
+    for op, expected in [(first // 2, 2), (first % 2, 1), (first**2, 25)]:
+        op.update()
+        np.testing.assert_allclose(np.asarray(op.compute()), expected)
+
+
+def test_metrics_matmul():
+    first = DummyMetric([2.0, 2.0, 2.0])
+    final_matmul = first @ jnp.asarray([2.0, 2.0, 2.0])
+    final_matmul.update()
+    np.testing.assert_allclose(np.asarray(final_matmul.compute()), 12.0)
+
+
+def test_metrics_comparisons():
+    first = DummyMetric(2)
+    cases = [
+        (first == 2, True),
+        (first != 2, False),
+        (first > 1, True),
+        (first >= 2, True),
+        (first < 1, False),
+        (first <= 2, True),
+    ]
+    for metric, expected in cases:
+        metric.update()
+        assert bool(np.asarray(metric.compute())) is expected
+
+
+def test_metrics_bitwise():
+    first = DummyMetric(5)
+    cases = [
+        (first & 3, 5 & 3),
+        (first | 3, 5 | 3),
+        (first ^ 3, 5 ^ 3),
+    ]
+    for metric, expected in cases:
+        metric.update()
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected)
+
+
+def test_metrics_unary():
+    first = DummyMetric(-2)
+    for metric, expected in [(abs(first), 2), (-first, -2), (+first, 2)]:
+        metric.update()
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected)
+
+
+def test_metrics_getitem():
+    first = DummyMetric([1.0, 2.0, 3.0])
+    final = first[1]
+    final.update()
+    np.testing.assert_allclose(np.asarray(final.compute()), 2.0)
+
+
+def test_compositional_update_fans_out():
+    a, b = DummyMetric(2), DummyMetric(3)
+    comp = a + b
+    comp.update()
+    assert np.asarray(a._num_updates) == 1
+    assert np.asarray(b._num_updates) == 1
+    comp.reset()
+    assert np.asarray(a._num_updates) == 0
+    assert np.asarray(b._num_updates) == 0
+
+
+def test_nested_composition():
+    a, b = DummyMetric(2), DummyMetric(3)
+    comp = (a + b) * 2
+    comp.update()
+    np.testing.assert_allclose(np.asarray(comp.compute()), 10)
+
+
+def test_compositional_forward_returns_value():
+    a = DummyMetric(2)
+    comp = a + 3
+    val = comp()
+    np.testing.assert_allclose(np.asarray(val), 5)

@@ -1,0 +1,282 @@
+"""Base-class lifecycle tests — the engine spec.
+
+Ports the behavioral contract of the reference's ``tests/bases/test_metric.py``
+(add_state validation, reset, compute caching, forward double-result protocol,
+hash, pickle, state_dict) to the JAX engine, plus tests of the pure-functional
+interface that the reference has no analogue for.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.metric import Metric
+from tests.helpers.testers import DummyListMetric, DummyMetric, DummyMetricDiff, DummyMetricSum
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state():
+    m = DummyMetric()
+
+    m.add_state("a", jnp.asarray(0), "sum")
+    assert np.asarray(m._defaults["a"]) == 0
+
+    m.add_state("b", jnp.asarray(0), "mean")
+    m.add_state("c", jnp.asarray(0), "cat")
+    m.add_state("d", [], "cat")
+    m.add_state("e", jnp.asarray(0), None)
+    m.add_state("f", jnp.asarray(0), lambda x: x[0])
+
+    with pytest.raises(ValueError):
+        m.add_state("g", jnp.asarray(0), "xyz")
+    with pytest.raises(ValueError):
+        m.add_state("h", jnp.asarray(0), 42)
+    with pytest.raises(ValueError):
+        m.add_state("i", [jnp.asarray(0)], "sum")  # non-empty list
+    with pytest.raises(ValueError):
+        m.add_state("j", 42, "sum")  # not an array
+
+
+def test_add_state_persistent():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0), "sum", persistent=True)
+    assert m._persistent["a"]
+    m.add_state("b", jnp.asarray(0), "sum", persistent=False)
+    assert not m._persistent["b"]
+
+
+def test_reset():
+    class A(DummyMetric):
+        pass
+
+    class B(DummyListMetric):
+        pass
+
+    m = A()
+    assert np.asarray(m.x) == 0
+    m.x = jnp.asarray(5)
+    m.reset()
+    assert np.asarray(m.x) == 0
+
+    m = B()
+    assert isinstance(m.x, list) and len(m.x) == 0
+    m.x = [jnp.asarray(5)]
+    m.reset()
+    assert isinstance(m.x, list) and len(m.x) == 0
+
+
+def test_update():
+    class A(DummyMetric):
+        def update(self, x):
+            self.x = self.x + x
+
+    a = A()
+    assert np.asarray(a.x) == 0
+    assert a._computed is None
+    a.update(1)
+    assert a._computed is None
+    assert np.asarray(a.x) == 1
+    a.update(2)
+    assert np.asarray(a.x) == 3
+    assert a._computed is None
+
+
+def test_compute():
+    class A(DummyMetric):
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A()
+    assert np.asarray(a.compute()) == 0
+    a.update(1)
+    assert a._computed is None
+    assert np.asarray(a.compute()) == 1
+    assert np.asarray(a._computed) == 1
+    a.update(2)
+    assert a._computed is None
+    assert np.asarray(a.compute()) == 3
+
+    a.reset()
+    assert a._computed is None
+
+
+def test_compute_warns_before_update():
+    m = DummyMetricSum()
+    with pytest.warns(UserWarning, match="before the ``update`` method"):
+        m.compute()
+
+
+def test_hash():
+    m1, m2 = DummyMetric(), DummyMetric()
+    assert hash(m1) != hash(m2)  # identity-based state hash
+
+    m1, m2 = DummyListMetric(), DummyListMetric()
+    assert hash(m1) == hash(m2)  # empty list states hash equal
+    m1.x.append(jnp.asarray(5))
+    assert hash(m1) != hash(m2)
+
+
+def test_forward():
+    m = DummyMetricSum()
+    assert np.asarray(m(1)) == 1  # batch value
+    assert np.asarray(m(2)) == 2  # batch value, not accumulated
+    assert np.asarray(m.compute()) == 3  # accumulated
+
+    m = DummyMetricSum(compute_on_step=False)
+    assert m(1) is None
+    assert m(2) is None
+    assert np.asarray(m.compute()) == 3
+
+
+def test_forward_resets_compute_cache():
+    m = DummyMetricSum()
+    m.update(1)
+    assert np.asarray(m.compute()) == 1
+    m(2)
+    assert m._computed is None
+    assert np.asarray(m.compute()) == 3
+
+
+def test_pickle(tmp_path):
+    m = DummyMetricSum()
+    m.update(1)
+
+    restored = pickle.loads(pickle.dumps(m))
+    assert np.asarray(restored.compute()) == 1
+
+    restored.update(5)
+    assert np.asarray(restored.compute()) == 6
+
+
+def test_state_dict():
+    m = DummyMetric()
+    assert m.state_dict() == {}
+    m.persistent(True)
+    sd = m.state_dict()
+    assert "x" in sd and np.asarray(sd["x"]) == 0
+
+    m2 = DummyMetricSum()
+    m2.persistent(True)
+    m2.update(7)
+    sd = m2.state_dict()
+    assert np.asarray(sd["x"]) == 7
+
+    m3 = DummyMetricSum()
+    m3.persistent(True)
+    m3.load_state_dict(sd)
+    assert np.asarray(m3.compute()) == 7
+
+
+def test_load_state_dict_non_rank_zero(monkeypatch):
+    """Saved states are rank-aggregated; non-zero ranks must not reload them."""
+    monkeypatch.setenv("GLOBAL_RANK", "1")
+    m = DummyMetricSum()
+    m.load_state_dict({"x": np.asarray(7)})
+    assert np.asarray(m.x) == 0
+    monkeypatch.setenv("GLOBAL_RANK", "0")
+    m.load_state_dict({"x": np.asarray(7)})
+    assert np.asarray(m.x) == 7
+
+
+def test_child_metric_state_dict():
+    class TestModule:
+        def __init__(self):
+            self.metric = DummyMetric()
+            self.metric.add_state("a", jnp.asarray(0), persistent=True)
+            self.metric.add_state("b", [], persistent=True)
+            self.metric.x = jnp.asarray(5)
+
+    module = TestModule()
+    sd = module.metric.state_dict(prefix="metric.")
+    assert "metric.a" in sd and "metric.b" in sd and "metric.x" not in sd
+
+
+def test_clone():
+    m = DummyMetricSum()
+    m.update(3)
+    c = m.clone()
+    c.update(2)
+    assert np.asarray(m.compute()) == 3
+    assert np.asarray(c.compute()) == 5
+
+
+def test_device_put():
+    m = DummyMetricSum()
+    m.update(1)
+    m.device_put(jax.devices()[0])
+    assert np.asarray(m.compute()) == 1
+
+
+# ---------------------------------------------------------------------------
+# pure-functional interface
+# ---------------------------------------------------------------------------
+
+
+def test_pure_update_compute():
+    m = DummyMetricSum()
+    state = m.init_state()
+    state = m.apply_update(state, 1)
+    state = m.apply_update(state, 2)
+    assert np.asarray(m.apply_compute(state)) == 3
+    # the live metric is untouched by pure calls
+    assert np.asarray(m.x) == 0
+
+
+def test_pure_update_under_jit():
+    m = DummyMetricSum()
+    step = jax.jit(lambda s, x: m.apply_update(s, x))
+    state = m.init_state()
+    for i in range(5):
+        state = step(state, jnp.asarray(float(i)))
+    assert np.asarray(m.apply_compute(state)) == 10.0
+
+
+def test_apply_forward_matches_stateful():
+    m_pure = DummyMetricSum()
+    m_stateful = DummyMetricSum()
+    state = m_pure.init_state()
+    for x in [1.0, 2.0, 3.0]:
+        state, val = m_pure.apply_forward(state, jnp.asarray(x))
+        assert np.asarray(val) == np.asarray(m_stateful(jnp.asarray(x)))
+    assert np.asarray(m_pure.apply_compute(state)) == np.asarray(m_stateful.compute())
+
+
+def test_merge_states():
+    m = DummyMetricSum()
+    a = m.apply_update(m.init_state(), 1)
+    b = m.apply_update(m.init_state(), 2)
+    merged = m.merge_states(a, b)
+    assert np.asarray(m.apply_compute(merged)) == 3
+
+
+def test_list_state_accumulation():
+    class L(DummyListMetric):
+        def update(self, x):
+            self.x.append(jnp.asarray(x))
+
+        def compute(self):
+            from metrics_tpu.utilities.data import dim_zero_cat
+
+            return dim_zero_cat(self.x)
+
+    m = L()
+    m(jnp.asarray([1.0, 2.0]))
+    m(jnp.asarray([3.0]))
+    np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_filter_kwargs():
+    class A(DummyMetric):
+        def update(self, x, y):
+            pass
+
+    a = A()
+    assert a._filter_kwargs(x=1, y=2, z=3) == {"x": 1, "y": 2}

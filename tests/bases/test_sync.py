@@ -1,0 +1,177 @@
+"""Distributed-sync protocol tests.
+
+The analogue of the reference's ``tests/bases/test_ddp.py`` — but instead of a
+2-process gloo pool, cross-"rank" reductions run through real XLA collectives
+inside ``shard_map`` over the virtual CPU device mesh, plus injected
+``dist_sync_fn`` fakes for the eager host path (stack/flatten/reduce
+bookkeeping, state-restore semantics).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from metrics_tpu.metric import Metric
+from tests.helpers.testers import DummyListMetric, DummyMetricSum, sharded_compute
+
+
+class SumAndCatMetric(Metric):
+    """Mixed reductions: one psum state, one cat state, one max state."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("values", [], dist_reduce_fx="cat")
+        self.add_state("peak", jnp.full((), -jnp.inf), dist_reduce_fx="max")
+
+    def update(self, x):
+        x = jnp.asarray(x, dtype=jnp.float32)
+        self.total = self.total + jnp.sum(x)
+        self.values.append(x)
+        self.peak = jnp.maximum(self.peak, jnp.max(x))
+
+    def compute(self):
+        from metrics_tpu.utilities.data import dim_zero_cat
+
+        return {
+            "total": self.total,
+            "values": dim_zero_cat(self.values),
+            "peak": self.peak,
+        }
+
+
+def test_in_graph_sync_sum_cat_max():
+    world = 4
+    ranks = [SumAndCatMetric() for _ in range(world)]
+    data = [jnp.arange(3, dtype=jnp.float32) + r for r in range(world)]
+    for r, m in enumerate(ranks):
+        m.update(data[r])
+
+    out = sharded_compute(ranks[0], ranks)
+    all_data = np.concatenate([np.asarray(d) for d in data])
+    np.testing.assert_allclose(np.asarray(out["total"]), all_data.sum())
+    np.testing.assert_allclose(np.sort(np.asarray(out["values"])), np.sort(all_data))
+    np.testing.assert_allclose(np.asarray(out["peak"]), all_data.max())
+
+
+def test_in_graph_sync_matches_single_device():
+    """compute() over N simulated shards must equal the sequential stream."""
+    world = 8
+    ranks = [DummyMetricSum() for _ in range(world)]
+    seq = DummyMetricSum()
+    for i in range(world):
+        ranks[i].update(jnp.asarray(float(i)))
+        seq.update(jnp.asarray(float(i)))
+    out = sharded_compute(ranks[0], ranks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(seq.compute()))
+
+
+def test_apply_forward_dist_sync_on_step():
+    """Per-step values under dist_sync_on_step reduce across the mesh axis."""
+    world = 2
+    metric = DummyMetricSum(dist_sync_on_step=True)
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("procs",))
+
+    def step(state, x):
+        return metric.apply_forward(state, x, axis_name="procs")
+
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(P("procs"), P("procs")), out_specs=(P("procs"), P()), check_vma=False
+        )
+    )
+    state = jax.tree.map(lambda x: jnp.stack([x] * world), metric.init_state())
+    xs = jnp.asarray([1.0, 2.0])  # rank 0 sees 1.0, rank 1 sees 2.0
+    state, val = fn(state, xs)
+    # step value is synced across ranks: 1 + 2
+    np.testing.assert_allclose(np.asarray(val), 3.0)
+    # each rank's accumulated state remains local
+    np.testing.assert_allclose(np.asarray(state["x"]).reshape(-1), [1.0, 2.0])
+
+
+def test_eager_sync_with_injected_gather():
+    """Host-path bookkeeping: stacking + reduction for tensor states, flatten
+    + cat for list states, and local-state restore after compute."""
+    fake_gather = lambda x, group=None: [x, x]  # noqa: E731 - simulate 2 identical ranks
+
+    m = DummyMetricSum(dist_sync_fn=fake_gather)
+    m.update(jnp.asarray(5.0))
+    assert np.asarray(m.compute()) == 10.0
+    assert np.asarray(m.x) == 5.0  # restored after sync_context
+
+    class CatMetric(DummyListMetric):
+        def update(self, x):
+            self.x.append(jnp.asarray(x))
+
+        def compute(self):
+            from metrics_tpu.utilities.data import dim_zero_cat
+
+            return dim_zero_cat(self.x)
+
+    c = CatMetric(dist_sync_fn=fake_gather)
+    c.update(jnp.asarray([1.0, 2.0]))
+    c.update(jnp.asarray([3.0]))
+    np.testing.assert_array_equal(np.asarray(c.compute()), [1.0, 2.0, 3.0, 1.0, 2.0, 3.0])
+    assert len(c.x) == 2  # local list state restored
+
+
+def test_forward_dist_sync_on_step_does_not_pollute_local_state():
+    """Regression: the fused forward must merge the *local* batch state, not the
+    world-reduced one, or epoch-end sync double-counts."""
+    m = DummyMetricSum(dist_sync_on_step=True, dist_sync_fn=lambda x, group=None: [x, x])
+    step_val = m(jnp.asarray(1.0))
+    np.testing.assert_allclose(np.asarray(step_val), 2.0)  # step value IS synced
+    m(jnp.asarray(1.0))
+    np.testing.assert_allclose(np.asarray(m.x), 2.0)  # local accumulator stays local
+    np.testing.assert_allclose(np.asarray(m.compute()), 4.0)  # one sync at the end
+
+
+def test_eager_sync_custom_reduce_fx():
+    """A custom callable receives the stacked (world, ...) gather."""
+
+    class CustomReduce(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("x", jnp.zeros(()), dist_reduce_fx=lambda s: jnp.max(s, axis=0))
+
+        def update(self, x):
+            self.x = jnp.maximum(self.x, jnp.asarray(x, dtype=jnp.float32))
+
+        def compute(self):
+            return self.x
+
+    m = CustomReduce(dist_sync_fn=lambda x, group=None: [x, 2 * x])
+    m.update(3.0)
+    assert np.asarray(m.compute()) == 6.0
+
+
+def test_sync_context_restores_cache():
+    m = DummyMetricSum(dist_sync_fn=lambda x, group=None: [x, x, x])
+    m.update(jnp.asarray(2.0))
+    with m.sync_context(dist_sync_fn=m.dist_sync_fn):
+        assert np.asarray(m.x) == 6.0
+    assert np.asarray(m.x) == 2.0
+
+
+def test_uneven_cat_state_gather():
+    """Ragged per-rank cat states concatenate correctly (host fake path)."""
+
+    class CatMetric(DummyListMetric):
+        def update(self, x):
+            self.x.append(jnp.asarray(x))
+
+        def compute(self):
+            from metrics_tpu.utilities.data import dim_zero_cat
+
+            return dim_zero_cat(self.x)
+
+    # simulate rank 1 contributing a different-length tensor
+    def ragged_gather(x, group=None):
+        return [x, jnp.concatenate([x, x])]
+
+    c = CatMetric(dist_sync_fn=ragged_gather)
+    c.update(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_array_equal(np.asarray(c.compute()), [1.0, 2.0, 1.0, 2.0, 1.0, 2.0])

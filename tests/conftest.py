@@ -1,0 +1,28 @@
+"""Test configuration.
+
+Runs the whole suite on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) so psum/all_gather collective
+semantics are exercised without real multi-chip hardware — the strategy the
+reference implements with a 2-process gloo pool (``tests/helpers/testers.py``)
+translated to JAX's in-process SPMD testing model. Float64 is enabled so
+oracle comparisons (sklearn/scipy run in double) can use tight tolerances.
+"""
+import os
+
+# must be set before jax initializes its backends; override the environment's
+# tunnel platform (e.g. JAX_PLATFORMS=axon) — tests run on the virtual CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon sitecustomize force-registers the TPU-tunnel platform via
+# jax.config (overriding JAX_PLATFORMS); undo that before backends initialize
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402 F401
+
+NUM_DEVICES = 8

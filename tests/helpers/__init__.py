@@ -1,0 +1,5 @@
+import numpy as np
+
+
+def seed_all(seed: int) -> None:
+    np.random.seed(seed)
