@@ -19,12 +19,30 @@ _PACKAGE_ROOT = os.path.dirname(__file__)
 PROJECT_ROOT = os.path.dirname(_PACKAGE_ROOT)
 
 from metrics_tpu.average import AverageMeter  # noqa: F401 E402
+from metrics_tpu.classification import (  # noqa: F401 E402
+    F1,
+    Accuracy,
+    FBeta,
+    HammingDistance,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
 from metrics_tpu.collections import MetricCollection  # noqa: F401 E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401 E402
 
 __all__ = [
+    "Accuracy",
     "AverageMeter",
     "CompositionalMetric",
+    "F1",
+    "FBeta",
+    "HammingDistance",
     "Metric",
     "MetricCollection",
+    "Precision",
+    "Recall",
+    "Specificity",
+    "StatScores",
 ]

@@ -1,0 +1,63 @@
+"""HammingDistance module metric.
+
+Capability parity with the reference's ``torchmetrics/classification/
+hamming_distance.py:23-115``: two scalar sum states that sync with one psum.
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hamming_distance import (
+    _hamming_distance_compute,
+    _hamming_distance_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array
+
+
+class HammingDistance(Metric):
+    """Average fraction of per-label disagreements between preds and target.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HammingDistance
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> hamming_distance = HammingDistance()
+        >>> hamming_distance(preds, target)
+        Array(0.25, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("correct", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+        if not 0 < threshold < 1:
+            raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+        self.threshold = threshold
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate correct/total label counts from a batch."""
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Hamming distance over everything seen so far."""
+        return _hamming_distance_compute(self.correct, self.total)

@@ -1,0 +1,67 @@
+"""Specificity module metric.
+
+Capability parity with the reference's ``torchmetrics/classification/
+specificity.py:23-176``.
+"""
+from typing import Any, Callable, Optional
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.specificity import _specificity_compute
+from metrics_tpu.utilities.data import Array
+
+
+class Specificity(StatScores):
+    """``tn / (tn + fp)`` accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Specificity
+        >>> preds  = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> specificity = Specificity(average='macro', num_classes=3)
+        >>> specificity(preds, target)
+        Array(0.6111111, dtype=float32)
+        >>> specificity = Specificity(average='micro')
+        >>> specificity(preds, target)
+        Array(0.625, dtype=float32)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.average = average
+
+    def compute(self) -> Array:
+        """Specificity over everything seen so far."""
+        tp, fp, tn, fn = self._get_final_stats()
+        return _specificity_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce)

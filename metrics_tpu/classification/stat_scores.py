@@ -1,0 +1,139 @@
+"""StatScores module metric — the stateful tp/fp/tn/fn accumulator.
+
+Capability parity with the reference's ``torchmetrics/classification/
+stat_scores.py:24-276``: fixed-shape sum-reduced states for global counting
+(micro scalar / macro ``(C,)``) which compile to a single ``psum`` at sync, or
+list ("cat") states for samplewise counting. Base class of Accuracy /
+Precision / Recall / FBeta / F1 / Specificity.
+"""
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_compute, _stat_scores_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+
+class StatScores(Metric):
+    """Computes the number of true/false positives and true/false negatives.
+
+    Args:
+        threshold: probability threshold binarizing prob/logit predictions.
+        top_k: number of highest-probability predictions considered correct
+            for (multi-dim) multi-class inputs.
+        reduce: counting granularity — ``'micro'`` (global), ``'macro'``
+            (per class; requires ``num_classes``), ``'samples'`` (per sample).
+        num_classes: number of classes (required for macro counting).
+        ignore_index: class index excluded from the counts (macro: its stats
+            are reported as ``-1``).
+        mdmc_reduce: ``'global'`` or ``'samplewise'`` handling of the extra
+            dims of multi-dim multi-class inputs.
+        multiclass: override the inferred input case.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StatScores
+        >>> preds  = jnp.asarray([1, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> stat_scores = StatScores(reduce='micro')
+        >>> stat_scores(preds, target)
+        Array([2, 2, 6, 2, 4], dtype=int32)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        top_k: Optional[int] = None,
+        reduce: str = "micro",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        mdmc_reduce: Optional[str] = None,
+        multiclass: Optional[bool] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.reduce = reduce
+        self.mdmc_reduce = mdmc_reduce
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.multiclass = multiclass
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+
+        if not 0 < threshold < 1:
+            raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+
+        if reduce not in ["micro", "macro", "samples"]:
+            raise ValueError(f"The `reduce` {reduce} is not valid.")
+
+        if mdmc_reduce not in [None, "samplewise", "global"]:
+            raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+
+        if reduce == "macro" and (not num_classes or num_classes < 1):
+            raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+
+        if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+        if mdmc_reduce != "samplewise" and reduce != "samples":
+            zeros_shape = () if reduce == "micro" else (num_classes,)
+            default, reduce_fn = lambda: jnp.zeros(zeros_shape, dtype=jnp.int32), "sum"
+        else:
+            default, reduce_fn = lambda: [], None
+
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default=default(), dist_reduce_fx=reduce_fn)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate tp/fp/tn/fn from a batch of predictions and targets."""
+        tp, fp, tn, fn = _stat_scores_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+        )
+
+        if self.reduce != AverageMethod.SAMPLES and self.mdmc_reduce != MDMCAverageMethod.SAMPLEWISE:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+        else:
+            self.tp.append(tp)
+            self.fp.append(fp)
+            self.tn.append(tn)
+            self.fn.append(fn)
+
+    def _get_final_stats(self) -> Tuple[Array, Array, Array, Array]:
+        """Concatenate samplewise list states (no-op for fixed-shape states)."""
+        if isinstance(self.tp, list):
+            return (
+                dim_zero_cat(self.tp),
+                dim_zero_cat(self.fp),
+                dim_zero_cat(self.tn),
+                dim_zero_cat(self.fn),
+            )
+        return self.tp, self.fp, self.tn, self.fn
+
+    def compute(self) -> Array:
+        """``[..., (tp, fp, tn, fn, support)]`` over everything seen so far."""
+        tp, fp, tn, fn = self._get_final_stats()
+        return _stat_scores_compute(tp, fp, tn, fn)

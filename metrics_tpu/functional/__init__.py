@@ -1,0 +1,24 @@
+"""Stateless functional metric kernels (L3).
+
+Every metric here is a pure ``f(preds, target, **opts)`` jnp program split
+into ``_update``/``_compute`` halves so the module metrics reuse exactly the
+same math across batches (parity: ``torchmetrics/functional/__init__.py``).
+"""
+from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
+from metrics_tpu.functional.classification.f_beta import f1, fbeta  # noqa: F401
+from metrics_tpu.functional.classification.hamming_distance import hamming_distance  # noqa: F401
+from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall  # noqa: F401
+from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
+from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
+
+__all__ = [
+    "accuracy",
+    "f1",
+    "fbeta",
+    "hamming_distance",
+    "precision",
+    "precision_recall",
+    "recall",
+    "specificity",
+    "stat_scores",
+]

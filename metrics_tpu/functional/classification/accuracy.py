@@ -1,0 +1,178 @@
+"""Accuracy (incl. top-k and subset accuracy).
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/accuracy.py`` (``_accuracy_update``
+at ``:42-69``, ``_accuracy_compute`` at ``:72-94``, subset variants at
+``:97-125``, public ``accuracy`` at ``:128-296``) — the "meaningless class"
+masking for ``average=None`` is a branch-free ``where`` select instead of an
+indexed in-place write, so the whole kernel traces into one XLA program.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _check_average_arg,
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utilities.checks import _check_classification_inputs, _input_format_classification, _input_squeeze
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.utilities.enums import AverageMethod, DataType, MDMCAverageMethod
+
+
+def _check_subset_validity(mode: DataType) -> bool:
+    return mode in (DataType.MULTILABEL, DataType.MULTIDIM_MULTICLASS)
+
+
+def _mode(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+) -> DataType:
+    return _check_classification_inputs(
+        preds, target, threshold=threshold, top_k=top_k, num_classes=num_classes, multiclass=multiclass
+    )
+
+
+def _accuracy_update(
+    preds: Array,
+    target: Array,
+    reduce: str,
+    mdmc_reduce: Optional[str],
+    threshold: float,
+    num_classes: Optional[int],
+    top_k: Optional[int],
+    multiclass: Optional[bool],
+    ignore_index: Optional[int],
+    mode: DataType,
+) -> Tuple[Array, Array, Array, Array]:
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+
+    preds, target = _input_squeeze(preds, target)
+    return _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+
+
+def _accuracy_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    mode: DataType,
+) -> Array:
+    simple_average = (AverageMethod.MICRO, AverageMethod.SAMPLES)
+    if (mode == DataType.BINARY and average in simple_average) or mode == DataType.MULTILABEL:
+        numerator = tp + tn
+        denominator = tp + tn + fp + fn
+    else:
+        numerator = tp
+        denominator = tp + fn
+
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        # a class is absent when it has no TPs, FPs or FNs: flag with -1 so the
+        # reduction reports NaN for it (reference: accuracy.py:82-86)
+        meaningless = (tp | fn | fp) == 0
+        numerator = jnp.where(meaningless, -1, numerator)
+        denominator = jnp.where(meaningless, -1, denominator)
+
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _subset_accuracy_update(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+) -> Tuple[Array, Array]:
+    preds, target = _input_squeeze(preds, target)
+    preds, target, mode = _input_format_classification(preds, target, threshold=threshold, top_k=top_k)
+
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+
+    if mode == DataType.MULTILABEL:
+        correct = jnp.sum(jnp.all(preds == target, axis=1))
+        total = jnp.asarray(target.shape[0])
+    elif mode == DataType.MULTICLASS:
+        correct = jnp.sum(preds * target)
+        total = jnp.sum(target)
+    elif mode == DataType.MULTIDIM_MULTICLASS:
+        sample_correct = jnp.sum(preds * target, axis=(1, 2))
+        correct = jnp.sum(sample_correct == target.shape[2])
+        total = jnp.asarray(target.shape[0])
+    else:
+        raise ValueError(f"Subset accuracy is undefined for {mode} inputs.")
+
+    return correct, total
+
+
+def _subset_accuracy_compute(correct: Array, total: Array) -> Array:
+    return correct.astype(jnp.float32) / total
+
+
+def accuracy(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    subset_accuracy: bool = False,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Fraction of correctly classified samples (micro/macro/weighted/samples
+    averaging, top-k for multi-class probabilities, subset accuracy for
+    multi-label / multi-dim inputs).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import accuracy
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> accuracy(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    if not 0 < threshold < 1:
+        raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+
+    if top_k is not None and (not isinstance(top_k, int) or top_k <= 0):
+        raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
+
+    preds, target = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
+    mode = _mode(preds, target, threshold, top_k, num_classes, multiclass)
+    reduce = "macro" if average in ["weighted", "none", None] else average
+
+    if subset_accuracy and _check_subset_validity(mode):
+        correct, total = _subset_accuracy_update(preds, target, threshold, top_k)
+        return _subset_accuracy_compute(correct, total)
+
+    tp, fp, tn, fn = _accuracy_update(
+        preds, target, reduce, mdmc_average, threshold, num_classes, top_k, multiclass, ignore_index, mode
+    )
+    return _accuracy_compute(tp, fp, tn, fn, average, mdmc_average, mode)

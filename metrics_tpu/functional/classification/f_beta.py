@@ -1,0 +1,141 @@
+"""F-beta / F1.
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/f_beta.py`` (``_safe_divide`` at
+``:24``, ``_fbeta_compute`` at ``:30-77``): micro-averaged stats mask ignored
+classes (flagged ``-1``) via branch-free ``where`` sums; per-class scores
+auto-ignore classes absent from both preds and target.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _check_average_arg,
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """Division that returns 0 where the denominator is 0."""
+    return num / jnp.where(denom == 0, 1.0, denom)
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    if average == AverageMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        mask = tp >= 0  # classes deleted by ignore_index are flagged -1
+        tp_sum = jnp.sum(jnp.where(mask, tp, 0)).astype(jnp.float32)
+        fp_sum = jnp.sum(jnp.where(mask, fp, 0)).astype(jnp.float32)
+        fn_sum = jnp.sum(jnp.where(mask, fn, 0)).astype(jnp.float32)
+        precision = _safe_divide(tp_sum, tp_sum + fp_sum)
+        recall = _safe_divide(tp_sum, tp_sum + fn_sum)
+    else:
+        precision = _safe_divide(tp.astype(jnp.float32), (tp + fp).astype(jnp.float32))
+        recall = _safe_divide(tp.astype(jnp.float32), (tp + fn).astype(jnp.float32))
+
+    num = (1 + beta**2) * precision * recall
+    denom = beta**2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+
+    # build the ignore mask: explicitly ignored class + (for average='none')
+    # classes absent from preds and target (reference: f_beta.py:52-68)
+    ignore_mask = None
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        ignore_mask = (tp | fn | fp) == 0
+        if ignore_index is not None:
+            ignore_mask = ignore_mask.at[ignore_index].set(True)
+    elif ignore_index is not None and average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
+        ignore_mask = jnp.zeros(num.shape[-1] if mdmc_average == MDMCAverageMethod.SAMPLEWISE else num.shape[0],
+                                dtype=bool).at[ignore_index].set(True)
+        if mdmc_average != MDMCAverageMethod.SAMPLEWISE and num.ndim > 1:
+            ignore_mask = ignore_mask.reshape((-1,) + (1,) * (num.ndim - 1))
+
+    if ignore_mask is not None:
+        num = jnp.where(ignore_mask, -1.0, num)
+        denom = jnp.where(ignore_mask, -1.0, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F-beta: ``(1 + beta^2) * P * R / (beta^2 * P + R)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import fbeta
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> fbeta(preds, target, num_classes=3, beta=0.5)
+        Array(0.33333334, dtype=float32)
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 = harmonic mean of precision and recall (F-beta with ``beta=1``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import f1
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> f1(preds, target, num_classes=3)
+        Array(0.33333334, dtype=float32)
+    """
+    return fbeta(
+        preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass
+    )

@@ -1,0 +1,46 @@
+"""Hamming distance (Hamming loss).
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/hamming_distance.py``: two scalar
+sum states — ``correct`` element matches and ``total`` element count — which
+sync as a single fused psum.
+"""
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.data import Array
+
+
+def _hamming_distance_update(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+) -> Tuple[Array, int]:
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+    correct = jnp.sum(preds == target)
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
+    """Average fraction of per-label disagreements between preds and target.
+
+    Equals ``1 - accuracy`` for binary data; every other input case is
+    treated label-wise (as if multi-label).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hamming_distance
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> hamming_distance(preds, target)
+        Array(0.25, dtype=float32)
+    """
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)

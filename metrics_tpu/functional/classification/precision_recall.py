@@ -1,0 +1,185 @@
+"""Precision and recall.
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/precision_recall.py`` — the
+"meaningless class" flagging (classes with no tp/fp/fn) is a ``where`` select
+so the kernel stays a single traced XLA program.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _check_average_arg,
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+
+def _mask_meaningless(numerator: Array, denominator: Array, tp: Array, fp: Array, fn: Array) -> Tuple[Array, Array]:
+    """Flag classes absent from both preds and target (-1 -> ignored downstream)."""
+    meaningless = (tp | fn | fp) == 0
+    return jnp.where(meaningless, -1, numerator), jnp.where(meaningless, -1, denominator)
+
+
+def _precision_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    numerator = tp
+    denominator = tp + fp
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        numerator, denominator = _mask_meaningless(numerator, denominator, tp, fp, fn)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _recall_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    numerator = tp
+    denominator = tp + fn
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        numerator, denominator = _mask_meaningless(numerator, denominator, tp, fp, fn)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def precision(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """``tp / (tp + fp)`` with micro/macro/weighted/samples averaging.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision
+        >>> preds  = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> precision(preds, target, average='macro', num_classes=3)
+        Array(0.16666667, dtype=float32)
+        >>> precision(preds, target, average='micro')
+        Array(0.25, dtype=float32)
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, _, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _precision_compute(tp, fp, fn, average, mdmc_average)
+
+
+def recall(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """``tp / (tp + fn)`` with micro/macro/weighted/samples averaging.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import recall
+        >>> preds  = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> recall(preds, target, average='macro', num_classes=3)
+        Array(0.33333334, dtype=float32)
+        >>> recall(preds, target, average='micro')
+        Array(0.25, dtype=float32)
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, _, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _recall_compute(tp, fp, fn, average, mdmc_average)
+
+
+def precision_recall(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """Both precision and recall from a single stat-scores pass.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall
+        >>> preds  = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> precision_recall(preds, target, average='micro')
+        (Array(0.25, dtype=float32), Array(0.25, dtype=float32))
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, _, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return (
+        _precision_compute(tp, fp, fn, average, mdmc_average),
+        _recall_compute(tp, fp, fn, average, mdmc_average),
+    )

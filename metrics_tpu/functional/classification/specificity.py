@@ -1,0 +1,80 @@
+"""Specificity (true negative rate).
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/specificity.py``: ``tn / (tn + fp)``
+through the shared weighted stat-scores reduction.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _check_average_arg,
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+
+def _specificity_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    numerator = tn
+    denominator = tn + fp
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        meaningless = (tp | fn | fp) == 0
+        numerator = jnp.where(meaningless, -1, numerator)
+        denominator = jnp.where(meaningless, -1, denominator)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else denominator,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def specificity(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """``tn / (tn + fp)`` with micro/macro/weighted/samples averaging.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import specificity
+        >>> preds  = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> specificity(preds, target, average='macro', num_classes=3)
+        Array(0.6111111, dtype=float32)
+        >>> specificity(preds, target, average='micro')
+        Array(0.625, dtype=float32)
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _specificity_compute(tp, fp, tn, fn, average, mdmc_average)

@@ -1,0 +1,236 @@
+"""True/false positive/negative counting — the shared classification engine.
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/stat_scores.py`` (``_stat_scores``
+masked sums at ``:29-75``, the update/compute split at ``:78-138``, and the
+generic weighted reduction ``_reduce_stat_scores`` at ``:141-204``) —
+TPU-first: every path is pure static-shape jnp (boolean masks + reductions XLA
+fuses into a single pass over ``(N, C[, X])``); the data-dependent "meaningless
+class" and ignore masks are expressed as ``where`` selects instead of in-place
+indexed writes.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+
+def _del_column(data: Array, index: int) -> Array:
+    """Drop column ``index`` from a ``(N, C[, X])`` tensor (static index)."""
+    return jnp.concatenate([data[:, :index], data[:, (index + 1):]], axis=1)
+
+
+def _stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+) -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn over canonical binary ``(N, C)`` or ``(N, C, X)`` inputs.
+
+    Output shapes follow the reference contract (``stat_scores.py:44-57``):
+    micro -> scalar / ``(N,)``; macro -> ``(C,)`` / ``(N, C)``; samples ->
+    ``(N,)`` / ``(N, X)``.
+    """
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = 0 if preds.ndim == 2 else 2
+    elif reduce == "samples":
+        dim = 1
+    else:
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+
+    true_pred = target == preds
+    false_pred = target != preds
+    pos_pred = preds == 1
+    neg_pred = preds == 0
+
+    tp = jnp.sum(true_pred & pos_pred, axis=dim)
+    fp = jnp.sum(false_pred & pos_pred, axis=dim)
+    tn = jnp.sum(true_pred & neg_pred, axis=dim)
+    fn = jnp.sum(false_pred & neg_pred, axis=dim)
+
+    dtype = jnp.int32
+    return tp.astype(dtype), fp.astype(dtype), tn.astype(dtype), fn.astype(dtype)
+
+
+def _stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Canonicalize inputs and count stats (parity: ``stat_scores.py:78-123``)."""
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=threshold, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+    )
+
+    if ignore_index is not None and not 0 <= ignore_index < preds.shape[1]:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes")
+
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            # (N, C, X) -> (N*X, C)
+            preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro":
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro":
+        # flag the ignored class with -1 so downstream reductions mask it out
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+
+    return tp, fp, tn, fn
+
+
+def _stat_scores_compute(tp: Array, fp: Array, tn: Array, fn: Array) -> Array:
+    """Pack ``[tp, fp, tn, fn, support]`` along a trailing axis, -1 kept as -1."""
+    outputs = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    return jnp.where(outputs < 0, -1, outputs)
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> Array:
+    """Weighted ``numerator/denominator`` reduction shared by the stat-scores family.
+
+    Semantics (parity: ``stat_scores.py:141-204``): denominator==0 -> the
+    ``zero_division`` score; denominator<0 -> class ignored (weight zeroed, or
+    NaN when ``average`` is none); ``samplewise`` averages over the sample axis
+    first. All masking is branch-free ``where`` arithmetic — trace-safe.
+    """
+    numerator = numerator.astype(jnp.float32)
+    denominator = denominator.astype(jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    if weights is None:
+        weights = jnp.ones_like(denominator)
+    else:
+        weights = weights.astype(jnp.float32)
+
+    numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+    # all-classes-ignored under 'weighted' -> 0/0; map NaN to zero_division
+    scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+        scores = jnp.mean(scores, axis=0)
+        ignore_mask = jnp.sum(ignore_mask, axis=0).astype(bool)
+
+    if average in (AverageMethod.NONE, None):
+        scores = jnp.where(ignore_mask, jnp.nan, scores)
+    else:
+        scores = jnp.sum(scores)
+
+    return scores
+
+
+def _check_average_arg(
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    num_classes: Optional[int],
+    ignore_index: Optional[int],
+) -> None:
+    """Shared kwarg validation for the stat-scores metric family."""
+    allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+    allowed_mdmc_average = [None, "samplewise", "global"]
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+
+    if average in ["macro", "weighted", "none", None] and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Compute ``[tp, fp, tn, fn, support]`` for classification inputs.
+
+    ``reduce`` ∈ micro/macro/samples selects the counting granularity;
+    ``mdmc_reduce`` ∈ global/samplewise controls how the extra dims of
+    multi-dim multi-class inputs fold in (parity: ``stat_scores.py:207-363``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import stat_scores
+        >>> preds = jnp.asarray([1, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> stat_scores(preds, target, reduce='macro', num_classes=3)
+        Array([[0, 1, 2, 1, 1],
+               [1, 1, 1, 1, 2],
+               [1, 0, 3, 0, 1]], dtype=int32)
+        >>> stat_scores(preds, target, reduce='micro')
+        Array([2, 2, 6, 2, 4], dtype=int32)
+    """
+    if reduce not in ["micro", "macro", "samples"]:
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+
+    if mdmc_reduce not in [None, "samplewise", "global"]:
+        raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+
+    if reduce == "macro" and (not num_classes or num_classes < 1):
+        raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        top_k=top_k,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _stat_scores_compute(tp, fp, tn, fn)

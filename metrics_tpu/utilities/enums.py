@@ -22,6 +22,8 @@ class EnumStr(str, Enum):
     def __eq__(self, other: Union[str, "EnumStr", None]) -> bool:
         if other is None:
             return False
+        if isinstance(other, Enum):
+            other = other.value
         return self.value.lower() == str(other).lower()
 
     def __ne__(self, other) -> bool:

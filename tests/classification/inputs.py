@@ -1,0 +1,69 @@
+"""Deterministic random input bundles for classification tests.
+
+Mirrors the reference's fixture strategy (``tests/classification/inputs.py``):
+one ``Input(preds, target)`` namedtuple per input case — binary
+probs/labels, multilabel, multiclass probs/labels, multidim multiclass —
+including the adversarial no-match case.
+"""
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.RandomState(42)
+
+_binary_prob_inputs = Input(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE),
+    target=_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_binary_inputs = Input(
+    preds=_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+    target=_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multilabel_prob_inputs = Input(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    target=_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_multilabel_inputs = Input(
+    preds=_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    target=_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+
+def _softmax(x: np.ndarray, axis: int) -> np.ndarray:
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+_multiclass_prob_inputs = Input(
+    preds=_softmax(_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), axis=-1),
+    target=_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multiclass_inputs = Input(
+    preds=_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    target=_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multidim_multiclass_prob_inputs = Input(
+    preds=_softmax(_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM), axis=2),
+    target=_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)
+
+_multidim_multiclass_inputs = Input(
+    preds=_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+    target=_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)
+
+# adversarial case: no predictions match targets
+__temp_preds = _rng.randint(1, 2, (NUM_BATCHES, BATCH_SIZE))
+_no_match_inputs = Input(
+    preds=__temp_preds,
+    target=1 - __temp_preds,
+)

@@ -1,0 +1,123 @@
+"""Accuracy parity vs sklearn (oracle canonicalizes independently in numpy)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu import Accuracy
+from metrics_tpu.functional import accuracy
+from tests.classification.inputs import (
+    _binary_inputs,
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multidim_multiclass_inputs,
+    _multidim_multiclass_prob_inputs,
+    _multilabel_inputs,
+    _multilabel_prob_inputs,
+    _no_match_inputs,
+)
+from tests.helpers.testers import THRESHOLD, MetricTester
+
+
+def _sk_binary_prob(preds, target):
+    return sk_accuracy(target.reshape(-1), (preds >= THRESHOLD).astype(int).reshape(-1))
+
+
+def _sk_labels(preds, target):
+    return sk_accuracy(target.reshape(-1), preds.reshape(-1))
+
+
+def _sk_multiclass_prob(preds, target):
+    return sk_accuracy(target.reshape(-1), np.argmax(preds, axis=1).reshape(-1))
+
+
+def _sk_multilabel_prob(preds, target):
+    return sk_accuracy(target.reshape(-1), (preds >= THRESHOLD).astype(int).reshape(-1))
+
+
+def _sk_mdmc_prob(preds, target):
+    # (N, C, X) probs -> argmax over C, flatten with target (global micro)
+    return sk_accuracy(target.reshape(-1), np.argmax(preds, axis=1).reshape(-1))
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric",
+    [
+        (_binary_prob_inputs.preds, _binary_prob_inputs.target, _sk_binary_prob),
+        (_binary_inputs.preds, _binary_inputs.target, _sk_labels),
+        (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, _sk_multilabel_prob),
+        (_multilabel_inputs.preds, _multilabel_inputs.target, _sk_labels),
+        (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, _sk_multiclass_prob),
+        (_multiclass_inputs.preds, _multiclass_inputs.target, _sk_labels),
+        (_multidim_multiclass_prob_inputs.preds, _multidim_multiclass_prob_inputs.target, _sk_mdmc_prob),
+        (_multidim_multiclass_inputs.preds, _multidim_multiclass_inputs.target, _sk_labels),
+        (_no_match_inputs.preds, _no_match_inputs.target, _sk_labels),
+    ],
+)
+class TestAccuracy(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_accuracy_class(self, ddp, preds, target, sk_metric):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=sk_metric,
+            atol=1e-6,
+        )
+
+    def test_accuracy_fn(self, preds, target, sk_metric):
+        self.run_functional_metric_test(
+            preds, target, metric_functional=accuracy, sk_metric=sk_metric, atol=1e-6
+        )
+
+
+def test_accuracy_topk():
+    """Top-2 accuracy on a hand-computed example (reference docstring case)."""
+    target = jnp.asarray([0, 1, 2])
+    preds = jnp.asarray([[0.1, 0.9, 0.0], [0.3, 0.1, 0.6], [0.2, 0.5, 0.3]])
+    np.testing.assert_allclose(accuracy(preds, target, top_k=2), 2 / 3, atol=1e-6)
+    acc = Accuracy(top_k=2)
+    np.testing.assert_allclose(acc(preds, target), 2 / 3, atol=1e-6)
+
+
+def test_subset_accuracy_multilabel():
+    """Multilabel subset accuracy requires whole rows to match."""
+    rng = np.random.RandomState(0)
+    preds = rng.rand(64, 4)
+    target = rng.randint(0, 2, (64, 4))
+    expected = np.mean(((preds >= THRESHOLD).astype(int) == target).all(axis=1))
+    result = accuracy(jnp.asarray(preds), jnp.asarray(target), subset_accuracy=True)
+    np.testing.assert_allclose(result, expected, atol=1e-6)
+
+
+def test_subset_accuracy_mdmc():
+    """Multidim multiclass subset accuracy: all sub-samples must be correct."""
+    rng = np.random.RandomState(1)
+    preds = rng.randint(0, 3, (32, 6))
+    target = rng.randint(0, 3, (32, 6))
+    expected = np.mean((preds == target).all(axis=1))
+    result = accuracy(jnp.asarray(preds), jnp.asarray(target), subset_accuracy=True)
+    np.testing.assert_allclose(result, expected, atol=1e-6)
+
+
+def test_accuracy_average_macro():
+    """Macro accuracy equals sklearn balanced recall over present classes."""
+    from sklearn.metrics import recall_score
+
+    rng = np.random.RandomState(2)
+    preds = rng.randint(0, 5, 200)
+    target = rng.randint(0, 5, 200)
+    expected = recall_score(target, preds, average="macro", labels=list(range(5)), zero_division=0)
+    result = accuracy(jnp.asarray(preds), jnp.asarray(target), average="macro", num_classes=5)
+    np.testing.assert_allclose(result, expected, atol=1e-6)
+
+
+def test_accuracy_mode_locking():
+    """Feeding a different input case than previous updates raises."""
+    acc = Accuracy()
+    acc(jnp.asarray([0.3, 0.8, 0.9]), jnp.asarray([1, 1, 0]))  # binary probs
+    with pytest.raises(ValueError, match="You can not use"):
+        acc(jnp.asarray([[0.1, 0.9], [0.8, 0.2]]), jnp.asarray([[1, 0], [0, 1]]))  # multilabel

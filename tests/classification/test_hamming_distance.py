@@ -1,0 +1,59 @@
+"""HammingDistance parity vs sklearn / numpy oracle."""
+import numpy as np
+import pytest
+from sklearn.metrics import hamming_loss as sk_hamming_loss
+
+from metrics_tpu import HammingDistance
+from metrics_tpu.functional import hamming_distance
+from tests.classification.inputs import (
+    _binary_inputs,
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multilabel_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_binary_prob(preds, target):
+    return sk_hamming_loss(target.reshape(-1), (preds >= THRESHOLD).astype(int).reshape(-1))
+
+
+def _sk_labels(preds, target):
+    return sk_hamming_loss(target.reshape(-1), preds.reshape(-1))
+
+
+def _sk_multiclass_onehot(preds, target):
+    # the library treats multiclass labels as one-hot multi-label columns
+    p = np.eye(NUM_CLASSES, dtype=int)[preds.reshape(-1)]
+    t = np.eye(NUM_CLASSES, dtype=int)[target.reshape(-1)]
+    return np.mean(p != t)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric",
+    [
+        (_binary_prob_inputs.preds, _binary_prob_inputs.target, _sk_binary_prob),
+        (_binary_inputs.preds, _binary_inputs.target, _sk_labels),
+        (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, _sk_binary_prob),
+        (_multilabel_inputs.preds, _multilabel_inputs.target, _sk_labels),
+        (_multiclass_inputs.preds, _multiclass_inputs.target, _sk_multiclass_onehot),
+    ],
+)
+class TestHammingDistance(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_hamming_class(self, ddp, preds, target, sk_metric):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=HammingDistance,
+            sk_metric=sk_metric,
+            atol=1e-6,
+        )
+
+    def test_hamming_fn(self, preds, target, sk_metric):
+        self.run_functional_metric_test(
+            preds, target, metric_functional=hamming_distance, sk_metric=sk_metric, atol=1e-6
+        )

@@ -1,0 +1,181 @@
+"""Precision / Recall / FBeta / F1 / Specificity parity vs sklearn."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score, multilabel_confusion_matrix, precision_score, recall_score
+
+from metrics_tpu import F1, FBeta, Precision, Recall, Specificity
+from metrics_tpu.functional import f1, fbeta, precision, recall, specificity
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+# each case: (preds, target, canonicalize -> (y_pred, y_true, labels))
+
+
+def _canon_binary_prob(preds, target):
+    return (preds >= THRESHOLD).astype(int).reshape(-1), target.reshape(-1), [0, 1]
+
+
+def _canon_multiclass(preds, target):
+    return preds.reshape(-1), target.reshape(-1), list(range(NUM_CLASSES))
+
+
+def _canon_multiclass_prob(preds, target):
+    return np.argmax(preds, axis=1).reshape(-1), target.reshape(-1), list(range(NUM_CLASSES))
+
+
+def _canon_multilabel_prob(preds, target):
+    return (preds >= THRESHOLD).astype(int).reshape(-1), target.reshape(-1), [0, 1]
+
+
+def _sk_prec_recall(preds, target, sk_fn, canon, average, **fn_kwargs):
+    y_pred, y_true, labels = canon(preds, target)
+    if average == "micro" and len(labels) == 2:
+        # binary/multilabel micro in the library counts class-1 as positive
+        return sk_fn(y_true, y_pred, average="binary", zero_division=0, **fn_kwargs)
+    return sk_fn(y_true, y_pred, average=average, labels=labels, zero_division=0, **fn_kwargs)
+
+
+def _sk_specificity(preds, target, canon, average):
+    y_pred, y_true, labels = canon(preds, target)
+    if len(labels) == 2:
+        # binary: positive class only
+        tn = np.sum((y_pred == 0) & (y_true == 0))
+        fp = np.sum((y_pred == 1) & (y_true == 0))
+        return tn / max(tn + fp, 1)
+    mcm = multilabel_confusion_matrix(y_true, y_pred, labels=labels)
+    tn, fp = mcm[:, 0, 0], mcm[:, 0, 1]
+    if average == "micro":
+        return tn.sum() / max((tn + fp).sum(), 1)
+    per_class = np.where((tn + fp) == 0, 0.0, tn / np.maximum(tn + fp, 1))
+    if average == "macro":
+        return per_class.mean()
+    if average == "weighted":
+        support = mcm[:, 1, 0] + mcm[:, 1, 1]  # fn + tp
+        weights = np.where((tn + fp) == 0, 0, tn + fp)
+        return np.average(per_class, weights=weights) if weights.sum() else 0.0
+    return per_class
+
+
+_cases = [
+    (_binary_prob_inputs.preds, _binary_prob_inputs.target, _canon_binary_prob, None),
+    (_multiclass_inputs.preds, _multiclass_inputs.target, _canon_multiclass, NUM_CLASSES),
+    (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, _canon_multiclass_prob, NUM_CLASSES),
+    (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, _canon_multilabel_prob, None),
+]
+
+
+@pytest.mark.parametrize("preds, target, canon, num_classes", _cases)
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+class TestPrecisionRecall(MetricTester):
+
+    def _needed_args(self, average, num_classes):
+        if average == "micro" and num_classes is None:
+            return {"average": average}
+        if num_classes is None:
+            pytest.skip("macro/weighted need num_classes; binary/multilabel micro-only here")
+        return {"average": average, "num_classes": num_classes}
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_precision_class(self, ddp, preds, target, canon, num_classes, average):
+        args = self._needed_args(average, num_classes)
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Precision,
+            sk_metric=partial(_sk_prec_recall, sk_fn=precision_score, canon=canon, average=average),
+            metric_args=args,
+            atol=1e-6,
+        )
+
+    def test_precision_fn(self, preds, target, canon, num_classes, average):
+        args = self._needed_args(average, num_classes)
+        self.run_functional_metric_test(
+            preds, target, metric_functional=precision,
+            sk_metric=partial(_sk_prec_recall, sk_fn=precision_score, canon=canon, average=average),
+            metric_args=args, atol=1e-6,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_recall_class(self, ddp, preds, target, canon, num_classes, average):
+        args = self._needed_args(average, num_classes)
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Recall,
+            sk_metric=partial(_sk_prec_recall, sk_fn=recall_score, canon=canon, average=average),
+            metric_args=args,
+            atol=1e-6,
+        )
+
+    def test_recall_fn(self, preds, target, canon, num_classes, average):
+        args = self._needed_args(average, num_classes)
+        self.run_functional_metric_test(
+            preds, target, metric_functional=recall,
+            sk_metric=partial(_sk_prec_recall, sk_fn=recall_score, canon=canon, average=average),
+            metric_args=args, atol=1e-6,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_fbeta_class(self, ddp, preds, target, canon, num_classes, average):
+        args = self._needed_args(average, num_classes)
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=FBeta,
+            sk_metric=partial(_sk_prec_recall, sk_fn=fbeta_score, canon=canon, average=average, beta=2.0),
+            metric_args={**args, "beta": 2.0},
+            atol=1e-6,
+        )
+
+    def test_f1_fn(self, preds, target, canon, num_classes, average):
+        args = self._needed_args(average, num_classes)
+        self.run_functional_metric_test(
+            preds, target, metric_functional=f1,
+            sk_metric=partial(_sk_prec_recall, sk_fn=fbeta_score, canon=canon, average=average, beta=1.0),
+            metric_args=args, atol=1e-6,
+        )
+
+    @pytest.mark.parametrize("ddp", [False])
+    def test_specificity_class(self, ddp, preds, target, canon, num_classes, average):
+        args = self._needed_args(average, num_classes)
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Specificity,
+            sk_metric=partial(_sk_specificity, canon=canon, average=average),
+            metric_args=args,
+            atol=1e-6,
+        )
+
+
+def test_f1_module_matches_fbeta1():
+    import jax.numpy as jnp
+
+    target = jnp.asarray([0, 1, 2, 0, 1, 2])
+    preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+    np.testing.assert_allclose(
+        F1(num_classes=3)(preds, target), FBeta(num_classes=3, beta=1.0)(preds, target), atol=1e-8
+    )
+
+
+def test_precision_recall_combo_fn():
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional import precision_recall
+
+    preds = jnp.asarray([2, 0, 2, 1])
+    target = jnp.asarray([1, 1, 2, 0])
+    p, r = precision_recall(preds, target, average="micro")
+    np.testing.assert_allclose(p, 0.25, atol=1e-6)
+    np.testing.assert_allclose(r, 0.25, atol=1e-6)

@@ -1,0 +1,124 @@
+"""StatScores parity vs an independent numpy oracle."""
+from functools import partial
+
+import numpy as np
+import pytest
+
+from metrics_tpu import StatScores
+from metrics_tpu.functional import stat_scores
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _onehot(labels, num_classes):
+    return np.eye(num_classes, dtype=int)[labels]
+
+
+def _canonical_binary_cols(preds, target):
+    """Canonical (N, C) binary arrays for each fixture type."""
+    if preds.ndim == target.ndim and np.issubdtype(np.asarray(preds).dtype, np.floating):
+        if preds.ndim == 1:  # binary probs
+            return (preds >= THRESHOLD).astype(int)[:, None], target[:, None]
+        return (preds >= THRESHOLD).astype(int), target  # multilabel probs
+    if preds.ndim == target.ndim + 1:  # multiclass probs
+        return _onehot(np.argmax(preds, axis=1), preds.shape[1]), _onehot(target, preds.shape[1])
+    # multiclass labels
+    return _onehot(preds, NUM_CLASSES), _onehot(target, NUM_CLASSES)
+
+
+def _np_stat_scores(preds, target, reduce="micro"):
+    p, t = _canonical_binary_cols(np.asarray(preds), np.asarray(target))
+    axis = None if reduce == "micro" else (0 if reduce == "macro" else 1)
+    tp = np.sum((p == 1) & (t == 1), axis=axis)
+    fp = np.sum((p == 1) & (t == 0), axis=axis)
+    tn = np.sum((p == 0) & (t == 0), axis=axis)
+    fn = np.sum((p == 0) & (t == 1), axis=axis)
+    return np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+
+
+_cases = [
+    (_binary_prob_inputs.preds, _binary_prob_inputs.target, None),
+    (_multiclass_inputs.preds, _multiclass_inputs.target, NUM_CLASSES),
+    (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, NUM_CLASSES),
+    (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, NUM_CLASSES),
+]
+
+
+@pytest.mark.parametrize("preds, target, num_classes", _cases)
+@pytest.mark.parametrize("reduce_", ["micro", "macro"])
+class TestStatScores(MetricTester):
+
+    def _args(self, reduce_, num_classes):
+        if reduce_ == "macro":
+            if num_classes is None:
+                pytest.skip("macro requires num_classes")
+            return {"reduce": reduce_, "num_classes": num_classes}
+        return {"reduce": reduce_}
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_stat_scores_class(self, ddp, preds, target, num_classes, reduce_):
+        args = self._args(reduce_, num_classes)
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=StatScores,
+            sk_metric=partial(_np_stat_scores, reduce=reduce_),
+            metric_args=args,
+        )
+
+    def test_stat_scores_fn(self, preds, target, num_classes, reduce_):
+        args = self._args(reduce_, num_classes)
+        self.run_functional_metric_test(
+            preds, target, metric_functional=stat_scores,
+            sk_metric=partial(_np_stat_scores, reduce=reduce_), metric_args=args,
+        )
+
+
+def test_stat_scores_samples_reduce():
+    """samples reduce keeps a per-sample axis and accumulates by concatenation."""
+    rng = np.random.RandomState(7)
+    preds = rng.randint(0, NUM_CLASSES, (4, 16))
+    target = rng.randint(0, NUM_CLASSES, (4, 16))
+
+    metric = StatScores(reduce="samples", num_classes=NUM_CLASSES)
+    for i in range(4):
+        metric.update(preds[i], target[i])
+    result = np.asarray(metric.compute())
+    assert result.shape == (64, 5)
+
+    p = np.eye(NUM_CLASSES, dtype=int)[preds.reshape(-1)]
+    t = np.eye(NUM_CLASSES, dtype=int)[target.reshape(-1)]
+    tp = np.sum((p == 1) & (t == 1), axis=1)
+    np.testing.assert_array_equal(result[:, 0], tp)
+
+
+def test_stat_scores_ignore_index_macro():
+    """macro + ignore_index flags the ignored class with -1."""
+    preds = np.asarray([1, 0, 2, 1])
+    target = np.asarray([1, 1, 2, 0])
+    result = np.asarray(stat_scores(preds, target, reduce="macro", num_classes=3, ignore_index=1))
+    assert (result[1] == -1).all()
+    assert (result[[0, 2]] >= 0).all()
+
+
+def test_stat_scores_mdmc():
+    """multi-dim inputs under both mdmc_reduce modes."""
+    rng = np.random.RandomState(11)
+    preds = rng.randint(0, 3, (8, 6))
+    target = rng.randint(0, 3, (8, 6))
+
+    glob = np.asarray(stat_scores(preds, target, reduce="micro", mdmc_reduce="global"))
+    assert glob.shape == (5,)
+    p = np.eye(3, dtype=int)[preds.reshape(-1)]
+    t = np.eye(3, dtype=int)[target.reshape(-1)]
+    np.testing.assert_array_equal(glob[0], np.sum((p == 1) & (t == 1)))
+
+    sw = np.asarray(stat_scores(preds, target, reduce="micro", mdmc_reduce="samplewise"))
+    assert sw.shape == (8, 5)
+    np.testing.assert_array_equal(sw[:, 0].sum(), glob[0])
