@@ -1,0 +1,129 @@
+"""Step-overhead microbenchmark (BASELINE.json config #2).
+
+Workload: the MetricCollection of Accuracy + macro Precision/Recall/F1 —
+per-step state update fused into one jitted XLA program on the TPU chip,
+vs the reference library's eager per-metric updates (TorchMetrics running on
+torch-CPU, imported from the read-only reference checkout when available).
+
+Prints exactly one JSON line:
+``{"metric": "...", "value": N, "unit": "...", "vs_baseline": N}`` where
+``vs_baseline`` is reference_time / our_time (higher is better, >1 = faster
+than the reference).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+NUM_CLASSES = 10
+BATCH = 1024
+STEPS = 50
+
+
+def _bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+
+    collection = MetricCollection(
+        [
+            Accuracy(),
+            Precision(average="macro", num_classes=NUM_CLASSES),
+            Recall(average="macro", num_classes=NUM_CLASSES),
+            F1(average="macro", num_classes=NUM_CLASSES),
+        ]
+    )
+
+    rng = np.random.RandomState(0)
+    logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH))
+
+    step = jax.jit(lambda s, p, t: collection.apply_update(s, p, t))
+    state = collection.init_state()
+    state = step(state, preds, target)  # compile
+    jax.block_until_ready(jax.tree.leaves(state))
+
+    start = time.perf_counter()
+    for _ in range(STEPS):
+        state = step(state, preds, target)
+    jax.block_until_ready(jax.tree.leaves(state))
+    return (time.perf_counter() - start) / STEPS
+
+
+def _bench_reference() -> float:
+    """TorchMetrics (the reference) on torch-CPU, same workload."""
+    sys.path.insert(0, "/root/reference")
+    try:
+        if "pkg_resources" not in sys.modules:
+            # the reference's version gates use the long-removed pkg_resources API
+            import types
+
+            shim = types.ModuleType("pkg_resources")
+
+            class DistributionNotFound(Exception):
+                pass
+
+            def get_distribution(name):
+                import importlib.metadata
+
+                class _Dist:
+                    def __init__(self, version):
+                        self.version = version
+
+                try:
+                    return _Dist(importlib.metadata.version(name))
+                except importlib.metadata.PackageNotFoundError as err:
+                    raise DistributionNotFound(name) from err
+
+            shim.DistributionNotFound = DistributionNotFound
+            shim.get_distribution = get_distribution
+            sys.modules["pkg_resources"] = shim
+
+        import torch
+        from torchmetrics import Accuracy, F1, MetricCollection, Precision, Recall
+
+        collection = MetricCollection(
+            [
+                Accuracy(),
+                Precision(average="macro", num_classes=NUM_CLASSES),
+                Recall(average="macro", num_classes=NUM_CLASSES),
+                F1(average="macro", num_classes=NUM_CLASSES),
+            ]
+        )
+        rng = np.random.RandomState(0)
+        logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+        preds = torch.from_numpy(logits / logits.sum(-1, keepdims=True))
+        target = torch.from_numpy(rng.randint(0, NUM_CLASSES, BATCH))
+
+        collection.update(preds, target)  # warm caches
+        start = time.perf_counter()
+        for _ in range(STEPS):
+            collection.update(preds, target)
+        return (time.perf_counter() - start) / STEPS
+    except Exception:
+        return float("nan")
+    finally:
+        sys.path.pop(0)
+
+
+def main() -> None:
+    ours = _bench_ours()
+    ref = _bench_reference()
+    vs_baseline = (ref / ours) if (ref == ref) else None
+    print(
+        json.dumps(
+            {
+                "metric": "metric_collection_update_step",
+                "value": round(ours * 1e6, 2),
+                "unit": "us/step",
+                "vs_baseline": round(vs_baseline, 3) if vs_baseline is not None else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -124,16 +124,7 @@ class Accuracy(StatScores):
                 mode=self.mode,
             )
 
-            if self.reduce != "samples" and self.mdmc_reduce != "samplewise":
-                self.tp = self.tp + tp
-                self.fp = self.fp + fp
-                self.tn = self.tn + tn
-                self.fn = self.fn + fn
-            else:
-                self.tp.append(tp)
-                self.fp.append(fp)
-                self.tn.append(tn)
-                self.fn.append(fn)
+            self._accumulate(tp, fp, tn, fn)
 
     def compute(self) -> Array:
         """Accuracy over everything seen so far."""

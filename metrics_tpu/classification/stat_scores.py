@@ -111,6 +111,10 @@ class StatScores(Metric):
             ignore_index=self.ignore_index,
         )
 
+        self._accumulate(tp, fp, tn, fn)
+
+    def _accumulate(self, tp: Array, fp: Array, tn: Array, fn: Array) -> None:
+        """Add fixed-shape counts in place, or append samplewise counts."""
         if self.reduce != AverageMethod.SAMPLES and self.mdmc_reduce != MDMCAverageMethod.SAMPLEWISE:
             self.tp = self.tp + tp
             self.fp = self.fp + fp

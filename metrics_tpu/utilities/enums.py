@@ -20,10 +20,10 @@ class EnumStr(str, Enum):
             return None
 
     def __eq__(self, other: Union[str, "EnumStr", None]) -> bool:
-        if other is None:
-            return False
         if isinstance(other, Enum):
             other = other.value
+        # str(None) == "none" intentionally matches the NONE member, so users
+        # may spell the no-averaging mode either average=None or average="none"
         return self.value.lower() == str(other).lower()
 
     def __ne__(self, other) -> bool:

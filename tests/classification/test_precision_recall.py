@@ -179,3 +179,15 @@ def test_precision_recall_combo_fn():
     p, r = precision_recall(preds, target, average="micro")
     np.testing.assert_allclose(p, 0.25, atol=1e-6)
     np.testing.assert_allclose(r, 0.25, atol=1e-6)
+
+
+def test_average_none_matches_none_string():
+    """average=None and average='none' are the same mode, incl. absent-class NaN."""
+    import jax.numpy as jnp
+
+    preds = jnp.asarray([0, 0, 1, 1])
+    target = jnp.asarray([0, 0, 1, 1])
+    for avg in (None, "none"):
+        out = np.asarray(precision(preds, target, average=avg, num_classes=3))
+        np.testing.assert_allclose(out[:2], [1.0, 1.0])
+        assert np.isnan(out[2]), f"absent class must be NaN for average={avg!r}"
