@@ -20,11 +20,25 @@ PROJECT_ROOT = os.path.dirname(_PACKAGE_ROOT)
 
 from metrics_tpu.average import AverageMeter  # noqa: F401 E402
 from metrics_tpu.classification import (  # noqa: F401 E402
+    AUC,
+    AUROC,
     F1,
+    ROC,
     Accuracy,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    CohenKappa,
+    ConfusionMatrix,
     FBeta,
     HammingDistance,
+    Hinge,
+    IoU,
+    KLDivergence,
+    MatthewsCorrcoef,
     Precision,
+    PrecisionRecallCurve,
     Recall,
     Specificity,
     StatScores,
@@ -33,15 +47,29 @@ from metrics_tpu.collections import MetricCollection  # noqa: F401 E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401 E402
 
 __all__ = [
+    "AUC",
+    "AUROC",
     "Accuracy",
     "AverageMeter",
+    "AveragePrecision",
+    "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve",
+    "BinnedRecallAtFixedPrecision",
+    "CohenKappa",
     "CompositionalMetric",
+    "ConfusionMatrix",
     "F1",
     "FBeta",
     "HammingDistance",
+    "Hinge",
+    "IoU",
+    "KLDivergence",
+    "MatthewsCorrcoef",
     "Metric",
     "MetricCollection",
     "Precision",
+    "PrecisionRecallCurve",
+    "ROC",
     "Recall",
     "Specificity",
     "StatScores",

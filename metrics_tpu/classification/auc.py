@@ -1,0 +1,52 @@
+"""AUC module metric (generic trapezoidal area under accumulated x/y points).
+
+Capability parity with the reference's ``torchmetrics/classification/
+auc.py:24-99``.
+"""
+from typing import Any, Callable, Optional
+
+from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+
+
+class AUC(Metric):
+    """Area under an accumulated (x, y) curve.
+
+    Args:
+        reorder: sort the accumulated x points before integrating.
+    """
+
+    is_differentiable = False
+    _fusable = False
+
+    def __init__(
+        self,
+        reorder: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.reorder = reorder
+
+        self.add_state("x", default=[], dist_reduce_fx="cat")
+        self.add_state("y", default=[], dist_reduce_fx="cat")
+
+    def update(self, x: Array, y: Array) -> None:
+        """Append curve points."""
+        x, y = _auc_update(x, y)
+        self.x.append(x)
+        self.y.append(y)
+
+    def compute(self) -> Array:
+        """AUC over all accumulated points."""
+        x = dim_zero_cat(self.x)
+        y = dim_zero_cat(self.y)
+        return _auc_compute(x, y, reorder=self.reorder)

@@ -1,0 +1,89 @@
+"""AUROC module metric.
+
+Capability parity with the reference's ``torchmetrics/classification/
+auroc.py:26-192``: cat-reduced ``preds``/``target`` states with mode locking.
+"""
+from typing import Any, Callable, Optional
+
+from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+
+
+class AUROC(Metric):
+    """Area under the ROC curve over all batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUROC
+        >>> preds = jnp.asarray([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> auroc = AUROC(pos_label=1)
+        >>> auroc(preds, target)
+        Array(0.5, dtype=float32)
+    """
+
+    is_differentiable = False
+    _fusable = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        average: Optional[str] = "macro",
+        max_fpr: Optional[float] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        self.average = average
+        self.max_fpr = max_fpr
+        self.mode = None
+
+        allowed_average = (None, "macro", "weighted", "micro")
+        if average not in allowed_average:
+            raise ValueError(
+                f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+            )
+
+        if max_fpr is not None and (not isinstance(max_fpr, float) or not 0 < max_fpr <= 1):
+            raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append the batch scores/targets to the state."""
+        preds, target, mode = _auroc_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+        if self.mode is not None and self.mode != mode:
+            raise ValueError(
+                "The mode of data (binary, multi-label, multi-class) should be constant, but changed"
+                f" between batches from {self.mode} to {mode}"
+            )
+        self.mode = mode
+
+    def compute(self) -> Array:
+        """AUROC over everything seen so far."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _auroc_compute(
+            preds,
+            target,
+            self.mode,
+            num_classes=self.num_classes,
+            pos_label=self.pos_label,
+            average=self.average,
+            max_fpr=self.max_fpr,
+        )

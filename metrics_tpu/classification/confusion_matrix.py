@@ -1,0 +1,77 @@
+"""ConfusionMatrix module metric.
+
+Capability parity with the reference's ``torchmetrics/classification/
+confusion_matrix.py:23-147``: one fixed-shape sum-reduced ``confmat`` state
+(``(C, C)`` or ``(C, 2, 2)``) that syncs with a single psum.
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array
+
+
+class ConfusionMatrix(Metric):
+    """Accumulated confusion matrix over batches.
+
+    Args:
+        num_classes: number of classes.
+        normalize: ``None``/``'none'`` | ``'true'`` | ``'pred'`` | ``'all'``.
+        threshold: probability threshold for binary/multilabel predictions.
+        multilabel: compute a per-label ``(C, 2, 2)`` table instead.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> confmat = ConfusionMatrix(num_classes=2)
+        >>> confmat(preds, target)
+        Array([[2, 0],
+               [1, 1]], dtype=int32)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        normalize: Optional[str] = None,
+        threshold: float = 0.5,
+        multilabel: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self.threshold = threshold
+        self.multilabel = multilabel
+
+        allowed_normalize = ("true", "pred", "all", "none", None)
+        if normalize not in allowed_normalize:
+            raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+
+        default = jnp.zeros((num_classes, 2, 2) if multilabel else (num_classes, num_classes), dtype=jnp.int32)
+        self.add_state("confmat", default=default, dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the batch confusion matrix."""
+        confmat = _confusion_matrix_update(preds, target, self.num_classes, self.threshold, self.multilabel)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        """Confusion matrix over everything seen so far."""
+        return _confusion_matrix_compute(self.confmat, self.normalize)

@@ -1,0 +1,66 @@
+"""Hinge module metric.
+
+Capability parity with the reference's ``torchmetrics/classification/
+hinge.py:22-127``: sum-reduced ``measure``/``total`` states.
+"""
+from typing import Any, Callable, Optional, Union
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hinge import MulticlassMode, _hinge_compute, _hinge_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array
+
+
+class Hinge(Metric):
+    """Mean hinge loss accumulated over batches.
+
+    Example (binary):
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Hinge
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> hinge = Hinge()
+        >>> hinge(preds, target)
+        Array(0.3, dtype=float32)
+    """
+
+    is_differentiable = True
+
+    def __init__(
+        self,
+        squared: bool = False,
+        multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("measure", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+        if multiclass_mode not in (None, MulticlassMode.CRAMMER_SINGER, MulticlassMode.ONE_VS_ALL):
+            raise ValueError(
+                "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+                "(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL,"
+                f" got {multiclass_mode}."
+            )
+
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the batch hinge measure."""
+        measure, total = _hinge_update(preds, target, squared=self.squared, multiclass_mode=self.multiclass_mode)
+        self.measure = measure + self.measure
+        self.total = total + self.total
+
+    def compute(self) -> Array:
+        """Hinge loss over everything seen so far."""
+        return _hinge_compute(self.measure, self.total)

@@ -1,0 +1,58 @@
+"""IoU (Jaccard index) module metric.
+
+Capability parity with the reference's ``torchmetrics/classification/
+iou.py:23-112``: a ConfusionMatrix subclass reducing diag/union at compute.
+"""
+from typing import Any, Callable, Optional
+
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
+from metrics_tpu.functional.classification.iou import _iou_from_confmat
+from metrics_tpu.utilities.data import Array
+
+
+class IoU(ConfusionMatrix):
+    """Intersection over union accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import IoU
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> iou = IoU(num_classes=2)
+        >>> iou(preds, target)
+        Array(0.5833333, dtype=float32)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        absent_score: float = 0.0,
+        threshold: float = 0.5,
+        reduction: str = "elementwise_mean",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes,
+            normalize=None,
+            threshold=threshold,
+            multilabel=False,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.reduction = reduction
+        self.ignore_index = ignore_index
+        self.absent_score = absent_score
+
+    def compute(self) -> Array:
+        """IoU over everything seen so far."""
+        return _iou_from_confmat(
+            self.confmat, self.num_classes, self.ignore_index, self.absent_score, self.reduction
+        )

@@ -1,0 +1,77 @@
+"""KLDivergence module metric.
+
+Capability parity with the reference's ``torchmetrics/classification/
+kldivergence.py:24-108``: sum state for mean/sum reduction, cat list state
+for 'none'.
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.kldivergence import _kld_compute, _kld_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+
+
+class KLDivergence(Metric):
+    """KL divergence accumulated over batches.
+
+    Args:
+        log_prob: inputs are log-probabilities (already normalized).
+        reduction: ``'mean' | 'sum' | 'none' | None``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import KLDivergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> kldivergence = KLDivergence()
+        >>> kldivergence(p, q)
+        Array(0.08540184, dtype=float32)
+    """
+
+    is_differentiable = True
+
+    def __init__(
+        self,
+        log_prob: bool = False,
+        reduction: Optional[str] = "mean",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        self.log_prob = log_prob
+
+        allowed_reduction = ("mean", "sum", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        if self.reduction in ("mean", "sum"):
+            self.add_state("measures", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, p: Array, q: Array) -> None:
+        """Accumulate per-row KL measures."""
+        measures, total = _kld_update(p, q, self.log_prob)
+        if self.reduction is None or self.reduction == "none":
+            self.measures.append(measures)
+        else:
+            self.measures = self.measures + jnp.sum(measures)
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """KL divergence over everything seen so far."""
+        measures = dim_zero_cat(self.measures) if self.reduction in ("none", None) else self.measures
+        return _kld_compute(measures, self.total, self.reduction)

@@ -5,20 +5,44 @@ into ``_update``/``_compute`` halves so the module metrics reuse exactly the
 same math across batches (parity: ``torchmetrics/functional/__init__.py``).
 """
 from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
+from metrics_tpu.functional.classification.auc import auc  # noqa: F401
+from metrics_tpu.functional.classification.auroc import auroc  # noqa: F401
+from metrics_tpu.functional.classification.average_precision import average_precision  # noqa: F401
+from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa  # noqa: F401
+from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix  # noqa: F401
+from metrics_tpu.functional.classification.dice import dice_score  # noqa: F401
 from metrics_tpu.functional.classification.f_beta import f1, fbeta  # noqa: F401
 from metrics_tpu.functional.classification.hamming_distance import hamming_distance  # noqa: F401
+from metrics_tpu.functional.classification.hinge import hinge  # noqa: F401
+from metrics_tpu.functional.classification.iou import iou  # noqa: F401
+from metrics_tpu.functional.classification.kldivergence import kldivergence  # noqa: F401
+from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef  # noqa: F401
 from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall  # noqa: F401
+from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve  # noqa: F401
+from metrics_tpu.functional.classification.roc import roc  # noqa: F401
 from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
 
 __all__ = [
     "accuracy",
+    "auc",
+    "auroc",
+    "average_precision",
+    "cohen_kappa",
+    "confusion_matrix",
+    "dice_score",
     "f1",
     "fbeta",
     "hamming_distance",
+    "hinge",
+    "iou",
+    "kldivergence",
+    "matthews_corrcoef",
     "precision",
     "precision_recall",
+    "precision_recall_curve",
     "recall",
+    "roc",
     "specificity",
     "stat_scores",
 ]

@@ -1,0 +1,111 @@
+"""Hinge loss.
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/hinge.py`` (Crammer-Singer margin /
+one-vs-all at ``:61-98``) — the reference's boolean-mask gather/scatter
+(dynamic shapes) becomes static ``where`` selects and masked row max, fully
+trace-safe.
+"""
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import Array, to_onehot
+from metrics_tpu.utilities.enums import DataType, EnumStr
+
+
+class MulticlassMode(EnumStr):
+    """Possible multiclass modes of hinge.
+
+    >>> "Crammer-Singer" in list(MulticlassMode)
+    True
+    """
+
+    CRAMMER_SINGER = "crammer-singer"
+    ONE_VS_ALL = "one-vs-all"
+
+
+def _check_shape_and_type_consistency_hinge(preds: Array, target: Array) -> DataType:
+    if target.ndim > 1:
+        raise ValueError(f"The `target` should be one dimensional, got `target` with shape={target.shape}.")
+
+    if preds.ndim == 1:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        mode = DataType.BINARY
+    elif preds.ndim == 2:
+        if preds.shape[0] != target.shape[0]:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape in the first dimension,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        mode = DataType.MULTICLASS
+    else:
+        raise ValueError(f"The `preds` should be one or two dimensional, got `preds` with shape={preds.shape}.")
+    return mode
+
+
+def _hinge_update(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Tuple[Array, Array]:
+    if preds.shape[0] == 1:
+        preds = jnp.expand_dims(jnp.squeeze(preds), 0)
+        target = jnp.expand_dims(jnp.squeeze(target), 0)
+    else:
+        preds, target = jnp.squeeze(preds), jnp.squeeze(target)
+
+    mode = _check_shape_and_type_consistency_hinge(preds, target)
+
+    if mode == DataType.MULTICLASS:
+        target = to_onehot(target, max(2, preds.shape[1])).astype(bool)
+
+    if mode == DataType.MULTICLASS and (multiclass_mode is None or multiclass_mode == MulticlassMode.CRAMMER_SINGER):
+        # margin = score of the true class minus the best wrong-class score
+        margin = jnp.sum(jnp.where(target, preds, 0.0), axis=1)
+        margin = margin - jnp.max(jnp.where(target, -jnp.inf, preds), axis=1)
+    elif mode == DataType.BINARY or multiclass_mode == MulticlassMode.ONE_VS_ALL:
+        target = target.astype(bool)
+        margin = jnp.where(target, preds, -preds)
+    else:
+        raise ValueError(
+            "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+            "(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL,"
+            f" got {multiclass_mode}."
+        )
+
+    measures = jnp.clip(1 - margin, 0, None)
+    if squared:
+        measures = measures**2
+
+    total = jnp.asarray(target.shape[0])
+    return jnp.sum(measures, axis=0), total
+
+
+def _hinge_compute(measure: Array, total: Array) -> Array:
+    return measure / total
+
+
+def hinge(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Array:
+    """Mean hinge loss ``max(0, 1 - margin)`` (optionally squared).
+
+    Example (binary):
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hinge
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> hinge(preds, target)
+        Array(0.3, dtype=float32)
+    """
+    measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
+    return _hinge_compute(measure, total)

@@ -1,0 +1,56 @@
+"""Intersection over union (Jaccard index).
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/iou.py:24-45``: diag/union from the
+confusion matrix with ``absent_score`` for classes in neither preds nor
+target, and static-slice removal of ``ignore_index``.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.utilities.data import Array, get_num_classes
+from metrics_tpu.utilities.distributed import reduce
+
+
+def _iou_from_confmat(
+    confmat: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    intersection = jnp.diag(confmat)
+    union = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - intersection
+
+    scores = intersection.astype(jnp.float32) / jnp.where(union == 0, 1, union).astype(jnp.float32)
+    scores = jnp.where(union == 0, absent_score, scores)
+
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1:]])
+    return reduce(scores, reduction=reduction)
+
+
+def iou(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Jaccard index ``|A ∩ B| / |A ∪ B|`` over class masks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import iou
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> iou(preds, target, num_classes=2)
+        Array(0.5833333, dtype=float32)
+    """
+    num_classes = get_num_classes(preds=preds, target=target, num_classes=num_classes)
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
+    return _iou_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

@@ -1,0 +1,59 @@
+"""KL divergence.
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/kldivergence.py:25-48``.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import METRIC_EPS, Array
+
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, int]:
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+
+    total = p.shape[0]
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        q = q / jnp.sum(q, axis=-1, keepdims=True)
+        q = jnp.clip(q, METRIC_EPS, None)
+        measures = jnp.sum(p * jnp.log(p / q), axis=-1)
+
+    return measures, total
+
+
+def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean") -> Array:
+    if reduction == "sum":
+        return jnp.sum(measures)
+    if reduction == "mean":
+        return jnp.sum(measures) / total
+    if reduction is None or reduction == "none":
+        return measures
+    return measures / total
+
+
+def kldivergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """KL divergence ``D_KL(P||Q)`` over rows of distributions.
+
+    Args:
+        p: ``(N, d)`` data distribution(s).
+        q: ``(N, d)`` prior/approximation distribution(s).
+        log_prob: inputs are log-probabilities (already normalized).
+        reduction: ``'mean' | 'sum' | 'none' | None``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import kldivergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> kldivergence(p, q)
+        Array(0.08540184, dtype=float32)
+    """
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)

@@ -1,0 +1,41 @@
+"""Matthews correlation coefficient.
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/matthews_corrcoef.py:22-28``:
+computed from confusion-matrix row/column/trace sums.
+"""
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.utilities.data import Array
+
+_matthews_corrcoef_update = _confusion_matrix_update
+
+
+def _matthews_corrcoef_compute(confmat: Array) -> Array:
+    confmat = confmat.astype(jnp.float32)
+    tk = jnp.sum(confmat, axis=1)
+    pk = jnp.sum(confmat, axis=0)
+    c = jnp.trace(confmat)
+    s = jnp.sum(confmat)
+    return (c * s - jnp.sum(tk * pk)) / (jnp.sqrt(s**2 - jnp.sum(pk * pk)) * jnp.sqrt(s**2 - jnp.sum(tk * tk)))
+
+
+def matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    threshold: float = 0.5,
+) -> Array:
+    """Matthews correlation coefficient of a classification.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import matthews_corrcoef
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> matthews_corrcoef(preds, target, num_classes=2)
+        Array(0.57735026, dtype=float32)
+    """
+    confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold)
+    return _matthews_corrcoef_compute(confmat)

@@ -1,0 +1,171 @@
+"""Precision-recall curve and the shared binary sort-scan kernel.
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/precision_recall_curve.py``
+(``_binary_clf_curve`` at ``:23-63``, update reshapes at ``:66-111``, curve
+compute at ``:114-163``).
+
+TPU note: curve outputs are inherently data-dependent in length (one point
+per distinct threshold), which XLA cannot express as a static shape — so, as
+in the reference, these run **eagerly at epoch end** on concrete (already
+synced) state; the device does the heavy lifting (sort + cumsum) and only the
+final dynamic trim happens at the host boundary. For a fully in-graph,
+fixed-shape alternative use the binned curve metrics
+(``binned_precision_recall.py``), the TPU-preferred design.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Cumulative fps/tps per distinct decreasing threshold (sklearn-style).
+
+    Stable descending sort + cumsum on device; the distinct-threshold
+    compaction is the one data-dependent step.
+    """
+    if sample_weights is not None and not isinstance(sample_weights, jnp.ndarray):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    # stable argsort of -preds = descending with ascending-index tiebreak,
+    # matching torch.argsort(descending=True) on ties
+    desc_score_indices = jnp.argsort(-preds, stable=True)
+
+    preds = preds[desc_score_indices]
+    target = target[desc_score_indices]
+
+    weight = sample_weights[desc_score_indices] if sample_weights is not None else 1.0
+
+    distinct_value_indices = jnp.where(preds[1:] - preds[:-1])[0]
+    threshold_idxs = jnp.append(distinct_value_indices, target.shape[0] - 1)
+
+    target = (target == pos_label).astype(jnp.int64 if target.dtype == jnp.int64 else jnp.int32)
+    tps = jnp.cumsum(target * weight, axis=0)[threshold_idxs]
+
+    if sample_weights is not None:
+        fps = jnp.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+
+    return fps, tps, preds[threshold_idxs]
+
+
+def _precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, int]:
+    """Reshape binary/multilabel/multiclass inputs to the curve layout."""
+    if not (preds.ndim == target.ndim or preds.ndim == target.ndim + 1):
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+
+    if preds.ndim == target.ndim:
+        if pos_label is None:
+            rank_zero_warn("`pos_label` automatically set 1.")
+            pos_label = 1
+        if num_classes is not None and num_classes != 1:
+            # multilabel: (N, C, ...) -> (N·X, C)
+            if num_classes != preds.shape[1]:
+                raise ValueError(
+                    f"Argument `num_classes` was set to {num_classes} in"
+                    f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                    " number of classes from predictions"
+                )
+            preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+            target = jnp.swapaxes(target, 0, 1).reshape(num_classes, -1).T
+        else:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+
+    if preds.ndim == target.ndim + 1:
+        if pos_label is not None:
+            rank_zero_warn(
+                f"Argument `pos_label` should be `None` when running multiclass precision recall curve. Got {pos_label}"
+            )
+        if num_classes != preds.shape[1]:
+            raise ValueError(
+                f"Argument `num_classes` was set to {num_classes} in"
+                f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                " number of classes from predictions"
+            )
+        preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+        target = target.reshape(-1)
+
+    return preds, target, num_classes, pos_label
+
+
+def _precision_recall_curve_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if num_classes == 1:
+        fps, tps, thresholds = _binary_clf_curve(
+            preds=preds, target=target, sample_weights=sample_weights, pos_label=pos_label
+        )
+
+        precision = tps / (tps + fps)
+        recall = tps / tps[-1]
+
+        # stop once full recall is attained, reverse so recall decreases,
+        # and append the (1, 0) endpoint
+        last_ind = int(jnp.where(tps == tps[-1])[0][0])
+        sl = slice(0, last_ind + 1)
+
+        precision = jnp.append(jnp.flip(precision[sl]), 1.0)
+        recall = jnp.append(jnp.flip(recall[sl]), 0.0)
+        thresholds = jnp.flip(thresholds[sl])
+
+        return precision, recall, thresholds
+
+    # per-class recursion on the class columns
+    precision, recall, thresholds = [], [], []
+    for c in range(num_classes):
+        res = precision_recall_curve(
+            preds=preds[:, c], target=target, num_classes=1, pos_label=c, sample_weights=sample_weights
+        )
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+
+    return precision, recall, thresholds
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Precision/recall pairs at every distinct decision threshold.
+
+    Example (binary):
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall_curve
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> precision, recall, thresholds = precision_recall_curve(pred, target, pos_label=1)
+        >>> precision
+        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+        >>> recall
+        Array([1. , 0.5, 0. , 0. ], dtype=float32)
+        >>> thresholds
+        Array([1., 2., 3.], dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

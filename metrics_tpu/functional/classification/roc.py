@@ -1,0 +1,90 @@
+"""Receiver operating characteristic.
+
+Capability parity with the reference's
+``torchmetrics/functional/classification/roc.py:128-178``: (0, 0) curve
+start, fpr/tpr from the shared sort-scan kernel, per-class recursion for
+multiclass/multilabel. Eager epoch-end math (dynamic curve length) — see the
+note in ``precision_recall_curve.py``.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utilities.data import Array
+
+
+def _roc_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, int]:
+    return _precision_recall_curve_update(preds, target, num_classes, pos_label)
+
+
+def _roc_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if num_classes == 1 and preds.ndim == 1:  # binary
+        fps, tps, thresholds = _binary_clf_curve(
+            preds=preds, target=target, sample_weights=sample_weights, pos_label=pos_label
+        )
+        # extra threshold so the curve starts at (0, 0)
+        tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+        fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+        thresholds = jnp.concatenate([thresholds[:1] + 1, thresholds])
+
+        if fps[-1] <= 0:
+            raise ValueError("No negative samples in targets, false positive value should be meaningless")
+        fpr = fps / fps[-1]
+
+        if tps[-1] <= 0:
+            raise ValueError("No positive samples in targets, true positive value should be meaningless")
+        tpr = tps / tps[-1]
+
+        return fpr, tpr, thresholds
+
+    # per-class recursion
+    fpr, tpr, thresholds = [], [], []
+    for c in range(num_classes):
+        if preds.shape == target.shape:
+            preds_c, target_c, pos_label_c = preds[:, c], target[:, c], 1
+        else:
+            preds_c, target_c, pos_label_c = preds[:, c], target, c
+        res = roc(preds=preds_c, target=target_c, num_classes=1, pos_label=pos_label_c, sample_weights=sample_weights)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(res[2])
+    return fpr, tpr, thresholds
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """ROC curve: (fpr, tpr, thresholds), binary or per class.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import roc
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> fpr, tpr, thresholds = roc(pred, target, pos_label=1)
+        >>> fpr
+        Array([0., 0., 0., 0., 1.], dtype=float32)
+        >>> tpr
+        Array([0.        , 0.33333334, 0.6666667 , 1.        , 1.        ],      dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
+    return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -1,0 +1,170 @@
+"""ConfusionMatrix / CohenKappa / MatthewsCorrcoef / IoU / dice parity vs sklearn."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_matthews
+from sklearn.metrics import multilabel_confusion_matrix as sk_multilabel_cm
+
+from metrics_tpu import CohenKappa, ConfusionMatrix, IoU, MatthewsCorrcoef
+from metrics_tpu.functional import cohen_kappa, confusion_matrix, dice_score, iou, matthews_corrcoef
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _canon(preds, target):
+    preds, target = np.asarray(preds), np.asarray(target)
+    if preds.ndim == target.ndim + 1:  # multiclass probs
+        return np.argmax(preds, axis=1).reshape(-1), target.reshape(-1)
+    if np.issubdtype(preds.dtype, np.floating):
+        return (preds >= THRESHOLD).astype(int).reshape(-1), target.reshape(-1)
+    return preds.reshape(-1), target.reshape(-1)
+
+
+def _sk_cm(preds, target, num_classes, normalize=None):
+    y_pred, y_true = _canon(preds, target)
+    return sk_confusion_matrix(y_true, y_pred, labels=list(range(num_classes)), normalize=normalize)
+
+
+def _sk_cm_multilabel(preds, target):
+    p = (np.asarray(preds) >= THRESHOLD).astype(int)
+    return sk_multilabel_cm(np.asarray(target).reshape(-1, p.shape[-1]), p.reshape(-1, p.shape[-1]))
+
+
+_cases = [
+    (_binary_prob_inputs.preds, _binary_prob_inputs.target, 2),
+    (_multiclass_inputs.preds, _multiclass_inputs.target, NUM_CLASSES),
+    (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, NUM_CLASSES),
+]
+
+
+@pytest.mark.parametrize("preds, target, num_classes", _cases)
+class TestConfusionMatrixFamily(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+    def test_confusion_matrix_class(self, ddp, preds, target, num_classes, normalize):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=ConfusionMatrix,
+            sk_metric=partial(_sk_cm, num_classes=num_classes, normalize=normalize),
+            metric_args={"num_classes": num_classes, "normalize": normalize},
+            check_batch=True,
+            atol=1e-6,
+        )
+
+    def test_confusion_matrix_fn(self, preds, target, num_classes):
+        self.run_functional_metric_test(
+            preds, target, metric_functional=confusion_matrix,
+            sk_metric=partial(_sk_cm, num_classes=num_classes),
+            metric_args={"num_classes": num_classes}, atol=1e-6,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_cohen_kappa_class(self, ddp, preds, target, num_classes, weights):
+        def sk_kappa(p, t):
+            y_pred, y_true = _canon(p, t)
+            return sk_cohen_kappa(y_true, y_pred, weights=weights, labels=list(range(num_classes)))
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=CohenKappa,
+            sk_metric=sk_kappa,
+            metric_args={"num_classes": num_classes, "weights": weights},
+            atol=1e-5,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_matthews_class(self, ddp, preds, target, num_classes):
+        def sk_mcc(p, t):
+            y_pred, y_true = _canon(p, t)
+            return sk_matthews(y_true, y_pred)
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=MatthewsCorrcoef,
+            sk_metric=sk_mcc,
+            metric_args={"num_classes": num_classes},
+            atol=1e-5,
+        )
+
+    def test_matthews_fn(self, preds, target, num_classes):
+        def sk_mcc(p, t):
+            y_pred, y_true = _canon(p, t)
+            return sk_matthews(y_true, y_pred)
+
+        self.run_functional_metric_test(
+            preds, target, metric_functional=matthews_corrcoef, sk_metric=sk_mcc,
+            metric_args={"num_classes": num_classes}, atol=1e-5,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_iou_class(self, ddp, preds, target, num_classes):
+        def sk_iou(p, t):
+            y_pred, y_true = _canon(p, t)
+            return sk_jaccard(y_true, y_pred, labels=list(range(num_classes)), average="macro")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=IoU,
+            sk_metric=sk_iou,
+            metric_args={"num_classes": num_classes},
+            atol=1e-5,
+        )
+
+
+def test_confusion_matrix_multilabel():
+    preds = _multilabel_prob_inputs.preds[0]
+    target = _multilabel_prob_inputs.target[0]
+    ours = np.asarray(confusion_matrix(jnp.asarray(preds), jnp.asarray(target),
+                                       num_classes=NUM_CLASSES, multilabel=True))
+    expected = _sk_cm_multilabel(preds, target)
+    np.testing.assert_array_equal(ours, expected)
+
+
+def test_cohen_kappa_fn_example():
+    target = jnp.asarray([1, 1, 0, 0])
+    preds = jnp.asarray([0, 1, 0, 0])
+    np.testing.assert_allclose(cohen_kappa(preds, target, num_classes=2), 0.5, atol=1e-6)
+
+
+def test_iou_absent_and_ignore():
+    target = jnp.asarray([0, 0, 0, 0])
+    preds = jnp.asarray([0, 0, 0, 0])
+    # class 1 absent from both -> absent_score
+    out = np.asarray(iou(preds, target, num_classes=2, absent_score=0.77, reduction="none"))
+    np.testing.assert_allclose(out, [1.0, 0.77], atol=1e-6)
+    # ignore_index drops the class
+    out2 = np.asarray(iou(preds, target, num_classes=2, ignore_index=1, reduction="none"))
+    np.testing.assert_allclose(out2, [1.0], atol=1e-6)
+
+
+def test_dice_score_example():
+    pred = jnp.asarray([
+        [0.85, 0.05, 0.05, 0.05],
+        [0.05, 0.85, 0.05, 0.05],
+        [0.05, 0.05, 0.85, 0.05],
+        [0.05, 0.05, 0.05, 0.85],
+    ])
+    target = jnp.asarray([0, 1, 3, 2])
+    np.testing.assert_allclose(dice_score(pred, target), 1 / 3, atol=1e-6)
+    # with background
+    np.testing.assert_allclose(dice_score(pred, target, bg=True), 0.5, atol=1e-6)

@@ -1,0 +1,175 @@
+"""Curve metrics (PR-curve / ROC / AUROC / AP / AUC) parity vs sklearn."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import auc as sk_auc
+from sklearn.metrics import average_precision_score as sk_average_precision
+from sklearn.metrics import precision_recall_curve as sk_precision_recall_curve
+from sklearn.metrics import roc_auc_score as sk_roc_auc
+from sklearn.metrics import roc_curve as sk_roc_curve
+
+from metrics_tpu import AUC, AUROC, ROC, AveragePrecision, PrecisionRecallCurve
+from metrics_tpu.functional import auc, auroc, average_precision, precision_recall_curve, roc
+from tests.classification.inputs import _binary_prob_inputs, _multiclass_prob_inputs
+from tests.helpers.testers import NUM_BATCHES, NUM_CLASSES, MetricTester
+
+
+def _sk_pr_curve_trimmed(y_true, y_score):
+    """sklearn PR curve trimmed at first full recall (the reference-era
+    convention this library follows): drop redundant leading recall==1 points
+    that modern sklearn keeps."""
+    prec, rec, thr = sk_precision_recall_curve(y_true, y_score)
+    lead = int(np.sum(rec == 1.0)) - 1
+    if lead > 0:
+        prec, rec, thr = prec[lead:], rec[lead:], thr[lead:]
+    return prec, rec, thr
+
+
+class TestBinaryCurves(MetricTester):
+    preds = _binary_prob_inputs.preds
+    target = _binary_prob_inputs.target
+
+    def test_roc_fn(self):
+        for i in range(NUM_BATCHES):
+            fpr, tpr, thr = roc(jnp.asarray(self.preds[i]), jnp.asarray(self.target[i]), pos_label=1)
+            sk_fpr, sk_tpr, sk_thr = sk_roc_curve(self.target[i], self.preds[i], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(thr)[1:], sk_thr[1:], atol=1e-6)
+
+    def test_pr_curve_fn(self):
+        for i in range(NUM_BATCHES):
+            prec, rec, thr = precision_recall_curve(
+                jnp.asarray(self.preds[i]), jnp.asarray(self.target[i]), pos_label=1
+            )
+            sk_prec, sk_rec, sk_thr = _sk_pr_curve_trimmed(self.target[i], self.preds[i])
+            np.testing.assert_allclose(np.asarray(prec), sk_prec, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(rec), sk_rec, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(thr), sk_thr, atol=1e-6)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=self.preds,
+            target=self.target,
+            metric_class=AUROC,
+            sk_metric=lambda p, t: sk_roc_auc(t.reshape(-1), p.reshape(-1)),
+            atol=1e-6,
+        )
+
+    def test_auroc_fn(self):
+        self.run_functional_metric_test(
+            self.preds, self.target, metric_functional=auroc,
+            sk_metric=lambda p, t: sk_roc_auc(t.reshape(-1), p.reshape(-1)), atol=1e-6,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_average_precision_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=self.preds,
+            target=self.target,
+            metric_class=AveragePrecision,
+            sk_metric=lambda p, t: sk_average_precision(t.reshape(-1), p.reshape(-1)),
+            atol=1e-6,
+        )
+
+    def test_average_precision_fn(self):
+        self.run_functional_metric_test(
+            self.preds, self.target, metric_functional=average_precision,
+            sk_metric=lambda p, t: sk_average_precision(t.reshape(-1), p.reshape(-1)), atol=1e-6,
+        )
+
+    def test_auroc_max_fpr(self):
+        for max_fpr in (0.25, 0.5, 0.75):
+            for i in range(3):
+                ours = auroc(jnp.asarray(self.preds[i]), jnp.asarray(self.target[i]), max_fpr=max_fpr)
+                expected = sk_roc_auc(self.target[i], self.preds[i], max_fpr=max_fpr)
+                np.testing.assert_allclose(np.asarray(ours), expected, atol=1e-5)
+
+
+class TestMulticlassCurves(MetricTester):
+    preds = _multiclass_prob_inputs.preds
+    target = _multiclass_prob_inputs.target
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_class(self, ddp, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=self.preds,
+            target=self.target,
+            metric_class=AUROC,
+            sk_metric=lambda p, t: sk_roc_auc(t, p, multi_class="ovr", average=average,
+                                              labels=list(range(NUM_CLASSES))),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            atol=1e-6,
+        )
+
+    def test_average_precision_class(self):
+        def sk_ap(p, t):
+            return [sk_average_precision((t == c).astype(int), p[:, c]) for c in range(NUM_CLASSES)]
+
+        self.run_class_metric_test(
+            ddp=False,
+            preds=self.preds,
+            target=self.target,
+            metric_class=AveragePrecision,
+            sk_metric=sk_ap,
+            metric_args={"num_classes": NUM_CLASSES},
+            atol=1e-6,
+        )
+
+    def test_pr_curve_class(self):
+        metric = PrecisionRecallCurve(num_classes=NUM_CLASSES)
+        for i in range(NUM_BATCHES):
+            metric.update(jnp.asarray(self.preds[i]), jnp.asarray(self.target[i]))
+        prec, rec, thr = metric.compute()
+        all_preds = self.preds.reshape(-1, NUM_CLASSES)
+        all_target = self.target.reshape(-1)
+        for c in range(NUM_CLASSES):
+            sk_prec, sk_rec, sk_thr = _sk_pr_curve_trimmed((all_target == c).astype(int), all_preds[:, c])
+            np.testing.assert_allclose(np.asarray(prec[c]), sk_prec, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(rec[c]), sk_rec, atol=1e-6)
+
+    def test_roc_class(self):
+        metric = ROC(num_classes=NUM_CLASSES)
+        for i in range(NUM_BATCHES):
+            metric.update(jnp.asarray(self.preds[i]), jnp.asarray(self.target[i]))
+        fpr, tpr, thr = metric.compute()
+        all_preds = self.preds.reshape(-1, NUM_CLASSES)
+        all_target = self.target.reshape(-1)
+        for c in range(NUM_CLASSES):
+            sk_fpr, sk_tpr, _ = sk_roc_curve((all_target == c).astype(int), all_preds[:, c],
+                                             drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fpr[c]), sk_fpr, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(tpr[c]), sk_tpr, atol=1e-6)
+
+
+def test_auc_fn():
+    x = jnp.asarray([0, 1, 2, 3])
+    y = jnp.asarray([0, 1, 2, 2])
+    np.testing.assert_allclose(auc(x, y), 4.0)
+    np.testing.assert_allclose(auc(x, y, reorder=True), 4.0)
+    # decreasing x: direction flip keeps the area positive
+    np.testing.assert_allclose(auc(jnp.flip(x), jnp.flip(y)), 4.0)
+
+
+def test_auc_class_vs_sklearn():
+    rng = np.random.RandomState(9)
+    x = np.sort(rng.rand(64))
+    y = rng.rand(64)
+    metric = AUC()
+    for i in range(4):
+        metric.update(jnp.asarray(x[i * 16:(i + 1) * 16]), jnp.asarray(y[i * 16:(i + 1) * 16]))
+    np.testing.assert_allclose(np.asarray(metric.compute()), sk_auc(x, y), atol=1e-6)
+
+
+def test_auroc_multilabel():
+    rng = np.random.RandomState(10)
+    preds = rng.rand(128, 4)
+    target = rng.randint(0, 2, (128, 4))
+    ours = auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=4)
+    expected = sk_roc_auc(target, preds, average="macro")
+    np.testing.assert_allclose(np.asarray(ours), expected, atol=1e-6)

@@ -1,0 +1,154 @@
+"""Hinge / KLDivergence / Binned curve metrics parity tests."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import entropy as scipy_entropy
+from sklearn.metrics import average_precision_score as sk_average_precision
+from sklearn.metrics import hinge_loss as sk_hinge
+
+from metrics_tpu import (
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    Hinge,
+    KLDivergence,
+)
+from metrics_tpu.functional import hinge, kldivergence
+from tests.helpers.testers import MetricTester
+
+
+class TestHinge(MetricTester):
+
+    def test_hinge_binary_vs_sklearn(self):
+        rng = np.random.RandomState(3)
+        preds = rng.randn(128)
+        target = rng.randint(0, 2, 128)
+        expected = sk_hinge(target, preds, labels=[0, 1])
+        np.testing.assert_allclose(np.asarray(hinge(jnp.asarray(preds), jnp.asarray(target))), expected, atol=1e-6)
+
+    def test_hinge_multiclass_crammer_singer(self):
+        rng = np.random.RandomState(4)
+        preds = rng.randn(128, 5)
+        target = rng.randint(0, 5, 128)
+        expected = sk_hinge(target, preds, labels=list(range(5)))
+        np.testing.assert_allclose(np.asarray(hinge(jnp.asarray(preds), jnp.asarray(target))), expected, atol=1e-6)
+
+    def test_hinge_one_vs_all(self):
+        rng = np.random.RandomState(5)
+        preds = rng.randn(64, 3)
+        target = rng.randint(0, 3, 64)
+        onehot = np.eye(3)[target].astype(bool)
+        margin = np.where(onehot, preds, -preds)
+        expected = np.clip(1 - margin, 0, None).mean(axis=0)
+        result = hinge(jnp.asarray(preds), jnp.asarray(target), multiclass_mode="one-vs-all")
+        np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+    def test_hinge_module_accumulates(self):
+        rng = np.random.RandomState(6)
+        preds = rng.randn(4, 32)
+        target = rng.randint(0, 2, (4, 32))
+        metric = Hinge()
+        for i in range(4):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        expected = sk_hinge(target.reshape(-1), preds.reshape(-1), labels=[0, 1])
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, atol=1e-6)
+
+
+class TestKLDivergence(MetricTester):
+
+    def test_kld_vs_scipy(self):
+        rng = np.random.RandomState(7)
+        p = rng.rand(64, 8); p /= p.sum(-1, keepdims=True)
+        q = rng.rand(64, 8); q /= q.sum(-1, keepdims=True)
+        expected = np.mean([scipy_entropy(pi, qi) for pi, qi in zip(p, q)])
+        np.testing.assert_allclose(np.asarray(kldivergence(jnp.asarray(p), jnp.asarray(q))), expected, atol=1e-5)
+
+    def test_kld_log_prob(self):
+        rng = np.random.RandomState(8)
+        p = rng.rand(32, 4); p /= p.sum(-1, keepdims=True)
+        q = rng.rand(32, 4); q /= q.sum(-1, keepdims=True)
+        expected = np.mean([scipy_entropy(pi, qi) for pi, qi in zip(p, q)])
+        result = kldivergence(jnp.asarray(np.log(p)), jnp.asarray(np.log(q)), log_prob=True)
+        np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_kld_module(self, reduction):
+        rng = np.random.RandomState(9)
+        p = rng.rand(4, 16, 4); p /= p.sum(-1, keepdims=True)
+        q = rng.rand(4, 16, 4); q /= q.sum(-1, keepdims=True)
+        metric = KLDivergence(reduction=reduction)
+        for i in range(4):
+            metric.update(jnp.asarray(p[i]), jnp.asarray(q[i]))
+        result = np.asarray(metric.compute())
+        rows = np.array([scipy_entropy(pi, qi) for pi, qi in zip(p.reshape(-1, 4), q.reshape(-1, 4))])
+        if reduction == "mean":
+            np.testing.assert_allclose(result, rows.mean(), atol=1e-5)
+        elif reduction == "sum":
+            np.testing.assert_allclose(result, rows.sum(), atol=1e-4)
+        else:
+            np.testing.assert_allclose(result, rows, atol=1e-5)
+
+
+class TestBinned(MetricTester):
+
+    def test_binned_pr_curve_binary_reference_example(self):
+        pred = jnp.asarray([0, 0.1, 0.8, 0.4])
+        target = jnp.asarray([0, 1, 1, 0])
+        pr_curve = BinnedPrecisionRecallCurve(num_classes=1, num_thresholds=5)
+        precision, recall, thresholds = pr_curve(pred, target)
+        np.testing.assert_allclose(np.asarray(precision), [0.5, 0.5, 1.0, 1.0, 1.0, 1.0], atol=1e-4)
+        np.testing.assert_allclose(np.asarray(recall), [1.0, 0.5, 0.5, 0.5, 0.0, 0.0], atol=1e-4)
+        np.testing.assert_allclose(np.asarray(thresholds), [0.0, 0.25, 0.5, 0.75, 1.0], atol=1e-6)
+
+    def test_binned_ap_close_to_exact(self):
+        """With many thresholds the binned AP approaches sklearn's exact AP."""
+        rng = np.random.RandomState(11)
+        preds = rng.rand(512)
+        target = rng.randint(0, 2, 512)
+        metric = BinnedAveragePrecision(num_classes=1, num_thresholds=500)
+        result = metric(jnp.asarray(preds), jnp.asarray(target))
+        expected = sk_average_precision(target, preds)
+        np.testing.assert_allclose(np.asarray(result), expected, atol=0.01)
+
+    def test_binned_recall_at_fixed_precision(self):
+        pred = jnp.asarray([0, 0.2, 0.5, 0.8])
+        target = jnp.asarray([0, 1, 1, 0])
+        metric = BinnedRecallAtFixedPrecision(num_classes=1, num_thresholds=10, min_precision=0.5)
+        recall, threshold = metric(pred, target)
+        np.testing.assert_allclose(np.asarray(recall), 1.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(threshold), 1 / 9, atol=1e-6)
+
+    def test_binned_multiclass_shapes(self):
+        pred = jnp.asarray([
+            [0.75, 0.05, 0.05, 0.05, 0.05],
+            [0.05, 0.75, 0.05, 0.05, 0.05],
+            [0.05, 0.05, 0.75, 0.05, 0.05],
+            [0.05, 0.05, 0.05, 0.75, 0.05],
+        ])
+        target = jnp.asarray([0, 1, 3, 2])
+        pr_curve = BinnedPrecisionRecallCurve(num_classes=5, num_thresholds=3)
+        precision, recall, thresholds = pr_curve(pred, target)
+        assert len(precision) == 5 and len(recall) == 5 and len(thresholds) == 5
+        np.testing.assert_allclose(np.asarray(precision[0]), [0.25, 1.0, 1.0, 1.0], atol=1e-4)
+        np.testing.assert_allclose(np.asarray(recall[0]), [1.0, 1.0, 0.0, 0.0], atol=1e-4)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binned_ap_class_ddp(self, ddp):
+        rng = np.random.RandomState(12)
+        preds = rng.rand(10, 32)
+        target = rng.randint(0, 2, (10, 32))
+
+        def sk_binned_ap(p, t):
+            # oracle: exact AP is close enough at 500 thresholds
+            return sk_average_precision(t.reshape(-1), p.reshape(-1))
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=BinnedAveragePrecision,
+            sk_metric=sk_binned_ap,
+            metric_args={"num_classes": 1, "num_thresholds": 500},
+            check_batch=False,
+            atol=0.01,
+        )

@@ -60,10 +60,12 @@ def sharded_compute(metric: Metric, rank_metrics: Sequence[Metric]) -> Any:
     """Synchronize per-rank metric states with real collectives and compute.
 
     Stacks every rank's state along a leading axis, lays it out over a
-    ``("procs",)`` mesh of virtual devices, and runs ``apply_compute`` with
-    ``axis_name="procs"`` inside ``shard_map`` — so "sum" states reduce via
-    ``lax.psum`` and "cat" states via tiled ``lax.all_gather``, exactly as on
-    a real TPU mesh.
+    ``("procs",)`` mesh of virtual devices, and synchronizes it inside a
+    ``shard_map`` — "sum" states reduce via ``lax.psum`` and "cat" states via
+    tiled ``lax.all_gather``, exactly as on a real TPU mesh. The final
+    ``compute`` then runs eagerly on the synced state, which keeps
+    dynamic-shape epoch-end math (curve metrics) out of the traced program —
+    the same split a real deployment uses.
     """
     world = len(rank_metrics)
     states = [m._get_states() for m in rank_metrics]
@@ -72,14 +74,29 @@ def sharded_compute(metric: Metric, rank_metrics: Sequence[Metric]) -> Any:
     devices = np.array(jax.devices()[:world])
     mesh = Mesh(devices, ("procs",))
 
-    def _compute(state):
-        state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
-        return metric.apply_compute(state, axis_name="procs")
+    if metric._fusable:
+        # fixed-shape metrics: the whole sync+compute must trace in-graph —
+        # this is the real TPU hot path and the stronger check
+        def _compute(state):
+            state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
+            return metric.apply_compute(state, axis_name="procs")
 
-    # check_vma=False: lax.all_gather outputs are semantically replicated but the
-    # varying-manual-axes checker can't prove it statically
-    fn = jax.jit(jax.shard_map(_compute, mesh=mesh, in_specs=P("procs"), out_specs=P(), check_vma=False))
-    return fn(stacked)
+        # check_vma=False: lax.all_gather outputs are semantically replicated but
+        # the varying-manual-axes checker can't prove it statically
+        fn = jax.jit(jax.shard_map(_compute, mesh=mesh, in_specs=P("procs"), out_specs=P(), check_vma=False))
+        return fn(stacked)
+
+    # curve-style metrics (dynamic epoch-end math): collectives in-graph,
+    # final compute eager — the same split a real deployment uses
+    def _sync(state):
+        state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
+        from metrics_tpu.utilities.distributed import sync_in_graph
+
+        return sync_in_graph(state, metric._reductions, "procs")
+
+    fn = jax.jit(jax.shard_map(_sync, mesh=mesh, in_specs=P("procs"), out_specs=P(), check_vma=False))
+    synced = fn(stacked)
+    return metric.apply_compute(synced)
 
 
 class MetricTester:
