@@ -1,0 +1,40 @@
+"""Cosine similarity.
+
+Capability parity with the reference's
+``torchmetrics/functional/regression/cosine_similarity.py``.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import Array
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: str = "sum") -> Array:
+    dot_product = jnp.sum(preds * target, axis=-1)
+    preds_norm = jnp.linalg.norm(preds, axis=-1)
+    target_norm = jnp.linalg.norm(target, axis=-1)
+    similarity = dot_product / (preds_norm * target_norm)
+    reduction_mapping = {"sum": jnp.sum, "mean": jnp.mean, "none": lambda x: x, None: lambda x: x}
+    return reduction_mapping[reduction](similarity)
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: str = "sum") -> Array:
+    """Row-wise cosine similarity with sum/mean/none reduction.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cosine_similarity
+        >>> target = jnp.asarray([[1., 2, 3, 4], [1., 2, 3, 4]])
+        >>> preds = jnp.asarray([[1., 2, 3, 4], [-1., -2, -3, -4]])
+        >>> cosine_similarity(preds, target, 'none')
+        Array([ 1., -1.], dtype=float32)
+    """
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

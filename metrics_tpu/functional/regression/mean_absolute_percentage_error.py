@@ -1,0 +1,53 @@
+"""Mean absolute percentage error (+ deprecated mean_relative_error alias).
+
+Capability parity with the reference's
+``torchmetrics/functional/regression/mean_absolute_percentage_error.py`` and
+``mean_relative_error.py``.
+"""
+from typing import Tuple
+from warnings import warn
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import Array
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array,
+    target: Array,
+    epsilon: float = 1.17e-06,
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), epsilon, None)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """MAPE (epsilon-guarded like sklearn).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_percentage_error
+        >>> target = jnp.asarray([1., 10, 1e6])
+        >>> preds = jnp.asarray([0.9, 15, 1.2e6])
+        >>> mean_absolute_percentage_error(preds, target)
+        Array(0.26666668, dtype=float32)
+    """
+    sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)
+
+
+def mean_relative_error(preds: Array, target: Array) -> Array:
+    """Deprecated alias of :func:`mean_absolute_percentage_error`."""
+    warn(
+        "Function `mean_relative_error` was deprecated v0.4 and will be removed in v0.5."
+        "Use `mean_absolute_percentage_error` instead.",
+        DeprecationWarning,
+    )
+    sum_rltv_error, n_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_rltv_error, n_obs)

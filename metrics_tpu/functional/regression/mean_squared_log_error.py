@@ -1,0 +1,36 @@
+"""Mean squared log error.
+
+Capability parity with the reference's
+``torchmetrics/functional/regression/mean_squared_log_error.py``.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import Array
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    sum_squared_log_error = jnp.sum((jnp.log1p(preds) - jnp.log1p(target)) ** 2)
+    return sum_squared_log_error, target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs: Array) -> Array:
+    return sum_squared_log_error / n_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """MSLE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_log_error
+        >>> x = jnp.asarray([0., 1, 2, 3])
+        >>> y = jnp.asarray([0., 1, 2, 2])
+        >>> mean_squared_log_error(x, y)
+        Array(0.02068, dtype=float32)
+    """
+    sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

@@ -1,0 +1,49 @@
+"""Pearson correlation coefficient.
+
+Capability parity with the reference's
+``torchmetrics/functional/regression/pearson.py:22-76``.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import Array
+
+
+def _pearson_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = jnp.squeeze(preds)
+    target = jnp.squeeze(target)
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+    return preds, target
+
+
+def _pearson_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    preds_diff = preds - jnp.mean(preds)
+    target_diff = target - jnp.mean(target)
+
+    cov = jnp.mean(preds_diff * target_diff)
+    preds_std = jnp.sqrt(jnp.mean(preds_diff * preds_diff))
+    target_std = jnp.sqrt(jnp.mean(target_diff * target_diff))
+
+    denom = preds_std * target_std
+    denom = jnp.where(denom == 0, denom + eps, denom)
+
+    return jnp.clip(cov / denom, -1.0, 1.0)
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pearson_corrcoef
+        >>> target = jnp.asarray([3., -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> pearson_corrcoef(preds, target)
+        Array(0.98546666, dtype=float32)
+    """
+    preds, target = _pearson_corrcoef_update(preds, target)
+    return _pearson_corrcoef_compute(preds, target)

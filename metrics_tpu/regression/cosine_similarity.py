@@ -1,0 +1,62 @@
+"""CosineSimilarity module metric (parity: ``torchmetrics/regression/cosine_similarity.py:24``)."""
+from typing import Any, Callable, Optional
+
+from metrics_tpu.functional.regression.cosine_similarity import (
+    _cosine_similarity_compute,
+    _cosine_similarity_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+
+
+class CosineSimilarity(Metric):
+    """Row-wise cosine similarity over all seen pairs.
+
+    Args:
+        reduction: ``'sum' | 'mean' | 'none'``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CosineSimilarity
+        >>> target = jnp.asarray([[1., 2, 3, 4], [1., 2, 3, 4]])
+        >>> preds = jnp.asarray([[1., 2, 3, 4], [-1., -2, -3, -4]])
+        >>> cosine_similarity = CosineSimilarity(reduction='mean')
+        >>> cosine_similarity(preds, target)
+        Array(0., dtype=float32)
+    """
+
+    is_differentiable = True
+
+    def __init__(
+        self,
+        reduction: str = "sum",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        allowed_reduction = ("sum", "mean", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        self.add_state("preds_all", default=[], dist_reduce_fx="cat")
+        self.add_state("target_all", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append the batch pairs."""
+        preds, target = _cosine_similarity_update(preds, target)
+        self.preds_all.append(preds)
+        self.target_all.append(target)
+
+    def compute(self) -> Array:
+        """Cosine similarity over everything seen so far."""
+        preds = dim_zero_cat(self.preds_all)
+        target = dim_zero_cat(self.target_all)
+        return _cosine_similarity_compute(preds, target, self.reduction)

@@ -1,0 +1,56 @@
+"""MeanSquaredError module metric (parity: ``torchmetrics/regression/mean_squared_error.py:26``)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.mean_squared_error import (
+    _mean_squared_error_compute,
+    _mean_squared_error_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array
+
+
+class MeanSquaredError(Metric):
+    """MSE (or RMSE with ``squared=False``) accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> mean_squared_error = MeanSquaredError()
+        >>> mean_squared_error(preds, target)
+        Array(0.875, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+
+    def __init__(
+        self,
+        squared: bool = True,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.squared = squared
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate squared-error sums."""
+        sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> Array:
+        """MSE over everything seen so far."""
+        return _mean_squared_error_compute(self.sum_squared_error, self.total, squared=self.squared)

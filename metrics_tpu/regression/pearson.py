@@ -1,0 +1,50 @@
+"""PearsonCorrcoef module metric (parity: ``torchmetrics/regression/pearson.py:25``)."""
+from typing import Any, Callable, Optional
+
+from metrics_tpu.functional.regression.pearson import _pearson_corrcoef_compute, _pearson_corrcoef_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+
+
+class PearsonCorrcoef(Metric):
+    """Pearson correlation over all seen (preds, target) pairs (cat states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PearsonCorrcoef
+        >>> target = jnp.asarray([3., -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> pearson = PearsonCorrcoef()
+        >>> pearson(preds, target)
+        Array(0.98546666, dtype=float32)
+    """
+
+    is_differentiable = True
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("preds_all", default=[], dist_reduce_fx="cat")
+        self.add_state("target_all", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append the batch pairs."""
+        preds, target = _pearson_corrcoef_update(preds, target)
+        self.preds_all.append(preds)
+        self.target_all.append(target)
+
+    def compute(self) -> Array:
+        """Pearson correlation over everything seen so far."""
+        preds = dim_zero_cat(self.preds_all)
+        target = dim_zero_cat(self.target_all)
+        return _pearson_corrcoef_compute(preds, target)

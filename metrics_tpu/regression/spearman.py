@@ -1,0 +1,55 @@
+"""SpearmanCorrcoef module metric (parity: ``torchmetrics/regression/spearman.py:25``)."""
+from typing import Any, Callable, Optional
+
+from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+class SpearmanCorrcoef(Metric):
+    """Spearman rank correlation over all seen (preds, target) pairs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpearmanCorrcoef
+        >>> target = jnp.asarray([3., -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> spearman = SpearmanCorrcoef()
+        >>> spearman(preds, target)
+        Array(0.9999999, dtype=float32)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        rank_zero_warn(
+            "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
+            " For large datasets, this may lead to a large memory footprint."
+        )
+        self.add_state("preds_all", default=[], dist_reduce_fx="cat")
+        self.add_state("target_all", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append the batch pairs."""
+        preds, target = _spearman_corrcoef_update(preds, target)
+        self.preds_all.append(preds)
+        self.target_all.append(target)
+
+    def compute(self) -> Array:
+        """Spearman correlation over everything seen so far."""
+        preds = dim_zero_cat(self.preds_all)
+        target = dim_zero_cat(self.target_all)
+        return _spearman_corrcoef_compute(preds, target)
