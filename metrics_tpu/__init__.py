@@ -45,6 +45,27 @@ from metrics_tpu.classification import (  # noqa: F401 E402
 )
 from metrics_tpu.collections import MetricCollection  # noqa: F401 E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401 E402
+from metrics_tpu.regression import (  # noqa: F401 E402
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrcoef,
+    R2Score,
+    SpearmanCorrcoef,
+)
+from metrics_tpu.retrieval import (  # noqa: F401 E402
+    RetrievalFallOut,
+    RetrievalMAP,
+    RetrievalMetric,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.wrappers import BootStrapper  # noqa: F401 E402
 
 __all__ = [
     "AUC",
@@ -55,9 +76,12 @@ __all__ = [
     "BinnedAveragePrecision",
     "BinnedPrecisionRecallCurve",
     "BinnedRecallAtFixedPrecision",
+    "BootStrapper",
     "CohenKappa",
     "CompositionalMetric",
     "ConfusionMatrix",
+    "CosineSimilarity",
+    "ExplainedVariance",
     "F1",
     "FBeta",
     "HammingDistance",
@@ -65,12 +89,26 @@ __all__ = [
     "IoU",
     "KLDivergence",
     "MatthewsCorrcoef",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
     "Metric",
     "MetricCollection",
+    "PearsonCorrcoef",
     "Precision",
     "PrecisionRecallCurve",
+    "R2Score",
     "ROC",
     "Recall",
+    "RetrievalFallOut",
+    "RetrievalMAP",
+    "RetrievalMetric",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalRecall",
     "Specificity",
+    "SpearmanCorrcoef",
     "StatScores",
 ]

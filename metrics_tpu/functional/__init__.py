@@ -22,6 +22,24 @@ from metrics_tpu.functional.classification.precision_recall_curve import precisi
 from metrics_tpu.functional.classification.roc import roc  # noqa: F401
 from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
+from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity  # noqa: F401
+from metrics_tpu.functional.regression.explained_variance import explained_variance  # noqa: F401
+from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error  # noqa: F401
+from metrics_tpu.functional.regression.mean_absolute_percentage_error import (  # noqa: F401
+    mean_absolute_percentage_error,
+    mean_relative_error,
+)
+from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error  # noqa: F401
+from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error  # noqa: F401
+from metrics_tpu.functional.regression.pearson import pearson_corrcoef  # noqa: F401
+from metrics_tpu.functional.regression.r2score import r2score  # noqa: F401
+from metrics_tpu.functional.regression.spearman import spearman_corrcoef  # noqa: F401
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision  # noqa: F401
+from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out  # noqa: F401
+from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg  # noqa: F401
+from metrics_tpu.functional.retrieval.precision import retrieval_precision  # noqa: F401
+from metrics_tpu.functional.retrieval.recall import retrieval_recall  # noqa: F401
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank  # noqa: F401
 
 __all__ = [
     "accuracy",
@@ -30,7 +48,9 @@ __all__ = [
     "average_precision",
     "cohen_kappa",
     "confusion_matrix",
+    "cosine_similarity",
     "dice_score",
+    "explained_variance",
     "f1",
     "fbeta",
     "hamming_distance",
@@ -38,11 +58,25 @@ __all__ = [
     "iou",
     "kldivergence",
     "matthews_corrcoef",
+    "mean_absolute_error",
+    "mean_absolute_percentage_error",
+    "mean_relative_error",
+    "mean_squared_error",
+    "mean_squared_log_error",
+    "pearson_corrcoef",
     "precision",
     "precision_recall",
     "precision_recall_curve",
+    "r2score",
     "recall",
+    "retrieval_average_precision",
+    "retrieval_fall_out",
+    "retrieval_normalized_dcg",
+    "retrieval_precision",
+    "retrieval_recall",
+    "retrieval_reciprocal_rank",
     "roc",
     "specificity",
+    "spearman_corrcoef",
     "stat_scores",
 ]

@@ -43,7 +43,7 @@ def pearson_corrcoef(preds: Array, target: Array) -> Array:
         >>> target = jnp.asarray([3., -0.5, 2, 7])
         >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
         >>> pearson_corrcoef(preds, target)
-        Array(0.98546666, dtype=float32)
+        Array(0.9848697, dtype=float32)
     """
     preds, target = _pearson_corrcoef_update(preds, target)
     return _pearson_corrcoef_compute(preds, target)

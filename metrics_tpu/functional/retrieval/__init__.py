@@ -1,0 +1,25 @@
+"""Functional retrieval metrics (parity: ``torchmetrics/functional/retrieval/``).
+
+Every public function scores a *single query* ``f(preds, target, [k])``, like
+the reference. Each is implemented as a thin wrapper over a pure
+``_*_from_sorted`` row kernel operating on the target vector already sorted by
+descending score — the module path (:class:`~metrics_tpu.retrieval.RetrievalMetric`)
+``vmap``s those row kernels over a padded ``(num_queries, max_len)`` layout,
+replacing the reference's per-query Python loop
+(``retrieval/retrieval_metric.py:118-128``) with one fused XLA program.
+"""
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision  # noqa: F401
+from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out  # noqa: F401
+from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg  # noqa: F401
+from metrics_tpu.functional.retrieval.precision import retrieval_precision  # noqa: F401
+from metrics_tpu.functional.retrieval.recall import retrieval_recall  # noqa: F401
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank  # noqa: F401
+
+__all__ = [
+    "retrieval_average_precision",
+    "retrieval_fall_out",
+    "retrieval_normalized_dcg",
+    "retrieval_precision",
+    "retrieval_recall",
+    "retrieval_reciprocal_rank",
+]

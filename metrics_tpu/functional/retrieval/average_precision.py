@@ -1,0 +1,39 @@
+"""Average precision for information retrieval
+(parity: ``torchmetrics/functional/retrieval/average_precision.py:21-59``)."""
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.data import Array
+
+
+def _retrieval_average_precision_from_sorted(sorted_target: Array) -> Array:
+    """AP of one query given its targets sorted by descending score.
+
+    Pure, vmap-safe, and padding-tolerant: trailing zero-padded entries (used
+    by the module path's ``(num_queries, max_len)`` layout) contribute nothing
+    to either the hit positions or the positive count. Queries with no
+    positive target evaluate to 0, matching the reference's early-out
+    (``average_precision.py:47-48``).
+    """
+    sorted_target = jnp.asarray(sorted_target, dtype=jnp.float32)
+    positions = jnp.arange(1, sorted_target.shape[-1] + 1, dtype=jnp.float32)
+    hits = jnp.cumsum(sorted_target, axis=-1)
+    precision_at_hit = jnp.where(sorted_target > 0, hits / positions, 0.0)
+    total_pos = jnp.sum(sorted_target, axis=-1)
+    return jnp.where(total_pos > 0, jnp.sum(precision_at_hit, axis=-1) / jnp.maximum(total_pos, 1), 0.0)
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """Average precision of a single query's predictions w.r.t. binary targets.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_average_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_average_precision(preds, target)
+        Array(0.8333334, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    sorted_target = target[jnp.argsort(-preds, stable=True)]
+    return _retrieval_average_precision_from_sorted(sorted_target)

@@ -1,0 +1,46 @@
+"""Fall-out@k for information retrieval
+(parity: ``torchmetrics/functional/retrieval/fall_out.py:21-65``)."""
+from typing import Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.functional.retrieval.precision import _check_k, _per_row
+
+
+def _retrieval_fall_out_from_sorted(sorted_target: Array, k: Array, num_valid: Array) -> Array:
+    """Retrieved negatives in the top-``k`` over total negatives.
+
+    Unlike the positive-based kernels, padded entries would read as negatives,
+    so the true query length ``num_valid`` masks them out of both numerator
+    and denominator. Queries with no negative target evaluate to 0 (reference
+    early-out at ``fall_out.py:58-59``).
+    """
+    sorted_target = jnp.asarray(sorted_target, dtype=jnp.float32)
+    k = _per_row(k, sorted_target)
+    num_valid = _per_row(num_valid, sorted_target)
+    positions = jnp.arange(sorted_target.shape[-1])
+    negatives = (1.0 - sorted_target) * (positions < num_valid)
+    retrieved_neg = jnp.sum(negatives * (positions < k), axis=-1)
+    total_neg = jnp.sum(negatives, axis=-1)
+    return jnp.where(total_neg > 0, retrieved_neg / jnp.maximum(total_neg, 1), 0.0)
+
+
+def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fall-out@k of a single query's predictions w.r.t. binary targets.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_fall_out
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_fall_out(preds, target, k=2)
+        Array(1., dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _check_k(k)
+    if k is None:
+        k = preds.shape[-1]
+    sorted_target = target[jnp.argsort(-preds, stable=True)]
+    return _retrieval_fall_out_from_sorted(sorted_target, jnp.asarray(k), jnp.asarray(preds.shape[-1]))

@@ -1,0 +1,52 @@
+"""Normalized discounted cumulative gain
+(parity: ``torchmetrics/functional/retrieval/ndcg.py:20-61``)."""
+from typing import Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.functional.retrieval.precision import _check_k, _per_row
+
+
+def _dcg_at_k(sorted_target: Array, k: Array) -> Array:
+    """Discounted cumulative gain of the first ``k`` entries of a sorted row."""
+    sorted_target = jnp.asarray(sorted_target, dtype=jnp.float32)
+    k = _per_row(k, sorted_target)
+    positions = jnp.arange(sorted_target.shape[-1], dtype=jnp.float32)
+    discount = jnp.log2(positions + 2.0)
+    return jnp.sum(sorted_target / discount * (positions < k), axis=-1)
+
+
+def _retrieval_normalized_dcg_from_sorted(sorted_target: Array, k: Array) -> Array:
+    """nDCG@k given targets sorted by descending score.
+
+    The ideal ordering re-sorts the (non-negative) relevances descending in
+    graph; zero padding sorts to the tail and contributes no gain, so the
+    kernel is padding-tolerant for the vmapped module path. Queries with zero
+    total relevance evaluate to 0 (reference early-out at ``ndcg.py:55-56``).
+    """
+    sorted_target = jnp.asarray(sorted_target, dtype=jnp.float32)
+    ideal_target = -jnp.sort(-sorted_target, axis=-1)
+    dcg = _dcg_at_k(sorted_target, k)
+    idcg = _dcg_at_k(ideal_target, k)
+    return jnp.where(idcg > 0, dcg / jnp.where(idcg > 0, idcg, 1.0), 0.0)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """nDCG@k of a single query; ``target`` may hold graded (non-binary) relevance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_normalized_dcg
+        >>> preds = jnp.asarray([.1, .2, .3, 4, 70])
+        >>> target = jnp.asarray([10, 0, 0, 1, 5])
+        >>> retrieval_normalized_dcg(preds, target)
+        Array(0.69569826, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    _check_k(k)
+    if k is None:
+        k = preds.shape[-1]
+    sorted_target = target[jnp.argsort(-preds, stable=True)]
+    return _retrieval_normalized_dcg_from_sorted(sorted_target, jnp.asarray(k))

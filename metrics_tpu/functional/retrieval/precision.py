@@ -1,0 +1,56 @@
+"""Precision@k for information retrieval
+(parity: ``torchmetrics/functional/retrieval/precision.py:21-62``)."""
+from typing import Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.data import Array
+
+
+def _check_k(k: Optional[int]) -> None:
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+
+
+def _per_row(x: Array, ref: Array) -> Array:
+    """Broadcast a per-query scalar/vector against ``(num_queries, max_len)`` rows."""
+    x = jnp.asarray(x)
+    if x.ndim == ref.ndim - 1 and x.ndim > 0:
+        x = x[..., None]
+    return x
+
+
+def _retrieval_precision_from_sorted(sorted_target: Array, k: Array) -> Array:
+    """Hits in the top-``k`` over ``k``, given targets sorted by descending score.
+
+    ``k`` may be a traced scalar (the module path passes per-query lengths when
+    ``k=None``). Queries with no positive target evaluate to 0
+    (reference early-out at ``precision.py:55-56``).
+    """
+    sorted_target = jnp.asarray(sorted_target, dtype=jnp.float32)
+    k = _per_row(k, sorted_target)
+    positions = jnp.arange(sorted_target.shape[-1])
+    relevant = jnp.sum(sorted_target * (positions < k), axis=-1)
+    has_pos = jnp.sum(sorted_target, axis=-1) > 0
+    k_per_query = jnp.squeeze(k, -1) if k.ndim > 1 else k
+    return jnp.where(has_pos, relevant / k_per_query, 0.0)
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Precision@k of a single query's predictions w.r.t. binary targets.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_precision(preds, target, k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _check_k(k)
+    if k is None:
+        k = preds.shape[-1]
+    sorted_target = target[jnp.argsort(-preds, stable=True)]
+    return _retrieval_precision_from_sorted(sorted_target, jnp.asarray(k))

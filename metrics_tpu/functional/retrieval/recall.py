@@ -1,0 +1,38 @@
+"""Recall@k for information retrieval
+(parity: ``torchmetrics/functional/retrieval/recall.py:21-63``)."""
+from typing import Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.functional.retrieval.precision import _check_k, _per_row
+
+
+def _retrieval_recall_from_sorted(sorted_target: Array, k: Array) -> Array:
+    """Hits in the top-``k`` over total positives, targets sorted by score desc."""
+    sorted_target = jnp.asarray(sorted_target, dtype=jnp.float32)
+    k = _per_row(k, sorted_target)
+    positions = jnp.arange(sorted_target.shape[-1])
+    relevant = jnp.sum(sorted_target * (positions < k), axis=-1)
+    total_pos = jnp.sum(sorted_target, axis=-1)
+    return jnp.where(total_pos > 0, relevant / jnp.maximum(total_pos, 1), 0.0)
+
+
+def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Recall@k of a single query's predictions w.r.t. binary targets.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_recall
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_recall(preds, target, k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _check_k(k)
+    if k is None:
+        k = preds.shape[-1]
+    sorted_target = target[jnp.argsort(-preds, stable=True)]
+    return _retrieval_recall_from_sorted(sorted_target, jnp.asarray(k))

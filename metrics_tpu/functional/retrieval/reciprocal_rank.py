@@ -1,0 +1,35 @@
+"""Reciprocal rank for information retrieval
+(parity: ``torchmetrics/functional/retrieval/reciprocal_rank.py:21-56``)."""
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.data import Array
+
+
+def _retrieval_reciprocal_rank_from_sorted(sorted_target: Array) -> Array:
+    """1/(position of first hit) given targets sorted by descending score.
+
+    ``argmax`` on the boolean hit vector finds the first positive; queries
+    with no positive evaluate to 0 (reference early-out at
+    ``reciprocal_rank.py:44-45``). Padding-tolerant for the vmapped module path.
+    """
+    sorted_target = jnp.asarray(sorted_target)
+    first_hit = jnp.argmax(sorted_target > 0, axis=-1)
+    has_hit = jnp.sum(sorted_target, axis=-1) > 0
+    return jnp.where(has_hit, 1.0 / (first_hit + 1.0), 0.0)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """Reciprocal rank of the first relevant document for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, False])
+        >>> retrieval_reciprocal_rank(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    sorted_target = target[jnp.argsort(-preds, stable=True)]
+    return _retrieval_reciprocal_rank_from_sorted(sorted_target)

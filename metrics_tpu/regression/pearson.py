@@ -16,7 +16,7 @@ class PearsonCorrcoef(Metric):
         >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
         >>> pearson = PearsonCorrcoef()
         >>> pearson(preds, target)
-        Array(0.98546666, dtype=float32)
+        Array(0.9848697, dtype=float32)
     """
 
     is_differentiable = True

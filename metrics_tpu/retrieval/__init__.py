@@ -1,0 +1,17 @@
+from metrics_tpu.retrieval.mean_average_precision import RetrievalMAP  # noqa: F401
+from metrics_tpu.retrieval.mean_reciprocal_rank import RetrievalMRR  # noqa: F401
+from metrics_tpu.retrieval.retrieval_fallout import RetrievalFallOut  # noqa: F401
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric  # noqa: F401
+from metrics_tpu.retrieval.retrieval_ndcg import RetrievalNormalizedDCG  # noqa: F401
+from metrics_tpu.retrieval.retrieval_precision import RetrievalPrecision  # noqa: F401
+from metrics_tpu.retrieval.retrieval_recall import RetrievalRecall  # noqa: F401
+
+__all__ = [
+    "RetrievalFallOut",
+    "RetrievalMAP",
+    "RetrievalMetric",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalRecall",
+]

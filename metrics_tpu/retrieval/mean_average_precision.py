@@ -1,0 +1,24 @@
+"""RetrievalMAP module (parity: ``torchmetrics/retrieval/mean_average_precision.py:20-70``)."""
+from metrics_tpu.functional.retrieval.average_precision import _retrieval_average_precision_from_sorted
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utilities.data import Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMAP
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> rmap(preds, target, indexes=indexes)
+        Array(0.79166667, dtype=float32)
+    """
+
+    higher_is_better = True
+
+    def _metric_rows(self, target_rows: Array, lengths: Array) -> Array:
+        return _retrieval_average_precision_from_sorted(target_rows)

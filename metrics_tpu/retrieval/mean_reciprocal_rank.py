@@ -1,0 +1,24 @@
+"""RetrievalMRR module (parity: ``torchmetrics/retrieval/mean_reciprocal_rank.py:20-70``)."""
+from metrics_tpu.functional.retrieval.reciprocal_rank import _retrieval_reciprocal_rank_from_sorted
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utilities.data import Array
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> mrr = RetrievalMRR()
+        >>> mrr(preds, target, indexes=indexes)
+        Array(0.75, dtype=float32)
+    """
+
+    higher_is_better = True
+
+    def _metric_rows(self, target_rows: Array, lengths: Array) -> Array:
+        return _retrieval_reciprocal_rank_from_sorted(target_rows)

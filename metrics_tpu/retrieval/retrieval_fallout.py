@@ -1,0 +1,51 @@
+"""RetrievalFallOut module (parity: ``torchmetrics/retrieval/retrieval_fallout.py:24-128``)."""
+from typing import Any, Callable, Optional
+
+from metrics_tpu.functional.retrieval.fall_out import _retrieval_fall_out_from_sorted
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utilities.data import Array
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Mean fall-out@k over queries.
+
+    A query counts as "empty" when it has no *negative* target
+    (``retrieval_fallout.py:113-119``), and the default policy scores it 1.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalFallOut
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> fo = RetrievalFallOut(k=2)
+        >>> fo(preds, target, indexes=indexes)
+        Array(0.5, dtype=float32)
+    """
+
+    higher_is_better = False
+    _empty_relevance = "negative"
+    _uses_k = True
+
+    def __init__(
+        self,
+        empty_target_action: str = "pos",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        k: Optional[int] = None,
+    ) -> None:
+        # only the default policy differs from the base ('pos': a query with no
+        # negatives has "retrieved no negatives", the benign outcome)
+        super().__init__(
+            empty_target_action=empty_target_action,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            k=k,
+        )
+
+    def _metric_rows(self, target_rows: Array, lengths: Array) -> Array:
+        return _retrieval_fall_out_from_sorted(target_rows, self._resolve_k(lengths), lengths)

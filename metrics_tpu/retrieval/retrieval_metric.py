@@ -1,0 +1,146 @@
+"""RetrievalMetric base class (parity: ``torchmetrics/retrieval/retrieval_metric.py:27-141``).
+
+The reference computes per-query scores with a Python loop over
+``get_group_indexes`` groups — thousands of tiny kernel launches
+(``retrieval_metric.py:118-128``). Here the epoch-end compute instead:
+
+1. densifies query ids and lexsorts the flat stream by ``(query, -score)``
+   once on the host (epoch boundary, concrete data),
+2. scatters it into a padded ``(num_queries, max_len)`` layout, and
+3. evaluates every query at once with a single vmapped XLA program built from
+   the same ``_*_from_sorted`` row kernels the functional API uses — the
+   empty-query policies become masked arithmetic instead of branches.
+"""
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.retrieval.precision import _check_k
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.checks import _check_retrieval_inputs
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base for information-retrieval metrics over ``(preds, target, indexes)``.
+
+    ``indexes`` maps each prediction to its query; scores are grouped by
+    query, scored per query by the subclass row kernel, and averaged.
+
+    Args:
+        empty_target_action: what to do with queries having no positive (for
+            fall-out: no negative) target — ``'neg'`` score 0, ``'pos'`` score
+            1, ``'skip'`` drop the query, ``'error'`` raise.
+        compute_on_step: return the batch value from ``forward``.
+        dist_sync_on_step: sync state across processes each ``forward``.
+        process_group: mesh axis (or process group analogue) to reduce over.
+        dist_sync_fn: override for the eager state gather.
+        k: score only each query's top ``k`` predictions (``None``: all);
+            only subclasses with ``_uses_k`` accept it.
+    """
+
+    #: compute() groups queries on the host (epoch boundary) and cannot trace
+    _fusable = False
+    #: targets may hold graded relevance (NDCG) instead of binary labels
+    allow_non_binary_target: bool = False
+    #: queries are "empty" when they lack this kind of target (fall-out: negatives)
+    _empty_relevance: str = "positive"
+    #: whether this metric has @k semantics (MAP/MRR do not)
+    _uses_k: bool = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        k: Optional[int] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"`empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if k is not None and not self._uses_k:
+            raise TypeError(f"{self.__class__.__name__} does not accept `k`")
+        _check_k(k)
+        self.k = k
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def _resolve_k(self, lengths: Array) -> Array:
+        """``k`` per query: the configured top-k or each query's full length."""
+        return lengths if self.k is None else jnp.asarray(self.k)
+
+    def update(self, preds: Array, target: Array, indexes: Optional[Array] = None) -> None:
+        """Validate, flatten and append one batch of (preds, target, indexes)."""
+        if indexes is None:
+            raise ValueError("`indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            jnp.asarray(indexes), jnp.asarray(preds), jnp.asarray(target),
+            allow_non_binary_target=self.allow_non_binary_target,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _group_into_rows(self) -> Tuple[Array, Array]:
+        """Flat accumulated stream -> ``(num_queries, max_len)`` rows sorted by
+        descending score, plus per-query lengths. Host-side (concrete epoch data)."""
+        indexes = np.asarray(dim_zero_cat(self.indexes))
+        preds = np.asarray(dim_zero_cat(self.preds))
+        target = np.asarray(dim_zero_cat(self.target))
+
+        _, inverse = np.unique(indexes, return_inverse=True)
+        order = np.lexsort((-preds, inverse))  # query-major, score-descending
+        counts = np.bincount(inverse)
+        num_queries, max_len = counts.size, int(counts.max())
+
+        row = inverse[order]
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        col = np.arange(indexes.size) - starts[row]
+
+        target_rows = np.zeros((num_queries, max_len), dtype=target.dtype)
+        target_rows[row, col] = target[order]
+        return jnp.asarray(target_rows), jnp.asarray(counts)
+
+    def compute(self) -> Array:
+        """Mean per-query score with the empty-query policy applied as masks."""
+        target_rows, lengths = self._group_into_rows()
+        values = self._metric_rows(target_rows, lengths)
+
+        if self._empty_relevance == "negative":
+            relevant = lengths - jnp.sum(target_rows > 0, axis=-1)
+        else:
+            relevant = jnp.sum(target_rows, axis=-1)
+        empty = relevant == 0
+
+        if self.empty_target_action == "error":
+            if bool(jnp.any(empty)):
+                kind = self._empty_relevance
+                raise ValueError(f"`compute` method was provided with a query with no {kind} target.")
+            return jnp.mean(values)
+        if self.empty_target_action == "pos":
+            values = jnp.where(empty, 1.0, values)
+        elif self.empty_target_action == "neg":
+            values = jnp.where(empty, 0.0, values)
+        elif self.empty_target_action == "skip":
+            kept = jnp.sum(~empty)
+            return jnp.where(kept > 0, jnp.sum(jnp.where(empty, 0.0, values)) / jnp.maximum(kept, 1), 0.0)
+        return jnp.mean(values)
+
+    @abstractmethod
+    def _metric_rows(self, target_rows: Array, lengths: Array) -> Array:
+        """Score every query at once: ``(num_queries, max_len)`` sorted-target
+        rows + true lengths -> ``(num_queries,)`` values. Must be pure jnp."""

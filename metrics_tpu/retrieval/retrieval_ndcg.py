@@ -1,0 +1,26 @@
+"""RetrievalNormalizedDCG module (parity: ``torchmetrics/retrieval/retrieval_ndcg.py:22-94``)."""
+from metrics_tpu.functional.retrieval.ndcg import _retrieval_normalized_dcg_from_sorted
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utilities.data import Array
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """Mean nDCG@k over queries; targets may hold graded relevance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalNormalizedDCG
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> ndcg = RetrievalNormalizedDCG()
+        >>> ndcg(preds, target, indexes=indexes)
+        Array(0.84670985, dtype=float32)
+    """
+
+    higher_is_better = True
+    allow_non_binary_target = True
+    _uses_k = True
+
+    def _metric_rows(self, target_rows: Array, lengths: Array) -> Array:
+        return _retrieval_normalized_dcg_from_sorted(target_rows, self._resolve_k(lengths))

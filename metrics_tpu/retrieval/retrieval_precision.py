@@ -1,0 +1,25 @@
+"""RetrievalPrecision module (parity: ``torchmetrics/retrieval/retrieval_precision.py:22-94``)."""
+from metrics_tpu.functional.retrieval.precision import _retrieval_precision_from_sorted
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utilities.data import Array
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Mean precision@k over queries (``k=None`` uses each query's full length).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> p2 = RetrievalPrecision(k=2)
+        >>> p2(preds, target, indexes=indexes)
+        Array(0.5, dtype=float32)
+    """
+
+    higher_is_better = True
+    _uses_k = True
+
+    def _metric_rows(self, target_rows: Array, lengths: Array) -> Array:
+        return _retrieval_precision_from_sorted(target_rows, self._resolve_k(lengths))

@@ -1,0 +1,25 @@
+"""RetrievalRecall module (parity: ``torchmetrics/retrieval/retrieval_recall.py:22-94``)."""
+from metrics_tpu.functional.retrieval.recall import _retrieval_recall_from_sorted
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utilities.data import Array
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Mean recall@k over queries (``k=None`` uses each query's full length).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecall
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> r2 = RetrievalRecall(k=2)
+        >>> r2(preds, target, indexes=indexes)
+        Array(0.75, dtype=float32)
+    """
+
+    higher_is_better = True
+    _uses_k = True
+
+    def _metric_rows(self, target_rows: Array, lengths: Array) -> Array:
+        return _retrieval_recall_from_sorted(target_rows, self._resolve_k(lengths))

@@ -1,0 +1,39 @@
+"""Deterministic fixtures for the retrieval tests (reference pattern:
+``tests/retrieval/inputs.py``): (indexes, preds, target) batches where indexes
+repeat across batches so queries span batch (and simulated-rank) boundaries."""
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES
+
+RetrievalInput = namedtuple("RetrievalInput", ["indexes", "preds", "target"])
+
+_rng = np.random.RandomState(42)
+
+NUM_QUERIES = 10
+
+_irs = RetrievalInput(
+    indexes=_rng.randint(0, NUM_QUERIES, size=(NUM_BATCHES, BATCH_SIZE)),
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=_rng.randint(0, 2, size=(NUM_BATCHES, BATCH_SIZE)),
+)
+
+# non-binary relevance for nDCG
+_irs_non_binary = RetrievalInput(
+    indexes=_rng.randint(0, NUM_QUERIES, size=(NUM_BATCHES, BATCH_SIZE)),
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=_rng.randint(0, 4, size=(NUM_BATCHES, BATCH_SIZE)),
+)
+
+# guaranteed all-negative queries (policy paths): queries 0..2 have target 0
+# everywhere; guaranteed all-positive queries 7..9 (fall-out policy paths)
+_idx_empty = _rng.randint(0, NUM_QUERIES, size=(NUM_BATCHES, BATCH_SIZE))
+_tgt_empty = _rng.randint(0, 2, size=(NUM_BATCHES, BATCH_SIZE))
+_tgt_empty[_idx_empty <= 2] = 0
+_tgt_empty[_idx_empty >= 7] = 1
+_irs_empty_queries = RetrievalInput(
+    indexes=_idx_empty,
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=_tgt_empty,
+)

@@ -1,0 +1,260 @@
+"""Retrieval-family parity vs an independent numpy oracle implementing the
+reference's per-query loop semantics (``retrieval/retrieval_metric.py:104-133``)."""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from tests.helpers.testers import MetricTester
+from tests.retrieval.inputs import _irs, _irs_empty_queries, _irs_non_binary
+
+# ---------------------------------------------------------------------------
+# numpy oracle: single-query scores
+# ---------------------------------------------------------------------------
+
+
+def _np_ap(preds, target, k=None):
+    order = np.argsort(-preds, kind="stable")
+    t = target[order]
+    positions = np.arange(1, len(t) + 1)[t > 0]
+    if len(positions) == 0:
+        return 0.0
+    return np.mean((np.arange(len(positions)) + 1) / positions)
+
+
+def _np_rr(preds, target, k=None):
+    t = target[np.argsort(-preds, kind="stable")]
+    hits = np.nonzero(t > 0)[0]
+    return 0.0 if len(hits) == 0 else 1.0 / (hits[0] + 1)
+
+
+def _np_precision(preds, target, k=None):
+    k = len(preds) if k is None else k
+    if target.sum() == 0:
+        return 0.0
+    t = target[np.argsort(-preds, kind="stable")]
+    return t[:k].sum() / k
+
+
+def _np_recall(preds, target, k=None):
+    k = len(preds) if k is None else k
+    if target.sum() == 0:
+        return 0.0
+    t = target[np.argsort(-preds, kind="stable")]
+    return t[:k].sum() / target.sum()
+
+
+def _np_fall_out(preds, target, k=None):
+    k = len(preds) if k is None else k
+    neg = 1 - target
+    if neg.sum() == 0:
+        return 0.0
+    n = neg[np.argsort(-preds, kind="stable")]
+    return n[:k].sum() / neg.sum()
+
+
+def _np_dcg(t):
+    return (t / np.log2(np.arange(len(t)) + 2.0)).sum()
+
+
+def _np_ndcg(preds, target, k=None):
+    k = len(preds) if k is None else k
+    if target.sum() == 0:
+        return 0.0
+    sorted_t = target[np.argsort(-preds, kind="stable")][:k]
+    ideal_t = np.sort(target)[::-1][:k]
+    idcg = _np_dcg(ideal_t)
+    return 0.0 if idcg == 0 else _np_dcg(sorted_t) / idcg
+
+
+def _np_grouped(query_fn, empty_on="pos"):
+    """Reference group-loop semantics as an oracle over the flat stream."""
+
+    def _oracle(preds, target, indexes=None, k=None, empty_target_action="neg"):
+        preds, target, indexes = np.asarray(preds), np.asarray(target), np.asarray(indexes)
+        res = []
+        for g in np.unique(indexes):
+            mask = indexes == g
+            p, t = preds[mask], target[mask]
+            relevant = (1 - t).sum() if empty_on == "neg" else t.sum()
+            if relevant == 0:
+                if empty_target_action == "pos":
+                    res.append(1.0)
+                elif empty_target_action == "neg":
+                    res.append(0.0)
+                # 'skip' drops the query
+            else:
+                res.append(query_fn(p, t, k))
+        return np.mean(res) if res else 0.0
+
+    return _oracle
+
+
+_METRICS = [
+    (RetrievalMAP, retrieval_average_precision, _np_ap, "pos", False),
+    (RetrievalMRR, retrieval_reciprocal_rank, _np_rr, "pos", False),
+    (RetrievalPrecision, retrieval_precision, _np_precision, "pos", True),
+    (RetrievalRecall, retrieval_recall, _np_recall, "pos", True),
+    (RetrievalFallOut, retrieval_fall_out, _np_fall_out, "neg", True),
+]
+
+
+@pytest.mark.parametrize("metric_class, functional, query_fn, empty_on, has_k", _METRICS)
+class TestRetrieval(MetricTester):
+    atol = 1e-6
+
+    def test_functional_single_query(self, metric_class, functional, query_fn, empty_on, has_k):
+        rng = np.random.RandomState(7)
+        for n in (1, 5, 33):
+            preds = rng.rand(n).astype(np.float32)
+            target = rng.randint(0, 2, size=n)
+            for k in ([None, 1, 3] if has_k else [None]):
+                if k is not None and k > n:
+                    continue
+                kwargs = {} if k is None else {"k": k}
+                tm = functional(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+                # the functional API scores one query: empty targets -> 0
+                if empty_on == "neg":
+                    expected = 0.0 if (1 - target).sum() == 0 else query_fn(preds, target, k)
+                else:
+                    expected = 0.0 if target.sum() == 0 else query_fn(preds, target, k)
+                np.testing.assert_allclose(np.asarray(tm), expected, atol=self.atol, rtol=0)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_metric(self, ddp, metric_class, functional, query_fn, empty_on, has_k):
+        default_action = "pos" if metric_class is RetrievalFallOut else "neg"
+        sk = _np_grouped(query_fn, empty_on=empty_on)
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_irs.preds,
+            target=_irs.target,
+            metric_class=metric_class,
+            sk_metric=lambda p, t, indexes: sk(p, t, indexes=indexes, empty_target_action=default_action),
+            metric_args={},
+            check_batch=False,
+            indexes=_irs.indexes,
+        )
+
+    @pytest.mark.parametrize("empty_target_action", ["neg", "pos", "skip"])
+    def test_empty_target_policies(self, metric_class, functional, query_fn, empty_on, has_k, empty_target_action):
+        sk = _np_grouped(query_fn, empty_on=empty_on)
+        metric = metric_class(empty_target_action=empty_target_action)
+        for i in range(_irs_empty_queries.preds.shape[0]):
+            metric.update(
+                jnp.asarray(_irs_empty_queries.preds[i]),
+                jnp.asarray(_irs_empty_queries.target[i]),
+                indexes=jnp.asarray(_irs_empty_queries.indexes[i]),
+            )
+        result = metric.compute()
+        expected = sk(
+            _irs_empty_queries.preds.reshape(-1),
+            _irs_empty_queries.target.reshape(-1),
+            indexes=_irs_empty_queries.indexes.reshape(-1),
+            empty_target_action=empty_target_action,
+        )
+        np.testing.assert_allclose(np.asarray(result), expected, atol=self.atol, rtol=0)
+
+    def test_empty_target_error(self, metric_class, functional, query_fn, empty_on, has_k):
+        metric = metric_class(empty_target_action="error")
+        metric.update(
+            jnp.asarray(_irs_empty_queries.preds[0]),
+            jnp.asarray(_irs_empty_queries.target[0]),
+            indexes=jnp.asarray(_irs_empty_queries.indexes[0]),
+        )
+        with pytest.raises(ValueError, match="no (positive|negative) target"):
+            metric.compute()
+
+
+@pytest.mark.parametrize("k", [None, 1, 4])
+@pytest.mark.parametrize("ddp", [False, True])
+def test_ndcg_class(k, ddp):
+    tester = MetricTester()
+    tester.atol = 1e-6
+    sk = _np_grouped(lambda p, t, kk: _np_ndcg(p, t, kk), empty_on="pos")
+    tester.run_class_metric_test(
+        ddp=ddp,
+        preds=_irs_non_binary.preds,
+        target=_irs_non_binary.target,
+        metric_class=RetrievalNormalizedDCG,
+        sk_metric=lambda p, t, indexes: sk(p, t, indexes=indexes, k=k),
+        metric_args={"k": k},
+        check_batch=False,
+        indexes=_irs_non_binary.indexes,
+    )
+
+
+@pytest.mark.parametrize("k", [1, 4])
+@pytest.mark.parametrize(
+    "metric_class, query_fn, empty_on, default_action",
+    [
+        (RetrievalPrecision, _np_precision, "pos", "neg"),
+        (RetrievalRecall, _np_recall, "pos", "neg"),
+        (RetrievalFallOut, _np_fall_out, "neg", "pos"),
+    ],
+)
+def test_k_variants(metric_class, query_fn, empty_on, default_action, k):
+    sk = _np_grouped(query_fn, empty_on=empty_on)
+    metric = metric_class(k=k)
+    for i in range(_irs.preds.shape[0]):
+        metric.update(
+            jnp.asarray(_irs.preds[i]), jnp.asarray(_irs.target[i]), indexes=jnp.asarray(_irs.indexes[i])
+        )
+    result = metric.compute()
+    expected = sk(
+        _irs.preds.reshape(-1),
+        _irs.target.reshape(-1),
+        indexes=_irs.indexes.reshape(-1),
+        k=k,
+        empty_target_action=default_action,
+    )
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6, rtol=0)
+
+
+def test_functional_ndcg_non_binary():
+    rng = np.random.RandomState(3)
+    preds = rng.rand(40).astype(np.float32)
+    target = rng.randint(0, 5, size=40)
+    tm = retrieval_normalized_dcg(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(tm), _np_ndcg(preds, target), atol=1e-6, rtol=0)
+
+
+@pytest.mark.parametrize(
+    "indexes, preds, target, match",
+    [
+        (None, [0.1], [1], "cannot be None"),
+        ([0], [0.1], [1.0], "booleans or integers"),
+        ([0.5], [0.1], [1], "long integers"),
+        ([0, 0], [0.1, 0.2], [0, 3], "binary"),
+        ([0], [1], [1], "floats"),
+    ],
+)
+def test_update_input_errors(indexes, preds, target, match):
+    metric = RetrievalMAP()
+    with pytest.raises(ValueError, match=match):
+        metric.update(
+            jnp.asarray(preds),
+            jnp.asarray(target),
+            indexes=None if indexes is None else jnp.asarray(indexes),
+        )
+
+
+def test_bad_empty_target_action():
+    with pytest.raises(ValueError, match="received a wrong value"):
+        RetrievalMAP(empty_target_action="bogus")
