@@ -42,7 +42,7 @@ def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = Non
         >>> preds = jnp.asarray([.1, .2, .3, 4, 70])
         >>> target = jnp.asarray([10, 0, 0, 1, 5])
         >>> retrieval_normalized_dcg(preds, target)
-        Array(0.69569826, dtype=float32)
+        Array(0.6956907, dtype=float32)
     """
     preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
     _check_k(k)

@@ -13,10 +13,10 @@ def _retrieval_reciprocal_rank_from_sorted(sorted_target: Array) -> Array:
     with no positive evaluate to 0 (reference early-out at
     ``reciprocal_rank.py:44-45``). Padding-tolerant for the vmapped module path.
     """
-    sorted_target = jnp.asarray(sorted_target)
+    sorted_target = jnp.asarray(sorted_target, dtype=jnp.float32)
     first_hit = jnp.argmax(sorted_target > 0, axis=-1)
     has_hit = jnp.sum(sorted_target, axis=-1) > 0
-    return jnp.where(has_hit, 1.0 / (first_hit + 1.0), 0.0)
+    return jnp.where(has_hit, jnp.float32(1.0) / (first_hit + jnp.float32(1.0)), jnp.float32(0.0))
 
 
 def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:

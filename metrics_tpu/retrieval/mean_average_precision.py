@@ -15,7 +15,7 @@ class RetrievalMAP(RetrievalMetric):
         >>> target = jnp.asarray([False, False, True, False, True, False, True])
         >>> rmap = RetrievalMAP()
         >>> rmap(preds, target, indexes=indexes)
-        Array(0.79166667, dtype=float32)
+        Array(0.7916667, dtype=float32)
     """
 
     higher_is_better = True

@@ -336,12 +336,17 @@ def _check_retrieval_functional_inputs(
     target: Array,
     allow_non_binary_target: bool = False,
 ) -> Tuple[Array, Array]:
-    """Validate and flatten a (preds, target) retrieval pair -> (f32, int32)."""
+    """Validate and flatten a (preds, target) retrieval pair -> (f32, int32).
+
+    With ``allow_non_binary_target`` (nDCG), targets hold graded relevance:
+    float dtypes are accepted and preserved as f32 instead of cast to int.
+    """
     if preds.shape != target.shape:
         raise ValueError("`preds` and `target` must be of the same shape")
     if preds.ndim == 0 or preds.size == 0:
         raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
-    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_):
+    target_is_int = jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_
+    if not target_is_int and not (allow_non_binary_target and is_floating_point(target)):
         raise ValueError("`target` must be a tensor of booleans or integers")
     if not is_floating_point(preds):
         raise ValueError("`preds` must be a tensor of floats")
@@ -349,7 +354,8 @@ def _check_retrieval_functional_inputs(
         t = np.asarray(target)
         if (not allow_non_binary_target and t.max() > 1) or t.min() < 0:
             raise ValueError("`target` must contain `binary` values")
-    return preds.astype(jnp.float32).reshape(-1), target.astype(jnp.int32).reshape(-1)
+    target = target.astype(jnp.int32) if target_is_int else target.astype(jnp.float32)
+    return preds.astype(jnp.float32).reshape(-1), target.reshape(-1)
 
 
 def _check_retrieval_inputs(
@@ -358,7 +364,11 @@ def _check_retrieval_inputs(
     target: Array,
     allow_non_binary_target: bool = False,
 ) -> Tuple[Array, Array, Array]:
-    """Validate and flatten an (indexes, preds, target) triple -> (int32, f32, int32)."""
+    """Validate and flatten an (indexes, preds, target) triple -> (int32, f32, int32).
+
+    With ``allow_non_binary_target`` (nDCG), float graded-relevance targets are
+    accepted and preserved as f32.
+    """
     if indexes.shape != preds.shape or preds.shape != target.shape:
         raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
     if indexes.ndim == 0 or indexes.size == 0:
@@ -367,14 +377,16 @@ def _check_retrieval_inputs(
         raise ValueError("`indexes` must be a tensor of long integers")
     if not is_floating_point(preds):
         raise ValueError("`preds` must be a tensor of floats")
-    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_):
+    target_is_int = jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_
+    if not target_is_int and not (allow_non_binary_target and is_floating_point(target)):
         raise ValueError("`target` must be a tensor of booleans or integers")
     if not _is_traced(target):
         t = np.asarray(target)
         if (not allow_non_binary_target and t.max() > 1) or t.min() < 0:
             raise ValueError("`target` must contain `binary` values")
+    target = target.astype(jnp.int32) if target_is_int else target.astype(jnp.float32)
     return (
         indexes.astype(jnp.int32).reshape(-1),
         preds.astype(jnp.float32).reshape(-1),
-        target.astype(jnp.int32).reshape(-1),
+        target.reshape(-1),
     )

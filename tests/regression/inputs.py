@@ -1,0 +1,23 @@
+"""Deterministic fixtures for the regression tests (reference pattern:
+``tests/regression/test_mean_error.py:30-43``)."""
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES
+
+RegressionInput = namedtuple("RegressionInput", ["preds", "target"])
+
+_rng = np.random.RandomState(42)
+
+NUM_OUTPUTS = 5
+
+_single_target_inputs = RegressionInput(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float64),
+    target=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float64),
+)
+
+_multi_target_inputs = RegressionInput(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_OUTPUTS).astype(np.float64),
+    target=_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_OUTPUTS).astype(np.float64),
+)

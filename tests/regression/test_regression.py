@@ -1,0 +1,248 @@
+"""Regression-family parity vs sklearn/scipy oracles (reference pattern:
+``tests/regression/``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score,
+    mean_absolute_error as sk_mae,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    r2_score as sk_r2,
+)
+
+from metrics_tpu import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrcoef,
+    R2Score,
+    SpearmanCorrcoef,
+)
+from metrics_tpu.functional import (
+    cosine_similarity,
+    explained_variance,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_relative_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    pearson_corrcoef,
+    r2score,
+    spearman_corrcoef,
+)
+from tests.helpers.testers import MetricTester
+from tests.regression.inputs import NUM_OUTPUTS, _multi_target_inputs, _single_target_inputs
+
+
+def _sk_mape(preds, target):
+    eps = 1.17e-06  # float32 tiny, matching the kernel's clamp
+    return np.mean(np.abs(preds - target) / np.clip(np.abs(target), eps, None))
+
+
+def _sk_cosine(preds, target, reduction="sum"):
+    p, t = np.atleast_2d(preds), np.atleast_2d(target)
+    sim = np.sum(p * t, axis=1) / (np.linalg.norm(p, axis=1) * np.linalg.norm(t, axis=1))
+    if reduction == "sum":
+        return sim.sum()
+    if reduction == "mean":
+        return sim.mean()
+    return sim
+
+
+_mean_error_cases = [
+    (MeanSquaredError, mean_squared_error, lambda p, t: sk_mse(t, p), {}),
+    (MeanSquaredError, mean_squared_error, lambda p, t: np.sqrt(sk_mse(t, p)), {"squared": False}),
+    (MeanAbsoluteError, mean_absolute_error, lambda p, t: sk_mae(t, p), {}),
+    (MeanSquaredLogError, mean_squared_log_error, lambda p, t: sk_msle(t, p), {}),
+    (MeanAbsolutePercentageError, mean_absolute_percentage_error, _sk_mape, {}),
+]
+
+
+@pytest.mark.parametrize("metric_class, metric_fn, sk_fn, metric_args", _mean_error_cases)
+class TestMeanError(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp, metric_class, metric_fn, sk_fn, metric_args):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_single_target_inputs.preds,
+            target=_single_target_inputs.target,
+            metric_class=metric_class,
+            sk_metric=sk_fn,
+            metric_args=metric_args,
+        )
+
+    def test_functional(self, metric_class, metric_fn, sk_fn, metric_args):
+        self.run_functional_metric_test(
+            _single_target_inputs.preds,
+            _single_target_inputs.target,
+            metric_fn,
+            sk_fn,
+            metric_args=metric_args,
+        )
+
+    def test_differentiability(self, metric_class, metric_fn, sk_fn, metric_args):
+        self.run_differentiability_test(
+            _single_target_inputs.preds,
+            _single_target_inputs.target,
+            metric_class(**metric_args),
+            metric_fn,
+            metric_args=metric_args,
+        )
+
+
+def test_mean_relative_error():
+    preds = _single_target_inputs.preds[0]
+    target = _single_target_inputs.target[0]
+    tm = mean_relative_error(jnp.asarray(preds), jnp.asarray(target))
+    expected = np.mean(np.abs(preds - target) / np.abs(target))
+    np.testing.assert_allclose(np.asarray(tm), expected, atol=1e-6)
+
+
+def test_mean_squared_log_error_negative_is_nan():
+    # the kernel mirrors the reference (log1p, no value validation): negative
+    # inputs below -1 produce NaN rather than raising
+    result = mean_squared_log_error(jnp.asarray([-2.0, 2.0]), jnp.asarray([1.0, 2.0]))
+    assert bool(jnp.isnan(result))
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+class TestExplainedVariance(MetricTester):
+    atol = 1e-8
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_multi(self, ddp, multioutput):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_multi_target_inputs.preds,
+            target=_multi_target_inputs.target,
+            metric_class=ExplainedVariance,
+            sk_metric=lambda p, t: explained_variance_score(t, p, multioutput=multioutput),
+            metric_args={"multioutput": multioutput},
+        )
+
+    def test_functional(self, multioutput):
+        self.run_functional_metric_test(
+            _multi_target_inputs.preds,
+            _multi_target_inputs.target,
+            explained_variance,
+            lambda p, t: explained_variance_score(t, p, multioutput=multioutput),
+            metric_args={"multioutput": multioutput},
+        )
+
+
+class TestR2Score(MetricTester):
+    atol = 1e-8
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+    def test_class_multi(self, ddp, multioutput):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_multi_target_inputs.preds,
+            target=_multi_target_inputs.target,
+            metric_class=R2Score,
+            sk_metric=lambda p, t: sk_r2(t, p, multioutput=multioutput),
+            metric_args={"num_outputs": NUM_OUTPUTS, "multioutput": multioutput},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_single(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_single_target_inputs.preds,
+            target=_single_target_inputs.target,
+            metric_class=R2Score,
+            sk_metric=lambda p, t: sk_r2(t, p),
+            metric_args={},
+        )
+
+    def test_adjusted(self):
+        preds = _single_target_inputs.preds.reshape(-1)
+        target = _single_target_inputs.target.reshape(-1)
+        n, k = preds.size, 1
+        raw = sk_r2(target, preds)
+        expected = 1 - (1 - raw) * (n - 1) / (n - k - 1)
+        tm = r2score(jnp.asarray(preds), jnp.asarray(target), adjusted=k)
+        np.testing.assert_allclose(np.asarray(tm), expected, atol=1e-8)
+
+
+class TestCorrcoefs(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_pearson_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_single_target_inputs.preds,
+            target=_single_target_inputs.target,
+            metric_class=PearsonCorrcoef,
+            sk_metric=lambda p, t: pearsonr(t.reshape(-1), p.reshape(-1))[0],
+            metric_args={},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_spearman_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_single_target_inputs.preds,
+            target=_single_target_inputs.target,
+            metric_class=SpearmanCorrcoef,
+            sk_metric=lambda p, t: spearmanr(t.reshape(-1), p.reshape(-1))[0],
+            metric_args={},
+        )
+
+    def test_pearson_functional(self):
+        self.run_functional_metric_test(
+            _single_target_inputs.preds,
+            _single_target_inputs.target,
+            pearson_corrcoef,
+            lambda p, t: pearsonr(t.reshape(-1), p.reshape(-1))[0],
+        )
+
+    def test_spearman_functional(self):
+        self.run_functional_metric_test(
+            _single_target_inputs.preds,
+            _single_target_inputs.target,
+            spearman_corrcoef,
+            lambda p, t: spearmanr(t.reshape(-1), p.reshape(-1))[0],
+        )
+
+    def test_spearman_with_ties(self):
+        preds = np.asarray([1.0, 2.0, 2.0, 2.0, 3.0, 4.0, 4.0, 5.0])
+        target = np.asarray([3.0, 1.0, 1.0, 2.0, 2.0, 4.0, 5.0, 5.0])
+        tm = spearman_corrcoef(jnp.asarray(preds), jnp.asarray(target))
+        np.testing.assert_allclose(np.asarray(tm), spearmanr(target, preds)[0], atol=1e-6)
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean", "none"])
+class TestCosineSimilarity(MetricTester):
+    atol = 1e-4  # the kernel computes in float32 (reference parity)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp, reduction):
+        if ddp and reduction == "none":
+            pytest.skip("rank-striped gather reorders per-sample output")
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_multi_target_inputs.preds,
+            target=_multi_target_inputs.target,
+            metric_class=CosineSimilarity,
+            sk_metric=lambda p, t: _sk_cosine(p, t, reduction=reduction),
+            metric_args={"reduction": reduction},
+        )
+
+    def test_functional(self, reduction):
+        self.run_functional_metric_test(
+            _multi_target_inputs.preds,
+            _multi_target_inputs.target,
+            cosine_similarity,
+            lambda p, t: _sk_cosine(p, t, reduction=reduction),
+            metric_args={"reduction": reduction},
+        )

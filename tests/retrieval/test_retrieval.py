@@ -235,6 +235,19 @@ def test_functional_ndcg_non_binary():
     np.testing.assert_allclose(np.asarray(tm), _np_ndcg(preds, target), atol=1e-6, rtol=0)
 
 
+def test_ndcg_float_graded_relevance():
+    # fractional relevance grades must be preserved, not truncated to int
+    rng = np.random.RandomState(4)
+    preds = rng.rand(40).astype(np.float32)
+    target = (rng.rand(40) * 4).astype(np.float32)
+    tm = retrieval_normalized_dcg(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(tm), _np_ndcg(preds, target), atol=1e-6, rtol=0)
+
+    metric = RetrievalNormalizedDCG()
+    metric.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.zeros(40, dtype=jnp.int32))
+    np.testing.assert_allclose(np.asarray(metric.compute()), _np_ndcg(preds, target), atol=1e-6, rtol=0)
+
+
 @pytest.mark.parametrize(
     "indexes, preds, target, match",
     [
