@@ -18,6 +18,7 @@ _logger.setLevel(__logging.INFO)
 _PACKAGE_ROOT = os.path.dirname(__file__)
 PROJECT_ROOT = os.path.dirname(_PACKAGE_ROOT)
 
+from metrics_tpu.audio import SI_SDR, SI_SNR, SNR  # noqa: F401 E402
 from metrics_tpu.average import AverageMeter  # noqa: F401 E402
 from metrics_tpu.classification import (  # noqa: F401 E402
     AUC,
@@ -108,6 +109,9 @@ __all__ = [
     "RetrievalNormalizedDCG",
     "RetrievalPrecision",
     "RetrievalRecall",
+    "SI_SDR",
+    "SI_SNR",
+    "SNR",
     "Specificity",
     "SpearmanCorrcoef",
     "StatScores",

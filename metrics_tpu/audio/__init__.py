@@ -1,0 +1,3 @@
+from metrics_tpu.audio.si_sdr import SI_SDR  # noqa: F401
+from metrics_tpu.audio.si_snr import SI_SNR  # noqa: F401
+from metrics_tpu.audio.snr import SNR  # noqa: F401

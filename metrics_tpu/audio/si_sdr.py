@@ -1,0 +1,60 @@
+"""SI_SDR module metric (parity: ``torchmetrics/audio/si_sdr.py:22``)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.si_sdr import si_sdr
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array
+
+
+class SI_SDR(Metric):
+    """Scale-invariant signal-to-distortion ratio, averaged over all samples.
+
+    States are two psum-able scalars (``sum_si_sdr``, ``total``) so the
+    per-batch update fuses into the training step and epoch sync is a single
+    collective.
+
+    Args:
+        zero_mean: if True, mean-center ``preds``/``target`` before scaling
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SI_SDR
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> si_sdr = SI_SDR()
+        >>> print(f"{si_sdr(preds, target):.2f}")
+        18.40
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        zero_mean: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.zero_mean = zero_mean
+        self.add_state("sum_si_sdr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample SI-SDR values."""
+        si_sdr_batch = si_sdr(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_si_sdr = self.sum_si_sdr + jnp.sum(si_sdr_batch)
+        self.total = self.total + si_sdr_batch.size
+
+    def compute(self) -> Array:
+        """Average SI-SDR over everything seen so far."""
+        return self.sum_si_sdr / self.total

@@ -1,0 +1,51 @@
+"""SI_SNR module metric (parity: ``torchmetrics/audio/si_snr.py:22``)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.si_snr import si_snr
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array
+
+
+class SI_SNR(Metric):
+    """Scale-invariant signal-to-noise ratio, averaged over all samples.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SI_SNR
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> si_snr = SI_SNR()
+        >>> print(f"{si_snr(preds, target):.2f}")
+        15.09
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("sum_si_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample SI-SNR values."""
+        si_snr_batch = si_snr(preds=preds, target=target)
+        self.sum_si_snr = self.sum_si_snr + jnp.sum(si_snr_batch)
+        self.total = self.total + si_snr_batch.size
+
+    def compute(self) -> Array:
+        """Average SI-SNR over everything seen so far."""
+        return self.sum_si_snr / self.total

@@ -1,0 +1,56 @@
+"""SNR module metric (parity: ``torchmetrics/audio/snr.py:22``)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.snr import snr
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array
+
+
+class SNR(Metric):
+    """Signal-to-noise ratio, averaged over all samples.
+
+    Args:
+        zero_mean: if True, mean-center ``preds``/``target`` before the ratio
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SNR
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> snr = SNR()
+        >>> print(f"{snr(preds, target):.2f}")
+        16.18
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        zero_mean: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.zero_mean = zero_mean
+        self.add_state("sum_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample SNR values."""
+        snr_batch = snr(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_snr = self.sum_snr + jnp.sum(snr_batch)
+        self.total = self.total + snr_batch.size
+
+    def compute(self) -> Array:
+        """Average SNR over everything seen so far."""
+        return self.sum_snr / self.total

@@ -4,6 +4,9 @@ Every metric here is a pure ``f(preds, target, **opts)`` jnp program split
 into ``_update``/``_compute`` halves so the module metrics reuse exactly the
 same math across batches (parity: ``torchmetrics/functional/__init__.py``).
 """
+from metrics_tpu.functional.audio.si_sdr import si_sdr  # noqa: F401
+from metrics_tpu.functional.audio.si_snr import si_snr  # noqa: F401
+from metrics_tpu.functional.audio.snr import snr  # noqa: F401
 from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
 from metrics_tpu.functional.classification.auc import auc  # noqa: F401
 from metrics_tpu.functional.classification.auroc import auroc  # noqa: F401
@@ -76,6 +79,9 @@ __all__ = [
     "retrieval_recall",
     "retrieval_reciprocal_rank",
     "roc",
+    "si_sdr",
+    "si_snr",
+    "snr",
     "specificity",
     "spearman_corrcoef",
     "stat_scores",

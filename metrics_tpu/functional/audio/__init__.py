@@ -1,0 +1,3 @@
+from metrics_tpu.functional.audio.si_sdr import si_sdr  # noqa: F401
+from metrics_tpu.functional.audio.si_snr import si_snr  # noqa: F401
+from metrics_tpu.functional.audio.snr import snr  # noqa: F401

@@ -1,0 +1,51 @@
+"""Scale-invariant signal-to-distortion ratio.
+
+Capability parity with the reference's ``torchmetrics/functional/audio/
+si_sdr.py:20-63``: optimal-scaling projection of ``preds`` onto ``target``
+followed by a 10*log10 energy ratio, eps-guarded. One fused jnp program over
+the trailing (time) axis — batched leading dims ride the TPU vector units for
+free, no per-sample loop.
+"""
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import Array
+
+
+def si_sdr(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """Scale-invariant signal-to-distortion ratio (SI-SDR).
+
+    Args:
+        preds: shape ``[..., time]``
+        target: shape ``[..., time]``
+        zero_mean: if True, mean-center ``preds`` and ``target`` over time first
+
+    Returns:
+        si-sdr value of shape ``[...]``
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import si_sdr
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(f"{si_sdr(preds, target):.2f}")
+        18.40
+
+    References:
+        [1] Le Roux, Jonathan, et al. "SDR half-baked or well done." ICASSP 2019.
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target**2, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+
+    ratio = (jnp.sum(target_scaled**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(ratio)

@@ -1,0 +1,32 @@
+"""Scale-invariant signal-to-noise ratio.
+
+Capability parity with the reference's ``torchmetrics/functional/audio/
+si_snr.py``: SI-SNR is SI-SDR with mean-centered signals.
+"""
+from metrics_tpu.functional.audio.si_sdr import si_sdr
+from metrics_tpu.utilities.data import Array
+
+
+def si_snr(preds: Array, target: Array) -> Array:
+    """Scale-invariant signal-to-noise ratio (SI-SNR).
+
+    Args:
+        preds: shape ``[..., time]``
+        target: shape ``[..., time]``
+
+    Returns:
+        si-snr value of shape ``[...]``
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import si_snr
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(f"{si_snr(preds, target):.2f}")
+        15.09
+
+    References:
+        [1] Y. Luo and N. Mesgarani, "TaSNet: Time-Domain Audio Separation
+        Network for Real-Time, Single-Channel Speech Separation," ICASSP 2018.
+    """
+    return si_sdr(target=target, preds=preds, zero_mean=True)

@@ -1,0 +1,44 @@
+"""Signal-to-noise ratio.
+
+Capability parity with the reference's ``torchmetrics/functional/audio/
+snr.py:20-65``: 10*log10 of signal power over residual power, eps-guarded,
+batched over leading dims.
+"""
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import Array
+
+
+def snr(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    r"""Signal-to-noise ratio: :math:`10\log_{10}(P_{signal}/P_{noise})`.
+
+    Args:
+        preds: shape ``[..., time]``
+        target: shape ``[..., time]``
+        zero_mean: if True, mean-center ``preds`` and ``target`` over time first
+
+    Returns:
+        snr value of shape ``[...]``
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import snr
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(f"{snr(preds, target):.2f}")
+        16.18
+
+    References:
+        [1] Le Roux, Jonathan, et al. "SDR half-baked or well done." ICASSP 2019.
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    ratio = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(ratio)

@@ -1,0 +1,115 @@
+"""Audio-family parity vs a NumPy oracle (reference pattern: ``tests/audio/``,
+which uses speechmetrics/museval as oracles; here the oracle is the published
+SI-SDR/SNR formulas implemented directly in float64 NumPy)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import SI_SDR, SI_SNR, SNR
+from metrics_tpu.functional import si_sdr, si_snr, snr
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+TIME = 100
+
+_rng = np.random.RandomState(42)
+_preds = _rng.randn(NUM_BATCHES, BATCH_SIZE, TIME).astype(np.float32)
+_target = _rng.randn(NUM_BATCHES, BATCH_SIZE, TIME).astype(np.float32)
+
+
+def _np_si_sdr(preds, target, zero_mean=False):
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    eps = np.finfo(np.float32).eps
+    if zero_mean:
+        target = target - target.mean(axis=-1, keepdims=True)
+        preds = preds - preds.mean(axis=-1, keepdims=True)
+    alpha = ((preds * target).sum(-1, keepdims=True) + eps) / ((target**2).sum(-1, keepdims=True) + eps)
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    ratio = ((target_scaled**2).sum(-1) + eps) / ((noise**2).sum(-1) + eps)
+    return 10 * np.log10(ratio)
+
+
+def _np_snr(preds, target, zero_mean=False):
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    eps = np.finfo(np.float32).eps
+    if zero_mean:
+        target = target - target.mean(axis=-1, keepdims=True)
+        preds = preds - preds.mean(axis=-1, keepdims=True)
+    noise = target - preds
+    ratio = ((target**2).sum(-1) + eps) / ((noise**2).sum(-1) + eps)
+    return 10 * np.log10(ratio)
+
+
+def _avg(oracle, **opts):
+    return lambda preds, target: oracle(preds, target, **opts).mean()
+
+
+_cases = [
+    (SI_SDR, si_sdr, _np_si_sdr, {"zero_mean": False}),
+    (SI_SDR, si_sdr, _np_si_sdr, {"zero_mean": True}),
+    (SNR, snr, _np_snr, {"zero_mean": False}),
+    (SNR, snr, _np_snr, {"zero_mean": True}),
+]
+
+
+@pytest.mark.parametrize("metric_class, metric_fn, oracle, metric_args", _cases)
+class TestAudioMetrics(MetricTester):
+    atol = 1e-2  # log-domain float32 vs float64 oracle
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp, metric_class, metric_fn, oracle, metric_args):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=metric_class,
+            sk_metric=_avg(oracle, **metric_args),
+            metric_args=metric_args,
+        )
+
+    def test_functional(self, metric_class, metric_fn, oracle, metric_args):
+        self.run_functional_metric_test(
+            _preds, _target, metric_fn, partial(oracle, **metric_args), metric_args=metric_args
+        )
+
+    def test_differentiability(self, metric_class, metric_fn, oracle, metric_args):
+        self.run_differentiability_test(_preds, _target, metric_class(**metric_args), metric_fn, metric_args)
+
+    def test_bf16(self, metric_class, metric_fn, oracle, metric_args):
+        self.run_precision_test(_preds, _target, metric_fn, metric_args)
+
+
+class TestSISNR(MetricTester):
+    atol = 1e-2
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=SI_SNR,
+            sk_metric=_avg(_np_si_sdr, zero_mean=True),
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(_preds, _target, si_snr, partial(_np_si_sdr, zero_mean=True))
+
+
+def test_si_sdr_known_value():
+    target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+    preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+    np.testing.assert_allclose(np.asarray(si_sdr(preds, target)), 18.4030, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(si_snr(preds, target)), 15.0918, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(snr(preds, target)), 16.1805, atol=1e-3)
+
+
+def test_audio_shape_mismatch_raises():
+    with pytest.raises(RuntimeError):
+        si_sdr(jnp.zeros((4, 10)), jnp.zeros((4, 11)))
+    with pytest.raises(RuntimeError):
+        snr(jnp.zeros((4, 10)), jnp.zeros((4, 11)))
