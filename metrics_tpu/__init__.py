@@ -45,6 +45,7 @@ from metrics_tpu.classification import (  # noqa: F401 E402
     StatScores,
 )
 from metrics_tpu.collections import MetricCollection  # noqa: F401 E402
+from metrics_tpu.image import PSNR, SSIM  # noqa: F401 E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401 E402
 from metrics_tpu.regression import (  # noqa: F401 E402
     CosineSimilarity,
@@ -99,6 +100,7 @@ __all__ = [
     "PearsonCorrcoef",
     "Precision",
     "PrecisionRecallCurve",
+    "PSNR",
     "R2Score",
     "ROC",
     "Recall",
@@ -112,6 +114,7 @@ __all__ = [
     "SI_SDR",
     "SI_SNR",
     "SNR",
+    "SSIM",
     "Specificity",
     "SpearmanCorrcoef",
     "StatScores",

@@ -25,6 +25,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import precisi
 from metrics_tpu.functional.classification.roc import roc  # noqa: F401
 from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
+from metrics_tpu.functional.image_gradients import image_gradients  # noqa: F401
 from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity  # noqa: F401
 from metrics_tpu.functional.regression.explained_variance import explained_variance  # noqa: F401
 from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error  # noqa: F401
@@ -35,8 +36,10 @@ from metrics_tpu.functional.regression.mean_absolute_percentage_error import (  
 from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error  # noqa: F401
 from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error  # noqa: F401
 from metrics_tpu.functional.regression.pearson import pearson_corrcoef  # noqa: F401
+from metrics_tpu.functional.regression.psnr import psnr  # noqa: F401
 from metrics_tpu.functional.regression.r2score import r2score  # noqa: F401
 from metrics_tpu.functional.regression.spearman import spearman_corrcoef  # noqa: F401
+from metrics_tpu.functional.regression.ssim import ssim  # noqa: F401
 from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision  # noqa: F401
 from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out  # noqa: F401
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg  # noqa: F401
@@ -58,6 +61,7 @@ __all__ = [
     "fbeta",
     "hamming_distance",
     "hinge",
+    "image_gradients",
     "iou",
     "kldivergence",
     "matthews_corrcoef",
@@ -70,6 +74,7 @@ __all__ = [
     "precision",
     "precision_recall",
     "precision_recall_curve",
+    "psnr",
     "r2score",
     "recall",
     "retrieval_average_precision",
@@ -84,5 +89,6 @@ __all__ = [
     "snr",
     "specificity",
     "spearman_corrcoef",
+    "ssim",
     "stat_scores",
 ]

@@ -1,0 +1,56 @@
+"""Image gradients via 1-step finite differences.
+
+Capability parity with the reference's ``torchmetrics/functional/
+image_gradients.py:200-253``: dy/dx with the last row/column zero-padded,
+matching the TF convention (gradient of ``I(x+1,y)-I(x,y)`` stored at
+``(x, y)``).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.data import Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    if not isinstance(img, (jax.Array, np.ndarray)):
+        raise TypeError(f"The `img` expects a value of <jax.Array> type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Finite-difference gradients of a batch of images.
+
+    Args:
+        img: an ``(N, C, H, W)`` image tensor
+
+    Returns:
+        tuple ``(dy, dx)``, each of shape ``(N, C, H, W)``
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import image_gradients
+        >>> image = jnp.arange(0, 1*1*5*5, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, :, :]
+        Array([[5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [0., 0., 0., 0., 0.]], dtype=float32)
+    """
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -1,0 +1,91 @@
+"""Peak signal-to-noise ratio.
+
+Capability parity with the reference's ``torchmetrics/functional/regression/
+psnr.py``: squared-error/count partial sums (optionally over a ``dim``
+subset) and a log-domain compute, all static-shape jnp so the update fuses
+into the surrounding step program.
+"""
+import math
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import Array
+from metrics_tpu.utilities.distributed import reduce
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    n_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / n_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(jnp.asarray(base)))
+    return reduce(psnr_vals, reduction=reduction)
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    if dim is None:
+        diff = preds - target
+        sum_squared_error = jnp.sum(diff * diff)
+        n_obs = jnp.asarray(target.size)
+        return sum_squared_error, n_obs
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    if not dim_list:
+        n_obs = jnp.asarray(target.size)
+    else:
+        n_obs = math.prod(target.shape[d] for d in dim_list)
+        n_obs = jnp.broadcast_to(jnp.asarray(n_obs), sum_squared_error.shape)
+
+    return sum_squared_error, n_obs
+
+
+def psnr(
+    preds: Array,
+    target: Array,
+    data_range: Optional[float] = None,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """Peak signal-to-noise ratio.
+
+    Args:
+        preds: estimated signal
+        target: ground-truth signal
+        data_range: the range of the data; if None it is determined from the
+            data (max - min). Must be given when ``dim`` is not None.
+        base: logarithm base
+        reduction: ``'elementwise_mean'`` | ``'sum'`` | ``'none'``
+        dim: dimension(s) to reduce PSNR scores over; None reduces over all
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import psnr
+        >>> pred = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> print(f"{psnr(pred, target):.2f}")
+        2.55
+    """
+    if dim is None and reduction != "elementwise_mean":
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = target.max() - target.min()
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, n_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, n_obs, data_range, base=base, reduction=reduction)

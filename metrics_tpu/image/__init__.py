@@ -1,0 +1,2 @@
+from metrics_tpu.image.psnr import PSNR  # noqa: F401
+from metrics_tpu.image.ssim import SSIM  # noqa: F401

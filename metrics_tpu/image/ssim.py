@@ -1,0 +1,84 @@
+"""SSIM module metric (parity: ``torchmetrics/image/ssim.py:25``)."""
+from typing import Any, Callable, Optional, Sequence
+
+from metrics_tpu.functional.regression.ssim import _ssim_compute, _ssim_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+class SSIM(Metric):
+    """Structural similarity index measure.
+
+    Like the reference, buffers all predictions/targets (``cat`` states) so
+    epoch-end compute can determine a global ``data_range`` — pass an explicit
+    ``data_range`` and ``reduction='elementwise_mean'`` if memory is a concern.
+
+    Args:
+        kernel_size: size of the gaussian window
+        sigma: standard deviation of the gaussian window
+        reduction: ``'elementwise_mean'`` | ``'sum'`` | ``'none'``
+        data_range: range of the image; if None determined from the data
+        k1: SSIM stability constant (luminance)
+        k2: SSIM stability constant (contrast)
+
+    Example:
+        >>> import jax
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SSIM
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (16, 1, 16, 16))
+        >>> target = preds * 0.75
+        >>> ssim = SSIM()
+        >>> print(f"{ssim(preds, target):.3f}")
+        0.922
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: str = "elementwise_mean",
+        data_range: Optional[float] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        rank_zero_warn(
+            "Metric `SSIM` will save all targets and"
+            " predictions in buffer. For large datasets this may lead"
+            " to large memory footprint."
+        )
+        self.add_state("y", default=[], dist_reduce_fx="cat")
+        self.add_state("y_pred", default=[], dist_reduce_fx="cat")
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.reduction = reduction
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Buffer this batch's predictions and targets."""
+        preds, target = _ssim_update(preds, target)
+        self.y_pred.append(preds)
+        self.y.append(target)
+
+    def compute(self) -> Array:
+        """SSIM over all buffered images."""
+        preds = dim_zero_cat(self.y_pred)
+        target = dim_zero_cat(self.y)
+        return _ssim_compute(
+            preds, target, self.kernel_size, self.sigma, self.reduction, self.data_range, self.k1, self.k2
+        )

@@ -1,0 +1,39 @@
+"""Deprecated location shim (parity: ``torchmetrics/regression/psnr.py:20``) —
+``PSNR`` moved to :mod:`metrics_tpu.image.psnr`."""
+from typing import Any, Callable, Optional, Tuple, Union
+from warnings import warn
+
+from metrics_tpu.image.psnr import PSNR as _PSNR
+
+
+class PSNR(_PSNR):
+    """.. deprecated::
+        ``PSNR`` was moved to ``metrics_tpu.image.psnr``.
+    """
+
+    def __init__(
+        self,
+        data_range: Optional[float] = None,
+        base: float = 10.0,
+        reduction: str = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        warn(
+            "This `PSNR` was moved to `metrics_tpu.image.psnr` and this shell will be removed"
+            " in a future release. Use `metrics_tpu.image.psnr.PSNR` instead.",
+            DeprecationWarning,
+        )
+        super().__init__(
+            data_range=data_range,
+            base=base,
+            reduction=reduction,
+            dim=dim,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )

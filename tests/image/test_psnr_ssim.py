@@ -1,0 +1,192 @@
+"""PSNR/SSIM/image-gradients parity vs NumPy/scipy oracles (reference pattern:
+``tests/regression/test_psnr.py`` uses a numpy psnr, ``test_ssim.py`` uses
+skimage — unavailable here, so the SSIM oracle is an independent
+scipy.ndimage implementation of the published formula)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.ndimage import correlate
+
+from metrics_tpu import PSNR, SSIM
+from metrics_tpu.functional import image_gradients, psnr, ssim
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+BATCH = 8
+H = W = 24
+
+_rng = np.random.RandomState(7)
+_psnr_preds = _rng.rand(NUM_BATCHES, BATCH, 8, 8).astype(np.float32) * 3
+_psnr_target = _rng.rand(NUM_BATCHES, BATCH, 8, 8).astype(np.float32) * 3
+_ssim_preds = _rng.rand(NUM_BATCHES, BATCH, 3, H, W).astype(np.float32)
+_ssim_target = (_ssim_preds * 0.8 + 0.1 * _rng.rand(NUM_BATCHES, BATCH, 3, H, W)).astype(np.float32)
+
+
+def _np_psnr(preds, target, data_range=None, base=10.0, reduction="elementwise_mean", dim=None):
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    if data_range is None:
+        data_range = target.max() - target.min()
+    if dim is None:
+        mse = np.mean((preds - target) ** 2)
+    else:
+        mse = ((preds - target) ** 2).mean(axis=dim)
+    value = (2 * np.log(data_range) - np.log(mse)) * 10 / np.log(base)
+    if dim is None or reduction == "elementwise_mean":
+        return np.mean(value)
+    if reduction == "sum":
+        return np.sum(value)
+    return value
+
+
+def _np_psnr_running_range(preds, target, **kw):
+    # the module's auto data_range lets the initial 0.0 state participate
+    data_range = max(target.max(), 0.0) - min(target.min(), 0.0)
+    return _np_psnr(preds, target, data_range=data_range, **kw)
+
+
+def _gauss_window(kernel_size, sigma):
+    dist = np.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2)
+    g = np.exp(-((dist / sigma) ** 2) / 2)
+    g /= g.sum()
+    return g
+
+
+def _np_ssim(
+    preds, target, kernel_size=(11, 11), sigma=(1.5, 1.5), data_range=None, k1=0.01, k2=0.03
+):
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    if data_range is None:
+        data_range = max(preds.max() - preds.min(), target.max() - target.min())
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    kernel = np.outer(_gauss_window(kernel_size[0], sigma[0]), _gauss_window(kernel_size[1], sigma[1]))
+
+    def win_mean(x):  # (B, C, H, W) gaussian-window mean, mirror-padded
+        return np.stack(
+            [
+                np.stack([correlate(img, kernel, mode="mirror") for img in chan_imgs])
+                for chan_imgs in x
+            ]
+        )
+
+    mu_p, mu_t = win_mean(preds), win_mean(target)
+    sigma_p = win_mean(preds * preds) - mu_p**2
+    sigma_t = win_mean(target * target) - mu_t**2
+    sigma_pt = win_mean(preds * target) - mu_p * mu_t
+    ssim_map = ((2 * mu_p * mu_t + c1) * (2 * sigma_pt + c2)) / (
+        (mu_p**2 + mu_t**2 + c1) * (sigma_p + sigma_t + c2)
+    )
+    pad_h = (kernel_size[1] - 1) // 2
+    pad_w = (kernel_size[0] - 1) // 2
+    return ssim_map[..., pad_h : ssim_map.shape[-2] - pad_h, pad_w : ssim_map.shape[-1] - pad_w].mean()
+
+
+_psnr_cases = [
+    ({}, _np_psnr_running_range),
+    ({"data_range": 3.0}, partial(_np_psnr, data_range=3.0)),
+    ({"base": 2.0}, partial(_np_psnr_running_range, base=2.0)),
+    ({"data_range": 3.0, "dim": (1, 2), "reduction": "elementwise_mean"},
+     partial(_np_psnr, data_range=3.0, dim=(1, 2))),
+    ({"data_range": 3.0, "dim": (1, 2), "reduction": "sum"},
+     partial(_np_psnr, data_range=3.0, dim=(1, 2), reduction="sum")),
+]
+
+
+@pytest.mark.parametrize("metric_args, oracle", _psnr_cases)
+class TestPSNR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp, metric_args, oracle):
+        # auto data_range depends on all data seen: skip per-batch value checks
+        check_batch = "data_range" in metric_args
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_psnr_preds,
+            target=_psnr_target,
+            metric_class=PSNR,
+            sk_metric=oracle,
+            metric_args=metric_args,
+            check_batch=check_batch,
+        )
+
+    def test_functional(self, metric_args, oracle):
+        if "dim" not in metric_args and "data_range" not in metric_args:
+            # the functional derives data_range per call (no running state)
+            oracle = partial(_np_psnr, **metric_args)
+        self.run_functional_metric_test(_psnr_preds, _psnr_target, psnr, oracle, metric_args=metric_args)
+
+
+def test_psnr_dim_requires_data_range():
+    with pytest.raises(ValueError):
+        PSNR(dim=0)
+    with pytest.raises(ValueError):
+        psnr(jnp.zeros((2, 2)), jnp.zeros((2, 2)), dim=0)
+
+
+@pytest.mark.parametrize(
+    "metric_args",
+    [
+        {},
+        {"data_range": 1.0},
+        {"kernel_size": (7, 7), "sigma": (1.0, 1.0)},
+        {"k1": 0.02, "k2": 0.05},
+    ],
+)
+class TestSSIM(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp, metric_args):
+        # auto data_range depends on all data: final compute only
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_ssim_preds,
+            target=_ssim_target,
+            metric_class=SSIM,
+            sk_metric=partial(_np_ssim, **metric_args),
+            metric_args=metric_args,
+            check_batch="data_range" in metric_args,
+        )
+
+    def test_functional(self, metric_args):
+        self.run_functional_metric_test(
+            _ssim_preds, _ssim_target, ssim, partial(_np_ssim, **metric_args), metric_args=metric_args
+        )
+
+
+def test_ssim_invalid_inputs():
+    with pytest.raises(TypeError):
+        ssim(jnp.zeros((1, 1, 16, 16), dtype=jnp.float32), jnp.zeros((1, 1, 16, 16), dtype=jnp.float64))
+    with pytest.raises(ValueError):
+        ssim(jnp.zeros((1, 16, 16)), jnp.zeros((1, 16, 16)))
+    with pytest.raises(ValueError):
+        ssim(jnp.zeros((1, 1, 16, 16)), jnp.zeros((1, 1, 16, 16)), kernel_size=(10, 10))
+    with pytest.raises(ValueError):
+        ssim(jnp.zeros((1, 1, 16, 16)), jnp.zeros((1, 1, 16, 16)), sigma=(-1.5, 1.5))
+
+
+def test_ssim_identical_images_is_one():
+    img = jnp.asarray(_rng.rand(4, 3, 32, 32).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(ssim(img, img, data_range=1.0)), 1.0, atol=1e-4)
+
+
+def test_image_gradients_known_values():
+    image = jnp.arange(25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+    dy, dx = image_gradients(image)
+    expected_dy = np.zeros((5, 5), dtype=np.float32)
+    expected_dy[:4] = 5.0
+    expected_dx = np.zeros((5, 5), dtype=np.float32)
+    expected_dx[:, :4] = 1.0
+    np.testing.assert_allclose(np.asarray(dy[0, 0]), expected_dy)
+    np.testing.assert_allclose(np.asarray(dx[0, 0]), expected_dx)
+
+
+def test_image_gradients_invalid():
+    with pytest.raises(TypeError):
+        image_gradients([[1.0, 2.0]])
+    with pytest.raises(RuntimeError):
+        image_gradients(jnp.zeros((5, 5)))
