@@ -26,6 +26,7 @@ from metrics_tpu.functional.classification.roc import roc  # noqa: F401
 from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
 from metrics_tpu.functional.image_gradients import image_gradients  # noqa: F401
+from metrics_tpu.functional.nlp import bleu_score  # noqa: F401
 from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity  # noqa: F401
 from metrics_tpu.functional.regression.explained_variance import explained_variance  # noqa: F401
 from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error  # noqa: F401
@@ -40,6 +41,7 @@ from metrics_tpu.functional.regression.psnr import psnr  # noqa: F401
 from metrics_tpu.functional.regression.r2score import r2score  # noqa: F401
 from metrics_tpu.functional.regression.spearman import spearman_corrcoef  # noqa: F401
 from metrics_tpu.functional.regression.ssim import ssim  # noqa: F401
+from metrics_tpu.functional.self_supervised import embedding_similarity  # noqa: F401
 from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision  # noqa: F401
 from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out  # noqa: F401
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg  # noqa: F401
@@ -52,10 +54,12 @@ __all__ = [
     "auc",
     "auroc",
     "average_precision",
+    "bleu_score",
     "cohen_kappa",
     "confusion_matrix",
     "cosine_similarity",
     "dice_score",
+    "embedding_similarity",
     "explained_variance",
     "f1",
     "fbeta",

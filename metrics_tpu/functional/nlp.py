@@ -1,0 +1,87 @@
+"""BLEU score for machine-translated text.
+
+Capability parity with the reference's ``torchmetrics/functional/nlp.py:48-114``.
+Tokenized strings are host data, not device data, so the n-gram counting is
+deliberately host-side Python (exactly as in the reference); only the final
+precision-vector math is a jnp program.
+"""
+from collections import Counter
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import Array
+
+
+def _count_ngram(ngram_input_list: List[str], n_gram: int) -> Counter:
+    """Count every 1..n_gram n-gram occurring in a token list."""
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_key = tuple(ngram_input_list[j : (i + j)])
+            ngram_counter[ngram_key] += 1
+    return ngram_counter
+
+
+def bleu_score(
+    translate_corpus: Sequence[str],
+    reference_corpus: Sequence[str],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """BLEU score of machine-translated text against one or more references.
+
+    Args:
+        translate_corpus: an iterable of tokenized machine-translated sentences
+        reference_corpus: an iterable of iterables of tokenized reference sentences
+        n_gram: maximum n-gram order (1 to 4)
+        smooth: apply Lin et al. 2004 smoothing
+
+    Example:
+        >>> from metrics_tpu.functional import bleu_score
+        >>> translate_corpus = ['the cat is on the mat'.split()]
+        >>> reference_corpus = [['there is a cat on the mat'.split(), 'a cat is on the mat'.split()]]
+        >>> print(f"{bleu_score(translate_corpus, reference_corpus):.4f}")
+        0.7598
+    """
+    if len(translate_corpus) != len(reference_corpus):
+        raise ValueError(f"Corpus has different size {len(translate_corpus)} != {len(reference_corpus)}")
+
+    numerator = [0.0] * n_gram
+    denominator = [0.0] * n_gram
+    c = 0.0  # candidate length
+    r = 0.0  # effective reference length (closest-length match)
+
+    for translation, references in zip(translate_corpus, reference_corpus):
+        c += len(translation)
+        ref_len_list = [len(ref) for ref in references]
+        ref_len_diff = [abs(len(translation) - x) for x in ref_len_list]
+        r += ref_len_list[ref_len_diff.index(min(ref_len_diff))]
+
+        translation_counter = _count_ngram(list(translation), n_gram)
+        reference_counter: Counter = Counter()
+        for ref in references:
+            reference_counter |= _count_ngram(list(ref), n_gram)
+
+        ngram_counter_clip = translation_counter & reference_counter
+        for counter_clip in ngram_counter_clip:
+            numerator[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+        for counter in translation_counter:
+            denominator[len(counter) - 1] += translation_counter[counter]
+
+    numerator_arr = jnp.asarray(numerator)
+    denominator_arr = jnp.asarray(denominator)
+
+    if min(numerator) == 0.0:
+        return jnp.asarray(0.0)
+
+    if smooth:
+        precision_scores = (numerator_arr + 1.0) / (denominator_arr + 1.0)
+        precision_scores = precision_scores.at[0].set(numerator_arr[0] / denominator_arr[0])
+    else:
+        precision_scores = numerator_arr / denominator_arr
+
+    log_precision_scores = (1.0 / n_gram) * jnp.log(precision_scores)
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    brevity_penalty = jnp.asarray(1.0) if c > r else jnp.exp(1 - jnp.asarray(r) / jnp.asarray(c))
+    return brevity_penalty * geometric_mean

@@ -10,6 +10,7 @@ from typing import Optional, Tuple, Union
 
 import jax.numpy as jnp
 
+from metrics_tpu.utilities.checks import _check_same_shape
 from metrics_tpu.utilities.data import Array
 from metrics_tpu.utilities.distributed import reduce
 from metrics_tpu.utilities.prints import rank_zero_warn
@@ -32,6 +33,7 @@ def _psnr_update(
     target: Array,
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
     if dim is None:
         diff = preds - target
         sum_squared_error = jnp.sum(diff * diff)

@@ -1,0 +1,56 @@
+"""Pairwise embedding similarity.
+
+Capability parity with the reference's ``torchmetrics/functional/
+self_supervised.py:132-171``: one ``(B, D) @ (D, B)`` matmul — exactly the
+shape the MXU wants — with optional cosine normalization, zeroed diagonal,
+and row reduction.
+"""
+import jax.lax as lax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import Array
+
+
+def embedding_similarity(
+    batch: Array,
+    similarity: str = "cosine",
+    reduction: str = "none",
+    zero_diagonal: bool = True,
+) -> Array:
+    """Similarity matrix between every pair of row embeddings.
+
+    Args:
+        batch: embeddings of shape ``(batch, dim)``
+        similarity: ``'dot'`` or ``'cosine'``
+        reduction: ``'none'`` | ``'sum'`` | ``'mean'`` (along the last dim)
+        zero_diagonal: if True, self-similarities are set to zero
+
+    Returns:
+        a ``(batch, batch)`` matrix (or ``(batch,)`` after reduction)
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import embedding_similarity
+        >>> embeddings = jnp.asarray([[1., 2., 3., 4.], [1., 2., 3., 4.], [4., 5., 6., 7.]])
+        >>> jnp.round(embedding_similarity(embeddings), 4)
+        Array([[0.    , 1.    , 0.9759],
+               [1.    , 0.    , 0.9759],
+               [0.9759, 0.9759, 0.    ]], dtype=float32)
+    """
+    if similarity == "cosine":
+        norm = jnp.linalg.norm(batch, ord=2, axis=1)
+        batch = batch / norm[:, None]
+
+    # metrics need full fp32 accumulation — the TPU default (bf16 matmul)
+    # would report ~0.999 for identical embeddings
+    sqr_mtx = jnp.matmul(batch, batch.T, precision=lax.Precision.HIGHEST)
+
+    if zero_diagonal:
+        sqr_mtx = jnp.fill_diagonal(sqr_mtx, 0, inplace=False)
+
+    if reduction == "mean":
+        sqr_mtx = sqr_mtx.mean(axis=-1)
+    if reduction == "sum":
+        sqr_mtx = sqr_mtx.sum(axis=-1)
+
+    return sqr_mtx

@@ -64,7 +64,9 @@ class PSNR(Metric):
 
         if dim is None:
             self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+            # float accumulator: int32 would wrap past 2**31 elements and only
+            # the ratio sum/total is consumed, where ~1e-7 relative error is harmless
+            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         else:
             self.add_state("sum_squared_error", default=[])
             self.add_state("total", default=[])

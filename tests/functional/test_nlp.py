@@ -1,0 +1,63 @@
+"""BLEU parity vs the NLTK oracle (reference pattern:
+``tests/functional/test_nlp.py``, which compares against
+``nltk.translate.bleu_score.corpus_bleu``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from nltk.translate.bleu_score import SmoothingFunction, corpus_bleu
+
+from metrics_tpu.functional import bleu_score
+
+# example from the NLTK docs / reference tests
+HYP1 = "It is a guide to action which ensures that the military always obeys the commands of the party".split()
+HYP2 = "he read the book because he was interested in world history".split()
+
+REF1A = "It is a guide to action that ensures that the military will forever heed Party commands".split()
+REF1B = "It is a guiding principle which makes the military forces always being under the command of the Party".split()
+REF1C = "It is the practical guide for the army always to heed the directions of the party".split()
+REF2A = "he was interested in world history because he read the book".split()
+
+TUPLE_OF_REFERENCES = ([REF1A, REF1B, REF1C], [REF2A])
+HYPOTHESES = (HYP1, HYP2)
+
+smooth_func = SmoothingFunction().method2
+
+
+@pytest.mark.parametrize(
+    "weights, n_gram, smooth",
+    [
+        ((1.0,), 1, False),
+        ((0.5, 0.5), 2, False),
+        ((1 / 3, 1 / 3, 1 / 3), 3, False),
+        ((0.25, 0.25, 0.25, 0.25), 4, False),
+        ((1.0,), 1, True),
+        ((0.5, 0.5), 2, True),
+        ((1 / 3, 1 / 3, 1 / 3), 3, True),
+        ((0.25, 0.25, 0.25, 0.25), 4, True),
+    ],
+)
+def test_bleu_vs_nltk(weights, n_gram, smooth):
+    nltk_kwargs = {"smoothing_function": smooth_func} if smooth else {}
+    nltk_output = corpus_bleu(TUPLE_OF_REFERENCES, HYPOTHESES, weights=weights, **nltk_kwargs)
+    tm_output = bleu_score(HYPOTHESES, TUPLE_OF_REFERENCES, n_gram=n_gram, smooth=smooth)
+    np.testing.assert_allclose(np.asarray(tm_output), nltk_output, atol=1e-4)
+
+
+def test_bleu_known_value():
+    translate_corpus = ["the cat is on the mat".split()]
+    reference_corpus = [["there is a cat on the mat".split(), "a cat is on the mat".split()]]
+    np.testing.assert_allclose(np.asarray(bleu_score(translate_corpus, reference_corpus)), 0.7598, atol=1e-4)
+
+
+def test_bleu_no_match_is_zero():
+    assert float(bleu_score(["a b c".split()], [["d e f".split()]])) == 0.0
+
+
+def test_bleu_size_mismatch_raises():
+    with pytest.raises(ValueError):
+        bleu_score(["a b".split()], [["a b".split()], ["c d".split()]])
+
+
+def test_bleu_empty_translation():
+    # empty candidate: zero n-gram matches -> 0.0 (reference behavior)
+    assert float(bleu_score([[]], [["a b".split()]])) == 0.0

@@ -1,0 +1,29 @@
+"""embedding_similarity parity vs a sklearn/numpy oracle (reference pattern:
+``tests/functional/test_self_supervised.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import cosine_similarity as sk_cosine, linear_kernel
+
+from metrics_tpu.functional import embedding_similarity
+
+
+@pytest.mark.parametrize("similarity", ["cosine", "dot"])
+@pytest.mark.parametrize("reduction", ["none", "mean", "sum"])
+@pytest.mark.parametrize("zero_diagonal", [True, False])
+def test_embedding_similarity(similarity, reduction, zero_diagonal):
+    rng = np.random.RandomState(3)
+    batch = rng.randn(12, 16).astype(np.float32)
+
+    expected = sk_cosine(batch) if similarity == "cosine" else linear_kernel(batch)
+    if zero_diagonal:
+        np.fill_diagonal(expected, 0)
+    if reduction == "mean":
+        expected = expected.mean(axis=-1)
+    elif reduction == "sum":
+        expected = expected.sum(axis=-1)
+
+    result = embedding_similarity(
+        jnp.asarray(batch), similarity=similarity, reduction=reduction, zero_diagonal=zero_diagonal
+    )
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-4)
