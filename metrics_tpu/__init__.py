@@ -45,7 +45,7 @@ from metrics_tpu.classification import (  # noqa: F401 E402
     StatScores,
 )
 from metrics_tpu.collections import MetricCollection  # noqa: F401 E402
-from metrics_tpu.image import PSNR, SSIM  # noqa: F401 E402
+from metrics_tpu.image import FID, IS, KID, PSNR, SSIM  # noqa: F401 E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401 E402
 from metrics_tpu.regression import (  # noqa: F401 E402
     CosineSimilarity,
@@ -86,9 +86,12 @@ __all__ = [
     "ExplainedVariance",
     "F1",
     "FBeta",
+    "FID",
     "HammingDistance",
     "Hinge",
     "IoU",
+    "IS",
+    "KID",
     "KLDivergence",
     "MatthewsCorrcoef",
     "MeanAbsoluteError",

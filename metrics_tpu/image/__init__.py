@@ -1,2 +1,5 @@
+from metrics_tpu.image.fid import FID  # noqa: F401
+from metrics_tpu.image.inception import IS  # noqa: F401
+from metrics_tpu.image.kid import KID  # noqa: F401
 from metrics_tpu.image.psnr import PSNR  # noqa: F401
 from metrics_tpu.image.ssim import SSIM  # noqa: F401

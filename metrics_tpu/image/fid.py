@@ -1,0 +1,179 @@
+"""Fréchet Inception Distance (parity: ``torchmetrics/image/fid.py:126-282``).
+
+TPU-native design notes:
+
+* The reference computes the matrix square root by detaching to CPU NumPy and
+  calling ``scipy.linalg.sqrtm`` (``fid.py:55-93``) — a device→host→device
+  round trip on every compute. Here the whole FID formula stays on device:
+  ``Tr((Σ₁Σ₂)^{1/2})`` is evaluated through the symmetric form
+  ``Tr((Σ₁^{1/2} Σ₂ Σ₁^{1/2})^{1/2})`` with PSD square roots from ``eigh``
+  (differentiable, jit-able), or optionally via Newton–Schulz iteration —
+  both pure XLA programs.
+* The reference casts features to float64 (``fid.py:265-270``). JAX runs f32
+  by default; this module computes in float64 when ``jax_enable_x64`` is on
+  and otherwise uses a stabilized f32 path (mean-centering before the
+  covariance product and symmetrization before eigh).
+"""
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+def sqrtm_psd(mat: Array) -> Array:
+    """Square root of a positive semi-definite matrix via eigendecomposition.
+
+    Negative eigenvalues (numerical noise) are clamped to zero. Differentiable
+    and jit-able; runs on TPU — the on-device replacement for the reference's
+    ``MatrixSquareRoot`` scipy round-trip (``torchmetrics/image/fid.py:55-93``).
+    """
+    mat = (mat + mat.T) / 2.0
+    eigvals, eigvecs = jnp.linalg.eigh(mat)
+    eigvals = jnp.clip(eigvals, 0.0, None)
+    return (eigvecs * jnp.sqrt(eigvals)) @ eigvecs.T
+
+
+def sqrtm_newton_schulz(mat: Array, num_iters: int = 50) -> Array:
+    """Matrix square root by coupled Newton–Schulz iteration.
+
+    Matmul-only (MXU-friendly) alternative to :func:`sqrtm_psd` for the FID
+    trace term; converges quadratically for matrices scaled inside the unit
+    ball. Fully differentiable through ``lax.scan``.
+    """
+    dim = mat.shape[0]
+    norm = jnp.sqrt(jnp.sum(mat * mat))
+    y0 = mat / norm
+    eye = jnp.eye(dim, dtype=mat.dtype)
+
+    def step(carry, _):
+        y, z = carry
+        t = 0.5 * (3.0 * eye - z @ y)
+        return (y @ t, t @ z), None
+
+    (y, _), _ = jax.lax.scan(step, (y0, eye), None, length=num_iters)
+    return y * jnp.sqrt(norm)
+
+
+def _trace_sqrt_product(sigma1: Array, sigma2: Array, method: str = "eigh") -> Array:
+    """``Tr((Σ₁ Σ₂)^{1/2})`` — PSD-symmetrized eigh form, or Newton–Schulz."""
+    if method == "ns":
+        return jnp.trace(sqrtm_newton_schulz(sigma1 @ sigma2))
+    s1_half = sqrtm_psd(sigma1)
+    inner = s1_half @ sigma2 @ s1_half
+    inner = (inner + inner.T) / 2.0
+    eigvals = jnp.clip(jnp.linalg.eigvalsh(inner), 0.0, None)
+    return jnp.sum(jnp.sqrt(eigvals))
+
+
+def _compute_fid(
+    mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: float = 1e-6, method: str = "eigh"
+) -> Array:
+    """``‖μ₁-μ₂‖² + Tr(Σ₁ + Σ₂ - 2(Σ₁Σ₂)^{1/2})`` (ref ``fid.py:96-123``).
+
+    Trace-safe: the singular-product jitter retry (ref ``fid.py:115-120``) is a
+    ``lax.cond``, so the whole formula works under ``jit`` and only runs the
+    jittered recomputation when the plain product was non-finite.
+    """
+    diff = mu1 - mu2
+    base = diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2)
+
+    def _with_jitter() -> Array:
+        offset = jnp.eye(sigma1.shape[0], dtype=sigma1.dtype) * eps
+        return _trace_sqrt_product(sigma1 + offset, sigma2 + offset, method)
+
+    tr_covmean = _trace_sqrt_product(sigma1, sigma2, method)
+    tr_covmean = jax.lax.cond(jnp.isfinite(tr_covmean), lambda: tr_covmean, _with_jitter)
+    return base - 2.0 * tr_covmean
+
+
+def _mean_cov(features: Array) -> Tuple[Array, Array]:
+    """Sample mean and unbiased covariance of an ``(N, d)`` feature matrix."""
+    n = features.shape[0]
+    mean = features.mean(axis=0)
+    diff = features - mean
+    cov = (diff.T @ diff) / (n - 1)
+    return mean, cov
+
+
+class FID(Metric):
+    """Fréchet inception distance between the real and generated feature distributions.
+
+    Args:
+        feature: an int/str InceptionV3 tap (``64 | 192 | 768 | 2048 |
+            'logits_unbiased'`` — needs pretrained weights, see
+            :mod:`metrics_tpu.image.inception_net`) or any callable mapping
+            ``(N, 3, H, W)`` images to ``(N, d)`` features.
+        sqrtm_method: ``'eigh'`` (default, robust) or ``'ns'`` — matmul-only
+            Newton–Schulz for the trace term, faster on the MXU for large
+            feature dims at slightly looser accuracy.
+        compute_on_step: defaults to ``False`` (like the reference,
+            ``fid.py:211`` — a per-batch FID is not meaningful).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.image.fid import FID
+        >>> feats = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8]
+        >>> fid = FID(feature=feats)
+        >>> imgs = jnp.linspace(0, 1, 4 * 3 * 4 * 4).reshape(4, 3, 4, 4)
+        >>> fid.update(imgs, real=True)
+        >>> fid.update(imgs * 0.9, real=False)
+        >>> bool(fid.compute() >= 0)
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+
+    def __init__(
+        self,
+        feature: Union[int, str, Callable] = 2048,
+        sqrtm_method: str = "eigh",
+        compute_on_step: bool = False,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable[[Array], List[Array]]] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        rank_zero_warn(
+            "Metric `FID` will save all extracted features in buffer."
+            " For large datasets this may lead to large memory footprint.",
+            UserWarning,
+        )
+        from metrics_tpu.image.inception_net import resolve_feature_extractor
+
+        self.inception = resolve_feature_extractor(feature)
+        if sqrtm_method not in ("eigh", "ns"):
+            raise ValueError("Argument `sqrtm_method` expected to be 'eigh' or 'ns'")
+        self.sqrtm_method = sqrtm_method
+
+        self.add_state("real_features", [], dist_reduce_fx=None)
+        self.add_state("fake_features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract features for ``imgs`` and buffer them under the ``real`` flag."""
+        features = self.inception(imgs)
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Array:
+        """FID over all buffered real/fake features."""
+        real_features = dim_zero_cat(self.real_features)
+        fake_features = dim_zero_cat(self.fake_features)
+        orig_dtype = real_features.dtype
+        # float64 when x64 is enabled (the reference always uses double,
+        # fid.py:267-270); otherwise the f32 path relies on centering + eigh
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        mean1, cov1 = _mean_cov(real_features.astype(dtype))
+        mean2, cov2 = _mean_cov(fake_features.astype(dtype))
+        return _compute_fid(mean1, cov1, mean2, cov2, method=self.sqrtm_method).astype(orig_dtype)

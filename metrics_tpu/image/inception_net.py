@@ -1,0 +1,361 @@
+"""Flax InceptionV3 feature extractor for the generative image metrics.
+
+TPU-native replacement for the reference's ``NoTrainInceptionV3``
+(``torchmetrics/image/fid.py:34-52``), which wraps
+``torch_fidelity.FeatureExtractorInceptionV3``. Here the network is a Flax
+module compiled by XLA, so feature extraction runs on the TPU chip as part of
+the metric's jitted update instead of through an external torch package.
+
+The topology is the standard Inception-V3 (Szegedy et al. 2015) as used for
+FID scoring, with the same feature taps the reference exposes:
+
+* ``64``   — stem features after the first max-pool, globally average-pooled
+* ``192``  — stem features after the second max-pool, globally average-pooled
+* ``768``  — ``Mixed_6e`` output, globally average-pooled
+* ``2048`` — ``Mixed_7c`` output after global average pooling (the FID layer)
+* ``logits_unbiased`` — final linear layer without bias
+
+Pretrained weights are NOT bundled (this environment has no network egress).
+The extractor loads parameters from an ``.npz``/torch ``state_dict`` file when
+one is supplied (``weights_path=...`` or the ``METRICS_TPU_INCEPTION_WEIGHTS``
+env var); otherwise construction with default features raises, mirroring the
+reference's hard gate on ``_TORCH_FIDELITY_AVAILABLE``
+(``torchmetrics/image/fid.py:26-31``, ``fid.py:214-219``). Any callable
+``(N, 3, H, W) -> (N, d)`` can always be passed as a custom extractor.
+"""
+import os
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.imports import _FLAX_AVAILABLE
+
+if _FLAX_AVAILABLE:
+    import flax.linen as nn
+else:  # pragma: no cover - flax is baked into the target image
+    nn = None
+
+VALID_FEATURE_TAPS = ("logits_unbiased", 64, 192, 768, 2048)
+
+_WEIGHTS_ENV_VAR = "METRICS_TPU_INCEPTION_WEIGHTS"
+
+
+def _inception_weights_path() -> Optional[str]:
+    path = os.environ.get(_WEIGHTS_ENV_VAR)
+    return path if path and os.path.exists(path) else None
+
+
+def inception_weights_available() -> bool:
+    """True when a pretrained-weights file is discoverable for the default extractor."""
+    return _FLAX_AVAILABLE and _inception_weights_path() is not None
+
+
+if _FLAX_AVAILABLE:
+
+    class BasicConv2d(nn.Module):
+        """Conv + BatchNorm(eps=1e-3, no scale bias on conv) + ReLU."""
+
+        features: int
+        kernel: Tuple[int, int]
+        strides: Tuple[int, int] = (1, 1)
+        padding: Any = "VALID"
+
+        @nn.compact
+        def __call__(self, x: jax.Array) -> jax.Array:
+            x = nn.Conv(self.features, self.kernel, self.strides, padding=self.padding, use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9)(x)
+            return nn.relu(x)
+
+    def _max_pool_3x3_s2(x: jax.Array) -> jax.Array:
+        return nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+    def _avg_pool_3x3_s1_same(x: jax.Array) -> jax.Array:
+        # count_include_pad=True average pooling (torch default), so a plain
+        # constant-window mean over zero padding matches.
+        return nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+
+    class InceptionA(nn.Module):
+        pool_features: int
+
+        @nn.compact
+        def __call__(self, x: jax.Array) -> jax.Array:
+            b1 = BasicConv2d(64, (1, 1))(x)
+            b5 = BasicConv2d(48, (1, 1))(x)
+            b5 = BasicConv2d(64, (5, 5), padding=((2, 2), (2, 2)))(b5)
+            b3 = BasicConv2d(64, (1, 1))(x)
+            b3 = BasicConv2d(96, (3, 3), padding=((1, 1), (1, 1)))(b3)
+            b3 = BasicConv2d(96, (3, 3), padding=((1, 1), (1, 1)))(b3)
+            bp = _avg_pool_3x3_s1_same(x)
+            bp = BasicConv2d(self.pool_features, (1, 1))(bp)
+            return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+    class InceptionB(nn.Module):
+        @nn.compact
+        def __call__(self, x: jax.Array) -> jax.Array:
+            b3 = BasicConv2d(384, (3, 3), strides=(2, 2))(x)
+            bd = BasicConv2d(64, (1, 1))(x)
+            bd = BasicConv2d(96, (3, 3), padding=((1, 1), (1, 1)))(bd)
+            bd = BasicConv2d(96, (3, 3), strides=(2, 2))(bd)
+            bp = _max_pool_3x3_s2(x)
+            return jnp.concatenate([b3, bd, bp], axis=-1)
+
+    class InceptionC(nn.Module):
+        channels_7x7: int
+
+        @nn.compact
+        def __call__(self, x: jax.Array) -> jax.Array:
+            c7 = self.channels_7x7
+            b1 = BasicConv2d(192, (1, 1))(x)
+            b7 = BasicConv2d(c7, (1, 1))(x)
+            b7 = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)))(b7)
+            b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)))(b7)
+            bd = BasicConv2d(c7, (1, 1))(x)
+            bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)))(bd)
+            bd = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)))(bd)
+            bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)))(bd)
+            bd = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)))(bd)
+            bp = _avg_pool_3x3_s1_same(x)
+            bp = BasicConv2d(192, (1, 1))(bp)
+            return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+    class InceptionD(nn.Module):
+        @nn.compact
+        def __call__(self, x: jax.Array) -> jax.Array:
+            b3 = BasicConv2d(192, (1, 1))(x)
+            b3 = BasicConv2d(320, (3, 3), strides=(2, 2))(b3)
+            b7 = BasicConv2d(192, (1, 1))(x)
+            b7 = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)))(b7)
+            b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)))(b7)
+            b7 = BasicConv2d(192, (3, 3), strides=(2, 2))(b7)
+            bp = _max_pool_3x3_s2(x)
+            return jnp.concatenate([b3, b7, bp], axis=-1)
+
+    class InceptionE(nn.Module):
+        @nn.compact
+        def __call__(self, x: jax.Array) -> jax.Array:
+            b1 = BasicConv2d(320, (1, 1))(x)
+            b3 = BasicConv2d(384, (1, 1))(x)
+            b3a = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)))(b3)
+            b3b = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)))(b3)
+            b3 = jnp.concatenate([b3a, b3b], axis=-1)
+            bd = BasicConv2d(448, (1, 1))(x)
+            bd = BasicConv2d(384, (3, 3), padding=((1, 1), (1, 1)))(bd)
+            bda = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)))(bd)
+            bdb = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)))(bd)
+            bd = jnp.concatenate([bda, bdb], axis=-1)
+            bp = _avg_pool_3x3_s1_same(x)
+            bp = BasicConv2d(192, (1, 1))(bp)
+            return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+    class InceptionV3(nn.Module):
+        """Inception-V3 trunk emitting every FID feature tap in one forward.
+
+        Input: NHWC float images already normalized to roughly ``[-1, 1]``.
+        Output: dict ``{64, 192, 768, 2048, 'logits_unbiased'} -> (N, d)``.
+        """
+
+        num_logits: int = 1008  # TF-compat class count used by FID nets
+
+        @nn.compact
+        def __call__(self, x: jax.Array) -> Dict[str, jax.Array]:
+            # taps keyed by str so the output dict is a valid (sortable) pytree
+            taps: Dict[str, jax.Array] = {}
+            x = BasicConv2d(32, (3, 3), strides=(2, 2))(x)
+            x = BasicConv2d(32, (3, 3))(x)
+            x = BasicConv2d(64, (3, 3), padding=((1, 1), (1, 1)))(x)
+            x = _max_pool_3x3_s2(x)
+            taps["64"] = jnp.mean(x, axis=(1, 2))
+            x = BasicConv2d(80, (1, 1))(x)
+            x = BasicConv2d(192, (3, 3))(x)
+            x = _max_pool_3x3_s2(x)
+            taps["192"] = jnp.mean(x, axis=(1, 2))
+            x = InceptionA(pool_features=32)(x)
+            x = InceptionA(pool_features=64)(x)
+            x = InceptionA(pool_features=64)(x)
+            x = InceptionB()(x)
+            x = InceptionC(channels_7x7=128)(x)
+            x = InceptionC(channels_7x7=160)(x)
+            x = InceptionC(channels_7x7=160)(x)
+            x = InceptionC(channels_7x7=192)(x)
+            taps["768"] = jnp.mean(x, axis=(1, 2))
+            x = InceptionD()(x)
+            x = InceptionE()(x)
+            x = InceptionE()(x)
+            pooled = jnp.mean(x, axis=(1, 2))
+            taps["2048"] = pooled
+            taps["logits_unbiased"] = nn.Dense(self.num_logits, use_bias=False)(pooled)
+            return taps
+
+
+def _bilinear_resize(imgs: jax.Array, size: int = 299) -> jax.Array:
+    if imgs.shape[1] == size and imgs.shape[2] == size:
+        return imgs
+    return jax.image.resize(imgs, (imgs.shape[0], size, size, imgs.shape[3]), method="bilinear")
+
+
+class InceptionFeatureExtractor:
+    """Callable ``(N, 3, H, W) -> (N, d)`` feature extractor on InceptionV3.
+
+    The analogue of ``NoTrainInceptionV3`` (``torchmetrics/image/fid.py:34-52``):
+    frozen (inference-only batch norm, no train mode to switch back to), resizes
+    any input to 299x299 and normalizes to ``[-1, 1]`` — integer-dtype images
+    are read as ``[0, 255]`` (the reference's uint8 contract), float images as
+    ``[0, 1]``. Returns the requested tap as a flat ``(N, d)`` matrix; the
+    whole pipeline is one jitted XLA program.
+
+    Args:
+        feature: one of ``64 | 192 | 768 | 2048 | 'logits_unbiased'``.
+        weights_path: ``.npz`` flattened param file or a torch ``state_dict``
+            checkpoint (``.pt``/``.pth``); defaults to ``$METRICS_TPU_INCEPTION_WEIGHTS``.
+        rng_seed: seed for random init when explicitly allowed via
+            ``allow_random_weights=True`` (architecture tests only).
+    """
+
+    def __init__(
+        self,
+        feature: Any = 2048,
+        weights_path: Optional[str] = None,
+        allow_random_weights: bool = False,
+        rng_seed: int = 0,
+    ) -> None:
+        if not _FLAX_AVAILABLE:  # pragma: no cover
+            raise ModuleNotFoundError("InceptionFeatureExtractor requires `flax` to be installed")
+        if feature not in VALID_FEATURE_TAPS:
+            raise ValueError(
+                f"Integer input to argument `feature` must be one of {VALID_FEATURE_TAPS}, but got {feature}."
+            )
+        self.feature = feature
+
+        weights_path = weights_path or _inception_weights_path()
+        if weights_path is not None:
+            self.variables = self._load_weights(weights_path)
+            # the checkpoint's fc width decides the logits head (torchvision
+            # ships 1000-way, TF-compat FID nets 1008-way)
+            num_logits = self.variables["params"]["Dense_0"]["kernel"].shape[-1]
+            self.net = InceptionV3(num_logits=num_logits)
+        elif allow_random_weights:
+            self.net = InceptionV3()
+            dummy = jnp.zeros((1, 299, 299, 3), jnp.float32)
+            self.variables = self.net.init(jax.random.PRNGKey(rng_seed), dummy)
+        else:
+            raise ValueError(
+                "The default InceptionV3 feature extractor needs pretrained weights: pass"
+                f" `weights_path=...`, set ${_WEIGHTS_ENV_VAR}, or supply a custom feature"
+                " extractor callable instead."
+            )
+        self._forward = jax.jit(self._apply)
+
+    def _apply(self, imgs: jax.Array) -> jax.Array:
+        # dtype decides the input convention (static, so trace-safe):
+        # integer images are [0, 255] (the reference's uint8 contract),
+        # float images are assumed already in [0, 1]
+        if jnp.issubdtype(imgs.dtype, jnp.integer):
+            imgs = jnp.asarray(imgs, jnp.float32)
+            imgs = (imgs - 128.0) / 128.0
+        else:
+            imgs = jnp.asarray(imgs, jnp.float32) * 2.0 - 1.0
+        imgs = jnp.transpose(imgs, (0, 2, 3, 1))  # NCHW -> NHWC
+        imgs = _bilinear_resize(imgs, 299)
+        taps = self.net.apply(self.variables, imgs)
+        return taps[str(self.feature)].reshape(imgs.shape[0], -1)
+
+    def __call__(self, imgs: jax.Array) -> jax.Array:
+        return self._forward(imgs)
+
+    # ------------------------------------------------------------------
+    # weight loading
+    # ------------------------------------------------------------------
+
+    def _load_weights(self, path: str) -> Dict[str, Any]:
+        if path.endswith(".npz"):
+            flat = dict(np.load(path))
+            return _unflatten_params(flat)
+        return self._load_torch_checkpoint(path)
+
+    def _load_torch_checkpoint(self, path: str) -> Dict[str, Any]:
+        """Map a torchvision ``Inception3`` state_dict onto the Flax tree."""
+        import torch
+
+        state = torch.load(path, map_location="cpu", weights_only=True)
+        if hasattr(state, "state_dict"):
+            state = state.state_dict()
+        flat = {}
+        torch_names = _torchvision_name_map()
+        for flax_key, torch_key in torch_names.items():
+            tensor = np.asarray(state[torch_key])
+            if flax_key.endswith("Conv_0/kernel"):
+                tensor = tensor.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+            elif flax_key.endswith("Dense_0/kernel"):
+                tensor = tensor.transpose(1, 0)
+            flat[flax_key] = tensor
+        return _unflatten_params(flat)
+
+
+def _unflatten_params(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Rebuild the nested ``{'params': ..., 'batch_stats': ...}`` variables tree
+    from ``/``-joined keys (the ``.npz`` export format)."""
+    tree: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = jnp.asarray(value)
+    return tree
+
+
+def _module_paths() -> Sequence[Tuple[str, str]]:
+    """(flax submodule path, torchvision module name) pairs for every BasicConv2d."""
+    pairs = [
+        ("BasicConv2d_0", "Conv2d_1a_3x3"),
+        ("BasicConv2d_1", "Conv2d_2a_3x3"),
+        ("BasicConv2d_2", "Conv2d_2b_3x3"),
+        ("BasicConv2d_3", "Conv2d_3b_1x1"),
+        ("BasicConv2d_4", "Conv2d_4a_3x3"),
+    ]
+    incept_names = [
+        ("InceptionA_0", "Mixed_5b", ["branch1x1", "branch5x5_1", "branch5x5_2", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3", "branch_pool"]),
+        ("InceptionA_1", "Mixed_5c", ["branch1x1", "branch5x5_1", "branch5x5_2", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3", "branch_pool"]),
+        ("InceptionA_2", "Mixed_5d", ["branch1x1", "branch5x5_1", "branch5x5_2", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3", "branch_pool"]),
+        ("InceptionB_0", "Mixed_6a", ["branch3x3", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3"]),
+        ("InceptionC_0", "Mixed_6b", ["branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3", "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3", "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool"]),
+        ("InceptionC_1", "Mixed_6c", ["branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3", "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3", "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool"]),
+        ("InceptionC_2", "Mixed_6d", ["branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3", "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3", "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool"]),
+        ("InceptionC_3", "Mixed_6e", ["branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3", "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3", "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool"]),
+        ("InceptionD_0", "Mixed_7a", ["branch3x3_1", "branch3x3_2", "branch7x7x3_1", "branch7x7x3_2", "branch7x7x3_3", "branch7x7x3_4"]),
+        ("InceptionE_0", "Mixed_7b", ["branch1x1", "branch3x3_1", "branch3x3_2a", "branch3x3_2b", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3a", "branch3x3dbl_3b", "branch_pool"]),
+        ("InceptionE_1", "Mixed_7c", ["branch1x1", "branch3x3_1", "branch3x3_2a", "branch3x3_2b", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3a", "branch3x3dbl_3b", "branch_pool"]),
+    ]
+    for flax_mod, torch_mod, branches in incept_names:
+        for i, branch in enumerate(branches):
+            pairs.append((f"{flax_mod}/BasicConv2d_{i}", f"{torch_mod}.{branch}"))
+    return pairs
+
+
+def _torchvision_name_map() -> Dict[str, str]:
+    """flax flat param key -> torchvision ``Inception3`` state_dict key."""
+    mapping: Dict[str, str] = {}
+    for flax_mod, torch_mod in _module_paths():
+        mapping[f"params/{flax_mod}/Conv_0/kernel"] = f"{torch_mod}.conv.weight"
+        mapping[f"params/{flax_mod}/BatchNorm_0/scale"] = f"{torch_mod}.bn.weight"
+        mapping[f"params/{flax_mod}/BatchNorm_0/bias"] = f"{torch_mod}.bn.bias"
+        mapping[f"batch_stats/{flax_mod}/BatchNorm_0/mean"] = f"{torch_mod}.bn.running_mean"
+        mapping[f"batch_stats/{flax_mod}/BatchNorm_0/var"] = f"{torch_mod}.bn.running_var"
+    mapping["params/Dense_0/kernel"] = "fc.weight"
+    return mapping
+
+
+def resolve_feature_extractor(feature: Any, allow_random_weights: bool = False) -> Callable:
+    """Turn the metric's ``feature`` argument into an ``(N,3,H,W)->(N,d)`` callable.
+
+    Parity with the reference's dispatch (``torchmetrics/image/fid.py:211-227``):
+    int/str selects an InceptionV3 tap (hard-failing when the pretrained weights
+    are unavailable), any callable is used as-is.
+    """
+    if isinstance(feature, (int, str)):
+        return InceptionFeatureExtractor(feature, allow_random_weights=allow_random_weights)
+    if callable(feature):
+        return feature
+    raise TypeError("Got unknown input to argument `feature`")

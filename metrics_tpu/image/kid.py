@@ -1,0 +1,158 @@
+"""Kernel Inception Distance (parity: ``torchmetrics/image/kid.py:70-281``).
+
+TPU-native design notes:
+
+* The reference loops ``subsets`` times on the host, drawing a fresh
+  ``torch.randperm`` and launching a fresh MMD kernel each iteration
+  (``kid.py:267-279``). Here all subset index matrices are drawn at once with
+  an explicit JAX PRNG key and the polynomial-kernel MMD is ``vmap``-ped over
+  the subset axis — one fused XLA program of batched matmuls on the MXU
+  instead of ``subsets`` sequential launches.
+* Randomness is reproducible by construction: the metric holds a fixed PRNG
+  key (``rng_seed`` ctor arg) and ``compute()`` derives the subset indices
+  from it without mutating any attribute — repeated computes on the same
+  state return identical values, and the method stays pure under ``jit``.
+"""
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import Array, dim_zero_cat
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+def poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0) -> Array:
+    """Polynomial kernel matrix ``(γ·f1ᵀf2 + coef)^degree`` (ref ``kid.py:49-56``)."""
+    if gamma is None:
+        gamma = 1.0 / f1.shape[1]
+    return (f1 @ f2.T * gamma + coef) ** degree
+
+
+def maximum_mean_discrepancy(k_xx: Array, k_xy: Array, k_yy: Array) -> Array:
+    """Unbiased MMD² estimate from the three kernel blocks (ref ``kid.py:27-46``)."""
+    m = k_xx.shape[0]
+    kt_xx_sum = (k_xx.sum() - jnp.trace(k_xx)) / (m * (m - 1))
+    kt_yy_sum = (k_yy.sum() - jnp.trace(k_yy)) / (m * (m - 1))
+    k_xy_sum = k_xy.sum() / (m**2)
+    return kt_xx_sum + kt_yy_sum - 2 * k_xy_sum
+
+
+def poly_mmd(
+    f_real: Array, f_fake: Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0
+) -> Array:
+    """Polynomial-kernel MMD² between two feature matrices (ref ``kid.py:59-68``)."""
+    k_11 = poly_kernel(f_real, f_real, degree, gamma, coef)
+    k_22 = poly_kernel(f_fake, f_fake, degree, gamma, coef)
+    k_12 = poly_kernel(f_real, f_fake, degree, gamma, coef)
+    return maximum_mean_discrepancy(k_11, k_12, k_22)
+
+
+class KID(Metric):
+    """Kernel inception distance: mean/std of MMD² over random feature subsets.
+
+    Args:
+        feature: InceptionV3 tap (int/str, needs pretrained weights) or a
+            callable ``(N, 3, H, W) -> (N, d)`` feature extractor.
+        subsets: number of random subsets the score is averaged over.
+        subset_size: samples drawn (without replacement) per subset.
+        degree / gamma / coef: polynomial kernel parameters.
+        rng_seed: seed of the metric's PRNG key (subset sampling).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.image.kid import KID
+        >>> feats = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8]
+        >>> kid = KID(feature=feats, subsets=3, subset_size=4)
+        >>> imgs = jnp.linspace(0, 1, 6 * 3 * 4 * 4).reshape(6, 3, 4, 4)
+        >>> kid.update(imgs, real=True)
+        >>> kid.update(imgs * 0.9, real=False)
+        >>> kid_mean, kid_std = kid.compute()
+        >>> bool(jnp.isfinite(kid_mean))
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+
+    def __init__(
+        self,
+        feature: Union[str, int, Callable] = 2048,
+        subsets: int = 100,
+        subset_size: int = 1000,
+        degree: int = 3,
+        gamma: Optional[float] = None,
+        coef: float = 1.0,
+        rng_seed: int = 42,
+        compute_on_step: bool = False,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        rank_zero_warn(
+            "Metric `KID` will save all extracted features in buffer."
+            " For large datasets this may lead to large memory footprint.",
+            UserWarning,
+        )
+        from metrics_tpu.image.inception_net import resolve_feature_extractor
+
+        self.inception = resolve_feature_extractor(feature)
+
+        if not (isinstance(subsets, int) and subsets > 0):
+            raise ValueError("Argument `subsets` expected to be integer larger than 0")
+        self.subsets = subsets
+        if not (isinstance(subset_size, int) and subset_size > 0):
+            raise ValueError("Argument `subset_size` expected to be integer larger than 0")
+        self.subset_size = subset_size
+        if not (isinstance(degree, int) and degree > 0):
+            raise ValueError("Argument `degree` expected to be integer larger than 0")
+        self.degree = degree
+        if gamma is not None and not (isinstance(gamma, float) and gamma > 0):
+            raise ValueError("Argument `gamma` expected to be `None` or float larger than 0")
+        self.gamma = gamma
+        if not (isinstance(coef, float) and coef > 0):
+            raise ValueError("Argument `coef` expected to be float larger than 0")
+        self.coef = coef
+        self._rng_key = jax.random.PRNGKey(rng_seed)
+
+        self.add_state("real_features", [], dist_reduce_fx=None)
+        self.add_state("fake_features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract features for ``imgs`` and buffer them under the ``real`` flag."""
+        features = self.inception(imgs)
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """(mean, std) of KID over ``subsets`` random subset pairs."""
+        real_features = dim_zero_cat(self.real_features)
+        fake_features = dim_zero_cat(self.fake_features)
+
+        n_real, n_fake = real_features.shape[0], fake_features.shape[0]
+        if n_real < self.subset_size or n_fake < self.subset_size:
+            raise ValueError("Argument `subset_size` should be smaller than the number of samples")
+
+        key_real, key_fake = jax.random.split(self._rng_key)
+        # all subset index matrices at once: (subsets, subset_size) each
+        real_idx = jax.vmap(lambda k: jax.random.permutation(k, n_real)[: self.subset_size])(
+            jax.random.split(key_real, self.subsets)
+        )
+        fake_idx = jax.vmap(lambda k: jax.random.permutation(k, n_fake)[: self.subset_size])(
+            jax.random.split(key_fake, self.subsets)
+        )
+
+        def one_subset(ridx: Array, fidx: Array) -> Array:
+            return poly_mmd(real_features[ridx], fake_features[fidx], self.degree, self.gamma, self.coef)
+
+        kid_scores = jax.vmap(one_subset)(real_idx, fake_idx)
+        return kid_scores.mean(), kid_scores.std()
