@@ -4,8 +4,10 @@ Capability parity with the reference's ``torchmetrics/classification/
 binned_precision_recall.py:37-294`` — and the **TPU-preferred** curve design:
 states are fixed ``(C, T)`` sum-reduced count tensors (pure psum at sync, no
 ragged gather), and where the reference iterates thresholds in a Python loop
-("to conserve memory", ``:147-152``) the update here is a single broadcast
-compare ``(N, C, 1) >= (T,)`` reduced over N — one fused XLA kernel.
+("to conserve memory", ``:147-152``) the update here dispatches through
+:mod:`metrics_tpu.kernels.binned_counts` — on TPU a Pallas histogram kernel
+(bucketize + MXU weighted bincount + suffix-cumsum), elsewhere one fused
+broadcast compare ``(N, C, 1) >= (T,)`` reduced over N.
 """
 from typing import Any, List, Optional, Tuple, Union
 
@@ -14,6 +16,7 @@ import jax.numpy as jnp
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute_with_precision_recall,
 )
+from metrics_tpu.kernels.binned_counts import binned_tp_fp_fn
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import METRIC_EPS, Array, to_onehot
 
@@ -104,12 +107,10 @@ class BinnedPrecisionRecallCurve(Metric):
         if preds.ndim == targets.ndim + 1:
             targets = to_onehot(targets, num_classes=self.num_classes)
 
-        t = (targets == 1)[:, :, None]  # (N, C, 1)
-        p = preds[:, :, None] >= self.thresholds[None, None, :]  # (N, C, T)
-
-        self.TPs = self.TPs + jnp.sum(t & p, axis=0)
-        self.FPs = self.FPs + jnp.sum(~t & p, axis=0)
-        self.FNs = self.FNs + jnp.sum(t & ~p, axis=0)
+        tps, fps, fns = binned_tp_fp_fn(preds, targets, self.thresholds)
+        self.TPs = self.TPs + tps
+        self.FPs = self.FPs + fps
+        self.FNs = self.FNs + fns
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         """Per-class (precision, recall, thresholds) with the (1, 0) endpoint."""

@@ -3,11 +3,11 @@
 Capability parity with the reference's
 ``torchmetrics/functional/classification/confusion_matrix.py`` (bincount over
 the flat index ``target*C + preds`` at ``:291-310``, normalization at
-``:313-331``) — TPU-first: the count is a static-shape XLA ``scatter-add``
-into a zeros buffer (``.at[idx].add(1)``), which compiles to an on-device
-fused scatter instead of torch's host-tuned bincount; for the multilabel
-per-class 2x2 case the four cells are plain boolean-mask sums (one fused
-reduction pass, no scatter at all).
+``:313-331``) — TPU-first: counting dispatches through
+:mod:`metrics_tpu.kernels.confusion_matrix` (a Pallas one-hot-matmul kernel
+on the MXU for TPU, XLA scatter-add fallback elsewhere); the multilabel
+per-class 2x2 case stays four plain boolean-mask sums (one fused reduction
+pass, no scatter at all).
 """
 from typing import Optional
 
@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 import numpy as np
 
+from metrics_tpu.kernels.confusion_matrix import confmat_counts
 from metrics_tpu.utilities.checks import _input_format_classification
 from metrics_tpu.utilities.data import Array, _is_traced
 from metrics_tpu.utilities.enums import DataType
@@ -46,9 +47,7 @@ def _confusion_matrix_update(
         hi = max(int(np.asarray(preds).max(initial=0)), int(np.asarray(target).max(initial=0)))
         if hi >= num_classes:
             raise ValueError(f"Detected class label {hi} but `num_classes={num_classes}`")
-    flat = target.reshape(-1) * num_classes + preds.reshape(-1)
-    bins = jnp.zeros(num_classes * num_classes, dtype=jnp.int32).at[flat].add(1)
-    return bins.reshape(num_classes, num_classes)
+    return confmat_counts(preds, target, num_classes)
 
 
 def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:

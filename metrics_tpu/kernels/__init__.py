@@ -1,0 +1,25 @@
+"""Pallas TPU kernels for the hot metric ops.
+
+The reference leans on torch's tuned CPU/CUDA primitives for its inner loops
+(``torch.bincount`` for the confusion matrix,
+``functional/classification/confusion_matrix.py:291-310``; a Python threshold
+loop for binned PR counts, ``classification/binned_precision_recall.py:147-152``).
+Here the equivalents are hand-fused Pallas kernels that keep the per-batch
+pass in VMEM and feed the MXU directly, with the plain-XLA formulations as
+the portable fallback used on CPU and for any shape the kernel does not cover.
+
+Dispatch contract: every kernel module exposes ``<op>(...)`` (auto: Pallas on
+TPU when the shape qualifies, XLA otherwise) plus ``<op>_pallas`` /
+``<op>_xla`` for explicit selection and testing (``interpret=True`` runs the
+Pallas path on CPU).
+"""
+from metrics_tpu.kernels.confusion_matrix import (  # noqa: F401
+    confmat_counts,
+    confmat_counts_pallas,
+    confmat_counts_xla,
+)
+from metrics_tpu.kernels.binned_counts import (  # noqa: F401
+    binned_tp_fp_fn,
+    binned_tp_fp_fn_pallas,
+    binned_tp_fp_fn_xla,
+)
