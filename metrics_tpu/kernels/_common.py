@@ -1,0 +1,28 @@
+"""Shared plumbing for the Pallas kernel modules: availability probe,
+alignment helper, and the common part of the auto-dispatch predicate."""
+import jax
+
+try:  # pltpu import fails on builds without TPU support compiled in
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_TPU_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _PALLAS_TPU_AVAILABLE = False
+
+#: kernels accumulate counts in f32 (MXU output); counts stay integer-exact
+#: up to 2^24, so auto-dispatch caps the element count there
+_MAX_PALLAS_SAMPLES = 1 << 24
+
+
+def _round_up(value: int, multiple: int) -> int:
+    return ((value + multiple - 1) // multiple) * multiple
+
+
+def pallas_auto_ok(num_elems: int) -> bool:
+    """Common auto-dispatch gate: TPU backend, non-empty input, f32-exact counts."""
+    return (
+        _PALLAS_TPU_AVAILABLE
+        and jax.default_backend() == "tpu"
+        and 0 < num_elems <= _MAX_PALLAS_SAMPLES
+    )

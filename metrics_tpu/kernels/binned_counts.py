@@ -24,20 +24,17 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from metrics_tpu.kernels.confusion_matrix import _PALLAS_TPU_AVAILABLE, _round_up
-
-if _PALLAS_TPU_AVAILABLE:
-    from jax.experimental.pallas import tpu as pltpu
-else:  # pragma: no cover
-    pltpu = None
+from metrics_tpu.kernels._common import (
+    _PALLAS_TPU_AVAILABLE,
+    _round_up,
+    pallas_auto_ok,
+    pltpu,
+)
 
 _TILE = 512
 _KBLOCK = 2048  # bins per grid block: one-hot tile is TILE x KBLOCK f32 = 4 MB VMEM
 #: bin count past which the blocked histogram stops paying off vs the XLA path
 _MAX_PALLAS_BINS = 1 << 16
-#: the kernel accumulates counts in f32 (MXU output); per-bin counts stay
-#: integer-exact up to 2^24, so auto-dispatch caps the sample count there
-_MAX_PALLAS_SAMPLES = 1 << 24
 
 
 def binned_tp_fp_fn_xla(
@@ -82,6 +79,9 @@ def weighted_bincount_pallas(
     bin stays below 2^24.
     """
     squeeze = weights.ndim == 1
+    if indices.size == 0:  # reshape(-1) below cannot infer a dim from 0 elements
+        zeros = jnp.zeros(num_bins, jnp.float32)
+        return zeros if squeeze else jnp.zeros((weights.shape[-1], num_bins), jnp.float32)
     weights = weights.reshape(weights.shape[0], -1)
     m, num_weight_cols = weights.shape
     if num_weight_cols > 8:
@@ -125,6 +125,9 @@ def _binned_tp_fp_fn_pallas_impl(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     n, num_classes = preds.shape
     num_thresholds = thresholds.shape[0]
+    if n == 0:  # empty shard/batch: zero counts, like the XLA path
+        zeros = jnp.zeros((num_classes, num_thresholds), jnp.float32)
+        return zeros, zeros, zeros
     num_buckets = num_thresholds + 1  # bucket b = number of thresholds <= pred
 
     # NaN preds must never fire at any threshold (XLA-path parity: nan >= thr
@@ -167,10 +170,8 @@ def binned_tp_fp_fn(
     """Binned TP/FP/FN counts with automatic backend dispatch."""
     if use_pallas is None:
         use_pallas = (
-            _PALLAS_TPU_AVAILABLE
-            and jax.default_backend() == "tpu"
+            pallas_auto_ok(preds.size)
             and preds.shape[1] * (thresholds.shape[0] + 1) <= _MAX_PALLAS_BINS
-            and preds.size <= _MAX_PALLAS_SAMPLES  # keep f32 counts integer-exact
         )
     if use_pallas:
         return binned_tp_fp_fn_pallas(preds, target, thresholds)

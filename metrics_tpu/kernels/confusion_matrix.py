@@ -21,25 +21,17 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # pltpu import fails on builds without TPU support compiled in
-    from jax.experimental.pallas import tpu as pltpu
-
-    _PALLAS_TPU_AVAILABLE = True
-except ImportError:  # pragma: no cover
-    pltpu = None
-    _PALLAS_TPU_AVAILABLE = False
+from metrics_tpu.kernels._common import (
+    _PALLAS_TPU_AVAILABLE,
+    _round_up,
+    pallas_auto_ok,
+    pltpu,
+)
 
 #: largest C the Pallas path handles: VMEM must hold two (TILE, C̃) one-hot
 #: tiles plus the (C̃, C̃) f32 accumulator (C̃=512 -> 1 MB + 2 MB, well in budget)
 _MAX_PALLAS_CLASSES = 512
-#: the kernel accumulates counts in f32 (MXU output); a confusion cell stays
-#: integer-exact up to 2^24, so auto-dispatch caps the sample count there
-_MAX_PALLAS_SAMPLES = 1 << 24
 _TILE = 512
-
-
-def _round_up(value: int, multiple: int) -> int:
-    return ((value + multiple - 1) // multiple) * multiple
 
 
 def confmat_counts_xla(preds: jax.Array, target: jax.Array, num_classes: int) -> jax.Array:
@@ -108,12 +100,7 @@ def confmat_counts(
     ``num_classes <= 512`` and the XLA scatter otherwise.
     """
     if use_pallas is None:
-        use_pallas = (
-            _PALLAS_TPU_AVAILABLE
-            and jax.default_backend() == "tpu"
-            and num_classes <= _MAX_PALLAS_CLASSES
-            and preds.size <= _MAX_PALLAS_SAMPLES  # keep f32 counts integer-exact
-        )
+        use_pallas = pallas_auto_ok(preds.size) and num_classes <= _MAX_PALLAS_CLASSES
     if use_pallas:
         return confmat_counts_pallas(preds, target, num_classes)
     return confmat_counts_xla(preds, target, num_classes)

@@ -54,6 +54,7 @@ from metrics_tpu.utilities.distributed import (
     gather_all_arrays,
     sync_in_graph,
 )
+from metrics_tpu.utilities.profiling import compiled_scope, eager_span
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
@@ -212,9 +213,10 @@ class Metric(ABC):
 
     def apply_update(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
         """Pure update: return the state advanced by this batch. Trace-safe."""
-        with self._bound_state({k: (list(v) if isinstance(v, list) else v) for k, v in state.items()}):
-            self._unwrapped_update(*args, **kwargs)
-            return self._get_states()
+        with compiled_scope(f"{self.__class__.__name__}.update"):
+            with self._bound_state({k: (list(v) if isinstance(v, list) else v) for k, v in state.items()}):
+                self._unwrapped_update(*args, **kwargs)
+                return self._get_states()
 
     def apply_compute(self, state: StateDict, axis_name: Optional[Any] = None) -> Any:
         """Pure compute: final value from ``state``.
@@ -222,10 +224,12 @@ class Metric(ABC):
         With ``axis_name`` (inside ``shard_map``/``pmap``) states are first
         synchronized across the named mesh axis with XLA collectives.
         """
-        if axis_name is not None:
-            state = sync_in_graph(state, self._reductions, axis_name)
-        with self._bound_state(state):
-            return self._unwrapped_compute()
+        with compiled_scope(f"{self.__class__.__name__}.compute"):
+            if axis_name is not None:
+                with compiled_scope(f"{self.__class__.__name__}.sync"):
+                    state = sync_in_graph(state, self._reductions, axis_name)
+            with self._bound_state(state):
+                return self._unwrapped_compute()
 
     def apply_forward(
         self, state: StateDict, *args: Any, axis_name: Optional[Any] = None, **kwargs: Any
@@ -292,9 +296,10 @@ class Metric(ABC):
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate this batch and (if ``compute_on_step``) return its value."""
-        if self._states_mergeable():
-            return self._forward_fused(*args, **kwargs)
-        return self._forward_double_update(*args, **kwargs)
+        with eager_span(f"{self.__class__.__name__}.forward"):
+            if self._states_mergeable():
+                return self._forward_fused(*args, **kwargs)
+            return self._forward_double_update(*args, **kwargs)
 
     def _forward_fused(self, *args: Any, **kwargs: Any) -> Any:
         accumulated = self._get_states()

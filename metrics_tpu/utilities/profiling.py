@@ -1,0 +1,38 @@
+"""Profiling hooks.
+
+The reference has no in-repo tracing (only instantiation telemetry,
+``torchmetrics/metric.py:83``). Here every metric phase is observable
+natively: compiled regions carry ``jax.named_scope`` annotations (visible in
+HLO and in ``jax.profiler`` / XProf timelines as ``metrics/<Metric>.<phase>``)
+and eager calls carry ``jax.profiler.TraceAnnotation`` spans, so per-metric
+step overhead — the BASELINE north-star number — can be read straight off a
+profiler trace instead of wall-clock sampling.
+
+Enable a trace with the standard JAX tooling, e.g.::
+
+    with jax.profiler.trace("/tmp/metrics-trace"):
+        state = step(state, preds, target)   # annotated regions appear per metric
+"""
+from contextlib import contextmanager
+from typing import Iterator
+
+import jax
+
+_SCOPE_PREFIX = "metrics"
+
+
+def compiled_scope(name: str):
+    """Named scope for trace-time annotation inside jitted programs."""
+    return jax.named_scope(f"{_SCOPE_PREFIX}/{name}")
+
+
+@contextmanager
+def eager_span(name: str) -> Iterator[None]:
+    """Host-side profiler span for eager (non-compiled) metric phases."""
+    try:
+        annotation = jax.profiler.TraceAnnotation(f"{_SCOPE_PREFIX}/{name}")
+    except Exception:  # pragma: no cover - profiler backend unavailable
+        yield
+        return
+    with annotation:
+        yield

@@ -49,6 +49,14 @@ class TestBinnedCountsKernel:
         np.testing.assert_allclose(np.asarray(got_fp), np.asarray(exp_fp), atol=1e-6)
         np.testing.assert_allclose(np.asarray(got_fn), np.asarray(exp_fn), atol=1e-6)
 
+    def test_empty_batch_returns_zeros(self):
+        preds = jnp.zeros((0, 3), jnp.float32)
+        target = jnp.zeros((0, 3), jnp.int32)
+        thresholds = jnp.linspace(0, 1.0, 5)
+        for arr in binned_tp_fp_fn_pallas(preds, target, thresholds, interpret=True):
+            assert arr.shape == (3, 5)
+            np.testing.assert_array_equal(np.asarray(arr), 0.0)
+
     def test_nan_preds_never_fire(self):
         # parity with the XLA path: nan >= thr is False at every threshold
         preds = jnp.asarray([[np.nan], [0.7]], jnp.float32)
