@@ -99,7 +99,11 @@ class StatScores(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate tp/fp/tn/fn from a batch of predictions and targets."""
-        tp, fp, tn, fn = _stat_scores_update(
+        self._accumulate(*self._batch_deltas(preds, target))
+
+    def _batch_deltas(self, preds: Array, target: Array) -> Tuple[Array, Array, Array, Array]:
+        """This batch's (tp, fp, tn, fn) — the shareable part of ``update``."""
+        return _stat_scores_update(
             preds,
             target,
             reduce=self.reduce,
@@ -111,7 +115,21 @@ class StatScores(Metric):
             ignore_index=self.ignore_index,
         )
 
-        self._accumulate(tp, fp, tn, fn)
+    def _shared_update_key(self) -> Optional[Tuple]:
+        # sharing is only valid when the subclass runs StatScores' update
+        # verbatim (Accuracy/HammingDistance override it with extra states)
+        if type(self).update is not StatScores.update:
+            return None
+        return (
+            "stat_scores",
+            self.reduce,
+            self.mdmc_reduce,
+            self.threshold,
+            self.num_classes,
+            self.top_k,
+            self.multiclass,
+            self.ignore_index,
+        )
 
     def _accumulate(self, tp: Array, fp: Array, tn: Array, fn: Array) -> None:
         """Add fixed-shape counts in place, or append samplewise counts."""

@@ -15,6 +15,7 @@ from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Union
 
 from metrics_tpu.metric import Metric, StateDict
 from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.utilities.profiling import compiled_scope
 
 
 class MetricCollection:
@@ -62,12 +63,54 @@ class MetricCollection:
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Call forward on every metric; positional args broadcast, kwargs are
-        filtered per metric signature."""
-        return {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
+        filtered per metric signature. Shared-update classes (see
+        :meth:`_shared_deltas`) run their partial-statistics pass once."""
+        shared = self._shared_deltas(*args, **kwargs)
+        out = {}
+        for name, m in self.items(keep_base=True):
+            deltas = shared.get(name)
+            if deltas is not None and m._states_mergeable():
+                out[self._set_name(name)] = m._forward_fused(
+                    *args, _update_thunk=lambda m=m, d=deltas: m._accumulate(*d), **m._filter_kwargs(**kwargs)
+                )
+            else:
+                out[self._set_name(name)] = m(*args, **m._filter_kwargs(**kwargs))
+        return out
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        for _, m in self.items(keep_base=True):
-            m.update(*args, **m._filter_kwargs(**kwargs))
+        shared = self._shared_deltas(*args, **kwargs)
+        for name, m in self.items(keep_base=True):
+            if name in shared:
+                # bookkeeping normally done by the _wrap_update wrapper
+                m._computed = None
+                m._update_called = True
+                m._accumulate(*shared[name])
+            else:
+                m.update(*args, **m._filter_kwargs(**kwargs))
+
+    def _shared_deltas(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Per-batch partial statistics computed ONCE per equivalence class.
+
+        Metrics advertising the same :meth:`Metric._shared_update_key` (e.g.
+        Precision/Recall/F1 with identical stat-scores settings) get one
+        canonicalization + one tp/fp/tn/fn pass instead of one each — the
+        collection-level fusion the reference leaves on the table (every
+        member keeps private states, SURVEY §3.3)."""
+        groups: Dict[Tuple, list] = {}
+        for name, m in self.items(keep_base=True):
+            key = m._shared_update_key()
+            if key is not None:
+                groups.setdefault(key, []).append((name, m))
+        deltas: Dict[str, Any] = {}
+        for members in groups.values():
+            if len(members) < 2:
+                continue
+            rep = members[0][1]
+            with compiled_scope(f"{type(rep).__name__}.shared_update"):
+                value = rep._batch_deltas(*args, **rep._filter_kwargs(**kwargs))
+            for name, _ in members:
+                deltas[name] = value
+        return deltas
 
     def compute(self) -> Dict[str, Any]:
         return {k: m.compute() for k, m in self.items()}
@@ -107,9 +150,18 @@ class MetricCollection:
         return {name: m.init_state() for name, m in self.items(keep_base=True)}
 
     def apply_update(self, state: Dict[str, StateDict], *args: Any, **kwargs: Any) -> Dict[str, StateDict]:
-        """Advance every metric's state with this batch in one traceable pass."""
+        """Advance every metric's state with this batch in one traceable pass.
+
+        Metrics in the same shared-update equivalence class get their partial
+        statistics computed once and fanned out (one canonicalization + one
+        stat-scores kernel for e.g. Precision+Recall+F1)."""
+        shared = self._shared_deltas(*args, **kwargs)
         return {
-            name: m.apply_update(state[name], *args, **m._filter_kwargs(**kwargs))
+            name: (
+                m._apply_accumulate(state[name], shared[name])
+                if name in shared
+                else m.apply_update(state[name], *args, **m._filter_kwargs(**kwargs))
+            )
             for name, m in self.items(keep_base=True)
         }
 
@@ -124,10 +176,21 @@ class MetricCollection:
     def apply_forward(
         self, state: Dict[str, StateDict], *args: Any, axis_name: Optional[Any] = None, **kwargs: Any
     ) -> Tuple[Dict[str, StateDict], Dict[str, Any]]:
+        """(accumulated state, per-batch values) — one shared update pass.
+
+        The batch-local states come from a single :meth:`apply_update` (so
+        shared-update classes canonicalize once for the whole collection);
+        each metric then merges its batch state into the accumulator the same
+        way :meth:`Metric.apply_forward` would."""
+        batch_state = self.apply_update(self.init_state(), *args, **kwargs)
         new_state, values = {}, {}
         for name, m in self.items(keep_base=True):
             new_state[name], values[self._set_name(name)] = m.apply_forward(
-                state[name], *args, axis_name=axis_name, **m._filter_kwargs(**kwargs)
+                state[name],
+                *args,
+                axis_name=axis_name,
+                batch_state=batch_state[name],
+                **m._filter_kwargs(**kwargs),
             )
         return new_state, values
 

@@ -232,15 +232,23 @@ class Metric(ABC):
                 return self._unwrapped_compute()
 
     def apply_forward(
-        self, state: StateDict, *args: Any, axis_name: Optional[Any] = None, **kwargs: Any
+        self,
+        state: StateDict,
+        *args: Any,
+        axis_name: Optional[Any] = None,
+        batch_state: Optional[StateDict] = None,
+        **kwargs: Any,
     ) -> Tuple[StateDict, Any]:
         """Pure forward: ``(accumulated_state, batch_value)`` in one update pass.
 
         The batch value reflects only this batch (synced over ``axis_name``
         when ``dist_sync_on_step``), matching the reference's dual-result
         forward contract (``metric.py:168-198``) at single-update cost.
+        ``batch_state`` lets a caller (MetricCollection) supply the batch-local
+        state from a shared update pass instead of recomputing it here.
         """
-        batch_state = self.apply_update(self.init_state(), *args, **kwargs)
+        if batch_state is None:
+            batch_state = self.apply_update(self.init_state(), *args, **kwargs)
         value = self.apply_compute(
             batch_state, axis_name=axis_name if (self.dist_sync_on_step and axis_name is not None) else None
         )
@@ -249,6 +257,38 @@ class Metric(ABC):
         else:
             new_state = self.apply_update(state, *args, **kwargs)
         return new_state, value
+
+    def _shared_update_key(self) -> Optional[Tuple]:
+        """Hashable key identifying metrics whose per-batch update computes the
+        same partial statistics (``None`` = not shareable). MetricCollection
+        computes the statistics once per key and fans the deltas out — the
+        "shared stat-scores state" staging of the reference's
+        Accuracy+Precision+Recall+F1 collection (``collections.py`` keeps
+        fully private states; see SURVEY §3.3).
+
+        Opting in (returning a key) requires implementing the companion
+        protocol: :meth:`_batch_deltas` (the shareable computation) and
+        :meth:`_accumulate` (apply precomputed deltas to the live states)."""
+        return None
+
+    def _batch_deltas(self, *args: Any, **kwargs: Any) -> Tuple:
+        """This batch's partial statistics — the shareable part of ``update``."""
+        raise NotImplementedError(
+            f"{self.__class__.__name__} returns a _shared_update_key but does not implement _batch_deltas"
+        )
+
+    def _accumulate(self, *deltas: Any) -> None:
+        """Apply precomputed :meth:`_batch_deltas` output to the live states."""
+        raise NotImplementedError(
+            f"{self.__class__.__name__} returns a _shared_update_key but does not implement _accumulate"
+        )
+
+    def _apply_accumulate(self, state: StateDict, deltas: Tuple) -> StateDict:
+        """Pure analogue of :meth:`_accumulate`: state advanced by precomputed deltas."""
+        with compiled_scope(f"{self.__class__.__name__}.update"):
+            with self._bound_state({k: (list(v) if isinstance(v, list) else v) for k, v in state.items()}):
+                self._accumulate(*deltas)
+                return self._get_states()
 
     def _states_mergeable(self) -> bool:
         if not self._fusable:
@@ -301,10 +341,15 @@ class Metric(ABC):
                 return self._forward_fused(*args, **kwargs)
             return self._forward_double_update(*args, **kwargs)
 
-    def _forward_fused(self, *args: Any, **kwargs: Any) -> Any:
+    def _forward_fused(self, *args: Any, _update_thunk: Optional[Callable] = None, **kwargs: Any) -> Any:
         accumulated = self._get_states()
         self._set_states(self.init_state())
-        self._unwrapped_update(*args, **kwargs)  # single update pass: batch-local state
+        # single update pass: batch-local state (the thunk lets MetricCollection
+        # substitute precomputed shared deltas for the full update)
+        if _update_thunk is None:
+            self._unwrapped_update(*args, **kwargs)
+        else:
+            _update_thunk()
         self._update_called = True
         self._computed = None
 

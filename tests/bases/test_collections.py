@@ -96,3 +96,147 @@ def test_collection_len_iter_contains():
     assert len(collection) == 2
     assert "DummyMetricSum" in collection
     assert set(iter(collection)) == {"DummyMetricSum", "DummyMetricDiff"}
+
+
+def test_shared_stat_scores_update_dedup(monkeypatch):
+    """Precision/Recall/F1 with identical stat-scores settings must run ONE
+    shared canonicalization + stat-scores pass per batch, with states equal
+    to the unshared per-metric path."""
+    import metrics_tpu.classification.stat_scores as ss_mod
+    from metrics_tpu import F1, Precision, Recall
+
+    calls = {"n": 0}
+    real = ss_mod._stat_scores_update
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(ss_mod, "_stat_scores_update", counting)
+
+    rng = np.random.RandomState(5)
+    preds = jnp.asarray(rng.rand(64, 4).astype(np.float32))
+    preds = preds / preds.sum(-1, keepdims=True)
+    target = jnp.asarray(rng.randint(0, 4, 64))
+
+    make = lambda: [
+        Precision(average="macro", num_classes=4),
+        Recall(average="macro", num_classes=4),
+        F1(average="macro", num_classes=4),
+    ]
+
+    shared = MetricCollection(make())
+    shared.update(preds, target)
+    assert calls["n"] == 1  # one pass for all three metrics
+
+    calls["n"] = 0
+    loose = make()
+    for m in loose:
+        m.update(preds, target)
+    assert calls["n"] == 3
+
+    for m_shared, m_loose in zip(shared.values(), loose):
+        for s in ("tp", "fp", "tn", "fn"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(m_shared, s)), np.asarray(getattr(m_loose, s))
+            )
+    shared.compute()  # must not raise on the shared states
+
+    # pure path: same dedup, same states
+    calls["n"] = 0
+    pure = MetricCollection(make())
+    state = pure.apply_update(pure.init_state(), preds, target)
+    assert calls["n"] == 1
+    for name, m_loose in zip(("Precision", "Recall", "F1"), loose):
+        for s in ("tp", "fp", "tn", "fn"):
+            np.testing.assert_array_equal(np.asarray(state[name][s]), np.asarray(getattr(m_loose, s)))
+
+
+def test_shared_update_respects_differing_configs(monkeypatch):
+    """Metrics with different stat-scores settings must NOT share."""
+    import metrics_tpu.classification.stat_scores as ss_mod
+    from metrics_tpu import Precision, Recall
+
+    calls = {"n": 0}
+    real = ss_mod._stat_scores_update
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(ss_mod, "_stat_scores_update", counting)
+
+    collection = MetricCollection(
+        {
+            "p_macro": Precision(average="macro", num_classes=3),
+            "r_micro": Recall(average="micro"),
+        }
+    )
+    preds = jnp.asarray([0, 1, 2, 1])
+    target = jnp.asarray([0, 2, 2, 1])
+    collection.update(preds, target)
+    assert calls["n"] == 2  # different keys -> separate passes
+
+
+def test_shared_update_forward_values_match_individual():
+    """Collection forward/apply_forward step values are unchanged by sharing."""
+    from metrics_tpu import F1, Precision, Recall
+
+    rng = np.random.RandomState(6)
+    preds = jnp.asarray(rng.rand(32, 3).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 3, 32))
+
+    collection = MetricCollection(
+        [
+            Precision(average="macro", num_classes=3),
+            Recall(average="macro", num_classes=3),
+            F1(average="macro", num_classes=3),
+        ]
+    )
+    state = collection.init_state()
+    state, vals = collection.apply_forward(state, preds, target)
+
+    for cls, key in ((Precision, "Precision"), (Recall, "Recall"), (F1, "F1")):
+        solo = cls(average="macro", num_classes=3)
+        expected = solo(preds, target)
+        np.testing.assert_allclose(np.asarray(vals[key]), np.asarray(expected), atol=1e-7)
+        np.testing.assert_allclose(
+            np.asarray(collection.apply_compute(state)[key]), np.asarray(solo.compute()), atol=1e-7
+        )
+
+
+def test_shared_update_eager_forward_dedup(monkeypatch):
+    """The eager `collection(preds, target)` path must also run one shared
+    stat-scores pass, with step values equal to standalone metrics."""
+    import metrics_tpu.classification.stat_scores as ss_mod
+    from metrics_tpu import F1, Precision, Recall
+
+    calls = {"n": 0}
+    real = ss_mod._stat_scores_update
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(ss_mod, "_stat_scores_update", counting)
+
+    rng = np.random.RandomState(7)
+    preds = jnp.asarray(rng.rand(48, 3).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 3, 48))
+
+    collection = MetricCollection(
+        [
+            Precision(average="macro", num_classes=3),
+            Recall(average="macro", num_classes=3),
+            F1(average="macro", num_classes=3),
+        ]
+    )
+    vals = collection(preds, target)
+    assert calls["n"] == 1
+
+    for cls, key in ((Precision, "Precision"), (Recall, "Recall"), (F1, "F1")):
+        solo = cls(average="macro", num_classes=3)
+        np.testing.assert_allclose(np.asarray(vals[key]), np.asarray(solo(preds, target)), atol=1e-7)
+        np.testing.assert_allclose(
+            np.asarray(collection.compute()[key]), np.asarray(solo.compute()), atol=1e-7
+        )
