@@ -15,7 +15,7 @@ from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Union
 
 from metrics_tpu.metric import Metric, StateDict
 from metrics_tpu.utilities.prints import rank_zero_warn
-from metrics_tpu.utilities.profiling import compiled_scope
+from metrics_tpu.utilities.profiling import compiled_scope, eager_span
 
 
 class MetricCollection:
@@ -70,9 +70,12 @@ class MetricCollection:
         for name, m in self.items(keep_base=True):
             deltas = shared.get(name)
             if deltas is not None and m._states_mergeable():
-                out[self._set_name(name)] = m._forward_fused(
-                    *args, _update_thunk=lambda m=m, d=deltas: m._accumulate(*d), **m._filter_kwargs(**kwargs)
-                )
+                with eager_span(f"{type(m).__name__}.forward"):
+                    out[self._set_name(name)] = m._forward_fused(
+                        *args,
+                        _update_thunk=lambda m=m, d=deltas: m._update_from_deltas(*d),
+                        **m._filter_kwargs(**kwargs),
+                    )
             else:
                 out[self._set_name(name)] = m(*args, **m._filter_kwargs(**kwargs))
         return out
@@ -81,10 +84,7 @@ class MetricCollection:
         shared = self._shared_deltas(*args, **kwargs)
         for name, m in self.items(keep_base=True):
             if name in shared:
-                # bookkeeping normally done by the _wrap_update wrapper
-                m._computed = None
-                m._update_called = True
-                m._accumulate(*shared[name])
+                m._update_from_deltas(*shared[name])
             else:
                 m.update(*args, **m._filter_kwargs(**kwargs))
 

@@ -283,6 +283,13 @@ class Metric(ABC):
             f"{self.__class__.__name__} returns a _shared_update_key but does not implement _accumulate"
         )
 
+    def _update_from_deltas(self, *deltas: Any) -> None:
+        """``update`` by precomputed deltas, with the same cache bookkeeping
+        as the :meth:`_wrap_update` wrapper."""
+        self._computed = None
+        self._update_called = True
+        self._accumulate(*deltas)
+
     def _apply_accumulate(self, state: StateDict, deltas: Tuple) -> StateDict:
         """Pure analogue of :meth:`_accumulate`: state advanced by precomputed deltas."""
         with compiled_scope(f"{self.__class__.__name__}.update"):

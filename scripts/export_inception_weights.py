@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Convert a torchvision ``Inception3`` checkpoint into the flattened ``.npz``
+the Flax extractor loads directly.
+
+Usage::
+
+    python scripts/export_inception_weights.py inception_v3.pth weights.npz
+    export METRICS_TPU_INCEPTION_WEIGHTS=weights.npz   # FID/KID/IS default path
+
+The mapping (``metrics_tpu/image/inception_net.py:_torchvision_name_map``) is
+validated by a round-trip test in ``tests/image/test_fid_kid_is.py``; this
+script just applies it ahead of time so runtime weight loading needs neither
+torch nor the transpose pass.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("checkpoint", help="torchvision Inception3 state_dict (.pth/.pt)")
+    parser.add_argument("output", help="output .npz path")
+    args = parser.parse_args()
+
+    import torch
+
+    from metrics_tpu.image.inception_net import _torchvision_name_map
+
+    state = torch.load(args.checkpoint, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+
+    flat = {}
+    missing = []
+    for flax_key, torch_key in _torchvision_name_map().items():
+        if torch_key not in state:
+            missing.append(torch_key)
+            continue
+        tensor = np.asarray(state[torch_key])
+        if flax_key.endswith("Conv_0/kernel"):
+            tensor = tensor.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        elif flax_key.endswith("Dense_0/kernel"):
+            tensor = tensor.transpose(1, 0)
+        flat[flax_key] = tensor
+
+    if missing:
+        print(f"error: checkpoint is missing {len(missing)} expected keys, e.g. {missing[:3]}", file=sys.stderr)
+        return 1
+
+    np.savez(args.output, **flat)
+    print(f"wrote {len(flat)} arrays to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

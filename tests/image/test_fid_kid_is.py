@@ -260,6 +260,23 @@ class TestInceptionNet:
         direct = InceptionFeatureExtractor(feature="logits_unbiased", allow_random_weights=True, rng_seed=0)
         np.testing.assert_allclose(np.asarray(from_ckpt), np.asarray(direct(imgs)), atol=1e-4)
 
+        # the export script converts the same checkpoint to .npz, and the
+        # extractor's npz loader must produce identical outputs
+        import pathlib
+        import subprocess
+        import sys as _sys
+
+        script = pathlib.Path(__file__).resolve().parents[2] / "scripts" / "export_inception_weights.py"
+        npz_path = str(tmp_path / "weights.npz")
+        result = subprocess.run(
+            [_sys.executable, str(script), path, npz_path],
+            capture_output=True,
+            text=True,
+        )
+        assert result.returncode == 0, result.stderr
+        from_npz = InceptionFeatureExtractor(feature="logits_unbiased", weights_path=npz_path)(imgs)
+        np.testing.assert_allclose(np.asarray(from_npz), np.asarray(from_ckpt), atol=1e-6)
+
     def test_torchvision_name_map_is_complete(self, variables_and_taps):
         from metrics_tpu.image.inception_net import _torchvision_name_map
 

@@ -1,0 +1,102 @@
+"""BootStrapper parity (reference pattern: ``tests/wrappers/test_bootstrapping.py``
+— a capturing subclass records each copy's resampled stream so the bootstrap
+statistics can be cross-checked against sklearn on the recorded streams)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_score, recall_score
+
+from metrics_tpu import Precision, Recall
+from metrics_tpu.utilities.data import apply_to_collection
+from metrics_tpu.wrappers.bootstrapping import BootStrapper, _bootstrap_sampler
+
+_rng = np.random.RandomState(9)
+_preds = _rng.randint(0, 10, (10, 32))
+_target = _rng.randint(0, 10, (10, 32))
+
+
+class _CapturingBootStrapper(BootStrapper):
+    """Records the resampled inputs each child copy saw."""
+
+    def update(self, *args):
+        self.out = []
+        for idx in range(self.num_bootstraps):
+            size = len(args[0])
+            sample_idx = _bootstrap_sampler(size, self._next_key(), sampling_strategy=self.sampling_strategy)
+            new_args = apply_to_collection(args, (jax.Array, np.ndarray), jnp.take, sample_idx, axis=0)
+            self.metrics[idx].update(*new_args)
+            self.out.append(new_args)
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+def test_bootstrap_sampler(sampling_strategy):
+    """New samples consist only of old samples, some repeated, some dropped."""
+    old_samples = _rng.randn(20, 2)
+    idx = np.asarray(_bootstrap_sampler(20, jax.random.PRNGKey(0), sampling_strategy=sampling_strategy))
+    assert ((0 <= idx) & (idx < 20)).all()
+    new_samples = old_samples[idx]
+    for ns in new_samples:
+        assert any((ns == os).all() for os in old_samples)
+    counts = np.bincount(idx, minlength=20)
+    assert (counts > 1).any(), "no sample was drawn twice"
+    assert (counts == 0).any(), "every sample was drawn — not a resample"
+
+
+def test_bootstrap_sampler_reproducible():
+    key = jax.random.PRNGKey(5)
+    a = np.asarray(_bootstrap_sampler(16, key))
+    b = np.asarray(_bootstrap_sampler(16, key))
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+@pytest.mark.parametrize(
+    "metric_cls, sk_metric", [(Precision, precision_score), (Recall, recall_score)]
+)
+def test_bootstrap(sampling_strategy, metric_cls, sk_metric):
+    """Each copy's value must equal sklearn on its recorded stream; the
+    aggregate stats must equal numpy over the per-copy scores."""
+    bootstrapper = _CapturingBootStrapper(
+        metric_cls(average="micro"),
+        num_bootstraps=10,
+        mean=True,
+        std=True,
+        raw=True,
+        quantile=jnp.asarray([0.05, 0.95]),
+        sampling_strategy=sampling_strategy,
+        seed=11,
+    )
+
+    collected_preds = [[] for _ in range(10)]
+    collected_target = [[] for _ in range(10)]
+    for p, t in zip(_preds, _target):
+        bootstrapper.update(jnp.asarray(p), jnp.asarray(t))
+        for i, o in enumerate(bootstrapper.out):
+            collected_preds[i].append(np.asarray(o[0]))
+            collected_target[i].append(np.asarray(o[1]))
+
+    sk_scores = [
+        sk_metric(np.concatenate(ct), np.concatenate(cp), average="micro")
+        for ct, cp in zip(collected_target, collected_preds)
+    ]
+
+    output = bootstrapper.compute()
+    np.testing.assert_allclose(np.asarray(output["mean"]), np.mean(sk_scores), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(output["std"]), np.std(sk_scores, ddof=1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(output["raw"]), sk_scores, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(output["quantile"][0]), np.quantile(sk_scores, 0.05), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(output["quantile"][1]), np.quantile(sk_scores, 0.95), atol=1e-6)
+
+
+def test_bootstrap_reset_and_invalid_args():
+    strapper = BootStrapper(Precision(average="micro"), num_bootstraps=4)
+    strapper.update(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 1, 0, 1]))
+    strapper.reset()
+    for child in strapper.metrics:
+        assert float(child.tp) == 0.0
+
+    with pytest.raises(ValueError, match="base metric"):
+        BootStrapper(lambda x: x)
+    with pytest.raises(ValueError, match="sampling_strategy"):
+        BootStrapper(Precision(), sampling_strategy="jackknife")
