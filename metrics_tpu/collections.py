@@ -73,7 +73,7 @@ class MetricCollection:
                 with eager_span(f"{type(m).__name__}.forward"):
                     out[self._set_name(name)] = m._forward_fused(
                         *args,
-                        _update_thunk=lambda m=m, d=deltas: m._update_from_deltas(*d),
+                        _update_thunk=lambda m=m, d=deltas: m._accumulate(*d),
                         **m._filter_kwargs(**kwargs),
                     )
             else:

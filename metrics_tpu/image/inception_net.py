@@ -279,18 +279,31 @@ class InceptionFeatureExtractor:
         import torch
 
         state = torch.load(path, map_location="cpu", weights_only=True)
-        if hasattr(state, "state_dict"):
-            state = state.state_dict()
-        flat = {}
-        torch_names = _torchvision_name_map()
-        for flax_key, torch_key in torch_names.items():
-            tensor = np.asarray(state[torch_key])
-            if flax_key.endswith("Conv_0/kernel"):
-                tensor = tensor.transpose(2, 3, 1, 0)  # OIHW -> HWIO
-            elif flax_key.endswith("Dense_0/kernel"):
-                tensor = tensor.transpose(1, 0)
-            flat[flax_key] = tensor
-        return _unflatten_params(flat)
+        return _unflatten_params(torch_state_dict_to_flat(state))
+
+
+def torch_state_dict_to_flat(state: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """torchvision ``Inception3`` state_dict -> flat Flax param dict.
+
+    The single source of truth for the name map and layout transposes; used
+    by the runtime loader and ``scripts/export_inception_weights.py`` alike.
+    Raises ``KeyError`` listing the missing checkpoint keys if any.
+    """
+    flat = {}
+    missing = []
+    for flax_key, torch_key in _torchvision_name_map().items():
+        if torch_key not in state:
+            missing.append(torch_key)
+            continue
+        tensor = np.asarray(state[torch_key])
+        if flax_key.endswith("Conv_0/kernel"):
+            tensor = tensor.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        elif flax_key.endswith("Dense_0/kernel"):
+            tensor = tensor.transpose(1, 0)
+        flat[flax_key] = tensor
+    if missing:
+        raise KeyError(f"checkpoint is missing {len(missing)} expected keys, e.g. {missing[:3]}")
+    return flat
 
 
 def _unflatten_params(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:

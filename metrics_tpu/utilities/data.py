@@ -73,6 +73,8 @@ def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
     label on the host (eager only).
     """
     label_tensor = jnp.asarray(label_tensor)
+    if label_tensor.dtype == jnp.bool_:
+        label_tensor = label_tensor.astype(jnp.int32)
     if num_classes is None:
         num_classes = int(np.asarray(jnp.max(label_tensor)).item()) + 1
     onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=label_tensor.dtype)

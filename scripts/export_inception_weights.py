@@ -29,27 +29,13 @@ def main() -> int:
 
     import torch
 
-    from metrics_tpu.image.inception_net import _torchvision_name_map
+    from metrics_tpu.image.inception_net import torch_state_dict_to_flat
 
     state = torch.load(args.checkpoint, map_location="cpu", weights_only=True)
-    if hasattr(state, "state_dict"):
-        state = state.state_dict()
-
-    flat = {}
-    missing = []
-    for flax_key, torch_key in _torchvision_name_map().items():
-        if torch_key not in state:
-            missing.append(torch_key)
-            continue
-        tensor = np.asarray(state[torch_key])
-        if flax_key.endswith("Conv_0/kernel"):
-            tensor = tensor.transpose(2, 3, 1, 0)  # OIHW -> HWIO
-        elif flax_key.endswith("Dense_0/kernel"):
-            tensor = tensor.transpose(1, 0)
-        flat[flax_key] = tensor
-
-    if missing:
-        print(f"error: checkpoint is missing {len(missing)} expected keys, e.g. {missing[:3]}", file=sys.stderr)
+    try:
+        flat = torch_state_dict_to_flat(state)
+    except KeyError as err:
+        print(f"error: {err}", file=sys.stderr)
         return 1
 
     np.savez(args.output, **flat)

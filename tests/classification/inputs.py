@@ -61,6 +61,16 @@ _multidim_multiclass_inputs = Input(
     target=_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
 )
 
+_multilabel_multidim_prob_inputs = Input(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM),
+    target=_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+_multilabel_multidim_inputs = Input(
+    preds=_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+    target=_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
 # adversarial case: no predictions match targets
 __temp_preds = _rng.randint(1, 2, (NUM_BATCHES, BATCH_SIZE))
 _no_match_inputs = Input(

@@ -1,0 +1,254 @@
+"""Input canonicalization matrix — port of the reference's
+``tests/classification/test_inputs.py``: every (case, num_classes,
+multiclass, top_k) combination of ``_input_format_classification`` checked
+against explicitly constructed expected outputs, plus the error matrix."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.data import select_topk, to_onehot
+from metrics_tpu.utilities.enums import DataType
+from tests.classification.inputs import (
+    Input,
+    _binary_inputs as _bin,
+    _binary_prob_inputs as _bin_prob,
+    _multiclass_inputs as _mc,
+    _multiclass_prob_inputs as _mc_prob,
+    _multidim_multiclass_inputs as _mdmc,
+    _multidim_multiclass_prob_inputs as _mdmc_prob,
+    _multilabel_inputs as _ml,
+    _multilabel_multidim_inputs as _mlmd,
+    _multilabel_multidim_prob_inputs as _mlmd_prob,
+    _multilabel_prob_inputs as _ml_prob,
+)
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_CLASSES, THRESHOLD
+
+_rng = np.random.RandomState(13)
+
+# additional special-case fixtures (reference test_inputs.py:38-54)
+_ml_prob_half = Input(_ml_prob.preds.astype(np.float16), _ml_prob.target)
+
+_mc_prob_2cls_preds = _rng.rand(2, BATCH_SIZE, 2)
+_mc_prob_2cls_preds /= _mc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mc_prob_2cls = Input(_mc_prob_2cls_preds, _rng.randint(0, 2, (2, BATCH_SIZE)))
+
+_mdmc_prob_many_dims_preds = _rng.rand(2, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM, EXTRA_DIM)
+_mdmc_prob_many_dims_preds /= _mdmc_prob_many_dims_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_many_dims = Input(
+    _mdmc_prob_many_dims_preds, _rng.randint(0, 2, (2, BATCH_SIZE, EXTRA_DIM, EXTRA_DIM))
+)
+
+_mdmc_prob_2cls_preds = _rng.rand(2, BATCH_SIZE, 2, EXTRA_DIM)
+_mdmc_prob_2cls_preds /= _mdmc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_2cls = Input(_mdmc_prob_2cls_preds, _rng.randint(0, 2, (2, BATCH_SIZE, EXTRA_DIM)))
+
+
+# expected-output transforms (numpy/jnp mirrors of the reference helpers)
+def _idn(x):
+    return jnp.asarray(x)
+
+
+def _usq(x):
+    return jnp.asarray(x)[..., None]
+
+
+def _thrs(x):
+    return jnp.asarray(x) >= THRESHOLD
+
+
+def _rshp1(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], -1)
+
+
+def _rshp2(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], x.shape[1], -1)
+
+
+def _onehot(x):
+    return to_onehot(jnp.asarray(x), NUM_CLASSES)
+
+
+def _onehot2(x):
+    return to_onehot(jnp.asarray(x), 2)
+
+
+def _top1(x):
+    return select_topk(jnp.asarray(x), 1)
+
+
+def _top2(x):
+    return select_topk(jnp.asarray(x), 2)
+
+
+def _ml_preds_tr(x):
+    return _rshp1(_thrs(x))
+
+
+def _onehot_rshp1(x):
+    return _onehot(_rshp1(x))
+
+
+def _onehot2_rshp1(x):
+    return _onehot2(_rshp1(x))
+
+
+def _top1_rshp2(x):
+    return _top1(_rshp2(x))
+
+
+def _top2_rshp2(x):
+    return _top2(_rshp2(x))
+
+
+def _probs_to_mc_preds_tr(x):
+    return _onehot2(_thrs(x))
+
+
+def _mlmd_prob_to_mc_preds_tr(x):
+    return _onehot2(_rshp1(_thrs(x)))
+
+
+@pytest.mark.parametrize(
+    "inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target",
+    [
+        # usual expected cases (reference test_inputs.py:125-147)
+        (_bin, None, False, None, "multi-class", _usq, _usq),
+        (_bin, 1, False, None, "multi-class", _usq, _usq),
+        (_bin_prob, None, None, None, "binary", lambda x: _usq(_thrs(x)), _usq),
+        (_ml_prob, None, None, None, "multi-label", _thrs, _idn),
+        (_ml, None, False, None, "multi-dim multi-class", _idn, _idn),
+        (_ml_prob, None, None, 2, "multi-label", _top2, _rshp1),
+        (_mlmd, None, False, None, "multi-dim multi-class", _rshp1, _rshp1),
+        (_mc, NUM_CLASSES, None, None, "multi-class", _onehot, _onehot),
+        (_mc_prob, None, None, None, "multi-class", _top1, _onehot),
+        (_mc_prob, None, None, 2, "multi-class", _top2, _onehot),
+        (_mdmc, NUM_CLASSES, None, None, "multi-dim multi-class", _onehot, _onehot),
+        (_mdmc_prob, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot),
+        (_mdmc_prob, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot),
+        (_mdmc_prob_many_dims, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot_rshp1),
+        (_mdmc_prob_many_dims, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot_rshp1),
+        # special cases (reference test_inputs.py:148-170)
+        # half precision is upcast before thresholding
+        (_ml_prob_half, None, None, None, "multi-label", lambda x: _ml_preds_tr(np.asarray(x, np.float32)), _rshp1),
+        # binary as multiclass
+        (_bin, None, None, None, "multi-class", _onehot2, _onehot2),
+        # binary probs as multiclass
+        (_bin_prob, None, True, None, "binary", _probs_to_mc_preds_tr, _onehot2),
+        # multilabel as multiclass
+        (_ml, None, True, None, "multi-dim multi-class", _onehot2, _onehot2),
+        # multilabel probs as multiclass
+        (_ml_prob, None, True, None, "multi-label", _probs_to_mc_preds_tr, _onehot2),
+        # multidim multilabel as multiclass
+        (_mlmd, None, True, None, "multi-dim multi-class", _onehot2_rshp1, _onehot2_rshp1),
+        # multidim multilabel probs as multiclass
+        (_mlmd_prob, None, True, None, "multi-label", _mlmd_prob_to_mc_preds_tr, _onehot2_rshp1),
+        # multiclass probs with 2 classes as binary
+        (_mc_prob_2cls, None, False, None, "multi-class", lambda x: _top1(x)[:, [1]], _usq),
+        # multidim multiclass with 2 classes as multilabel
+        (_mdmc_prob_2cls, None, False, None, "multi-dim multi-class", lambda x: _top1(x)[:, 1], _idn),
+    ],
+)
+def test_usual_cases(inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target):
+    def _case(preds_in, target_in):
+        preds_out, target_out, mode = _input_format_classification(
+            preds=jnp.asarray(preds_in),
+            target=jnp.asarray(target_in),
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            top_k=top_k,
+        )
+        assert mode == exp_mode
+        np.testing.assert_array_equal(
+            np.asarray(preds_out), np.asarray(post_preds(preds_in)).astype(np.int32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(target_out), np.asarray(post_target(target_in)).astype(np.int32)
+        )
+
+    _case(inputs.preds[0], inputs.target[0])
+    # batch_size = 1 must behave identically (squeeze rules)
+    _case(inputs.preds[0][[0], ...], inputs.target[0][[0], ...])
+
+
+def test_threshold():
+    target = jnp.asarray([1, 1, 1])
+    preds_probs = jnp.asarray([0.5 - 1e-5, 0.5, 0.5 + 1e-5])
+    preds_out, _, _ = _input_format_classification(preds_probs, target, threshold=0.5)
+    np.testing.assert_array_equal(np.asarray(preds_out).squeeze(), [0, 1, 1])
+
+
+def _ri(*shape, low=0, high=2):
+    return _rng.randint(low, high, shape)
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass",
+    [
+        (_ri(7), _ri(7).astype(float), None, None),  # target not integer
+        (_ri(7), -_ri(7), None, None),  # target negative
+        (-_ri(7), _ri(7), None, None),  # preds negative integers
+        (_rng.rand(7), _ri(7, low=2, high=4), None, False),  # multiclass=False, target > 1
+        (_ri(7, low=2, high=4), _ri(7), None, False),  # multiclass=False, int preds > 1
+        (_ri(8), _ri(7), None, None),  # wrong batch size
+        (_ri(7), _ri(7, 4), None, None),  # completely wrong shape
+        (_ri(7, 3), _ri(7, 4), None, None),  # same ndim, different shape
+        (_rng.rand(7, 3), _ri(7, 3, low=2, high=4), None, None),  # float preds, non-binary target
+        (_rng.rand(7, 3, 4, 3), _ri(7, 3, 3, high=4), None, None),  # C not in dim 1
+        (_ri(7, 3, 3, 4), _ri(7, 3, 3, high=4), None, None),  # extra dim but int preds
+        (_mc_prob.preds[0], _ri(BATCH_SIZE), None, False),  # multiclass=False, C > 2
+        (_mc_prob.preds[0], _ri(BATCH_SIZE, low=NUM_CLASSES + 1, high=100), None, None),  # target >= C
+        (_mc_prob.preds[0], _mc_prob.target[0], NUM_CLASSES + 1, None),  # C != num_classes
+        (_ri(7, 3, high=4), _ri(7, 3, low=5, high=7), 4, None),  # target > num_classes
+        (_ri(7, 3, low=5, high=7), _ri(7, 3, high=4), 4, None),  # preds > num_classes
+        (_ri(7), _ri(7), 1, None),  # num_classes=1 without multiclass=False
+        (_ri(7, 3, 3), _ri(7, 3, 3), 4, False),  # implied class dim != num_classes
+        (_rng.rand(7, 3, 3), _ri(7, 3, 3), 4, False),  # ml with implied dim != num_classes
+        (_rng.rand(7, 3), _ri(7, 3), 4, True),  # ml multiclass=True but num_classes != 2
+        (_rng.rand(7), _ri(7), 4, None),  # binary, num_classes > 2
+        (_rng.rand(7), _ri(7), 2, None),  # binary, num_classes=2 without multiclass=True
+        (_rng.rand(7), _ri(7), 2, False),
+        (_rng.rand(7), _ri(7), 1, True),  # binary, num_classes=1 with multiclass=True
+    ],
+)
+def test_incorrect_inputs(preds, target, num_classes, multiclass):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(preds),
+            target=jnp.asarray(target),
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            multiclass=multiclass,
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass, top_k",
+    [
+        (_bin.preds[0], _bin.target[0], None, None, 2),  # top_k on label data
+        (_bin_prob.preds[0], _bin_prob.target[0], None, None, 2),  # top_k on binary probs
+        (_mc.preds[0], _mc.target[0], None, None, 2),  # top_k on mc labels
+        (_ml.preds[0], _ml.target[0], None, None, 2),  # top_k on ml labels
+        (_mlmd.preds[0], _mlmd.target[0], None, None, 2),  # top_k on mlmd labels
+        (_mdmc.preds[0], _mdmc.target[0], None, None, 2),  # top_k on mdmc labels
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, None, 0),  # top_k = 0
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, None, 0.123),  # top_k float
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, False, 2),  # top_k = C with mc=False
+        (_mc_prob.preds[0], _mc_prob.target[0], None, None, NUM_CLASSES),  # top_k = C
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, 2),  # ml probs mc=True with top_k
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, NUM_CLASSES),
+    ],
+)
+def test_incorrect_inputs_topk(preds, target, num_classes, multiclass, top_k):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(preds),
+            target=jnp.asarray(target),
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            top_k=top_k,
+        )

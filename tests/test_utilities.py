@@ -1,0 +1,78 @@
+"""Utility-layer tests (reference: ``tests/test_utilities.py`` covers the
+rank-zero prints; ``tests/functional/test_reduction.py`` covers
+``reduce``/``class_reduce``; tensor-helper coverage added on top)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utilities import class_reduce, rank_zero_debug, rank_zero_info, rank_zero_warn, reduce
+from metrics_tpu.utilities.data import (
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+
+
+def test_prints():
+    rank_zero_debug("DEBUG")
+    rank_zero_info("INFO")
+    with pytest.warns(UserWarning):
+        rank_zero_warn("WARN")
+
+
+def test_reduce():
+    start = jnp.arange(50.0).reshape(5, 10)
+    np.testing.assert_allclose(np.asarray(reduce(start, "elementwise_mean")), np.mean(np.asarray(start)))
+    np.testing.assert_allclose(np.asarray(reduce(start, "sum")), np.sum(np.asarray(start)))
+    np.testing.assert_allclose(np.asarray(reduce(start, "none")), np.asarray(start))
+    with pytest.raises(ValueError):
+        reduce(start, "error_reduction")
+
+
+def test_class_reduce():
+    num = jnp.asarray([2.0, 3.0, 5.0])
+    denom = jnp.asarray([4.0, 6.0, 10.0])
+    weights = jnp.asarray([10.0, 20.0, 30.0])
+
+    np.testing.assert_allclose(np.asarray(class_reduce(num, denom, weights, "micro")), 10.0 / 20.0)
+    np.testing.assert_allclose(np.asarray(class_reduce(num, denom, weights, "macro")), 0.5)
+    np.testing.assert_allclose(
+        np.asarray(class_reduce(num, denom, weights, "weighted")),
+        np.sum(np.asarray(num / denom) * np.asarray(weights / weights.sum())),
+    )
+    np.testing.assert_allclose(np.asarray(class_reduce(num, denom, weights, "none")), [0.5, 0.5, 0.5])
+
+
+def test_class_reduce_nan_zeroing():
+    # 0/0 classes contribute 0, not NaN (parity: utilities/distributed.py:44-89)
+    num = jnp.asarray([0.0, 1.0])
+    denom = jnp.asarray([0.0, 2.0])
+    weights = jnp.asarray([0.0, 2.0])
+    out = np.asarray(class_reduce(num, denom, weights, "macro"))
+    np.testing.assert_allclose(out, (0.0 + 0.5) / 2)
+
+
+def test_onehot():
+    test_tensor = jnp.stack([jnp.arange(5), jnp.arange(5)])
+    expected = np.stack([np.eye(5, dtype=int)] * 2)  # (2, C, 5): identity per row
+    onehot = to_onehot(test_tensor, num_classes=5)
+    assert onehot.shape == (2, 5, 5)
+    np.testing.assert_array_equal(np.asarray(onehot), expected)
+    # inferred num_classes (eager)
+    np.testing.assert_array_equal(np.asarray(to_onehot(test_tensor)), expected)
+
+
+def test_onehot_bool_input():
+    out = to_onehot(jnp.asarray([True, False]), num_classes=2)
+    np.testing.assert_array_equal(np.asarray(out), [[0, 1], [1, 0]])
+
+
+def test_to_categorical():
+    probs = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+    np.testing.assert_array_equal(np.asarray(to_categorical(probs)), [1, 0])
+
+
+def test_select_topk():
+    probs = jnp.asarray([[0.1, 0.5, 0.4], [0.6, 0.1, 0.3]])
+    np.testing.assert_array_equal(np.asarray(select_topk(probs, 1)), [[0, 1, 0], [1, 0, 0]])
+    np.testing.assert_array_equal(np.asarray(select_topk(probs, 2)), [[0, 1, 1], [1, 0, 1]])
