@@ -1,0 +1,15 @@
+"""Root pytest configuration.
+
+Forces the CPU backend for any pytest invocation from the repo root — in
+particular ``pytest --doctest-modules metrics_tpu/`` (the CI doctest step),
+where per-example compiles through a remote TPU tunnel would be prohibitively
+slow. The ``tests/`` suite layers float64 and the virtual 8-device mesh on
+top via ``tests/conftest.py``.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

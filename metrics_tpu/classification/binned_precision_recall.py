@@ -60,12 +60,12 @@ class BinnedPrecisionRecallCurve(Metric):
         >>> target = jnp.asarray([0, 1, 1, 0])
         >>> pr_curve = BinnedPrecisionRecallCurve(num_classes=1, num_thresholds=5)
         >>> precision, recall, thresholds = pr_curve(pred, target)
-        >>> precision
-        Array([0.5      , 0.5      , 1.       , 1.       , 0.999999 , 1.       ],      dtype=float32)
-        >>> recall
-        Array([1. , 0.5, 0.5, 0.5, 0. , 0. ], dtype=float32)
-        >>> thresholds
-        Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32)
+        >>> print(jnp.round(precision, 2))
+        [0.5 0.5 1.  1.  1.  1. ]
+        >>> print(jnp.round(recall, 2))
+        [1.  0.5 0.5 0.5 0.  0. ]
+        >>> print(jnp.round(thresholds, 2))
+        [0.   0.25 0.5  0.75 1.  ]
     """
 
     is_differentiable = False
@@ -133,8 +133,8 @@ class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
         >>> pred = jnp.asarray([0, 1, 2, 3], dtype=jnp.float32)
         >>> target = jnp.asarray([0, 1, 1, 1])
         >>> average_precision = BinnedAveragePrecision(num_classes=1, num_thresholds=10)
-        >>> average_precision(pred, target)
-        Array(1.0000001, dtype=float32)
+        >>> print(f"{average_precision(pred, target):.2f}")
+        1.00
     """
 
     def compute(self) -> Union[List[Array], Array]:  # type: ignore[override]
@@ -151,8 +151,9 @@ class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
         >>> pred = jnp.asarray([0, 0.2, 0.5, 0.8])
         >>> target = jnp.asarray([0, 1, 1, 0])
         >>> average_precision = BinnedRecallAtFixedPrecision(num_classes=1, num_thresholds=10, min_precision=0.5)
-        >>> average_precision(pred, target)
-        (Array(1., dtype=float32), Array(0.11111111, dtype=float32))
+        >>> recall, threshold = average_precision(pred, target)
+        >>> print(f"{recall:.2f}, {threshold:.4f}")
+        1.00, 0.1111
     """
 
     def __init__(

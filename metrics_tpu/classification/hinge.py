@@ -21,8 +21,8 @@ class Hinge(Metric):
         >>> target = jnp.asarray([0, 1, 1])
         >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
         >>> hinge = Hinge()
-        >>> hinge(preds, target)
-        Array(0.3, dtype=float32)
+        >>> print(f"{hinge(preds, target):.2f}")
+        0.30
     """
 
     is_differentiable = True

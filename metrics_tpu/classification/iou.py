@@ -19,8 +19,8 @@ class IoU(ConfusionMatrix):
         >>> target = jnp.asarray([0, 1, 1, 0])
         >>> preds = jnp.asarray([0, 1, 0, 0])
         >>> iou = IoU(num_classes=2)
-        >>> iou(preds, target)
-        Array(0.5833333, dtype=float32)
+        >>> print(f"{iou(preds, target):.4f}")
+        0.5833
     """
 
     is_differentiable = False

@@ -26,8 +26,8 @@ class KLDivergence(Metric):
         >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
         >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
         >>> kldivergence = KLDivergence()
-        >>> kldivergence(p, q)
-        Array(0.08540184, dtype=float32)
+        >>> print(f"{kldivergence(p, q):.3f}")
+        0.085
     """
 
     is_differentiable = True

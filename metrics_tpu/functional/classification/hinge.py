@@ -104,8 +104,8 @@ def hinge(
         >>> from metrics_tpu.functional import hinge
         >>> target = jnp.asarray([0, 1, 1])
         >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
-        >>> hinge(preds, target)
-        Array(0.3, dtype=float32)
+        >>> print(f"{hinge(preds, target):.2f}")
+        0.30
     """
     measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
     return _hinge_compute(measure, total)

@@ -48,8 +48,8 @@ def iou(
         >>> from metrics_tpu.functional import iou
         >>> target = jnp.asarray([0, 1, 1, 0])
         >>> preds = jnp.asarray([0, 1, 0, 0])
-        >>> iou(preds, target, num_classes=2)
-        Array(0.5833333, dtype=float32)
+        >>> print(f"{iou(preds, target, num_classes=2):.4f}")
+        0.5833
     """
     num_classes = get_num_classes(preds=preds, target=target, num_classes=num_classes)
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold)

@@ -52,8 +52,8 @@ def kldivergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional
         >>> from metrics_tpu.functional import kldivergence
         >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
         >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
-        >>> kldivergence(p, q)
-        Array(0.08540184, dtype=float32)
+        >>> print(f"{kldivergence(p, q):.3f}")
+        0.085
     """
     measures, total = _kld_update(p, q, log_prob)
     return _kld_compute(measures, total, reduction)

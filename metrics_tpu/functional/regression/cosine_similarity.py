@@ -33,8 +33,8 @@ def cosine_similarity(preds: Array, target: Array, reduction: str = "sum") -> Ar
         >>> from metrics_tpu.functional import cosine_similarity
         >>> target = jnp.asarray([[1., 2, 3, 4], [1., 2, 3, 4]])
         >>> preds = jnp.asarray([[1., 2, 3, 4], [-1., -2, -3, -4]])
-        >>> cosine_similarity(preds, target, 'none')
-        Array([ 1., -1.], dtype=float32)
+        >>> print(jnp.round(cosine_similarity(preds, target, 'none'), 4))
+        [ 1. -1.]
     """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)

@@ -29,8 +29,8 @@ def mean_squared_log_error(preds: Array, target: Array) -> Array:
         >>> from metrics_tpu.functional import mean_squared_log_error
         >>> x = jnp.asarray([0., 1, 2, 3])
         >>> y = jnp.asarray([0., 1, 2, 2])
-        >>> mean_squared_log_error(x, y)
-        Array(0.02068, dtype=float32)
+        >>> print(f"{mean_squared_log_error(x, y):.4f}")
+        0.0207
     """
     sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
     return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

@@ -42,8 +42,8 @@ def pearson_corrcoef(preds: Array, target: Array) -> Array:
         >>> from metrics_tpu.functional import pearson_corrcoef
         >>> target = jnp.asarray([3., -0.5, 2, 7])
         >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
-        >>> pearson_corrcoef(preds, target)
-        Array(0.9848697, dtype=float32)
+        >>> print(f"{pearson_corrcoef(preds, target):.4f}")
+        0.9849
     """
     preds, target = _pearson_corrcoef_update(preds, target)
     return _pearson_corrcoef_compute(preds, target)

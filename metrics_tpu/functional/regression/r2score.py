@@ -86,8 +86,8 @@ def r2score(
         >>> from metrics_tpu.functional import r2score
         >>> target = jnp.asarray([3, -0.5, 2, 7])
         >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
-        >>> r2score(preds, target)
-        Array(0.9486081, dtype=float32)
+        >>> print(f"{r2score(preds, target):.4f}")
+        0.9486
     """
     sum_squared_error, sum_error, residual, total = _r2score_update(preds, target)
     return _r2score_compute(sum_squared_error, sum_error, residual, total, adjusted, multioutput)

@@ -60,8 +60,8 @@ def spearman_corrcoef(preds: Array, target: Array) -> Array:
         >>> from metrics_tpu.functional import spearman_corrcoef
         >>> target = jnp.asarray([3., -0.5, 2, 7])
         >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
-        >>> spearman_corrcoef(preds, target)
-        Array(0.9999999, dtype=float32)
+        >>> print(f"{spearman_corrcoef(preds, target):.2f}")
+        1.00
     """
     preds, target = _spearman_corrcoef_update(preds, target)
     return _spearman_corrcoef_compute(preds, target)

@@ -41,8 +41,8 @@ def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = Non
         >>> from metrics_tpu.functional import retrieval_normalized_dcg
         >>> preds = jnp.asarray([.1, .2, .3, 4, 70])
         >>> target = jnp.asarray([10, 0, 0, 1, 5])
-        >>> retrieval_normalized_dcg(preds, target)
-        Array(0.6956907, dtype=float32)
+        >>> print(f"{retrieval_normalized_dcg(preds, target):.4f}")
+        0.6957
     """
     preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
     _check_k(k)

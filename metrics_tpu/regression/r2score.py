@@ -17,8 +17,8 @@ class R2Score(Metric):
         >>> target = jnp.asarray([3, -0.5, 2, 7])
         >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
         >>> r2score = R2Score()
-        >>> r2score(preds, target)
-        Array(0.9486081, dtype=float32)
+        >>> print(f"{r2score(preds, target):.4f}")
+        0.9486
     """
 
     is_differentiable = True

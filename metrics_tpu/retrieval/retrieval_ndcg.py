@@ -14,8 +14,8 @@ class RetrievalNormalizedDCG(RetrievalMetric):
         >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
         >>> target = jnp.asarray([False, False, True, False, True, False, True])
         >>> ndcg = RetrievalNormalizedDCG()
-        >>> ndcg(preds, target, indexes=indexes)
-        Array(0.84670985, dtype=float32)
+        >>> print(f"{ndcg(preds, target, indexes=indexes):.4f}")
+        0.8467
     """
 
     higher_is_better = True
