@@ -1,0 +1,43 @@
+"""Half-precision smoke tests for the classification stack (reference
+pattern: ``run_precision_test_cpu/gpu``, ``testers.py:416-462`` — fp16
+inputs are upcast by the canonicalization and must produce the same result
+as f32 inputs)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import accuracy, auroc, confusion_matrix, f1, precision, recall
+
+_rng = np.random.RandomState(21)
+_N, _C = 128, 5
+_probs = _rng.rand(_N, _C).astype(np.float32)
+_probs /= _probs.sum(-1, keepdims=True)
+_target = _rng.randint(0, _C, _N)
+_bin_probs = _rng.rand(_N).astype(np.float32)
+_bin_target = _rng.randint(0, 2, _N)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float16, jnp.bfloat16])
+@pytest.mark.parametrize(
+    "fn, args",
+    [
+        (accuracy, {}),
+        (precision, dict(average="macro", num_classes=_C)),
+        (recall, dict(average="macro", num_classes=_C)),
+        (f1, dict(average="macro", num_classes=_C)),
+        (confusion_matrix, dict(num_classes=_C)),
+    ],
+)
+def test_half_precision_matches_f32(dtype, fn, args):
+    full = fn(jnp.asarray(_probs), jnp.asarray(_target), **args)
+    half = fn(jnp.asarray(_probs, dtype=dtype), jnp.asarray(_target), **args)
+    # canonicalization thresholds/top-ks in f32, so int statistics may differ
+    # only where the dtype cast moved a probability across a decision boundary
+    np.testing.assert_allclose(np.asarray(half, np.float64), np.asarray(full, np.float64), atol=0.02)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float16, jnp.bfloat16])
+def test_half_precision_binary_auroc(dtype):
+    full = auroc(jnp.asarray(_bin_probs), jnp.asarray(_bin_target))
+    half = auroc(jnp.asarray(_bin_probs, dtype=dtype), jnp.asarray(_bin_target))
+    np.testing.assert_allclose(float(half), float(full), atol=0.02)
