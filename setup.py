@@ -22,9 +22,9 @@ setup(
     license=_about["__license__"],
     packages=find_packages(exclude=["tests", "tests.*"]),
     python_requires=">=3.9",
-    install_requires=["numpy", "jax", "packaging"],
+    install_requires=[line.strip() for line in open(os.path.join(_PATH_ROOT, "requirements.txt"))],
     extras_require={
-        "image": ["flax"],
-        "test": ["pytest", "scikit-learn", "scipy", "torch"],
+        name: [line.strip() for line in open(os.path.join(_PATH_ROOT, "requirements", f"{name}.txt"))]
+        for name in ("image", "test", "integrate")
     },
 )
