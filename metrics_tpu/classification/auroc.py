@@ -2,16 +2,34 @@
 
 Capability parity with the reference's ``torchmetrics/classification/
 auroc.py:26-192``: cat-reduced ``preds``/``target`` states with mode locking.
+
+TPU extension — ``capacity``: with ``AUROC(capacity=N)`` (binary only) the
+metric swaps its unbounded list states for a preallocated ``(N,)`` sample
+buffer plus a fill counter, so the whole lifecycle — update, cross-shard
+sync (one tiled ``all_gather`` + counter gather), and the masked sort-scan
+compute — runs inside a single compiled program with a step-invariant state
+structure (no per-step retracing, SURVEY hard part #1). Samples past the
+capacity are dropped (tracked by the counter; a warning is raised at eager
+compute).
 """
 from typing import Any, Callable, Optional
 
+from metrics_tpu.classification.capped_buffer import CappedBufferMixin
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.functional.classification.masked_curves import masked_binary_auroc
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array, dim_zero_cat
 
 
-class AUROC(Metric):
+class AUROC(CappedBufferMixin, Metric):
     """Area under the ROC curve over all batches.
+
+    Args:
+        capacity: when set (binary inputs only), accumulate into a fixed-size
+            ``(capacity,)`` buffer instead of unbounded lists — the state
+            structure is step-invariant, so the metric lives inside ``jit``/
+            ``shard_map`` without retracing. Incompatible with ``max_fpr``
+            and multiclass ``num_classes``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -32,6 +50,7 @@ class AUROC(Metric):
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
         max_fpr: Optional[float] = None,
+        capacity: Optional[int] = None,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -47,6 +66,7 @@ class AUROC(Metric):
         self.pos_label = pos_label
         self.average = average
         self.max_fpr = max_fpr
+        self.capacity = capacity
         self.mode = None
 
         allowed_average = (None, "macro", "weighted", "micro")
@@ -58,11 +78,20 @@ class AUROC(Metric):
         if max_fpr is not None and (not isinstance(max_fpr, float) or not 0 < max_fpr <= 1):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if capacity is not None:
+            if max_fpr is not None:
+                raise ValueError("`capacity` mode does not support `max_fpr`")
+            self._init_capacity_states(capacity, num_classes, pos_label)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Append the batch scores/targets to the state."""
+        if self.capacity is not None:
+            self._buffer_update(preds, target)
+            return
+
         preds, target, mode = _auroc_update(preds, target)
         self.preds.append(preds)
         self.target.append(target)
@@ -76,6 +105,10 @@ class AUROC(Metric):
 
     def compute(self) -> Array:
         """AUROC over everything seen so far."""
+        if self.capacity is not None:
+            preds, target, valid = self._buffer_flatten()
+            return masked_binary_auroc(preds, target, valid)
+
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _auroc_compute(

@@ -1,20 +1,30 @@
 """AveragePrecision module metric.
 
 Capability parity with the reference's ``torchmetrics/classification/
-average_precision.py:28-132``.
+average_precision.py:28-132``, plus the TPU ``capacity`` extension (see
+``auroc.py``): a fixed-size sample buffer whose state structure is
+step-invariant, so the metric runs inside ``jit``/``shard_map`` without
+retracing.
 """
 from typing import Any, Callable, List, Optional, Union
 
+from metrics_tpu.classification.capped_buffer import CappedBufferMixin
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
+from metrics_tpu.functional.classification.masked_curves import masked_binary_average_precision
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array, dim_zero_cat
 
 
-class AveragePrecision(Metric):
+class AveragePrecision(CappedBufferMixin, Metric):
     """Average precision over all batches.
+
+    Args:
+        capacity: when set (binary inputs only), accumulate into a fixed-size
+            ``(capacity,)`` buffer instead of unbounded lists — usable inside
+            compiled programs without per-step retracing.
 
     Example:
         >>> import jax.numpy as jnp
@@ -33,6 +43,7 @@ class AveragePrecision(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        capacity: Optional[int] = None,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -46,12 +57,20 @@ class AveragePrecision(Metric):
         )
         self.num_classes = num_classes
         self.pos_label = pos_label
+        self.capacity = capacity
 
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if capacity is not None:
+            self._init_capacity_states(capacity, num_classes, pos_label)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Append the canonicalized batch to the state."""
+        if self.capacity is not None:
+            self._buffer_update(preds, target)
+            return
+
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label
         )
@@ -62,6 +81,10 @@ class AveragePrecision(Metric):
 
     def compute(self) -> Union[List[Array], Array]:
         """Average precision over everything seen so far."""
+        if self.capacity is not None:
+            preds, target, valid = self._buffer_flatten()
+            return masked_binary_average_precision(preds, target, valid)
+
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _average_precision_compute(preds, target, self.num_classes, self.pos_label)

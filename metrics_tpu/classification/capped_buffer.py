@@ -1,0 +1,72 @@
+"""Fixed-capacity sample buffer for the curve-scalar metrics.
+
+Backs the ``capacity=...`` mode of :class:`~metrics_tpu.AUROC` and
+:class:`~metrics_tpu.AveragePrecision`: a preallocated ``(capacity,)``
+score/label buffer plus a fill counter, giving a step-invariant state
+structure that lives inside ``jit``/``shard_map`` without retracing (the
+masked compute kernels are in ``functional/classification/masked_curves.py``).
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.auroc import _auroc_update
+from metrics_tpu.utilities.data import Array, _is_traced, dim_zero_cat
+from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+class CappedBufferMixin:
+    """State/update/mask logic shared by the fixed-capacity curve metrics."""
+
+    def _init_capacity_states(
+        self, capacity: int, num_classes: Optional[int], pos_label: Optional[int]
+    ) -> None:
+        """Validate the capacity-mode configuration and register the buffer states."""
+        if not (isinstance(capacity, int) and capacity > 0):
+            raise ValueError(f"`capacity` should be a positive integer, got: {capacity}")
+        if num_classes not in (None, 1):
+            raise ValueError("`capacity` mode supports binary inputs only; leave `num_classes` unset")
+        if pos_label not in (None, 0, 1):
+            raise ValueError(f"`capacity` mode expects `pos_label` in (0, 1), got: {pos_label}")
+        self.add_state("preds_buf", jnp.full((capacity,), -jnp.inf, jnp.float32), dist_reduce_fx="cat")
+        self.add_state("target_buf", jnp.zeros((capacity,), jnp.int32), dist_reduce_fx="cat")
+        self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="cat")
+
+    def _buffer_update(self, preds: Array, target: Array) -> None:
+        preds, target, mode = _auroc_update(preds, target)
+        if mode != DataType.BINARY:
+            raise ValueError(f"`capacity` mode supports binary inputs only, got mode {mode}")
+        pos_label = 1 if self.pos_label is None else self.pos_label
+        target = (target == pos_label).astype(jnp.int32)
+        idx = self.count + jnp.arange(preds.shape[0])
+        # writes past the capacity are dropped; the counter keeps the true total
+        self.preds_buf = self.preds_buf.at[idx].set(preds.astype(jnp.float32), mode="drop")
+        self.target_buf = self.target_buf.at[idx].set(target, mode="drop")
+        self.count = self.count + preds.shape[0]
+
+    def _buffer_flatten(self) -> Tuple[Array, Array, Array]:
+        """(flat preds, flat target, valid mask) across however many shards the
+        sync produced — scalar count = 1 shard; ``(world,)`` counts = world
+        shards of ``capacity`` samples each."""
+        preds_buf = dim_zero_cat(self.preds_buf) if isinstance(self.preds_buf, list) else self.preds_buf
+        target_buf = dim_zero_cat(self.target_buf) if isinstance(self.target_buf, list) else self.target_buf
+        count = self.count
+        if isinstance(count, list):
+            count = jnp.stack([jnp.asarray(c) for c in count])
+        counts = jnp.atleast_1d(count)
+
+        if not _is_traced(counts):
+            import numpy as np
+
+            overflow = np.asarray(jnp.maximum(counts - self.capacity, 0)).sum()
+            if overflow > 0:
+                rank_zero_warn(
+                    f"{self.__class__.__name__}(capacity={self.capacity}) dropped {int(overflow)}"
+                    " samples past the buffer capacity; the computed value covers the first"
+                    " `capacity` samples per shard.",
+                    UserWarning,
+                )
+
+        valid = (jnp.arange(self.capacity)[None, :] < jnp.clip(counts, 0, self.capacity)[:, None]).reshape(-1)
+        return preds_buf.reshape(-1), target_buf.reshape(-1), valid

@@ -1,0 +1,80 @@
+"""Fixed-shape (masked) binary curve scalars — AUROC / average precision.
+
+The list-state curve metrics trim to distinct thresholds, a data-dependent
+shape XLA cannot express (see ``precision_recall_curve.py``). But the curve
+*scalars* — AUROC and average precision — can be computed entirely with
+static shapes: keep every sorted sample as a curve point, propagate the
+cumulative counts to each point's tie-group end (so tied predictions all
+carry the group's final counts), and let duplicate points contribute
+zero-width trapezoids / zero-Δrecall terms. Invalid (padding) entries sort
+to the end with ``-inf`` scores and zero weight, adding nothing.
+
+This is what powers the ``capacity=...`` mode of :class:`~metrics_tpu.AUROC`
+and :class:`~metrics_tpu.AveragePrecision`: a preallocated sample buffer
+updated in-place under ``jit`` (no per-step retracing, pure ``all_gather`` +
+masked scan at compute) — the TPU answer to SURVEY's hard part #1.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import METRIC_EPS, Array
+
+
+def _masked_curve_points(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array]:
+    """Per-sorted-sample ``(fps, tps, pos_total)`` with tie-group-end counts.
+
+    All inputs ``(N,)``; every output position carries the cumulative counts
+    at the END of its prediction tie group, so positions inside a group are
+    exact duplicates of the group's final curve point (zero-contribution under
+    trapezoid/Δrecall sums). Padding (``valid=False``) sorts last and keeps
+    the final counts (another zero-width duplicate).
+    """
+    n = preds.shape[0]
+    score = jnp.where(valid, preds.astype(jnp.float32), -jnp.inf)
+    order = jnp.argsort(-score, stable=True)
+    score_s = score[order]
+    valid_s = valid[order]
+    pos_s = jnp.where(valid_s, (target[order] == 1).astype(jnp.float32), 0.0)
+
+    tps = jnp.cumsum(pos_s)
+    fps = jnp.cumsum(jnp.where(valid_s, 1.0 - pos_s, 0.0))
+
+    # index of each position's tie-group end: nearest j >= i where the score
+    # changes (or the array ends) — reverse cumulative minimum of end indices
+    idx = jnp.arange(n)
+    group_end = jnp.concatenate([score_s[1:] != score_s[:-1], jnp.ones((1,), bool)])
+    end_idx = jnp.where(group_end, idx, n - 1)
+    end_idx = jnp.flip(jax.lax.cummin(jnp.flip(end_idx)))
+
+    return fps[end_idx], tps[end_idx], tps[-1]
+
+
+def masked_binary_auroc(preds: Array, target: Array, valid: Array) -> Array:
+    """Binary AUROC over the valid entries — static shapes, jit/psum-safe.
+
+    Ties and padding contribute zero-width trapezoids, so the result equals
+    the distinct-threshold computation (``auroc.py``) on the valid subset.
+    """
+    fps, tps, pos_total = _masked_curve_points(preds, target, valid)
+    neg_total = jnp.sum(valid) - pos_total
+    tpr = tps / jnp.maximum(pos_total, 1.0)
+    fpr = fps / jnp.maximum(neg_total, 1.0)
+    # prepend the (0, 0) point; duplicates add zero area
+    tpr = jnp.concatenate([jnp.zeros((1,)), tpr])
+    fpr = jnp.concatenate([jnp.zeros((1,)), fpr])
+    return jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)
+
+
+def masked_binary_average_precision(preds: Array, target: Array, valid: Array) -> Array:
+    """Binary average precision over the valid entries — static shapes.
+
+    ``AP = Σ (recall_i - recall_{i-1}) · precision_i`` over descending
+    thresholds; tie-group duplicates and padding carry ``Δrecall = 0``.
+    """
+    fps, tps, pos_total = _masked_curve_points(preds, target, valid)
+    precision = tps / jnp.maximum(tps + fps, METRIC_EPS)
+    recall = tps / jnp.maximum(pos_total, 1.0)
+    recall_prev = jnp.concatenate([jnp.zeros((1,)), recall[:-1]])
+    return jnp.sum((recall - recall_prev) * precision)

@@ -126,6 +126,35 @@ def test_metrics_bitwise():
         np.testing.assert_allclose(np.asarray(metric.compute()), expected)
 
 
+def test_metrics_reflected_arithmetic():
+    first = DummyMetric(2)
+    cases = [
+        (5 // first, 5 // 2),
+        (5 % first, 5 % 2),
+        (5**first, 5**2),
+        (5 & first, 5 & 2),
+        (5 | first, 5 | 2),
+        (5 ^ first, 5 ^ 2),
+    ]
+    for metric, expected in cases:
+        metric.update()
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected)
+
+
+def test_metrics_rmatmul():
+    first = DummyMetric([2.0, 2.0, 2.0])
+    final = jnp.asarray([1.0, 2.0, 3.0]) @ first
+    final.update()
+    np.testing.assert_allclose(np.asarray(final.compute()), 12.0)
+
+
+def test_metrics_invert():
+    first = DummyMetric(5)
+    final = ~first
+    final.update()
+    np.testing.assert_allclose(np.asarray(final.compute()), ~np.int32(5))
+
+
 def test_metrics_unary():
     first = DummyMetric(-2)
     for metric, expected in [(abs(first), 2), (-first, -2), (+first, 2)]:

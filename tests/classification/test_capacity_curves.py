@@ -1,0 +1,160 @@
+"""Fixed-capacity (masked-buffer) AUROC / AveragePrecision: the jit-native
+curve-scalar path (state structure is step-invariant -> one compilation for
+every step, pure collective sync in-graph)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, roc_auc_score
+
+from metrics_tpu import AUROC, AveragePrecision
+from metrics_tpu.functional.classification.masked_curves import (
+    masked_binary_auroc,
+    masked_binary_average_precision,
+)
+from tests.conftest import NUM_DEVICES
+
+_rng = np.random.RandomState(17)
+
+
+class TestMaskedKernels:
+    @pytest.mark.parametrize("ties", [False, True])
+    def test_auroc_vs_sklearn_with_padding(self, ties):
+        n, cap = 300, 384
+        preds = _rng.rand(n)
+        if ties:
+            preds = np.round(preds, 1)  # heavy tie groups
+        target = _rng.randint(0, 2, n)
+        pp = np.full(cap, -np.inf, np.float32)
+        pp[:n] = preds
+        tt = np.zeros(cap, np.int32)
+        tt[:n] = target
+        valid = jnp.asarray(np.arange(cap) < n)
+        got = float(masked_binary_auroc(jnp.asarray(pp), jnp.asarray(tt), valid))
+        np.testing.assert_allclose(got, roc_auc_score(target, preds), atol=1e-6)
+
+    @pytest.mark.parametrize("ties", [False, True])
+    def test_ap_vs_sklearn_with_padding(self, ties):
+        n, cap = 300, 384
+        preds = _rng.rand(n)
+        if ties:
+            preds = np.round(preds, 1)
+        target = _rng.randint(0, 2, n)
+        pp = np.full(cap, -np.inf, np.float32)
+        pp[:n] = preds
+        tt = np.zeros(cap, np.int32)
+        tt[:n] = target
+        valid = jnp.asarray(np.arange(cap) < n)
+        got = float(masked_binary_average_precision(jnp.asarray(pp), jnp.asarray(tt), valid))
+        np.testing.assert_allclose(got, average_precision_score(target, preds), atol=1e-6)
+
+
+@pytest.mark.parametrize("metric_cls, sk_fn", [(AUROC, roc_auc_score), (AveragePrecision, average_precision_score)])
+class TestCapacityMode:
+    def test_matches_list_mode_and_sklearn(self, metric_cls, sk_fn):
+        preds = _rng.rand(10, 32).astype(np.float32)
+        target = _rng.randint(0, 2, (10, 32))
+        capped = metric_cls(capacity=512)
+        listed = metric_cls()
+        for i in range(10):
+            capped.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            listed.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        expected = sk_fn(target.reshape(-1), preds.reshape(-1))
+        np.testing.assert_allclose(float(capped.compute()), expected, atol=1e-6)
+        np.testing.assert_allclose(float(listed.compute()), expected, atol=1e-6)
+
+    def test_no_retrace_across_steps(self, metric_cls, sk_fn):
+        metric = metric_cls(capacity=256)
+        traces = {"n": 0}
+
+        def step(state, p, t):
+            traces["n"] += 1
+            return metric.apply_update(state, p, t)
+
+        jitted = jax.jit(step)
+        state = metric.init_state()
+        for i in range(6):
+            p = jnp.asarray(_rng.rand(32).astype(np.float32))
+            t = jnp.asarray(_rng.randint(0, 2, 32))
+            state = jitted(state, p, t)
+        assert traces["n"] == 1  # state structure is step-invariant
+
+    def test_sharded_compute_matches_sequential(self, metric_cls, sk_fn):
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        n = NUM_DEVICES * 48
+        preds = jnp.asarray(_rng.rand(n).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 2, n))
+
+        metric = metric_cls(capacity=64)
+        mesh = Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("data",))
+
+        def step(p, t):
+            state = metric.apply_update(metric.init_state(), p, t)
+            return metric.apply_compute(state, axis_name="data")
+
+        fn = jax.jit(
+            jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        )
+        value = float(
+            fn(
+                jax.device_put(preds, NamedSharding(mesh, P("data"))),
+                jax.device_put(target, NamedSharding(mesh, P("data"))),
+            )
+        )
+        expected = sk_fn(np.asarray(target), np.asarray(preds))
+        np.testing.assert_allclose(value, expected, atol=1e-6)
+
+    def test_overflow_drops_and_warns(self, metric_cls, sk_fn):
+        metric = metric_cls(capacity=64)
+        preds = _rng.rand(100).astype(np.float32)
+        target = _rng.randint(0, 2, 100)
+        metric.update(jnp.asarray(preds), jnp.asarray(target))
+        with pytest.warns(UserWarning, match="dropped"):
+            value = float(metric.compute())
+        expected = sk_fn(target[:64], preds[:64])
+        np.testing.assert_allclose(value, expected, atol=1e-6)
+
+    def test_invalid_args(self, metric_cls, sk_fn):
+        with pytest.raises(ValueError, match="capacity"):
+            metric_cls(capacity=0)
+        with pytest.raises(ValueError, match="binary"):
+            metric_cls(capacity=16, num_classes=5)
+
+    def test_reset(self, metric_cls, sk_fn):
+        metric = metric_cls(capacity=32)
+        metric.update(jnp.asarray(_rng.rand(8).astype(np.float32)), jnp.asarray(_rng.randint(0, 2, 8)))
+        metric.reset()
+        assert int(metric.count) == 0
+        assert float(metric.preds_buf[0]) == -np.inf
+
+
+@pytest.mark.parametrize(
+    "metric_cls, sk_fn", [(AUROC, roc_auc_score), (AveragePrecision, average_precision_score)]
+)
+def test_capacity_honors_pos_label_zero(metric_cls, sk_fn):
+    preds = _rng.rand(64).astype(np.float32)
+    target = _rng.randint(0, 2, 64)
+    metric = metric_cls(capacity=128, pos_label=0)
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = sk_fn(1 - target, preds)
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-6)
+
+
+def test_capacity_rejects_out_of_range_pos_label():
+    with pytest.raises(ValueError, match="pos_label"):
+        AUROC(capacity=16, pos_label=2)
+
+
+def test_auroc_capacity_rejects_max_fpr():
+    with pytest.raises(ValueError, match="max_fpr"):
+        AUROC(capacity=16, max_fpr=0.5)
+
+
+def test_capacity_rejects_multiclass_inputs():
+    metric = AUROC(capacity=16)
+    probs = _rng.rand(8, 4).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    with pytest.raises(ValueError, match="binary"):
+        metric.update(jnp.asarray(probs), jnp.asarray(_rng.randint(0, 4, 8)))
