@@ -246,3 +246,81 @@ class TestCosineSimilarity(MetricTester):
             lambda p, t: _sk_cosine(p, t, reduction=reduction),
             metric_args={"reduction": reduction},
         )
+
+
+def test_pearson_streaming_matches_buffered():
+    """streaming=True (co-moment sums, jit-native) equals the buffered mode."""
+    import jax
+
+    rng = np.random.RandomState(31)
+    streaming = PearsonCorrcoef(streaming=True)
+    buffered = PearsonCorrcoef()
+    for _ in range(6):
+        p = jnp.asarray(rng.randn(40).astype(np.float32))
+        t = jnp.asarray((rng.randn(40) * 0.5 + np.asarray(p)).astype(np.float32))
+        streaming.update(p, t)
+        buffered.update(p, t)
+    np.testing.assert_allclose(float(streaming.compute()), float(buffered.compute()), atol=1e-5)
+
+    # jit path: state structure must be step-invariant (single trace)
+    metric = PearsonCorrcoef(streaming=True)
+    traces = {"n": 0}
+
+    def step(state, p, t):
+        traces["n"] += 1
+        return metric.apply_update(state, p, t)
+
+    jitted = jax.jit(step)
+    state = metric.init_state()
+    for _ in range(4):
+        p = jnp.asarray(rng.randn(16).astype(np.float32))
+        state = jitted(state, p, p * 2)
+    assert traces["n"] == 1
+    np.testing.assert_allclose(float(metric.apply_compute(state)), 1.0, atol=1e-5)
+
+
+def test_pearson_streaming_sharded():
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.RandomState(32)
+    n = 8 * 16
+    preds = jnp.asarray(rng.randn(n).astype(np.float32))
+    target = jnp.asarray((rng.randn(n) * 0.3 + np.asarray(preds)).astype(np.float32))
+
+    metric = PearsonCorrcoef(streaming=True)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    def step(p, t):
+        state = metric.apply_update(metric.init_state(), p, t)
+        return metric.apply_compute(state, axis_name="data")
+
+    fn = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+    )
+    value = float(fn(
+        jax.device_put(preds, NamedSharding(mesh, P("data"))),
+        jax.device_put(target, NamedSharding(mesh, P("data"))),
+    ))
+    seq = metric.apply_update(metric.init_state(), preds, target)
+    np.testing.assert_allclose(value, float(metric.apply_compute(seq)), atol=1e-6)
+
+
+def test_pearson_streaming_edge_cases():
+    # constant preds: correlation is numerically zero, not garbage
+    metric = PearsonCorrcoef(streaming=True)
+    metric.update(jnp.full((50,), 1000.0), jnp.asarray(np.random.RandomState(33).randn(50).astype(np.float32)))
+    np.testing.assert_allclose(float(metric.compute()), 0.0, atol=1e-6)
+
+    # batch size 1 must not crash (squeeze makes the input 0-d)
+    single = PearsonCorrcoef(streaming=True)
+    single.update(jnp.asarray([1.5]), jnp.asarray([2.0]))
+    single.update(jnp.asarray([2.5]), jnp.asarray([3.0]))
+    np.testing.assert_allclose(float(single.compute()), 1.0, atol=1e-5)
+
+    # result is clipped to [-1, 1]
+    perfect = PearsonCorrcoef(streaming=True)
+    x = jnp.linspace(0, 1, 100)
+    perfect.update(x, x * 3 + 1)
+    assert -1.0 <= float(perfect.compute()) <= 1.0
