@@ -1,5 +1,13 @@
-"""CosineSimilarity module metric (parity: ``torchmetrics/regression/cosine_similarity.py:24``)."""
+"""CosineSimilarity module metric (parity: ``torchmetrics/regression/cosine_similarity.py:24``).
+
+TPU extension — ``streaming=True`` (for ``'sum'``/``'mean'`` reductions):
+the per-row cosine values accumulate as a running sum + count instead of
+buffering every pair, giving a fixed-shape state that fuses into compiled
+steps and syncs with one ``psum``.
+"""
 from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.cosine_similarity import (
     _cosine_similarity_compute,
@@ -14,6 +22,8 @@ class CosineSimilarity(Metric):
 
     Args:
         reduction: ``'sum' | 'mean' | 'none'``.
+        streaming: accumulate the reduced value instead of buffering samples
+            (``'sum'``/``'mean'`` only) — constant memory, jit-native state.
 
     Example:
         >>> import jax.numpy as jnp
@@ -30,6 +40,7 @@ class CosineSimilarity(Metric):
     def __init__(
         self,
         reduction: str = "sum",
+        streaming: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -45,18 +56,35 @@ class CosineSimilarity(Metric):
         if reduction not in allowed_reduction:
             raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
         self.reduction = reduction
+        self.streaming = streaming
 
-        self.add_state("preds_all", default=[], dist_reduce_fx="cat")
-        self.add_state("target_all", default=[], dist_reduce_fx="cat")
+        if streaming:
+            if reduction not in ("sum", "mean"):
+                raise ValueError("`streaming=True` requires reduction 'sum' or 'mean'")
+            self.add_state("sim_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("n_total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        else:
+            self.add_state("preds_all", default=[], dist_reduce_fx="cat")
+            self.add_state("target_all", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
-        """Append the batch pairs."""
+        """Append the batch pairs (or fold their reduced similarity in)."""
         preds, target = _cosine_similarity_update(preds, target)
-        self.preds_all.append(preds)
-        self.target_all.append(target)
+        if self.streaming:
+            self.sim_sum = self.sim_sum + _cosine_similarity_compute(preds, target, "sum")
+            # one similarity value per vector (= everything but the feature axis)
+            self.n_total = self.n_total + preds[..., 0].size
+        else:
+            self.preds_all.append(preds)
+            self.target_all.append(target)
 
     def compute(self) -> Array:
         """Cosine similarity over everything seen so far."""
+        if self.streaming:
+            if self.reduction == "mean":
+                return self.sim_sum / jnp.maximum(self.n_total, 1)
+            return self.sim_sum
+
         preds = dim_zero_cat(self.preds_all)
         target = dim_zero_cat(self.target_all)
         return _cosine_similarity_compute(preds, target, self.reduction)

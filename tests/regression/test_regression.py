@@ -324,3 +324,52 @@ def test_pearson_streaming_edge_cases():
     x = jnp.linspace(0, 1, 100)
     perfect.update(x, x * 3 + 1)
     assert -1.0 <= float(perfect.compute()) <= 1.0
+
+
+def test_cosine_streaming_matches_buffered():
+    import jax
+
+    rng = np.random.RandomState(41)
+    for reduction in ("sum", "mean"):
+        streaming = CosineSimilarity(reduction=reduction, streaming=True)
+        buffered = CosineSimilarity(reduction=reduction)
+        for _ in range(5):
+            p = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+            t = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+            streaming.update(p, t)
+            buffered.update(p, t)
+        np.testing.assert_allclose(float(streaming.compute()), float(buffered.compute()), atol=1e-5)
+
+    with pytest.raises(ValueError, match="streaming"):
+        CosineSimilarity(reduction="none", streaming=True)
+
+    # fused forward works (sum states are mergeable) and jit keeps one trace
+    metric = CosineSimilarity(reduction="mean", streaming=True)
+    traces = {"n": 0}
+
+    def step(state, p, t):
+        traces["n"] += 1
+        return metric.apply_update(state, p, t)
+
+    jitted = jax.jit(step)
+    state = metric.init_state()
+    oracle = CosineSimilarity(reduction="mean")
+    for _ in range(4):
+        p = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+        t = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+        state = jitted(state, p, t)
+        oracle.update(p, t)
+    assert traces["n"] == 1
+    np.testing.assert_allclose(float(metric.apply_compute(state)), float(oracle.compute()), atol=1e-5)
+
+
+def test_cosine_streaming_higher_rank_inputs():
+    # similarity is per vector along the last axis; counts must follow
+    rng = np.random.RandomState(42)
+    p = jnp.asarray(rng.randn(4, 5, 8).astype(np.float32))
+    t = jnp.asarray(rng.randn(4, 5, 8).astype(np.float32))
+    streaming = CosineSimilarity(reduction="mean", streaming=True)
+    buffered = CosineSimilarity(reduction="mean")
+    streaming.update(p, t)
+    buffered.update(p, t)
+    np.testing.assert_allclose(float(streaming.compute()), float(buffered.compute()), atol=1e-6)
