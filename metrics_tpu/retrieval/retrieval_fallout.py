@@ -30,6 +30,7 @@ class RetrievalFallOut(RetrievalMetric):
     def __init__(
         self,
         empty_target_action: str = "pos",
+        padded: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -40,6 +41,7 @@ class RetrievalFallOut(RetrievalMetric):
         # negatives has "retrieved no negatives", the benign outcome)
         super().__init__(
             empty_target_action=empty_target_action,
+            padded=padded,
             compute_on_step=compute_on_step,
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,

@@ -10,6 +10,13 @@ The reference computes per-query scores with a Python loop over
 3. evaluates every query at once with a single vmapped XLA program built from
    the same ``_*_from_sorted`` row kernels the functional API uses — the
    empty-query policies become masked arithmetic instead of branches.
+
+TPU extension — ``padded=True``: when every query's candidate set arrives as
+one fixed-width row (the usual reranker-eval layout), ``update(preds, target,
+mask=...)`` with ``(Q, D)`` batches scores the queries immediately and
+accumulates just a value sum + query counts. The state is three scalars, so
+the whole metric — update, ``psum`` sync, compute — runs inside a compiled
+step with no per-step retracing and no epoch-end host pass.
 """
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Optional, Tuple
@@ -53,6 +60,7 @@ class RetrievalMetric(Metric, ABC):
     def __init__(
         self,
         empty_target_action: str = "neg",
+        padded: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -69,22 +77,49 @@ class RetrievalMetric(Metric, ABC):
         if empty_target_action not in empty_target_action_options:
             raise ValueError(f"`empty_target_action` received a wrong value `{empty_target_action}`.")
         self.empty_target_action = empty_target_action
+        self.padded = padded
 
         if k is not None and not self._uses_k:
             raise TypeError(f"{self.__class__.__name__} does not accept `k`")
         _check_k(k)
         self.k = k
 
-        self.add_state("indexes", default=[], dist_reduce_fx=None)
-        self.add_state("preds", default=[], dist_reduce_fx=None)
-        self.add_state("target", default=[], dist_reduce_fx=None)
+        if padded:
+            if empty_target_action == "error":
+                raise ValueError(
+                    "`padded=True` cannot raise per-query inside a compiled program;"
+                    " use empty_target_action 'neg', 'pos' or 'skip'"
+                )
+            import jax
+
+            # streaming scalars are mergeable -> the fused single-update
+            # forward applies (the flat mode needs the host grouping pass)
+            self._fusable = True
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            self.add_state("value_sum", default=jnp.zeros((), dtype), dist_reduce_fx="sum")
+            self.add_state("query_total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        else:
+            self.add_state("indexes", default=[], dist_reduce_fx=None)
+            self.add_state("preds", default=[], dist_reduce_fx=None)
+            self.add_state("target", default=[], dist_reduce_fx=None)
 
     def _resolve_k(self, lengths: Array) -> Array:
         """``k`` per query: the configured top-k or each query's full length."""
         return lengths if self.k is None else jnp.asarray(self.k)
 
-    def update(self, preds: Array, target: Array, indexes: Optional[Array] = None) -> None:
-        """Validate, flatten and append one batch of (preds, target, indexes)."""
+    def update(
+        self,
+        preds: Array,
+        target: Array,
+        indexes: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> None:
+        """Validate, flatten and append one batch of (preds, target, indexes) —
+        or, with ``padded=True``, score ``(Q, D)`` query rows immediately."""
+        if self.padded:
+            self._update_padded(jnp.asarray(preds), jnp.asarray(target), mask)
+            return
+
         if indexes is None:
             raise ValueError("`indexes` cannot be None")
         indexes, preds, target = _check_retrieval_inputs(
@@ -94,6 +129,61 @@ class RetrievalMetric(Metric, ABC):
         self.indexes.append(indexes)
         self.preds.append(preds)
         self.target.append(target)
+
+    def _update_padded(self, preds: Array, target: Array, mask: Optional[Array]) -> None:
+        """Score one ``(Q, D)`` batch of fully-contained queries in-graph."""
+        if preds.ndim != 2 or preds.shape != target.shape:
+            raise ValueError(f"`padded=True` expects (Q, D) preds/target of equal shape, got {preds.shape}")
+        if mask is None:
+            mask = jnp.ones(preds.shape, bool)
+        mask = jnp.asarray(mask, bool)
+        if mask.shape != preds.shape:
+            raise ValueError(f"`mask` must match preds shape {preds.shape}, got {mask.shape}")
+        self._validate_padded_values(preds, target, mask)
+
+        # sort each query row by (valid first, then descending score); the
+        # two-key lexsort keeps a real -inf score ahead of masked padding
+        score = jnp.where(mask, preds.astype(jnp.float32), 0.0)
+        order = jnp.lexsort((-score, ~mask), axis=-1)
+        target_rows = jnp.where(mask, target, 0)
+        target_rows = jnp.take_along_axis(target_rows, order, axis=-1)
+        lengths = jnp.sum(mask, axis=-1)
+
+        values = self._metric_rows(target_rows, lengths)
+        values, counted = self._apply_empty_policy(values, target_rows, lengths)
+        # fully-masked rows are query-axis padding, not queries: drop entirely
+        is_query = lengths > 0
+        values = jnp.where(is_query, values, 0.0)
+        counted = counted & is_query
+        self.value_sum = self.value_sum + jnp.sum(values).astype(self.value_sum.dtype)
+        self.query_total = self.query_total + jnp.sum(counted).astype(jnp.int32)
+
+    def _validate_padded_values(self, preds: Array, target: Array, mask: Array) -> None:
+        """The flat path's dtype/value checks, adapted to masked rows
+        (host-side when concrete, skipped under tracing)."""
+        from metrics_tpu.utilities.data import _is_traced, is_floating_point
+
+        if not is_floating_point(preds):
+            raise ValueError("`preds` must be a tensor of floats")
+        if not self.allow_non_binary_target and not _is_traced(preds, target, mask):
+            valid_targets = np.asarray(jnp.where(mask, target, 0))
+            if ((valid_targets != 0) & (valid_targets != 1)).any():
+                raise ValueError("`target` must contain `binary` values")
+
+    def _apply_empty_policy(self, values: Array, target_rows: Array, lengths: Array):
+        """(masked values, counted mask) under the empty-query policy."""
+        if self._empty_relevance == "negative":
+            relevant = lengths - jnp.sum(target_rows > 0, axis=-1)
+        else:
+            relevant = jnp.sum(target_rows, axis=-1)
+        empty = relevant == 0
+
+        if self.empty_target_action == "pos":
+            values = jnp.where(empty, 1.0, values)
+        elif self.empty_target_action in ("neg", "skip"):
+            values = jnp.where(empty, 0.0, values)
+        counted = ~empty if self.empty_target_action == "skip" else jnp.ones_like(empty)
+        return values, counted
 
     def _group_into_rows(self) -> Tuple[Array, Array]:
         """Flat accumulated stream -> ``(num_queries, max_len)`` rows sorted by
@@ -117,28 +207,25 @@ class RetrievalMetric(Metric, ABC):
 
     def compute(self) -> Array:
         """Mean per-query score with the empty-query policy applied as masks."""
+        if self.padded:
+            return (self.value_sum / jnp.maximum(self.query_total, 1)).astype(jnp.float32)
+
         target_rows, lengths = self._group_into_rows()
         values = self._metric_rows(target_rows, lengths)
 
-        if self._empty_relevance == "negative":
-            relevant = lengths - jnp.sum(target_rows > 0, axis=-1)
-        else:
-            relevant = jnp.sum(target_rows, axis=-1)
-        empty = relevant == 0
-
         if self.empty_target_action == "error":
-            if bool(jnp.any(empty)):
+            if self._empty_relevance == "negative":
+                relevant = lengths - jnp.sum(target_rows > 0, axis=-1)
+            else:
+                relevant = jnp.sum(target_rows, axis=-1)
+            if bool(jnp.any(relevant == 0)):
                 kind = self._empty_relevance
                 raise ValueError(f"`compute` method was provided with a query with no {kind} target.")
             return jnp.mean(values)
-        if self.empty_target_action == "pos":
-            values = jnp.where(empty, 1.0, values)
-        elif self.empty_target_action == "neg":
-            values = jnp.where(empty, 0.0, values)
-        elif self.empty_target_action == "skip":
-            kept = jnp.sum(~empty)
-            return jnp.where(kept > 0, jnp.sum(jnp.where(empty, 0.0, values)) / jnp.maximum(kept, 1), 0.0)
-        return jnp.mean(values)
+
+        values, counted = self._apply_empty_policy(values, target_rows, lengths)
+        kept = jnp.sum(counted)
+        return jnp.where(kept > 0, jnp.sum(values) / jnp.maximum(kept, 1), 0.0)
 
     @abstractmethod
     def _metric_rows(self, target_rows: Array, lengths: Array) -> Array:

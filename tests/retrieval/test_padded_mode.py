@@ -1,0 +1,193 @@
+"""Padded (fully in-graph) retrieval mode: each query is one fixed-width
+``(Q, D)`` row, the state is three streaming scalars, and results must match
+the flat-stream (indexes-based) mode on the same data."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
+from tests.conftest import NUM_DEVICES
+
+_rng = np.random.RandomState(23)
+ALL_CLASSES = [
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalFallOut,
+    RetrievalNormalizedDCG,
+]
+
+
+def _to_flat(preds, target, mask):
+    """(Q, D) padded batch -> flat (indexes, preds, target) stream."""
+    q, d = preds.shape
+    idx = np.repeat(np.arange(q), d)
+    keep = mask.reshape(-1)
+    return idx[keep], preds.reshape(-1)[keep], target.reshape(-1)[keep]
+
+
+@pytest.mark.parametrize("metric_cls", ALL_CLASSES)
+@pytest.mark.parametrize("ragged", [False, True])
+def test_padded_matches_flat_stream(metric_cls, ragged):
+    q, d = 12, 10
+    preds = _rng.rand(q, d).astype(np.float32)
+    target = _rng.randint(0, 2, (q, d))
+    if ragged:
+        lengths = _rng.randint(2, d + 1, q)
+        mask = np.arange(d)[None, :] < lengths[:, None]
+    else:
+        mask = np.ones((q, d), bool)
+
+    padded = metric_cls(padded=True)
+    padded.update(jnp.asarray(preds), jnp.asarray(target), mask=jnp.asarray(mask))
+
+    flat = metric_cls()
+    idx, p, t = _to_flat(preds, target, mask)
+    flat.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+
+    np.testing.assert_allclose(float(padded.compute()), float(flat.compute()), atol=1e-6)
+
+
+@pytest.mark.parametrize("metric_cls", ALL_CLASSES)
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+def test_padded_empty_policies_match_flat(metric_cls, action):
+    q, d = 8, 6
+    preds = _rng.rand(q, d).astype(np.float32)
+    target = _rng.randint(0, 2, (q, d))
+    # force some empty queries for both relevance kinds
+    target[0] = 0  # no positives
+    target[1] = 1  # no negatives
+    mask = np.ones((q, d), bool)
+
+    padded = metric_cls(padded=True, empty_target_action=action)
+    padded.update(jnp.asarray(preds), jnp.asarray(target), mask=jnp.asarray(mask))
+    flat = metric_cls(empty_target_action=action)
+    idx, p, t = _to_flat(preds, target, mask)
+    flat.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+
+    np.testing.assert_allclose(float(padded.compute()), float(flat.compute()), atol=1e-6)
+
+
+def test_padded_accumulates_across_batches_and_jits():
+    metric = RetrievalMAP(padded=True)
+    traces = {"n": 0}
+
+    def step(state, p, t, m):
+        traces["n"] += 1
+        return metric.apply_update(state, p, t, mask=m)
+
+    jitted = jax.jit(step)
+    state = metric.init_state()
+    all_p, all_t = [], []
+    for _ in range(5):
+        p = _rng.rand(6, 8).astype(np.float32)
+        t = _rng.randint(0, 2, (6, 8))
+        all_p.append(p)
+        all_t.append(t)
+        state = jitted(state, jnp.asarray(p), jnp.asarray(t), jnp.ones((6, 8), bool))
+    assert traces["n"] == 1  # step-invariant state
+
+    flat = RetrievalMAP()
+    for batch_i, (p, t) in enumerate(zip(all_p, all_t)):
+        idx, fp, ft = _to_flat(p, t, np.ones((6, 8), bool))
+        idx = idx + batch_i * 6  # every padded row is its own query
+        flat.update(jnp.asarray(fp), jnp.asarray(ft), indexes=jnp.asarray(idx))
+    np.testing.assert_allclose(
+        float(metric.apply_compute(state)), float(flat.compute()), atol=1e-6
+    )
+
+
+def test_padded_query_axis_padding_dropped():
+    metric = RetrievalMRR(padded=True)
+    preds = _rng.rand(4, 5).astype(np.float32)
+    target = _rng.randint(0, 2, (4, 5))
+    target[:, 0] = 1  # every real query has a positive
+    mask = np.ones((4, 5), bool)
+    mask[2:] = False  # last two rows are padding, not queries
+    metric.update(jnp.asarray(preds), jnp.asarray(target), mask=jnp.asarray(mask))
+    assert int(metric.query_total) == 2
+
+    flat = RetrievalMRR()
+    idx, p, t = _to_flat(preds, target, mask)
+    flat.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+    np.testing.assert_allclose(float(metric.compute()), float(flat.compute()), atol=1e-6)
+
+
+def test_padded_sharded_compute():
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    q, d = NUM_DEVICES * 4, 6
+    preds = _rng.rand(q, d).astype(np.float32)
+    target = _rng.randint(0, 2, (q, d))
+
+    metric = RetrievalMAP(padded=True)
+    mesh = Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("data",))
+
+    def step(p, t):
+        state = metric.apply_update(metric.init_state(), p, t)
+        return metric.apply_compute(state, axis_name="data")
+
+    fn = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+    )
+    value = float(fn(
+        jax.device_put(jnp.asarray(preds), NamedSharding(mesh, P("data"))),
+        jax.device_put(jnp.asarray(target), NamedSharding(mesh, P("data"))),
+    ))
+    seq = metric.apply_update(metric.init_state(), jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(value, float(metric.apply_compute(seq)), atol=1e-6)
+
+
+def test_padded_rejects_error_action_and_bad_shapes():
+    with pytest.raises(ValueError, match="padded"):
+        RetrievalMAP(padded=True, empty_target_action="error")
+    metric = RetrievalMAP(padded=True)
+    with pytest.raises(ValueError, match="expects"):
+        metric.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 1]))
+    with pytest.raises(ValueError, match="mask"):
+        metric.update(jnp.ones((4, 5)), jnp.zeros((4, 5), jnp.int32), mask=jnp.ones((4, 1), bool))
+    with pytest.raises(ValueError, match="floats"):
+        metric.update(jnp.ones((4, 5), jnp.int32), jnp.zeros((4, 5), jnp.int32))
+    with pytest.raises(ValueError, match="binary"):
+        metric.update(jnp.ones((4, 5)), jnp.full((4, 5), 2, jnp.int32))
+
+
+def test_padded_real_neg_inf_score_beats_padding():
+    # a legitimate -inf logit must still outrank masked padding slots
+    metric = RetrievalMRR(padded=True)
+    preds = jnp.asarray([[0.3, -np.inf]])
+    target = jnp.asarray([[0, 1]])
+    mask = jnp.asarray([[True, True]])
+    metric.update(preds, target, mask=mask)
+    np.testing.assert_allclose(float(metric.compute()), 0.5, atol=1e-6)
+
+    # same with an actually-masked second slot: the -inf real score ranks
+    # ahead of a padding slot carrying garbage
+    metric2 = RetrievalMRR(padded=True)
+    metric2.update(
+        jnp.asarray([[-np.inf, 123.0]]), jnp.asarray([[1, 1]]), mask=jnp.asarray([[True, False]])
+    )
+    np.testing.assert_allclose(float(metric2.compute()), 1.0, atol=1e-6)
+
+
+def test_padded_fused_forward_single_pass():
+    # streaming scalars are mergeable -> forward runs one update, and the
+    # returned step value reflects only the batch
+    metric = RetrievalMRR(padded=True)
+    preds = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+    target = jnp.asarray([[1, 0], [1, 0]])
+    step_val = metric(preds, target)
+    np.testing.assert_allclose(float(step_val), (1.0 + 0.5) / 2, atol=1e-6)
+    assert int(metric.query_total) == 2
+    metric(preds, target)
+    assert int(metric.query_total) == 4
