@@ -1,5 +1,15 @@
-"""SSIM module metric (parity: ``torchmetrics/image/ssim.py:25``)."""
+"""SSIM module metric (parity: ``torchmetrics/image/ssim.py:25``).
+
+TPU extension — ``streaming=True`` (requires an explicit ``data_range`` and
+``'elementwise_mean'``/``'sum'`` reduction): per-batch SSIM maps reduce into
+a running sum + element count instead of buffering every image, so the state
+is two scalars, memory is O(1) in the stream, and the metric fuses into
+compiled steps (the conv already runs on the MXU either way).
+"""
 from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.ssim import _ssim_compute, _ssim_update
 from metrics_tpu.metric import Metric
@@ -12,7 +22,7 @@ class SSIM(Metric):
 
     Like the reference, buffers all predictions/targets (``cat`` states) so
     epoch-end compute can determine a global ``data_range`` — pass an explicit
-    ``data_range`` and ``reduction='elementwise_mean'`` if memory is a concern.
+    ``data_range`` with ``streaming=True`` if memory is a concern.
 
     Args:
         kernel_size: size of the gaussian window
@@ -21,6 +31,9 @@ class SSIM(Metric):
         data_range: range of the image; if None determined from the data
         k1: SSIM stability constant (luminance)
         k2: SSIM stability constant (contrast)
+        streaming: reduce each batch on arrival into a running sum + count
+            (needs ``data_range`` and a mean/sum reduction) — O(1) memory,
+            jit-native state
 
     Example:
         >>> import jax
@@ -44,6 +57,7 @@ class SSIM(Metric):
         data_range: Optional[float] = None,
         k1: float = 0.01,
         k2: float = 0.03,
+        streaming: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -55,28 +69,53 @@ class SSIM(Metric):
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
         )
-        rank_zero_warn(
-            "Metric `SSIM` will save all targets and"
-            " predictions in buffer. For large datasets this may lead"
-            " to large memory footprint."
-        )
-        self.add_state("y", default=[], dist_reduce_fx="cat")
-        self.add_state("y_pred", default=[], dist_reduce_fx="cat")
         self.kernel_size = kernel_size
         self.sigma = sigma
         self.data_range = data_range
         self.k1 = k1
         self.k2 = k2
         self.reduction = reduction
+        self.streaming = streaming
+
+        if streaming:
+            if data_range is None:
+                raise ValueError("`streaming=True` requires an explicit `data_range`")
+            if reduction not in ("elementwise_mean", "sum"):
+                raise ValueError("`streaming=True` requires reduction 'elementwise_mean' or 'sum'")
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            self.add_state("ssim_sum", default=jnp.zeros((), dtype), dist_reduce_fx="sum")
+            self.add_state("n_elements", default=jnp.zeros((), dtype), dist_reduce_fx="sum")
+        else:
+            rank_zero_warn(
+                "Metric `SSIM` will save all targets and"
+                " predictions in buffer. For large datasets this may lead"
+                " to large memory footprint."
+            )
+            self.add_state("y", default=[], dist_reduce_fx="cat")
+            self.add_state("y_pred", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
-        """Buffer this batch's predictions and targets."""
+        """Buffer this batch (or reduce it into the running sums)."""
         preds, target = _ssim_update(preds, target)
-        self.y_pred.append(preds)
-        self.y.append(target)
+        if self.streaming:
+            # take the per-pixel map so the element count is exactly the
+            # cropped map's size (no duplicated crop-geometry knowledge here)
+            ssim_map = _ssim_compute(
+                preds, target, self.kernel_size, self.sigma, "none", self.data_range, self.k1, self.k2
+            )
+            self.ssim_sum = self.ssim_sum + jnp.sum(ssim_map).astype(self.ssim_sum.dtype)
+            self.n_elements = self.n_elements + float(ssim_map.size)
+        else:
+            self.y_pred.append(preds)
+            self.y.append(target)
 
     def compute(self) -> Array:
-        """SSIM over all buffered images."""
+        """SSIM over all images seen so far."""
+        if self.streaming:
+            if self.reduction == "sum":
+                return self.ssim_sum.astype(jnp.float32)
+            return (self.ssim_sum / jnp.maximum(self.n_elements, 1.0)).astype(jnp.float32)
+
         preds = dim_zero_cat(self.y_pred)
         target = dim_zero_cat(self.y)
         return _ssim_compute(

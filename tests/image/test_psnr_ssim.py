@@ -190,3 +190,41 @@ def test_image_gradients_invalid():
         image_gradients([[1.0, 2.0]])
     with pytest.raises(RuntimeError):
         image_gradients(jnp.zeros((5, 5)))
+
+
+def test_ssim_streaming_matches_buffered():
+    import jax
+
+    rng = np.random.RandomState(51)
+    # asymmetric kernel on non-square images: the element count must follow
+    # the actual cropped map, not a symmetric-geometry assumption
+    for kernel_size, (h, w) in [((11, 11), (20, 20)), ((11, 7), (20, 40))]:
+        streaming = SSIM(kernel_size=kernel_size, data_range=1.0, streaming=True)
+        buffered = SSIM(kernel_size=kernel_size, data_range=1.0)
+        for _ in range(4):
+            p = jnp.asarray(rng.rand(4, 3, h, w).astype(np.float32))
+            t = jnp.asarray((np.asarray(p) * 0.8 + 0.1 * rng.rand(4, 3, h, w)).astype(np.float32))
+            streaming.update(p, t)
+            buffered.update(p, t)
+        np.testing.assert_allclose(float(streaming.compute()), float(buffered.compute()), atol=1e-5)
+
+    with pytest.raises(ValueError, match="data_range"):
+        SSIM(streaming=True)
+    with pytest.raises(ValueError, match="reduction"):
+        SSIM(data_range=1.0, reduction="none", streaming=True)
+
+    # jit-native: single trace across steps
+    metric = SSIM(data_range=1.0, streaming=True)
+    traces = {"n": 0}
+
+    def step(state, p, t):
+        traces["n"] += 1
+        return metric.apply_update(state, p, t)
+
+    jitted = jax.jit(step)
+    state = metric.init_state()
+    for _ in range(3):
+        p = jnp.asarray(rng.rand(2, 1, 16, 16).astype(np.float32))
+        state = jitted(state, p, p)
+    assert traces["n"] == 1
+    np.testing.assert_allclose(float(metric.apply_compute(state)), 1.0, atol=1e-5)
