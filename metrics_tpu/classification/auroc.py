@@ -14,6 +14,8 @@ compute).
 """
 from typing import Any, Callable, Optional
 
+import jax.numpy as jnp
+
 from metrics_tpu.classification.capped_buffer import CappedBufferMixin
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.functional.classification.masked_curves import masked_binary_auroc
@@ -25,11 +27,12 @@ class AUROC(CappedBufferMixin, Metric):
     """Area under the ROC curve over all batches.
 
     Args:
-        capacity: when set (binary inputs only), accumulate into a fixed-size
-            ``(capacity,)`` buffer instead of unbounded lists — the state
-            structure is step-invariant, so the metric lives inside ``jit``/
-            ``shard_map`` without retracing. Incompatible with ``max_fpr``
-            and multiclass ``num_classes``.
+        capacity: when set, accumulate into a fixed-size sample buffer
+            instead of unbounded lists — the state structure is
+            step-invariant, so the metric lives inside ``jit``/``shard_map``
+            without retracing. Binary by default; with ``num_classes > 1``
+            the buffer is ``(capacity, C)`` and the result is the
+            one-vs-rest macro/weighted average. Incompatible with ``max_fpr``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -81,6 +84,8 @@ class AUROC(CappedBufferMixin, Metric):
         if capacity is not None:
             if max_fpr is not None:
                 raise ValueError("`capacity` mode does not support `max_fpr`")
+            if num_classes is not None and num_classes > 1 and average not in ("macro", "weighted"):
+                raise ValueError("multiclass `capacity` mode supports average 'macro' or 'weighted'")
             self._init_capacity_states(capacity, num_classes, pos_label)
         else:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
@@ -107,6 +112,12 @@ class AUROC(CappedBufferMixin, Metric):
         """AUROC over everything seen so far."""
         if self.capacity is not None:
             preds, target, valid = self._buffer_flatten()
+            if self._capacity_multiclass:
+                per_class = self._one_vs_rest(masked_binary_auroc, preds, target, valid)
+                if self.average == "weighted":
+                    support = self._class_supports(target, valid)
+                    return jnp.sum(per_class * support / jnp.maximum(jnp.sum(support), 1.0))
+                return jnp.mean(per_class)
             return masked_binary_auroc(preds, target, valid)
 
         preds = dim_zero_cat(self.preds)

@@ -22,9 +22,11 @@ class AveragePrecision(CappedBufferMixin, Metric):
     """Average precision over all batches.
 
     Args:
-        capacity: when set (binary inputs only), accumulate into a fixed-size
-            ``(capacity,)`` buffer instead of unbounded lists — usable inside
-            compiled programs without per-step retracing.
+        capacity: when set, accumulate into a fixed-size sample buffer
+            instead of unbounded lists — usable inside compiled programs
+            without per-step retracing. Binary by default; with
+            ``num_classes > 1`` compute returns the per-class one-vs-rest
+            APs as a ``(C,)`` array.
 
     Example:
         >>> import jax.numpy as jnp
@@ -83,6 +85,10 @@ class AveragePrecision(CappedBufferMixin, Metric):
         """Average precision over everything seen so far."""
         if self.capacity is not None:
             preds, target, valid = self._buffer_flatten()
+            if self._capacity_multiclass:
+                # per-class one-vs-rest APs as a (C,) array (the list-mode
+                # API returns a Python list; in-graph results must be arrays)
+                return self._one_vs_rest(masked_binary_average_precision, preds, target, valid)
             return masked_binary_average_precision(preds, target, valid)
 
         preds = dim_zero_cat(self.preds)

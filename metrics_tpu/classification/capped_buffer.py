@@ -8,6 +8,7 @@ masked compute kernels are in ``functional/classification/masked_curves.py``).
 """
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.auroc import _auroc_update
@@ -22,23 +23,42 @@ class CappedBufferMixin:
     def _init_capacity_states(
         self, capacity: int, num_classes: Optional[int], pos_label: Optional[int]
     ) -> None:
-        """Validate the capacity-mode configuration and register the buffer states."""
+        """Validate the capacity-mode configuration and register the buffer states.
+
+        ``num_classes > 1`` switches to the multiclass layout: a
+        ``(capacity, C)`` score buffer with integer class labels, computed
+        one-vs-rest at epoch end.
+        """
         if not (isinstance(capacity, int) and capacity > 0):
             raise ValueError(f"`capacity` should be a positive integer, got: {capacity}")
-        if num_classes not in (None, 1):
-            raise ValueError("`capacity` mode supports binary inputs only; leave `num_classes` unset")
-        if pos_label not in (None, 0, 1):
+        multiclass = num_classes is not None and num_classes > 1
+        if not multiclass and pos_label not in (None, 0, 1):
             raise ValueError(f"`capacity` mode expects `pos_label` in (0, 1), got: {pos_label}")
-        self.add_state("preds_buf", jnp.full((capacity,), -jnp.inf, jnp.float32), dist_reduce_fx="cat")
+        if multiclass and pos_label is not None:
+            raise ValueError("`pos_label` does not apply to multiclass `capacity` mode")
+        buf_shape = (capacity, num_classes) if multiclass else (capacity,)
+        self.add_state("preds_buf", jnp.full(buf_shape, -jnp.inf, jnp.float32), dist_reduce_fx="cat")
         self.add_state("target_buf", jnp.zeros((capacity,), jnp.int32), dist_reduce_fx="cat")
         self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="cat")
 
+    @property
+    def _capacity_multiclass(self) -> bool:
+        return self.num_classes is not None and self.num_classes > 1
+
     def _buffer_update(self, preds: Array, target: Array) -> None:
         preds, target, mode = _auroc_update(preds, target)
-        if mode != DataType.BINARY:
-            raise ValueError(f"`capacity` mode supports binary inputs only, got mode {mode}")
-        pos_label = 1 if self.pos_label is None else self.pos_label
-        target = (target == pos_label).astype(jnp.int32)
+        if self._capacity_multiclass:
+            if mode != DataType.MULTICLASS or preds.ndim != 2 or preds.shape[1] != self.num_classes:
+                raise ValueError(
+                    f"`capacity` mode with num_classes={self.num_classes} expects (N, C) class scores"
+                    f" and (N,) labels, got mode {mode} with preds shape {preds.shape}"
+                )
+            target = target.astype(jnp.int32)
+        else:
+            if mode != DataType.BINARY:
+                raise ValueError(f"`capacity` mode supports binary inputs only, got mode {mode}")
+            pos_label = 1 if self.pos_label is None else self.pos_label
+            target = (target == pos_label).astype(jnp.int32)
         idx = self.count + jnp.arange(preds.shape[0])
         # writes past the capacity are dropped; the counter keeps the true total
         self.preds_buf = self.preds_buf.at[idx].set(preds.astype(jnp.float32), mode="drop")
@@ -48,7 +68,8 @@ class CappedBufferMixin:
     def _buffer_flatten(self) -> Tuple[Array, Array, Array]:
         """(flat preds, flat target, valid mask) across however many shards the
         sync produced — scalar count = 1 shard; ``(world,)`` counts = world
-        shards of ``capacity`` samples each."""
+        shards of ``capacity`` samples each. Multiclass preds keep their
+        trailing class axis: ``(world·capacity, C)``."""
         preds_buf = dim_zero_cat(self.preds_buf) if isinstance(self.preds_buf, list) else self.preds_buf
         target_buf = dim_zero_cat(self.target_buf) if isinstance(self.target_buf, list) else self.target_buf
         count = self.count
@@ -69,4 +90,23 @@ class CappedBufferMixin:
                 )
 
         valid = (jnp.arange(self.capacity)[None, :] < jnp.clip(counts, 0, self.capacity)[:, None]).reshape(-1)
-        return preds_buf.reshape(-1), target_buf.reshape(-1), valid
+        if self._capacity_multiclass:
+            preds_flat = preds_buf.reshape(-1, self.num_classes)
+        else:
+            preds_flat = preds_buf.reshape(-1)
+        return preds_flat, target_buf.reshape(-1), valid
+
+    def _one_vs_rest(self, kernel, preds: Array, target: Array, valid: Array) -> Array:
+        """Apply a masked binary curve kernel per class: ``(C,)`` values.
+
+        Takes the already-flattened buffers so callers flatten (and gather,
+        in the sharded path) exactly once per compute.
+        """
+        return jax.vmap(lambda c: kernel(preds[:, c], (target == c).astype(jnp.int32), valid))(
+            jnp.arange(self.num_classes)
+        )
+
+    def _class_supports(self, target: Array, valid: Array) -> Array:
+        """Valid-sample count per class (for weighted averaging)."""
+        onehot = (target[None, :] == jnp.arange(self.num_classes)[:, None]) & valid[None, :]
+        return jnp.sum(onehot, axis=1).astype(jnp.float32)

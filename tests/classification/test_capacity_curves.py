@@ -119,8 +119,8 @@ class TestCapacityMode:
     def test_invalid_args(self, metric_cls, sk_fn):
         with pytest.raises(ValueError, match="capacity"):
             metric_cls(capacity=0)
-        with pytest.raises(ValueError, match="binary"):
-            metric_cls(capacity=16, num_classes=5)
+        # num_classes > 1 now selects the multiclass (capacity, C) layout
+        assert metric_cls(capacity=16, num_classes=5).preds_buf.shape == (16, 5)
 
     def test_reset(self, metric_cls, sk_fn):
         metric = metric_cls(capacity=32)
@@ -145,6 +145,71 @@ def test_capacity_honors_pos_label_zero(metric_cls, sk_fn):
 def test_capacity_rejects_out_of_range_pos_label():
     with pytest.raises(ValueError, match="pos_label"):
         AUROC(capacity=16, pos_label=2)
+
+
+class TestMulticlassCapacity:
+    def _data(self, n=200, c=4):
+        logits = _rng.rand(n, c).astype(np.float32)
+        probs = logits / logits.sum(-1, keepdims=True)
+        target = _rng.randint(0, c, n)
+        return probs, target
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_auroc_multiclass_vs_sklearn(self, average):
+        probs, target = self._data()
+        metric = AUROC(capacity=256, num_classes=4, average=average)
+        metric.update(jnp.asarray(probs), jnp.asarray(target))
+        expected = roc_auc_score(target, probs, multi_class="ovr", average=average)
+        np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-6)
+
+    def test_ap_multiclass_per_class_vs_sklearn(self):
+        probs, target = self._data()
+        metric = AveragePrecision(capacity=256, num_classes=4)
+        metric.update(jnp.asarray(probs), jnp.asarray(target))
+        got = np.asarray(metric.compute())
+        for c in range(4):
+            np.testing.assert_allclose(
+                got[c], average_precision_score((target == c).astype(int), probs[:, c]), atol=1e-6
+            )
+
+    def test_multiclass_capacity_matches_list_mode(self):
+        probs, target = self._data()
+        capped = AUROC(capacity=256, num_classes=4, average="macro")
+        listed = AUROC(num_classes=4, average="macro")
+        capped.update(jnp.asarray(probs), jnp.asarray(target))
+        listed.update(jnp.asarray(probs), jnp.asarray(target))
+        np.testing.assert_allclose(float(capped.compute()), float(listed.compute()), atol=1e-6)
+
+    def test_multiclass_capacity_sharded(self):
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        probs, target = self._data(n=NUM_DEVICES * 32)
+        metric = AUROC(capacity=32, num_classes=4, average="macro")
+        mesh = Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("data",))
+
+        def step(p, t):
+            state = metric.apply_update(metric.init_state(), p, t)
+            return metric.apply_compute(state, axis_name="data")
+
+        fn = jax.jit(
+            jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        )
+        value = float(fn(
+            jax.device_put(jnp.asarray(probs), NamedSharding(mesh, P("data"))),
+            jax.device_put(jnp.asarray(target), NamedSharding(mesh, P("data"))),
+        ))
+        expected = roc_auc_score(target, probs, multi_class="ovr", average="macro")
+        np.testing.assert_allclose(value, expected, atol=1e-6)
+
+    def test_multiclass_capacity_invalid_args(self):
+        with pytest.raises(ValueError, match="average"):
+            AUROC(capacity=16, num_classes=3, average="micro")
+        with pytest.raises(ValueError, match="pos_label"):
+            AUROC(capacity=16, num_classes=3, pos_label=1)
+        metric = AUROC(capacity=16, num_classes=3)
+        with pytest.raises(ValueError, match="expects"):
+            metric.update(jnp.asarray(_rng.rand(8).astype(np.float32)), jnp.asarray(_rng.randint(0, 2, 8)))
 
 
 def test_auroc_capacity_rejects_max_fpr():
