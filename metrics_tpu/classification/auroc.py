@@ -16,7 +16,7 @@ from typing import Any, Callable, Optional
 
 import jax.numpy as jnp
 
-from metrics_tpu.classification.capped_buffer import CappedBufferMixin
+from metrics_tpu.utilities.capped_buffer import CappedBufferMixin
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.functional.classification.masked_curves import masked_binary_auroc
 from metrics_tpu.metric import Metric

@@ -8,7 +8,7 @@ retracing.
 """
 from typing import Any, Callable, List, Optional, Union
 
-from metrics_tpu.classification.capped_buffer import CappedBufferMixin
+from metrics_tpu.utilities.capped_buffer import CappedBufferMixin
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,

@@ -17,10 +17,21 @@ from metrics_tpu.utilities.data import Array
 
 def _rank_data(data: Array) -> Array:
     """Fractional ranks (1-based); ties get the mean of their rank block."""
-    sorted_data = jnp.sort(data)
-    count_less = jnp.searchsorted(sorted_data, data, side="left")
-    count_le = jnp.searchsorted(sorted_data, data, side="right")
-    return count_less.astype(data.dtype) + (count_le - count_less + 1).astype(data.dtype) / 2
+    return _masked_rank(data, jnp.ones(data.shape, bool)).astype(data.dtype)
+
+
+def _masked_rank(data: Array, valid: Array) -> Array:
+    """Fractional ranks among the valid entries (invalid slots sort to +inf
+    and receive meaningless ranks — mask them out downstream)."""
+    x = jnp.where(valid, data.astype(jnp.float32), jnp.inf)
+    sorted_x = jnp.sort(x)
+    count_less = jnp.searchsorted(sorted_x, x, side="left")
+    count_le = jnp.searchsorted(sorted_x, x, side="right")
+    # a legitimate +inf value must not tie with the +inf padding sentinels:
+    # no valid entry can have more than n_valid entries <= it
+    n_valid = jnp.sum(valid)
+    count_le = jnp.minimum(count_le, n_valid)
+    return count_less.astype(jnp.float32) + (count_le - count_less + 1).astype(jnp.float32) / 2
 
 
 def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array]:
@@ -50,6 +61,27 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -
 
     corrcoef = cov / (preds_std * target_std + eps)
     return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def masked_spearman_corrcoef(preds: Array, target: Array, valid: Array, eps: float = 1e-6) -> Array:
+    """Spearman correlation over the valid entries — static shapes, jit-safe.
+
+    Powers ``SpearmanCorrcoef(capacity=...)``: ranks come from the masked
+    searchsorted formula, then a mask-weighted Pearson with the same eps
+    guard and clipping as :func:`_spearman_corrcoef_compute`.
+    """
+    rp = _masked_rank(preds, valid)
+    rt = _masked_rank(target, valid)
+    m = valid.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(m), 1.0)
+    mean_p = jnp.sum(rp * m) / n
+    mean_t = jnp.sum(rt * m) / n
+    dp = (rp - mean_p) * m
+    dt = (rt - mean_t) * m
+    cov = jnp.sum(dp * dt) / n
+    std_p = jnp.sqrt(jnp.sum(dp * dp) / n)
+    std_t = jnp.sqrt(jnp.sum(dt * dt) / n)
+    return jnp.clip(cov / (std_p * std_t + eps), -1.0, 1.0)
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:

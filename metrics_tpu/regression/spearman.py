@@ -1,14 +1,32 @@
-"""SpearmanCorrcoef module metric (parity: ``torchmetrics/regression/spearman.py:25``)."""
+"""SpearmanCorrcoef module metric (parity: ``torchmetrics/regression/spearman.py:25``).
+
+TPU extension — ``capacity``: a preallocated ``(capacity,)`` sample buffer
+(rank correlation needs the whole stream jointly, so unlike Pearson it cannot
+stream to moments) whose state structure is step-invariant: updates write in
+place under ``jit``, sync is a tiled ``all_gather`` + counter gather, and
+compute is the masked searchsorted rank formula over the valid entries.
+"""
 from typing import Any, Callable, Optional
 
-from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
+from metrics_tpu.utilities.capped_buffer import CappedBufferMixin
+from metrics_tpu.functional.regression.spearman import (
+    _spearman_corrcoef_compute,
+    _spearman_corrcoef_update,
+    masked_spearman_corrcoef,
+)
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array, dim_zero_cat
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 
-class SpearmanCorrcoef(Metric):
+class SpearmanCorrcoef(CappedBufferMixin, Metric):
     """Spearman rank correlation over all seen (preds, target) pairs.
+
+    Args:
+        capacity: when set, accumulate into a fixed-size ``(capacity,)``
+            buffer instead of unbounded lists — usable inside compiled
+            programs without per-step retracing; samples past the capacity
+            are dropped (warned about at eager compute).
 
     Example:
         >>> import jax.numpy as jnp
@@ -24,6 +42,7 @@ class SpearmanCorrcoef(Metric):
 
     def __init__(
         self,
+        capacity: Optional[int] = None,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -35,21 +54,34 @@ class SpearmanCorrcoef(Metric):
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
         )
-        rank_zero_warn(
-            "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
-            " For large datasets, this may lead to a large memory footprint."
-        )
-        self.add_state("preds_all", default=[], dist_reduce_fx="cat")
-        self.add_state("target_all", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        self.num_classes = None  # raw-value buffer; no class semantics
+
+        if capacity is not None:
+            self._init_raw_buffer_states(capacity)
+        else:
+            rank_zero_warn(
+                "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
+                " For large datasets, this may lead to a large memory footprint."
+            )
+            self.add_state("preds_all", default=[], dist_reduce_fx="cat")
+            self.add_state("target_all", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
-        """Append the batch pairs."""
+        """Append the batch pairs (buffered in place under ``capacity``)."""
         preds, target = _spearman_corrcoef_update(preds, target)
+        if self.capacity is not None:
+            self._raw_buffer_update(preds, target)
+            return
         self.preds_all.append(preds)
         self.target_all.append(target)
 
     def compute(self) -> Array:
         """Spearman correlation over everything seen so far."""
+        if self.capacity is not None:
+            preds, target, valid = self._buffer_flatten()
+            return masked_spearman_corrcoef(preds, target, valid)
+
         preds = dim_zero_cat(self.preds_all)
         target = dim_zero_cat(self.target_all)
         return _spearman_corrcoef_compute(preds, target)

@@ -1,24 +1,30 @@
-"""Fixed-capacity sample buffer for the curve-scalar metrics.
+"""Fixed-capacity sample buffer shared by the ``capacity=...`` metric modes.
 
-Backs the ``capacity=...`` mode of :class:`~metrics_tpu.AUROC` and
-:class:`~metrics_tpu.AveragePrecision`: a preallocated ``(capacity,)``
-score/label buffer plus a fill counter, giving a step-invariant state
-structure that lives inside ``jit``/``shard_map`` without retracing (the
-masked compute kernels are in ``functional/classification/masked_curves.py``).
+Backs :class:`~metrics_tpu.AUROC`, :class:`~metrics_tpu.AveragePrecision`
+(score/label buffers + masked curve kernels) and
+:class:`~metrics_tpu.SpearmanCorrcoef` (raw value buffers + masked ranks): a
+preallocated ``(capacity, ...)`` buffer plus a fill counter gives a
+step-invariant state structure that lives inside ``jit``/``shard_map``
+without retracing, syncs with one tiled ``all_gather``, and drops (and
+warns about) samples past the capacity.
 """
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.auroc import _auroc_update
 from metrics_tpu.utilities.data import Array, _is_traced, dim_zero_cat
 from metrics_tpu.utilities.enums import DataType
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 
+def _check_capacity(capacity: int) -> None:
+    if not (isinstance(capacity, int) and capacity > 0):
+        raise ValueError(f"`capacity` should be a positive integer, got: {capacity}")
+
+
 class CappedBufferMixin:
-    """State/update/mask logic shared by the fixed-capacity curve metrics."""
+    """State/update/mask logic shared by the fixed-capacity metric modes."""
 
     def _init_capacity_states(
         self, capacity: int, num_classes: Optional[int], pos_label: Optional[int]
@@ -29,8 +35,7 @@ class CappedBufferMixin:
         ``(capacity, C)`` score buffer with integer class labels, computed
         one-vs-rest at epoch end.
         """
-        if not (isinstance(capacity, int) and capacity > 0):
-            raise ValueError(f"`capacity` should be a positive integer, got: {capacity}")
+        _check_capacity(capacity)
         multiclass = num_classes is not None and num_classes > 1
         if not multiclass and pos_label not in (None, 0, 1):
             raise ValueError(f"`capacity` mode expects `pos_label` in (0, 1), got: {pos_label}")
@@ -45,7 +50,30 @@ class CappedBufferMixin:
     def _capacity_multiclass(self) -> bool:
         return self.num_classes is not None and self.num_classes > 1
 
+    def _init_raw_buffer_states(self, capacity: int, dtype=jnp.float32) -> None:
+        """Raw-value variant: preds/target kept verbatim (no canonicalization)."""
+        _check_capacity(capacity)
+        self.add_state("preds_buf", jnp.zeros((capacity,), dtype), dist_reduce_fx="cat")
+        self.add_state("target_buf", jnp.zeros((capacity,), dtype), dist_reduce_fx="cat")
+        self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="cat")
+
+    def _buffer_write(self, preds: Array, target: Array) -> None:
+        """Append one batch at the fill offset; writes past capacity drop,
+        the counter keeps the true total."""
+        idx = self.count + jnp.arange(preds.shape[0])
+        self.preds_buf = self.preds_buf.at[idx].set(preds, mode="drop")
+        self.target_buf = self.target_buf.at[idx].set(target, mode="drop")
+        self.count = self.count + preds.shape[0]
+
+    def _raw_buffer_update(self, preds: Array, target: Array) -> None:
+        dtype = self.preds_buf.dtype
+        self._buffer_write(
+            jnp.atleast_1d(preds).astype(dtype), jnp.atleast_1d(target).astype(dtype)
+        )
+
     def _buffer_update(self, preds: Array, target: Array) -> None:
+        from metrics_tpu.functional.classification.auroc import _auroc_update
+
         preds, target, mode = _auroc_update(preds, target)
         if self._capacity_multiclass:
             if mode != DataType.MULTICLASS or preds.ndim != 2 or preds.shape[1] != self.num_classes:
@@ -59,11 +87,7 @@ class CappedBufferMixin:
                 raise ValueError(f"`capacity` mode supports binary inputs only, got mode {mode}")
             pos_label = 1 if self.pos_label is None else self.pos_label
             target = (target == pos_label).astype(jnp.int32)
-        idx = self.count + jnp.arange(preds.shape[0])
-        # writes past the capacity are dropped; the counter keeps the true total
-        self.preds_buf = self.preds_buf.at[idx].set(preds.astype(jnp.float32), mode="drop")
-        self.target_buf = self.target_buf.at[idx].set(target, mode="drop")
-        self.count = self.count + preds.shape[0]
+        self._buffer_write(preds.astype(jnp.float32), target)
 
     def _buffer_flatten(self) -> Tuple[Array, Array, Array]:
         """(flat preds, flat target, valid mask) across however many shards the

@@ -373,3 +373,96 @@ def test_cosine_streaming_higher_rank_inputs():
     streaming.update(p, t)
     buffered.update(p, t)
     np.testing.assert_allclose(float(streaming.compute()), float(buffered.compute()), atol=1e-6)
+
+
+def test_spearman_capacity_mode():
+    import jax
+    from scipy.stats import spearmanr
+
+    from metrics_tpu.functional.regression.spearman import masked_spearman_corrcoef
+
+    rng = np.random.RandomState(61)
+
+    # masked kernel vs scipy, with heavy ties and padding
+    n, cap = 150, 200
+    preds = np.round(rng.rand(n), 1).astype(np.float32)
+    target = np.round(rng.rand(n), 1).astype(np.float32)
+    pp = np.zeros(cap, np.float32); pp[:n] = preds
+    tt = np.zeros(cap, np.float32); tt[:n] = target
+    valid = jnp.asarray(np.arange(cap) < n)
+    got = float(masked_spearman_corrcoef(jnp.asarray(pp), jnp.asarray(tt), valid))
+    np.testing.assert_allclose(got, spearmanr(preds, target).statistic, atol=1e-4)
+
+    # capacity metric accumulates across batches and matches list mode
+    capped = SpearmanCorrcoef(capacity=256)
+    listed = SpearmanCorrcoef()
+    for i in range(5):
+        p = jnp.asarray(rng.randn(32).astype(np.float32))
+        t = jnp.asarray((rng.randn(32) * 0.5 + np.asarray(p)).astype(np.float32))
+        capped.update(p, t)
+        listed.update(p, t)
+    np.testing.assert_allclose(float(capped.compute()), float(listed.compute()), atol=1e-4)
+
+    # jit-native: one trace across steps
+    metric = SpearmanCorrcoef(capacity=128)
+    traces = {"n": 0}
+
+    def step(state, p, t):
+        traces["n"] += 1
+        return metric.apply_update(state, p, t)
+
+    jitted = jax.jit(step)
+    state = metric.init_state()
+    for _ in range(4):
+        p = jnp.asarray(rng.randn(16).astype(np.float32))
+        state = jitted(state, p, p * 2 + 1)
+    assert traces["n"] == 1
+    np.testing.assert_allclose(float(metric.apply_compute(state)), 1.0, atol=1e-5)
+
+    # overflow warns and covers the first `capacity` samples
+    small = SpearmanCorrcoef(capacity=32)
+    p = rng.randn(50).astype(np.float32)
+    t = (rng.randn(50) * 0.1 + p).astype(np.float32)
+    small.update(jnp.asarray(p), jnp.asarray(t))
+    with pytest.warns(UserWarning, match="dropped"):
+        value = float(small.compute())
+    np.testing.assert_allclose(value, spearmanr(p[:32], t[:32]).statistic, atol=1e-4)
+
+
+def test_spearman_capacity_sharded():
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+    from scipy.stats import spearmanr
+
+    rng = np.random.RandomState(62)
+    n = 8 * 24
+    preds = rng.randn(n).astype(np.float32)
+    target = (rng.randn(n) * 0.4 + preds).astype(np.float32)
+
+    metric = SpearmanCorrcoef(capacity=24)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    def step(p, t):
+        state = metric.apply_update(metric.init_state(), p, t)
+        return metric.apply_compute(state, axis_name="data")
+
+    fn = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+    )
+    value = float(fn(
+        jax.device_put(jnp.asarray(preds), NamedSharding(mesh, P("data"))),
+        jax.device_put(jnp.asarray(target), NamedSharding(mesh, P("data"))),
+    ))
+    np.testing.assert_allclose(value, spearmanr(preds, target).statistic, atol=1e-4)
+
+
+def test_masked_rank_inf_value_vs_padding():
+    """A legitimate +inf pred must not tie with the +inf padding sentinels."""
+    from metrics_tpu.functional.regression.spearman import masked_spearman_corrcoef
+
+    preds = np.array([0.1, 0.5, np.inf, 0.3, 0.2] + [0.0] * 11, np.float32)
+    target = np.array([1.0, 2.0, 5.0, 1.5, 1.2] + [0.0] * 11, np.float32)
+    valid = jnp.asarray(np.arange(16) < 5)
+    got = float(masked_spearman_corrcoef(jnp.asarray(preds), jnp.asarray(target), valid))
+    np.testing.assert_allclose(got, 1.0, atol=1e-6)
