@@ -17,13 +17,21 @@ from metrics_tpu.utilities.data import Array
 
 def _rank_data(data: Array) -> Array:
     """Fractional ranks (1-based); ties get the mean of their rank block."""
-    return _masked_rank(data, jnp.ones(data.shape, bool)).astype(data.dtype)
+    return _masked_rank(data, jnp.ones(data.shape, bool))
 
 
 def _masked_rank(data: Array, valid: Array) -> Array:
     """Fractional ranks among the valid entries (invalid slots sort to +inf
-    and receive meaningless ranks — mask them out downstream)."""
-    x = jnp.where(valid, data.astype(jnp.float32), jnp.inf)
+    and receive meaningless ranks — mask them out downstream).
+
+    Ranks come back in the input's floating dtype (ints promote), so float64
+    streams keep full precision and integer ties still rank fractionally.
+    """
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        dtype = data.dtype
+    else:
+        dtype = jnp.promote_types(data.dtype, jnp.float32)
+    x = jnp.where(valid, data.astype(dtype), jnp.asarray(jnp.inf, dtype))
     sorted_x = jnp.sort(x)
     count_less = jnp.searchsorted(sorted_x, x, side="left")
     count_le = jnp.searchsorted(sorted_x, x, side="right")
@@ -31,7 +39,7 @@ def _masked_rank(data: Array, valid: Array) -> Array:
     # no valid entry can have more than n_valid entries <= it
     n_valid = jnp.sum(valid)
     count_le = jnp.minimum(count_le, n_valid)
-    return count_less.astype(jnp.float32) + (count_le - count_less + 1).astype(jnp.float32) / 2
+    return count_less.astype(dtype) + (count_le - count_less + 1).astype(dtype) / 2
 
 
 def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array]:
@@ -72,7 +80,7 @@ def masked_spearman_corrcoef(preds: Array, target: Array, valid: Array, eps: flo
     """
     rp = _masked_rank(preds, valid)
     rt = _masked_rank(target, valid)
-    m = valid.astype(jnp.float32)
+    m = valid.astype(rp.dtype)
     n = jnp.maximum(jnp.sum(m), 1.0)
     mean_p = jnp.sum(rp * m) / n
     mean_t = jnp.sum(rt * m) / n

@@ -466,3 +466,21 @@ def test_masked_rank_inf_value_vs_padding():
     valid = jnp.asarray(np.arange(16) < 5)
     got = float(masked_spearman_corrcoef(jnp.asarray(preds), jnp.asarray(target), valid))
     np.testing.assert_allclose(got, 1.0, atol=1e-6)
+
+
+def test_rank_data_precision_and_integer_ties():
+    from scipy.stats import spearmanr
+
+    from metrics_tpu.functional import spearman_corrcoef
+    from metrics_tpu.functional.regression.spearman import _rank_data
+
+    # integer inputs keep fractional tie ranks
+    got = float(spearman_corrcoef(jnp.asarray([1, 1, 2, 3], jnp.int32).astype(jnp.float32),
+                                  jnp.asarray([1, 2, 3, 3], jnp.int32).astype(jnp.float32)))
+    np.testing.assert_allclose(got, spearmanr([1, 1, 2, 3], [1, 2, 3, 3]).statistic, atol=1e-6)
+    ranks = np.asarray(_rank_data(jnp.asarray([1, 1, 2, 3], jnp.int32)))
+    np.testing.assert_allclose(ranks, [1.5, 1.5, 3.0, 4.0])
+
+    # float64 values that differ below f32 precision must not tie
+    data = jnp.asarray([16777216.0, 16777217.0, 0.0], jnp.float64)
+    np.testing.assert_allclose(np.asarray(_rank_data(data)), [2.0, 3.0, 1.0])
