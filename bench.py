@@ -70,33 +70,16 @@ def _bench_ours() -> float:
 
 def _bench_reference() -> float:
     """TorchMetrics (the reference) on torch-CPU, same workload."""
-    sys.path.insert(0, "/root/reference")
+    import os
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tests.helpers.reference_compat import REFERENCE_PATH, install_pkg_resources_shim
+
+    install_pkg_resources_shim()
+    sys.path.insert(0, REFERENCE_PATH)
     try:
-        if "pkg_resources" not in sys.modules:
-            # the reference's version gates use the long-removed pkg_resources API
-            import types
-
-            shim = types.ModuleType("pkg_resources")
-
-            class DistributionNotFound(Exception):
-                pass
-
-            def get_distribution(name):
-                import importlib.metadata
-
-                class _Dist:
-                    def __init__(self, version):
-                        self.version = version
-
-                try:
-                    return _Dist(importlib.metadata.version(name))
-                except importlib.metadata.PackageNotFoundError as err:
-                    raise DistributionNotFound(name) from err
-
-            shim.DistributionNotFound = DistributionNotFound
-            shim.get_distribution = get_distribution
-            sys.modules["pkg_resources"] = shim
-
         import torch
         from torchmetrics import Accuracy, F1, MetricCollection, Precision, Recall
 

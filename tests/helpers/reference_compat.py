@@ -1,0 +1,35 @@
+"""Compatibility shim for importing the reference TorchMetrics checkout.
+
+The reference's version gates use the long-removed ``pkg_resources`` API;
+one shared shim (used by ``bench.py`` and ``tests/parity/``) backs it with
+``importlib.metadata``.
+"""
+import sys
+import types
+
+REFERENCE_PATH = "/root/reference"
+
+
+def install_pkg_resources_shim() -> None:
+    if "pkg_resources" in sys.modules:
+        return
+    shim = types.ModuleType("pkg_resources")
+
+    class DistributionNotFound(Exception):
+        pass
+
+    def get_distribution(name):
+        import importlib.metadata
+
+        class _Dist:
+            def __init__(self, version):
+                self.version = version
+
+        try:
+            return _Dist(importlib.metadata.version(name))
+        except importlib.metadata.PackageNotFoundError as err:
+            raise DistributionNotFound(name) from err
+
+    shim.DistributionNotFound = DistributionNotFound
+    shim.get_distribution = get_distribution
+    sys.modules["pkg_resources"] = shim

@@ -1,0 +1,22 @@
+"""Make the reference TorchMetrics checkout importable (torch CPU)."""
+import sys
+
+import pytest
+
+from tests.helpers.reference_compat import REFERENCE_PATH, install_pkg_resources_shim
+
+
+@pytest.fixture(scope="session")
+def torchmetrics_ref():
+    """The reference torchmetrics package, or skip if unimportable."""
+    install_pkg_resources_shim()
+    if REFERENCE_PATH not in sys.path:
+        sys.path.insert(0, REFERENCE_PATH)
+    try:
+        import torchmetrics
+    except Exception as err:  # pragma: no cover
+        pytest.skip(f"reference torchmetrics not importable: {err}")
+    if not getattr(torchmetrics, "__file__", "").startswith(REFERENCE_PATH):
+        # a site-packages torchmetrics (different version) is NOT the reference
+        pytest.skip(f"torchmetrics resolved outside the reference checkout: {torchmetrics.__file__}")
+    return torchmetrics
