@@ -3,14 +3,15 @@
 Capability parity with the reference's ``torchmetrics/classification/
 auroc.py:26-192``: cat-reduced ``preds``/``target`` states with mode locking.
 
-TPU extension — ``capacity``: with ``AUROC(capacity=N)`` (binary only) the
-metric swaps its unbounded list states for a preallocated ``(N,)`` sample
-buffer plus a fill counter, so the whole lifecycle — update, cross-shard
-sync (one tiled ``all_gather`` + counter gather), and the masked sort-scan
-compute — runs inside a single compiled program with a step-invariant state
-structure (no per-step retracing, SURVEY hard part #1). Samples past the
-capacity are dropped (tracked by the counter; a warning is raised at eager
-compute).
+TPU extension — ``capacity``: with ``AUROC(capacity=N)`` the metric swaps
+its unbounded list states for a preallocated sample buffer plus a fill
+counter, so the whole lifecycle — update, cross-shard sync (one tiled
+``all_gather`` + counter gather), and the masked sort-scan compute — runs
+inside a single compiled program with a step-invariant state structure (no
+per-step retracing, SURVEY hard part #1). Binary by default; multiclass via
+``num_classes=C`` (one-vs-rest) and multilabel via additionally
+``multilabel=True``. Samples past the capacity are dropped (tracked by the
+counter; a warning is raised at eager compute).
 """
 from typing import Any, Callable, Optional
 
@@ -33,6 +34,9 @@ class AUROC(CappedBufferMixin, Metric):
             without retracing. Binary by default; with ``num_classes > 1``
             the buffer is ``(capacity, C)`` and the result is the
             one-vs-rest macro/weighted average. Incompatible with ``max_fpr``.
+        multilabel: capacity-mode hint that the ``(N, C)`` inputs are
+            per-label binaries rather than class probabilities (the list
+            mode infers this from data; a preallocated buffer cannot).
 
     Example:
         >>> import jax.numpy as jnp
@@ -54,6 +58,7 @@ class AUROC(CappedBufferMixin, Metric):
         average: Optional[str] = "macro",
         max_fpr: Optional[float] = None,
         capacity: Optional[int] = None,
+        multilabel: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -85,9 +90,11 @@ class AUROC(CappedBufferMixin, Metric):
             if max_fpr is not None:
                 raise ValueError("`capacity` mode does not support `max_fpr`")
             if num_classes is not None and num_classes > 1 and average not in ("macro", "weighted"):
-                raise ValueError("multiclass `capacity` mode supports average 'macro' or 'weighted'")
-            self._init_capacity_states(capacity, num_classes, pos_label)
+                raise ValueError("multi-column `capacity` mode supports average 'macro' or 'weighted'")
+            self._init_capacity_states(capacity, num_classes, pos_label, multilabel=multilabel)
         else:
+            if multilabel:
+                raise ValueError("`multilabel` is a `capacity`-mode hint; list mode infers it from data")
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
 
@@ -112,7 +119,7 @@ class AUROC(CappedBufferMixin, Metric):
         """AUROC over everything seen so far."""
         if self.capacity is not None:
             preds, target, valid = self._buffer_flatten()
-            if self._capacity_multiclass:
+            if self._capacity_multiclass or self._capacity_multilabel:
                 per_class = self._one_vs_rest(masked_binary_auroc, preds, target, valid)
                 if self.average == "weighted":
                     support = self._class_supports(target, valid)

@@ -27,28 +27,39 @@ class CappedBufferMixin:
     """State/update/mask logic shared by the fixed-capacity metric modes."""
 
     def _init_capacity_states(
-        self, capacity: int, num_classes: Optional[int], pos_label: Optional[int]
+        self, capacity: int, num_classes: Optional[int], pos_label: Optional[int], multilabel: bool = False
     ) -> None:
         """Validate the capacity-mode configuration and register the buffer states.
 
-        ``num_classes > 1`` switches to the multiclass layout: a
-        ``(capacity, C)`` score buffer with integer class labels, computed
-        one-vs-rest at epoch end.
+        ``num_classes > 1`` switches to the multi-column layout: a
+        ``(capacity, C)`` score buffer with integer class labels (multiclass,
+        one-vs-rest at epoch end) or per-label binary targets
+        (``multilabel=True``).
         """
         _check_capacity(capacity)
-        multiclass = num_classes is not None and num_classes > 1
-        if not multiclass and pos_label not in (None, 0, 1):
+        multi = num_classes is not None and num_classes > 1
+        if multilabel and not multi:
+            raise ValueError(
+                f"multilabel `capacity` mode needs `num_classes` > 1 (the label count), got {num_classes}"
+            )
+        if not multi and pos_label not in (None, 0, 1):
             raise ValueError(f"`capacity` mode expects `pos_label` in (0, 1), got: {pos_label}")
-        if multiclass and pos_label is not None:
-            raise ValueError("`pos_label` does not apply to multiclass `capacity` mode")
-        buf_shape = (capacity, num_classes) if multiclass else (capacity,)
+        if multi and pos_label is not None:
+            raise ValueError("`pos_label` does not apply to multi-column `capacity` mode")
+        self._capacity_multilabel = multilabel
+        buf_shape = (capacity, num_classes) if multi else (capacity,)
+        target_shape = (capacity, num_classes) if multilabel else (capacity,)
         self.add_state("preds_buf", jnp.full(buf_shape, -jnp.inf, jnp.float32), dist_reduce_fx="cat")
-        self.add_state("target_buf", jnp.zeros((capacity,), jnp.int32), dist_reduce_fx="cat")
+        self.add_state("target_buf", jnp.zeros(target_shape, jnp.int32), dist_reduce_fx="cat")
         self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="cat")
 
     @property
     def _capacity_multiclass(self) -> bool:
-        return self.num_classes is not None and self.num_classes > 1
+        return (
+            self.num_classes is not None
+            and self.num_classes > 1
+            and not getattr(self, "_capacity_multilabel", False)
+        )
 
     def _init_raw_buffer_states(self, capacity: int, dtype=jnp.float32) -> None:
         """Raw-value variant: preds/target kept verbatim (no canonicalization)."""
@@ -75,7 +86,14 @@ class CappedBufferMixin:
         from metrics_tpu.functional.classification.auroc import _auroc_update
 
         preds, target, mode = _auroc_update(preds, target)
-        if self._capacity_multiclass:
+        if getattr(self, "_capacity_multilabel", False):
+            if mode != DataType.MULTILABEL or preds.ndim != 2 or preds.shape[1] != self.num_classes:
+                raise ValueError(
+                    f"multilabel `capacity` mode with num_classes={self.num_classes} expects"
+                    f" (N, C) scores and (N, C) binary labels, got mode {mode} with preds shape {preds.shape}"
+                )
+            target = (target == 1).astype(jnp.int32)
+        elif self._capacity_multiclass:
             if mode != DataType.MULTICLASS or preds.ndim != 2 or preds.shape[1] != self.num_classes:
                 raise ValueError(
                     f"`capacity` mode with num_classes={self.num_classes} expects (N, C) class scores"
@@ -114,23 +132,33 @@ class CappedBufferMixin:
                 )
 
         valid = (jnp.arange(self.capacity)[None, :] < jnp.clip(counts, 0, self.capacity)[:, None]).reshape(-1)
-        if self._capacity_multiclass:
+        multilabel = getattr(self, "_capacity_multilabel", False)
+        if self._capacity_multiclass or multilabel:
             preds_flat = preds_buf.reshape(-1, self.num_classes)
         else:
             preds_flat = preds_buf.reshape(-1)
-        return preds_flat, target_buf.reshape(-1), valid
+        if multilabel:
+            target_flat = target_buf.reshape(-1, self.num_classes)
+        else:
+            target_flat = target_buf.reshape(-1)
+        return preds_flat, target_flat, valid
 
     def _one_vs_rest(self, kernel, preds: Array, target: Array, valid: Array) -> Array:
-        """Apply a masked binary curve kernel per class: ``(C,)`` values.
+        """Apply a masked binary curve kernel per class/label: ``(C,)`` values.
 
         Takes the already-flattened buffers so callers flatten (and gather,
-        in the sharded path) exactly once per compute.
+        in the sharded path) exactly once per compute. ``target`` is either
+        ``(M,)`` integer labels (one-vs-rest) or ``(M, C)`` per-label binaries.
         """
-        return jax.vmap(lambda c: kernel(preds[:, c], (target == c).astype(jnp.int32), valid))(
-            jnp.arange(self.num_classes)
-        )
+        if target.ndim == 2:
+            per_label = lambda c: kernel(preds[:, c], target[:, c], valid)  # noqa: E731
+        else:
+            per_label = lambda c: kernel(preds[:, c], (target == c).astype(jnp.int32), valid)  # noqa: E731
+        return jax.vmap(per_label)(jnp.arange(self.num_classes))
 
     def _class_supports(self, target: Array, valid: Array) -> Array:
-        """Valid-sample count per class (for weighted averaging)."""
+        """Valid positive count per class/label (for weighted averaging)."""
+        if target.ndim == 2:
+            return jnp.sum(target * valid[:, None], axis=0).astype(jnp.float32)
         onehot = (target[None, :] == jnp.arange(self.num_classes)[:, None]) & valid[None, :]
         return jnp.sum(onehot, axis=1).astype(jnp.float32)

@@ -17,6 +17,11 @@ from tests.conftest import NUM_DEVICES
 _rng = np.random.RandomState(17)
 
 
+def _normalize_rows(x):
+    # plain row normalization (rows sum to 1) so mode inference sees MULTICLASS
+    return x / x.sum(-1, keepdims=True)
+
+
 class TestMaskedKernels:
     @pytest.mark.parametrize("ties", [False, True])
     def test_auroc_vs_sklearn_with_padding(self, ties):
@@ -201,6 +206,47 @@ class TestMulticlassCapacity:
         ))
         expected = roc_auc_score(target, probs, multi_class="ovr", average="macro")
         np.testing.assert_allclose(value, expected, atol=1e-6)
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_auroc_multilabel_capacity_vs_sklearn(self, average):
+        n, c = 200, 4
+        probs = _rng.rand(n, c).astype(np.float32)
+        target = _rng.randint(0, 2, (n, c))
+        metric = AUROC(capacity=256, num_classes=c, multilabel=True, average=average)
+        metric.update(jnp.asarray(probs), jnp.asarray(target))
+        expected = roc_auc_score(target, probs, average=average)
+        np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-6)
+
+    def test_auroc_multilabel_capacity_accumulates_and_jits(self):
+        import jax as _jax
+
+        n, c = 64, 3
+        metric = AUROC(capacity=256, num_classes=c, multilabel=True)
+        step = _jax.jit(lambda s, p, t: metric.apply_update(s, p, t))
+        state = metric.init_state()
+        all_p, all_t = [], []
+        for _ in range(3):
+            p = _rng.rand(n, c).astype(np.float32)
+            t = _rng.randint(0, 2, (n, c))
+            all_p.append(p)
+            all_t.append(t)
+            state = step(state, jnp.asarray(p), jnp.asarray(t))
+        got = float(metric.apply_compute(state))
+        expected = roc_auc_score(np.concatenate(all_t), np.concatenate(all_p), average="macro")
+        np.testing.assert_allclose(got, expected, atol=1e-6)
+
+    def test_multilabel_capacity_invalid_args(self):
+        with pytest.raises(ValueError, match="num_classes"):
+            AUROC(capacity=16, multilabel=True)
+        with pytest.raises(ValueError, match="capacity"):
+            AUROC(multilabel=True)
+        metric = AUROC(capacity=16, num_classes=3, multilabel=True)
+        with pytest.raises(ValueError, match="multilabel"):
+            # multiclass-style integer labels are not (N, C) binaries
+            metric.update(
+                jnp.asarray(_normalize_rows(_rng.rand(8, 3).astype(np.float32))),
+                jnp.asarray(_rng.randint(0, 3, 8)),
+            )
 
     def test_multiclass_capacity_invalid_args(self):
         with pytest.raises(ValueError, match="average"):
