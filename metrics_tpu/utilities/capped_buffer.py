@@ -26,6 +26,10 @@ def _check_capacity(capacity: int) -> None:
 class CappedBufferMixin:
     """State/update/mask logic shared by the fixed-capacity metric modes."""
 
+    #: set True by _init_capacity_states(multilabel=True); class default keeps
+    #: plain attribute access safe for consumers that never set the flag
+    _capacity_multilabel = False
+
     def _init_capacity_states(
         self, capacity: int, num_classes: Optional[int], pos_label: Optional[int], multilabel: bool = False
     ) -> None:
@@ -58,7 +62,7 @@ class CappedBufferMixin:
         return (
             self.num_classes is not None
             and self.num_classes > 1
-            and not getattr(self, "_capacity_multilabel", False)
+            and not self._capacity_multilabel
         )
 
     def _init_raw_buffer_states(self, capacity: int, dtype=jnp.float32) -> None:
@@ -86,7 +90,7 @@ class CappedBufferMixin:
         from metrics_tpu.functional.classification.auroc import _auroc_update
 
         preds, target, mode = _auroc_update(preds, target)
-        if getattr(self, "_capacity_multilabel", False):
+        if self._capacity_multilabel:
             if mode != DataType.MULTILABEL or preds.ndim != 2 or preds.shape[1] != self.num_classes:
                 raise ValueError(
                     f"multilabel `capacity` mode with num_classes={self.num_classes} expects"
@@ -132,7 +136,7 @@ class CappedBufferMixin:
                 )
 
         valid = (jnp.arange(self.capacity)[None, :] < jnp.clip(counts, 0, self.capacity)[:, None]).reshape(-1)
-        multilabel = getattr(self, "_capacity_multilabel", False)
+        multilabel = self._capacity_multilabel
         if self._capacity_multiclass or multilabel:
             preds_flat = preds_buf.reshape(-1, self.num_classes)
         else:

@@ -235,6 +235,31 @@ class TestMulticlassCapacity:
         expected = roc_auc_score(np.concatenate(all_t), np.concatenate(all_p), average="macro")
         np.testing.assert_allclose(got, expected, atol=1e-6)
 
+    def test_auroc_multilabel_capacity_sharded(self):
+        # the multilabel mode is the only one pushing a 2-D target buffer
+        # through the cat sync + flatten path — cover it on the mesh
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        n, c = NUM_DEVICES * 24, 3
+        probs = _rng.rand(n, c).astype(np.float32)
+        target = _rng.randint(0, 2, (n, c))
+        metric = AUROC(capacity=24, num_classes=c, multilabel=True)
+        mesh = Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("data",))
+
+        def step(p, t):
+            state = metric.apply_update(metric.init_state(), p, t)
+            return metric.apply_compute(state, axis_name="data")
+
+        fn = jax.jit(
+            jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        )
+        value = float(fn(
+            jax.device_put(jnp.asarray(probs), NamedSharding(mesh, P("data"))),
+            jax.device_put(jnp.asarray(target), NamedSharding(mesh, P("data"))),
+        ))
+        np.testing.assert_allclose(value, roc_auc_score(target, probs, average="macro"), atol=1e-6)
+
     def test_multilabel_capacity_invalid_args(self):
         with pytest.raises(ValueError, match="num_classes"):
             AUROC(capacity=16, multilabel=True)
