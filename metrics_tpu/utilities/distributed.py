@@ -18,7 +18,7 @@ re-designed TPU-first with two complementary sync paths:
   ``jax.experimental.multihost_utils`` since XLA collectives need static,
   equal shapes across participants.
 """
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp

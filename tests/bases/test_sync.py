@@ -9,7 +9,6 @@ bookkeeping, state-restore semantics).
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 

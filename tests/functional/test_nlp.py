@@ -1,7 +1,6 @@
 """BLEU parity vs the NLTK oracle (reference pattern:
 ``tests/functional/test_nlp.py``, which compares against
 ``nltk.translate.bleu_score.corpus_bleu``)."""
-import jax.numpy as jnp
 import numpy as np
 import pytest
 from nltk.translate.bleu_score import SmoothingFunction, corpus_bleu

@@ -16,17 +16,16 @@ JAX translation of the reference's ``tests/helpers/testers.py`` strategy:
 """
 import pickle
 from functools import partial
-from typing import Any, Callable, Dict, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.utilities.data import apply_to_collection, dim_zero_cat
+from metrics_tpu.utilities.data import apply_to_collection
 
 NUM_PROCESSES = 2
 NUM_BATCHES = 10

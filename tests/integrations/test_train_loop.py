@@ -7,12 +7,9 @@ trained with optax where the metric state threads through the jitted train
 step, plus the same loop distributed over the 8-device CPU mesh with
 ``shard_map`` and mesh-axis sync at epoch end.
 """
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 import flax.linen as nn
 import optax

@@ -1,6 +1,5 @@
 """Retrieval-family parity vs an independent numpy oracle implementing the
 reference's per-query loop semantics (``retrieval/retrieval_metric.py:104-133``)."""
-import math
 
 import jax.numpy as jnp
 import numpy as np
