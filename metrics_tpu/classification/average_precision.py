@@ -27,6 +27,9 @@ class AveragePrecision(CappedBufferMixin, Metric):
             without per-step retracing. Binary by default; with
             ``num_classes > 1`` compute returns the per-class one-vs-rest
             APs as a ``(C,)`` array.
+        multilabel: capacity-mode hint that the ``(N, C)`` inputs are
+            per-label binaries rather than class probabilities (the list
+            mode infers this from data; a preallocated buffer cannot).
 
     Example:
         >>> import jax.numpy as jnp
@@ -46,6 +49,7 @@ class AveragePrecision(CappedBufferMixin, Metric):
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
         capacity: Optional[int] = None,
+        multilabel: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -62,8 +66,10 @@ class AveragePrecision(CappedBufferMixin, Metric):
         self.capacity = capacity
 
         if capacity is not None:
-            self._init_capacity_states(capacity, num_classes, pos_label)
+            self._init_capacity_states(capacity, num_classes, pos_label, multilabel=multilabel)
         else:
+            if multilabel:
+                raise ValueError("`multilabel` is a `capacity`-mode hint; list mode infers it from data")
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
 
@@ -85,9 +91,10 @@ class AveragePrecision(CappedBufferMixin, Metric):
         """Average precision over everything seen so far."""
         if self.capacity is not None:
             preds, target, valid = self._buffer_flatten()
-            if self._capacity_multiclass:
-                # per-class one-vs-rest APs as a (C,) array (the list-mode
-                # API returns a Python list; in-graph results must be arrays)
+            if self._capacity_multiclass or self._capacity_multilabel:
+                # per-class/label one-vs-rest APs as a (C,) array (the
+                # list-mode API returns a Python list; in-graph results
+                # must be arrays)
                 return self._one_vs_rest(masked_binary_average_precision, preds, target, valid)
             return masked_binary_average_precision(preds, target, valid)
 

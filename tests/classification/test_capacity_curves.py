@@ -217,6 +217,18 @@ class TestMulticlassCapacity:
         expected = roc_auc_score(target, probs, average=average)
         np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-6)
 
+    def test_ap_multilabel_capacity_vs_sklearn(self):
+        n, c = 200, 4
+        probs = _rng.rand(n, c).astype(np.float32)
+        target = _rng.randint(0, 2, (n, c))
+        metric = AveragePrecision(capacity=256, num_classes=c, multilabel=True)
+        metric.update(jnp.asarray(probs), jnp.asarray(target))
+        got = np.asarray(metric.compute())
+        for label in range(c):
+            np.testing.assert_allclose(
+                got[label], average_precision_score(target[:, label], probs[:, label]), atol=1e-6
+            )
+
     def test_auroc_multilabel_capacity_accumulates_and_jits(self):
         import jax as _jax
 
