@@ -1,0 +1,143 @@
+"""Guard the north-star collective staging: a whole MetricCollection's
+epoch-end sync must compile to O(1) collectives, not O(num_states).
+
+The reference issues (1 barrier + 2 all_gathers) per registered state at
+``compute()`` (``torchmetrics/utilities/distributed.py:92-149``,
+``metric.py:200-225``) — ~25-45 sequential collectives for a 10-metric
+collection. Here every psum-family state rides one combined all-reduce
+(XLA's all-reduce combiner merges the per-state ops emitted by
+``sync_in_graph``), which these tests pin down by counting collective ops
+in the compiled HLO.
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="collective staging needs a multi-device mesh"
+)
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
+    F1,
+    HammingDistance,
+    IoU,
+    MatthewsCorrcoef,
+    MetricCollection,
+    Precision,
+    Recall,
+    Specificity,
+)
+
+NC = 5
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), ("data",))
+
+
+def _collective_counts(compiled_text):
+    counts = {}
+    for op in ("all-reduce", "all-gather", "all-to-all", "collective-permute"):
+        counts[op] = len(re.findall(rf"{op}(?:-start)?\(", compiled_text))
+    return counts
+
+
+def _ten_metric_collection():
+    return MetricCollection(
+        [
+            Accuracy(),
+            Precision(average="macro", num_classes=NC),
+            Recall(average="macro", num_classes=NC),
+            F1(average="macro", num_classes=NC),
+            Specificity(average="macro", num_classes=NC),
+            HammingDistance(),
+            ConfusionMatrix(num_classes=NC),
+            CohenKappa(num_classes=NC),
+            MatthewsCorrcoef(num_classes=NC),
+            IoU(num_classes=NC),
+        ]
+    )
+
+
+def test_ten_metric_sync_is_one_allreduce():
+    """All sum-reduced states across 10 metrics combine into a single
+    all-reduce (22+ registered states in the reference's cost model)."""
+    coll = _ten_metric_collection()
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(64, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, 64))
+    state = coll.apply_update(coll.init_state(), preds, target)
+
+    mesh = _mesh()
+    fn = jax.jit(
+        jax.shard_map(
+            lambda s: coll.apply_compute(s, axis_name="data"),
+            mesh=mesh,
+            in_specs=(P(),),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    counts = _collective_counts(fn.lower(state).compile().as_text())
+    # one combined all-reduce; allow one extra for a dtype group, never O(states)
+    assert 1 <= counts["all-reduce"] <= 2, counts
+    assert counts["all-gather"] == 0, counts
+    assert counts["all-to-all"] == 0, counts
+
+
+def test_sync_values_match_sequential_after_combining():
+    """The combined collective computes the same values as the unsharded path."""
+    coll = _ten_metric_collection()
+    rng = np.random.RandomState(1)
+    preds = jnp.asarray(rng.rand(64, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, 64))
+
+    mesh = _mesh()
+
+    def sharded(p, t):
+        state = coll.apply_update(coll.init_state(), p, t)
+        return coll.apply_compute(state, axis_name="data")
+
+    fn = jax.jit(
+        jax.shard_map(
+            sharded, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False
+        )
+    )
+    values = jax.tree.map(np.asarray, fn(preds, target))
+
+    seq_state = coll.apply_update(coll.init_state(), preds, target)
+    expected = jax.tree.map(np.asarray, coll.apply_compute(seq_state))
+    for key in expected:
+        np.testing.assert_allclose(values[key], expected[key], atol=1e-6, err_msg=key)
+
+
+def test_capacity_auroc_sync_is_bounded():
+    """A cat-capacity state syncs with a bounded number of all-gathers
+    (buffer + counter), not one per accumulated batch."""
+    auroc = AUROC(capacity=256)
+    rng = np.random.RandomState(2)
+    preds = jnp.asarray(rng.rand(64).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, 64))
+    state = auroc.apply_update(auroc.init_state(), preds, target)
+
+    fn = jax.jit(
+        jax.shard_map(
+            lambda s: auroc.apply_compute(s, axis_name="data"),
+            mesh=_mesh(),
+            in_specs=(P(),),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    counts = _collective_counts(fn.lower(state).compile().as_text())
+    assert counts["all-gather"] <= 3, counts
+    assert counts["all-reduce"] <= 2, counts
