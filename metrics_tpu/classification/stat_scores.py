@@ -133,6 +133,15 @@ class StatScores(Metric):
 
     def _accumulate(self, tp: Array, fp: Array, tn: Array, fn: Array) -> None:
         """Add fixed-shape counts in place, or append samplewise counts."""
+        if self.mdmc_reduce == "samplewise" and self.reduce == "micro" and tp.ndim == 0:
+            # 0-dim per-batch stats cannot be accumulated samplewise; the
+            # reference crashes at compute() for this combo (0-dim concat,
+            # ``classification/stat_scores.py:223-236``) while its functional
+            # path works — so the guard lives here, not in the functional
+            # kernel
+            raise ValueError(
+                "`mdmc_reduce='samplewise'` with `reduce='micro'` requires multi-dimensional multi-class inputs"
+            )
         if self.reduce != AverageMethod.SAMPLES and self.mdmc_reduce != MDMCAverageMethod.SAMPLEWISE:
             self.tp = self.tp + tp
             self.fp = self.fp + fp

@@ -147,7 +147,9 @@ def _reduce_stat_scores(
     # all-classes-ignored under 'weighted' -> 0/0; map NaN to zero_division
     scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
 
-    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE and scores.ndim > 0:
+        # ndim guard: micro stats on 2-dim inputs are 0-dim here, and torch's
+        # ``mean(dim=0)`` accepts that where jnp.mean(axis=0) cannot
         scores = jnp.mean(scores, axis=0)
         ignore_mask = jnp.sum(ignore_mask, axis=0).astype(bool)
 

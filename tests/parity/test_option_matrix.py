@@ -1,0 +1,187 @@
+"""Exhaustive option-matrix parity for the stat-scores family.
+
+The reference's own suites sweep ``ignore_index``/``top_k``/``mdmc`` across
+every input case (``tests/classification/test_stat_scores.py:136-199``,
+``test_precision_recall.py``, ``test_accuracy.py``); this battery does the
+same sweep but uses the reference implementation directly as the oracle:
+identical multi-batch streams go through both libraries and ``compute()``
+must agree elementwise (NaN-equal). Combos the reference rejects must raise
+on our side too — error parity is part of the contract.
+"""
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import metrics_tpu
+
+_rng = np.random.RandomState(31)
+NUM_BATCHES = 4
+BATCH = 24
+NC = 3
+EXTRA = 5
+
+_mc_probs = _rng.rand(NUM_BATCHES, BATCH, NC).astype(np.float32)
+_mc_probs /= _mc_probs.sum(-1, keepdims=True)
+_mc_target = _rng.randint(0, NC, (NUM_BATCHES, BATCH))
+_mc_labels = _rng.randint(0, NC, (NUM_BATCHES, BATCH))
+_ml_probs = _rng.rand(NUM_BATCHES, BATCH, NC).astype(np.float32)
+_ml_target = _rng.randint(0, 2, (NUM_BATCHES, BATCH, NC))
+_bin_probs = _rng.rand(NUM_BATCHES, BATCH).astype(np.float32)
+_bin_target = _rng.randint(0, 2, (NUM_BATCHES, BATCH))
+_mdmc_probs = _rng.rand(NUM_BATCHES, BATCH, NC, EXTRA).astype(np.float32)
+_mdmc_probs /= _mdmc_probs.sum(2, keepdims=True)
+_mdmc_target = _rng.randint(0, NC, (NUM_BATCHES, BATCH, EXTRA))
+
+INPUT_KINDS = {
+    "mc_probs": (_mc_probs, _mc_target),
+    "mc_labels": (_mc_labels, _mc_target),
+    "multilabel": (_ml_probs, _ml_target),
+    "binary": (_bin_probs, _bin_target),
+    "mdmc": (_mdmc_probs, _mdmc_target),
+}
+
+
+def _stream_both(ours, theirs, preds, target, atol=1e-5):
+    """Run identical batch streams through both libraries.
+
+    Returns after asserting value parity; if the reference raises, our side
+    must raise too (any exception type — the messages differ by design).
+    """
+    try:
+        for i in range(NUM_BATCHES):
+            theirs.update(torch.from_numpy(np.asarray(preds[i])), torch.from_numpy(np.asarray(target[i])))
+        theirs_val = theirs.compute()
+    except Exception:
+        with pytest.raises(Exception):
+            for i in range(NUM_BATCHES):
+                ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            np.asarray(ours.compute())
+        return
+
+    for i in range(NUM_BATCHES):
+        ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    ours_np = np.asarray(jnp.asarray(ours.compute()), dtype=np.float64)
+    theirs_np = np.asarray(theirs_val.detach().numpy(), dtype=np.float64)
+    np.testing.assert_allclose(ours_np, theirs_np, atol=atol)
+
+
+STAT_SCORES_GRID = [
+    pytest.param(kind, reduce, mdmc, ignore_index, top_k, id=f"{kind}-{reduce}-{mdmc}-ig{ignore_index}-k{top_k}")
+    for kind, reduce, mdmc, ignore_index, top_k in itertools.product(
+        INPUT_KINDS,
+        ["micro", "macro", "samples"],
+        [None, "global", "samplewise"],
+        [None, 0],
+        [None, 2],
+    )
+]
+
+
+@pytest.mark.parametrize("kind, reduce, mdmc, ignore_index, top_k", STAT_SCORES_GRID)
+def test_stat_scores_option_matrix(torchmetrics_ref, kind, reduce, mdmc, ignore_index, top_k):
+    preds, target = INPUT_KINDS[kind]
+    kwargs = dict(
+        reduce=reduce,
+        mdmc_reduce=mdmc,
+        num_classes=NC if reduce == "macro" or kind == "mdmc" else None,
+        ignore_index=ignore_index,
+        top_k=top_k,
+    )
+    _stream_both(
+        metrics_tpu.StatScores(**kwargs),
+        torchmetrics_ref.StatScores(**kwargs),
+        preds,
+        target,
+    )
+
+
+PRF_GRID = [
+    pytest.param(name, kind, average, mdmc, ignore_index, id=f"{name}-{kind}-{average}-{mdmc}-ig{ignore_index}")
+    for name, kind, average, mdmc, ignore_index in itertools.product(
+        ["Precision", "Recall", "F1", "Specificity"],
+        ["mc_probs", "multilabel", "binary", "mdmc"],
+        ["micro", "macro", "weighted", "none", "samples"],
+        [None, "global", "samplewise"],
+        [None, 0],
+    )
+]
+
+
+@pytest.mark.parametrize("name, kind, average, mdmc, ignore_index", PRF_GRID)
+def test_prf_option_matrix(torchmetrics_ref, name, kind, average, mdmc, ignore_index):
+    preds, target = INPUT_KINDS[kind]
+    kwargs = dict(
+        average=average,
+        mdmc_average=mdmc,
+        num_classes=NC if average in ("macro", "weighted", "none") or kind == "mdmc" else None,
+        ignore_index=ignore_index,
+    )
+    _stream_both(
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
+        preds,
+        target,
+    )
+
+
+ACC_GRID = [
+    pytest.param(kind, mdmc, ignore_index, top_k, subset, id=f"{kind}-{mdmc}-ig{ignore_index}-k{top_k}-sub{subset}")
+    for kind, mdmc, ignore_index, top_k, subset in itertools.product(
+        INPUT_KINDS,
+        [None, "global", "samplewise"],
+        [None, 0],
+        [None, 2],
+        [False, True],
+    )
+]
+
+
+def test_functional_micro_samplewise_2d_matches_reference(torchmetrics_ref):
+    """The reference's FUNCTIONAL path returns values for micro+samplewise on
+    2-dim inputs even though its class path crashes at compute() — our
+    functional must match the values, and only the class path may raise."""
+    import torchmetrics.functional as tf
+
+    import metrics_tpu.functional as F
+
+    preds, target = INPUT_KINDS["mc_probs"]
+    theirs = tf.stat_scores(
+        torch.from_numpy(np.asarray(preds[0])),
+        torch.from_numpy(np.asarray(target[0])),
+        reduce="micro",
+        mdmc_reduce="samplewise",
+    )
+    ours = F.stat_scores(
+        jnp.asarray(preds[0]), jnp.asarray(target[0]), reduce="micro", mdmc_reduce="samplewise"
+    )
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy())
+
+    acc_theirs = tf.accuracy(
+        torch.from_numpy(np.asarray(preds[0])),
+        torch.from_numpy(np.asarray(target[0])),
+        mdmc_average="samplewise",
+    )
+    acc_ours = F.accuracy(
+        jnp.asarray(preds[0]), jnp.asarray(target[0]), mdmc_average="samplewise"
+    )
+    np.testing.assert_allclose(np.asarray(acc_ours), acc_theirs.numpy(), atol=1e-6)
+
+
+@pytest.mark.parametrize("kind, mdmc, ignore_index, top_k, subset", ACC_GRID)
+def test_accuracy_option_matrix(torchmetrics_ref, kind, mdmc, ignore_index, top_k, subset):
+    preds, target = INPUT_KINDS[kind]
+    kwargs = dict(
+        mdmc_average=mdmc,
+        ignore_index=ignore_index,
+        top_k=top_k,
+        subset_accuracy=subset,
+    )
+    _stream_both(
+        metrics_tpu.Accuracy(**kwargs),
+        torchmetrics_ref.Accuracy(**kwargs),
+        preds,
+        target,
+    )
