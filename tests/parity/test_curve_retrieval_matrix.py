@@ -1,0 +1,149 @@
+"""Option-matrix parity for curve metrics and the retrieval family.
+
+Companion to ``test_option_matrix.py`` (stat-scores family): identical
+multi-batch streams through both libraries, reference as oracle, error
+parity included. Covers the reference's AUROC/AP/ROC/PR-curve option axes
+(``num_classes``/``pos_label``/``average``/``max_fpr``) and the retrieval
+family's ``empty_target_action`` × ``k`` grid with adversarial group
+layouts (empty-target and empty-negative queries).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import metrics_tpu
+
+_rng = np.random.RandomState(53)
+NUM_BATCHES = 4
+BATCH = 32
+NC = 4
+
+_bin_probs = _rng.rand(NUM_BATCHES, BATCH).astype(np.float32)
+_bin_target = _rng.randint(0, 2, (NUM_BATCHES, BATCH))
+_mc_probs = _rng.rand(NUM_BATCHES, BATCH, NC).astype(np.float32)
+_mc_probs /= _mc_probs.sum(-1, keepdims=True)
+_mc_target = _rng.randint(0, NC, (NUM_BATCHES, BATCH))
+# adversarial: one class never appears as a target in one batch
+_mc_target[1][_mc_target[1] == 2] = 0
+
+
+def _to_np(x):
+    if isinstance(x, (list, tuple)):
+        return [_to_np(v) for v in x]
+    return np.asarray(x, dtype=np.float64)
+
+
+def _assert_close(ours, theirs, atol):
+    if isinstance(theirs, (list, tuple)):
+        assert isinstance(ours, (list, tuple)) and len(ours) == len(theirs)
+        for o, t in zip(ours, theirs):
+            _assert_close(o, t, atol)
+        return
+    t = np.asarray(theirs.detach().numpy() if torch.is_tensor(theirs) else theirs, dtype=np.float64)
+    np.testing.assert_allclose(np.asarray(jnp.asarray(ours), dtype=np.float64), t, atol=atol)
+
+
+def _stream_both(ours, theirs, batches, atol=1e-5):
+    try:
+        for args in batches:
+            theirs.update(*[torch.from_numpy(np.asarray(a)) for a in args])
+        theirs_val = theirs.compute()
+    except Exception:
+        with pytest.raises(Exception):
+            for args in batches:
+                ours.update(*[jnp.asarray(a) for a in args])
+            _to_np(ours.compute())
+        return
+    for args in batches:
+        ours.update(*[jnp.asarray(a) for a in args])
+    _assert_close(ours.compute(), theirs_val, atol)
+
+
+CURVE_GRID = [
+    pytest.param(name, kwargs, kind, id=f"{name}-{'-'.join(f'{k}={v}' for k, v in kwargs.items()) or 'default'}-{kind}")
+    for name, kwargs, kind in [
+        ("AUROC", {}, "binary"),
+        ("AUROC", {"pos_label": 1}, "binary"),
+        ("AUROC", {"max_fpr": 0.5}, "binary"),
+        ("AUROC", {"max_fpr": 0.9}, "binary"),
+        ("AUROC", {"num_classes": NC, "average": "macro"}, "multiclass"),
+        ("AUROC", {"num_classes": NC, "average": "weighted"}, "multiclass"),
+        # reference rejects micro for multiclass-with-missing-class data at
+        # compute; keep for error parity
+        ("AUROC", {"num_classes": NC, "average": "micro"}, "multiclass"),
+        ("AUROC", {"num_classes": NC}, "binary"),  # mismatched config
+        ("AveragePrecision", {}, "binary"),
+        ("AveragePrecision", {"pos_label": 1}, "binary"),
+        ("AveragePrecision", {"num_classes": NC}, "multiclass"),
+        ("ROC", {}, "binary"),
+        ("ROC", {"pos_label": 0}, "binary"),
+        ("ROC", {"num_classes": NC}, "multiclass"),
+        ("PrecisionRecallCurve", {}, "binary"),
+        ("PrecisionRecallCurve", {"pos_label": 0}, "binary"),
+        ("PrecisionRecallCurve", {"num_classes": NC}, "multiclass"),
+    ]
+]
+
+
+@pytest.mark.parametrize("name, kwargs, kind", CURVE_GRID)
+def test_curve_option_matrix(torchmetrics_ref, name, kwargs, kind):
+    if kind == "binary":
+        batches = [(_bin_probs[i], _bin_target[i]) for i in range(NUM_BATCHES)]
+    else:
+        batches = [(_mc_probs[i], _mc_target[i]) for i in range(NUM_BATCHES)]
+    _stream_both(
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
+        batches,
+    )
+
+
+# ---------------------------------------------------------------- retrieval
+QUERIES = 12
+DOCS = 6
+
+
+def _make_retrieval_batches():
+    """(preds, target, indexes) batches with empty-target and empty-negative
+    groups baked in to exercise every empty_target_action policy."""
+    rng = np.random.RandomState(91)
+    batches = []
+    for _ in range(NUM_BATCHES):
+        idx = np.repeat(np.arange(QUERIES), DOCS)
+        preds = rng.rand(QUERIES * DOCS).astype(np.float32)
+        target = rng.randint(0, 2, QUERIES * DOCS)
+        target[idx == 3] = 0  # query 3: no positives
+        target[idx == 7] = 1  # query 7: no negatives
+        batches.append((preds, target, idx))
+    return batches
+
+
+_RETRIEVAL_BATCHES = _make_retrieval_batches()
+
+
+RETRIEVAL_GRID = [
+    pytest.param(name, kwargs, id=f"{name}-{'-'.join(f'{k}={v}' for k, v in kwargs.items()) or 'default'}")
+    for name, base_kwargs in [
+        ("RetrievalMAP", {}),
+        ("RetrievalMRR", {}),
+        ("RetrievalPrecision", {"k": None}),
+        ("RetrievalPrecision", {"k": 3}),
+        ("RetrievalRecall", {"k": None}),
+        ("RetrievalRecall", {"k": 3}),
+        ("RetrievalFallOut", {"k": 3}),
+        ("RetrievalNormalizedDCG", {"k": None}),
+        ("RetrievalNormalizedDCG", {"k": 3}),
+    ]
+    for action in ["neg", "pos", "skip", "error"]
+    for kwargs in [dict(base_kwargs, empty_target_action=action)]
+]
+
+
+@pytest.mark.parametrize("name, kwargs", RETRIEVAL_GRID)
+def test_retrieval_option_matrix(torchmetrics_ref, name, kwargs):
+    _stream_both(
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
+        _RETRIEVAL_BATCHES,
+    )
