@@ -13,7 +13,10 @@ from metrics_tpu.utilities.data import Array
 
 def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
     _check_same_shape(preds, target)
-    return preds.astype(jnp.float32), target.astype(jnp.float32)
+    # the reference's ``.float()`` upcasts ints/halves to fp32; promote instead
+    # of a hard cast so float64 inputs keep their precision
+    dtype = jnp.promote_types(jnp.promote_types(preds.dtype, target.dtype), jnp.float32)
+    return preds.astype(dtype), target.astype(dtype)
 
 
 def _cosine_similarity_compute(preds: Array, target: Array, reduction: str = "sum") -> Array:

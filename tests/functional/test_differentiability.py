@@ -1,0 +1,49 @@
+"""Gradcheck battery for every remaining differentiable functional.
+
+The mean-error and audio families run their checks inside their own
+``MetricTester`` suites; this file covers the rest of the
+``is_differentiable=True`` surface (the reference runs
+``torch.autograd.gradcheck`` per metric, ``testers.py:490-494``) with the
+shared directional finite-difference harness.
+"""
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.append("tests")
+import metrics_tpu
+import metrics_tpu.functional as F
+from helpers.testers import MetricTester
+
+_rng = np.random.RandomState(19)
+NB, BATCH, NC = 2, 16, 4
+
+_reg_preds = _rng.randn(NB, BATCH).astype(np.float64)
+_reg_target = (_reg_preds * 0.8 + 0.3 * _rng.randn(NB, BATCH)).astype(np.float64)
+_vec_preds = _rng.randn(NB, BATCH, NC).astype(np.float64)
+_vec_target = _rng.randn(NB, BATCH, NC).astype(np.float64)
+_probs = _rng.rand(NB, BATCH, NC).astype(np.float64)
+_probs /= _probs.sum(-1, keepdims=True)
+_probs2 = np.roll(_probs, 1, axis=1)
+_int_target = _rng.randint(0, NC, (NB, BATCH))
+_imgs_a = _rng.rand(NB, 2, 1, 24, 24).astype(np.float64)
+_imgs_b = np.clip(_imgs_a + 0.1 * _rng.randn(NB, 2, 1, 24, 24), 0, 1).astype(np.float64)
+
+CASES = [
+    pytest.param(metrics_tpu.CosineSimilarity(), F.cosine_similarity, _vec_preds, _vec_target, {}, id="cosine"),
+    pytest.param(metrics_tpu.ExplainedVariance(), F.explained_variance, _reg_preds, _reg_target, {}, id="explained_variance"),
+    pytest.param(metrics_tpu.R2Score(), F.r2score, _reg_preds, _reg_target, {}, id="r2score"),
+    pytest.param(metrics_tpu.PearsonCorrcoef(), F.pearson_corrcoef, _reg_preds, _reg_target, {}, id="pearson"),
+    pytest.param(metrics_tpu.Hinge(), F.hinge, _reg_preds, (_reg_preds > 0).astype(np.int64), {}, id="hinge_binary"),
+    pytest.param(metrics_tpu.KLDivergence(), F.kldivergence, _probs, _probs2, {}, id="kldivergence"),
+    pytest.param(metrics_tpu.PSNR(data_range=1.0), F.psnr, _probs, _probs2, {"data_range": 1.0}, id="psnr"),
+    pytest.param(
+        metrics_tpu.SSIM(data_range=1.0), F.ssim, _imgs_a, _imgs_b, {"data_range": 1.0}, id="ssim"
+    ),
+]
+
+
+@pytest.mark.parametrize("module, fn, preds, target, kwargs", CASES)
+def test_differentiability(module, fn, preds, target, kwargs):
+    MetricTester().run_differentiability_test(preds, target, module, fn, metric_args=kwargs)

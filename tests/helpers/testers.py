@@ -205,13 +205,30 @@ class MetricTester:
         metric_args: Optional[dict] = None,
     ) -> None:
         """``jax.grad`` through the functional must yield finite gradients when
-        the module declares itself differentiable."""
+        the module declares itself differentiable, and the gradient must match
+        a central finite difference along a random direction — the analogue of
+        the reference's ``torch.autograd.gradcheck`` (``testers.py:490-494``)."""
         metric_args = metric_args or {}
         p = jnp.asarray(preds[0], dtype=jnp.float64)
         t = jnp.asarray(target[0])
-        if metric_module.is_differentiable:
-            grad = jax.grad(lambda x: jnp.sum(jnp.asarray(metric_functional(x, t, **metric_args))))(p)
-            assert bool(jnp.all(jnp.isfinite(grad)))
+        if not metric_module.is_differentiable:
+            return
+
+        def loss(x):
+            return jnp.sum(jnp.asarray(metric_functional(x, t, **metric_args)))
+
+        grad = jax.grad(loss)(p)
+        assert bool(jnp.all(jnp.isfinite(grad)))
+
+        rng = np.random.RandomState(11)
+        direction = jnp.asarray(rng.randn(*p.shape))
+        direction = direction / jnp.linalg.norm(direction.ravel())
+        eps = 1e-6
+        numeric = (loss(p + eps * direction) - loss(p - eps * direction)) / (2 * eps)
+        analytic = jnp.vdot(grad.ravel(), direction.ravel())
+        np.testing.assert_allclose(
+            float(analytic), float(numeric), rtol=1e-3, atol=1e-5
+        )
 
 
 class DummyMetric(Metric):
