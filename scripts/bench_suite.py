@@ -278,6 +278,48 @@ def bench_image_audio():
     return "ssim_psnr_sisdr_update_step", ours, ref
 
 
+# ------------------------------------------------------- epoch-end compute
+def bench_auroc_compute():
+    """AUROC epoch-end compute on full 200k-sample buffers — the sort-scan
+    kernel (sort + cumsum) that dominates curve-metric cost.
+
+    Per-call device round-trips through the TPU tunnel are too noisy to time
+    a single compute; scan EPOCHS distinct buffers inside one program (the
+    way a cross-validation or multi-metric epoch end actually runs) and
+    amortize."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.classification.masked_curves import masked_binary_auroc
+
+    n = STEPS * BATCH
+    epochs = 20
+    rng = np.random.RandomState(0)
+    all_preds = jnp.asarray(rng.rand(epochs, n).astype(np.float32))
+    all_target = jnp.asarray(rng.randint(0, 2, (epochs, n)))
+    valid = jnp.ones(n, bool)
+
+    ours = _time_scan_epoch(
+        (all_preds, all_target),
+        lambda: jnp.zeros(()),
+        lambda acc, p, t: acc + masked_binary_auroc(p, t, valid),
+        steps=epochs,
+    )
+
+    def ref(torchmetrics, torch):
+        from torchmetrics.functional import auroc as ref_auroc
+
+        preds_t = torch.from_numpy(np.asarray(all_preds))
+        target_t = torch.from_numpy(np.asarray(all_target))
+        ref_auroc(preds_t[0], target_t[0])  # warm caches
+        start = time.perf_counter()
+        acc = 0.0
+        for e in range(epochs):
+            acc += float(ref_auroc(preds_t[e], target_t[e]))
+        return (time.perf_counter() - start) / epochs
+
+    return "auroc_epoch_compute_200k", ours, ref
+
+
 def main() -> None:
     configs = [
         bench_accuracy,
@@ -285,6 +327,7 @@ def main() -> None:
         bench_auroc_ap,
         bench_retrieval,
         bench_image_audio,
+        bench_auroc_compute,
     ]
     results = []
     for cfg in configs:
