@@ -19,7 +19,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.utilities.data import METRIC_EPS, Array
+from metrics_tpu.utilities.data import METRIC_EPS, Array, tie_group_bounds
 
 
 def _masked_curve_points(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array]:
@@ -43,12 +43,9 @@ def _masked_curve_points(preds: Array, target: Array, valid: Array) -> Tuple[Arr
     tps = jnp.cumsum(pos_s)
     fps = jnp.cumsum(jnp.where(valid_s, 1.0 - pos_s, 0.0))
 
-    # index of each position's tie-group end: nearest j >= i where the score
-    # changes (or the array ends) — reverse cumulative minimum of end indices
-    idx = jnp.arange(n)
-    group_end = jnp.concatenate([neg_score_s[1:] != neg_score_s[:-1], jnp.ones((1,), bool)])
-    end_idx = jnp.where(group_end, idx, n - 1)
-    end_idx = jnp.flip(jax.lax.cummin(jnp.flip(end_idx)))
+    # each position adopts the cumulative counts at its tie-group END so that
+    # positions inside a group duplicate the group's final curve point
+    _, end_idx = tie_group_bounds(neg_score_s[1:] != neg_score_s[:-1])
 
     return fps[end_idx], tps[end_idx], tps[-1]
 

@@ -46,6 +46,24 @@ def dim_zero_cat(x: Union[Array, List[Array], Tuple[Array, ...]]) -> Array:
     return jnp.concatenate(items, axis=0)
 
 
+def tie_group_bounds(changed: Array) -> Tuple[Array, Array]:
+    """Per-position tie-group start/end indices from an adjacent-change mask.
+
+    ``changed`` is the ``(n-1,)`` boolean mask ``key[1:] != key[:-1]`` over a
+    SORTED key sequence; returns ``(start_idx, end_idx)``, both ``(n,)``,
+    where position ``i`` carries the first/last index of its tie group. The
+    shared TPU idiom behind the masked curve scalars (zero-width trapezoids
+    for duplicates) and the fractional rank kernel (mean of the rank block).
+    """
+    n = changed.shape[0] + 1
+    idx = jnp.arange(n)
+    is_start = jnp.concatenate([jnp.ones((1,), bool), changed])
+    is_end = jnp.concatenate([changed, jnp.ones((1,), bool)])
+    start_idx = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    end_idx = jnp.flip(jax.lax.cummin(jnp.flip(jnp.where(is_end, idx, n - 1))))
+    return start_idx, end_idx
+
+
 def dim_zero_sum(x: Array) -> Array:
     return jnp.sum(jnp.asarray(x), axis=0)
 

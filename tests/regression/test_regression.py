@@ -393,6 +393,18 @@ def test_spearman_capacity_mode():
     got = float(masked_spearman_corrcoef(jnp.asarray(pp), jnp.asarray(tt), valid))
     np.testing.assert_allclose(got, spearmanr(preds, target).statistic, atol=1e-4)
 
+    # adversarial rank edges: padding value ties with the max valid value,
+    # and a literal +inf is a real sample — neither may group with padding
+    from scipy.stats import rankdata
+
+    from metrics_tpu.functional.regression.spearman import _masked_rank
+
+    data = jnp.asarray([3.0, 1.0, 3.0, 2.0, np.inf, 3.0, 7.0])
+    valid_edges = jnp.asarray([True, True, True, True, True, False, False])
+    np.testing.assert_allclose(
+        np.asarray(_masked_rank(data, valid_edges))[:5], rankdata(np.asarray(data)[:5])
+    )
+
     # capacity metric accumulates across batches and matches list mode
     capped = SpearmanCorrcoef(capacity=256)
     listed = SpearmanCorrcoef()
