@@ -1,0 +1,48 @@
+"""Shared streaming/error-parity harness for the parity batteries.
+
+One definition of what "parity" means: identical multi-batch streams go
+through both libraries; epoch-end ``compute()`` values must agree (NaN-equal,
+absolute + relative tolerance, recursively for curve-style list outputs), and
+any configuration the reference rejects — at update or compute, any exception
+type — must raise on our side too.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+
+def assert_close(ours, theirs, atol=1e-5, rtol=1e-5):
+    """Recursive allclose over scalars/arrays/lists-of-arrays."""
+    if isinstance(theirs, (list, tuple)):
+        assert isinstance(ours, (list, tuple)) and len(ours) == len(theirs)
+        for o, t in zip(ours, theirs):
+            assert_close(o, t, atol, rtol)
+        return
+    t = np.asarray(
+        theirs.detach().numpy() if torch.is_tensor(theirs) else theirs, dtype=np.float64
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.asarray(ours), dtype=np.float64), t, atol=atol, rtol=rtol
+    )
+
+
+def stream_both(ours, theirs, batches, atol=1e-5, rtol=1e-5):
+    """Run identical batch streams through both libraries.
+
+    If the reference raises (at update or compute), our side must raise too —
+    any exception type; the messages differ by design.
+    """
+    try:
+        for args in batches:
+            theirs.update(*[torch.from_numpy(np.asarray(a)) for a in args])
+        theirs_val = theirs.compute()
+    except Exception:
+        with pytest.raises(Exception):
+            for args in batches:
+                ours.update(*[jnp.asarray(a) for a in args])
+            jnp.asarray(ours.compute())
+        return
+    for args in batches:
+        ours.update(*[jnp.asarray(a) for a in args])
+    assert_close(ours.compute(), theirs_val, atol=atol, rtol=rtol)

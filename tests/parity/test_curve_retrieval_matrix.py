@@ -14,6 +14,8 @@ import torch
 
 import metrics_tpu
 
+from tests.parity.helpers import stream_both
+
 _rng = np.random.RandomState(53)
 NUM_BATCHES = 4
 BATCH = 32
@@ -26,38 +28,6 @@ _mc_probs /= _mc_probs.sum(-1, keepdims=True)
 _mc_target = _rng.randint(0, NC, (NUM_BATCHES, BATCH))
 # adversarial: one class never appears as a target in one batch
 _mc_target[1][_mc_target[1] == 2] = 0
-
-
-def _to_np(x):
-    if isinstance(x, (list, tuple)):
-        return [_to_np(v) for v in x]
-    return np.asarray(x, dtype=np.float64)
-
-
-def _assert_close(ours, theirs, atol):
-    if isinstance(theirs, (list, tuple)):
-        assert isinstance(ours, (list, tuple)) and len(ours) == len(theirs)
-        for o, t in zip(ours, theirs):
-            _assert_close(o, t, atol)
-        return
-    t = np.asarray(theirs.detach().numpy() if torch.is_tensor(theirs) else theirs, dtype=np.float64)
-    np.testing.assert_allclose(np.asarray(jnp.asarray(ours), dtype=np.float64), t, atol=atol)
-
-
-def _stream_both(ours, theirs, batches, atol=1e-5):
-    try:
-        for args in batches:
-            theirs.update(*[torch.from_numpy(np.asarray(a)) for a in args])
-        theirs_val = theirs.compute()
-    except Exception:
-        with pytest.raises(Exception):
-            for args in batches:
-                ours.update(*[jnp.asarray(a) for a in args])
-            _to_np(ours.compute())
-        return
-    for args in batches:
-        ours.update(*[jnp.asarray(a) for a in args])
-    _assert_close(ours.compute(), theirs_val, atol)
 
 
 CURVE_GRID = [
@@ -92,7 +62,7 @@ def test_curve_option_matrix(torchmetrics_ref, name, kwargs, kind):
         batches = [(_bin_probs[i], _bin_target[i]) for i in range(NUM_BATCHES)]
     else:
         batches = [(_mc_probs[i], _mc_target[i]) for i in range(NUM_BATCHES)]
-    _stream_both(
+    stream_both(
         getattr(metrics_tpu, name)(**kwargs),
         getattr(torchmetrics_ref, name)(**kwargs),
         batches,
@@ -142,7 +112,7 @@ RETRIEVAL_GRID = [
 
 @pytest.mark.parametrize("name, kwargs", RETRIEVAL_GRID)
 def test_retrieval_option_matrix(torchmetrics_ref, name, kwargs):
-    _stream_both(
+    stream_both(
         getattr(metrics_tpu, name)(**kwargs),
         getattr(torchmetrics_ref, name)(**kwargs),
         _RETRIEVAL_BATCHES,

@@ -1,0 +1,101 @@
+"""Option-matrix parity for image/regression/loss metric axes.
+
+Third companion battery (see ``test_option_matrix.py`` and
+``test_curve_retrieval_matrix.py``): sweeps the less-traveled constructor
+axes — PSNR's ``dim``/``reduction`` (the library's only custom
+``dist_reduce_fx`` path), SSIM's window/stabilizer knobs, multioutput
+regression aggregations, KLDivergence's ``log_prob``/``reduction``, Hinge's
+squared/multiclass modes, and CohenKappa weighting — against the reference
+implementation on identical multi-batch streams.
+"""
+import numpy as np
+import pytest
+
+import metrics_tpu
+
+from tests.parity.helpers import stream_both
+
+_rng = np.random.RandomState(67)
+NUM_BATCHES = 3
+BATCH = 20
+NC = 4
+
+_reg_preds = _rng.randn(NUM_BATCHES, BATCH).astype(np.float32)
+_reg_target = (_reg_preds * 0.7 + 0.4 * _rng.randn(NUM_BATCHES, BATCH)).astype(np.float32)
+_mo_preds = _rng.randn(NUM_BATCHES, BATCH, 3).astype(np.float32)
+_mo_target = (_mo_preds * 0.8 + 0.3 * _rng.randn(NUM_BATCHES, BATCH, 3)).astype(np.float32)
+_imgs_a = _rng.rand(NUM_BATCHES, 2, 3, 32, 32).astype(np.float32)
+_imgs_b = np.clip(_imgs_a + 0.15 * _rng.randn(*_imgs_a.shape), 0, 1).astype(np.float32)
+_probs = _rng.rand(NUM_BATCHES, BATCH, NC).astype(np.float32)
+_probs /= _probs.sum(-1, keepdims=True)
+_probs2 = np.roll(_probs, 1, axis=1)
+_hinge_logits = _rng.randn(NUM_BATCHES, BATCH, NC).astype(np.float32)
+_mc_target = _rng.randint(0, NC, (NUM_BATCHES, BATCH))
+_bin_scores = _rng.randn(NUM_BATCHES, BATCH).astype(np.float32)
+_bin_target = _rng.randint(0, 2, (NUM_BATCHES, BATCH))
+
+
+def _batches(kind):
+    return {
+        "reg": [(_reg_preds[i], _reg_target[i]) for i in range(NUM_BATCHES)],
+        "multioutput": [(_mo_preds[i], _mo_target[i]) for i in range(NUM_BATCHES)],
+        "imgs": [(_imgs_a[i], _imgs_b[i]) for i in range(NUM_BATCHES)],
+        "dists": [(_probs[i], _probs2[i]) for i in range(NUM_BATCHES)],
+        "hinge_mc": [(_hinge_logits[i], _mc_target[i]) for i in range(NUM_BATCHES)],
+        "hinge_bin": [(_bin_scores[i], _bin_target[i]) for i in range(NUM_BATCHES)],
+        "mc": [(_probs[i], _mc_target[i]) for i in range(NUM_BATCHES)],
+    }[kind]
+
+
+CASES = [
+    # PSNR: dim selects per-sample PSNR (list states + custom min/max reduce)
+    ("PSNR", {"data_range": 1.0}, "imgs"),
+    ("PSNR", {"data_range": 1.0, "base": 2.0}, "imgs"),
+    ("PSNR", {}, "imgs"),  # data_range inferred from target min/max states
+    ("PSNR", {"data_range": 1.0, "dim": (1, 2, 3), "reduction": "elementwise_mean"}, "imgs"),
+    ("PSNR", {"data_range": 1.0, "dim": (1, 2, 3), "reduction": "sum"}, "imgs"),
+    ("PSNR", {"data_range": 1.0, "dim": (1, 2, 3), "reduction": "none"}, "imgs"),
+    # SSIM window/stabilizer axes
+    ("SSIM", {"data_range": 1.0}, "imgs"),
+    ("SSIM", {"data_range": 1.0, "kernel_size": (7, 7), "sigma": (1.0, 1.0)}, "imgs"),
+    ("SSIM", {"data_range": 1.0, "k1": 0.03, "k2": 0.05}, "imgs"),
+    ("SSIM", {"data_range": 1.0, "reduction": "sum"}, "imgs"),
+    ("SSIM", {"kernel_size": (4, 4)}, "imgs"),  # even kernel -> error parity
+    # multioutput regression aggregations
+    ("ExplainedVariance", {"multioutput": "raw_values"}, "multioutput"),
+    ("ExplainedVariance", {"multioutput": "variance_weighted"}, "multioutput"),
+    ("R2Score", {"num_outputs": 3, "multioutput": "raw_values"}, "multioutput"),
+    ("R2Score", {"num_outputs": 3, "multioutput": "variance_weighted"}, "multioutput"),
+    ("R2Score", {"adjusted": 5}, "reg"),
+    # KLDivergence axes
+    ("KLDivergence", {"log_prob": True}, "log_dists"),
+    ("KLDivergence", {"reduction": "sum"}, "dists"),
+    ("KLDivergence", {"reduction": "none"}, "dists"),
+    # Hinge modes
+    ("Hinge", {}, "hinge_bin"),
+    ("Hinge", {"squared": True}, "hinge_bin"),
+    ("Hinge", {}, "hinge_mc"),
+    ("Hinge", {"squared": True, "multiclass_mode": "crammer-singer"}, "hinge_mc"),
+    ("Hinge", {"multiclass_mode": "one-vs-all"}, "hinge_mc"),
+    # CohenKappa weighting
+    ("CohenKappa", {"num_classes": NC, "weights": "linear"}, "mc"),
+    ("CohenKappa", {"num_classes": NC, "weights": "quadratic"}, "mc"),
+]
+
+
+@pytest.mark.parametrize(
+    "name, kwargs, kind",
+    CASES,
+    ids=[f"{n}-{'-'.join(f'{k}={v}' for k, v in kw.items()) or 'default'}-{kd}" for n, kw, kd in CASES],
+)
+def test_option_parity(torchmetrics_ref, name, kwargs, kind):
+    if kind == "log_dists":
+        batches = [(np.log(_probs[i]), np.log(_probs2[i])) for i in range(NUM_BATCHES)]
+    else:
+        batches = _batches(kind)
+    stream_both(
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
+        batches,
+        atol=1e-4,
+    )

@@ -17,6 +17,8 @@ import torch
 
 import metrics_tpu
 
+from tests.parity.helpers import stream_both
+
 _rng = np.random.RandomState(31)
 NUM_BATCHES = 4
 BATCH = 24
@@ -45,27 +47,7 @@ INPUT_KINDS = {
 
 
 def _stream_both(ours, theirs, preds, target, atol=1e-5):
-    """Run identical batch streams through both libraries.
-
-    Returns after asserting value parity; if the reference raises, our side
-    must raise too (any exception type — the messages differ by design).
-    """
-    try:
-        for i in range(NUM_BATCHES):
-            theirs.update(torch.from_numpy(np.asarray(preds[i])), torch.from_numpy(np.asarray(target[i])))
-        theirs_val = theirs.compute()
-    except Exception:
-        with pytest.raises(Exception):
-            for i in range(NUM_BATCHES):
-                ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
-            np.asarray(ours.compute())
-        return
-
-    for i in range(NUM_BATCHES):
-        ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
-    ours_np = np.asarray(jnp.asarray(ours.compute()), dtype=np.float64)
-    theirs_np = np.asarray(theirs_val.detach().numpy(), dtype=np.float64)
-    np.testing.assert_allclose(ours_np, theirs_np, atol=atol)
+    stream_both(ours, theirs, [(preds[i], target[i]) for i in range(NUM_BATCHES)], atol=atol)
 
 
 STAT_SCORES_GRID = [
