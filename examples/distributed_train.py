@@ -95,62 +95,59 @@ def main() -> None:
         ]
     )
 
-    # params/opt_state replicated; batches sharded over the data axis;
-    # metric state stays per-shard (synced only at epoch-end compute)
+    # params/opt_state replicated; batches sharded over the data axis. The
+    # whole epoch — scan over steps, per-shard partial metric states, and the
+    # epoch-end sync — runs inside ONE shard_map program, so the divergent
+    # per-shard metric state never crosses the program boundary (it lives and
+    # dies inside the scan carry; only genuinely replicated values come out).
     data_sharding = NamedSharding(mesh, P("data"))
     replicated = NamedSharding(mesh, P())
 
-    def train_step(params, opt_state, metric_state, x, y):
-        def loss_fn(p):
-            logits = model.apply(p, x)
-            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+    def train_epoch(params, opt_state, epoch_x, epoch_y):
+        def train_step(carry, batch):
+            params, opt_state, metric_state = carry
+            x, y = batch
 
-        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        # data-parallel: gradients and loss reduce over the mesh axis
-        grads = jax.lax.pmean(grads, "data")
-        loss = jax.lax.pmean(loss, "data")
-        updates, opt_state = optimizer.update(grads, opt_state)
-        params = optax.apply_updates(params, updates)
-        # per-shard partial stats — no collective here, sync happens at compute
-        metric_state = metrics.apply_update(metric_state, jax.nn.softmax(logits), y)
-        return params, opt_state, metric_state, loss
+            def loss_fn(p):
+                logits = model.apply(p, x)
+                return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
 
-    sharded_train_step = jax.jit(
+            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            # data-parallel: gradients and loss reduce over the mesh axis
+            grads = jax.lax.pmean(grads, "data")
+            loss = jax.lax.pmean(loss, "data")
+            updates, opt_state = optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            # per-shard partial stats — no collective here, sync at epoch end
+            metric_state = metrics.apply_update(metric_state, jax.nn.softmax(logits), y)
+            return (params, opt_state, metric_state), loss
+
+        (params, opt_state, metric_state), losses = jax.lax.scan(
+            train_step, (params, opt_state, metrics.init_state()), (epoch_x, epoch_y)
+        )
+        # ONE sync: every metric's psum-family states ride a single combined
+        # all-reduce over the data axis (tests/bases/test_collective_fusion.py)
+        values = metrics.apply_compute(metric_state, axis_name="data")
+        return params, opt_state, values, losses[-1]
+
+    sharded_train_epoch = jax.jit(
         jax.shard_map(
-            train_step,
+            train_epoch,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P("data"), P("data")),
+            in_specs=(P(), P(), P(None, "data"), P(None, "data")),
             out_specs=(P(), P(), P(), P()),
             check_vma=False,
-        )
-    )
-
-    def epoch_values(metric_state):
-        # ONE program: every metric's psum-family states ride a single
-        # combined all-reduce over the data axis (see
-        # tests/bases/test_collective_fusion.py for the guarantee)
-        return metrics.apply_compute(metric_state, axis_name="data")
-
-    sharded_compute = jax.jit(
-        jax.shard_map(
-            epoch_values, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False
         )
     )
 
     params = jax.device_put(params, replicated)
     opt_state = jax.device_put(opt_state, replicated)
 
-    step_idx = 0
     for epoch in range(EPOCHS):
-        metric_state = jax.device_put(metrics.init_state(), replicated)
-        for _ in range(STEPS_PER_EPOCH):
-            x = jax.device_put(jnp.asarray(xs[step_idx]), data_sharding)
-            y = jax.device_put(jnp.asarray(ys[step_idx]), data_sharding)
-            params, opt_state, metric_state, loss = sharded_train_step(
-                params, opt_state, metric_state, x, y
-            )
-            step_idx += 1
-        values = sharded_compute(metric_state)
+        sl = slice(epoch * STEPS_PER_EPOCH, (epoch + 1) * STEPS_PER_EPOCH)
+        epoch_x = jax.device_put(jnp.asarray(xs[sl]), NamedSharding(mesh, P(None, "data")))
+        epoch_y = jax.device_put(jnp.asarray(ys[sl]), NamedSharding(mesh, P(None, "data")))
+        params, opt_state, values, loss = sharded_train_epoch(params, opt_state, epoch_x, epoch_y)
         summary = ", ".join(f"{k}={float(np.asarray(v).ravel()[0]):.3f}" for k, v in values.items())
         print(f"epoch {epoch}: loss={float(np.asarray(loss).ravel()[0]):.3f}, {summary}")
 
