@@ -7,7 +7,9 @@ is designed to run: the whole epoch's updates compiled into ONE XLA program
 a jitted train step costs), vs the reference library's eager per-metric
 updates (TorchMetrics on torch-CPU, imported from the read-only reference
 checkout when available). Per-step data varies inside the scan so XLA cannot
-hoist the update out of the loop.
+hoist the update out of the loop. Timing uses the two-length slope harness
+from ``scripts/bench_suite.py`` (see its docstring): the marginal device
+cost per step, with the TPU tunnel's fixed round-trip subtracted out.
 
 Prints exactly one JSON line:
 ``{"metric": "...", "value": N, "unit": "...", "vs_baseline": N}`` where
@@ -15,6 +17,7 @@ Prints exactly one JSON line:
 than the reference).
 """
 import json
+import os
 import sys
 import time
 
@@ -23,13 +26,17 @@ import numpy as np
 NUM_CLASSES = 10
 BATCH = 1024
 STEPS = 200
-REPEATS = 5
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+for _p in (REPO_ROOT, os.path.join(REPO_ROOT, "scripts")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
 
 
 def _bench_ours() -> float:
-    import jax
     import jax.numpy as jnp
 
+    from bench_suite import _time_scan_epoch
     from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
 
     collection = MetricCollection(
@@ -46,26 +53,9 @@ def _bench_ours() -> float:
     all_preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
     all_target = jnp.asarray(rng.randint(0, NUM_CLASSES, (STEPS, BATCH)))
 
-    @jax.jit
-    def epoch(state, preds, target):
-        def body(s, xt):
-            p, t = xt
-            return collection.apply_update(s, p, t), None
-
-        return jax.lax.scan(body, state, (preds, target))[0]
-
-    state = epoch(collection.init_state(), all_preds, all_target)  # compile
-    jax.block_until_ready(jax.tree.leaves(state))
-
-    # best of 3 measurement rounds: robust against host/dispatch jitter
-    best = float("inf")
-    for _round in range(3):
-        start = time.perf_counter()
-        for _ in range(REPEATS):
-            state = epoch(collection.init_state(), all_preds, all_target)
-        jax.block_until_ready(jax.tree.leaves(state))
-        best = min(best, (time.perf_counter() - start) / (REPEATS * STEPS))
-    return best
+    return _time_scan_epoch(
+        (all_preds, all_target), collection.init_state, collection.apply_update
+    )
 
 
 def _bench_reference() -> float:

@@ -1,11 +1,11 @@
 """Structural similarity index measure.
 
 Capability parity with the reference's ``torchmetrics/functional/regression/
-ssim.py``: one grouped gaussian convolution over the stacked
-``(5*B, C, H, W)`` batch computes every window statistic in a single pass.
-TPU-first details: the depthwise conv lowers to
-``lax.conv_general_dilated(feature_group_count=C)`` which XLA tiles onto the
-MXU, and the reflect pad is a static-shape ``jnp.pad``.
+ssim.py``: every window statistic is computed over the stacked
+``(5*B, C, H, W)`` batch in one pass. TPU-first details: one static-shape
+reflect ``jnp.pad`` on the stack, then the separable gaussian window as two
+1-D depthwise ``lax.conv_general_dilated(feature_group_count=C)`` passes at
+``precision='highest'`` (kh + kw taps instead of kh*kw).
 """
 from typing import Optional, Sequence, Tuple
 
@@ -21,16 +21,6 @@ def _gaussian(kernel_size: int, sigma: float, dtype: jnp.dtype) -> Array:
     dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, step=1, dtype=dtype)
     gauss = jnp.exp(-jnp.square(dist / sigma) / 2)
     return (gauss / gauss.sum())[None, :]  # (1, kernel_size)
-
-
-def _gaussian_kernel(
-    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype: jnp.dtype
-) -> Array:
-    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
-    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
-    kernel = jnp.matmul(kernel_x.T, kernel_y)  # (kernel_size[0], kernel_size[1])
-    # depthwise layout: (out_channels=C, in_channels/groups=1, kh, kw)
-    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
 
 
 def _ssim_update(preds: Array, target: Array) -> Tuple[Array, Array]:
@@ -76,25 +66,48 @@ def _ssim_compute(
 
     channel = preds.shape[1]
     dtype = preds.dtype
-    kernel = _gaussian_kernel(channel, kernel_size, sigma, dtype)
     pad_w = (kernel_size[0] - 1) // 2
     pad_h = (kernel_size[1] - 1) // 2
 
     pad_cfg = ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w))
-    preds_p = jnp.pad(preds, pad_cfg, mode="reflect")
-    target_p = jnp.pad(target, pad_cfg, mode="reflect")
 
-    # every window statistic in one depthwise conv over the stacked 5B batch
-    input_list = jnp.concatenate(
-        (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p)
+    # every window statistic over the stacked 5B batch; one reflect pad on
+    # the stack (reflect-pad commutes with elementwise products), then the
+    # gaussian window — an outer product — as two separable 1-D depthwise
+    # passes (kh + kw taps instead of kh*kw, ~5x fewer FLOPs at 11x11)
+    input_list = jnp.pad(
+        jnp.concatenate((preds, target, preds * preds, target * target, preds * target)),
+        pad_cfg,
+        mode="reflect",
     )  # (5*B, C, H+2ph, W+2pw)
+    kern_h = jnp.broadcast_to(
+        _gaussian(kernel_size[0], sigma[0], dtype).reshape(1, 1, kernel_size[0], 1),
+        (channel, 1, kernel_size[0], 1),
+    )
+    kern_w = jnp.broadcast_to(
+        _gaussian(kernel_size[1], sigma[1], dtype).reshape(1, 1, 1, kernel_size[1]),
+        (channel, 1, 1, kernel_size[1]),
+    )
+    # precision='highest': the intermediate between the two passes must not
+    # round to bf16 — the downstream variance cancellation E[X^2] - mu^2
+    # amplifies that rounding ~13x vs the single-pass formulation
     outputs = lax.conv_general_dilated(
         input_list,
-        kernel,
+        kern_h,
         window_strides=(1, 1),
         padding="VALID",
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=channel,
+        precision="highest",
+    )
+    outputs = lax.conv_general_dilated(
+        outputs,
+        kern_w,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=channel,
+        precision="highest",
     )
     batch = preds.shape[0]
     mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (

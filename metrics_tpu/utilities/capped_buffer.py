@@ -12,6 +12,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from metrics_tpu.utilities.data import Array, _is_traced, dim_zero_cat
 from metrics_tpu.utilities.enums import DataType
@@ -21,6 +22,34 @@ from metrics_tpu.utilities.prints import rank_zero_warn
 def _check_capacity(capacity: int) -> None:
     if not (isinstance(capacity, int) and capacity > 0):
         raise ValueError(f"`capacity` should be a positive integer, got: {capacity}")
+
+
+def _append_slice(buf: Array, batch: Array, count: Array) -> Array:
+    """Write ``batch`` into ``buf`` at offset ``count``; positions past the
+    buffer's capacity drop.
+
+    Contiguous ``dynamic_update_slice`` instead of a scatter (TPU scatters
+    serialize; a clamped slice write does not). The slice start clamps to
+    ``capacity - n``, so the window is re-masked against the true offsets:
+    already-written slots keep their old values, past-capacity elements of
+    the batch are dropped — the exact semantics of a ``mode="drop"`` scatter
+    at ``count + arange(n)``.
+    """
+    capacity, n = buf.shape[0], batch.shape[0]
+    if n >= capacity:
+        # the batch alone can cover the buffer: position i takes batch[i - count]
+        # when the batch reaches it, otherwise keeps its (already written) value
+        i = jnp.arange(capacity)
+        mask = (i >= count)[(...,) + (None,) * (buf.ndim - 1)]
+        return jnp.where(mask, batch[jnp.clip(i - count, 0, n - 1)], buf)
+    start = jnp.clip(count, 0, capacity - n)
+    window = lax.dynamic_slice_in_dim(buf, start, n, axis=0)
+    # batch element that lands on window position j (negative -> keep old)
+    k = start + jnp.arange(n) - count
+    take = jnp.clip(k, 0, n - 1)
+    mask = ((k >= 0) & (k < n))[(...,) + (None,) * (buf.ndim - 1)]
+    window = jnp.where(mask, batch[take], window)
+    return lax.dynamic_update_slice_in_dim(buf, window, start, axis=0)
 
 
 class CappedBufferMixin:
@@ -75,9 +104,8 @@ class CappedBufferMixin:
     def _buffer_write(self, preds: Array, target: Array) -> None:
         """Append one batch at the fill offset; writes past capacity drop,
         the counter keeps the true total."""
-        idx = self.count + jnp.arange(preds.shape[0])
-        self.preds_buf = self.preds_buf.at[idx].set(preds, mode="drop")
-        self.target_buf = self.target_buf.at[idx].set(target, mode="drop")
+        self.preds_buf = _append_slice(self.preds_buf, preds, self.count)
+        self.target_buf = _append_slice(self.target_buf, target, self.count)
         self.count = self.count + preds.shape[0]
 
     def _raw_buffer_update(self, preds: Array, target: Array) -> None:
