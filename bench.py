@@ -100,7 +100,8 @@ def _bench_reference() -> float:
 def main() -> None:
     ours = _bench_ours()
     ref = _bench_reference()
-    vs_baseline = (ref / ours) if (ref == ref) else None
+    measured = ours == ours  # NaN -> slope measurement failed
+    vs_baseline = (ref / ours) if (measured and ref == ref) else None
     print(
         json.dumps(
             {
@@ -108,7 +109,7 @@ def main() -> None:
                 # compiled into the step program (lax.scan), the reference side
                 # its eager per-call cost — the architectural delta under test
                 "metric": "metric_collection_update_step_fused",
-                "value": round(ours * 1e6, 2),
+                "value": round(ours * 1e6, 2) if measured else None,
                 "unit": "us/step",
                 "vs_baseline": round(vs_baseline, 3) if vs_baseline is not None else None,
             }

@@ -1,0 +1,71 @@
+"""Edge-case matrix for the capacity-buffer append primitive.
+
+``_append_slice`` replaces a ``mode="drop"`` scatter with a clamped
+``dynamic_update_slice`` plus re-masking; the equivalence must hold at every
+boundary: partial overflow (batch straddles capacity), exact fill, writes
+starting past capacity, batches larger than the whole buffer, and 2-D
+(multiclass/multilabel) buffers.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utilities.capped_buffer import _append_slice
+
+
+def _oracle(buf, batch, count):
+    out = np.asarray(buf).copy()
+    for j in range(batch.shape[0]):
+        g = count + j
+        if g < out.shape[0]:
+            out[g] = np.asarray(batch)[j]
+    return out
+
+
+CASES = [
+    (10, 4, 0),  # plain append into empty
+    (10, 4, 6),  # exact fill
+    (10, 4, 8),  # partial overflow: two in, two dropped
+    (10, 4, 10),  # full buffer: everything drops
+    (10, 4, 12),  # count already past capacity
+    (10, 10, 0),  # batch exactly covers the buffer
+    (10, 10, 3),  # n == capacity, offset start
+    (10, 12, 0),  # batch larger than the buffer
+    (10, 12, 7),  # larger batch, offset start
+    (4, 9, 2),  # much larger batch, offset start
+    (1, 1, 0),  # degenerate capacity
+]
+
+
+@pytest.mark.parametrize("cap, n, count", CASES)
+@pytest.mark.parametrize("ndim", [1, 2])
+def test_append_slice_matches_drop_scatter(cap, n, count, ndim):
+    rng = np.random.RandomState(cap * 100 + n * 10 + count)
+    shape = (cap,) if ndim == 1 else (cap, 3)
+    bshape = (n,) if ndim == 1 else (n, 3)
+    buf = jnp.asarray(rng.rand(*shape).astype(np.float32))
+    batch = jnp.asarray(100 + rng.rand(*bshape).astype(np.float32))
+    got = np.asarray(_append_slice(buf, batch, jnp.asarray(count)))
+    np.testing.assert_array_equal(got, _oracle(buf, batch, count))
+
+
+def test_append_slice_under_jit_and_scan():
+    """The append must stay correct when the count is a traced value inside
+    a scanned loop — the way capacity metrics actually run."""
+    cap, n = 16, 5
+    rng = np.random.RandomState(0)
+    batches = jnp.asarray(rng.rand(6, n).astype(np.float32))
+
+    @jax.jit
+    def fill(batches):
+        def body(carry, batch):
+            buf, count = carry
+            return (_append_slice(buf, batch, count), count + n), None
+
+        return jax.lax.scan(body, (jnp.zeros(cap), jnp.zeros((), jnp.int32)), batches)[0]
+
+    buf, count = fill(batches)
+    expected = np.asarray(batches).reshape(-1)[:cap]
+    np.testing.assert_allclose(np.asarray(buf), expected)
+    assert int(count) == 30
