@@ -21,6 +21,7 @@ step with no per-step retracing and no epoch-end host pass.
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -90,8 +91,6 @@ class RetrievalMetric(Metric, ABC):
                     "`padded=True` cannot raise per-query inside a compiled program;"
                     " use empty_target_action 'neg', 'pos' or 'skip'"
                 )
-            import jax
-
             # streaming scalars are mergeable -> the fused single-update
             # forward applies (the flat mode needs the host grouping pass)
             self._fusable = True
@@ -142,11 +141,16 @@ class RetrievalMetric(Metric, ABC):
         self._validate_padded_values(preds, target, mask)
 
         # sort each query row by (valid first, then descending score); the
-        # two-key lexsort keeps a real -inf score ahead of masked padding
+        # two-key variadic sort keeps a real -inf score ahead of masked
+        # padding and carries the targets through the sort — no gather.
+        # Stable, so score ties keep document order like the lexsort it
+        # replaces.
         score = jnp.where(mask, preds.astype(jnp.float32), 0.0)
-        order = jnp.lexsort((-score, ~mask), axis=-1)
-        target_rows = jnp.where(mask, target, 0)
-        target_rows = jnp.take_along_axis(target_rows, order, axis=-1)
+        _, _, target_rows = jax.lax.sort(
+            ((~mask).astype(jnp.int32), -score, jnp.where(mask, target, 0)),
+            num_keys=2,
+            is_stable=True,
+        )
         lengths = jnp.sum(mask, axis=-1)
 
         values = self._metric_rows(target_rows, lengths)
