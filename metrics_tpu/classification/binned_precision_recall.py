@@ -88,7 +88,19 @@ class BinnedPrecisionRecallCurve(Metric):
         )
         self.num_classes = num_classes
         self.num_thresholds = num_thresholds
-        self.thresholds = jnp.linspace(0, 1.0, num_thresholds)
+        # a state (not a plain attribute) so checkpoints carry it under the
+        # same key as the reference's register_buffer ("thresholds",
+        # ``binned_precision_recall.py:123``); values are identical on every
+        # replica, so the "mean" sync is a no-op
+        self.add_state(
+            "thresholds",
+            default=jnp.linspace(0, 1.0, num_thresholds),
+            # every replica holds identical values, so any idempotent sync
+            # works; "max" (unlike "mean") keeps the fused single-update
+            # forward path available (_MERGEABLE_REDUCTIONS)
+            dist_reduce_fx="max",
+            persistent=True,  # the reference's register_buffer always persists
+        )
 
         for name in ("TPs", "FPs", "FNs"):
             self.add_state(

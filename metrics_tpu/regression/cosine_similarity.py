@@ -64,8 +64,8 @@ class CosineSimilarity(Metric):
             self.add_state("sim_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
             self.add_state("n_total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
         else:
-            self.add_state("preds_all", default=[], dist_reduce_fx="cat")
-            self.add_state("target_all", default=[], dist_reduce_fx="cat")
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Append the batch pairs (or fold their reduced similarity in)."""
@@ -75,8 +75,8 @@ class CosineSimilarity(Metric):
             # one similarity value per vector (= everything but the feature axis)
             self.n_total = self.n_total + preds[..., 0].size
         else:
-            self.preds_all.append(preds)
-            self.target_all.append(target)
+            self.preds.append(preds)
+            self.target.append(target)
 
     def compute(self) -> Array:
         """Cosine similarity over everything seen so far."""
@@ -85,6 +85,6 @@ class CosineSimilarity(Metric):
                 return self.sim_sum / jnp.maximum(self.n_total, 1)
             return self.sim_sum
 
-        preds = dim_zero_cat(self.preds_all)
-        target = dim_zero_cat(self.target_all)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
         return _cosine_similarity_compute(preds, target, self.reduction)

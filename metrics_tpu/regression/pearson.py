@@ -58,8 +58,8 @@ class PearsonCorrcoef(Metric):
             for name in ("sum_x", "sum_y", "sum_xx", "sum_yy", "sum_xy"):
                 self.add_state(name, default=jnp.zeros((), dtype), dist_reduce_fx="sum")
         else:
-            self.add_state("preds_all", default=[], dist_reduce_fx="cat")
-            self.add_state("target_all", default=[], dist_reduce_fx="cat")
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Append the batch pairs (or fold them into the co-moment sums)."""
@@ -74,8 +74,8 @@ class PearsonCorrcoef(Metric):
             self.sum_yy = self.sum_yy + jnp.sum(y * y)
             self.sum_xy = self.sum_xy + jnp.sum(x * y)
         else:
-            self.preds_all.append(preds)
-            self.target_all.append(target)
+            self.preds.append(preds)
+            self.target.append(target)
 
     def compute(self) -> Array:
         """Pearson correlation over everything seen so far."""
@@ -96,6 +96,6 @@ class PearsonCorrcoef(Metric):
             corr = jnp.where(degenerate, 0.0, cov / jnp.where(degenerate, 1.0, denom))
             return jnp.clip(corr, -1.0, 1.0).astype(jnp.float32)
 
-        preds = dim_zero_cat(self.preds_all)
-        target = dim_zero_cat(self.target_all)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
         return _pearson_corrcoef_compute(preds, target)

@@ -64,8 +64,8 @@ class SpearmanCorrcoef(CappedBufferMixin, Metric):
                 "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
                 " For large datasets, this may lead to a large memory footprint."
             )
-            self.add_state("preds_all", default=[], dist_reduce_fx="cat")
-            self.add_state("target_all", default=[], dist_reduce_fx="cat")
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Append the batch pairs (buffered in place under ``capacity``)."""
@@ -73,8 +73,8 @@ class SpearmanCorrcoef(CappedBufferMixin, Metric):
         if self.capacity is not None:
             self._raw_buffer_update(preds, target)
             return
-        self.preds_all.append(preds)
-        self.target_all.append(target)
+        self.preds.append(preds)
+        self.target.append(target)
 
     def compute(self) -> Array:
         """Spearman correlation over everything seen so far."""
@@ -82,6 +82,6 @@ class SpearmanCorrcoef(CappedBufferMixin, Metric):
             preds, target, valid = self._buffer_flatten()
             return masked_spearman_corrcoef(preds, target, valid)
 
-        preds = dim_zero_cat(self.preds_all)
-        target = dim_zero_cat(self.target_all)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
         return _spearman_corrcoef_compute(preds, target)

@@ -1,0 +1,65 @@
+"""Checkpoint-format parity: every metric's state_dict keys match the
+reference's, so a checkpoint produced against one library's layout maps
+onto the other (the reference serializes states + buffers through
+``nn.Module.state_dict``; ours through the state registry)."""
+import inspect
+
+import pytest
+
+import metrics_tpu
+
+NC = 3
+
+CTOR_KWARGS = {
+    "ConfusionMatrix": {"num_classes": NC},
+    "CohenKappa": {"num_classes": NC},
+    "MatthewsCorrcoef": {"num_classes": NC},
+    "IoU": {"num_classes": NC},
+    "BinnedPrecisionRecallCurve": {"num_classes": NC},
+    "BinnedAveragePrecision": {"num_classes": NC},
+    "BinnedRecallAtFixedPrecision": {"num_classes": NC, "min_precision": 0.5},
+}
+SKIP = {
+    "Metric",  # abstract
+    "FID", "KID", "IS", "InceptionScore",  # need extractor weights
+    "BootStrapper",  # wraps a base metric
+    "CompositionalMetric",  # built by operators, not directly
+    "MetricCollection",  # container, delegates to members
+}
+
+
+def _metric_classes(mod, base):
+    for name in sorted(dir(mod)):
+        if name.startswith("_") or name in SKIP:
+            continue
+        cls = getattr(mod, name)
+        if inspect.isclass(cls) and issubclass(cls, base) and cls is not base:
+            yield name, cls
+
+
+def test_state_dict_keys_match_reference(torchmetrics_ref):
+    ours_classes = dict(_metric_classes(metrics_tpu, metrics_tpu.Metric))
+    mismatches = []
+    for name, ref_cls in _metric_classes(torchmetrics_ref, torchmetrics_ref.Metric):
+        ours_cls = ours_classes.get(name)
+        if ours_cls is None:
+            mismatches.append((name, "missing class"))
+            continue
+        kwargs = CTOR_KWARGS.get(name, {})
+        ref_m, our_m = ref_cls(**kwargs), ours_cls(**kwargs)
+        ref_m.persistent(True)
+        our_m.persistent(True)
+        ref_keys = set(ref_m.state_dict().keys())
+        our_keys = set(our_m.state_dict().keys())
+        if ref_keys != our_keys:
+            mismatches.append((name, f"ref {sorted(ref_keys)} vs ours {sorted(our_keys)}"))
+    assert not mismatches, mismatches
+
+
+def test_buffer_states_persist_by_default(torchmetrics_ref):
+    """The reference's thresholds buffer persists without opting in; ours
+    must too (it is configuration, not accumulated data)."""
+    ref_m = torchmetrics_ref.BinnedAveragePrecision(num_classes=NC)
+    our_m = metrics_tpu.BinnedAveragePrecision(num_classes=NC)
+    assert set(ref_m.state_dict().keys()) == {"thresholds"}
+    assert set(our_m.state_dict().keys()) == {"thresholds"}
