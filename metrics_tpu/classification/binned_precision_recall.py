@@ -69,7 +69,6 @@ class BinnedPrecisionRecallCurve(Metric):
     """
 
     is_differentiable = False
-    _fusable = False  # compute returns per-class lists for multiclass
 
     def __init__(
         self,

@@ -1,4 +1,5 @@
 """Hinge / KLDivergence / Binned curve metrics parity tests."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -151,4 +152,40 @@ class TestBinned(MetricTester):
             metric_args={"num_classes": 1, "num_thresholds": 500},
             check_batch=False,
             atol=0.01,
+        )
+
+
+def test_binned_fused_forward_matches_double_update():
+    """The binned family is mergeable (sum counts + idempotent thresholds),
+    so forward() takes the fused single-update path; its per-step values and
+    epoch compute must equal the reference-faithful double-update protocol."""
+    rng = np.random.RandomState(5)
+    for cls, kwargs in (
+        (BinnedPrecisionRecallCurve, dict(num_classes=3, num_thresholds=20)),
+        (BinnedPrecisionRecallCurve, dict(num_classes=1, num_thresholds=20)),
+        (BinnedAveragePrecision, dict(num_classes=3, num_thresholds=20)),
+        (BinnedAveragePrecision, dict(num_classes=1, num_thresholds=20)),
+        (BinnedRecallAtFixedPrecision, dict(num_classes=3, num_thresholds=20, min_precision=0.4)),
+    ):
+        fused, double = cls(**kwargs), cls(**kwargs)
+        assert fused._states_mergeable(), cls.__name__
+        double._fusable = False  # force the reference double-update protocol
+        nc = kwargs["num_classes"]
+        for _ in range(4):
+            if nc == 1:
+                p = jnp.asarray(rng.rand(32).astype(np.float32))
+                t = jnp.asarray(rng.randint(0, 2, 32))
+            else:
+                p = jnp.asarray(rng.rand(32, nc).astype(np.float32))
+                t = jnp.asarray(rng.randint(0, nc, 32))
+            va, vb = fused(p, t), double(p, t)
+            jax.tree.map(  # validates treedef equality, then values
+                lambda x, y: np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6),
+                va,
+                vb,
+            )
+        jax.tree.map(
+            lambda x, y: np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6),
+            fused.compute(),
+            double.compute(),
         )
