@@ -13,8 +13,9 @@ Enable a trace with the standard JAX tooling, e.g.::
     with jax.profiler.trace("/tmp/metrics-trace"):
         state = step(state, preds, target)   # annotated regions appear per metric
 """
+import time
 from contextlib import contextmanager
-from typing import Iterator
+from typing import Any, Iterator
 
 import jax
 
@@ -36,3 +37,87 @@ def eager_span(name: str) -> Iterator[None]:
         return
     with annotation:
         yield
+
+
+def measure_scan_slope(all_inputs: Any, init_state: Any, update: Any, rounds: int = 7) -> float:
+    """Median marginal per-step device time (seconds) of ``update`` scanned
+    over ``all_inputs`` (leading axis = steps) — the shared two-length-slope
+    harness behind ``bench.py`` / ``scripts/bench_suite.py`` and
+    :func:`measure_step_overhead`.
+
+    The same jitted program runs at 1x and 5x the step count; the slope
+    ``(t_long - t_short) / (4 * steps)`` cancels fixed dispatch/transfer
+    latency, which on remote-device links can exceed the per-step cost by
+    orders of magnitude. Outputs fold to one scalar so no state computation
+    is dead-code-eliminable, the two lengths are timed back-to-back per
+    round (cancels slow latency drift), and the median averages the middle
+    pair for even ``rounds``. Returns NaN (with a warning) when noise
+    swallows the signal even after retrying with more rounds — never a
+    silent zero.
+    """
+    import warnings
+
+    import jax.numpy as jnp
+
+    steps = jax.tree.leaves(all_inputs)[0].shape[0]
+
+    @jax.jit
+    def epoch(state, inputs):
+        def body(s, xs):
+            return update(s, *xs), None
+
+        final = jax.lax.scan(body, state, inputs)[0]
+        return jax.tree.reduce(
+            lambda a, b: a + b,
+            [jnp.sum(jnp.asarray(leaf, jnp.float32)) for leaf in jax.tree.leaves(final)],
+        )
+
+    tiled = jax.tree.map(lambda x: jnp.concatenate([x] * 5, axis=0), all_inputs)
+
+    def run(inputs):
+        start = time.perf_counter()
+        float(epoch(init_state(), inputs))
+        return time.perf_counter() - start
+
+    run(all_inputs)  # compile both lengths
+    run(tiled)
+    for attempt in range(2):
+        slopes = sorted(run(tiled) - run(all_inputs) for _ in range(rounds * (attempt + 1)))
+        mid = len(slopes) // 2
+        median = slopes[mid] if len(slopes) % 2 else (slopes[mid - 1] + slopes[mid]) / 2
+        if median > 0:
+            return median / (4 * steps)
+    warnings.warn(
+        "slope measurement failed (non-positive median): per-step signal is"
+        " below the link's timing noise; raise the step count"
+    )
+    return float("nan")
+
+
+def measure_step_overhead(metric: Any, *example_batch: Any, steps: int = 256, rounds: int = 5) -> float:
+    """Marginal per-step device cost (seconds) of ``metric``'s fused update —
+    the BASELINE "µs/step overhead" number, measured natively.
+
+    Builds ``steps`` varied copies of ``example_batch`` and delegates to
+    :func:`measure_scan_slope` — exactly how the update rides a jitted train
+    step. Works for a single metric or a
+    :class:`~metrics_tpu.MetricCollection`. Returns NaN when the signal is
+    swallowed by link noise; raise ``steps`` until the slope dominates (the
+    per-step signal grows linearly with it).
+    """
+    import jax.numpy as jnp
+
+    batch = tuple(jnp.asarray(a) for a in example_batch)
+    # per-step data must differ or XLA hoists the loop-invariant update delta
+    # out of the scan; rolling the sample axis varies it for free (scalars
+    # have nothing to roll and broadcast unchanged)
+    idx = jnp.arange(steps)
+    inputs = tuple(
+        jnp.broadcast_to(a, (steps,) + a.shape)
+        if a.ndim == 0
+        else jax.vmap(lambda i, a=a: jnp.roll(a, i, axis=0))(idx)
+        for a in batch
+    )
+    return measure_scan_slope(
+        inputs, metric.init_state, metric.apply_update, rounds=rounds
+    )

@@ -46,49 +46,12 @@ ROUNDS = 7
 
 # ---------------------------------------------------------------- harnesses
 def _time_scan_epoch(all_inputs, init_state, update):
-    """Marginal per-step device time of a scanned, jitted update loop,
-    via the two-length slope described in the module docstring. The step
-    count is the inputs' leading dimension."""
-    import jax
-    import jax.numpy as jnp
+    """Marginal per-step device time of a scanned, jitted update loop — the
+    shared two-length-slope harness, which returns NaN (-> a null JSON value)
+    with a warning when noise swallows the signal."""
+    from metrics_tpu.utilities.profiling import measure_scan_slope
 
-    steps = jax.tree.leaves(all_inputs)[0].shape[0]
-
-    @jax.jit
-    def epoch(state, inputs):
-        def body(s, xs):
-            return update(s, *xs), None
-
-        final = jax.lax.scan(body, state, inputs)[0]
-        # fold every leaf into one scalar: a single cheap materialization
-        # that still forces the full state computation
-        return jax.tree.reduce(
-            lambda a, b: a + b,
-            [jnp.sum(jnp.asarray(leaf, jnp.float32)) for leaf in jax.tree.leaves(final)],
-        )
-
-    # slope between 1x and 5x the step count — the 4x-steps gap keeps the
-    # per-step signal above the fixed round-trip's noise; measuring the two
-    # lengths back-to-back within each round and taking the median slope
-    # cancels the tunnel's slow latency drift between rounds
-    tiled = jax.tree.map(lambda x: jnp.concatenate([x] * 5, axis=0), all_inputs)
-
-    def run(inputs):
-        start = time.perf_counter()
-        float(epoch(init_state(), inputs))
-        return time.perf_counter() - start
-
-    run(all_inputs)  # compile both lengths
-    run(tiled)
-    for attempt in range(2):
-        slopes = sorted(run(tiled) - run(all_inputs) for _ in range(ROUNDS * (attempt + 1)))
-        median = slopes[len(slopes) // 2]
-        if median > 0:
-            return median / (4 * steps)
-    # tunnel noise swallowed the signal; report a failed measurement rather
-    # than a near-zero cost and an astronomically inflated speedup
-    print("# slope measurement failed (non-positive median); reporting null", file=sys.stderr)
-    return float("nan")
+    return measure_scan_slope(all_inputs, init_state, update, rounds=ROUNDS)
 
 
 def _time_eager_loop(update, steps=STEPS):

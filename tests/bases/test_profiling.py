@@ -22,3 +22,22 @@ def test_eager_span_transparent():
     metric = Accuracy()
     value = metric(jnp.asarray([0, 1, 1, 0]), jnp.asarray([0, 1, 0, 0]))
     np.testing.assert_allclose(float(value), 0.75)
+
+
+def test_measure_step_overhead_runs_and_is_finite():
+    """The overhead probe compiles, runs, and returns a finite non-negative
+    per-step cost for both a single metric and a collection (values are
+    platform-dependent; only the contract is asserted)."""
+    from metrics_tpu import Accuracy, MetricCollection, Precision
+    from metrics_tpu.utilities.profiling import measure_step_overhead
+
+    rng = np.random.RandomState(0)
+    preds = rng.rand(64, 4).astype(np.float32)
+    target = rng.randint(0, 4, 64)
+
+    single = measure_step_overhead(Accuracy(), preds, target, steps=8, rounds=2)
+    assert single >= 0.0 and single == single
+
+    coll = MetricCollection([Accuracy(), Precision(average="macro", num_classes=4)])
+    fused = measure_step_overhead(coll, preds, target, steps=8, rounds=2)
+    assert fused >= 0.0 and fused == fused
