@@ -112,9 +112,9 @@ class BootStrapper(Metric):
             new_kwargs = apply_to_collection(kwargs, ArrayTypes, jnp.take, sample_idx, axis=0)
             self.metrics[idx].update(*new_args, **new_kwargs)
 
-    def compute(self) -> Dict[str, Array]:
-        """Dict of the requested bootstrap statistics (mean/std/quantile/raw)."""
-        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+    def _stats_dict(self, computed_vals: Array) -> Dict[str, Array]:
+        """The requested bootstrap statistics (mean/std/quantile/raw) of the
+        stacked per-child values — shared by both the stateful and pure APIs."""
         output_dict = {}
         if self.mean:
             output_dict["mean"] = jnp.mean(computed_vals, axis=0)
@@ -126,11 +126,72 @@ class BootStrapper(Metric):
             output_dict["raw"] = computed_vals
         return output_dict
 
+    def compute(self) -> Dict[str, Array]:
+        """Dict of the requested bootstrap statistics (mean/std/quantile/raw)."""
+        return self._stats_dict(jnp.stack([m.compute() for m in self.metrics], axis=0))
+
     def reset(self) -> None:
+        # no registered states on the wrapper itself, so skip the base
+        # class's _set_states(init_state()) — building the stacked pure state
+        # on every eager reset would cost N child inits per forward step and
+        # pin stray children/key attributes on the wrapper
         for m in self.metrics:
             m.reset()
-        super().reset()
+        self._update_called = False
+        self._forward_cache = None
+        self._computed = None
 
     def persistent(self, mode: bool = False) -> None:
         for m in self.metrics:
             m.persistent(mode)
+
+    # ------------------------------------------------------------------
+    # pure (jit-native) API: children as one vmapped state stack
+    # ------------------------------------------------------------------
+    def init_state(self) -> Dict[str, Any]:
+        """Pure state: every child's state stacked on a leading bootstrap
+        axis, plus the PRNG key. ``apply_update`` requires the
+        ``'multinomial'`` strategy — Poisson resampling produces
+        data-dependent batch lengths, which XLA cannot express; use the
+        stateful ``update`` for Poisson."""
+        stacked = jax.tree.map(
+            lambda *leaves: jnp.stack(leaves, axis=0),
+            *[m.init_state() for m in self.metrics],
+        )
+        return {"children": stacked, "key": self._rng_key}
+
+    def _check_pure_supported(self) -> None:
+        if self.sampling_strategy != "multinomial":
+            raise ValueError(
+                "the jit-native BootStrapper state requires"
+                " sampling_strategy='multinomial' (fixed-size resamples);"
+                " Poisson resampling is eager-only"
+            )
+
+    def apply_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        self._check_pure_supported()
+        sizes = apply_to_collection((args, kwargs), ArrayTypes, lambda a: a.shape[0])
+        flat_sizes = jax.tree.leaves(sizes)
+        if not flat_sizes:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        size = flat_sizes[0]
+
+        key, sub = jax.random.split(state["key"])
+        child = self.metrics[0]
+
+        def one(child_state: Dict[str, Any], k: Array) -> Dict[str, Any]:
+            idx = _bootstrap_sampler(size, k, sampling_strategy="multinomial")
+            new_args = apply_to_collection(args, ArrayTypes, jnp.take, idx, axis=0)
+            new_kwargs = apply_to_collection(kwargs, ArrayTypes, jnp.take, idx, axis=0)
+            return child.apply_update(child_state, *new_args, **new_kwargs)
+
+        children = jax.vmap(one)(state["children"], jax.random.split(sub, self.num_bootstraps))
+        return {"children": children, "key": key}
+
+    def apply_compute(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Array]:
+        self._check_pure_supported()
+        child = self.metrics[0]
+        computed_vals = jax.vmap(lambda s: child.apply_compute(s, axis_name=axis_name))(
+            state["children"]
+        )
+        return self._stats_dict(computed_vals)

@@ -100,3 +100,80 @@ def test_bootstrap_reset_and_invalid_args():
         BootStrapper(lambda x: x)
     with pytest.raises(ValueError, match="sampling_strategy"):
         BootStrapper(Precision(), sampling_strategy="jackknife")
+
+
+class TestPureApi:
+    """jit-native BootStrapper: vmapped child states, multinomial resampling."""
+
+    def _wrapper(self, **kwargs):
+        from metrics_tpu import Accuracy
+
+        return BootStrapper(
+            Accuracy(), num_bootstraps=20, sampling_strategy="multinomial", seed=3, raw=True, **kwargs
+        )
+
+    def test_scan_single_trace_and_sane_stats(self):
+        rng = np.random.RandomState(0)
+        b = self._wrapper()
+        state = b.init_state()
+        traces = {"n": 0}
+
+        def step(s, p, t):
+            traces["n"] += 1
+            return b.apply_update(s, p, t)
+
+        jitted = jax.jit(step)
+        P, T = [], []
+        for _ in range(5):
+            p = jnp.asarray(rng.rand(64, 4).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, 4, 64))
+            state = jitted(state, p, t)
+            P.append(np.asarray(p))
+            T.append(np.asarray(t))
+        assert traces["n"] == 1  # one compile across steps
+
+        out = b.apply_compute(state)
+        from metrics_tpu import Accuracy
+
+        full = Accuracy()
+        full.update(jnp.asarray(np.concatenate(P)), jnp.asarray(np.concatenate(T)))
+        assert out["raw"].shape == (20,)
+        np.testing.assert_allclose(float(out["mean"]), float(full.compute()), atol=0.08)
+        assert float(out["std"]) > 0
+
+    def test_deterministic_given_state(self):
+        rng = np.random.RandomState(1)
+        b = self._wrapper()
+        p = jnp.asarray(rng.rand(48, 4).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 4, 48))
+        r1 = b.apply_compute(b.apply_update(b.init_state(), p, t))["raw"]
+        r2 = b.apply_compute(b.apply_update(b.init_state(), p, t))["raw"]
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+
+    def test_poisson_rejected_on_pure_path(self):
+        from metrics_tpu import Accuracy
+
+        b = BootStrapper(Accuracy(), sampling_strategy="poisson")
+        state = b.init_state()  # building state is fine (reset() uses it)
+        with pytest.raises(ValueError, match="multinomial"):
+            b.apply_update(state, jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+
+    def test_sharded_compute(self):
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        rng = np.random.RandomState(2)
+        b = self._wrapper()
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+
+        def run(s, p, t):
+            s = b.apply_update(s, p, t)
+            return b.apply_compute(s, axis_name="data")["mean"]
+
+        fn = jax.jit(
+            jax.shard_map(run, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P(), check_vma=False)
+        )
+        p = jnp.asarray(rng.rand(320, 4).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 4, 320))
+        v = float(np.asarray(fn(b.init_state(), p, t)).ravel()[0])
+        assert 0.0 <= v <= 1.0
