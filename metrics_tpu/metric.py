@@ -506,11 +506,16 @@ class Metric(ABC):
     def compute(self) -> Any:
         """Override to produce the final value from (synced) states."""
 
-    def reset(self) -> None:
-        """Restore every state to its default."""
+    def _reset_flags(self) -> None:
+        """Clear the per-epoch bookkeeping (shared with wrapper overrides of
+        ``reset`` that must not rebuild state through ``init_state``)."""
         self._update_called = False
         self._forward_cache = None
         self._computed = None
+
+    def reset(self) -> None:
+        """Restore every state to its default."""
+        self._reset_flags()
         self._set_states(self.init_state())
 
     def clone(self) -> "Metric":
@@ -656,6 +661,54 @@ class CompositionalMetric(Metric):
             self.metric_a.persistent(mode=mode)
         if isinstance(self.metric_b, Metric):
             self.metric_b.persistent(mode=mode)
+
+    # ------------------------------------------------------------------
+    # pure (jit-native) API: child states keyed "a"/"b" — without this the
+    # base implementation would return an empty state and apply_compute
+    # would silently read the children's mutable (untracked) states
+    # ------------------------------------------------------------------
+    def init_state(self) -> StateDict:
+        state: StateDict = {}
+        if isinstance(self.metric_a, Metric):
+            state["a"] = self.metric_a.init_state()
+        if isinstance(self.metric_b, Metric) and self.metric_b is not self.metric_a:
+            state["b"] = self.metric_b.init_state()
+        return state
+
+    def apply_update(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
+        new_state: StateDict = {}
+        if isinstance(self.metric_a, Metric):
+            new_state["a"] = self.metric_a.apply_update(
+                state["a"], *args, **self.metric_a._filter_kwargs(**kwargs)
+            )
+        if isinstance(self.metric_b, Metric):
+            if self.metric_b is self.metric_a:
+                # aliased composition (m + m): eager update hits the shared
+                # object twice per step, so the pure state advances twice too
+                new_state["a"] = self.metric_a.apply_update(
+                    new_state["a"], *args, **self.metric_a._filter_kwargs(**kwargs)
+                )
+            else:
+                new_state["b"] = self.metric_b.apply_update(
+                    state["b"], *args, **self.metric_b._filter_kwargs(**kwargs)
+                )
+        return new_state
+
+    def apply_compute(self, state: StateDict, axis_name: Optional[Any] = None) -> Any:
+        val_a = (
+            self.metric_a.apply_compute(state["a"], axis_name=axis_name)
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        if isinstance(self.metric_b, Metric):
+            val_b = val_a if self.metric_b is self.metric_a else self.metric_b.apply_compute(
+                state["b"], axis_name=axis_name
+            )
+        else:
+            val_b = self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
 
     def __repr__(self) -> str:
         _op_name = getattr(self.op, "__name__", repr(self.op))

@@ -137,9 +137,7 @@ class BootStrapper(Metric):
         # pin stray children/key attributes on the wrapper
         for m in self.metrics:
             m.reset()
-        self._update_called = False
-        self._forward_cache = None
-        self._computed = None
+        self._reset_flags()
 
     def persistent(self, mode: bool = False) -> None:
         for m in self.metrics:

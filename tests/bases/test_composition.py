@@ -192,3 +192,55 @@ def test_compositional_forward_returns_value():
     comp = a + 3
     val = comp()
     np.testing.assert_allclose(np.asarray(val), 5)
+
+
+def test_compositional_pure_api_under_jit():
+    """The pure path threads explicit child states (keyed a/b) and matches
+    the eager composition — metric (op) metric, metric (op) constant, and
+    unary forms, all inside one jitted step."""
+    import jax
+
+    from metrics_tpu import Accuracy, Precision
+
+    rng = np.random.RandomState(0)
+    cases = [
+        Accuracy() + Precision(average="micro"),
+        Accuracy() * 2.0,
+        2.0 - Accuracy(),
+        abs(-Accuracy()),
+    ]
+    for comp in cases:
+        eager = comp.clone()
+        state = comp.init_state()
+        step = jax.jit(comp.apply_update)
+        for _ in range(3):
+            p = jnp.asarray(rng.rand(32, 4).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, 4, 32))
+            state = step(state, p, t)
+            eager.update(p, t)
+        np.testing.assert_allclose(
+            np.asarray(comp.apply_compute(state)), np.asarray(eager.compute()), atol=1e-6
+        )
+
+
+def test_compositional_pure_api_aliased_operand():
+    """m + m shares one instance: eager updates it twice per step; the pure
+    path must advance the single shared state twice to match."""
+    from metrics_tpu import Accuracy
+
+    m = Accuracy()
+    comp = m + m
+    eager_m = Accuracy()
+    eager = eager_m + eager_m
+
+    rng = np.random.RandomState(3)
+    state = comp.init_state()
+    assert set(state) == {"a"}
+    for _ in range(2):
+        p = jnp.asarray(rng.rand(16, 4).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 4, 16))
+        state = comp.apply_update(state, p, t)
+        eager.update(p, t)
+    np.testing.assert_allclose(
+        np.asarray(comp.apply_compute(state)), np.asarray(eager.compute()), atol=1e-6
+    )

@@ -154,7 +154,7 @@ class TestPureApi:
         from metrics_tpu import Accuracy
 
         b = BootStrapper(Accuracy(), sampling_strategy="poisson")
-        state = b.init_state()  # building state is fine (reset() uses it)
+        state = b.init_state()  # building the state itself is allowed
         with pytest.raises(ValueError, match="multinomial"):
             b.apply_update(state, jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
 
