@@ -280,3 +280,28 @@ def test_filter_kwargs():
 
     a = A()
     assert a._filter_kwargs(x=1, y=2, z=3) == {"x": 1, "y": 2}
+
+
+def test_gradients_flow_through_forward_value():
+    """The per-batch value is differentiable w.r.t. the inputs (the docs'
+    'forward detaches nothing' contract — the reference asserts this via
+    requires_grad on forward outputs, ``testers.py:464-497``): using a
+    metric's batch value as a training loss must yield the same gradient as
+    the raw functional."""
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.functional import mean_squared_error
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.randn(32).astype(np.float64))
+    target = jnp.asarray(rng.randn(32).astype(np.float64))
+
+    metric = MeanSquaredError()
+
+    def loss_via_forward(p):
+        _, value = metric.apply_forward(metric.init_state(), p, target)
+        return value
+
+    g_forward = jax.grad(loss_via_forward)(preds)
+    g_functional = jax.grad(lambda p: mean_squared_error(p, target))(preds)
+    assert bool(jnp.all(jnp.isfinite(g_forward)))
+    np.testing.assert_allclose(np.asarray(g_forward), np.asarray(g_functional), atol=1e-12)
