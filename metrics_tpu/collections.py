@@ -13,7 +13,7 @@ from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Union
 
-from metrics_tpu.metric import Metric, StateDict
+from metrics_tpu.metric import AXIS_UNSET, Metric, StateDict
 from metrics_tpu.utilities.prints import rank_zero_warn
 from metrics_tpu.utilities.profiling import compiled_scope, eager_span
 
@@ -165,16 +165,17 @@ class MetricCollection:
             for name, m in self.items(keep_base=True)
         }
 
-    def apply_compute(self, state: Dict[str, StateDict], axis_name: Optional[Any] = None) -> Dict[str, Any]:
+    def apply_compute(self, state: Dict[str, StateDict], axis_name: Any = AXIS_UNSET) -> Dict[str, Any]:
         """Compute every metric from its state; with ``axis_name`` the per-metric
-        collectives are emitted into one program for XLA to fuse/stage."""
+        collectives are emitted into one program for XLA to fuse/stage. When
+        omitted, each member falls back to its own declared ``process_group``."""
         out = {}
         for name, m in self.items(keep_base=True):
             out[self._set_name(name)] = m.apply_compute(state[name], axis_name=axis_name)
         return out
 
     def apply_forward(
-        self, state: Dict[str, StateDict], *args: Any, axis_name: Optional[Any] = None, **kwargs: Any
+        self, state: Dict[str, StateDict], *args: Any, axis_name: Any = AXIS_UNSET, **kwargs: Any
     ) -> Tuple[Dict[str, StateDict], Dict[str, Any]]:
         """(accumulated state, per-batch values) — one shared update pass.
 

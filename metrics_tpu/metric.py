@@ -62,6 +62,26 @@ ArrayTypes = (jax.Array, np.ndarray)
 StateValue = Union[Array, List[Array]]
 StateDict = Dict[str, StateValue]
 
+
+class _AxisUnset:
+    """Sentinel for "``axis_name`` not passed": the pure API then falls back
+    to the metric's constructor-declared ``process_group`` mesh axis. Distinct
+    from ``None``, which explicitly disables in-graph sync."""
+
+    _instance: Optional["_AxisUnset"] = None
+
+    def __new__(cls) -> "_AxisUnset":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "<axis unset>"
+
+
+#: pass-through default for ``apply_compute``/``apply_forward`` ``axis_name``
+AXIS_UNSET = _AxisUnset()
+
 _STR_REDUCTIONS: Dict[str, Callable] = {
     "sum": dim_zero_sum,
     "mean": dim_zero_mean,
@@ -108,8 +128,11 @@ class Metric(ABC):
             ``forward`` before computing the step value.
         process_group: mesh-axis name (or tuple of names) the metric's states
             reduce over in the in-graph path; the analogue of the reference's
-            torch.distributed process group (``metric.py:76``). ``None`` means
-            "all participants".
+            torch.distributed process group (``metric.py:76``). It is the
+            default ``axis_name`` of :meth:`apply_compute`/:meth:`apply_forward`
+            (an explicit ``axis_name=`` argument wins). ``None`` means "all
+            participants" (and no in-graph sync unless a call site passes an
+            axis).
         dist_sync_fn: override for the eager gather used at ``compute()``;
             receives one state array and returns the per-participant list.
     """
@@ -139,6 +162,7 @@ class Metric(ABC):
 
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
+        self._buffers: Dict[str, bool] = {}
         self._reductions: Dict[str, Optional[Union[str, Callable]]] = {}
 
         self._update_signature = inspect.signature(self.update)
@@ -155,6 +179,7 @@ class Metric(ABC):
         default: StateValue,
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        buffer: bool = False,
     ) -> None:
         """Register a state variable, accessible as ``self.<name>``.
 
@@ -164,6 +189,11 @@ class Metric(ABC):
         callable receiving the stacked ``(world, ...)`` gather. String specs
         are kept symbolic so the in-graph path can lower them to the matching
         XLA collective (psum/pmean/pmax/pmin/all_gather) directly.
+
+        ``buffer=True`` pins the state's persistence: :meth:`persistent` mode
+        flips skip it, mirroring the reference's ``register_buffer`` states
+        (e.g. binned-curve thresholds) which stay in ``state_dict`` regardless
+        of ``Metric.persistent()``.
         """
         is_empty_list = isinstance(default, list) and not default
         if not (isinstance(default, ArrayTypes) or is_empty_list):
@@ -180,6 +210,7 @@ class Metric(ABC):
         setattr(self, name, default if isinstance(default, ArrayTypes) else [])
         self._defaults[name] = deepcopy(default) if isinstance(default, list) else default
         self._persistent[name] = persistent
+        self._buffers[name] = buffer
         self._reductions[name] = dist_reduce_fx
 
     # ------------------------------------------------------------------
@@ -218,12 +249,18 @@ class Metric(ABC):
                 self._unwrapped_update(*args, **kwargs)
                 return self._get_states()
 
-    def apply_compute(self, state: StateDict, axis_name: Optional[Any] = None) -> Any:
+    def apply_compute(self, state: StateDict, axis_name: Any = AXIS_UNSET) -> Any:
         """Pure compute: final value from ``state``.
 
         With ``axis_name`` (inside ``shard_map``/``pmap``) states are first
-        synchronized across the named mesh axis with XLA collectives.
+        synchronized across the named mesh axis with XLA collectives. When the
+        argument is omitted it defaults to ``self.process_group`` — the
+        constructor's declared mesh axis — so a metric built with
+        ``process_group="data"`` syncs over that axis without every call site
+        repeating it; passing ``axis_name=None`` explicitly disables sync.
         """
+        if axis_name is AXIS_UNSET:
+            axis_name = self.process_group
         with compiled_scope(f"{self.__class__.__name__}.compute"):
             if axis_name is not None:
                 with compiled_scope(f"{self.__class__.__name__}.sync"):
@@ -235,7 +272,7 @@ class Metric(ABC):
         self,
         state: StateDict,
         *args: Any,
-        axis_name: Optional[Any] = None,
+        axis_name: Any = AXIS_UNSET,
         batch_state: Optional[StateDict] = None,
         **kwargs: Any,
     ) -> Tuple[StateDict, Any]:
@@ -244,9 +281,13 @@ class Metric(ABC):
         The batch value reflects only this batch (synced over ``axis_name``
         when ``dist_sync_on_step``), matching the reference's dual-result
         forward contract (``metric.py:168-198``) at single-update cost.
-        ``batch_state`` lets a caller (MetricCollection) supply the batch-local
-        state from a shared update pass instead of recomputing it here.
+        ``axis_name`` omitted defaults to ``self.process_group`` (see
+        :meth:`apply_compute`). ``batch_state`` lets a caller
+        (MetricCollection) supply the batch-local state from a shared update
+        pass instead of recomputing it here.
         """
+        if axis_name is AXIS_UNSET:
+            axis_name = self.process_group
         if batch_state is None:
             batch_state = self.apply_update(self.init_state(), *args, **kwargs)
         value = self.apply_compute(
@@ -523,7 +564,8 @@ class Metric(ABC):
 
     def persistent(self, mode: bool = False) -> None:
         for key in self._persistent:
-            self._persistent[key] = mode
+            if not self._buffers.get(key, False):
+                self._persistent[key] = mode
 
     def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
         """Serialize persistent states, synced across processes first so the
@@ -694,7 +736,9 @@ class CompositionalMetric(Metric):
                 )
         return new_state
 
-    def apply_compute(self, state: StateDict, axis_name: Optional[Any] = None) -> Any:
+    def apply_compute(self, state: StateDict, axis_name: Any = AXIS_UNSET) -> Any:
+        # forwarded verbatim: when unset, each child falls back to its own
+        # declared process_group; an explicit axis (or None) overrides all
         val_a = (
             self.metric_a.apply_compute(state["a"], axis_name=axis_name)
             if isinstance(self.metric_a, Metric)

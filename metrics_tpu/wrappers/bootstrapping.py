@@ -13,7 +13,7 @@ from typing import Any, Callable, Dict, Optional, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.metric import Array, ArrayTypes, Metric
+from metrics_tpu.metric import AXIS_UNSET, Array, ArrayTypes, Metric
 from metrics_tpu.utilities.data import apply_to_collection
 
 
@@ -21,14 +21,28 @@ def _bootstrap_sampler(
     size: int,
     rng_key: Array,
     sampling_strategy: str = "poisson",
+    fixed_length: bool = False,
 ) -> Array:
     """Index array that resamples ``size`` rows with replacement.
 
     ``'poisson'``: each row is repeated n ~ Poisson(1) times (approximates the
     true bootstrap for large N); ``'multinomial'``: ``size`` uniform draws with
     replacement.
+
+    ``fixed_length=True`` (required under ``jit``, where output shapes must be
+    static) pins the Poisson resample to exactly ``size`` indices: rows are
+    visited in a random order and their Poisson repeats truncated/padded at
+    ``size``. Since Poisson(1) counts conditioned on a fixed total are
+    multinomial, this is the faithful static-shape reading of the Poisson
+    bootstrap; only the random total length is given up, and the random visit
+    order keeps the truncation/padding bias off any particular row.
     """
     if sampling_strategy == "poisson":
+        if fixed_length:
+            count_key, order_key = jax.random.split(rng_key)
+            counts = jax.random.poisson(count_key, 1.0, (size,))
+            order = jax.random.permutation(order_key, size)
+            return jnp.repeat(order, counts[order], total_repeat_length=size)
         counts = jax.random.poisson(rng_key, 1.0, (size,))
         return jnp.repeat(jnp.arange(size), counts, total_repeat_length=None)
     if sampling_strategy == "multinomial":
@@ -89,6 +103,7 @@ class BootStrapper(Metric):
                 f" but recieved {sampling_strategy}"
             )
         self.sampling_strategy = sampling_strategy
+        self._seed = seed
         self._rng_key = jax.random.PRNGKey(seed)
 
     def _next_key(self) -> Array:
@@ -148,26 +163,23 @@ class BootStrapper(Metric):
     # ------------------------------------------------------------------
     def init_state(self) -> Dict[str, Any]:
         """Pure state: every child's state stacked on a leading bootstrap
-        axis, plus the PRNG key. ``apply_update`` requires the
-        ``'multinomial'`` strategy — Poisson resampling produces
-        data-dependent batch lengths, which XLA cannot express; use the
-        stateful ``update`` for Poisson."""
+        axis, plus a PRNG key derived from ``seed``.
+
+        The pure path's key stream is seeded independently of the eager
+        ``update`` path's live key: interleaving eager updates never changes
+        which resamples a pure state built afterwards will draw, so pure runs
+        are reproducible from ``seed`` alone.
+
+        Under ``jit`` the ``'poisson'`` strategy uses the fixed-length
+        resample (see :func:`_bootstrap_sampler`): exactly ``size`` draws per
+        child, the static-shape reading of the Poisson bootstrap."""
         stacked = jax.tree.map(
             lambda *leaves: jnp.stack(leaves, axis=0),
             *[m.init_state() for m in self.metrics],
         )
-        return {"children": stacked, "key": self._rng_key}
-
-    def _check_pure_supported(self) -> None:
-        if self.sampling_strategy != "multinomial":
-            raise ValueError(
-                "the jit-native BootStrapper state requires"
-                " sampling_strategy='multinomial' (fixed-size resamples);"
-                " Poisson resampling is eager-only"
-            )
+        return {"children": stacked, "key": jax.random.PRNGKey(self._seed)}
 
     def apply_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        self._check_pure_supported()
         sizes = apply_to_collection((args, kwargs), ArrayTypes, lambda a: a.shape[0])
         flat_sizes = jax.tree.leaves(sizes)
         if not flat_sizes:
@@ -178,7 +190,9 @@ class BootStrapper(Metric):
         child = self.metrics[0]
 
         def one(child_state: Dict[str, Any], k: Array) -> Dict[str, Any]:
-            idx = _bootstrap_sampler(size, k, sampling_strategy="multinomial")
+            idx = _bootstrap_sampler(
+                size, k, sampling_strategy=self.sampling_strategy, fixed_length=True
+            )
             new_args = apply_to_collection(args, ArrayTypes, jnp.take, idx, axis=0)
             new_kwargs = apply_to_collection(kwargs, ArrayTypes, jnp.take, idx, axis=0)
             return child.apply_update(child_state, *new_args, **new_kwargs)
@@ -186,8 +200,9 @@ class BootStrapper(Metric):
         children = jax.vmap(one)(state["children"], jax.random.split(sub, self.num_bootstraps))
         return {"children": children, "key": key}
 
-    def apply_compute(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Array]:
-        self._check_pure_supported()
+    def apply_compute(self, state: Dict[str, Any], axis_name: Any = AXIS_UNSET) -> Dict[str, Array]:
+        if axis_name is AXIS_UNSET and self.process_group is not None:
+            axis_name = self.process_group  # wrapper-declared axis wins; else children resolve theirs
         child = self.metrics[0]
         computed_vals = jax.vmap(lambda s: child.apply_compute(s, axis_name=axis_name))(
             state["children"]

@@ -85,6 +85,87 @@ def test_collection_on_2d_mesh():
         np.testing.assert_allclose(values[key], expected[key], atol=1e-6)
 
 
+def test_process_group_is_default_axis_name():
+    """A metric constructed with ``process_group="data"`` syncs over that axis
+    when ``apply_compute``/``apply_forward`` are called WITHOUT ``axis_name`` —
+    the constructor contract (``Metric`` docstring); an explicit
+    ``axis_name=None`` disables sync again."""
+    rng = np.random.RandomState(6)
+    n, c = 64, 5
+    logits = rng.rand(n, c).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, c, n))
+
+    metric = Accuracy(process_group="data")
+    mesh = _mesh()
+
+    def step(p, t):
+        state = metric.apply_update(metric.init_state(), p, t)
+        defaulted = metric.apply_compute(state)  # no axis_name: uses process_group
+        local = metric.apply_compute(state, axis_name=None)  # explicit None wins: no sync
+        _, fwd_value = metric.apply_forward(metric.init_state(), p, t)
+        return defaulted.reshape(1), local.reshape(1), fwd_value.reshape(1)
+
+    fn = jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P("data"), P("data")),
+            out_specs=(P("model"), P(("data", "model")), P(("data", "model"))),
+            check_vma=False,
+        )
+    )
+    defaulted, local, fwd_value = (
+        np.asarray(x)
+        for x in fn(
+            jax.device_put(preds, NamedSharding(mesh, P("data"))),
+            jax.device_put(target, NamedSharding(mesh, P("data"))),
+        )
+    )
+
+    seq = metric.apply_update(metric.init_state(), preds, target)
+    expected = float(metric.apply_compute(seq, axis_name=None))
+    np.testing.assert_allclose(defaulted, expected, atol=1e-6)
+    # the un-synced per-shard values are genuinely local (they differ across
+    # data shards for this stream) and average to the global value
+    assert local.shape[0] == DATA * MODEL
+    assert np.std(local[::MODEL]) > 0
+    np.testing.assert_allclose(np.mean(local[::MODEL]), expected, atol=1e-6)
+    # forward's batch value with dist_sync_on_step=False stays local (one
+    # per-shard accuracy each); equal shard sizes make their mean the global
+    np.testing.assert_allclose(np.mean(fwd_value[::MODEL]), expected, atol=1e-6)
+
+
+def test_forward_syncs_batch_value_over_defaulted_axis():
+    """dist_sync_on_step=True + process_group: the per-batch forward value is
+    synced over the declared axis with no axis_name at the call site."""
+    rng = np.random.RandomState(7)
+    n = 64
+    preds = jnp.asarray(rng.rand(n).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, n))
+
+    metric = Accuracy(dist_sync_on_step=True, process_group="data")
+    mesh = _mesh()
+
+    def step(p, t):
+        _, value = metric.apply_forward(metric.init_state(), p, t)
+        return value.reshape(1)
+
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("model"), check_vma=False
+        )
+    )
+    per_model = np.asarray(
+        fn(
+            jax.device_put(preds, NamedSharding(mesh, P("data"))),
+            jax.device_put(target, NamedSharding(mesh, P("data"))),
+        )
+    )
+    seq = metric.apply_update(metric.init_state(), preds, target)
+    np.testing.assert_allclose(per_model, float(metric.apply_compute(seq, axis_name=None)), atol=1e-6)
+
+
 def test_tuple_axis_names_reduce_over_both():
     """axis_name=("data", "model") reduces over the whole mesh — the
     'all participants' default of the reference's process_group=None."""

@@ -150,13 +150,58 @@ class TestPureApi:
         r2 = b.apply_compute(b.apply_update(b.init_state(), p, t))["raw"]
         np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
 
-    def test_poisson_rejected_on_pure_path(self):
+    def test_poisson_pure_path_fixed_length(self):
+        """Poisson resampling works under jit via the fixed-length (size-
+        conditioned) approximation — statistics match multinomial closely."""
         from metrics_tpu import Accuracy
 
-        b = BootStrapper(Accuracy(), sampling_strategy="poisson")
-        state = b.init_state()  # building the state itself is allowed
-        with pytest.raises(ValueError, match="multinomial"):
-            b.apply_update(state, jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+        rng = np.random.RandomState(5)
+        b = BootStrapper(Accuracy(), num_bootstraps=20, sampling_strategy="poisson", seed=3, raw=True)
+        p = jnp.asarray(rng.rand(256, 4).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 4, 256))
+        out = jax.jit(lambda s, p, t: b.apply_compute(b.apply_update(s, p, t), axis_name=None))(
+            b.init_state(), p, t
+        )
+        full = Accuracy()
+        full.update(p, t)
+        assert out["raw"].shape == (20,)
+        np.testing.assert_allclose(float(out["mean"]), float(full.compute()), atol=0.08)
+        assert float(out["std"]) > 0
+
+    def test_fixed_length_poisson_sampler_statistics(self):
+        """The fixed-length Poisson resample is uniform over rows (random
+        visit order keeps the truncation/padding off any particular row)."""
+        from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+        size = 64
+        counts = np.zeros(size)
+        n_draws = 200
+        for i in range(n_draws):
+            idx = np.asarray(
+                _bootstrap_sampler(size, jax.random.PRNGKey(i), "poisson", fixed_length=True)
+            )
+            assert idx.shape == (size,)
+            assert idx.min() >= 0 and idx.max() < size
+            counts += np.bincount(idx, minlength=size)
+        per_row = counts / n_draws
+        # each row is drawn ~1 time per resample on average
+        np.testing.assert_allclose(per_row.mean(), 1.0, atol=0.05)
+        assert per_row.std() < 0.3
+
+    def test_pure_key_stream_independent_of_eager_updates(self):
+        """Eager updates advance the wrapper's live key, but a pure state
+        built afterwards still draws the seed-derived stream."""
+        rng = np.random.RandomState(6)
+        p = jnp.asarray(rng.rand(48, 4).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 4, 48))
+
+        b1 = self._wrapper()
+        r1 = b1.apply_compute(b1.apply_update(b1.init_state(), p, t))["raw"]
+
+        b2 = self._wrapper()
+        b2.update(p, t)  # mutates the eager key stream
+        r2 = b2.apply_compute(b2.apply_update(b2.init_state(), p, t))["raw"]
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
 
     def test_sharded_compute(self):
         from jax.sharding import Mesh
