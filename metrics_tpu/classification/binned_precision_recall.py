@@ -98,7 +98,10 @@ class BinnedPrecisionRecallCurve(Metric):
             # works; "max" (unlike "mean") keeps the fused single-update
             # forward path available (_MERGEABLE_REDUCTIONS)
             dist_reduce_fx="max",
-            persistent=True,  # the reference's register_buffer always persists
+            # the reference's register_buffer always persists — buffer=True
+            # keeps it in state_dict even after Metric.persistent(False)
+            persistent=True,
+            buffer=True,
         )
 
         for name in ("TPs", "FPs", "FNs"):

@@ -46,9 +46,11 @@ def _masked_rank(data: Array, valid: Array) -> Array:
     )
     changed = (inv_s[1:] != inv_s[:-1]) | (x_s[1:] != x_s[:-1])
     start_idx, end_idx = tie_group_bounds(changed)
-    # fractional rank = mean of the tie group's 1-based rank block; compute
-    # in float32 so half-precision dtypes don't overflow on start+end (~2n)
-    frac = ((start_idx + end_idx).astype(jnp.float32) / 2 + 1).astype(dtype)
+    # fractional rank = mean of the tie group's 1-based rank block; at least
+    # float32 so half-precision dtypes don't overflow on start+end (~2n), and
+    # the full promoted dtype (float64 streams) so ranks beyond 2^23 stay exact
+    frac_dtype = jnp.promote_types(dtype, jnp.float32)
+    frac = ((start_idx + end_idx).astype(frac_dtype) / 2 + 1).astype(dtype)
     return jnp.zeros(n, dtype).at[orig].set(frac)
 
 

@@ -36,6 +36,23 @@ def test_state_pytree_in_orbax_checkpoint(tmp_path):
         np.testing.assert_allclose(out_resumed[key], out_direct[key], atol=1e-7)
 
 
+def test_buffer_states_survive_persistent_flip():
+    """Buffer-like states (the reference's register_buffer, e.g. binned-curve
+    thresholds) stay in state_dict even after ``persistent(False)``."""
+    from metrics_tpu import BinnedPrecisionRecallCurve
+
+    metric = BinnedPrecisionRecallCurve(num_classes=2, num_thresholds=5)
+    metric.persistent(False)
+    sd = metric.state_dict()
+    assert "thresholds" in sd
+    np.testing.assert_allclose(sd["thresholds"], np.linspace(0, 1.0, 5))
+    # ordinary states obey the flip
+    assert "TPs" not in sd
+    # and flip back on
+    metric.persistent(True)
+    assert "TPs" in metric.state_dict()
+
+
 def test_state_dict_numpy_roundtrip_via_file(tmp_path):
     """state_dict values are NumPy arrays storable in any checkpoint format."""
     metric = Accuracy()
