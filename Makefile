@@ -1,0 +1,29 @@
+# Headless CI entry points — `make ci` reproduces the green state locally
+# exactly as .github/workflows/ci.yml runs it.
+.PHONY: ci test doctest doctest-docs dryrun bench
+
+ci: test doctest doctest-docs dryrun
+
+# Full suite on the virtual 8-device CPU mesh (tests/conftest.py), including
+# the real 2-process jax.distributed sync test (tests/bases/test_multiprocess.py).
+# -rs is in setup.cfg addopts, so every skip prints its reason.
+test:
+	python -m pytest tests/ -q --durations=25
+
+# Docstring examples over the whole library (also collected by default via
+# --doctest-modules in setup.cfg addopts; root conftest.py forces CPU).
+doctest:
+	python -m pytest --doctest-modules metrics_tpu/ -q
+
+# Markdown documentation examples (docs/ + README) as doctests.
+doctest-docs:
+	python -m pytest --doctest-glob='*.md' docs/ README.md -q
+
+# The driver's multi-chip sharding gate: full distributed metric step on an
+# 8-device mesh (falls back to virtual CPU devices when chips are missing).
+dryrun:
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('DRYRUN OK')"
+
+# Full benchmark suite on the default backend (the real TPU chip under axon).
+bench:
+	python bench.py

@@ -37,8 +37,8 @@ class AveragePrecision(CappedBufferMixin, Metric):
         >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
         >>> target = jnp.asarray([0, 1, 1, 1])
         >>> average_precision = AveragePrecision(pos_label=1)
-        >>> average_precision(pred, target)
-        Array(1., dtype=float32)
+        >>> print(f"{average_precision(pred, target):.4f}")
+        1.0000
     """
 
     is_differentiable = False

@@ -24,8 +24,8 @@ class PrecisionRecallCurve(Metric):
         >>> target = jnp.asarray([0, 1, 1, 0])
         >>> pr_curve = PrecisionRecallCurve(pos_label=1)
         >>> precision, recall, thresholds = pr_curve(pred, target)
-        >>> precision
-        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+        >>> print(jnp.round(precision, 4))
+        [0.6667 0.5    0.     1.    ]
     """
 
     is_differentiable = False

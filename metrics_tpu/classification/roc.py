@@ -20,8 +20,8 @@ class ROC(Metric):
         >>> target = jnp.asarray([0, 1, 1, 1])
         >>> roc = ROC(pos_label=1)
         >>> fpr, tpr, thresholds = roc(pred, target)
-        >>> fpr
-        Array([0., 0., 0., 0., 1.], dtype=float32)
+        >>> print(jnp.round(fpr, 4))
+        [0. 0. 0. 0. 1.]
     """
 
     is_differentiable = False

@@ -60,8 +60,8 @@ def average_precision(
         >>> from metrics_tpu.functional import average_precision
         >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
         >>> target = jnp.asarray([0, 1, 1, 1])
-        >>> average_precision(pred, target, pos_label=1)
-        Array(1., dtype=float32)
+        >>> print(f"{average_precision(pred, target, pos_label=1):.4f}")
+        1.0000
     """
     preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label)
     return _average_precision_compute(preds, target, num_classes, pos_label, sample_weights)

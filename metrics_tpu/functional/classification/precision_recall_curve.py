@@ -160,12 +160,12 @@ def precision_recall_curve(
         >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
         >>> target = jnp.asarray([0, 1, 1, 0])
         >>> precision, recall, thresholds = precision_recall_curve(pred, target, pos_label=1)
-        >>> precision
-        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
-        >>> recall
-        Array([1. , 0.5, 0. , 0. ], dtype=float32)
-        >>> thresholds
-        Array([1., 2., 3.], dtype=float32)
+        >>> print(jnp.round(precision, 4))
+        [0.6667 0.5    0.     1.    ]
+        >>> print(jnp.round(recall, 4))
+        [1.  0.5 0.  0. ]
+        >>> print(jnp.round(thresholds, 4))
+        [1. 2. 3.]
     """
     preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
     return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

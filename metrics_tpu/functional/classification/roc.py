@@ -81,10 +81,10 @@ def roc(
         >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
         >>> target = jnp.asarray([0, 1, 1, 1])
         >>> fpr, tpr, thresholds = roc(pred, target, pos_label=1)
-        >>> fpr
-        Array([0., 0., 0., 0., 1.], dtype=float32)
-        >>> tpr
-        Array([0.        , 0.33333334, 0.6666667 , 1.        , 1.        ],      dtype=float32)
+        >>> print(jnp.round(fpr, 4))
+        [0. 0. 0. 0. 1.]
+        >>> print(jnp.round(tpr, 4))
+        [0.     0.3333 0.6667 1.     1.    ]
     """
     preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
     return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

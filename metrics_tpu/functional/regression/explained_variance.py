@@ -70,8 +70,8 @@ def explained_variance(
         >>> from metrics_tpu.functional import explained_variance
         >>> target = jnp.asarray([3, -0.5, 2, 7])
         >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
-        >>> explained_variance(preds, target)
-        Array(0.95717347, dtype=float32)
+        >>> print(f"{explained_variance(preds, target):.4f}")
+        0.9572
     """
     n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
     return _explained_variance_compute(

@@ -29,8 +29,8 @@ def mean_absolute_error(preds: Array, target: Array) -> Array:
         >>> from metrics_tpu.functional import mean_absolute_error
         >>> x = jnp.asarray([0., 1, 2, 3])
         >>> y = jnp.asarray([0., 1, 2, 2])
-        >>> mean_absolute_error(x, y)
-        Array(0.25, dtype=float32)
+        >>> print(f"{mean_absolute_error(x, y):.4f}")
+        0.2500
     """
     sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
     return _mean_absolute_error_compute(sum_abs_error, n_obs)

@@ -35,8 +35,8 @@ def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
         >>> from metrics_tpu.functional import mean_absolute_percentage_error
         >>> target = jnp.asarray([1., 10, 1e6])
         >>> preds = jnp.asarray([0.9, 15, 1.2e6])
-        >>> mean_absolute_percentage_error(preds, target)
-        Array(0.26666668, dtype=float32)
+        >>> print(f"{mean_absolute_percentage_error(preds, target):.4f}")
+        0.2667
     """
     sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
     return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

@@ -32,8 +32,8 @@ def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Arr
         >>> from metrics_tpu.functional import mean_squared_error
         >>> x = jnp.asarray([0., 1, 2, 3])
         >>> y = jnp.asarray([0., 1, 2, 2])
-        >>> mean_squared_error(x, y)
-        Array(0.25, dtype=float32)
+        >>> print(f"{mean_squared_error(x, y):.4f}")
+        0.2500
     """
     sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
     return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

@@ -32,10 +32,10 @@ def embedding_similarity(
         >>> import jax.numpy as jnp
         >>> from metrics_tpu.functional import embedding_similarity
         >>> embeddings = jnp.asarray([[1., 2., 3., 4.], [1., 2., 3., 4.], [4., 5., 6., 7.]])
-        >>> jnp.round(embedding_similarity(embeddings), 4)
-        Array([[0.    , 1.    , 0.9759],
-               [1.    , 0.    , 0.9759],
-               [0.9759, 0.9759, 0.    ]], dtype=float32)
+        >>> print(jnp.round(embedding_similarity(embeddings), 4))
+        [[0.     1.     0.9759]
+         [1.     0.     0.9759]
+         [0.9759 0.9759 0.    ]]
     """
     if similarity == "cosine":
         norm = jnp.linalg.norm(batch, ord=2, axis=1)

@@ -31,8 +31,8 @@ class CosineSimilarity(Metric):
         >>> target = jnp.asarray([[1., 2, 3, 4], [1., 2, 3, 4]])
         >>> preds = jnp.asarray([[1., 2, 3, 4], [-1., -2, -3, -4]])
         >>> cosine_similarity = CosineSimilarity(reduction='mean')
-        >>> cosine_similarity(preds, target)
-        Array(0., dtype=float32)
+        >>> print(f"{cosine_similarity(preds, target):.4f}")
+        0.0000
     """
 
     is_differentiable = True

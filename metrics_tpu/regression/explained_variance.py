@@ -23,8 +23,8 @@ class ExplainedVariance(Metric):
         >>> target = jnp.asarray([3, -0.5, 2, 7])
         >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
         >>> explained_variance = ExplainedVariance()
-        >>> explained_variance(preds, target)
-        Array(0.95717347, dtype=float32)
+        >>> print(f"{explained_variance(preds, target):.4f}")
+        0.9572
     """
 
     is_differentiable = True

@@ -20,8 +20,8 @@ class MeanAbsoluteError(Metric):
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
         >>> mean_absolute_error = MeanAbsoluteError()
-        >>> mean_absolute_error(preds, target)
-        Array(0.5, dtype=float32)
+        >>> print(f"{mean_absolute_error(preds, target):.4f}")
+        0.5000
     """
 
     is_differentiable = True

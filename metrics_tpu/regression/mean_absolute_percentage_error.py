@@ -20,8 +20,8 @@ class MeanAbsolutePercentageError(Metric):
         >>> target = jnp.asarray([1., 10, 1e6])
         >>> preds = jnp.asarray([0.9, 15, 1.2e6])
         >>> mean_abs_percentage_error = MeanAbsolutePercentageError()
-        >>> mean_abs_percentage_error(preds, target)
-        Array(0.26666668, dtype=float32)
+        >>> print(f"{mean_abs_percentage_error(preds, target):.4f}")
+        0.2667
     """
 
     is_differentiable = True

@@ -20,8 +20,8 @@ class MeanSquaredError(Metric):
         >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
         >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
         >>> mean_squared_error = MeanSquaredError()
-        >>> mean_squared_error(preds, target)
-        Array(0.875, dtype=float32)
+        >>> print(f"{mean_squared_error(preds, target):.4f}")
+        0.8750
     """
 
     is_differentiable = True

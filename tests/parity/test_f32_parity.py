@@ -1,0 +1,138 @@
+"""Parity at deployment precision: the suite-wide float64 default is turned
+OFF for this module, so these cases certify the numerics users actually get
+on TPU (float32 states/kernels) against the reference running its own
+default float32.
+
+A representative slice of every family — the stat-scores stack (integer
+sums: still exact in f32), regression streaming moments and correlations
+(f32 reduction-order differences allowed for by per-metric tolerances),
+sort-scan curves, padded retrieval, and the conv/log-domain image/audio
+metrics — each streamed through both libraries via the shared
+``stream_both`` harness (tolerance practice per the reference's
+``tests/helpers/testers.py:283`` atol overrides).
+"""
+import jax
+import numpy as np
+import pytest
+
+import metrics_tpu
+
+from tests.parity.helpers import stream_both
+from tests.parity.test_fuzz import _random_classification_case
+
+SEEDS = list(range(20))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _f32_mode():
+    """x64 off for this module only (restored afterwards). jit caches key on
+    the flag, so compiled programs from the f64 suite are not reused."""
+    jax.config.update("jax_enable_x64", False)
+    yield
+    jax.config.update("jax_enable_x64", True)
+
+
+def test_x64_is_off(_f32_mode):
+    import jax.numpy as jnp
+
+    assert jnp.asarray(1.5).dtype == jnp.float32
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_f32_fuzz_classification(torchmetrics_ref, seed):
+    """Stat-scores stack: counts are integer-valued, so f32 stays exact —
+    tolerances need no loosening."""
+    rng = np.random.RandomState(5000 + seed)
+    name, kwargs, preds, target = _random_classification_case(rng)
+    stream_both(
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
+        [(preds[i], target[i]) for i in range(preds.shape[0])],
+    )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_f32_fuzz_regression(torchmetrics_ref, seed):
+    """Streaming moments in f32 on both sides; reduction orders differ, so
+    relative tolerance is f32-scale."""
+    rng = np.random.RandomState(6000 + seed)
+    batch = int(rng.choice([2, 5, 33, 128]))
+    batches = int(rng.randint(1, 5))
+    scale = float(10.0 ** rng.randint(-2, 3))
+    preds = (rng.randn(batches, batch) * scale).astype(np.float32)
+    target = (preds * 0.9 + 0.1 * scale * rng.randn(batches, batch)).astype(np.float32)
+
+    name = rng.choice(
+        ["MeanSquaredError", "MeanAbsoluteError", "ExplainedVariance", "R2Score", "PearsonCorrcoef"]
+    )
+    stream_both(
+        getattr(metrics_tpu, name)(),
+        getattr(torchmetrics_ref, name)(),
+        [(preds[i], target[i]) for i in range(batches)],
+        atol=1e-4,
+        rtol=5e-3,
+    )
+
+
+@pytest.mark.parametrize("name,kwargs", [("AUROC", {}), ("AveragePrecision", {})])
+def test_f32_curves_binary(torchmetrics_ref, name, kwargs):
+    """Sort-scan curve kernels: identical tie semantics at f32."""
+    rng = np.random.RandomState(77)
+    batches = [(rng.rand(64).astype(np.float32), rng.randint(0, 2, 64)) for _ in range(4)]
+    stream_both(
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
+        batches,
+        atol=1e-5,
+        rtol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("name", ["RetrievalMAP", "RetrievalNormalizedDCG", "RetrievalMRR"])
+def test_f32_retrieval(torchmetrics_ref, name):
+    rng = np.random.RandomState(88)
+    batches = []
+    for _ in range(3):
+        n = 48
+        idx = np.sort(rng.randint(0, 6, n))
+        batches.append((rng.rand(n).astype(np.float32), rng.randint(0, 2, n), idx))
+    stream_both(
+        getattr(metrics_tpu, name)(),
+        getattr(torchmetrics_ref, name)(),
+        batches,
+        atol=1e-5,
+        rtol=1e-4,
+    )
+
+
+def test_f32_image_audio(torchmetrics_ref):
+    rng = np.random.RandomState(99)
+    imgs = [
+        (rng.rand(2, 3, 32, 32).astype(np.float32), rng.rand(2, 3, 32, 32).astype(np.float32))
+        for _ in range(2)
+    ]
+    wavs = [
+        (rng.randn(4, 2000).astype(np.float32), rng.randn(4, 2000).astype(np.float32))
+        for _ in range(2)
+    ]
+    stream_both(
+        metrics_tpu.PSNR(data_range=1.0),
+        torchmetrics_ref.PSNR(data_range=1.0),
+        imgs,
+        atol=1e-4,
+        rtol=1e-4,
+    )
+    stream_both(
+        metrics_tpu.SSIM(data_range=1.0),
+        torchmetrics_ref.SSIM(data_range=1.0),
+        imgs,
+        atol=1e-4,
+        rtol=1e-3,
+    )
+    stream_both(
+        metrics_tpu.SI_SDR(),
+        torchmetrics_ref.SI_SDR(),
+        wavs,
+        atol=1e-3,
+        rtol=1e-3,
+    )
