@@ -1,120 +1,57 @@
-"""Step-overhead microbenchmark (BASELINE.json config #2).
+"""Driver benchmark: all BASELINE configs, one JSON line each.
 
-Workload: the MetricCollection of Accuracy + macro Precision/Recall/F1
-updated once per training step on a (1024, 10) batch — the way the framework
-is designed to run: the whole epoch's updates compiled into ONE XLA program
-(``lax.scan`` over the step axis, exactly what fusing the metric update into
-a jitted train step costs), vs the reference library's eager per-metric
-updates (TorchMetrics on torch-CPU, imported from the read-only reference
-checkout when available). Per-step data varies inside the scan so XLA cannot
-hoist the update out of the loop. Timing uses the two-length slope harness
-from ``scripts/bench_suite.py`` (see its docstring): the marginal device
-cost per step, with the TPU tunnel's fixed round-trip subtracted out.
+Emits every config from ``scripts/bench_suite.py`` — the five BASELINE.md
+rows (Accuracy loop; the fused Accuracy+P/R/F1 MetricCollection; AUROC/AP;
+retrieval MAP+NDCG; SSIM+PSNR+SI-SDR), the epoch-end compute configs
+(AUROC 200k sort-scan, FID 2048-d), the Pallas-vs-XLA kernel configs run on
+the real TPU backend, and the north-star ``train_step_metric_overhead``
+(% overhead of the 10-metric collection fused into a Flax train step,
+target <1%). The flagship collection config prints LAST.
 
-Prints exactly one JSON line:
-``{"metric": "...", "value": N, "unit": "...", "vs_baseline": N}`` where
-``vs_baseline`` is reference_time / our_time (higher is better, >1 = faster
-than the reference).
+Each line is ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
+where ``vs_baseline`` is baseline_time / our_time (higher is better; >1 =
+faster than the baseline — the reference library on torch-CPU for the parity
+configs, our own XLA formulation for the Pallas configs, the 1% target for
+the overhead config). Values are NaN-safe: a failed measurement prints
+``null``, never a fake number.
+
+Timing uses the two-length scan-slope harness (see
+``metrics_tpu/utilities/profiling.py::measure_scan_slope``): the marginal
+device cost per step with the TPU tunnel's fixed round-trip subtracted out,
+per-step data varied inside the scan so XLA cannot hoist the update.
 """
 import json
 import os
 import sys
-import time
-
-import numpy as np
-
-NUM_CLASSES = 10
-BATCH = 1024
-STEPS = 200
+import traceback
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 for _p in (REPO_ROOT, os.path.join(REPO_ROOT, "scripts")):
     if _p not in sys.path:
         sys.path.insert(0, _p)
 
-
-def _bench_ours() -> float:
-    import jax.numpy as jnp
-
-    from bench_suite import _time_scan_epoch
-    from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
-
-    collection = MetricCollection(
-        [
-            Accuracy(),
-            Precision(average="macro", num_classes=NUM_CLASSES),
-            Recall(average="macro", num_classes=NUM_CLASSES),
-            F1(average="macro", num_classes=NUM_CLASSES),
-        ]
-    )
-
-    rng = np.random.RandomState(0)
-    logits = rng.rand(STEPS, BATCH, NUM_CLASSES).astype(np.float32)
-    all_preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
-    all_target = jnp.asarray(rng.randint(0, NUM_CLASSES, (STEPS, BATCH)))
-
-    return _time_scan_epoch(
-        (all_preds, all_target), collection.init_state, collection.apply_update
-    )
-
-
-def _bench_reference() -> float:
-    """TorchMetrics (the reference) on torch-CPU, same workload."""
-    import os
-
-    repo_root = os.path.dirname(os.path.abspath(__file__))
-    if repo_root not in sys.path:
-        sys.path.insert(0, repo_root)
-    from tests.helpers.reference_compat import REFERENCE_PATH, install_pkg_resources_shim
-
-    install_pkg_resources_shim()
-    sys.path.insert(0, REFERENCE_PATH)
-    try:
-        import torch
-        from torchmetrics import Accuracy, F1, MetricCollection, Precision, Recall
-
-        collection = MetricCollection(
-            [
-                Accuracy(),
-                Precision(average="macro", num_classes=NUM_CLASSES),
-                Recall(average="macro", num_classes=NUM_CLASSES),
-                F1(average="macro", num_classes=NUM_CLASSES),
-            ]
-        )
-        rng = np.random.RandomState(0)
-        logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
-        preds = torch.from_numpy(logits / logits.sum(-1, keepdims=True))
-        target = torch.from_numpy(rng.randint(0, NUM_CLASSES, BATCH))
-
-        collection.update(preds, target)  # warm caches
-        start = time.perf_counter()
-        for _ in range(STEPS):
-            collection.update(preds, target)
-        return (time.perf_counter() - start) / STEPS
-    except Exception:
-        return float("nan")
-    finally:
-        sys.path.pop(0)
+# persistent compilation cache: XLA compiles of the large programs (scans,
+# eigh) can take minutes through this toolchain; cache them on disk so
+# repeated bench runs (and the driver's) pay once. Must be set before jax
+# initializes.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO_ROOT, ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
 def main() -> None:
-    ours = _bench_ours()
-    ref = _bench_reference()
-    measured = ours == ours  # NaN -> slope measurement failed
-    vs_baseline = (ref / ours) if (measured and ref == ref) else None
-    print(
-        json.dumps(
-            {
-                # "_fused" marks the methodology: our side measures the update
-                # compiled into the step program (lax.scan), the reference side
-                # its eager per-call cost — the architectural delta under test
-                "metric": "metric_collection_update_step_fused",
-                "value": round(ours * 1e6, 2) if measured else None,
-                "unit": "us/step",
-                "vs_baseline": round(vs_baseline, 3) if vs_baseline is not None else None,
-            }
-        )
-    )
+    import bench_suite
+
+    for cfg in bench_suite.CONFIGS:
+        try:
+            line = bench_suite.run_config(cfg)
+        except Exception:
+            print(f"# config {cfg.__name__} crashed:", file=sys.stderr)
+            traceback.print_exc()
+            name, unit = bench_suite.CONFIG_META.get(
+                cfg.__name__, (cfg.__name__.replace("bench_", ""), "us/step")
+            )
+            line = {"metric": name, "value": None, "unit": unit, "vs_baseline": None}
+        print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

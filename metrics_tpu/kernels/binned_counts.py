@@ -6,9 +6,12 @@ Computes the per-threshold confusion counts behind
 state the reference fills with a Python loop over thresholds,
 ``classification/binned_precision_recall.py:135-153``).
 
-* **XLA fallback** — one broadcast compare ``(N, C, 1) >= (T,)`` reduced over
-  N. XLA fuses it, but the ``(N, C, T)`` boolean intermediate bounds the fusion.
-* **Pallas kernel** — histogram formulation. With sorted thresholds,
+* **XLA formulation (the default)** — one broadcast compare
+  ``(N, C, 1) >= (T,)`` reduced over N. XLA fuses the compare-and-reduce
+  without materializing the ``(N, C, T)`` boolean, and on a real v5e chip
+  this beats the Pallas histogram at every measured size (see
+  :func:`binned_tp_fp_fn`) — the compiler's fusion is the right tool here.
+* **Pallas kernel (explicit only)** — histogram formulation. With sorted thresholds,
   ``[pred ≥ thr_t] ⇔ t < bucket`` where ``bucket = #{thr ≤ pred}``
   (a cheap ``O(N·C·log T)`` searchsorted in XLA). The counts then reduce to a
   **weighted bincount** over flat ``(class, bucket)`` bins — one Pallas pass
@@ -27,14 +30,11 @@ from jax.experimental import pallas as pl
 from metrics_tpu.kernels._common import (
     _PALLAS_TPU_AVAILABLE,
     _round_up,
-    pallas_auto_ok,
     pltpu,
 )
 
 _TILE = 512
 _KBLOCK = 2048  # bins per grid block: one-hot tile is TILE x KBLOCK f32 = 4 MB VMEM
-#: bin count past which the blocked histogram stops paying off vs the XLA path
-_MAX_PALLAS_BINS = 1 << 16
 
 
 def binned_tp_fp_fn_xla(
@@ -167,12 +167,18 @@ def binned_tp_fp_fn_pallas(
 def binned_tp_fp_fn(
     preds: jax.Array, target: jax.Array, thresholds: jax.Array, use_pallas: Optional[bool] = None
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Binned TP/FP/FN counts with automatic backend dispatch."""
+    """Binned TP/FP/FN counts with automatic backend dispatch.
+
+    Auto-dispatch always selects the XLA formulation: measured on a real
+    v5e chip the Pallas histogram loses at every size (5x at best,
+    n=8192/C=5/T=4000; 1000x at small sizes — its weighted bincount is a
+    rank-1 contraction the MXU cannot tile, while XLA fuses the broadcast
+    compare-and-reduce without materializing ``(N, C, T)``). The kernel
+    stays available via ``use_pallas=True`` for explicit use/benchmarks
+    (``scripts/bench_suite.py::bench_pallas_binned`` tracks the numbers).
+    """
     if use_pallas is None:
-        use_pallas = (
-            pallas_auto_ok(preds.size)
-            and preds.shape[1] * (thresholds.shape[0] + 1) <= _MAX_PALLAS_BINS
-        )
+        use_pallas = False
     if use_pallas:
         return binned_tp_fp_fn_pallas(preds, target, thresholds)
     return binned_tp_fp_fn_xla(preds, target, thresholds)
