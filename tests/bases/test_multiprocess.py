@@ -74,15 +74,16 @@ _WORKER = textwrap.dedent(
 )
 
 
-def test_two_process_sync_matches_sequential(tmp_path):
+def _run_two_process_worker(tmp_path, script, extra_env=None, timeout=220):
     with socket.socket() as s:  # reserve a free coordinator port
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+    worker.write_text(script)
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), str(r), str(port)],
@@ -95,10 +96,85 @@ def test_two_process_sync_matches_sequential(tmp_path):
     outputs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=220)
+            out, _ = p.communicate(timeout=timeout)
             outputs.append(out.decode())
     finally:
         for p in procs:
             p.kill()
     for rank, out in enumerate(outputs):
         assert f"PARITY_OK rank={rank}" in out, f"rank {rank} failed:\n{out[-3000:]}"
+
+
+def test_two_process_sync_matches_sequential(tmp_path):
+    _run_two_process_worker(tmp_path, _WORKER)
+
+
+_SPMD_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank, port = int(sys.argv[1]), sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from sklearn.metrics import accuracy_score, precision_score
+
+    from metrics_tpu import Accuracy, MetricCollection, Precision
+
+    # 2 processes x 4 local devices = one GLOBAL 8-device mesh: the in-graph
+    # psum crosses the process boundary (the DCN analogue), not just ICI
+    devices = np.array(jax.devices())
+    assert devices.size == 8, devices
+    mesh = Mesh(devices, ("data",))
+
+    NC, PER_DEV = 4, 16
+    n = 8 * PER_DEV
+    rng = np.random.RandomState(11)  # identical stream on both processes
+    probs = rng.rand(n, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.randint(0, NC, n)
+
+    sharding = NamedSharding(mesh, P("data"))
+    # each process contributes its addressable shards of the global array
+    gp = jax.make_array_from_callback((n, NC), sharding, lambda idx: probs[idx])
+    gt = jax.make_array_from_callback((n,), sharding, lambda idx: target[idx])
+
+    metrics = MetricCollection([Accuracy(), Precision(average="macro", num_classes=NC)])
+
+    def step(p, t):
+        state = metrics.apply_update(metrics.init_state(), p, t)
+        return metrics.apply_compute(state, axis_name="data")
+
+    fn = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+    )
+    values = jax.tree.map(lambda x: float(np.asarray(x)), fn(gp, gt))
+
+    want_acc = accuracy_score(target, probs.argmax(-1))
+    np.testing.assert_allclose(values["Accuracy"], want_acc, atol=1e-6)
+    want_prec = precision_score(target, probs.argmax(-1), average="macro", zero_division=0)
+    np.testing.assert_allclose(values["Precision"], want_prec, atol=1e-6)
+
+    print(f"PARITY_OK rank={rank}", flush=True)
+    """
+)
+
+
+def test_two_process_global_mesh_in_graph_sync(tmp_path):
+    """Multi-host SPMD: a global mesh spanning 2 processes (4 virtual devices
+    each); the metric's in-graph psum crosses the process boundary — the
+    jit-path analogue of the reference's NCCL all_gather, complementing the
+    eager-gather test above."""
+    # keep any operator-set XLA flags; only the device-count flag is replaced
+    kept = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags = " ".join(kept + ["--xla_force_host_platform_device_count=4"])
+    _run_two_process_worker(tmp_path, _SPMD_WORKER, extra_env={"XLA_FLAGS": flags})
