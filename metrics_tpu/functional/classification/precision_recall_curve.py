@@ -15,6 +15,7 @@ fixed-shape alternative use the binned curve metrics
 """
 from typing import List, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.data import Array
@@ -37,14 +38,16 @@ def _binary_clf_curve(
 
     if preds.ndim > target.ndim:
         preds = preds[:, 0]
-    # stable argsort of -preds = descending with ascending-index tiebreak,
-    # matching torch.argsort(descending=True) on ties
-    desc_score_indices = jnp.argsort(-preds, stable=True)
-
-    preds = preds[desc_score_indices]
-    target = target[desc_score_indices]
-
-    weight = sample_weights[desc_score_indices] if sample_weights is not None else 1.0
+    # descending stable sort as one variadic sort — key (-preds, index) with
+    # ascending-index tiebreak matches torch.argsort(descending=True) on
+    # ties, and carrying preds/target/weights as payloads avoids the
+    # random-access gathers an argsort would need (TPU serializes gathers)
+    n = preds.shape[0]
+    payloads = (target,) if sample_weights is None else (target, sample_weights)
+    sorted_arrays = jax.lax.sort((-preds, jnp.arange(n)) + payloads, num_keys=2)
+    preds = -sorted_arrays[0]  # exact inverse of the key negation
+    target = sorted_arrays[2]
+    weight = sorted_arrays[3] if sample_weights is not None else 1.0
 
     distinct_value_indices = jnp.where(preds[1:] - preds[:-1])[0]
     threshold_idxs = jnp.append(distinct_value_indices, target.shape[0] - 1)
