@@ -5,8 +5,10 @@ varies EVERYTHING per seed — batch size, class count, batch count, dtype,
 degenerate label distributions (all-one-class, single-sample batches) and a
 random metric configuration — and streams identical data through both
 libraries (dtype varies in the regression family; classification sticks to
-the reference's float32-probs convention). 40 seeds x 2 families; failures
-reproduce from the seed alone.
+the reference's float32-probs convention). 40 seeds x 4 families
+(classification, regression, curve scalars under randomized tie density,
+retrieval under adversarial group layouts); failures reproduce from the
+seed alone.
 """
 import numpy as np
 import pytest
@@ -86,4 +88,81 @@ def test_fuzz_regression(torchmetrics_ref, seed):
         getattr(torchmetrics_ref, name)(),
         [(preds[i], target[i]) for i in range(batches)],
         atol=1e-4 * max(value_scale, 1e-4),
+    )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_curves(torchmetrics_ref, seed):
+    """Curve-scalar metrics under randomized tie density, degenerate label
+    distributions, and binary/multiclass modes — the sort-scan kernels'
+    tie/threshold semantics are the parity-riskiest surface."""
+    rng = np.random.RandomState(3000 + seed)
+    batch = int(rng.choice([1, 7, 33, 128]))
+    batches = int(rng.randint(1, 5))
+    # quantization controls tie density: 2 -> almost everything ties
+    quant = int(rng.choice([2, 10, 1000]))
+    multiclass = rng.rand() < 0.4
+
+    if multiclass:
+        nc = int(rng.randint(2, 6))
+        raw = rng.rand(batches, batch, nc)
+        raw /= raw.sum(-1, keepdims=True)
+        # quantize AFTER normalizing so per-class columns genuinely tie
+        # (both libraries accept [0,1] scores that don't sum to exactly 1)
+        preds = (np.round(raw * quant) / quant).astype(np.float32)
+        target = rng.randint(0, nc, (batches, batch))
+        name = str(rng.choice(["AUROC", "AveragePrecision"]))
+        kwargs = {"num_classes": nc}
+        if name == "AUROC":
+            kwargs["average"] = "macro"
+    else:
+        preds = (np.round(rng.rand(batches, batch) * quant) / quant).astype(np.float32)
+        target = rng.randint(0, 2, (batches, batch))
+        if rng.rand() < 0.2:
+            target = np.ones_like(target)  # single-class stream: error parity path
+        name = str(rng.choice(["AUROC", "AveragePrecision", "ROC", "PrecisionRecallCurve"]))
+        kwargs = {"pos_label": 1} if name in ("ROC", "PrecisionRecallCurve") else {}
+    stream_both(
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
+        [(preds[i], target[i]) for i in range(batches)],
+    )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_retrieval(torchmetrics_ref, seed):
+    """Retrieval metrics under adversarial group layouts: ragged group sizes,
+    empty-target groups (every policy), singleton groups, non-contiguous and
+    unsorted group ids."""
+    rng = np.random.RandomState(4000 + seed)
+    batches = int(rng.randint(1, 4))
+    policy = str(rng.choice(["neg", "pos", "skip"]))
+    name = str(
+        rng.choice(
+            ["RetrievalMAP", "RetrievalMRR", "RetrievalPrecision", "RetrievalRecall", "RetrievalNormalizedDCG"]
+        )
+    )
+    kwargs = {"empty_target_action": policy}
+    if name in ("RetrievalPrecision", "RetrievalRecall") and rng.rand() < 0.5:
+        kwargs["k"] = int(rng.randint(1, 5))
+
+    stream = []
+    for _ in range(batches):
+        n_groups = int(rng.randint(1, 6))
+        sizes = rng.randint(1, 9, n_groups)
+        ids = rng.choice(np.arange(0, 40), n_groups, replace=False)  # non-contiguous ids
+        idx = np.concatenate([np.full(s, g) for g, s in zip(ids, sizes)])
+        if rng.rand() < 0.5:
+            perm = rng.permutation(idx.size)  # unsorted group order
+            idx = idx[perm]
+        n = idx.size
+        preds = rng.rand(n).astype(np.float32)
+        target = rng.randint(0, 2, n)
+        if rng.rand() < 0.4:  # force at least one all-negative group
+            target[idx == ids[0]] = 0
+        stream.append((preds, target, idx.astype(np.int64)))
+    stream_both(
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
+        stream,
     )
