@@ -29,6 +29,33 @@ class Accuracy(StatScores):
     generalizes to top-K accuracy; ``subset_accuracy`` requires whole samples
     to match for multi-label / multi-dim inputs.
 
+    Args:
+        threshold: probability cutoff that binarizes float predictions in the
+            binary/multi-label cases.
+        num_classes: class count. Optional eagerly (inferred from data), but
+            REQUIRED whenever label-valued predictions are canonicalized
+            inside a traced program (``jit``/``shard_map``) — shapes cannot
+            depend on data values under XLA.
+        average: how per-class results combine — ``"micro"`` pools all
+            decisions, ``"macro"`` averages classes equally, ``"weighted"``
+            weights classes by support, ``"samples"`` averages per-sample
+            scores, ``"none"``/``None`` returns the per-class vector.
+        mdmc_average: how the extra dimension of multi-dim multi-class
+            inputs is handled: ``"global"`` flattens it into the sample axis,
+            ``"samplewise"`` computes per-sample then averages.
+        ignore_index: class label excluded from the score (its column is
+            dropped, or masked when it is the only class).
+        top_k: count a sample correct when the true class is within the
+            ``k`` highest-probability predictions (prob-like multi-class /
+            multi-dim inputs only).
+        multiclass: force inputs to be treated as multi-class (``True``) or
+            binary/multi-label (``False``) when the automatic case inference
+            would decide otherwise.
+        subset_accuracy: for multi-label / multi-dim inputs, require EVERY
+            label of a sample to match for the sample to count.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the common lifecycle quartet — see :class:`~metrics_tpu.Metric`.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import Accuracy

@@ -28,15 +28,28 @@ class AUROC(CappedBufferMixin, Metric):
     """Area under the ROC curve over all batches.
 
     Args:
+        num_classes: class count for multi-class scores (one-vs-rest at
+            compute); leave unset for binary streams.
+        pos_label: which of the two binary labels counts as positive
+            (binary mode only).
+        average: combination of the per-class areas — ``"macro"`` (equal
+            class weight), ``"weighted"`` (support-weighted), ``"micro"``
+            (pool every decision; prob-input multiclass only).
+        max_fpr: integrate only up to this false-positive rate and
+            standardize (McClish correction); binary list mode only.
         capacity: when set, accumulate into a fixed-size sample buffer
             instead of unbounded lists — the state structure is
             step-invariant, so the metric lives inside ``jit``/``shard_map``
             without retracing. Binary by default; with ``num_classes > 1``
             the buffer is ``(capacity, C)`` and the result is the
-            one-vs-rest macro/weighted average. Incompatible with ``max_fpr``.
+            one-vs-rest macro/weighted average. Samples past the capacity
+            are dropped with a warning (see ``docs/overview.md``).
+            Incompatible with ``max_fpr``.
         multilabel: capacity-mode hint that the ``(N, C)`` inputs are
             per-label binaries rather than class probabilities (the list
             mode infers this from data; a preallocated buffer cannot).
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the common lifecycle quartet — see :class:`~metrics_tpu.Metric`.
 
     Example:
         >>> import jax.numpy as jnp

@@ -13,6 +13,12 @@ from metrics_tpu.utilities.data import Array
 class FBeta(StatScores):
     """F-beta score: ``(1 + beta^2) * P * R / (beta^2 * P + R)``.
 
+    ``beta < 1`` favors precision, ``beta > 1`` favors recall. Shares the
+    stat-scores engine (and its argument set) with
+    :class:`~metrics_tpu.Accuracy`; classes whose precision AND recall are
+    both undefined are dropped from the ``"macro"``/``"weighted"`` mean.
+    :class:`~metrics_tpu.F1` is the ``beta=1`` special case.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import FBeta

@@ -14,6 +14,14 @@ from metrics_tpu.utilities.data import Array
 class Precision(StatScores):
     """``tp / (tp + fp)`` accumulated over batches.
 
+    Shares the stat-scores engine (and its argument set) with
+    :class:`~metrics_tpu.Accuracy` — see that class for the full description
+    of ``threshold`` / ``num_classes`` / ``average`` / ``mdmc_average`` /
+    ``ignore_index`` / ``top_k`` / ``multiclass``. ``average`` additionally
+    affects zero-division handling: classes with no predicted positives
+    score 0 and, under ``"weighted"``/``"macro"``, classes that never appear
+    are dropped from the mean.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import Precision
@@ -70,6 +78,11 @@ class Precision(StatScores):
 
 class Recall(StatScores):
     """``tp / (tp + fn)`` accumulated over batches.
+
+    Shares the stat-scores engine (and its argument set) with
+    :class:`~metrics_tpu.Accuracy`; see :class:`~metrics_tpu.Precision` for
+    the zero-division conventions (here: classes with no true positives +
+    false negatives).
 
     Example:
         >>> import jax.numpy as jnp
