@@ -141,6 +141,12 @@ class BinnedPrecisionRecallCurve(Metric):
 class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
     """Average precision from the binned curve (constant memory).
 
+    Args:
+        num_classes: class/label count (1 = binary stream).
+        num_thresholds: number of evenly spaced probability thresholds; more
+            thresholds tighten the approximation to the exact
+            :class:`~metrics_tpu.AveragePrecision` at linear state cost.
+
     Example (binary case):
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import BinnedAveragePrecision
@@ -158,6 +164,12 @@ class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
 
 class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
     """Highest recall (and its threshold) with precision above a floor.
+
+    Args:
+        num_classes: class/label count (1 = binary stream).
+        min_precision: the precision floor; returns recall 0 and threshold
+            1e6 for classes that never reach it.
+        num_thresholds: number of evenly spaced probability thresholds.
 
     Example (binary case):
         >>> import jax.numpy as jnp

@@ -15,6 +15,12 @@ from metrics_tpu.utilities.data import Array
 class CohenKappa(Metric):
     """Cohen's kappa agreement score accumulated over batches.
 
+    Args:
+        num_classes: number of classes.
+        weights: disagreement weighting — ``None`` (plain agreement),
+            ``'linear'`` or ``'quadratic'`` distance weighting.
+        threshold: probability cutoff binarizing float predictions.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import CohenKappa

@@ -18,6 +18,9 @@ from metrics_tpu.utilities.data import Array
 class HammingDistance(Metric):
     """Average fraction of per-label disagreements between preds and target.
 
+    Args:
+        threshold: probability cutoff binarizing float predictions.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import HammingDistance

@@ -15,6 +15,12 @@ from metrics_tpu.utilities.data import Array
 class Hinge(Metric):
     """Mean hinge loss accumulated over batches.
 
+    Args:
+        squared: square each sample's hinge loss before averaging.
+        multiclass_mode: ``None`` — Crammer-Singer margin (true-class score
+            minus the best other class); ``'one-vs-all'`` — a ``(C,)`` vector
+            of per-class binary hinge losses.
+
     Example (binary):
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import Hinge

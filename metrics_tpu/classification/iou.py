@@ -13,6 +13,16 @@ from metrics_tpu.utilities.data import Array
 class IoU(ConfusionMatrix):
     """Intersection over union accumulated over batches.
 
+    Args:
+        num_classes: number of classes.
+        ignore_index: class dropped from the reduction (its row/column still
+            counts toward other classes' unions).
+        absent_score: value reported for classes that appear in neither
+            predictions nor targets.
+        threshold: probability cutoff binarizing float predictions.
+        reduction: ``'elementwise_mean'`` | ``'sum'`` | ``'none'`` over the
+            per-class IoU vector.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import IoU

@@ -18,6 +18,10 @@ from metrics_tpu.utilities.data import Array
 class MatthewsCorrcoef(Metric):
     """Matthews correlation coefficient accumulated over batches.
 
+    Args:
+        num_classes: number of classes.
+        threshold: probability cutoff binarizing float predictions.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import MatthewsCorrcoef

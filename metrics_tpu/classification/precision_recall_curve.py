@@ -17,6 +17,15 @@ from metrics_tpu.utilities.data import Array, dim_zero_cat
 class PrecisionRecallCurve(Metric):
     """Precision/recall pairs at every distinct threshold, over all batches.
 
+    Args:
+        num_classes: class count for multi-class scores (returns per-class
+            curve lists); unset for binary streams.
+        pos_label: which binary label counts as positive.
+
+    Output shapes depend on the data (one point per distinct threshold), so
+    compute is an epoch-end operation; inside a compiled step use the
+    fixed-shape :class:`~metrics_tpu.BinnedPrecisionRecallCurve`.
+
     Example (binary):
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import PrecisionRecallCurve

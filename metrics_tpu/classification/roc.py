@@ -13,6 +13,15 @@ from metrics_tpu.utilities.data import Array, dim_zero_cat
 class ROC(Metric):
     """ROC curve (fpr, tpr, thresholds) over all batches.
 
+    Args:
+        num_classes: class count for multi-class scores (returns per-class
+            curve lists); unset for binary streams.
+        pos_label: which binary label counts as positive.
+
+    Like :class:`~metrics_tpu.PrecisionRecallCurve`, output shapes are
+    data-dependent — an epoch-end metric; use :class:`~metrics_tpu.AUROC`
+    with ``capacity=`` for the jit-native scalar.
+
     Example (binary):
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import ROC

@@ -13,6 +13,10 @@ from metrics_tpu.utilities.data import Array
 class Specificity(StatScores):
     """``tn / (tn + fp)`` accumulated over batches.
 
+    Shares the stat-scores engine (and its argument set) with
+    :class:`~metrics_tpu.Accuracy`; classes with no true negatives + false
+    positives score 0 under the averaged modes.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import Specificity

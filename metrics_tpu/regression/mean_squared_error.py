@@ -14,6 +14,9 @@ from metrics_tpu.utilities.data import Array
 class MeanSquaredError(Metric):
     """MSE (or RMSE with ``squared=False``) accumulated over batches.
 
+    Args:
+        squared: if ``False``, return the root mean squared error.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import MeanSquaredError

@@ -11,6 +11,12 @@ from metrics_tpu.utilities.data import Array
 class R2Score(Metric):
     """R2 score from streaming moment sums, ``(num_outputs,)``-shaped states.
 
+    Args:
+        num_outputs: regression target dimensionality.
+        adjusted: degrees of freedom for the adjusted-R2 penalty (0 = plain).
+        multioutput: ``'uniform_average'`` | ``'raw_values'`` |
+            ``'variance_weighted'`` combination of per-output scores.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import R2Score

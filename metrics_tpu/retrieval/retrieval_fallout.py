@@ -9,6 +9,9 @@ from metrics_tpu.utilities.data import Array
 class RetrievalFallOut(RetrievalMetric):
     """Mean fall-out@k over queries.
 
+
+    Constructor arguments (``empty_target_action`` / ``padded`` / ``k`` and the lifecycle quartet) are documented on the shared base class, :class:`~metrics_tpu.retrieval.retrieval_metric.RetrievalMetric`.
+
     A query counts as "empty" when it has no *negative* target
     (``retrieval_fallout.py:113-119``), and the default policy scores it 1.
 

@@ -7,6 +7,9 @@ from metrics_tpu.utilities.data import Array
 class RetrievalPrecision(RetrievalMetric):
     """Mean precision@k over queries (``k=None`` uses each query's full length).
 
+
+    Constructor arguments (``empty_target_action`` / ``padded`` / ``k`` and the lifecycle quartet) are documented on the shared base class, :class:`~metrics_tpu.retrieval.retrieval_metric.RetrievalMetric`.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import RetrievalPrecision

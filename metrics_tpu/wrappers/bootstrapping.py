@@ -53,6 +53,20 @@ def _bootstrap_sampler(
 class BootStrapper(Metric):
     """Wrap a metric to estimate the bootstrap distribution of its value.
 
+    Args:
+        base_metric: the metric to resample; it is deep-copied
+            ``num_bootstraps`` times.
+        num_bootstraps: number of independent resampled copies.
+        mean / std / quantile / raw: which statistics of the stacked child
+            values ``compute`` returns (``quantile`` takes the level(s);
+            ``raw`` includes the per-copy vector).
+        sampling_strategy: ``'poisson'`` — each row repeated n ~ Poisson(1)
+            times (fixed-length variant under ``jit``, see
+            :func:`_bootstrap_sampler`); ``'multinomial'`` — n uniform draws
+            with replacement.
+        seed: PRNG seed; the pure path's stream derives from it alone and is
+            unaffected by interleaved eager updates.
+
     Example::
 
         >>> import jax, jax.numpy as jnp
