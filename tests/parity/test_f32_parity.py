@@ -105,6 +105,63 @@ def test_f32_retrieval(torchmetrics_ref, name):
     )
 
 
+@pytest.mark.parametrize("seed", SEEDS[:10])
+def test_bf16_inputs_classification(torchmetrics_ref, seed):
+    """bfloat16 activations (the TPU deployment dtype) through the
+    stat-scores stack: our side consumes genuine bf16 arrays; the reference
+    is fed the identical post-rounding values in f32 (torch has no bf16
+    kernels for these). Thresholding/argmax decisions resolve on the same
+    values either way and the counts are integer-exact, so parity is exact."""
+    import jax.numpy as jnp
+    import torch
+
+    rng = np.random.RandomState(7000 + seed)
+    name, kwargs, preds, target = _random_classification_case(rng)
+    if np.issubdtype(np.asarray(preds).dtype, np.floating):
+        bf16 = jnp.asarray(np.asarray(preds, np.float32), jnp.bfloat16)
+        ref_preds = np.asarray(bf16.astype(jnp.float32))
+    else:
+        bf16 = jnp.asarray(preds)  # label predictions: no float dtype in play
+        ref_preds = np.asarray(preds)
+
+    ours = getattr(metrics_tpu, name)(**kwargs)
+    theirs = getattr(torchmetrics_ref, name)(**kwargs)
+    for i in range(preds.shape[0]):
+        ours.update(bf16[i], jnp.asarray(target[i]))
+        theirs.update(torch.from_numpy(ref_preds[i]), torch.from_numpy(np.asarray(target[i])))
+    np.testing.assert_allclose(
+        np.asarray(jnp.asarray(ours.compute()), np.float64),
+        np.asarray(theirs.compute().detach().numpy(), np.float64),
+        atol=1e-5,
+        rtol=1e-5,
+    )
+
+
+def test_bf16_inputs_regression_sums(torchmetrics_ref):
+    """bf16 regression streams: accumulation happens in the state dtype
+    (f32), so only the input rounding differs — compare against the
+    reference fed the same bf16-rounded values."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7777)
+    preds32 = rng.randn(4, 64).astype(np.float32)
+    target32 = (preds32 * 0.9 + 0.1 * rng.randn(4, 64)).astype(np.float32)
+    p16 = np.asarray(jnp.asarray(preds32, jnp.bfloat16).astype(jnp.float32))
+    t16 = np.asarray(jnp.asarray(target32, jnp.bfloat16).astype(jnp.float32))
+    for name in ("MeanSquaredError", "MeanAbsoluteError", "ExplainedVariance"):
+        ours = getattr(metrics_tpu, name)()
+        for i in range(4):
+            ours.update(jnp.asarray(p16[i], jnp.bfloat16), jnp.asarray(t16[i], jnp.bfloat16))
+        theirs = getattr(torchmetrics_ref, name)()
+        import torch
+
+        for i in range(4):
+            theirs.update(torch.from_numpy(p16[i]), torch.from_numpy(t16[i]))
+        np.testing.assert_allclose(
+            float(ours.compute()), float(theirs.compute()), rtol=2e-2, atol=1e-2
+        )
+
+
 def test_f32_image_audio(torchmetrics_ref):
     rng = np.random.RandomState(99)
     imgs = [
