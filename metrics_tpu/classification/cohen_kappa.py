@@ -7,12 +7,13 @@ from typing import Any, Callable, Optional
 
 import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_compute, _cohen_kappa_update
+from metrics_tpu.classification.confusion_matrix import _ConfmatUpdateMixin
+from metrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_compute
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array
 
 
-class CohenKappa(Metric):
+class CohenKappa(_ConfmatUpdateMixin, Metric):
     """Cohen's kappa agreement score accumulated over batches.
 
     Args:
@@ -58,11 +59,6 @@ class CohenKappa(Metric):
             raise ValueError(f"Argument weights needs to one of the following: {allowed_weights}")
 
         self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
-
-    def update(self, preds: Array, target: Array) -> None:
-        """Accumulate the batch confusion matrix."""
-        confmat = _cohen_kappa_update(preds, target, self.num_classes, self.threshold)
-        self.confmat = self.confmat + confmat
 
     def compute(self) -> Array:
         """Cohen's kappa over everything seen so far."""

@@ -16,7 +16,41 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array
 
 
-class ConfusionMatrix(Metric):
+class _ConfmatUpdateMixin:
+    """Shared-update protocol for metrics accumulating a confusion matrix.
+
+    The whole family (ConfusionMatrix/CohenKappa/MatthewsCorrcoef/IoU with
+    matching settings) accumulates the identical batch matrix — one kernel
+    pass serves them all in a MetricCollection. A subclass that overrides
+    ``update`` opts out of sharing automatically.
+    """
+
+    @property
+    def _confmat_multilabel(self) -> bool:
+        return getattr(self, "multilabel", False)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the batch confusion matrix."""
+        self._accumulate(*self._batch_deltas(preds, target))
+
+    def _batch_deltas(self, preds: Array, target: Array) -> tuple:
+        """This batch's confusion matrix — the shareable part of ``update``."""
+        return (
+            _confusion_matrix_update(
+                preds, target, self.num_classes, self.threshold, self._confmat_multilabel
+            ),
+        )
+
+    def _shared_update_key(self) -> Optional[tuple]:
+        if type(self).update is not _ConfmatUpdateMixin.update:
+            return None
+        return ("confmat", self.num_classes, self.threshold, self._confmat_multilabel)
+
+    def _accumulate(self, confmat: Array) -> None:
+        self.confmat = self.confmat + confmat
+
+
+class ConfusionMatrix(_ConfmatUpdateMixin, Metric):
     """Accumulated confusion matrix over batches.
 
     Args:
@@ -66,11 +100,6 @@ class ConfusionMatrix(Metric):
 
         default = jnp.zeros((num_classes, 2, 2) if multilabel else (num_classes, num_classes), dtype=jnp.int32)
         self.add_state("confmat", default=default, dist_reduce_fx="sum")
-
-    def update(self, preds: Array, target: Array) -> None:
-        """Accumulate the batch confusion matrix."""
-        confmat = _confusion_matrix_update(preds, target, self.num_classes, self.threshold, self.multilabel)
-        self.confmat = self.confmat + confmat
 
     def compute(self) -> Array:
         """Confusion matrix over everything seen so far."""

@@ -7,15 +7,13 @@ from typing import Any, Callable, Optional
 
 import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.matthews_corrcoef import (
-    _matthews_corrcoef_compute,
-    _matthews_corrcoef_update,
-)
+from metrics_tpu.classification.confusion_matrix import _ConfmatUpdateMixin
+from metrics_tpu.functional.classification.matthews_corrcoef import _matthews_corrcoef_compute
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array
 
 
-class MatthewsCorrcoef(Metric):
+class MatthewsCorrcoef(_ConfmatUpdateMixin, Metric):
     """Matthews correlation coefficient accumulated over batches.
 
     Args:
@@ -52,11 +50,6 @@ class MatthewsCorrcoef(Metric):
         self.num_classes = num_classes
         self.threshold = threshold
         self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
-
-    def update(self, preds: Array, target: Array) -> None:
-        """Accumulate the batch confusion matrix."""
-        confmat = _matthews_corrcoef_update(preds, target, self.num_classes, self.threshold)
-        self.confmat = self.confmat + confmat
 
     def compute(self) -> Array:
         """Matthews correlation coefficient over everything seen so far."""

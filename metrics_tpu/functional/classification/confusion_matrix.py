@@ -27,6 +27,22 @@ def _confusion_matrix_update(
 ) -> Array:
     preds, target, mode = _input_format_classification(preds, target, threshold)
     if mode not in (DataType.BINARY, DataType.MULTILABEL):
+        n_contracted = preds.shape[0] * int(np.prod(preds.shape[2:], dtype=np.int64))
+        if not multilabel and preds.shape[1] == num_classes and num_classes <= 128 and n_contracted < (1 << 24):
+            # the canonical one-hots are already materialized, so the counts
+            # are one MXU contraction over the sample (and extra) axes:
+            # counts[i, j] = sum_n t[n, i, ...] * p[n, j, ...]. No argmax, no
+            # scatter, and the one-hots CSE with stat-scores collection
+            # members. Exact: 0/1 values are exact in bf16 and the f32
+            # accumulator holds integers exactly below 2**24, which
+            # ``n_contracted`` bounds per cell; bigger batches (and large C,
+            # where matmul cost grows as N*C^2) fall through to the exact
+            # int32 counting kernels.
+            contracted = (0,) + tuple(range(2, preds.ndim))
+            counts = jnp.tensordot(
+                target.astype(jnp.float32), preds.astype(jnp.float32), axes=(contracted, contracted)
+            )
+            return counts.astype(jnp.int32)
         preds = jnp.argmax(preds, axis=1)
         target = jnp.argmax(target, axis=1)
 

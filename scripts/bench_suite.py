@@ -40,7 +40,14 @@ if REPO_ROOT not in sys.path:
 
 NUM_CLASSES = 10
 BATCH = 1024
-STEPS = 200
+# scan length for our side: the slope's signal (marginal device time between
+# the 1x and 5x runs) grows linearly with it while the tunnel's per-call
+# latency noise does not — 1000 steps puts the update configs' ~2-20 us/step
+# signal well above the +-ms link jitter that made shorter runs swing 2x+
+# between processes
+STEPS = 1000
+#: eager-loop iterations for the torch-CPU reference side (stable at 200)
+REF_STEPS = 200
 ROUNDS = 7
 
 
@@ -54,7 +61,7 @@ def _time_scan_epoch(all_inputs, init_state, update):
     return measure_scan_slope(all_inputs, init_state, update, rounds=ROUNDS)
 
 
-def _time_eager_loop(update, steps=STEPS):
+def _time_eager_loop(update, steps=REF_STEPS):
     update()  # warm caches
     start = time.perf_counter()
     for _ in range(steps):
@@ -143,6 +150,9 @@ def bench_auroc_ap():
     from metrics_tpu import AUROC, AveragePrecision
 
     rng = np.random.RandomState(0)
+    # buffer sized to hold exactly the scanned epoch (as a real epoch-end
+    # AUROC would be); per-step cost is one in-place dynamic_update_slice
+    # regardless of the buffer's length
     capacity = STEPS * BATCH
     bin_preds = jnp.asarray(rng.rand(STEPS, BATCH).astype(np.float32))
     bin_target = jnp.asarray(rng.randint(0, 2, (STEPS, BATCH)))
@@ -228,7 +238,7 @@ def bench_image_audio():
 
     from metrics_tpu import PSNR, SI_SDR, SSIM
 
-    img_steps = 50  # conv-heavy; keep the program small
+    img_steps = 200  # conv-heavy; long enough for a stable slope
     rng = np.random.RandomState(0)
     imgs_a = jnp.asarray(rng.rand(img_steps, 4, 3, 64, 64).astype(np.float32))
     imgs_b = jnp.asarray(rng.rand(img_steps, 4, 3, 64, 64).astype(np.float32))
@@ -285,7 +295,7 @@ def bench_auroc_compute():
 
     from metrics_tpu.functional.classification.masked_curves import masked_binary_auroc
 
-    n = STEPS * BATCH
+    n = 200 * BATCH  # the config's 200k-sample buffer, independent of STEPS
     epochs = 20
     rng = np.random.RandomState(0)
     all_preds = jnp.asarray(rng.rand(epochs, n).astype(np.float32))
@@ -546,7 +556,10 @@ def bench_train_overhead():
     # overlap/fuse the update further, never add cost.
     t_base = _time_scan_epoch((X, Y), lambda: (params0, opt0), base_update)
 
-    metric_steps = 200
+    # long metric scan: at ~4 us/step the 2000-step slope carries ~32 ms of
+    # marginal signal, so the overhead ratio is stable to ~+-0.02 pct across
+    # driver runs (200 steps swung it 0.4 -> 1.0 pct between processes)
+    metric_steps = 2000
     kpp, kyy = jax.random.split(jax.random.PRNGKey(1))
     probs = jax.nn.softmax(jax.random.normal(kpp, (metric_steps, batch, nc), jnp.float32))
     labels = jax.random.randint(kyy, (metric_steps, batch), 0, nc)

@@ -152,6 +152,103 @@ def test_shared_stat_scores_update_dedup(monkeypatch):
             np.testing.assert_array_equal(np.asarray(state[name][s]), np.asarray(getattr(m_loose, s)))
 
 
+def test_shared_confmat_update_dedup(monkeypatch):
+    """ConfusionMatrix/CohenKappa/MatthewsCorrcoef/IoU with matching settings
+    must run ONE confusion-matrix pass per batch, with states equal to the
+    unshared per-metric path."""
+    import metrics_tpu.classification.confusion_matrix as cm_mod
+    from metrics_tpu import CohenKappa, ConfusionMatrix, IoU, MatthewsCorrcoef
+
+    calls = {"n": 0}
+    real = cm_mod._confusion_matrix_update
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    # every family member updates through the shared mixin, which resolves
+    # the kernel via this single module-level name
+    monkeypatch.setattr(cm_mod, "_confusion_matrix_update", counting)
+
+    rng = np.random.RandomState(8)
+    preds = jnp.asarray(rng.randint(0, 4, 64))
+    target = jnp.asarray(rng.randint(0, 4, 64))
+
+    make = lambda: [
+        ConfusionMatrix(num_classes=4),
+        CohenKappa(num_classes=4),
+        MatthewsCorrcoef(num_classes=4),
+        IoU(num_classes=4),
+    ]
+
+    shared = MetricCollection(make())
+    shared.update(preds, target)
+    assert calls["n"] == 1  # one confmat pass for all four metrics
+
+    calls["n"] = 0
+    loose = make()
+    for m in loose:
+        m.update(preds, target)
+    assert calls["n"] == 4
+
+    for m_shared, m_loose in zip(shared.values(), loose):
+        np.testing.assert_array_equal(np.asarray(m_shared.confmat), np.asarray(m_loose.confmat))
+    shared.compute()  # must not raise on the shared states
+
+    # pure path: same dedup, same states
+    calls["n"] = 0
+    pure = MetricCollection(make())
+    state = pure.apply_update(pure.init_state(), preds, target)
+    assert calls["n"] == 1
+    for name, m_loose in zip(("ConfusionMatrix", "CohenKappa", "MatthewsCorrcoef", "IoU"), loose):
+        np.testing.assert_array_equal(np.asarray(state[name]["confmat"]), np.asarray(m_loose.confmat))
+
+    # differing settings (threshold, multilabel) must NOT share
+    calls["n"] = 0
+    mixed = MetricCollection(
+        {
+            "cm": ConfusionMatrix(num_classes=4),
+            "kappa_thr": CohenKappa(num_classes=4, threshold=0.3),
+        }
+    )
+    mixed.update(preds, target)
+    assert calls["n"] == 2
+
+
+def test_shared_confmat_values_match_individual():
+    """Collection compute values are unchanged by confmat-family sharing."""
+    from metrics_tpu import CohenKappa, ConfusionMatrix, IoU, MatthewsCorrcoef
+
+    rng = np.random.RandomState(9)
+    preds = jnp.asarray(rng.randint(0, 3, 48))
+    target = jnp.asarray(rng.randint(0, 3, 48))
+
+    collection = MetricCollection(
+        [
+            ConfusionMatrix(num_classes=3),
+            CohenKappa(num_classes=3),
+            MatthewsCorrcoef(num_classes=3),
+            IoU(num_classes=3),
+        ]
+    )
+    state = collection.init_state()
+    state, vals = collection.apply_forward(state, preds, target)
+    out = collection.apply_compute(state)
+
+    for cls, key in (
+        (ConfusionMatrix, "ConfusionMatrix"),
+        (CohenKappa, "CohenKappa"),
+        (MatthewsCorrcoef, "MatthewsCorrcoef"),
+        (IoU, "IoU"),
+    ):
+        solo = cls(num_classes=3)
+        expected = solo(preds, target)
+        np.testing.assert_allclose(np.asarray(vals[key]), np.asarray(expected), atol=1e-7, err_msg=key)
+        np.testing.assert_allclose(
+            np.asarray(out[key]), np.asarray(solo.compute()), atol=1e-7, err_msg=key
+        )
+
+
 def test_shared_update_respects_differing_configs(monkeypatch):
     """Metrics with different stat-scores settings must NOT share."""
     import metrics_tpu.classification.stat_scores as ss_mod
