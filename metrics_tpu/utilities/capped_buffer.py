@@ -53,20 +53,30 @@ def _append_slice(buf: Array, batch: Array, count: Array) -> Array:
 
 
 class CappedBufferMixin:
-    """State/update/mask logic shared by the fixed-capacity metric modes."""
+    """State/update/mask logic shared by the fixed-capacity metric modes.
+
+    Scores and labels ride ONE merged ``(capacity, K)`` buffer (scores in the
+    leading columns, labels in the trailing ones) so every step issues a
+    single ``dynamic_update_slice`` — the dominant per-step cost on TPU, and
+    roughly half the price of writing two separate buffers. Labels live in
+    the score dtype; exact, since class indices and binary flags are far
+    below f32's 2**24 integer range.
+    """
 
     #: set True by _init_capacity_states(multilabel=True); class default keeps
     #: plain attribute access safe for consumers that never set the flag
     _capacity_multilabel = False
+    #: classification modes cast the label columns back to int32 at flatten
+    _capacity_int_target = True
 
     def _init_capacity_states(
         self, capacity: int, num_classes: Optional[int], pos_label: Optional[int], multilabel: bool = False
     ) -> None:
-        """Validate the capacity-mode configuration and register the buffer states.
+        """Validate the capacity-mode configuration and register the buffer state.
 
-        ``num_classes > 1`` switches to the multi-column layout: a
-        ``(capacity, C)`` score buffer with integer class labels (multiclass,
-        one-vs-rest at epoch end) or per-label binary targets
+        ``num_classes > 1`` switches to the multi-column layout: ``C`` score
+        columns with one integer class-label column (multiclass, one-vs-rest
+        at epoch end) or ``C`` per-label binary target columns
         (``multilabel=True``).
         """
         _check_capacity(capacity)
@@ -80,39 +90,45 @@ class CappedBufferMixin:
         if multi and pos_label is not None:
             raise ValueError("`pos_label` does not apply to multi-column `capacity` mode")
         self._capacity_multilabel = multilabel
-        buf_shape = (capacity, num_classes) if multi else (capacity,)
-        target_shape = (capacity, num_classes) if multilabel else (capacity,)
-        self.add_state("preds_buf", jnp.full(buf_shape, -jnp.inf, jnp.float32), dist_reduce_fx="cat")
-        self.add_state("target_buf", jnp.zeros(target_shape, jnp.int32), dist_reduce_fx="cat")
+        self._capacity_int_target = True
+        if multi:
+            width = 2 * num_classes if multilabel else num_classes + 1
+        else:
+            width = 2
+        self.add_state("buf", jnp.full((capacity, width), -jnp.inf, jnp.float32), dist_reduce_fx="cat")
         self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="cat")
 
     @property
     def _capacity_multiclass(self) -> bool:
-        return (
-            self.num_classes is not None
-            and self.num_classes > 1
-            and not self._capacity_multilabel
-        )
+        num_classes = getattr(self, "num_classes", None)  # raw-mode consumers have none
+        return num_classes is not None and num_classes > 1 and not self._capacity_multilabel
+
+    @property
+    def _capacity_score_cols(self) -> int:
+        """Leading buffer columns holding scores (the rest hold labels)."""
+        if self._capacity_multiclass or self._capacity_multilabel:
+            return self.num_classes
+        return 1
 
     def _init_raw_buffer_states(self, capacity: int, dtype=jnp.float32) -> None:
         """Raw-value variant: preds/target kept verbatim (no canonicalization)."""
         _check_capacity(capacity)
-        self.add_state("preds_buf", jnp.zeros((capacity,), dtype), dist_reduce_fx="cat")
-        self.add_state("target_buf", jnp.zeros((capacity,), dtype), dist_reduce_fx="cat")
+        self._capacity_int_target = False
+        self.add_state("buf", jnp.zeros((capacity, 2), dtype), dist_reduce_fx="cat")
         self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="cat")
 
     def _buffer_write(self, preds: Array, target: Array) -> None:
-        """Append one batch at the fill offset; writes past capacity drop,
-        the counter keeps the true total."""
-        self.preds_buf = _append_slice(self.preds_buf, preds, self.count)
-        self.target_buf = _append_slice(self.target_buf, target, self.count)
+        """Append one batch at the fill offset (one merged slice write);
+        positions past capacity drop, the counter keeps the true total."""
+        dtype = self.buf.dtype
+        p = preds if preds.ndim == 2 else preds[:, None]
+        t = target if target.ndim == 2 else target[:, None]
+        batch = jnp.concatenate([p.astype(dtype), t.astype(dtype)], axis=-1)
+        self.buf = _append_slice(self.buf, batch, self.count)
         self.count = self.count + preds.shape[0]
 
     def _raw_buffer_update(self, preds: Array, target: Array) -> None:
-        dtype = self.preds_buf.dtype
-        self._buffer_write(
-            jnp.atleast_1d(preds).astype(dtype), jnp.atleast_1d(target).astype(dtype)
-        )
+        self._buffer_write(jnp.atleast_1d(preds), jnp.atleast_1d(target))
 
     def _buffer_update(self, preds: Array, target: Array) -> None:
         from metrics_tpu.functional.classification.auroc import _auroc_update
@@ -144,8 +160,7 @@ class CappedBufferMixin:
         sync produced — scalar count = 1 shard; ``(world,)`` counts = world
         shards of ``capacity`` samples each. Multiclass preds keep their
         trailing class axis: ``(world·capacity, C)``."""
-        preds_buf = dim_zero_cat(self.preds_buf) if isinstance(self.preds_buf, list) else self.preds_buf
-        target_buf = dim_zero_cat(self.target_buf) if isinstance(self.target_buf, list) else self.target_buf
+        buf = dim_zero_cat(self.buf) if isinstance(self.buf, list) else self.buf
         count = self.count
         if isinstance(count, list):
             count = jnp.stack([jnp.asarray(c) for c in count])
@@ -164,15 +179,16 @@ class CappedBufferMixin:
                 )
 
         valid = (jnp.arange(self.capacity)[None, :] < jnp.clip(counts, 0, self.capacity)[:, None]).reshape(-1)
-        multilabel = self._capacity_multilabel
-        if self._capacity_multiclass or multilabel:
-            preds_flat = preds_buf.reshape(-1, self.num_classes)
-        else:
-            preds_flat = preds_buf.reshape(-1)
-        if multilabel:
-            target_flat = target_buf.reshape(-1, self.num_classes)
-        else:
-            target_flat = target_buf.reshape(-1)
+        flat = buf.reshape(-1, buf.shape[-1])
+        ncols = self._capacity_score_cols
+        preds_flat = flat[:, :ncols]
+        target_flat = flat[:, ncols:]
+        if preds_flat.shape[-1] == 1:
+            preds_flat = preds_flat[:, 0]
+        if target_flat.shape[-1] == 1:
+            target_flat = target_flat[:, 0]
+        if self._capacity_int_target:
+            target_flat = target_flat.astype(jnp.int32)
         return preds_flat, target_flat, valid
 
     def _one_vs_rest(self, kernel, preds: Array, target: Array, valid: Array) -> Array:
