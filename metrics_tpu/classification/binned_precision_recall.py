@@ -4,10 +4,10 @@ Capability parity with the reference's ``torchmetrics/classification/
 binned_precision_recall.py:37-294`` — and the **TPU-preferred** curve design:
 states are fixed ``(C, T)`` sum-reduced count tensors (pure psum at sync, no
 ragged gather), and where the reference iterates thresholds in a Python loop
-("to conserve memory", ``:147-152``) the update here dispatches through
-:mod:`metrics_tpu.kernels.binned_counts` — on TPU a Pallas histogram kernel
-(bucketize + MXU weighted bincount + suffix-cumsum), elsewhere one fused
-broadcast compare ``(N, C, 1) >= (T,)`` reduced over N.
+("to conserve memory", ``:147-152``) the update here is one fused broadcast
+compare ``(N, C, 1) >= (T,)`` reduced over N
+(:mod:`metrics_tpu.kernels.binned_counts`) — XLA fuses it without
+materializing the boolean cube.
 """
 from typing import Any, List, Optional, Tuple, Union
 

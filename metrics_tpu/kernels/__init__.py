@@ -20,6 +20,5 @@ from metrics_tpu.kernels.confusion_matrix import (  # noqa: F401
 )
 from metrics_tpu.kernels.binned_counts import (  # noqa: F401
     binned_tp_fp_fn,
-    binned_tp_fp_fn_pallas,
     binned_tp_fp_fn_xla,
 )

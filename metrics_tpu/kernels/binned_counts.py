@@ -1,4 +1,4 @@
-"""Fused binned precision-recall count kernel.
+"""Fused binned precision-recall counts.
 
 Computes the per-threshold confusion counts behind
 :class:`~metrics_tpu.classification.binned_precision_recall.BinnedPrecisionRecallCurve`:
@@ -6,41 +6,24 @@ Computes the per-threshold confusion counts behind
 state the reference fills with a Python loop over thresholds,
 ``classification/binned_precision_recall.py:135-153``).
 
-* **XLA formulation (the default)** — one broadcast compare
-  ``(N, C, 1) >= (T,)`` reduced over N. XLA fuses the compare-and-reduce
-  without materializing the ``(N, C, T)`` boolean, and on a real v5e chip
-  this beats the Pallas histogram at every measured size (see
-  :func:`binned_tp_fp_fn`) — the compiler's fusion is the right tool here.
-* **Pallas kernel (explicit only)** — histogram formulation. With sorted thresholds,
-  ``[pred ≥ thr_t] ⇔ t < bucket`` where ``bucket = #{thr ≤ pred}``
-  (a cheap ``O(N·C·log T)`` searchsorted in XLA). The counts then reduce to a
-  **weighted bincount** over flat ``(class, bucket)`` bins — one Pallas pass
-  building the one-hot in VMEM and contracting it against the weight column on
-  the MXU (``(1, TILE) @ (TILE, K̃)``) — followed by a tiny suffix-cumsum over
-  the bucket axis. Per-sample work is ``O(K̃)`` independent of ``T·C``
-  materialization, and bins are K-blocked so large ``C·T`` stays in VMEM.
+The formulation is one broadcast compare ``(N, C, 1) >= (T,)`` reduced over
+N. XLA fuses the compare-and-reduce without materializing the ``(N, C, T)``
+boolean — on a real v5e chip this beat a hand-written Pallas histogram
+kernel at every measured size (5x at best, 1000x at small sizes; the
+histogram's one-hot-contraction bincount does ``N·C²·T`` work, a factor C
+more than the fused compare, so it can never win). The kernel was removed;
+the compiler's fusion is the right tool here.
 """
-import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-
-from metrics_tpu.kernels._common import (
-    _PALLAS_TPU_AVAILABLE,
-    _round_up,
-    pltpu,
-)
-
-_TILE = 512
-_KBLOCK = 2048  # bins per grid block: one-hot tile is TILE x KBLOCK f32 = 4 MB VMEM
 
 
-def binned_tp_fp_fn_xla(
+def binned_tp_fp_fn(
     preds: jax.Array, target: jax.Array, thresholds: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Broadcast-compare formulation: three ``(C, T)`` float32 count tensors."""
+    """Binned TP/FP/FN counts: three ``(C, T)`` float32 count tensors."""
     t = (target == 1)[:, :, None]  # (N, C, 1)
     p = preds[:, :, None] >= thresholds[None, None, :]  # (N, C, T)
     tps = jnp.sum(t & p, axis=0).astype(jnp.float32)
@@ -49,136 +32,5 @@ def binned_tp_fp_fn_xla(
     return tps, fps, fns
 
 
-def _wbincount_kernel(idx_ref, w_ref, out_ref):
-    n_step = pl.program_id(1)
-
-    @pl.when(n_step == 0)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    kblock = out_ref.shape[1]
-    num_weight_cols = w_ref.shape[1]
-    base = pl.program_id(0) * kblock
-    bins = base + jax.lax.broadcasted_iota(jnp.int32, (1, kblock), 1)
-    onehot = (idx_ref[:] == bins).astype(jnp.float32)  # (TILE, K̃)
-    # one contraction yields every weight column's histogram: (W, TILE)@(TILE, K̃)
-    out_ref[0:num_weight_cols, :] += jax.lax.dot_general(
-        w_ref[:], onehot, dimension_numbers=(((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-
-
-@functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
-def weighted_bincount_pallas(
-    indices: jax.Array, weights: jax.Array, num_bins: int, interpret: bool = False
-) -> jax.Array:
-    """``out[w, b] = Σ_i weights[i, w]·[indices[i] == b]`` via MXU one-hot contraction.
-
-    ``weights`` is ``(M,)`` (returns ``(num_bins,)``) or ``(M, W)`` with
-    ``W <= 8`` weight columns histogrammed in one pass (returns
-    ``(W, num_bins)``). Counts are f32-accumulated: integer-exact while every
-    bin stays below 2^24.
-    """
-    squeeze = weights.ndim == 1
-    if indices.size == 0:  # reshape(-1) below cannot infer a dim from 0 elements
-        zeros = jnp.zeros(num_bins, jnp.float32)
-        return zeros if squeeze else jnp.zeros((weights.shape[-1], num_bins), jnp.float32)
-    weights = weights.reshape(weights.shape[0], -1)
-    m, num_weight_cols = weights.shape
-    if num_weight_cols > 8:
-        raise ValueError(f"weighted_bincount_pallas supports at most 8 weight columns, got {num_weight_cols}")
-    mpad = _round_up(max(m, _TILE), _TILE)
-    kpad = _round_up(num_bins, _KBLOCK if num_bins > _KBLOCK else 128)
-    kblock = min(kpad, _KBLOCK)
-
-    idx = jnp.pad(indices.reshape(-1).astype(jnp.int32), (0, mpad - m), constant_values=-1).reshape(mpad, 1)
-    w = jnp.pad(weights.astype(jnp.float32), ((0, mpad - m), (0, 0)))
-
-    vmem = pltpu.VMEM if _PALLAS_TPU_AVAILABLE else None
-    out = pl.pallas_call(
-        _wbincount_kernel,
-        grid=(kpad // kblock, mpad // _TILE),
-        in_specs=[
-            pl.BlockSpec((_TILE, 1), lambda k, i: (i, 0), memory_space=vmem),
-            pl.BlockSpec((_TILE, num_weight_cols), lambda k, i: (i, 0), memory_space=vmem),
-        ],
-        out_specs=pl.BlockSpec((8, kblock), lambda k, i: (0, k), memory_space=vmem),
-        out_shape=jax.ShapeDtypeStruct((8, kpad), jnp.float32),
-        interpret=interpret,
-    )(idx, w)
-    return out[0, :num_bins] if squeeze else out[:num_weight_cols, :num_bins]
-
-
-def _check_sorted_thresholds(thresholds: jax.Array) -> None:
-    """Host-side guard: searchsorted silently miscounts on unsorted thresholds."""
-    import numpy as np
-
-    if isinstance(thresholds, jax.core.Tracer):
-        return  # can't inspect values under tracing; precondition is documented
-    t = np.asarray(thresholds)
-    if t.size > 1 and not np.all(np.diff(t) >= 0):
-        raise ValueError("`thresholds` must be sorted ascending for the Pallas histogram path")
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _binned_tp_fp_fn_pallas_impl(
-    preds: jax.Array, target: jax.Array, thresholds: jax.Array, interpret: bool = False
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    n, num_classes = preds.shape
-    num_thresholds = thresholds.shape[0]
-    if n == 0:  # empty shard/batch: zero counts, like the XLA path
-        zeros = jnp.zeros((num_classes, num_thresholds), jnp.float32)
-        return zeros, zeros, zeros
-    num_buckets = num_thresholds + 1  # bucket b = number of thresholds <= pred
-
-    # NaN preds must never fire at any threshold (XLA-path parity: nan >= thr
-    # is False), but searchsorted would place them in the top bucket
-    preds = jnp.where(jnp.isnan(preds), -jnp.inf, preds.astype(jnp.float32))
-    bucket = jnp.searchsorted(thresholds.astype(jnp.float32), preds, side="right")
-    class_id = jax.lax.broadcasted_iota(jnp.int32, (n, num_classes), 1)
-    flat = class_id * num_buckets + bucket.astype(jnp.int32)
-
-    is_pos = (target == 1).astype(jnp.float32)
-    # both histograms (target-weighted and unweighted) in one kernel pass
-    weights = jnp.stack([is_pos.reshape(-1), jnp.ones(is_pos.size, jnp.float32)], axis=1)
-    hists = weighted_bincount_pallas(flat, weights, num_classes * num_buckets, interpret=interpret)
-    tp_hist = hists[0].reshape(num_classes, num_buckets)
-    cnt_hist = hists[1].reshape(num_classes, num_buckets)
-
-    # TP(c,t) = Σ_{b >= t+1} hist(c,b): reverse-cumsum, drop bucket 0
-    suffix = lambda h: jnp.cumsum(h[:, ::-1], axis=1)[:, ::-1][:, 1:]  # noqa: E731
-    tps = suffix(tp_hist)
-    cnts = suffix(cnt_hist)
-    pos = jnp.sum(is_pos, axis=0)[:, None]
-    return tps, cnts - tps, pos - tps
-
-
-def binned_tp_fp_fn_pallas(
-    preds: jax.Array, target: jax.Array, thresholds: jax.Array, interpret: bool = False
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Histogram + suffix-cumsum formulation: three ``(C, T)`` float32 tensors.
-
-    Requires ``thresholds`` sorted ascending (validated eagerly; documented
-    precondition under tracing).
-    """
-    _check_sorted_thresholds(thresholds)
-    return _binned_tp_fp_fn_pallas_impl(preds, target, thresholds, interpret=interpret)
-
-
-def binned_tp_fp_fn(
-    preds: jax.Array, target: jax.Array, thresholds: jax.Array, use_pallas: Optional[bool] = None
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Binned TP/FP/FN counts with automatic backend dispatch.
-
-    Auto-dispatch always selects the XLA formulation: measured on a real
-    v5e chip the Pallas histogram loses at every size (5x at best,
-    n=8192/C=5/T=4000; 1000x at small sizes — its weighted bincount is a
-    rank-1 contraction the MXU cannot tile, while XLA fuses the broadcast
-    compare-and-reduce without materializing ``(N, C, T)``). The kernel
-    stays available via ``use_pallas=True`` for explicit use/benchmarks
-    (``scripts/bench_suite.py::bench_pallas_binned`` tracks the numbers).
-    """
-    if use_pallas is None:
-        use_pallas = False
-    if use_pallas:
-        return binned_tp_fp_fn_pallas(preds, target, thresholds)
-    return binned_tp_fp_fn_xla(preds, target, thresholds)
+#: alias kept for callers that referenced the formulation explicitly
+binned_tp_fp_fn_xla = binned_tp_fp_fn

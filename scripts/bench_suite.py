@@ -426,51 +426,6 @@ def bench_pallas_confmat():
     return "confmat_pallas_vs_xla_step", ours, ref
 
 
-def bench_pallas_binned():
-    """BinnedPrecisionRecallCurve counts on the real TPU backend: the Pallas
-    weighted-bincount kernel vs the XLA broadcast-compare formulation.
-    Cross-checks equality on-device before timing."""
-    import jax
-    import jax.numpy as jnp
-
-    from metrics_tpu.kernels.binned_counts import binned_tp_fp_fn
-
-    n, c, t = 1024, 10, 100
-    rng = np.random.RandomState(0)
-    preds = jnp.asarray(rng.rand(STEPS, n, c).astype(np.float32))
-    target = jnp.asarray(rng.randint(0, 2, (STEPS, n, c)))
-    thresholds = jnp.linspace(0, 1.0, t)
-
-    def accumulate(s, p, tgt, use_pallas):
-        tps, fps, fns = binned_tp_fp_fn(p, tgt, thresholds, use_pallas=use_pallas)
-        return (s[0] + tps, s[1] + fps, s[2] + fns)
-
-    def init():
-        z = jnp.zeros((c, t), jnp.float32)
-        return (z, z, z)
-
-    if jax.default_backend() != "tpu":
-        print("# pallas binned bench skipped: backend is not tpu", file=sys.stderr)
-        ours = float("nan")
-    else:
-        got = binned_tp_fp_fn(preds[0], target[0], thresholds, use_pallas=True)
-        want = binned_tp_fp_fn(preds[0], target[0], thresholds, use_pallas=False)
-        if not all(np.array_equal(np.asarray(g), np.asarray(w)) for g, w in zip(got, want)):
-            print("# pallas binned MISMATCHES xla on tpu — not timing a wrong kernel", file=sys.stderr)
-            ours = float("nan")
-        else:
-            ours = _time_scan_epoch(
-                (preds, target), init, lambda s, p, tgt: accumulate(s, p, tgt, True)
-            )
-
-    def ref(torchmetrics, torch):  # our own XLA formulation is the baseline
-        return _time_scan_epoch(
-            (preds, target), init, lambda s, p, tgt: accumulate(s, p, tgt, False)
-        )
-
-    return "binned_counts_pallas_vs_xla_step", ours, ref
-
-
 # ------------------------------------------------ north-star overhead
 def bench_train_overhead():
     """The BASELINE north star measured directly: % step-time overhead of
@@ -617,7 +572,6 @@ CONFIG_META = {
     "bench_auroc_compute": ("auroc_epoch_compute_200k", "us/step"),
     "bench_fid_compute": ("fid_epoch_compute_2048d", "us/step"),
     "bench_pallas_confmat": ("confmat_pallas_vs_xla_step", "us/step"),
-    "bench_pallas_binned": ("binned_counts_pallas_vs_xla_step", "us/step"),
     "bench_train_overhead": ("train_step_metric_overhead", "pct"),
 }
 
@@ -630,7 +584,6 @@ CONFIGS = [
     bench_auroc_compute,
     bench_fid_compute,
     bench_pallas_confmat,
-    bench_pallas_binned,
     bench_train_overhead,
     bench_collection,
 ]

@@ -5,8 +5,7 @@ import pytest
 
 from metrics_tpu.functional import confusion_matrix
 from metrics_tpu.kernels import (
-    binned_tp_fp_fn_pallas,
-    binned_tp_fp_fn_xla,
+    binned_tp_fp_fn,
     confmat_counts_pallas,
     confmat_counts_xla,
 )
@@ -37,53 +36,42 @@ class TestConfmatKernel:
         assert int(jnp.sum(got)) == 333  # padding rows must not count
 
 
-class TestBinnedCountsKernel:
+class TestBinnedCounts:
+    """The binned-count formulation against a per-threshold numpy loop (the
+    reference's algorithm, ``classification/binned_precision_recall.py:147-152``)."""
+
     @pytest.mark.parametrize("n,c,t", [(64, 1, 5), (300, 4, 100), (1000, 16, 130)])
-    def test_matches_xla_broadcast(self, n, c, t):
-        preds = jnp.asarray(_rng.rand(n, c).astype(np.float32))
-        target = jnp.asarray(_rng.randint(0, 2, (n, c)))
-        thresholds = jnp.linspace(0, 1.0, t)
-        exp_tp, exp_fp, exp_fn = binned_tp_fp_fn_xla(preds, target, thresholds)
-        got_tp, got_fp, got_fn = binned_tp_fp_fn_pallas(preds, target, thresholds, interpret=True)
-        np.testing.assert_allclose(np.asarray(got_tp), np.asarray(exp_tp), atol=1e-6)
-        np.testing.assert_allclose(np.asarray(got_fp), np.asarray(exp_fp), atol=1e-6)
-        np.testing.assert_allclose(np.asarray(got_fn), np.asarray(exp_fn), atol=1e-6)
+    def test_matches_numpy_threshold_loop(self, n, c, t):
+        preds = _rng.rand(n, c).astype(np.float32)
+        target = _rng.randint(0, 2, (n, c))
+        thresholds = np.linspace(0, 1.0, t).astype(np.float32)
+        exp_tp = np.stack([((preds >= thr) & (target == 1)).sum(0) for thr in thresholds], 1)
+        exp_fp = np.stack([((preds >= thr) & (target != 1)).sum(0) for thr in thresholds], 1)
+        exp_fn = np.stack([((preds < thr) & (target == 1)).sum(0) for thr in thresholds], 1)
+        got_tp, got_fp, got_fn = binned_tp_fp_fn(
+            jnp.asarray(preds), jnp.asarray(target), jnp.asarray(thresholds)
+        )
+        np.testing.assert_array_equal(np.asarray(got_tp), exp_tp)
+        np.testing.assert_array_equal(np.asarray(got_fp), exp_fp)
+        np.testing.assert_array_equal(np.asarray(got_fn), exp_fn)
 
     def test_empty_batch_returns_zeros(self):
         preds = jnp.zeros((0, 3), jnp.float32)
         target = jnp.zeros((0, 3), jnp.int32)
         thresholds = jnp.linspace(0, 1.0, 5)
-        for arr in binned_tp_fp_fn_pallas(preds, target, thresholds, interpret=True):
+        for arr in binned_tp_fp_fn(preds, target, thresholds):
             assert arr.shape == (3, 5)
             np.testing.assert_array_equal(np.asarray(arr), 0.0)
 
     def test_nan_preds_never_fire(self):
-        # parity with the XLA path: nan >= thr is False at every threshold
+        # nan >= thr is False at every threshold
         preds = jnp.asarray([[np.nan], [0.7]], jnp.float32)
         target = jnp.asarray([[1], [0]])
         thresholds = jnp.asarray([0.25, 0.5], jnp.float32)
-        exp = binned_tp_fp_fn_xla(preds, target, thresholds)
-        got = binned_tp_fp_fn_pallas(preds, target, thresholds, interpret=True)
-        for g, e in zip(got, exp):
-            np.testing.assert_array_equal(np.asarray(g), np.asarray(e))
-
-    def test_unsorted_thresholds_raise(self):
-        with pytest.raises(ValueError, match="sorted"):
-            binned_tp_fp_fn_pallas(
-                jnp.asarray([[0.3]]), jnp.asarray([[1]]), jnp.asarray([0.5, 0.25]), interpret=True
-            )
-
-    def test_multi_column_weighted_bincount(self):
-        from metrics_tpu.kernels.binned_counts import weighted_bincount_pallas
-
-        idx = jnp.asarray(_rng.randint(0, 7, 100))
-        w = jnp.asarray(_rng.rand(100, 3).astype(np.float32))
-        got = weighted_bincount_pallas(idx, w, 7, interpret=True)
-        expected = np.stack([np.bincount(np.asarray(idx), np.asarray(w[:, j]), minlength=7) for j in range(3)])
-        np.testing.assert_allclose(np.asarray(got), expected, atol=1e-5)
-        # 1-D weights keep the squeezed return shape
-        got1 = weighted_bincount_pallas(idx, w[:, 0], 7, interpret=True)
-        np.testing.assert_allclose(np.asarray(got1), expected[0], atol=1e-5)
+        tp, fp, fn = binned_tp_fp_fn(preds, target, thresholds)
+        np.testing.assert_array_equal(np.asarray(tp), [[0.0, 0.0]])
+        np.testing.assert_array_equal(np.asarray(fp), [[1.0, 1.0]])
+        np.testing.assert_array_equal(np.asarray(fn), [[1.0, 1.0]])
 
     def test_threshold_boundary_inclusive(self):
         # preds exactly at a threshold must count as >= (parity with the
@@ -91,5 +79,5 @@ class TestBinnedCountsKernel:
         preds = jnp.asarray([[0.5], [0.25]], jnp.float32)
         target = jnp.asarray([[1], [1]])
         thresholds = jnp.asarray([0.25, 0.5], jnp.float32)
-        tp, _, _ = binned_tp_fp_fn_pallas(preds, target, thresholds, interpret=True)
+        tp, _, _ = binned_tp_fp_fn(preds, target, thresholds)
         np.testing.assert_array_equal(np.asarray(tp), [[2.0, 1.0]])
