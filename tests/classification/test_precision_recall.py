@@ -15,41 +15,55 @@ from tests.classification.inputs import (
 )
 from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
 
-# each case: (preds, target, canonicalize -> (y_pred, y_true, labels))
+# each case: (preds, target, canonicalize -> (y_pred, y_true, labels));
+# canons see the average because multilabel macro/weighted score per label
+# (2-D indicator form) while multilabel micro flattens (class-1 positive)
 
 
-def _canon_binary_prob(preds, target):
+def _canon_binary_prob(preds, target, average):
     return (preds >= THRESHOLD).astype(int).reshape(-1), target.reshape(-1), [0, 1]
 
 
-def _canon_multiclass(preds, target):
+def _canon_multiclass(preds, target, average):
     return preds.reshape(-1), target.reshape(-1), list(range(NUM_CLASSES))
 
 
-def _canon_multiclass_prob(preds, target):
+def _canon_multiclass_prob(preds, target, average):
     return np.argmax(preds, axis=1).reshape(-1), target.reshape(-1), list(range(NUM_CLASSES))
 
 
-def _canon_multilabel_prob(preds, target):
-    return (preds >= THRESHOLD).astype(int).reshape(-1), target.reshape(-1), [0, 1]
+def _canon_multilabel_prob(preds, target, average):
+    p = (preds >= THRESHOLD).astype(int)
+    if average == "micro":
+        return p.reshape(-1), target.reshape(-1), [0, 1]
+    return (
+        p.reshape(-1, p.shape[-1]),
+        np.asarray(target).reshape(-1, np.asarray(target).shape[-1]),
+        list(range(NUM_CLASSES)),
+    )
 
 
 def _sk_prec_recall(preds, target, sk_fn, canon, average, **fn_kwargs):
-    y_pred, y_true, labels = canon(preds, target)
-    if average == "micro" and len(labels) == 2:
-        # binary/multilabel micro in the library counts class-1 as positive
+    y_pred, y_true, labels = canon(preds, target, average)
+    if y_pred.ndim == 1 and len(labels) == 2:
+        # binary data (any average at num_classes=1 reduces to the positive-
+        # class score, mirroring the reference's `num_classes == 1 ->
+        # average = "binary"` oracle) and flattened multilabel micro
         return sk_fn(y_true, y_pred, average="binary", zero_division=0, **fn_kwargs)
+    if y_pred.ndim == 2:
+        # multilabel indicator form: sklearn scores per label directly
+        return sk_fn(y_true, y_pred, average=average, zero_division=0, **fn_kwargs)
     return sk_fn(y_true, y_pred, average=average, labels=labels, zero_division=0, **fn_kwargs)
 
 
 def _sk_specificity(preds, target, canon, average):
-    y_pred, y_true, labels = canon(preds, target)
-    if len(labels) == 2:
+    y_pred, y_true, labels = canon(preds, target, average)
+    if y_pred.ndim == 1 and len(labels) == 2:
         # binary: positive class only
         tn = np.sum((y_pred == 0) & (y_true == 0))
         fp = np.sum((y_pred == 1) & (y_true == 0))
         return tn / max(tn + fp, 1)
-    mcm = multilabel_confusion_matrix(y_true, y_pred, labels=labels)
+    mcm = multilabel_confusion_matrix(y_true, y_pred, labels=None if y_pred.ndim == 2 else labels)
     tn, fp = mcm[:, 0, 0], mcm[:, 0, 1]
     if average == "micro":
         return tn.sum() / max((tn + fp).sum(), 1)
@@ -63,28 +77,31 @@ def _sk_specificity(preds, target, canon, average):
     return per_class
 
 
+# (preds, target, canon, num_classes for micro, num_classes for macro/weighted)
+# — mirroring the reference's full matrix: binary runs macro/weighted at
+# num_classes=1 (== the positive-class score), multilabel at the label count
 _cases = [
-    (_binary_prob_inputs.preds, _binary_prob_inputs.target, _canon_binary_prob, None),
-    (_multiclass_inputs.preds, _multiclass_inputs.target, _canon_multiclass, NUM_CLASSES),
-    (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, _canon_multiclass_prob, NUM_CLASSES),
-    (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, _canon_multilabel_prob, None),
+    (_binary_prob_inputs.preds, _binary_prob_inputs.target, _canon_binary_prob, None, 1),
+    (_multiclass_inputs.preds, _multiclass_inputs.target, _canon_multiclass, NUM_CLASSES, NUM_CLASSES),
+    (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, _canon_multiclass_prob, NUM_CLASSES, NUM_CLASSES),
+    (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, _canon_multilabel_prob, None, NUM_CLASSES),
 ]
 
 
-@pytest.mark.parametrize("preds, target, canon, num_classes", _cases)
+@pytest.mark.parametrize("preds, target, canon, micro_nc, macro_nc", _cases)
 @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
 class TestPrecisionRecall(MetricTester):
 
-    def _needed_args(self, average, num_classes):
-        if average == "micro" and num_classes is None:
-            return {"average": average}
-        if num_classes is None:
-            pytest.skip("macro/weighted need num_classes; binary/multilabel micro-only here")
-        return {"average": average, "num_classes": num_classes}
+    def _needed_args(self, average, micro_nc, macro_nc):
+        num_classes = micro_nc if average == "micro" else macro_nc
+        args = {"average": average}
+        if num_classes is not None:
+            args["num_classes"] = num_classes
+        return args
 
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_precision_class(self, ddp, preds, target, canon, num_classes, average):
-        args = self._needed_args(average, num_classes)
+    def test_precision_class(self, ddp, preds, target, canon, micro_nc, macro_nc, average):
+        args = self._needed_args(average, micro_nc, macro_nc)
         self.run_class_metric_test(
             ddp=ddp,
             preds=preds,
@@ -95,8 +112,8 @@ class TestPrecisionRecall(MetricTester):
             atol=1e-6,
         )
 
-    def test_precision_fn(self, preds, target, canon, num_classes, average):
-        args = self._needed_args(average, num_classes)
+    def test_precision_fn(self, preds, target, canon, micro_nc, macro_nc, average):
+        args = self._needed_args(average, micro_nc, macro_nc)
         self.run_functional_metric_test(
             preds, target, metric_functional=precision,
             sk_metric=partial(_sk_prec_recall, sk_fn=precision_score, canon=canon, average=average),
@@ -104,8 +121,8 @@ class TestPrecisionRecall(MetricTester):
         )
 
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_recall_class(self, ddp, preds, target, canon, num_classes, average):
-        args = self._needed_args(average, num_classes)
+    def test_recall_class(self, ddp, preds, target, canon, micro_nc, macro_nc, average):
+        args = self._needed_args(average, micro_nc, macro_nc)
         self.run_class_metric_test(
             ddp=ddp,
             preds=preds,
@@ -116,8 +133,8 @@ class TestPrecisionRecall(MetricTester):
             atol=1e-6,
         )
 
-    def test_recall_fn(self, preds, target, canon, num_classes, average):
-        args = self._needed_args(average, num_classes)
+    def test_recall_fn(self, preds, target, canon, micro_nc, macro_nc, average):
+        args = self._needed_args(average, micro_nc, macro_nc)
         self.run_functional_metric_test(
             preds, target, metric_functional=recall,
             sk_metric=partial(_sk_prec_recall, sk_fn=recall_score, canon=canon, average=average),
@@ -125,8 +142,8 @@ class TestPrecisionRecall(MetricTester):
         )
 
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_fbeta_class(self, ddp, preds, target, canon, num_classes, average):
-        args = self._needed_args(average, num_classes)
+    def test_fbeta_class(self, ddp, preds, target, canon, micro_nc, macro_nc, average):
+        args = self._needed_args(average, micro_nc, macro_nc)
         self.run_class_metric_test(
             ddp=ddp,
             preds=preds,
@@ -137,8 +154,8 @@ class TestPrecisionRecall(MetricTester):
             atol=1e-6,
         )
 
-    def test_f1_fn(self, preds, target, canon, num_classes, average):
-        args = self._needed_args(average, num_classes)
+    def test_f1_fn(self, preds, target, canon, micro_nc, macro_nc, average):
+        args = self._needed_args(average, micro_nc, macro_nc)
         self.run_functional_metric_test(
             preds, target, metric_functional=f1,
             sk_metric=partial(_sk_prec_recall, sk_fn=fbeta_score, canon=canon, average=average, beta=1.0),
@@ -146,8 +163,8 @@ class TestPrecisionRecall(MetricTester):
         )
 
     @pytest.mark.parametrize("ddp", [False])
-    def test_specificity_class(self, ddp, preds, target, canon, num_classes, average):
-        args = self._needed_args(average, num_classes)
+    def test_specificity_class(self, ddp, preds, target, canon, micro_nc, macro_nc, average):
+        args = self._needed_args(average, micro_nc, macro_nc)
         self.run_class_metric_test(
             ddp=ddp,
             preds=preds,

@@ -41,8 +41,10 @@ def _np_stat_scores(preds, target, reduce="micro"):
     return np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
 
 
+# (preds, target, num_classes for macro) — binary macro runs at
+# num_classes=1: one canonical positive-class column, (1, 5) counts
 _cases = [
-    (_binary_prob_inputs.preds, _binary_prob_inputs.target, None),
+    (_binary_prob_inputs.preds, _binary_prob_inputs.target, 1),
     (_multiclass_inputs.preds, _multiclass_inputs.target, NUM_CLASSES),
     (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, NUM_CLASSES),
     (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, NUM_CLASSES),
@@ -55,8 +57,6 @@ class TestStatScores(MetricTester):
 
     def _args(self, reduce_, num_classes):
         if reduce_ == "macro":
-            if num_classes is None:
-                pytest.skip("macro requires num_classes")
             return {"reduce": reduce_, "num_classes": num_classes}
         return {"reduce": reduce_}
 
