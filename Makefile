@@ -1,6 +1,6 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun bench
+.PHONY: ci test doctest doctest-docs dryrun bench export-weights
 
 ci: test doctest doctest-docs dryrun
 
@@ -27,3 +27,10 @@ dryrun:
 # Full benchmark suite on the default backend (the real TPU chip under axon).
 bench:
 	python bench.py
+
+# Convert a torchvision Inception3 checkpoint into the .npz the Flax
+# extractor loads: make export-weights CKPT=inception_v3.pth OUT=weights.npz
+# Then METRICS_TPU_INCEPTION_WEIGHTS=weights.npz enables FID/KID/IS(feature=N)
+# and the opt-in real-weights battery (tests/image/test_real_inception_weights.py).
+export-weights:
+	python scripts/export_inception_weights.py $(CKPT) $(OUT)

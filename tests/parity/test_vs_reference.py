@@ -381,3 +381,75 @@ def test_inception_score_parity_single_split(torchmetrics_ref):
     ours_mean, _ = ours.compute()
     theirs_mean, _ = theirs.compute()
     np.testing.assert_allclose(float(ours_mean), float(theirs_mean.numpy()), atol=1e-5)
+
+
+def test_kid_statistical_parity_random_subsets(torchmetrics_ref):
+    """The subset estimator at realistic settings (subsets>1, subset_size<n):
+    both libraries draw different random subsets, so single values differ —
+    but across many seeds the means estimate the same population E[MMD²].
+    Asserts the seed-averaged KID means agree within the combined standard
+    error of the two estimates (reference sampling: ``kid.py:255-281``)."""
+    import warnings
+
+    class _Identity(torch.nn.Module):
+        def forward(self, x):
+            return x
+
+    n, d, seeds = 200, 16, 30
+    feats_real = _rng.randn(n, d).astype(np.float32)
+    feats_fake = (_rng.randn(n, d) * 1.1 + 0.4).astype(np.float32)
+
+    ours_means, ref_means = [], []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for seed in range(seeds):
+            ours = metrics_tpu.KID(feature=lambda x: x, subsets=20, subset_size=50, rng_seed=seed)
+            ours.update(jnp.asarray(feats_real), real=True)
+            ours.update(jnp.asarray(feats_fake), real=False)
+            ours_means.append(float(ours.compute()[0]))
+
+            theirs = torchmetrics_ref.KID(feature=_Identity(), subsets=20, subset_size=50)
+            theirs.update(torch.from_numpy(feats_real), real=True)
+            theirs.update(torch.from_numpy(feats_fake), real=False)
+            torch.manual_seed(seed)  # the reference draws subsets from the global RNG
+            ref_means.append(float(theirs.compute()[0].numpy()))
+
+    ours_mean, ref_mean = np.mean(ours_means), np.mean(ref_means)
+    stderr = np.sqrt((np.var(ours_means) + np.var(ref_means)) / seeds)
+    assert abs(ours_mean - ref_mean) < max(5 * stderr, 1e-4), (
+        f"ours {ours_mean:.6f} vs reference {ref_mean:.6f} (stderr {stderr:.2e})"
+    )
+
+
+def test_inception_score_statistical_parity_splits(torchmetrics_ref):
+    """The split estimator at realistic settings (splits=10): both libraries
+    permute before splitting, so values differ per seed — across seeds the
+    means estimate the same population score (reference sampling:
+    ``inception.py:157-178``)."""
+    import warnings
+
+    class _Identity(torch.nn.Module):
+        def forward(self, x):
+            return x
+
+    n, classes, seeds = 200, 10, 30
+    logits = _rng.randn(n, classes).astype(np.float32) * 2.0
+
+    ours_means, ref_means = [], []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for seed in range(seeds):
+            ours = metrics_tpu.IS(feature=lambda x: x, splits=10, rng_seed=seed)
+            ours.update(jnp.asarray(logits))
+            ours_means.append(float(ours.compute()[0]))
+
+            theirs = torchmetrics_ref.IS(feature=_Identity(), splits=10)
+            theirs.update(torch.from_numpy(logits))
+            torch.manual_seed(seed)  # the reference permutes via the global RNG
+            ref_means.append(float(theirs.compute()[0].numpy()))
+
+    ours_mean, ref_mean = np.mean(ours_means), np.mean(ref_means)
+    stderr = np.sqrt((np.var(ours_means) + np.var(ref_means)) / seeds)
+    assert abs(ours_mean - ref_mean) < max(5 * stderr, 1e-4), (
+        f"ours {ours_mean:.6f} vs reference {ref_mean:.6f} (stderr {stderr:.2e})"
+    )
