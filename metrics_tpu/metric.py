@@ -264,7 +264,15 @@ class Metric(ABC):
         with compiled_scope(f"{self.__class__.__name__}.compute"):
             if axis_name is not None:
                 with compiled_scope(f"{self.__class__.__name__}.sync"):
-                    state = sync_in_graph(state, self._reductions, axis_name)
+                    try:
+                        state = sync_in_graph(state, self._reductions, axis_name)
+                    except NameError as err:  # unbound collective axis
+                        raise NameError(
+                            f"{err}. This metric declares process_group={self.process_group!r}, which is"
+                            " the default `axis_name` of the pure compute/forward API — collectives over"
+                            " it only work inside shard_map/pmap binding that axis. To compute eagerly"
+                            " (single-device, no sync), pass `axis_name=None` explicitly."
+                        ) from err
             with self._bound_state(state):
                 return self._unwrapped_compute()
 

@@ -42,6 +42,14 @@ def _bootstrap_sampler(
             count_key, order_key = jax.random.split(rng_key)
             counts = jax.random.poisson(count_key, 1.0, (size,))
             order = jax.random.permutation(order_key, size)
+            # contract relied on here (pinned by
+            # tests/wrappers/test_bootstrapping.py::test_jnp_repeat_padding_contract):
+            # when the Poisson total falls short of `size`, jnp.repeat pads the
+            # output with copies of the final INPUT element — order[-1], the
+            # last-visited row, even if its own count was 0 — so that row gains
+            # the deficit as extra correlated repeats. The random visit order
+            # spreads this bias uniformly over rows, so the marginal per-row
+            # inclusion distribution stays exchangeable.
             return jnp.repeat(order, counts[order], total_repeat_length=size)
         counts = jax.random.poisson(rng_key, 1.0, (size,))
         return jnp.repeat(jnp.arange(size), counts, total_repeat_length=None)

@@ -25,11 +25,13 @@ SEEDS = list(range(20))
 
 @pytest.fixture(scope="module", autouse=True)
 def _f32_mode():
-    """x64 off for this module only (restored afterwards). jit caches key on
-    the flag, so compiled programs from the f64 suite are not reused."""
+    """x64 off for this module only (prior value restored afterwards). jit
+    caches key on the flag, so compiled programs from the f64 suite are not
+    reused."""
+    prev = jax.config.jax_enable_x64
     jax.config.update("jax_enable_x64", False)
     yield
-    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_enable_x64", prev)
 
 
 def test_x64_is_off(_f32_mode):

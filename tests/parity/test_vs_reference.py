@@ -453,3 +453,21 @@ def test_inception_score_statistical_parity_splits(torchmetrics_ref):
     assert abs(ours_mean - ref_mean) < max(5 * stderr, 1e-4), (
         f"ours {ours_mean:.6f} vs reference {ref_mean:.6f} (stderr {stderr:.2e})"
     )
+
+
+def test_hash_semantics_parity(torchmetrics_ref):
+    """Hash semantics match the reference exactly: identity-based per state
+    object. In BOTH libraries a deepcopy with identical state values hashes
+    differently (torch.Tensor.__hash__ is id-based, so the reference's
+    state-value hash, ``metric.py:470-482``, degrades to identity for
+    tensor states — verified here), while the same instance is stable."""
+    from copy import deepcopy
+
+    ours = metrics_tpu.Accuracy()
+    ours.update(jnp.asarray([0, 1]), jnp.asarray([0, 1]))
+    theirs = torchmetrics_ref.Accuracy()
+    theirs.update(torch.tensor([0, 1]), torch.tensor([0, 1]))
+
+    assert hash(ours) == hash(ours) and hash(theirs) == hash(theirs)  # stable
+    assert hash(deepcopy(ours)) != hash(ours)  # identity-based...
+    assert hash(deepcopy(theirs)) != hash(theirs)  # ...exactly like the reference

@@ -222,3 +222,17 @@ class TestPureApi:
         t = jnp.asarray(rng.randint(0, 4, 320))
         v = float(np.asarray(fn(b.init_state(), p, t)).ravel()[0])
         assert 0.0 <= v <= 1.0
+
+
+def test_jnp_repeat_padding_contract():
+    """The fixed-length Poisson resample relies on jnp.repeat padding a short
+    total by repeating the FINAL output element (see _bootstrap_sampler); pin
+    that upstream behavior so a silent change cannot skew the resampling."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    out = jnp.repeat(jnp.asarray([3, 5]), jnp.asarray([1, 1]), total_repeat_length=4)
+    np.testing.assert_array_equal(np.asarray(out), [3, 5, 5, 5])
+    # the pad value is the final INPUT element — even when its count is 0
+    out = jnp.repeat(jnp.asarray([7, 2]), jnp.asarray([2, 0]), total_repeat_length=4)
+    np.testing.assert_array_equal(np.asarray(out), [7, 7, 2, 2])
