@@ -131,6 +131,27 @@ class TestConfusionMatrixFamily(MetricTester):
         )
 
 
+def test_confusion_matrix_multidim_multiclass():
+    """(N, C, X) probs / (N, X) targets flow through the one-hot tensordot
+    counting path with the extra dim contracted alongside the sample dim."""
+    rng = np.random.RandomState(11)
+    preds = rng.rand(32, 4, 5).astype(np.float32)
+    target = rng.randint(0, 4, (32, 5))
+    got = np.asarray(confusion_matrix(jnp.asarray(preds), jnp.asarray(target), num_classes=4))
+    expected = sk_confusion_matrix(target.reshape(-1), preds.argmax(1).reshape(-1), labels=range(4))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_confusion_matrix_num_classes_mismatch_large_probs():
+    """A (N, C) probs input whose C exceeds num_classes must fail loudly on
+    the host (the tensordot fast path must not silently return the wrong
+    shape; parity: the reference's bincount raises on the same input)."""
+    preds = jnp.asarray(np.random.RandomState(12).rand(8, 6).astype(np.float32))
+    target = jnp.asarray(np.zeros(8, dtype=np.int64))
+    with pytest.raises(ValueError):
+        confusion_matrix(preds, target, num_classes=3)
+
+
 def test_confusion_matrix_multilabel():
     preds = _multilabel_prob_inputs.preds[0]
     target = _multilabel_prob_inputs.target[0]
