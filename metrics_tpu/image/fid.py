@@ -39,7 +39,7 @@ def sqrtm_psd(mat: Array) -> Array:
     return (eigvecs * jnp.sqrt(eigvals)) @ eigvecs.T
 
 
-def sqrtm_newton_schulz(mat: Array, num_iters: int = 50) -> Array:
+def sqrtm_newton_schulz(mat: Array, num_iters: int = 32) -> Array:
     """Matrix square root by coupled Newton–Schulz iteration.
 
     Matmul-only (MXU-friendly) alternative to :func:`sqrtm_psd` for the FID
@@ -50,7 +50,10 @@ def sqrtm_newton_schulz(mat: Array, num_iters: int = 50) -> Array:
     to bfloat16 passes, whose 8-bit mantissa makes the iteration diverge to
     NaN on ill-conditioned inputs (cond ≳ 1e4, i.e. any realistic feature
     covariance) — measured on-chip; full f32 converges to ~1e-5 relative
-    error at cond ~3e5.
+    error at cond ~3e5. The default iteration count is sized from an
+    on-chip sweep at d=2048, cond ~1e6: 20 iters → 5e-4 relative, 25 →
+    6e-5, 30 → 7e-6, 50 → 1e-7; 32 buys comfortably below any FID
+    tolerance at ~2/3 the matmul cost of 50.
     """
     dim = mat.shape[0]
     norm = jnp.sqrt(jnp.sum(mat * mat))
