@@ -125,15 +125,19 @@ class TestCapacityMode:
         with pytest.raises(ValueError, match="capacity"):
             metric_cls(capacity=0)
         # num_classes > 1 selects the multiclass layout: C score columns + 1
-        # label column in the merged buffer
-        assert metric_cls(capacity=16, num_classes=5).buf.shape == (16, 6)
+        # label column per row of the flat merged buffer (plus the slack zone)
+        from metrics_tpu.utilities.capped_buffer import BUF_SLACK_ROWS
+
+        m = metric_cls(capacity=16, num_classes=5)
+        assert m._buf_width == 6
+        assert m.buf.shape == ((16 + BUF_SLACK_ROWS) * 6,)
 
     def test_reset(self, metric_cls, sk_fn):
         metric = metric_cls(capacity=32)
         metric.update(jnp.asarray(_rng.rand(8).astype(np.float32)), jnp.asarray(_rng.randint(0, 2, 8)))
         metric.reset()
         assert int(metric.count) == 0
-        assert float(metric.buf[0, 0]) == -np.inf
+        assert float(metric.buf[0]) == -np.inf
 
 
 @pytest.mark.parametrize(
