@@ -311,3 +311,57 @@ def test_capacity_rejects_multiclass_inputs():
     probs /= probs.sum(-1, keepdims=True)
     with pytest.raises(ValueError, match="binary"):
         metric.update(jnp.asarray(probs), jnp.asarray(_rng.randint(0, 4, 8)))
+
+
+class TestSlackZoneWrites:
+    """Adversarial battery for the flat slack-zone append: odd batch sizes,
+    boundary-straddling writes, and batches past BUF_SLACK_ROWS (the chunked
+    path). Oracle: sklearn on exactly the first `capacity` samples."""
+
+    def _stream(self, sizes, capacity, seed=0):
+        from sklearn.metrics import roc_auc_score
+
+        rng = np.random.RandomState(seed)
+        metric = AUROC(capacity=capacity)
+        all_p, all_t = [], []
+        for n in sizes:
+            p = rng.rand(n).astype(np.float32)
+            t = rng.randint(0, 2, n)
+            # ensure both classes appear inside the kept prefix
+            if not all_p:
+                k = min(n, 2)
+                t[:k] = [0, 1][:k]
+            metric.update(jnp.asarray(p), jnp.asarray(t))
+            all_p.append(p)
+            all_t.append(t)
+        kept_p = np.concatenate(all_p)[:capacity]
+        kept_t = np.concatenate(all_t)[:capacity]
+        with pytest.warns(UserWarning, match="dropped") if sum(sizes) > capacity else _nullcontext():
+            value = float(metric.compute())
+        np.testing.assert_allclose(value, roc_auc_score(kept_t, kept_p), atol=1e-6)
+
+    def test_odd_batches_cross_capacity_boundary(self):
+        # 97+151+13+251 = 512 total against capacity 300: the third/fourth
+        # writes straddle and then fully overflow at unaligned offsets
+        self._stream([97, 151, 13, 251], capacity=300)
+
+    def test_single_sample_batches(self):
+        self._stream([1] * 40, capacity=25, seed=1)
+
+    def test_batch_larger_than_slack_uses_chunked_path(self):
+        from metrics_tpu.utilities.capped_buffer import BUF_SLACK_ROWS
+
+        n = BUF_SLACK_ROWS + 1777  # forces two chunks in one append
+        self._stream([n], capacity=2000, seed=2)
+        self._stream([n, 333], capacity=n + 100, seed=3)
+
+    def test_exact_fill_then_overflow(self):
+        self._stream([128, 128, 64], capacity=256, seed=4)
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
