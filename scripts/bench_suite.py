@@ -431,9 +431,9 @@ def bench_train_overhead():
     """The BASELINE north star measured directly: % step-time overhead of
     fusing the 10-metric classification collection
     (``tests/bases/test_collective_fusion.py``) into a real Flax/optax train
-    step (MLP with three 4096-wide hidden layers, batch 1024, ~1 ms/step),
-    target <1%. ``value`` is the overhead in percent; ``vs_baseline`` is
-    target/measured (>1 = under the 1% target)."""
+    step (MLP with three 4096-wide hidden layers, batch 1024, ~2.4 ms/step
+    measured on this chip), target <1%. ``value`` is the overhead in
+    percent; ``vs_baseline`` is target/measured (>1 = under the 1% target)."""
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -477,9 +477,12 @@ def bench_train_overhead():
             x = nn.relu(nn.Dense(4096)(x))
             return nn.Dense(nc)(x)
 
-    # sized so the bare step costs ~1 ms on a v5e chip — the scale at which
-    # the <1% north-star target is meaningful (a 30 µs toy step would make
-    # ANY metric update look like 20%+ overhead)
+    # sized so the bare step costs ~2.4 ms on this v5e chip (measured; slope
+    # of the 20-step scan) — the scale at which the <1% north-star target is
+    # meaningful (a 30 µs toy step would make ANY metric update look like
+    # 20%+ overhead). For reference: at the measured ~2.5-3.7 µs collection
+    # cost, even a 1 ms step would put the overhead at ~0.4%, still well
+    # under target.
     steps, batch, din = 20, 1024, 2048
     model = MLP()
     tx = optax.adam(1e-3)

@@ -1,71 +1,91 @@
 """Edge-case matrix for the capacity-buffer append primitive.
 
-``_append_slice`` replaces a ``mode="drop"`` scatter with a clamped
-``dynamic_update_slice`` plus re-masking; the equivalence must hold at every
-boundary: partial overflow (batch straddles capacity), exact fill, writes
-starting past capacity, batches larger than the whole buffer, and 2-D
-(multiclass/multilabel) buffers.
+The flat slack-zone layout replaces a ``mode="drop"`` scatter with plain
+contiguous slice writes whose offsets clamp into a zone the read path never
+touches; the drop equivalence must hold at every boundary: partial overflow
+(batch straddles capacity), exact fill, writes starting past capacity,
+batches larger than the whole buffer (and larger than the slack zone,
+which exercises the chunked append), and degenerate capacities.
 """
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from metrics_tpu.utilities.capped_buffer import _append_slice
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.capped_buffer import BUF_SLACK_ROWS, CappedBufferMixin
 
 
-def _oracle(buf, batch, count):
-    out = np.asarray(buf).copy()
-    for j in range(batch.shape[0]):
-        g = count + j
-        if g < out.shape[0]:
-            out[g] = np.asarray(batch)[j]
-    return out
+class _Buf(CappedBufferMixin, Metric):
+    """Minimal raw-buffer consumer (the Spearman capacity mode's shape)."""
+
+    def __init__(self, capacity):
+        super().__init__()
+        self.capacity = capacity
+        self._init_raw_buffer_states(capacity)
+
+    def update(self, preds, target):
+        self._raw_buffer_update(preds, target)
+
+    def compute(self):
+        return self._buffer_flatten()
 
 
+#: (capacity, batch sizes) — every boundary class of the old append matrix
 CASES = [
-    (10, 4, 0),  # plain append into empty
-    (10, 4, 6),  # exact fill
-    (10, 4, 8),  # partial overflow: two in, two dropped
-    (10, 4, 10),  # full buffer: everything drops
-    (10, 4, 12),  # count already past capacity
-    (10, 10, 0),  # batch exactly covers the buffer
-    (10, 10, 3),  # n == capacity, offset start
-    (10, 12, 0),  # batch larger than the buffer
-    (10, 12, 7),  # larger batch, offset start
-    (4, 9, 2),  # much larger batch, offset start
-    (1, 1, 0),  # degenerate capacity
+    (10, [4]),  # plain append into empty
+    (10, [6, 4]),  # exact fill
+    (10, [8, 4]),  # partial overflow: two in, two dropped
+    (10, [10, 4]),  # full buffer: everything drops
+    (10, [10, 2, 4]),  # count already past capacity
+    (10, [10]),  # batch exactly covers the buffer
+    (10, [3, 10]),  # n == capacity, offset start
+    (10, [12]),  # batch larger than the buffer
+    (10, [7, 12]),  # larger batch, offset start
+    (4, [2, 9]),  # much larger batch, offset start
+    (1, [1, 1]),  # degenerate capacity
+    (2000, [BUF_SLACK_ROWS + 1777]),  # bigger than the slack zone: chunked
 ]
 
 
-@pytest.mark.parametrize("cap, n, count", CASES)
-@pytest.mark.parametrize("ndim", [1, 2])
-def test_append_slice_matches_drop_scatter(cap, n, count, ndim):
-    rng = np.random.RandomState(cap * 100 + n * 10 + count)
-    shape = (cap,) if ndim == 1 else (cap, 3)
-    bshape = (n,) if ndim == 1 else (n, 3)
-    buf = jnp.asarray(rng.rand(*shape).astype(np.float32))
-    batch = jnp.asarray(100 + rng.rand(*bshape).astype(np.float32))
-    got = np.asarray(_append_slice(buf, batch, jnp.asarray(count)))
-    np.testing.assert_array_equal(got, _oracle(buf, batch, count))
+@pytest.mark.parametrize("cap, sizes", CASES)
+def test_buffer_write_matches_drop_scatter(cap, sizes):
+    rng = np.random.RandomState(cap * 100 + sum(sizes))
+    m = _Buf(cap)
+    stream_p, stream_t = [], []
+    for n in sizes:
+        p = rng.rand(n).astype(np.float32)
+        t = rng.rand(n).astype(np.float32)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        stream_p.append(p)
+        stream_t.append(t)
+    preds, target, valid = m._unwrapped_compute()
+    total = sum(sizes)
+    kept = min(total, cap)
+    assert int(m.count) == total
+    np.testing.assert_array_equal(np.asarray(valid), np.arange(cap) < kept)
+    np.testing.assert_array_equal(np.asarray(preds)[:kept], np.concatenate(stream_p)[:kept])
+    np.testing.assert_array_equal(np.asarray(target)[:kept], np.concatenate(stream_t)[:kept])
 
 
-def test_append_slice_under_jit_and_scan():
+def test_buffer_write_under_jit_and_scan():
     """The append must stay correct when the count is a traced value inside
     a scanned loop — the way capacity metrics actually run."""
     cap, n = 16, 5
     rng = np.random.RandomState(0)
-    batches = jnp.asarray(rng.rand(6, n).astype(np.float32))
+    ps = jnp.asarray(rng.rand(6, n).astype(np.float32))
+    ts = jnp.asarray(rng.rand(6, n).astype(np.float32))
+    m = _Buf(cap)
 
     @jax.jit
-    def fill(batches):
-        def body(carry, batch):
-            buf, count = carry
-            return (_append_slice(buf, batch, count), count + n), None
+    def fill(ps, ts):
+        def body(state, xs):
+            return m.apply_update(state, *xs), None
 
-        return jax.lax.scan(body, (jnp.zeros(cap), jnp.zeros((), jnp.int32)), batches)[0]
+        return jax.lax.scan(body, m.init_state(), (ps, ts))[0]
 
-    buf, count = fill(batches)
-    expected = np.asarray(batches).reshape(-1)[:cap]
-    np.testing.assert_allclose(np.asarray(buf), expected)
-    assert int(count) == 30
+    state = fill(ps, ts)
+    rows = np.asarray(state["buf"]).reshape(-1, 2)[:cap]
+    np.testing.assert_allclose(rows[:, 0], np.asarray(ps).reshape(-1)[:cap])
+    np.testing.assert_allclose(rows[:, 1], np.asarray(ts).reshape(-1)[:cap])
+    assert int(state["count"]) == 30
