@@ -228,3 +228,21 @@ def test_ssim_streaming_matches_buffered():
         state = jitted(state, p, p)
     assert traces["n"] == 1
     np.testing.assert_allclose(float(metric.apply_compute(state)), 1.0, atol=1e-5)
+
+
+def test_ssim_band_matrix_matches_conv_formulation(monkeypatch):
+    """The two in-tree smoothing formulations — band-matrix matmuls (small
+    images, MXU) and depthwise convs (large images) — must agree, including
+    asymmetric kernels and non-square images (cross-check per the
+    CONTRIBUTING rule for dispatched kernels)."""
+    import metrics_tpu.functional.regression.ssim as ssim_mod
+    from metrics_tpu.functional import ssim as ssim_fn
+
+    rng = np.random.RandomState(3)
+    a = jnp.asarray(rng.rand(2, 3, 31, 45).astype(np.float32))
+    b = jnp.asarray(rng.rand(2, 3, 31, 45).astype(np.float32))
+    configs = [((11, 11), (1.5, 1.5)), ((11, 7), (1.5, 0.8)), ((3, 9), (0.7, 2.0))]
+    fast = [float(ssim_fn(a, b, kernel_size=ks, sigma=sg, data_range=1.0)) for ks, sg in configs]
+    monkeypatch.setattr(ssim_mod, "_MATMUL_MAX_SIDE", 0)  # force the conv path
+    slow = [float(ssim_fn(a, b, kernel_size=ks, sigma=sg, data_range=1.0)) for ks, sg in configs]
+    np.testing.assert_allclose(fast, slow, atol=1e-6)
