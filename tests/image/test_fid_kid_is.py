@@ -37,7 +37,7 @@ def _np_fid(real, fake):
     mu1, mu2 = real.mean(0), fake.mean(0)
     cov1 = np.cov(real, rowvar=False)
     cov2 = np.cov(fake, rowvar=False)
-    covmean, _ = scipy.linalg.sqrtm(cov1 @ cov2, disp=False)
+    covmean = scipy.linalg.sqrtm(cov1 @ cov2)  # disp arg is deprecated in scipy 1.18
     return ((mu1 - mu2) ** 2).sum() + np.trace(cov1 + cov2 - 2 * covmean.real)
 
 
