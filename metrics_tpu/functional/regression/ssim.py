@@ -45,10 +45,14 @@ def _band_matrix(size: int, kernel_size: int, sigma: float, pad: int) -> np.ndar
     for o in range(size_out):
         for t in range(kernel_size):
             j = o + t - pad
-            if j < 0:
-                j = -j  # jnp.pad mode="reflect" semantics
-            if j >= size:
-                j = 2 * size - 2 - j
+            # jnp.pad mode="reflect" semantics: reflect repeatedly until the
+            # index lands in range (a single bounce is not enough when the
+            # image side is <= pad — the 4x4-image-with-11x11-window case)
+            if size == 1:
+                j = 0
+            else:
+                while j < 0 or j >= size:
+                    j = -j if j < 0 else 2 * size - 2 - j
             g[o, j] += taps[t]
     return g
 

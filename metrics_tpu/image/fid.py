@@ -54,6 +54,12 @@ def sqrtm_newton_schulz(mat: Array, num_iters: int = 32) -> Array:
     on-chip sweep at d=2048, cond ~1e6: 20 iters → 5e-4 relative, 25 →
     6e-5, 30 → 7e-6, 50 → 1e-7; 32 buys comfortably below any FID
     tolerance at ~2/3 the matmul cost of 50.
+
+    Requires a full-rank input: the coupled iterate tracks ``A^{-1/2}``,
+    which diverges to NaN in the null space of a singular matrix (e.g. a
+    covariance estimated from n <= d samples) — callers must route
+    rank-deficient inputs to :func:`sqrtm_psd` (``FID``'s ``'auto'`` mode
+    does).
     """
     dim = mat.shape[0]
     norm = jnp.sqrt(jnp.sum(mat * mat))
@@ -123,8 +129,11 @@ class FID(Metric):
             measured to agree with scipy's f64 sqrtm to ~1e-5 relative on
             ill-conditioned 2048-d covariances; ``'auto'`` picks the
             Newton–Schulz iteration (matmul-only, f32-precision pinned) at
-            ``d >= 512``, where TPU ``eigh`` pays a multi-minute one-time
-            XLA compile for no accuracy gain, and ``eigh`` below that.
+            ``d >= 512`` with full-rank covariances (more samples than
+            feature dims on both sides), where TPU ``eigh`` pays a
+            multi-minute one-time XLA compile for no accuracy gain, and
+            ``eigh`` otherwise (it clips the zero eigenvalues NS cannot
+            handle).
         compute_on_step: defaults to ``False`` (like the reference,
             ``fid.py:211`` — a per-batch FID is not meaningful).
 
@@ -193,5 +202,12 @@ class FID(Metric):
         mean2, cov2 = _mean_cov(fake_features.astype(dtype))
         method = self.sqrtm_method
         if method == "auto":
-            method = "ns" if cov1.shape[0] >= 512 else "eigh"
+            # Newton-Schulz needs full-rank covariances: its coupled iterate
+            # tracks A^{-1/2}, which blows up to NaN in the null space when
+            # n <= d (and the eps jitter cannot rescue f32 at that conditioning
+            # — measured). Rank-deficient inputs take the eigh form, which
+            # clips zero eigenvalues exactly.
+            d = cov1.shape[0]
+            full_rank = min(real_features.shape[0], fake_features.shape[0]) > d
+            method = "ns" if (d >= 512 and full_rank) else "eigh"
         return _compute_fid(mean1, cov1, mean2, cov2, method=method).astype(orig_dtype)

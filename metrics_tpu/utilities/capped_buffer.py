@@ -32,7 +32,10 @@ from metrics_tpu.utilities.data import Array, _is_traced, dim_zero_cat
 from metrics_tpu.utilities.enums import DataType
 from metrics_tpu.utilities.prints import rank_zero_warn
 
-#: overflow landing zone, in rows; also the chunk size for oversized batches
+#: upper bound on the overflow landing zone, in rows; the per-instance slack
+#: is ``min(capacity, BUF_SLACK_ROWS)`` so tiny or very wide buffers don't
+#: pay 4096 rows of allocation and all_gather traffic, and it doubles as the
+#: chunk size for oversized batches
 BUF_SLACK_ROWS = 4096
 
 
@@ -83,7 +86,8 @@ class CappedBufferMixin:
         else:
             width = 2
         self._buf_width = width
-        total = (capacity + BUF_SLACK_ROWS) * width
+        self._buf_slack = min(capacity, BUF_SLACK_ROWS)
+        total = (capacity + self._buf_slack) * width
         self.add_state("buf", jnp.full((total,), -jnp.inf, jnp.float32), dist_reduce_fx="cat")
         self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="cat")
 
@@ -104,7 +108,8 @@ class CappedBufferMixin:
         _check_capacity(capacity)
         self._capacity_int_target = False
         self._buf_width = 2
-        total = (capacity + BUF_SLACK_ROWS) * 2
+        self._buf_slack = min(capacity, BUF_SLACK_ROWS)
+        total = (capacity + self._buf_slack) * 2
         self.add_state("buf", jnp.zeros((total,), dtype), dist_reduce_fx="cat")
         self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="cat")
 
@@ -117,11 +122,12 @@ class CappedBufferMixin:
         t = target if target.ndim == 2 else target[:, None]
         batch = jnp.concatenate([p.astype(dtype), t.astype(dtype)], axis=-1).reshape(-1)
         width = self._buf_width
-        total_rows = self.capacity + BUF_SLACK_ROWS
+        slack = self._buf_slack
+        total_rows = self.capacity + slack
         n = p.shape[0]
         buf, count = self.buf, self.count
-        for i in range(0, n, BUF_SLACK_ROWS):
-            rows = min(BUF_SLACK_ROWS, n - i)  # static per trace
+        for i in range(0, n, slack):
+            rows = min(slack, n - i)  # static per trace
             chunk = batch[i * width : (i + rows) * width]
             # rows <= SLACK, so a clamped start keeps every overflow write
             # inside the slack zone — exact drop semantics, no masking
@@ -184,7 +190,7 @@ class CappedBufferMixin:
         valid = (jnp.arange(self.capacity)[None, :] < jnp.clip(counts, 0, self.capacity)[:, None]).reshape(-1)
         width = self._buf_width
         # (shards, rows, width) view; the slack zone past `capacity` is never read
-        rows = buf.reshape(-1, self.capacity + BUF_SLACK_ROWS, width)[:, : self.capacity, :]
+        rows = buf.reshape(-1, self.capacity + self._buf_slack, width)[:, : self.capacity, :]
         flat = rows.reshape(-1, width)
         ncols = self._capacity_score_cols
         preds_flat = flat[:, :ncols]

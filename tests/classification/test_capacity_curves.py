@@ -125,12 +125,12 @@ class TestCapacityMode:
         with pytest.raises(ValueError, match="capacity"):
             metric_cls(capacity=0)
         # num_classes > 1 selects the multiclass layout: C score columns + 1
-        # label column per row of the flat merged buffer (plus the slack zone)
-        from metrics_tpu.utilities.capped_buffer import BUF_SLACK_ROWS
-
+        # label column per row of the flat merged buffer (plus the slack
+        # zone, which scales down with small capacities)
         m = metric_cls(capacity=16, num_classes=5)
         assert m._buf_width == 6
-        assert m.buf.shape == ((16 + BUF_SLACK_ROWS) * 6,)
+        assert m._buf_slack == 16
+        assert m.buf.shape == ((16 + 16) * 6,)
 
     def test_reset(self, metric_cls, sk_fn):
         metric = metric_cls(capacity=32)

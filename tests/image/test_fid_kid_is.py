@@ -108,6 +108,22 @@ class TestFID:
         with pytest.raises(ValueError, match="sqrtm_method"):
             FID(feature=_flat_features, sqrtm_method="cholesky")
 
+    def test_fid_auto_rank_deficient_stays_finite(self):
+        """Fewer samples than feature dims makes the covariance singular —
+        Newton-Schulz NaNs there (its coupled iterate tracks A^(-1/2)), so
+        the 'auto' default must route n <= d to the eigh form. Regression
+        for the default FID(feature=2048)-with-few-images case."""
+        rng = np.random.RandomState(6)
+        d, n = 600, 100  # d >= 512 so size alone would have picked 'ns'
+        feats = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :d]  # noqa: E731
+        fid = FID(feature=feats)  # sqrtm_method='auto'
+        real = jnp.asarray(rng.rand(n, 3, 20, 10).astype(np.float32))
+        fake = jnp.asarray(rng.rand(n, 3, 20, 10).astype(np.float32))
+        fid.update(real, real=True)
+        fid.update(fake, real=False)
+        value = float(fid.compute())
+        assert np.isfinite(value) and value >= 0.0
+
     def test_fid_metric_accumulates_batches(self):
         fid = FID(feature=_flat_features)
         real_imgs = _rng.rand(40, 3, 6, 6).astype(np.float32)

@@ -242,7 +242,13 @@ def test_ssim_band_matrix_matches_conv_formulation(monkeypatch):
     a = jnp.asarray(rng.rand(2, 3, 31, 45).astype(np.float32))
     b = jnp.asarray(rng.rand(2, 3, 31, 45).astype(np.float32))
     configs = [((11, 11), (1.5, 1.5)), ((11, 7), (1.5, 0.8)), ((3, 9), (0.7, 2.0))]
-    fast = [float(ssim_fn(a, b, kernel_size=ks, sigma=sg, data_range=1.0)) for ks, sg in configs]
+    # tiny images whose side is <= the pad: the reflect fold-in must
+    # multi-bounce exactly like jnp.pad (a single reflection silently
+    # wrapped to the wrong column here)
+    tiny = jnp.asarray(rng.rand(2, 3, 4, 5).astype(np.float32))
+    tiny2 = jnp.asarray(rng.rand(2, 3, 4, 5).astype(np.float32))
+    cases = [(a, b, ks, sg) for ks, sg in configs] + [(tiny, tiny2, (11, 11), (1.5, 1.5))]
+    fast = [float(ssim_fn(x, y, kernel_size=ks, sigma=sg, data_range=1.0)) for x, y, ks, sg in cases]
     monkeypatch.setattr(ssim_mod, "_MATMUL_MAX_SIDE", 0)  # force the conv path
-    slow = [float(ssim_fn(a, b, kernel_size=ks, sigma=sg, data_range=1.0)) for ks, sg in configs]
+    slow = [float(ssim_fn(x, y, kernel_size=ks, sigma=sg, data_range=1.0)) for x, y, ks, sg in cases]
     np.testing.assert_allclose(fast, slow, atol=1e-6)
