@@ -9,12 +9,24 @@ kernel config run on the real TPU backend, and the north-star
 fused into a Flax train step, target <1%). The flagship collection config
 prints LAST, and the full line set is re-emitted as a final block.
 
-Each line is ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
-where ``vs_baseline`` is baseline_time / our_time (higher is better; >1 =
-faster than the baseline — the reference library on torch-CPU for the parity
-configs, our own XLA formulation for the Pallas configs, the 1% target for
-the overhead config). Values are NaN-safe: a failed measurement prints
-``null``, never a fake number.
+Each line is ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+"probe_us": ..., "probe_us_after": ..., "link_rtt_ms": ..., "degraded":
+bool}`` where ``vs_baseline`` is baseline_time / our_time (higher is
+better; >1 = faster than the baseline — the reference library on torch-CPU
+for the parity configs, our own XLA formulation for the Pallas config, the
+1% target for the overhead config). Values are NaN-safe: a failed
+measurement prints ``null``, never a fake number.
+
+Self-defending capture: the benching tunnel assigns a chip endpoint per
+process, and endpoints are occasionally sick — the round-3 official capture
+came out 10–20× slow across the board for exactly this reason. Every
+config therefore runs in its OWN subprocess, bracketed by a fixed
+known-cost probe kernel (see ``bench_suite.probe_endpoint``). If either
+probe shows a degraded endpoint, the config is retried in a fresh process
+(fresh tunnel session ⇒ fresh endpoint assignment), bounded at
+``MAX_ATTEMPTS``; a line that stays degraded after retries keeps
+``"degraded": true`` so a sick chip can never silently become the official
+number.
 
 Timing uses the two-length scan-slope harness (see
 ``metrics_tpu/utilities/profiling.py::measure_scan_slope``): the marginal
@@ -23,8 +35,8 @@ per-step data varied inside the scan so XLA cannot hoist the update.
 """
 import json
 import os
+import subprocess
 import sys
-import traceback
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 for _p in (REPO_ROOT, os.path.join(REPO_ROOT, "scripts")):
@@ -33,10 +45,74 @@ for _p in (REPO_ROOT, os.path.join(REPO_ROOT, "scripts")):
 
 # persistent compilation cache: XLA compiles of the large programs (scans,
 # eigh) can take minutes through this toolchain; cache them on disk so
-# repeated bench runs (and the driver's) pay once. Must be set before jax
-# initializes.
+# repeated bench runs (and every config subprocess) pay once. Set before
+# spawning so children inherit it.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO_ROOT, ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+#: attempts per config: first run + up to two fresh-endpoint retries
+MAX_ATTEMPTS = 3
+#: wall-clock bound per config subprocess (seconds). FID gets longer: its
+#: scanned NS-sqrtm program plus the reference's f64 scipy sqrtm is the one
+#: legitimately multi-minute config (first compile ~minutes without a warm
+#: cache).
+TIMEOUT_S = 1800
+TIMEOUT_FID_S = 3600
+
+
+def _run_config_subprocess(name: str, timeout: float):
+    """One config in a fresh process; returns its JSON line or None."""
+    cmd = [sys.executable, os.path.join(REPO_ROOT, "scripts", "bench_suite.py"), "--config", name]
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout, cwd=REPO_ROOT
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# config {name} timed out after {timeout}s", file=sys.stderr)
+        return None
+    for raw in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        raw = raw.strip()
+        if raw.startswith("{"):
+            try:
+                return json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+    print(f"# config {name} produced no JSON line (rc={proc.returncode})", file=sys.stderr)
+    return None
+
+
+def _measure(name: str, meta) -> dict:
+    """Run ``name`` with bounded fresh-endpoint retries; keep the best line.
+
+    Preference order: any non-degraded line beats any degraded one; among
+    degraded lines the one with the healthiest probe wins (closest to the
+    truth, still flagged).
+    """
+    timeout = TIMEOUT_FID_S if name == "bench_fid_compute" else TIMEOUT_S
+    best = None
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        line = _run_config_subprocess(name, timeout)
+        if line is None:  # crash/timeout — a fresh process is the only retry lever
+            continue
+        if not line.get("degraded"):
+            if attempt > 1:
+                print(f"# {name}: healthy endpoint on attempt {attempt}", file=sys.stderr)
+            return line
+        print(
+            f"# {name}: degraded endpoint on attempt {attempt}"
+            f" (probe {line.get('probe_us')}/{line.get('probe_us_after')} us)"
+            + (" — retrying on a fresh tunnel session" if attempt < MAX_ATTEMPTS else ""),
+            file=sys.stderr,
+        )
+        def worst_probe(ln):  # a mid-config sickening corrupts the slope too
+            return max(ln.get("probe_us") or 1e9, ln.get("probe_us_after") or 1e9)
+
+        if best is None or worst_probe(line) < worst_probe(best):
+            best = line
+    if best is not None:
+        return best
+    metric, unit = meta
+    return {"metric": metric, "value": None, "unit": unit, "vs_baseline": None}
 
 
 def main() -> None:
@@ -44,15 +120,7 @@ def main() -> None:
 
     lines = []
     for cfg in bench_suite.CONFIGS:
-        try:
-            line = bench_suite.run_config(cfg)
-        except Exception:
-            print(f"# config {cfg.__name__} crashed:", file=sys.stderr)
-            traceback.print_exc()
-            name, unit = bench_suite.CONFIG_META.get(
-                cfg.__name__, (cfg.__name__.replace("bench_", ""), "us/step")
-            )
-            line = {"metric": name, "value": None, "unit": unit, "vs_baseline": None}
+        line = _measure(cfg.__name__, bench_suite.CONFIG_META[cfg.__name__])
         lines.append(line)
         print(json.dumps(line), flush=True)
     # re-emit every config as one final uninterrupted block (flagship last):
