@@ -76,12 +76,19 @@ def sqrtm_newton_schulz(mat: Array, num_iters: int = 32) -> Array:
     return y * jnp.sqrt(norm)
 
 
+#: TPU matmuls default to bfloat16 passes; every product feeding a matrix
+#: square root is pinned to full f32 so the rounding of the *input* cannot
+#: dominate the documented ~1e-5 agreement with scipy's f64 sqrtm (the same
+#: rationale as the pin inside :func:`sqrtm_newton_schulz`).
+_mm_f32 = functools.partial(jnp.matmul, precision="float32")
+
+
 def _trace_sqrt_product(sigma1: Array, sigma2: Array, method: str = "eigh") -> Array:
     """``Tr((Σ₁ Σ₂)^{1/2})`` — PSD-symmetrized eigh form, or Newton–Schulz."""
     if method == "ns":
-        return jnp.trace(sqrtm_newton_schulz(sigma1 @ sigma2))
+        return jnp.trace(sqrtm_newton_schulz(_mm_f32(sigma1, sigma2)))
     s1_half = sqrtm_psd(sigma1)
-    inner = s1_half @ sigma2 @ s1_half
+    inner = _mm_f32(_mm_f32(s1_half, sigma2), s1_half)
     inner = (inner + inner.T) / 2.0
     eigvals = jnp.clip(jnp.linalg.eigvalsh(inner), 0.0, None)
     return jnp.sum(jnp.sqrt(eigvals))
@@ -92,19 +99,42 @@ def _compute_fid(
 ) -> Array:
     """``‖μ₁-μ₂‖² + Tr(Σ₁ + Σ₂ - 2(Σ₁Σ₂)^{1/2})`` (ref ``fid.py:96-123``).
 
-    Trace-safe: the singular-product jitter retry (ref ``fid.py:115-120``) is a
-    ``lax.cond``, so the whole formula works under ``jit`` and only runs the
-    jittered recomputation when the plain product was non-finite.
+    The non-finite rescue is **method-aware**: a NaN out of the Newton–Schulz
+    path means the product was (near-)singular — e.g. dead feature
+    dimensions give a rank-deficient covariance even with ``n > d``, the case
+    the ``'auto'`` dispatch's sample-count proxy cannot see — and re-running
+    NS with an ``eps`` jitter cannot rescue f32 at that conditioning
+    (measured). When the finiteness check is concrete (the eager module
+    ``compute()`` path, i.e. the default-configured metric), a non-finite NS
+    trace therefore retries with the **eigh** form, which clips the zero
+    eigenvalues exactly. Under tracing both ``lax.cond`` branches compile,
+    and an eigh branch would bolt its multi-minute 2048-d XLA compile onto
+    every jitted NS compute — so the in-graph rescue stays the reference's
+    same-method jitter retry (ref ``fid.py:115-120``), and jitted callers
+    that expect singular covariances should pass ``method='eigh'``.
     """
     diff = mu1 - mu2
     base = diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2)
 
-    def _with_jitter() -> Array:
+    def _with_jitter(rescue_method: str) -> Array:
         offset = jnp.eye(sigma1.shape[0], dtype=sigma1.dtype) * eps
-        return _trace_sqrt_product(sigma1 + offset, sigma2 + offset, method)
+        return _trace_sqrt_product(sigma1 + offset, sigma2 + offset, rescue_method)
 
     tr_covmean = _trace_sqrt_product(sigma1, sigma2, method)
-    tr_covmean = jax.lax.cond(jnp.isfinite(tr_covmean), lambda: tr_covmean, _with_jitter)
+    finite = jnp.isfinite(tr_covmean)
+    if isinstance(finite, jax.core.Tracer):
+        tr_covmean = jax.lax.cond(
+            finite, lambda: tr_covmean, lambda: _with_jitter(method)
+        )
+    elif not bool(finite):
+        rescue = "eigh" if method == "ns" else method
+        rank_zero_warn(
+            f"FID trace term was non-finite on the '{method}' sqrtm path;"
+            f" retrying with jittered '{rescue}' (the input covariance product"
+            " is likely singular — e.g. dead feature dimensions).",
+            UserWarning,
+        )
+        tr_covmean = _with_jitter(rescue)
     return base - 2.0 * tr_covmean
 
 
@@ -113,7 +143,7 @@ def _mean_cov(features: Array) -> Tuple[Array, Array]:
     n = features.shape[0]
     mean = features.mean(axis=0)
     diff = features - mean
-    cov = (diff.T @ diff) / (n - 1)
+    cov = _mm_f32(diff.T, diff) / (n - 1)
     return mean, cov
 
 

@@ -124,6 +124,47 @@ class TestFID:
         value = float(fid.compute())
         assert np.isfinite(value) and value >= 0.0
 
+    def test_fid_ns_nonfinite_rescues_to_eigh_eagerly(self):
+        """A (near-)singular covariance product NaNs the Newton-Schulz
+        iterate, and re-running NS with the eps jitter cannot rescue f32 at
+        that conditioning — the eager non-finite fallback must be
+        method-aware and retry with the eigh form (which clips the zero
+        eigenvalues exactly)."""
+        from metrics_tpu.image.fid import _mean_cov, _trace_sqrt_product
+
+        rng = np.random.RandomState(3)
+        n, d = 33, 512  # rank(cov) = 32 << d: NS deterministically NaNs
+        m1, s1 = _mean_cov(jnp.asarray(rng.randn(n, d).astype(np.float32)))
+        m2, s2 = _mean_cov(jnp.asarray(rng.randn(n, d).astype(np.float32)))
+        assert not np.isfinite(float(_trace_sqrt_product(s1, s2, "ns")))
+        with pytest.warns(UserWarning, match="non-finite on the 'ns'"):
+            rescued = float(_compute_fid(m1, s1, m2, s2, method="ns"))
+        via_eigh = float(_compute_fid(m1, s1, m2, s2, method="eigh"))
+        assert np.isfinite(rescued)
+        np.testing.assert_allclose(rescued, via_eigh, rtol=1e-3)
+
+    def test_fid_auto_dead_feature_dims_stays_finite(self):
+        """'auto' uses n > d as a full-rank proxy, but a covariance can be
+        singular with n > d (constant/dead feature dimensions). The
+        default-configured metric must still return a finite value — via the
+        NS y-iterate converging, or the eager eigh rescue if it NaNs."""
+        rng = np.random.RandomState(7)
+        d, n = 512, 700  # n > d and d >= 512: 'auto' picks Newton-Schulz
+        def feats(imgs):
+            flat = imgs.reshape(imgs.shape[0], -1)[:, :d]
+            return flat.at[:, :32].set(1.25)  # 32 dead dims -> singular cov
+
+        fid = FID(feature=feats)  # sqrtm_method='auto'
+        fid_eigh = FID(feature=feats, sqrtm_method="eigh")
+        real = jnp.asarray(rng.rand(n, 3, 20, 10).astype(np.float32))
+        fake = jnp.asarray(rng.rand(n, 3, 20, 10).astype(np.float32))
+        for m in (fid, fid_eigh):
+            m.update(real, real=True)
+            m.update(fake, real=False)
+        value = float(fid.compute())
+        assert np.isfinite(value) and value >= 0.0
+        np.testing.assert_allclose(value, float(fid_eigh.compute()), rtol=1e-3)
+
     def test_fid_metric_accumulates_batches(self):
         fid = FID(feature=_flat_features)
         real_imgs = _rng.rand(40, 3, 6, 6).astype(np.float32)
