@@ -32,8 +32,8 @@ from metrics_tpu.functional.regression.explained_variance import explained_varia
 from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error  # noqa: F401
 from metrics_tpu.functional.regression.mean_absolute_percentage_error import (  # noqa: F401
     mean_absolute_percentage_error,
-    mean_relative_error,
 )
+from metrics_tpu.functional.regression.mean_relative_error import mean_relative_error  # noqa: F401
 from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error  # noqa: F401
 from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error  # noqa: F401
 from metrics_tpu.functional.regression.pearson import pearson_corrcoef  # noqa: F401

@@ -1,11 +1,11 @@
-"""Mean absolute percentage error (+ deprecated mean_relative_error alias).
+"""Mean absolute percentage error.
 
 Capability parity with the reference's
-``torchmetrics/functional/regression/mean_absolute_percentage_error.py`` and
-``mean_relative_error.py``.
+``torchmetrics/functional/regression/mean_absolute_percentage_error.py``
+(the deprecated ``mean_relative_error`` alias lives in its own module,
+mirroring the reference layout).
 """
 from typing import Tuple
-from warnings import warn
 
 import jax.numpy as jnp
 
@@ -42,12 +42,3 @@ def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
     return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)
 
 
-def mean_relative_error(preds: Array, target: Array) -> Array:
-    """Deprecated alias of :func:`mean_absolute_percentage_error`."""
-    warn(
-        "Function `mean_relative_error` was deprecated v0.4 and will be removed in v0.5."
-        "Use `mean_absolute_percentage_error` instead.",
-        DeprecationWarning,
-    )
-    sum_rltv_error, n_obs = _mean_absolute_percentage_error_update(preds, target)
-    return _mean_absolute_percentage_error_compute(sum_rltv_error, n_obs)

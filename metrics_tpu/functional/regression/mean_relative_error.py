@@ -1,0 +1,26 @@
+"""Deprecated ``mean_relative_error`` alias.
+
+Capability parity with the reference's
+``torchmetrics/functional/regression/mean_relative_error.py:19-52`` (its
+v0.4 deprecated the function in favour of
+``mean_absolute_percentage_error``; the alias — and its warning — are part
+of the public surface until v0.5, so they are here too).
+"""
+from warnings import warn
+
+from metrics_tpu.functional.regression.mean_absolute_percentage_error import (
+    _mean_absolute_percentage_error_compute,
+    _mean_absolute_percentage_error_update,
+)
+from metrics_tpu.utilities.data import Array
+
+
+def mean_relative_error(preds: Array, target: Array) -> Array:
+    """Deprecated alias of :func:`mean_absolute_percentage_error`."""
+    warn(
+        "Function `mean_relative_error` was deprecated v0.4 and will be removed in v0.5."
+        "Use `mean_absolute_percentage_error` instead.",
+        DeprecationWarning,
+    )
+    sum_rltv_error, n_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_rltv_error, n_obs)
