@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.utilities.data import Array, dim_zero_cat
+from metrics_tpu.utilities.data import Array, _is_traced, dim_zero_cat
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 
@@ -122,7 +122,7 @@ def _compute_fid(
 
     tr_covmean = _trace_sqrt_product(sigma1, sigma2, method)
     finite = jnp.isfinite(tr_covmean)
-    if isinstance(finite, jax.core.Tracer):
+    if _is_traced(finite):
         tr_covmean = jax.lax.cond(
             finite, lambda: tr_covmean, lambda: _with_jitter(method)
         )
@@ -147,6 +147,29 @@ def _mean_cov(features: Array) -> Tuple[Array, Array]:
     return mean, cov
 
 
+def _feature_dim_of(feature: Union[int, str, Callable], feature_dim: Optional[int]) -> int:
+    """Resolve the feature dimensionality for fixed-shape streaming states."""
+    if feature_dim is not None:
+        return int(feature_dim)
+    if isinstance(feature, int):
+        return feature
+    if feature == "logits_unbiased":
+        return 1008
+    raise ValueError(
+        "`streaming=True`/`capacity=` needs the feature dimensionality to size"
+        " fixed-shape states; pass `feature_dim=` when `feature` is a callable."
+    )
+
+
+def _streaming_mean_cov(n: Array, feat_sum: Array, outer_sum: Array) -> Tuple[Array, Array]:
+    """Mean + unbiased covariance from the linear streaming moments:
+    ``Σ(x-μ)(x-μ)ᵀ = Σxxᵀ − n·μμᵀ``."""
+    nf = jnp.maximum(n, 2).astype(feat_sum.dtype)
+    mean = feat_sum / nf
+    cov = (outer_sum - nf * jnp.outer(mean, mean)) / (nf - 1)
+    return mean, cov
+
+
 class FID(Metric):
     """Fréchet inception distance between the real and generated feature distributions.
 
@@ -164,6 +187,22 @@ class FID(Metric):
             multi-minute one-time XLA compile for no accuracy gain, and
             ``eigh`` otherwise (it clips the zero eigenvalues NS cannot
             handle).
+        streaming: accumulate exact linear moments (count, feature sum,
+            outer-product sum per side) instead of buffering every feature —
+            TPU extension: the state is fixed-shape (jit/shard_map
+            step-invariant, no retrace as the stream grows), memory is
+            O(d²) instead of O(N·d), and sync is one ``psum`` bundle
+            instead of gathering the full feature history (the reference
+            explicitly warns about the buffer footprint,
+            ``torchmetrics/image/fid.py:223-226``). The mean/covariance
+            derived from the moments are mathematically identical to the
+            buffered path (unbiased, ``Σxxᵀ − n·μμᵀ``); in float32 the
+            uncentered second moment can lose a few digits to cancellation
+            when feature means dwarf their spread — enable x64 for strict
+            f64 parity, as the reference's double-precision path does.
+        feature_dim: feature dimensionality ``d`` (required for
+            ``streaming=True`` when ``feature`` is a callable; inferred for
+            int/str taps).
         compute_on_step: defaults to ``False`` (like the reference,
             ``fid.py:211`` — a per-batch FID is not meaningful).
 
@@ -186,6 +225,8 @@ class FID(Metric):
         self,
         feature: Union[int, str, Callable] = 2048,
         sqrtm_method: str = "auto",
+        streaming: bool = False,
+        feature_dim: Optional[int] = None,
         compute_on_step: bool = False,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -197,31 +238,67 @@ class FID(Metric):
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
         )
-        rank_zero_warn(
-            "Metric `FID` will save all extracted features in buffer."
-            " For large datasets this may lead to large memory footprint.",
-            UserWarning,
-        )
         from metrics_tpu.image.inception_net import resolve_feature_extractor
 
         self.inception = resolve_feature_extractor(feature)
         if sqrtm_method not in ("auto", "eigh", "ns"):
             raise ValueError("Argument `sqrtm_method` expected to be 'auto', 'eigh' or 'ns'")
         self.sqrtm_method = sqrtm_method
+        self.streaming = streaming
 
-        self.add_state("real_features", [], dist_reduce_fx=None)
-        self.add_state("fake_features", [], dist_reduce_fx=None)
+        if streaming:
+            d = _feature_dim_of(feature, feature_dim)
+            self.feature_dim = d
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            for side in ("real", "fake"):
+                self.add_state(f"{side}_n", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+                self.add_state(f"{side}_sum", jnp.zeros((d,), dtype), dist_reduce_fx="sum")
+                self.add_state(f"{side}_outer", jnp.zeros((d, d), dtype), dist_reduce_fx="sum")
+        else:
+            rank_zero_warn(
+                "Metric `FID` will save all extracted features in buffer."
+                " For large datasets this may lead to large memory footprint."
+                " Pass `streaming=True` for exact O(d**2) moment states.",
+                UserWarning,
+            )
+            self.add_state("real_features", [], dist_reduce_fx=None)
+            self.add_state("fake_features", [], dist_reduce_fx=None)
 
     def update(self, imgs: Array, real: bool) -> None:
-        """Extract features for ``imgs`` and buffer them under the ``real`` flag."""
+        """Extract features for ``imgs`` and buffer (or fold) them under the ``real`` flag."""
         features = self.inception(imgs)
-        if real:
+        if self.streaming:
+            side = "real" if real else "fake"
+            feats = features.astype(getattr(self, f"{side}_sum").dtype)
+            setattr(self, f"{side}_n", getattr(self, f"{side}_n") + feats.shape[0])
+            setattr(self, f"{side}_sum", getattr(self, f"{side}_sum") + feats.sum(axis=0))
+            setattr(self, f"{side}_outer", getattr(self, f"{side}_outer") + _mm_f32(feats.T, feats))
+        elif real:
             self.real_features.append(features)
         else:
             self.fake_features.append(features)
 
+    def _resolve_method(self, n_min, d: int) -> str:
+        """'auto' dispatch: NS at large d with full-rank covariances, eigh otherwise."""
+        method = self.sqrtm_method
+        if method != "auto":
+            return method
+        if _is_traced(jnp.asarray(n_min)):
+            # under tracing the sample count is data-dependent; pick by size
+            # alone (the eager path's non-finite rescue is unavailable too —
+            # jitted callers expecting rank-deficient inputs should pass
+            # method='eigh')
+            return "ns" if d >= 512 else "eigh"
+        return "ns" if (d >= 512 and int(n_min) > d) else "eigh"
+
     def compute(self) -> Array:
-        """FID over all buffered real/fake features."""
+        """FID over all accumulated real/fake features."""
+        if self.streaming:
+            mean1, cov1 = _streaming_mean_cov(self.real_n, self.real_sum, self.real_outer)
+            mean2, cov2 = _streaming_mean_cov(self.fake_n, self.fake_sum, self.fake_outer)
+            method = self._resolve_method(jnp.minimum(self.real_n, self.fake_n), cov1.shape[0])
+            return _compute_fid(mean1, cov1, mean2, cov2, method=method).astype(jnp.float32)
+
         real_features = dim_zero_cat(self.real_features)
         fake_features = dim_zero_cat(self.fake_features)
         orig_dtype = real_features.dtype
@@ -230,14 +307,12 @@ class FID(Metric):
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         mean1, cov1 = _mean_cov(real_features.astype(dtype))
         mean2, cov2 = _mean_cov(fake_features.astype(dtype))
-        method = self.sqrtm_method
-        if method == "auto":
-            # Newton-Schulz needs full-rank covariances: its coupled iterate
-            # tracks A^{-1/2}, which blows up to NaN in the null space when
-            # n <= d (and the eps jitter cannot rescue f32 at that conditioning
-            # — measured). Rank-deficient inputs take the eigh form, which
-            # clips zero eigenvalues exactly.
-            d = cov1.shape[0]
-            full_rank = min(real_features.shape[0], fake_features.shape[0]) > d
-            method = "ns" if (d >= 512 and full_rank) else "eigh"
+        # Newton-Schulz needs full-rank covariances: its coupled iterate
+        # tracks A^{-1/2}, which blows up to NaN in the null space when
+        # n <= d (and the eps jitter cannot rescue f32 at that conditioning
+        # — measured). Rank-deficient inputs take the eigh form, which
+        # clips zero eigenvalues exactly.
+        method = self._resolve_method(
+            min(real_features.shape[0], fake_features.shape[0]), cov1.shape[0]
+        )
         return _compute_fid(mean1, cov1, mean2, cov2, method=method).astype(orig_dtype)

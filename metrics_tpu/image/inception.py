@@ -27,6 +27,13 @@ class IS(Metric):
             (N, num_classes)`` returning classification logits.
         splits: number of splits for the mean/std estimate.
         rng_seed: seed of the PRNG key used for the pre-split shuffle.
+        capacity: TPU extension — preallocate a fixed ``(capacity, C)`` logit
+            buffer instead of an unbounded list (the reference warns about
+            the footprint, ``inception.py:146``). The update path becomes
+            step-invariant under ``jit``; rows past capacity are dropped
+            with a warning. ``compute()`` stays an eager epoch-end call.
+        feature_dim: logit dimensionality ``C`` (required with ``capacity=``
+            when ``feature`` is a callable; inferred for int/str taps).
 
     Example:
         >>> import jax.numpy as jnp
@@ -48,6 +55,8 @@ class IS(Metric):
         feature: Union[str, int, Callable] = "logits_unbiased",
         splits: int = 10,
         rng_seed: int = 42,
+        capacity: Optional[int] = None,
+        feature_dim: Optional[int] = None,
         compute_on_step: bool = False,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -59,26 +68,54 @@ class IS(Metric):
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
         )
-        rank_zero_warn(
-            "Metric `IS` will save all extracted features in buffer."
-            " For large datasets this may lead to large memory footprint.",
-            UserWarning,
-        )
+        if capacity is None:
+            rank_zero_warn(
+                "Metric `IS` will save all extracted features in buffer."
+                " For large datasets this may lead to large memory footprint."
+                " Pass `capacity=` for a fixed-size buffer.",
+                UserWarning,
+            )
         from metrics_tpu.image.inception_net import resolve_feature_extractor
 
         self.inception = resolve_feature_extractor(feature)
         self.splits = splits
         self._rng_key = jax.random.PRNGKey(rng_seed)
 
-        self.add_state("features", [], dist_reduce_fx=None)
+        self.capacity = capacity
+        if capacity is not None:
+            from metrics_tpu.image.fid import _feature_dim_of
+            from metrics_tpu.utilities.capped_buffer import init_feature_buffer
+
+            d = _feature_dim_of(feature, feature_dim)
+            self.feature_dim = d
+            buf, self._buf_slack = init_feature_buffer(capacity, d)
+            self.add_state("features_buf", buf, dist_reduce_fx="cat")
+            self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="cat")
+        else:
+            self.add_state("features", [], dist_reduce_fx=None)
 
     def update(self, imgs: Array) -> None:
         """Extract classification logits for ``imgs`` and buffer them."""
-        self.features.append(self.inception(imgs))
+        logits = self.inception(imgs)
+        if self.capacity is not None:
+            from metrics_tpu.utilities.capped_buffer import feature_buffer_write
+
+            self.features_buf, self.count = feature_buffer_write(
+                self.features_buf, self.count, logits, self.capacity, self._buf_slack
+            )
+        else:
+            self.features.append(logits)
 
     def compute(self) -> Tuple[Array, Array]:
         """(mean, std) of the per-split inception scores."""
-        features = dim_zero_cat(self.features)
+        if self.capacity is not None:
+            from metrics_tpu.utilities.capped_buffer import feature_buffer_read
+
+            features = feature_buffer_read(
+                self.features_buf, self.count, self.capacity, type(self).__name__
+            )
+        else:
+            features = dim_zero_cat(self.features)
         features = jax.random.permutation(self._rng_key, features, axis=0)
 
         # trim to a multiple of `splits` so the batched reshape is static

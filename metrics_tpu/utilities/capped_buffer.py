@@ -44,6 +44,63 @@ def _check_capacity(capacity: int) -> None:
         raise ValueError(f"`capacity` should be a positive integer, got: {capacity}")
 
 
+def init_feature_buffer(capacity: int, dim: int, dtype=jnp.float32) -> Tuple[Array, int]:
+    """Preallocated ``(capacity + slack, dim)`` row buffer for feature metrics.
+
+    The 2-D row layout (unlike the flat classification buffer above) is
+    already contiguous for whole-row writes — feature rows are ``dim`` wide,
+    so a row-aligned ``dynamic_update_slice`` writes one contiguous span and
+    none of the flat layout's sublane-stride pathology applies. The slack
+    zone plays the same role: overflow writes clamp into rows the read path
+    never touches, giving exact drop-past-capacity semantics with no
+    masking. Returns ``(buffer, slack_rows)``.
+    """
+    _check_capacity(capacity)
+    slack = min(capacity, BUF_SLACK_ROWS)
+    return jnp.zeros((capacity + slack, dim), dtype), slack
+
+
+def feature_buffer_write(
+    buf: Array, count: Array, feats: Array, capacity: int, slack: int
+) -> Tuple[Array, Array]:
+    """Append ``(N, dim)`` rows at the fill offset; overflow rows land in the
+    slack zone (dropped), the counter keeps the true total."""
+    total_rows = capacity + slack
+    n = feats.shape[0]
+    zero = jnp.zeros((), jnp.int32)
+    for i in range(0, n, slack):
+        rows = min(slack, n - i)  # static per trace
+        chunk = feats[i : i + rows].astype(buf.dtype)
+        start = jnp.minimum(count + i, total_rows - rows)
+        buf = lax.dynamic_update_slice(buf, chunk, (start, zero))
+    return buf, count + n
+
+
+def feature_buffer_read(buf, count, capacity: int, owner: str = "metric") -> Array:
+    """Valid rows across however many shards the sync produced — eager only
+    (the row count is data-dependent; feature metrics compute at epoch end
+    on the host boundary, like the reference). Warns when rows were dropped
+    past capacity."""
+    bufs = buf if isinstance(buf, list) else [buf]
+    counts = count if isinstance(count, list) else [count]
+    if any(_is_traced(c) for c in counts) or any(_is_traced(b) for b in bufs):
+        raise NotImplementedError(
+            f"{owner}: `capacity` mode computes on concrete (non-traced) state —"
+            " the valid-row count is data-dependent. Call compute()/apply_compute"
+            " outside jit (the fixed-shape part is the update path)."
+        )
+    dropped = sum(max(int(c) - capacity, 0) for c in counts)
+    if dropped > 0:
+        rank_zero_warn(
+            f"{owner}(capacity={capacity}) dropped {dropped} feature rows past"
+            " the buffer capacity; the computed value covers the first"
+            " `capacity` rows per shard.",
+            UserWarning,
+        )
+    valid = [b[: min(int(c), capacity)] for b, c in zip(bufs, counts)]
+    return jnp.concatenate(valid, axis=0)
+
+
 class CappedBufferMixin:
     """State/update/mask logic shared by the fixed-capacity metric modes.
 

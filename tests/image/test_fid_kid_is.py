@@ -189,6 +189,169 @@ class TestFID:
         assert fid.real_features == [] and fid.fake_features == []
 
 
+class TestFIDStreaming:
+    """streaming=True: exact linear-moment states (count + feature sum +
+    outer-product sum per side) — fixed-shape, psum-reduced, O(d²) memory."""
+
+    def test_streaming_matches_buffered(self):
+        rng = np.random.RandomState(21)
+        streaming = FID(feature=_flat_features, streaming=True, feature_dim=16)
+        buffered = FID(feature=_flat_features)
+        for _ in range(4):
+            real = jnp.asarray(rng.rand(24, 3, 6, 6).astype(np.float32))
+            fake = jnp.asarray((rng.rand(24, 3, 6, 6) * 0.8).astype(np.float32))
+            for m in (streaming, buffered):
+                m.update(real, real=True)
+                m.update(fake, real=False)
+        np.testing.assert_allclose(
+            float(streaming.compute()), float(buffered.compute()), rtol=1e-3, atol=1e-4
+        )
+
+    def test_streaming_requires_feature_dim_for_callables(self):
+        with pytest.raises(ValueError, match="feature_dim"):
+            FID(feature=_flat_features, streaming=True)
+
+    def test_streaming_infers_dim_from_tap(self):
+        from metrics_tpu.image.fid import _feature_dim_of
+
+        assert _feature_dim_of(64, None) == 64
+        assert _feature_dim_of(2048, None) == 2048
+        assert _feature_dim_of("logits_unbiased", None) == 1008
+        assert _feature_dim_of(_flat_features, 16) == 16
+
+    def test_streaming_update_is_step_invariant_under_jit(self):
+        rng = np.random.RandomState(22)
+        metric = FID(feature=_flat_features, streaming=True, feature_dim=16)
+        traces = {"n": 0}
+
+        def step(state, imgs, real):
+            traces["n"] += 1
+            return metric.apply_update(state, imgs, real=real)
+
+        jitted = jax.jit(step, static_argnames="real")
+        state = metric.init_state()
+        for _ in range(3):
+            imgs = jnp.asarray(rng.rand(8, 3, 6, 6).astype(np.float32))
+            state = jitted(state, imgs, real=True)
+            state = jitted(state, imgs * 0.9, real=False)
+        assert traces["n"] == 2  # one trace per `real` flag value
+        assert np.isfinite(float(metric.apply_compute(state)))
+
+    def test_streaming_sharded_psum_matches_sequential(self):
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        rng = np.random.RandomState(23)
+        real = jnp.asarray(rng.rand(8 * 8, 3, 6, 6).astype(np.float32))
+        fake = jnp.asarray((rng.rand(8 * 8, 3, 6, 6) * 0.8).astype(np.float32))
+
+        metric = FID(feature=_flat_features, streaming=True, feature_dim=16)
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+        def step(r, f):
+            state = metric.apply_update(metric.init_state(), r, real=True)
+            state = metric.apply_update(state, f, real=False)
+            return metric.apply_compute(state, axis_name="data")
+
+        fn = jax.jit(
+            jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        )
+        value = float(fn(
+            jax.device_put(real, NamedSharding(mesh, P("data"))),
+            jax.device_put(fake, NamedSharding(mesh, P("data"))),
+        ))
+        seq = metric.apply_update(metric.init_state(), real, real=True)
+        seq = metric.apply_update(seq, fake, real=False)
+        np.testing.assert_allclose(value, float(metric.apply_compute(seq)), rtol=1e-4, atol=1e-4)
+
+    def test_streaming_no_footprint_warning(self, recwarn):
+        FID(feature=_flat_features, streaming=True, feature_dim=16)
+        assert not any("footprint" in str(w.message) for w in recwarn.list)
+
+
+class TestKIDCapacity:
+    def test_capacity_matches_buffered(self):
+        rng = np.random.RandomState(24)
+        capped = KID(feature=_flat_features, subsets=3, subset_size=8, capacity=64, feature_dim=16)
+        buffered = KID(feature=_flat_features, subsets=3, subset_size=8)
+        for _ in range(3):
+            real = jnp.asarray(rng.rand(12, 3, 6, 6).astype(np.float32))
+            fake = jnp.asarray((rng.rand(12, 3, 6, 6) * 0.8).astype(np.float32))
+            for m in (capped, buffered):
+                m.update(real, real=True)
+                m.update(fake, real=False)
+        got_mean, got_std = capped.compute()
+        want_mean, want_std = buffered.compute()
+        # identical features in identical order + the same PRNG key -> equal
+        np.testing.assert_allclose(float(got_mean), float(want_mean), rtol=1e-6)
+        np.testing.assert_allclose(float(got_std), float(want_std), rtol=1e-6)
+
+    def test_capacity_overflow_drops_and_warns(self):
+        rng = np.random.RandomState(25)
+        capped = KID(feature=_flat_features, subsets=2, subset_size=4, capacity=16, feature_dim=16)
+        first16 = KID(feature=_flat_features, subsets=2, subset_size=4)
+        real = jnp.asarray(rng.rand(24, 3, 6, 6).astype(np.float32))
+        fake = jnp.asarray((rng.rand(24, 3, 6, 6) * 0.8).astype(np.float32))
+        capped.update(real, real=True)
+        capped.update(fake, real=False)
+        first16.update(real[:16], real=True)
+        first16.update(fake[:16], real=False)
+        with pytest.warns(UserWarning, match="dropped"):
+            got = capped.compute()
+        want = first16.compute()
+        np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=1e-6)
+
+    def test_capacity_update_is_step_invariant_under_jit(self):
+        rng = np.random.RandomState(26)
+        metric = KID(feature=_flat_features, subsets=2, subset_size=4, capacity=64, feature_dim=16)
+        traces = {"n": 0}
+
+        def step(state, imgs, real):
+            traces["n"] += 1
+            return metric.apply_update(state, imgs, real=real)
+
+        jitted = jax.jit(step, static_argnames="real")
+        state = metric.init_state()
+        for _ in range(4):
+            state = jitted(state, jnp.asarray(rng.rand(8, 3, 6, 6).astype(np.float32)), real=True)
+        assert traces["n"] == 1
+
+    def test_capacity_traced_compute_raises(self):
+        metric = KID(feature=_flat_features, subsets=2, subset_size=4, capacity=16, feature_dim=16)
+        state = metric.apply_update(metric.init_state(), jnp.ones((8, 3, 6, 6)), real=True)
+        state = metric.apply_update(state, jnp.ones((8, 3, 6, 6)) * 0.5, real=False)
+        with pytest.raises(NotImplementedError, match="capacity"):
+            jax.jit(metric.apply_compute)(state)
+
+
+class TestISCapacity:
+    def test_capacity_matches_buffered(self):
+        rng = np.random.RandomState(27)
+        logits = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :10]  # noqa: E731
+        capped = IS(feature=logits, splits=2, capacity=64, feature_dim=10)
+        buffered = IS(feature=logits, splits=2)
+        for _ in range(3):
+            imgs = jnp.asarray(rng.rand(12, 3, 4, 4).astype(np.float32))
+            capped.update(imgs)
+            buffered.update(imgs)
+        got = capped.compute()
+        want = buffered.compute()
+        np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=1e-6)
+        np.testing.assert_allclose(float(got[1]), float(want[1]), rtol=1e-5)
+
+    def test_capacity_overflow_drops_and_warns(self):
+        rng = np.random.RandomState(28)
+        logits = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :10]  # noqa: E731
+        capped = IS(feature=logits, splits=2, capacity=8, feature_dim=10)
+        imgs = jnp.asarray(rng.rand(20, 3, 4, 4).astype(np.float32))
+        capped.update(imgs)
+        with pytest.warns(UserWarning, match="dropped"):
+            mean, _ = capped.compute()
+        first8 = IS(feature=logits, splits=2)
+        first8.update(imgs[:8])
+        np.testing.assert_allclose(float(mean), float(first8.compute()[0]), rtol=1e-6)
+
+
 class TestKID:
     def test_kid_full_subset_matches_direct_mmd(self):
         # subset_size == n makes the permutation irrelevant -> deterministic
