@@ -207,18 +207,44 @@ class MetricTester:
         """``jax.grad`` through the functional must yield finite gradients when
         the module declares itself differentiable, and the gradient must match
         a central finite difference along a random direction — the analogue of
-        the reference's ``torch.autograd.gradcheck`` (``testers.py:490-494``)."""
+        the reference's ``torch.autograd.gradcheck`` (``testers.py:490-494``).
+
+        The flag is asserted against ACTUAL output differentiability in both
+        directions (the analogue of the reference's ``_assert_requires_grad``,
+        ``testers.py:44-48``): a metric declaring ``is_differentiable=False``
+        must genuinely carry no useful gradient — its output is non-float
+        (grads impossible) or piecewise-constant in the inputs (``jax.grad``
+        identically zero at a generic point, e.g. counting/ranking metrics) —
+        so a False flag on a differentiable metric fails just as loudly as a
+        True flag on a non-differentiable one.
+        """
         metric_args = metric_args or {}
         p = jnp.asarray(preds[0], dtype=jnp.float64)
         t = jnp.asarray(target[0])
-        if not metric_module.is_differentiable:
-            return
 
         def loss(x):
-            return jnp.sum(jnp.asarray(metric_functional(x, t, **metric_args)))
+            out = metric_functional(x, t, **metric_args)
+            return sum(jnp.sum(jnp.asarray(leaf, jnp.float64)) for leaf in jax.tree.leaves(out))
+
+        if not metric_module.is_differentiable:
+            out = metric_functional(p, t, **metric_args)
+            float_out = all(
+                jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating) for leaf in jax.tree.leaves(out)
+            )
+            if float_out:
+                grad = jax.grad(loss)(p)
+                assert bool(jnp.all(grad == 0.0)), (
+                    f"{type(metric_module).__name__} declares is_differentiable=False"
+                    " but its functional has a non-zero gradient"
+                )
+            return
 
         grad = jax.grad(loss)(p)
         assert bool(jnp.all(jnp.isfinite(grad)))
+        assert bool(jnp.any(grad != 0.0)), (
+            f"{type(metric_module).__name__} declares is_differentiable=True but its"
+            " functional's gradient is identically zero at a generic point"
+        )
 
         rng = np.random.RandomState(11)
         direction = jnp.asarray(rng.randn(*p.shape))
