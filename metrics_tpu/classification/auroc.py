@@ -142,10 +142,16 @@ class AUROC(CappedBufferMixin, Metric):
 
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
+        mode = self.mode
+        if mode is None and preds.size > 0:
+            # this rank never updated (its gather leg was 0-length) but the
+            # sync delivered the peers' stream: infer the data mode from it,
+            # exactly as update() would have
+            _, _, mode = _auroc_update(preds, target)
         return _auroc_compute(
             preds,
             target,
-            self.mode,
+            mode,
             num_classes=self.num_classes,
             pos_label=self.pos_label,
             average=self.average,

@@ -168,10 +168,48 @@ class MetricCollection:
     def apply_compute(self, state: Dict[str, StateDict], axis_name: Any = AXIS_UNSET) -> Dict[str, Any]:
         """Compute every metric from its state; with ``axis_name`` the per-metric
         collectives are emitted into one program for XLA to fuse/stage. When
-        omitted, each member falls back to its own declared ``process_group``."""
+        omitted, each member falls back to its own declared ``process_group``.
+
+        Shared-update equivalence classes sync ONE state bundle: the
+        collection's update fans identical deltas to every member of a class
+        (:meth:`_shared_deltas` / :meth:`apply_update`), so their states are
+        equal by construction and syncing each would multiply the combined
+        all-reduce payload by the class size for no information (A+P+R+F1
+        would ship 4 private tp/fp/tn/fn quartets). The representative's
+        synced bundle is fanned out to the members instead. This leans on
+        the collection state contract — states come from this collection's
+        ``init_state``/``apply_update`` chain; hand-divergent states for
+        same-class members are outside it."""
+        groups: Dict[Tuple, list] = {}
+        for name, m in self.items(keep_base=True):
+            key = m._shared_update_key()
+            if key is not None:
+                groups.setdefault(key, []).append(name)
+
+        presynced: Dict[str, StateDict] = {}
+        for names in groups.values():
+            if len(names) < 2:
+                continue
+            rep = self._metrics[names[0]]
+            # alias only when the members' state specs (and, with axis_name
+            # unset, their fallback axes) genuinely coincide
+            if any(self._metrics[n]._reductions != rep._reductions for n in names[1:]):
+                continue
+            if axis_name is AXIS_UNSET and any(
+                self._metrics[n].process_group != rep.process_group for n in names[1:]
+            ):
+                continue
+            axis = rep.process_group if axis_name is AXIS_UNSET else axis_name
+            synced = rep.sync_state(state[names[0]], axis)
+            for n in names:
+                presynced[n] = synced
+
         out = {}
         for name, m in self.items(keep_base=True):
-            out[self._set_name(name)] = m.apply_compute(state[name], axis_name=axis_name)
+            if name in presynced:
+                out[self._set_name(name)] = m.apply_compute(presynced[name], axis_name=None)
+            else:
+                out[self._set_name(name)] = m.apply_compute(state[name], axis_name=axis_name)
         return out
 
     def apply_forward(

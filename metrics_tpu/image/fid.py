@@ -161,6 +161,23 @@ def _feature_dim_of(feature: Union[int, str, Callable], feature_dim: Optional[in
     )
 
 
+def resolve_sqrtm_method(n_min, d: int, method: str = "auto") -> str:
+    """The shipped ``'auto'`` sqrtm dispatch: Newton–Schulz (matmul-only,
+    MXU-native) at ``d >= 512`` with full-rank covariances (more samples
+    than feature dims), eigh otherwise — see :class:`FID`. Under tracing the
+    sample count is data-dependent, so the choice falls back to size alone.
+    """
+    if method != "auto":
+        return method
+    if _is_traced(jnp.asarray(n_min)):
+        # under tracing the sample count is data-dependent; pick by size
+        # alone (the eager path's non-finite rescue is unavailable too —
+        # jitted callers expecting rank-deficient inputs should pass
+        # method='eigh')
+        return "ns" if d >= 512 else "eigh"
+    return "ns" if (d >= 512 and int(n_min) > d) else "eigh"
+
+
 def _streaming_mean_cov(n: Array, feat_sum: Array, outer_sum: Array) -> Tuple[Array, Array]:
     """Mean + unbiased covariance from the linear streaming moments:
     ``Σ(x-μ)(x-μ)ᵀ = Σxxᵀ − n·μμᵀ``."""
@@ -279,17 +296,7 @@ class FID(Metric):
             self.fake_features.append(features)
 
     def _resolve_method(self, n_min, d: int) -> str:
-        """'auto' dispatch: NS at large d with full-rank covariances, eigh otherwise."""
-        method = self.sqrtm_method
-        if method != "auto":
-            return method
-        if _is_traced(jnp.asarray(n_min)):
-            # under tracing the sample count is data-dependent; pick by size
-            # alone (the eager path's non-finite rescue is unavailable too —
-            # jitted callers expecting rank-deficient inputs should pass
-            # method='eigh')
-            return "ns" if d >= 512 else "eigh"
-        return "ns" if (d >= 512 and int(n_min) > d) else "eigh"
+        return resolve_sqrtm_method(n_min, d, self.sqrtm_method)
 
     def compute(self) -> Array:
         """FID over all accumulated real/fake features."""

@@ -262,19 +262,28 @@ class Metric(ABC):
         if axis_name is AXIS_UNSET:
             axis_name = self.process_group
         with compiled_scope(f"{self.__class__.__name__}.compute"):
-            if axis_name is not None:
-                with compiled_scope(f"{self.__class__.__name__}.sync"):
-                    try:
-                        state = sync_in_graph(state, self._reductions, axis_name)
-                    except NameError as err:  # unbound collective axis
-                        raise NameError(
-                            f"{err}. This metric declares process_group={self.process_group!r}, which is"
-                            " the default `axis_name` of the pure compute/forward API — collectives over"
-                            " it only work inside shard_map/pmap binding that axis. To compute eagerly"
-                            " (single-device, no sync), pass `axis_name=None` explicitly."
-                        ) from err
+            state = self.sync_state(state, axis_name)
             with self._bound_state(state):
                 return self._unwrapped_compute()
+
+    def sync_state(self, state: StateDict, axis_name: Any) -> StateDict:
+        """In-graph sync of a state pytree over ``axis_name`` (no compute);
+        ``None`` returns the state untouched. Exposed so a caller holding
+        several metrics with IDENTICAL states (a shared-update equivalence
+        class in a :class:`MetricCollection`) can sync one bundle and fan it
+        out instead of paying the collective payload once per member."""
+        if axis_name is None:
+            return state
+        with compiled_scope(f"{self.__class__.__name__}.sync"):
+            try:
+                return sync_in_graph(state, self._reductions, axis_name)
+            except NameError as err:  # unbound collective axis
+                raise NameError(
+                    f"{err}. This metric declares process_group={self.process_group!r}, which is"
+                    " the default `axis_name` of the pure compute/forward API — collectives over"
+                    " it only work inside shard_map/pmap binding that axis. To compute eagerly"
+                    " (single-device, no sync), pass `axis_name=None` explicitly."
+                ) from err
 
     def apply_forward(
         self,
@@ -486,10 +495,20 @@ class Metric(ABC):
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays, process_group: Optional[Any] = None) -> None:
         states = self._get_states()
 
-        # pre-concatenate list states so each costs one gather (metric.py:203-206)
+        # Pre-concatenate EVERY list state — regardless of its reduction, as
+        # the reference does (metric.py:203-206) — so each costs exactly one
+        # gather. This is also what keeps ranks with different per-rank batch
+        # counts issuing the same NUMBER of collectives: un-concatenated
+        # None-reduce lists would gather once per batch and deadlock on the
+        # rank with fewer batches. A never-updated (empty) list state still
+        # participates with a 0-length placeholder; the gather protocol
+        # aligns its ndim/dtype to the peers'.
         for name, fx in self._reductions.items():
-            if (fx == "cat" or fx is dim_zero_cat) and isinstance(states[name], list) and len(states[name]) > 1:
-                states[name] = [dim_zero_cat(states[name])]
+            value = states[name]
+            if isinstance(value, list):
+                states[name] = (
+                    [dim_zero_cat(value)] if value else [jnp.zeros((0,), jnp.float32)]
+                )
 
         gathered = apply_to_collection(states, ArrayTypes, dist_sync_fn, group=process_group or self.process_group)
 
@@ -499,6 +518,11 @@ class Metric(ABC):
                 value = jnp.stack([jnp.asarray(v) for v in value])
             elif isinstance(value[0], list):
                 value = _flatten(value)
+                # drop empty shards (ranks that never updated) so the cat
+                # result keeps the data's dtype/shape; keep one if all empty
+                filled = [v for v in value if jnp.asarray(v).size > 0]
+                if len(filled) < len(value):
+                    value = filled or value[:1]
             reduction_fn = _resolve_reduction(fx)
             if not (callable(reduction_fn) or reduction_fn is None):
                 raise TypeError("reduction_fn must be callable or None")

@@ -103,14 +103,36 @@ def _process_allgather(x: Array) -> Array:
     return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
 
 
+#: descriptor layout for the ragged gather: [ndim, d0..d7, dtype_code]
+_MAX_GATHER_NDIM = 8
+#: dtypes the ragged gather can align across ranks (code = list index);
+#: covers every dtype the library stores in states
+_GATHER_DTYPES = (
+    np.dtype(np.bool_),
+    np.dtype(np.uint8),
+    np.dtype(np.int8),
+    np.dtype(np.int16),
+    np.dtype(np.int32),
+    np.dtype(np.int64),
+    np.dtype(np.float16),
+    np.dtype(np.float32),
+    np.dtype(np.float64),
+)
+
+
 def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]:
     """Gather one array from every process into a list (eager, epoch-boundary path).
 
     Handles per-process shape raggedness with the pad-to-max/trim protocol the
-    reference uses (``utilities/distributed.py:126-149``): gather all shapes,
-    pad each local tensor to the elementwise max, all-gather, then trim each
-    result back to its true shape. ``group`` is accepted for API parity; use
-    mesh-axis names with the in-graph path for sub-group reductions.
+    reference uses (``utilities/distributed.py:126-149``): gather all shape
+    descriptors, pad each local tensor to the elementwise max, all-gather,
+    then trim each result back to its true shape. A rank with NO data (a
+    never-updated list state — 0 elements, possibly of a different rank and
+    placeholder dtype, the reference's 0-length case
+    ``tests/bases/test_ddp.py:63-81``) still participates: the descriptor
+    exchange aligns its contribution to the peers' ndim/dtype and its
+    trimmed result is a 0-row tensor. ``group`` is accepted for API parity;
+    use mesh-axis names with the in-graph path for sub-group reductions.
     """
     result = jnp.asarray(result)
     if not distributed_available():
@@ -122,20 +144,62 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
         gathered = _process_allgather(result)
         return [jnp.asarray(gathered[i]) for i in range(nprocs)]
 
-    local_shape = np.asarray(result.shape, dtype=np.int64)
-    all_shapes = _process_allgather(local_shape)  # (nprocs, ndim)
-    max_shape = all_shapes.max(axis=0)
+    if result.ndim > _MAX_GATHER_NDIM:
+        raise ValueError(f"gather_all_arrays supports up to {_MAX_GATHER_NDIM} dims, got {result.ndim}")
+    np_dtype = np.dtype(result.dtype)
+    if np_dtype not in _GATHER_DTYPES:
+        raise ValueError(f"gather_all_arrays cannot align dtype {np_dtype} across ranks")
 
-    if bool((all_shapes == max_shape[None, :]).all()):
-        gathered = _process_allgather(result)
+    desc = np.zeros(_MAX_GATHER_NDIM + 2, dtype=np.int64)
+    desc[0] = result.ndim
+    desc[1 : 1 + result.ndim] = result.shape
+    desc[-1] = _GATHER_DTYPES.index(np_dtype)
+    all_desc = _process_allgather(desc)  # (nprocs, 10)
+
+    ndims = all_desc[:, 0].astype(int)
+    counts = np.array(
+        [int(np.prod(all_desc[i, 1 : 1 + ndims[i]])) if ndims[i] else 0 for i in range(nprocs)]
+    )
+    nonempty = counts > 0
+    if nonempty.any():
+        ref_ranks = np.where(nonempty)[0]
+        if len({int(ndims[i]) for i in ref_ranks}) > 1:
+            raise ValueError(
+                f"gather_all_arrays: ranks hold data of different ranks (ndims {ndims.tolist()})"
+            )
+        if len({int(all_desc[i, -1]) for i in ref_ranks}) > 1:
+            raise ValueError("gather_all_arrays: ranks hold data of different dtypes")
+        ref_ndim = int(ndims[ref_ranks[0]])
+        target_dtype = _GATHER_DTYPES[int(all_desc[ref_ranks[0], -1])]
+    else:  # every rank is empty: any consistent alignment works
+        ref_ndim = int(ndims.max())
+        target_dtype = _GATHER_DTYPES[int(all_desc[0, -1])]
+
+    # per-rank true shapes aligned to ref_ndim; an empty rank's contribution
+    # becomes 0 rows of the peers' trailing dims
+    shapes = np.zeros((nprocs, ref_ndim), dtype=np.int64)
+    for i in range(nprocs):
+        nd = min(int(ndims[i]), ref_ndim)
+        shapes[i, :nd] = all_desc[i, 1 : 1 + nd]
+    max_shape = shapes[nonempty].max(axis=0) if nonempty.any() else np.ones(ref_ndim, np.int64)
+    for i in np.where(~nonempty)[0]:
+        shapes[i] = np.concatenate([[0], max_shape[1:]]) if ref_ndim else shapes[i]
+
+    rank = jax.process_index()
+    local = result.astype(target_dtype)
+    if counts[rank] == 0:
+        local = jnp.zeros(tuple(shapes[rank]), target_dtype)
+
+    if bool((shapes == max_shape[None, :]).all()):
+        gathered = _process_allgather(local)
         return [jnp.asarray(gathered[i]) for i in range(nprocs)]
 
-    pad_width = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
-    padded = jnp.pad(result, pad_width)
+    pad_width = [(0, int(m - s)) for s, m in zip(local.shape, max_shape)]
+    padded = jnp.pad(local, pad_width)
     gathered = _process_allgather(padded)
     out = []
     for i in range(nprocs):
-        trim = tuple(slice(int(d)) for d in all_shapes[i])
+        trim = tuple(slice(int(d)) for d in shapes[i])
         out.append(jnp.asarray(gathered[i][trim]))
     return out
 

@@ -39,7 +39,9 @@ def eager_span(name: str) -> Iterator[None]:
         yield
 
 
-def measure_scan_slope(all_inputs: Any, init_state: Any, update: Any, rounds: int = 7) -> float:
+def measure_scan_slope(
+    all_inputs: Any, init_state: Any, update: Any, rounds: int = 7, stats: Any = None
+) -> float:
     """Marginal per-step device time (seconds) of ``update`` scanned over
     ``all_inputs`` (leading axis = steps) — the shared two-length-slope
     harness behind ``bench.py`` / ``scripts/bench_suite.py`` and
@@ -56,6 +58,13 @@ def measure_scan_slope(all_inputs: Any, init_state: Any, update: Any, rounds: in
     pair for even ``rounds``. Returns NaN (with a warning) when noise
     swallows the signal even after retrying with more rounds — never a
     silent zero.
+
+    Pass a dict as ``stats`` to receive compile evidence:
+    ``warmup_short_s``/``warmup_long_s`` are the first-call wall times of
+    the two program lengths (compile + one run). When the persistent
+    compilation cache is warm these sit near the steady-state run time;
+    a cold cache shows up as the full XLA compile — which is how a bench
+    record proves its warmup actually hit the cache.
     """
     import warnings
 
@@ -83,8 +92,11 @@ def measure_scan_slope(all_inputs: Any, init_state: Any, update: Any, rounds: in
 
     from statistics import median
 
-    run(all_inputs)  # compile both lengths
-    run(tiled)
+    warmup_short = run(all_inputs)  # compile both lengths
+    warmup_long = run(tiled)
+    if stats is not None:
+        stats["warmup_short_s"] = round(warmup_short, 3)
+        stats["warmup_long_s"] = round(warmup_long, 3)
     for attempt in range(2):
         shorts, longs = [], []
         for _ in range(rounds * (attempt + 1)):

@@ -422,15 +422,21 @@ def bench_auroc_compute():
 
 def bench_fid_compute():
     """FID epoch-end compute (2048-dim features, 5k samples/side): mean/cov +
-    the matrix square-root trace term. Ours runs the Newton–Schulz (matmul-
-    only, MXU-native) sqrtm on-device — chosen over the eigh formulation here
-    because XLA's 2048x2048 eigh takes minutes to *compile* on this backend —
-    with a value cross-check against the eigh path; the reference round-trips
-    through scipy.linalg.sqrtm on the host (``torchmetrics/image/fid.py:55-93``)."""
+    the matrix square-root trace term, on the SHIPPED ``'auto'`` dispatch
+    (``resolve_sqrtm_method`` — at n=5000 > d=2048 full-rank it picks the
+    Newton–Schulz matmul-only sqrtm; the eigh formulation pays a
+    multi-minute one-time XLA compile on this backend) with a value
+    cross-check against the reference, which round-trips through
+    scipy.linalg.sqrtm on the host (``torchmetrics/image/fid.py:55-93``).
+    The JSON line carries ``warmup_short_s``/``warmup_long_s`` (first-call
+    wall time of the two scanned programs) so the record shows whether the
+    persistent compilation cache was hit — a cold cache is multi-minute
+    warmup, a warm one is seconds."""
     import jax
     import jax.numpy as jnp
 
-    from metrics_tpu.image.fid import _compute_fid, _mean_cov
+    from metrics_tpu.image.fid import _compute_fid, _mean_cov, resolve_sqrtm_method
+    from metrics_tpu.utilities.profiling import measure_scan_slope
 
     n, d, epochs = 5000, 2048, 3
     # generated on-device: host->tunnel transfer of ~GB inputs would dominate
@@ -438,13 +444,20 @@ def bench_fid_compute():
     real = jax.random.normal(kr, (epochs, n, d), jnp.float32)
     fake = jax.random.normal(kf, (epochs, n, d), jnp.float32) * 1.1 + 0.1
 
+    method = resolve_sqrtm_method(n, d)  # the default-path dispatch: 'ns' here
+
     def one(fr, ff):
         m1, s1 = _mean_cov(fr)
         m2, s2 = _mean_cov(ff)
-        return _compute_fid(m1, s1, m2, s2, method="ns")
+        return _compute_fid(m1, s1, m2, s2, method=method)
 
-    ours = _time_scan_epoch(
-        (real, fake), lambda: jnp.zeros(()), lambda acc, fr, ff: acc + one(fr, ff)
+    stats = {"sqrtm_method": method}
+    ours = measure_scan_slope(
+        (real, fake),
+        lambda: jnp.zeros(()),
+        lambda acc, fr, ff: acc + one(fr, ff),
+        rounds=ROUNDS,
+        stats=stats,
     )
 
     def ref(torchmetrics, torch):
@@ -478,7 +491,7 @@ def bench_fid_compute():
             )
         return elapsed
 
-    return "fid_epoch_compute_2048d", ours, ref
+    return "fid_epoch_compute_2048d", ours, ref, "us/step", stats
 
 
 # ------------------------------------------------ Pallas kernels on TPU
@@ -651,6 +664,7 @@ def run_config(cfg, probe: bool = True) -> dict:
     out = cfg()
     name, ours, ref_fn = out[0], out[1], out[2]
     unit = out[3] if len(out) > 3 else "us/step"
+    extra = out[4] if len(out) > 4 else None
     # probe again AFTER the measurement: an endpoint that sickens mid-config
     # corrupts the slope just as thoroughly as one that starts sick
     health_after = probe_endpoint() if probe else None
@@ -677,6 +691,8 @@ def run_config(cfg, probe: bool = True) -> dict:
         "unit": unit,
         "vs_baseline": round(vs, 3) if vs is not None else None,
     }
+    if extra:
+        line.update(extra)
     if probe:
         line.update(
             probe_us=health["probe_us"],

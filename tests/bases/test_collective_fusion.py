@@ -51,6 +51,17 @@ def _collective_counts(compiled_text):
     return counts
 
 
+def _allreduce_operand_count(compiled_text):
+    """Total operand count across all all-reduce instructions — the payload
+    ARRAY count the combined collective actually ships (XLA's combiner merges
+    ops but keeps every operand's bytes)."""
+    total = 0
+    for args in re.findall(r"all-reduce(?:-start)?\(([^)]*)\)", compiled_text):
+        args = args.strip()
+        total += args.count(",") + 1 if args else 0
+    return total
+
+
 def _ten_metric_collection():
     return MetricCollection(
         [
@@ -87,11 +98,18 @@ def test_ten_metric_sync_is_one_allreduce():
             check_vma=False,
         )
     )
-    counts = _collective_counts(fn.lower(state).compile().as_text())
+    compiled = fn.lower(state).compile().as_text()
+    counts = _collective_counts(compiled)
     # one combined all-reduce; allow one extra for a dtype group, never O(states)
     assert 1 <= counts["all-reduce"] <= 2, counts
     assert counts["all-gather"] == 0, counts
     assert counts["all-to-all"] == 0, counts
+    # shared-update classes alias ONE synced bundle: the payload is
+    # Accuracy(6: tp/fp/tn/fn + correct/total) + ONE stat-scores quartet for
+    # P/R/F1/Specificity (4, not 16) + Hamming(2) + ONE confmat for
+    # CM/Kappa/MCC/IoU (1, not 4) = 13 arrays, down from 28 without aliasing
+    operands = _allreduce_operand_count(compiled)
+    assert operands <= 13, f"all-reduce ships {operands} arrays; aliasing regressed"
 
 
 def test_sync_values_match_sequential_after_combining():

@@ -74,7 +74,7 @@ _WORKER = textwrap.dedent(
 )
 
 
-def _run_two_process_worker(tmp_path, script, extra_env=None, timeout=220):
+def _run_process_workers(tmp_path, script, nprocs=2, extra_env=None, timeout=220):
     with socket.socket() as s:  # reserve a free coordinator port
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -91,7 +91,7 @@ def _run_two_process_worker(tmp_path, script, extra_env=None, timeout=220):
             stderr=subprocess.STDOUT,
             env=env,
         )
-        for r in range(2)
+        for r in range(nprocs)
     ]
     outputs = []
     try:
@@ -103,6 +103,11 @@ def _run_two_process_worker(tmp_path, script, extra_env=None, timeout=220):
             p.kill()
     for rank, out in enumerate(outputs):
         assert f"PARITY_OK rank={rank}" in out, f"rank {rank} failed:\n{out[-3000:]}"
+
+
+# back-compat alias for the original 2-process helper name
+def _run_two_process_worker(tmp_path, script, extra_env=None, timeout=220):
+    _run_process_workers(tmp_path, script, nprocs=2, extra_env=extra_env, timeout=timeout)
 
 
 def test_two_process_sync_matches_sequential(tmp_path):
@@ -178,3 +183,138 @@ def test_two_process_global_mesh_in_graph_sync(tmp_path):
     ]
     flags = " ".join(kept + ["--xla_force_host_platform_device_count=4"])
     _run_two_process_worker(tmp_path, _SPMD_WORKER, extra_env={"XLA_FLAGS": flags})
+
+
+_FOUR_PROC_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank, port = int(sys.argv[1]), sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=4, process_id=rank
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from sklearn.metrics import accuracy_score, roc_auc_score
+
+    from metrics_tpu import Accuracy, AUROC
+
+    NB, B, NC = 6, 16, 4  # 6 batches over 4 ranks -> UNEVEN stripes (2,2,1,1)
+    rng = np.random.RandomState(13)
+    probs = rng.rand(NB, B, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.randint(0, NC, (NB, B))
+    bin_probs = rng.rand(NB, B).astype(np.float32)
+    bin_target = rng.randint(0, 2, (NB, B))
+
+    acc = Accuracy()   # scalar sum states: 4-way psum
+    auroc = AUROC()    # list cat states: ragged 4-way gather
+    for i in range(rank, NB, 4):
+        acc.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        # rank 3 contributes NOTHING to the curve metric: its gather leg is
+        # a 0-length tensor (the reference pins this case,
+        # tests/bases/test_ddp.py:63-81 with `torch.ones(rank)`)
+        if rank != 3:
+            auroc.update(jnp.asarray(bin_probs[i]), jnp.asarray(bin_target[i]))
+
+    got_acc = float(acc.compute())
+    want_acc = accuracy_score(target.reshape(-1), probs.argmax(-1).reshape(-1))
+    np.testing.assert_allclose(got_acc, want_acc, atol=1e-6)
+
+    seen = [i for i in range(NB) if i % 4 != 3]
+    got_auroc = float(auroc.compute())
+    want_auroc = roc_auc_score(
+        bin_target[seen].reshape(-1), bin_probs[seen].reshape(-1)
+    )
+    np.testing.assert_allclose(got_auroc, want_auroc, atol=1e-6)
+
+    print(f"PARITY_OK rank={rank}", flush=True)
+    """
+)
+
+
+def test_four_process_uneven_and_empty_rank_sync(tmp_path):
+    """4 actual ``jax.distributed`` processes: psum across 4 ranks, ragged
+    cat-state gather with uneven per-rank sample counts AND one rank holding
+    an empty (0-length) curve state — the reference's uneven-shape gather
+    case (``tests/bases/test_ddp.py:63-81``) at twice the world size."""
+    _run_process_workers(tmp_path, _FOUR_PROC_WORKER, nprocs=4)
+
+
+_SPMD_2D_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank, port = int(sys.argv[1]), sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from sklearn.metrics import accuracy_score, precision_score
+
+    from metrics_tpu import Accuracy, MetricCollection, Precision
+
+    # 2 processes x 4 local devices = 8 global devices arranged as a 2-D
+    # (data=4, model=2) mesh. Device order puts process 0 on devices 0-3,
+    # so the row-major reshape makes the DATA axis span the process
+    # boundary: rows (0,1),(2,3) live on process 0 and (4,5),(6,7) on
+    # process 1, while each model pair stays in-process. Metric sync is
+    # scoped to the data axis only — the process-spanning psum — and every
+    # model shard must come out with the identical global value.
+    devices = np.array(jax.devices())
+    assert devices.size == 8, devices
+    mesh = Mesh(devices.reshape(4, 2), ("data", "model"))
+
+    NC, PER_ROW = 4, 16
+    n = 4 * PER_ROW
+    rng = np.random.RandomState(17)  # identical stream on both processes
+    probs = rng.rand(n, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.randint(0, NC, n)
+
+    # inputs batch-sharded over data, REPLICATED over model
+    psh = NamedSharding(mesh, P("data", None))
+    tsh = NamedSharding(mesh, P("data"))
+    gp = jax.make_array_from_callback((n, NC), psh, lambda idx: probs[idx])
+    gt = jax.make_array_from_callback((n,), tsh, lambda idx: target[idx])
+
+    metrics = MetricCollection([Accuracy(), Precision(average="macro", num_classes=NC)])
+
+    def step(p, t):
+        state = metrics.apply_update(metrics.init_state(), p, t)
+        return metrics.apply_compute(state, axis_name="data")
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False
+    ))
+    values = jax.tree.map(lambda x: float(np.asarray(x)), fn(gp, gt))
+
+    want_acc = accuracy_score(target, probs.argmax(-1))
+    np.testing.assert_allclose(values["Accuracy"], want_acc, atol=1e-6)
+    want_prec = precision_score(target, probs.argmax(-1), average="macro", zero_division=0)
+    np.testing.assert_allclose(values["Precision"], want_prec, atol=1e-6)
+
+    print(f"PARITY_OK rank={rank}", flush=True)
+    """
+)
+
+
+def test_two_process_2d_mesh_data_axis_scoped_sync(tmp_path):
+    """Process-spanning 2-D ``(data, model)`` mesh: the data axis crosses the
+    process boundary, the model axis stays in-process, and metric sync is
+    scoped to the data axis only (the ``process_group`` -> mesh-axis
+    generalization) — previously exercised only single-process on the
+    virtual mesh (``tests/bases/test_mesh_axes.py``)."""
+    kept = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags = " ".join(kept + ["--xla_force_host_platform_device_count=4"])
+    _run_process_workers(tmp_path, _SPMD_2D_WORKER, nprocs=2, extra_env={"XLA_FLAGS": flags})

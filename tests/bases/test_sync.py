@@ -117,6 +117,66 @@ def test_eager_sync_with_injected_gather():
     assert len(c.x) == 2  # local list state restored
 
 
+def test_eager_sync_with_empty_list_state():
+    """A never-updated list state must still participate in the sync (with a
+    0-length placeholder the gather can align) and the cat result must keep
+    the PEERS' data — the reference's 0-length gather case
+    (``tests/bases/test_ddp.py:63-81``). Regression: this used to
+    IndexError, desyncing the collective across ranks."""
+
+    class CatMetric(DummyListMetric):
+        def update(self, x):
+            self.x.append(jnp.asarray(x))
+
+        def compute(self):
+            from metrics_tpu.utilities.data import dim_zero_cat
+
+            return dim_zero_cat(self.x)
+
+    peer = jnp.asarray([7, 8, 9], jnp.int32)  # int data: placeholder must not promote it
+
+    def fake_gather(x, group=None):  # this rank is empty; the peer has data
+        return [x, peer]
+
+    c = CatMetric(dist_sync_fn=fake_gather)
+    out = np.asarray(c.compute())
+    np.testing.assert_array_equal(out, [7, 8, 9])
+    assert out.dtype == np.int32  # empty f32 placeholder was dropped, not cat'd
+
+
+def test_none_reduce_list_state_is_precat_before_gather():
+    """EVERY list state pre-concatenates to exactly one gather call
+    (reference metric.py:203-206) — regardless of its reduction. Ranks with
+    different batch counts would otherwise issue different numbers of
+    collectives and deadlock."""
+
+    class GatherOnly(DummyListMetric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self._reductions["x"] = None  # gather-only, like ROC states
+
+        def update(self, x):
+            self.x.append(jnp.asarray(x))
+
+        def compute(self):
+            return self.x
+
+    calls = []
+
+    def counting_gather(x, group=None):
+        calls.append(np.asarray(x))
+        return [x, x]
+
+    m = GatherOnly(dist_sync_fn=counting_gather)
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    m.update(jnp.asarray([4.0, 5.0, 6.0]))
+    synced = m.compute()
+    assert len(calls) == 1  # three batches, ONE gather
+    np.testing.assert_array_equal(calls[0], [1, 2, 3, 4, 5, 6])
+    assert len(synced) == 2  # one entry per simulated rank
+
+
 def test_forward_dist_sync_on_step_does_not_pollute_local_state():
     """Regression: the fused forward must merge the *local* batch state, not the
     world-reduced one, or epoch-end sync double-counts."""
