@@ -18,7 +18,19 @@ from metrics_tpu.functional.classification.accuracy import (
     _subset_accuracy_compute,
     _subset_accuracy_update,
 )
-from metrics_tpu.utilities.data import Array
+from metrics_tpu.utilities.data import Array, _is_traced
+from metrics_tpu.utilities.enums import DataType
+
+#: mode <-> synced-code mapping for the ``mode_code`` state (0 = unset; the
+#: order is arbitrary but frozen — the max-reduction just needs "any seen
+#: mode beats unset")
+_MODE_CODES = (
+    None,
+    DataType.BINARY,
+    DataType.MULTILABEL,
+    DataType.MULTICLASS,
+    DataType.MULTIDIM_MULTICLASS,
+)
 
 
 class Accuracy(StatScores):
@@ -109,6 +121,13 @@ class Accuracy(StatScores):
 
         self.add_state("correct", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        # The data mode steers compute()'s formula (binary/multilabel micro is
+        # (tp+tn)/all, multiclass is tp/(tp+fn)) but is only learned at
+        # update() — a rank that never updated would silently take the wrong
+        # branch on the SYNCED global counts and disagree with its peers. A
+        # max-reduced code state makes the mode travel with the sync
+        # (non-persistent: checkpoints keep reference key parity).
+        self.add_state("mode_code", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="max")
 
         if top_k is not None and (not isinstance(top_k, int) or top_k <= 0):
             raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
@@ -120,6 +139,12 @@ class Accuracy(StatScores):
         self.mode = None
         self.multiclass = multiclass
 
+    def persistent(self, mode: bool = True) -> None:
+        """Flip state persistence; ``mode_code`` stays out of checkpoints
+        (sync bookkeeping, not a reference state — key parity)."""
+        super().persistent(mode)
+        self._persistent["mode_code"] = False
+
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate accuracy statistics from a batch."""
         preds, target = jnp.asarray(preds), jnp.asarray(target)
@@ -129,6 +154,7 @@ class Accuracy(StatScores):
             self.mode = mode
         elif self.mode != mode:
             raise ValueError(f"You can not use {mode} inputs with {self.mode} inputs.")
+        self.mode_code = jnp.maximum(self.mode_code, _MODE_CODES.index(mode))
 
         if self.subset_accuracy and not _check_subset_validity(self.mode):
             self.subset_accuracy = False
@@ -153,9 +179,21 @@ class Accuracy(StatScores):
 
             self._accumulate(tp, fp, tn, fn)
 
+    def _effective_mode(self):
+        """The data mode for compute(): locally learned, or — when this rank
+        never updated — decoded from the synced ``mode_code`` (concrete on
+        the eager path; under tracing the local trace's update set
+        ``self.mode``)."""
+        if self.mode is not None:
+            return self.mode
+        code = self.mode_code
+        if _is_traced(code):
+            return self.mode
+        return _MODE_CODES[int(jnp.max(jnp.atleast_1d(code)))]
+
     def compute(self) -> Array:
         """Accuracy over everything seen so far."""
         if self.subset_accuracy:
             return _subset_accuracy_compute(self.correct, self.total)
         tp, fp, tn, fn = self._get_final_stats()
-        return _accuracy_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce, self.mode)
+        return _accuracy_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce, self._effective_mode())

@@ -209,15 +209,20 @@ _FOUR_PROC_WORKER = textwrap.dedent(
     bin_probs = rng.rand(NB, B).astype(np.float32)
     bin_target = rng.randint(0, 2, (NB, B))
 
-    acc = Accuracy()   # scalar sum states: 4-way psum
-    auroc = AUROC()    # list cat states: ragged 4-way gather
+    acc = Accuracy()       # scalar sum states: 4-way psum
+    auroc = AUROC()        # list cat states: ragged 4-way gather
+    bin_acc = Accuracy()   # binary data + an empty rank: the mode must SYNC
     for i in range(rank, NB, 4):
         acc.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
-        # rank 3 contributes NOTHING to the curve metric: its gather leg is
-        # a 0-length tensor (the reference pins this case,
-        # tests/bases/test_ddp.py:63-81 with `torch.ones(rank)`)
+        # rank 3 contributes NOTHING to these: its curve gather leg is a
+        # 0-length tensor (the reference pins this case,
+        # tests/bases/test_ddp.py:63-81 with `torch.ones(rank)`), and its
+        # binary Accuracy must learn the data mode from the synced
+        # mode_code or it would compute tp/(tp+fn) instead of
+        # (tp+tn)/all on the global counts and disagree with its peers
         if rank != 3:
             auroc.update(jnp.asarray(bin_probs[i]), jnp.asarray(bin_target[i]))
+            bin_acc.update(jnp.asarray(bin_probs[i]), jnp.asarray(bin_target[i]))
 
     got_acc = float(acc.compute())
     want_acc = accuracy_score(target.reshape(-1), probs.argmax(-1).reshape(-1))
@@ -229,6 +234,12 @@ _FOUR_PROC_WORKER = textwrap.dedent(
         bin_target[seen].reshape(-1), bin_probs[seen].reshape(-1)
     )
     np.testing.assert_allclose(got_auroc, want_auroc, atol=1e-6)
+
+    got_bin_acc = float(bin_acc.compute())
+    want_bin_acc = accuracy_score(
+        bin_target[seen].reshape(-1), (bin_probs[seen] >= 0.5).reshape(-1)
+    )
+    np.testing.assert_allclose(got_bin_acc, want_bin_acc, atol=1e-6)
 
     print(f"PARITY_OK rank={rank}", flush=True)
     """
