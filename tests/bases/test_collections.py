@@ -337,3 +337,69 @@ def test_shared_update_eager_forward_dedup(monkeypatch):
         np.testing.assert_allclose(
             np.asarray(collection.compute()[key]), np.asarray(solo.compute()), atol=1e-7
         )
+
+
+def test_collection_eager_compute_aliases_class_sync():
+    """The eager epoch-boundary sync gathers each shared-update class ONCE:
+    P/R/F1 with identical settings ship one tp/fp/tn/fn quartet (4 gather
+    calls), not one per member (12) — and every member's value and local
+    state are unchanged by the aliasing."""
+    from metrics_tpu import F1, MetricCollection, Precision, Recall
+
+    calls = {"n": 0}
+
+    def fake_gather(x, group=None):  # simulate two identical ranks
+        calls["n"] += 1
+        return [x, x]
+
+    rng = np.random.RandomState(9)
+    preds = jnp.asarray(rng.rand(48, 3).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 3, 48))
+
+    members = dict(average="macro", num_classes=3, dist_sync_fn=fake_gather)
+    collection = MetricCollection([Precision(**members), Recall(**members), F1(**members)])
+    collection.update(preds, target)
+    values = collection.compute()
+    assert calls["n"] == 4, f"expected ONE quartet gather, saw {calls['n']} calls"
+
+    # values match a solo metric under the same 2-rank fake sync, and the
+    # local (unsynced) states were restored on every member
+    for cls, key in ((Precision, "Precision"), (Recall, "Recall"), (F1, "F1")):
+        solo = cls(average="macro", num_classes=3, dist_sync_fn=fake_gather)
+        solo.update(preds, target)
+        np.testing.assert_allclose(np.asarray(values[key]), np.asarray(solo.compute()), atol=1e-7)
+    for _, m in collection.items(keep_base=True):
+        assert m._to_sync is True
+        np.testing.assert_allclose(
+            np.asarray(m.tp), np.asarray(collection["Precision"].tp), atol=0
+        )
+
+
+def test_collection_eager_compute_alias_skips_mismatched_members():
+    """Members whose sync config differs (own dist_sync_fn) never adopt a
+    peer's synced state."""
+    from metrics_tpu import MetricCollection, Precision, Recall
+
+    doubling = lambda x, group=None: [x, x]  # noqa: E731
+    tripling = lambda x, group=None: [x, x, x]  # noqa: E731
+
+    rng = np.random.RandomState(10)
+    preds = jnp.asarray(rng.rand(32, 3).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 3, 32))
+
+    collection = MetricCollection(
+        [
+            Precision(average="macro", num_classes=3, dist_sync_fn=doubling),
+            Recall(average="macro", num_classes=3, dist_sync_fn=tripling),
+        ]
+    )
+    collection.update(preds, target)
+    values = collection.compute()
+    from metrics_tpu import Precision as P, Recall as R
+
+    solo_p = P(average="macro", num_classes=3, dist_sync_fn=doubling)
+    solo_r = R(average="macro", num_classes=3, dist_sync_fn=tripling)
+    solo_p.update(preds, target)
+    solo_r.update(preds, target)
+    np.testing.assert_allclose(np.asarray(values["Precision"]), np.asarray(solo_p.compute()), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(values["Recall"]), np.asarray(solo_r.compute()), atol=1e-7)
