@@ -1,0 +1,28 @@
+"""Packaging helpers used by ``setup.py`` (parity: ``torchmetrics/setup_tools.py``).
+
+Requirement files may carry inline comments and extra whitespace; loading
+through this helper keeps ``setup.py`` free of parsing logic.
+"""
+import os
+from typing import List
+
+_PROJECT_ROOT = os.path.dirname(os.path.dirname(__file__))
+
+
+def _load_requirements(path_dir: str, file_name: str = "requirements.txt", comment_char: str = "#") -> List[str]:
+    """Requirement specs from ``path_dir/file_name``, comments stripped.
+
+    >>> _load_requirements(_PROJECT_ROOT)  # doctest: +ELLIPSIS +NORMALIZE_WHITESPACE
+    ['numpy', 'jax...', 'packaging']
+    """
+    with open(os.path.join(path_dir, file_name)) as file:
+        lines = [ln.strip() for ln in file.readlines()]
+    reqs = []
+    for ln in lines:
+        if comment_char in ln:
+            ln = ln[: ln.index(comment_char)].strip()
+        if ln.startswith("http"):  # directly-installed dependencies
+            continue
+        if ln:
+            reqs.append(ln)
+    return reqs

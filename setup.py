@@ -1,9 +1,23 @@
 #!/usr/bin/env python
 import os
+from importlib.util import module_from_spec, spec_from_file_location
 
 from setuptools import find_packages, setup
 
 _PATH_ROOT = os.path.dirname(__file__)
+
+
+def _load_py_module(fname: str, pkg: str = "metrics_tpu"):
+    """Load a module by file path WITHOUT importing the package (which would
+    pull jax in at build time) — the reference's pattern (``setup.py:11``)."""
+    spec = spec_from_file_location(os.path.join(pkg, fname), os.path.join(_PATH_ROOT, pkg, fname))
+    module = module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+_setup_tools = _load_py_module("setup_tools.py")
+_load_requirements = _setup_tools._load_requirements
 
 
 def _load_about() -> dict:
@@ -22,9 +36,9 @@ setup(
     license=_about["__license__"],
     packages=find_packages(exclude=["tests", "tests.*"]),
     python_requires=">=3.9",
-    install_requires=[line.strip() for line in open(os.path.join(_PATH_ROOT, "requirements.txt"))],
+    install_requires=_load_requirements(_PATH_ROOT),
     extras_require={
-        name: [line.strip() for line in open(os.path.join(_PATH_ROOT, "requirements", f"{name}.txt"))]
+        name: _load_requirements(os.path.join(_PATH_ROOT, "requirements"), f"{name}.txt")
         for name in ("image", "test", "integrate")
     },
 )
