@@ -94,7 +94,9 @@ class PearsonCorrcoef(Metric):
             degenerate = (var_x <= eps * jnp.abs(self.sum_xx / n)) | (var_y <= eps * jnp.abs(self.sum_yy / n))
             denom = jnp.sqrt(jnp.clip(var_x, 0, None) * jnp.clip(var_y, 0, None))
             corr = jnp.where(degenerate, 0.0, cov / jnp.where(degenerate, 1.0, denom))
-            return jnp.clip(corr, -1.0, 1.0).astype(jnp.float32)
+            # keep the accumulation dtype: under x64 the buffered path
+            # returns f64 too, and the parity test pins ~1e-14 agreement
+            return jnp.clip(corr, -1.0, 1.0).astype(dtype)
 
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)

@@ -256,11 +256,23 @@ def test_pearson_streaming_matches_buffered():
     streaming = PearsonCorrcoef(streaming=True)
     buffered = PearsonCorrcoef()
     for _ in range(6):
-        p = jnp.asarray(rng.randn(40).astype(np.float32))
-        t = jnp.asarray((rng.randn(40) * 0.5 + np.asarray(p)).astype(np.float32))
+        p = jnp.asarray(rng.randn(40))  # f64 under x64 (on in this suite)
+        t = jnp.asarray(rng.randn(40) * 0.5 + np.asarray(p))
         streaming.update(p, t)
         buffered.update(p, t)
-    np.testing.assert_allclose(float(streaming.compute()), float(buffered.compute()), atol=1e-5)
+    # the moment sums are an EXACT reformulation: with both paths in f64
+    # they agree to rounding, not just a loose tolerance
+    np.testing.assert_allclose(float(streaming.compute()), float(buffered.compute()), atol=1e-13)
+
+    # f32 inputs: the buffered path computes in f32, streaming still
+    # accumulates f64 — agreement floors at f32 rounding
+    s32, b32 = PearsonCorrcoef(streaming=True), PearsonCorrcoef()
+    for _ in range(4):
+        p = jnp.asarray(rng.randn(40).astype(np.float32))
+        t = jnp.asarray((rng.randn(40) * 0.5 + np.asarray(p)).astype(np.float32))
+        s32.update(p, t)
+        b32.update(p, t)
+    np.testing.assert_allclose(float(s32.compute()), float(b32.compute()), atol=1e-6)
 
     # jit path: state structure must be step-invariant (single trace)
     metric = PearsonCorrcoef(streaming=True)
@@ -334,11 +346,24 @@ def test_cosine_streaming_matches_buffered():
         streaming = CosineSimilarity(reduction=reduction, streaming=True)
         buffered = CosineSimilarity(reduction=reduction)
         for _ in range(5):
-            p = jnp.asarray(rng.randn(16, 8).astype(np.float32))
-            t = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+            p = jnp.asarray(rng.randn(16, 8))  # f64 under x64 (on in this suite)
+            t = jnp.asarray(rng.randn(16, 8))
             streaming.update(p, t)
             buffered.update(p, t)
-        np.testing.assert_allclose(float(streaming.compute()), float(buffered.compute()), atol=1e-5)
+        # same per-row values summed in the same order: with both paths in
+        # f64 the running sum agrees to rounding
+        np.testing.assert_allclose(float(streaming.compute()), float(buffered.compute()), atol=1e-13)
+
+        # f32 inputs: buffered computes in f32, the running sum is f64 —
+        # agreement floors at f32 rounding
+        s32 = CosineSimilarity(reduction=reduction, streaming=True)
+        b32 = CosineSimilarity(reduction=reduction)
+        for _ in range(3):
+            p = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+            t = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+            s32.update(p, t)
+            b32.update(p, t)
+        np.testing.assert_allclose(float(s32.compute()), float(b32.compute()), atol=1e-5)
 
     with pytest.raises(ValueError, match="streaming"):
         CosineSimilarity(reduction="none", streaming=True)
