@@ -272,8 +272,33 @@ class MetricCollection:
         The batch-local states come from a single :meth:`apply_update` (so
         shared-update classes canonicalize once for the whole collection);
         each metric then merges its batch state into the accumulator the same
-        way :meth:`Metric.apply_forward` would."""
+        way :meth:`Metric.apply_forward` would. When members of a
+        shared-update class sync their on-step value
+        (``dist_sync_on_step=True`` over the same axis), the batch bundle is
+        synced ONCE and fanned out — the third sync path with class
+        aliasing, alongside :meth:`compute` and :meth:`apply_compute`."""
         batch_state = self.apply_update(self.init_state(), *args, **kwargs)
+
+        groups: Dict[Tuple, list] = {}
+        for name, m in self.items(keep_base=True):
+            key = m._shared_update_key()
+            if key is None or not m.dist_sync_on_step:
+                continue
+            axis = m.process_group if axis_name is AXIS_UNSET else axis_name
+            if axis is None:
+                continue
+            groups.setdefault((key, axis), []).append(name)
+        presynced: Dict[str, StateDict] = {}
+        for (_, axis), names in groups.items():
+            if len(names) < 2:
+                continue
+            rep = self._metrics[names[0]]
+            if any(self._metrics[n]._reductions != rep._reductions for n in names[1:]):
+                continue
+            synced = rep.sync_state(batch_state[names[0]], axis)
+            for n in names:
+                presynced[n] = synced
+
         new_state, values = {}, {}
         for name, m in self.items(keep_base=True):
             new_state[name], values[self._set_name(name)] = m.apply_forward(
@@ -281,6 +306,7 @@ class MetricCollection:
                 *args,
                 axis_name=axis_name,
                 batch_state=batch_state[name],
+                synced_batch_state=presynced.get(name),
                 **m._filter_kwargs(**kwargs),
             )
         return new_state, values

@@ -291,6 +291,7 @@ class Metric(ABC):
         *args: Any,
         axis_name: Any = AXIS_UNSET,
         batch_state: Optional[StateDict] = None,
+        synced_batch_state: Optional[StateDict] = None,
         **kwargs: Any,
     ) -> Tuple[StateDict, Any]:
         """Pure forward: ``(accumulated_state, batch_value)`` in one update pass.
@@ -301,15 +302,22 @@ class Metric(ABC):
         ``axis_name`` omitted defaults to ``self.process_group`` (see
         :meth:`apply_compute`). ``batch_state`` lets a caller
         (MetricCollection) supply the batch-local state from a shared update
-        pass instead of recomputing it here.
+        pass instead of recomputing it here; ``synced_batch_state``
+        additionally supplies the ALREADY-SYNCED batch bundle for the
+        on-step value (the collection syncs one bundle per shared-update
+        class) — the accumulator still merges the LOCAL ``batch_state``, or
+        cross-shard contributions would double-count at epoch sync.
         """
         if axis_name is AXIS_UNSET:
             axis_name = self.process_group
         if batch_state is None:
             batch_state = self.apply_update(self.init_state(), *args, **kwargs)
-        value = self.apply_compute(
-            batch_state, axis_name=axis_name if (self.dist_sync_on_step and axis_name is not None) else None
-        )
+        if synced_batch_state is not None and self.dist_sync_on_step:
+            value = self.apply_compute(synced_batch_state, axis_name=None)
+        else:
+            value = self.apply_compute(
+                batch_state, axis_name=axis_name if (self.dist_sync_on_step and axis_name is not None) else None
+            )
         if self._states_mergeable():
             new_state = self.merge_states(state, batch_state)
         else:

@@ -138,6 +138,38 @@ def test_sync_values_match_sequential_after_combining():
         np.testing.assert_allclose(values[key], expected[key], atol=1e-6, err_msg=key)
 
 
+def test_forward_on_step_sync_aliases_class_bundle():
+    """apply_forward with dist_sync_on_step: a shared-update class syncs ONE
+    batch bundle for the on-step values (4 all-reduce operand arrays for
+    P/R/F1, not 12), and the values equal the unsharded oracle."""
+    from metrics_tpu import F1, Precision, Recall
+
+    members = dict(average="macro", num_classes=NC, dist_sync_on_step=True)
+    coll = MetricCollection([Precision(**members), Recall(**members), F1(**members)])
+    rng = np.random.RandomState(3)
+    preds = jnp.asarray(rng.rand(64, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, 64))
+
+    mesh = _mesh()
+
+    def fwd(p, t):
+        _, values = coll.apply_forward(coll.init_state(), p, t, axis_name="data")
+        return values
+
+    fn = jax.jit(
+        jax.shard_map(fwd, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+    )
+    compiled = fn.lower(preds, target).compile().as_text()
+    operands = _allreduce_operand_count(compiled)
+    assert operands <= 4, f"on-step sync ships {operands} arrays; class aliasing regressed"
+
+    values = jax.tree.map(np.asarray, fn(preds, target))
+    seq_state = coll.apply_update(coll.init_state(), preds, target)
+    expected = jax.tree.map(np.asarray, coll.apply_compute(seq_state))
+    for key in expected:
+        np.testing.assert_allclose(values[key], expected[key], atol=1e-6, err_msg=key)
+
+
 def test_capacity_auroc_sync_is_bounded():
     """A cat-capacity state syncs with a bounded number of all-gathers
     (buffer + counter), not one per accumulated batch."""
