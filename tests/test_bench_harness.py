@@ -95,3 +95,16 @@ def test_every_config_has_meta_and_resolves():
     for cfg in bench_suite.CONFIGS:
         assert cfg.__name__ in bench_suite.CONFIG_META
         assert getattr(bench_suite, cfg.__name__) is cfg
+
+
+def test_measure_single_attempt_after_total_deadline(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        bench, "_run_config_subprocess", lambda n, t: calls.append(n) or _line(1400.0)
+    )
+    import time
+
+    monkeypatch.setattr(bench, "_START", time.monotonic() - bench.TOTAL_DEADLINE_S - 1)
+    out = bench._measure("bench_x", ("m", "us/step"))
+    # degraded line, but no retries once the capture's total budget is spent
+    assert len(calls) == 1 and out["degraded"] is True
