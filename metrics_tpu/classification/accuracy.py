@@ -139,9 +139,10 @@ class Accuracy(StatScores):
         self.mode = None
         self.multiclass = multiclass
 
-    def persistent(self, mode: bool = True) -> None:
-        """Flip state persistence; ``mode_code`` stays out of checkpoints
-        (sync bookkeeping, not a reference state — key parity)."""
+    def persistent(self, mode: bool = False) -> None:
+        """Flip state persistence (same default as :meth:`Metric.persistent`);
+        ``mode_code`` stays out of checkpoints (sync bookkeeping, not a
+        reference state — key parity)."""
         super().persistent(mode)
         self._persistent["mode_code"] = False
 

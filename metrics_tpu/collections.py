@@ -122,8 +122,11 @@ class MetricCollection:
         the duration of the compute — A+P+R+F1 gathers one tp/fp/tn/fn
         quartet instead of three extra copies. Restores every member's local
         state and sync flag afterwards."""
-        adopted = self._adopt_class_synced_states()
+        adopted: list = []
         try:
+            # adoption runs INSIDE the try so a failure while syncing a later
+            # class still restores members already pointed at synced states
+            self._adopt_class_synced_states(adopted)
             return {k: m.compute() for k, m in self.items()}
         finally:
             for m, cache, prev_to_sync in adopted:
@@ -131,9 +134,10 @@ class MetricCollection:
                     m._set_states(cache)
                 m._to_sync = prev_to_sync
 
-    def _adopt_class_synced_states(self):
+    def _adopt_class_synced_states(self, adopted: list) -> None:
         """Sync one representative per shared-update class and point the
-        members at the synced values; returns restore records. No-op (empty)
+        members at the synced values; appends restore records to ``adopted``
+        AS THEY HAPPEN (so a mid-way failure is fully restorable). No-op
         when not distributed — each member then syncs (trivially) itself."""
         groups: Dict[Tuple, list] = {}
         for name, m in self.items(keep_base=True):
@@ -141,7 +145,6 @@ class MetricCollection:
             if key is not None:
                 groups.setdefault(key, []).append(name)
 
-        adopted = []
         for names in groups.values():
             if len(names) < 2:
                 continue
@@ -165,7 +168,6 @@ class MetricCollection:
                 # fresh list shells so no member can mutate a shared one
                 m._set_states({k: (list(v) if isinstance(v, list) else v) for k, v in synced.items()})
                 m._to_sync = False
-        return adopted
 
     def reset(self) -> None:
         for _, m in self.items(keep_base=True):

@@ -180,10 +180,13 @@ def resolve_sqrtm_method(n_min, d: int, method: str = "auto") -> str:
 
 def _streaming_mean_cov(n: Array, feat_sum: Array, outer_sum: Array) -> Tuple[Array, Array]:
     """Mean + unbiased covariance from the linear streaming moments:
-    ``Σ(x-μ)(x-μ)ᵀ = Σxxᵀ − n·μμᵀ``."""
-    nf = jnp.maximum(n, 2).astype(feat_sum.dtype)
+    ``Σ(x-μ)(x-μ)ᵀ = Σxxᵀ − n·μμᵀ``. The mean divides by the TRUE count
+    (clamped only against 0); only the Bessel denominator clamps at 1 so a
+    single-sample side yields the correct mean with a zero covariance
+    instead of a silently halved mean."""
+    nf = jnp.maximum(n, 1).astype(feat_sum.dtype)
     mean = feat_sum / nf
-    cov = (outer_sum - nf * jnp.outer(mean, mean)) / (nf - 1)
+    cov = (outer_sum - nf * jnp.outer(mean, mean)) / jnp.maximum(nf - 1, 1)
     return mean, cov
 
 
@@ -301,6 +304,14 @@ class FID(Metric):
     def compute(self) -> Array:
         """FID over all accumulated real/fake features."""
         if self.streaming:
+            n_min = jnp.minimum(self.real_n, self.fake_n)
+            if not _is_traced(jnp.asarray(n_min)) and int(jnp.max(jnp.atleast_1d(jnp.asarray(n_min)))) == 0:
+                # match the buffered path's loud failure on an empty side
+                # instead of returning a finite-but-bogus zero-moment FID
+                raise ValueError(
+                    "FID(streaming=True): at least one update per side (real and"
+                    " fake) is required before compute()"
+                )
             mean1, cov1 = _streaming_mean_cov(self.real_n, self.real_sum, self.real_outer)
             mean2, cov2 = _streaming_mean_cov(self.fake_n, self.fake_sum, self.fake_outer)
             method = self._resolve_method(jnp.minimum(self.real_n, self.fake_n), cov1.shape[0])

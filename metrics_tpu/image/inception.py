@@ -112,7 +112,7 @@ class IS(Metric):
             from metrics_tpu.utilities.capped_buffer import feature_buffer_read
 
             features = feature_buffer_read(
-                self.features_buf, self.count, self.capacity, type(self).__name__
+                self.features_buf, self.count, self.capacity, self._buf_slack, type(self).__name__
             )
         else:
             features = dim_zero_cat(self.features)

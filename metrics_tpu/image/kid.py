@@ -175,8 +175,8 @@ class KID(Metric):
 
             owner = f"{type(self).__name__}"
             return (
-                feature_buffer_read(self.real_buf, self.real_count, self.capacity, owner),
-                feature_buffer_read(self.fake_buf, self.fake_count, self.capacity, owner),
+                feature_buffer_read(self.real_buf, self.real_count, self.capacity, self._buf_slack, owner),
+                feature_buffer_read(self.fake_buf, self.fake_count, self.capacity, self._buf_slack, owner),
             )
         return dim_zero_cat(self.real_features), dim_zero_cat(self.fake_features)
 

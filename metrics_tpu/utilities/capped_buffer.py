@@ -76,20 +76,51 @@ def feature_buffer_write(
     return buf, count + n
 
 
-def feature_buffer_read(buf, count, capacity: int, owner: str = "metric") -> Array:
+def feature_buffer_read(buf, count, capacity: int, slack: int, owner: str = "metric") -> Array:
     """Valid rows across however many shards the sync produced — eager only
     (the row count is data-dependent; feature metrics compute at epoch end
     on the host boundary, like the reference). Warns when rows were dropped
-    past capacity."""
+    past capacity.
+
+    Accepts every state form the sync paths produce: the local 2-D
+    ``(capacity+slack, d)`` buffer with a scalar count, the eager
+    multi-process sync's stacked ``(world, capacity+slack, d)`` buffer with
+    a ``(world,)`` count vector, a row-concatenated
+    ``(world·(capacity+slack), d)`` form (tiled in-graph all_gather), and
+    list-of-shards variants.
+    """
+    import numpy as np
+
     bufs = buf if isinstance(buf, list) else [buf]
-    counts = count if isinstance(count, list) else [count]
-    if any(_is_traced(c) for c in counts) or any(_is_traced(b) for b in bufs):
+    raw_counts = count if isinstance(count, list) else [count]
+    if any(_is_traced(c) for c in raw_counts) or any(_is_traced(b) for b in bufs):
         raise NotImplementedError(
             f"{owner}: `capacity` mode computes on concrete (non-traced) state —"
             " the valid-row count is data-dependent. Call compute()/apply_compute"
             " outside jit (the fixed-shape part is the update path)."
         )
-    dropped = sum(max(int(c) - capacity, 0) for c in counts)
+    counts = [int(c) for c in np.concatenate([np.atleast_1d(np.asarray(c)) for c in raw_counts])]
+    rows_per_shard = capacity + slack
+    # split multi-shard buffers back into (rows_per_shard, d) shards
+    shards = []
+    for b in bufs:
+        b = jnp.asarray(b)
+        if b.ndim == 3 and b.shape[1] == rows_per_shard:  # stacked (world, rows, d)
+            shards.extend(b)
+        elif b.ndim == 2 and b.shape[0] == rows_per_shard:
+            shards.append(b)
+        elif b.ndim == 2 and b.shape[0] % rows_per_shard == 0:  # row-concatenated
+            shards.extend(b.reshape(-1, rows_per_shard, b.shape[-1]))
+        else:
+            raise ValueError(
+                f"{owner}: synced buffer shape {b.shape} does not decompose"
+                f" into (capacity+slack={rows_per_shard}, dim) shards"
+            )
+    if len(shards) != len(counts):
+        raise ValueError(
+            f"{owner}: {len(shards)} buffer shard(s) but {len(counts)} count(s) after sync"
+        )
+    dropped = sum(max(c - capacity, 0) for c in counts)
     if dropped > 0:
         rank_zero_warn(
             f"{owner}(capacity={capacity}) dropped {dropped} feature rows past"
@@ -97,7 +128,7 @@ def feature_buffer_read(buf, count, capacity: int, owner: str = "metric") -> Arr
             " `capacity` rows per shard.",
             UserWarning,
         )
-    valid = [b[: min(int(c), capacity)] for b, c in zip(bufs, counts)]
+    valid = [b[: min(c, capacity)] for b, c in zip(shards, counts)]
     return jnp.concatenate(valid, axis=0)
 
 

@@ -89,3 +89,45 @@ def test_buffer_write_under_jit_and_scan():
     np.testing.assert_allclose(rows[:, 0], np.asarray(ps).reshape(-1)[:cap])
     np.testing.assert_allclose(rows[:, 1], np.asarray(ts).reshape(-1)[:cap])
     assert int(state["count"]) == 30
+
+
+def test_feature_buffer_read_handles_post_sync_multi_shard_state():
+    """The eager multi-process sync concatenates the 'cat'-reduced buffer
+    rows across ranks and stacks the counts to (world,) — read must split
+    the shards back apart and take each shard's valid prefix (regression:
+    it crashed on the (world,) count)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu.utilities.capped_buffer import (
+        feature_buffer_read,
+        feature_buffer_write,
+        init_feature_buffer,
+    )
+
+    capacity, dim = 8, 3
+    buf0, slack = init_feature_buffer(capacity, dim)
+    buf1, _ = init_feature_buffer(capacity, dim)
+    rows0 = jnp.arange(5 * dim, dtype=jnp.float32).reshape(5, dim)
+    rows1 = 100 + jnp.arange(2 * dim, dtype=jnp.float32).reshape(2, dim)
+    buf0, count0 = feature_buffer_write(buf0, jnp.zeros((), jnp.int32), rows0, capacity, slack)
+    buf1, count1 = feature_buffer_write(buf1, jnp.zeros((), jnp.int32), rows1, capacity, slack)
+
+    # the shapes Metric._sync_dist produces for tensor 'cat' states
+    synced_buf = jnp.stack([buf0, buf1])                    # (world, cap+slack, d)
+    synced_count = jnp.stack([count0, count1])              # (world,)
+    got = feature_buffer_read(synced_buf, synced_count, capacity, slack, "T")
+    np.testing.assert_array_equal(np.asarray(got), np.concatenate([rows0, rows1]))
+
+    # the tiled in-graph all_gather form (row-concatenated)
+    tiled = jnp.concatenate([buf0, buf1], axis=0)           # (world*(cap+slack), d)
+    got_tiled = feature_buffer_read(tiled, synced_count, capacity, slack, "T")
+    np.testing.assert_array_equal(np.asarray(got_tiled), np.concatenate([rows0, rows1]))
+
+    # the list form (fake dist_sync_fn returning per-rank results)
+    got_list = feature_buffer_read([buf0, buf1], [count0, count1], capacity, slack, "T")
+    np.testing.assert_array_equal(np.asarray(got_list), np.concatenate([rows0, rows1]))
+
+    # local single-shard form is unchanged
+    got_local = feature_buffer_read(buf0, count0, capacity, slack, "T")
+    np.testing.assert_array_equal(np.asarray(got_local), np.asarray(rows0))

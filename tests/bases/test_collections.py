@@ -403,3 +403,51 @@ def test_collection_eager_compute_alias_skips_mismatched_members():
     solo_r.update(preds, target)
     np.testing.assert_allclose(np.asarray(values["Precision"]), np.asarray(solo_p.compute()), atol=1e-7)
     np.testing.assert_allclose(np.asarray(values["Recall"]), np.asarray(solo_r.compute()), atol=1e-7)
+
+
+def test_collection_eager_alias_rolls_back_on_sync_failure():
+    """A failure while adopting a LATER class must restore members of the
+    classes adopted before it (states and sync flags) — otherwise they hold
+    world-aggregated states and silently skip every future sync."""
+    from metrics_tpu import CohenKappa, ConfusionMatrix, MetricCollection, Precision, Recall
+
+    def fake_gather(x, group=None):
+        return [x, x]
+
+    def raising_gather(x, group=None):
+        raise RuntimeError("link down")
+
+    rng = np.random.RandomState(11)
+    preds = jnp.asarray(rng.rand(32, 3).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 3, 32))
+
+    # class 1 (stat-scores: P/R) syncs fine; class 2 (confmat family) raises
+    collection = MetricCollection(
+        [
+            Precision(average="macro", num_classes=3, dist_sync_fn=fake_gather),
+            Recall(average="macro", num_classes=3, dist_sync_fn=fake_gather),
+            ConfusionMatrix(num_classes=3, dist_sync_fn=raising_gather),
+            CohenKappa(num_classes=3, dist_sync_fn=raising_gather),
+        ]
+    )
+    collection.update(preds, target)
+    before_tp = np.asarray(collection["Precision"].tp).copy()
+    with pytest.raises(RuntimeError, match="link down"):
+        collection.compute()
+    for name in ("Precision", "Recall"):
+        m = collection[name]
+        assert m._to_sync is True, name
+        np.testing.assert_array_equal(np.asarray(m.tp), before_tp, err_msg=name)
+
+
+def test_accuracy_persistent_default_matches_base():
+    """persistent() with no argument means 'non-persistent' on every metric
+    (the base default); Accuracy's override must not invert it."""
+    import inspect
+
+    from metrics_tpu import Accuracy
+    from metrics_tpu.metric import Metric
+
+    base_default = inspect.signature(Metric.persistent).parameters["mode"].default
+    acc_default = inspect.signature(Accuracy.persistent).parameters["mode"].default
+    assert acc_default == base_default

@@ -56,6 +56,29 @@ _WORKER = textwrap.dedent(
     want_auroc = roc_auc_score(bin_target.reshape(-1), bin_probs.reshape(-1))
     np.testing.assert_allclose(got_auroc, want_auroc, atol=1e-6)
 
+    # capacity feature buffer ('cat'-reduced tensor states): the synced
+    # buffer is the row-concatenation across ranks with a (world,) count
+    # vector; compute must split shards and take each valid prefix
+    from metrics_tpu import IS
+    logits_fn = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :5]
+    cap_is = IS(feature=logits_fn, splits=2, capacity=64, feature_dim=5)
+    imgs = rng.rand(NB, 6, 3, 5, 4).astype(np.float32)
+    for i in range(rank, NB, 2):
+        cap_is.update(jnp.asarray(imgs[i]))
+    got_mean, got_std = (float(v) for v in cap_is.compute())
+    # oracle: fed rank0's batches then rank1's (the gather's shard order),
+    # with a local-only gather so IT doesn't sync across the live runtime
+    oracle = IS(
+        feature=logits_fn, splits=2, capacity=64, feature_dim=5,
+        dist_sync_fn=lambda x, group=None: [x],
+    )
+    for r in range(2):
+        for i in range(r, NB, 2):
+            oracle.update(jnp.asarray(imgs[i]))
+    want_mean, want_std = (float(v) for v in oracle.compute())
+    np.testing.assert_allclose(got_mean, want_mean, atol=1e-6)
+    np.testing.assert_allclose(got_std, want_std, atol=1e-6)
+
     # synced-on-save checkpoint semantics: state_dict holds the GLOBAL
     # (rank-aggregated) values while live local state is restored afterwards
     acc2 = Accuracy()  # micro mode: `tp` counts exact matches

@@ -268,6 +268,24 @@ class TestFIDStreaming:
         FID(feature=_flat_features, streaming=True, feature_dim=16)
         assert not any("footprint" in str(w.message) for w in recwarn.list)
 
+    def test_streaming_single_sample_mean_is_exact(self):
+        """Only the Bessel denominator clamps; a 1-sample side must keep the
+        TRUE mean (regression: a max(n,2) clamp silently halved it)."""
+        from metrics_tpu.image.fid import _streaming_mean_cov
+
+        feats = jnp.asarray([[2.0, 4.0, 6.0]])
+        mean, cov = _streaming_mean_cov(
+            jnp.asarray(1), feats.sum(0), feats.T @ feats
+        )
+        np.testing.assert_allclose(np.asarray(mean), [2.0, 4.0, 6.0])
+        np.testing.assert_allclose(np.asarray(cov), 0.0, atol=1e-6)
+
+    def test_streaming_empty_side_raises(self):
+        fid = FID(feature=_flat_features, streaming=True, feature_dim=16)
+        fid.update(jnp.ones((4, 3, 6, 6)), real=True)  # fake side empty
+        with pytest.raises(ValueError, match="at least one update per side"):
+            fid.compute()
+
 
 class TestKIDCapacity:
     def test_capacity_matches_buffered(self):
