@@ -148,6 +148,8 @@ class MetricCollection:
         for names in groups.values():
             if len(names) < 2:
                 continue
+            if all(self._metrics[n]._computed is not None for n in names):
+                continue  # every member returns its cached value; don't re-gather
             rep = self._metrics[names[0]]
             if any(
                 self._metrics[n]._reductions != rep._reductions

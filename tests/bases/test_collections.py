@@ -405,6 +405,31 @@ def test_collection_eager_compute_alias_skips_mismatched_members():
     np.testing.assert_allclose(np.asarray(values["Recall"]), np.asarray(solo_r.compute()), atol=1e-7)
 
 
+def test_collection_eager_alias_skips_gather_when_values_cached():
+    """compute() twice without an update in between: the second call serves
+    every member's cached value and must not re-gather the class bundle."""
+    from metrics_tpu import F1, MetricCollection, Precision, Recall
+
+    calls = {"n": 0}
+
+    def fake_gather(x, group=None):
+        calls["n"] += 1
+        return [x, x]
+
+    rng = np.random.RandomState(12)
+    preds = jnp.asarray(rng.rand(32, 3).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 3, 32))
+    members = dict(average="macro", num_classes=3, dist_sync_fn=fake_gather)
+    collection = MetricCollection([Precision(**members), Recall(**members), F1(**members)])
+    collection.update(preds, target)
+    first = collection.compute()
+    after_first = calls["n"]
+    second = collection.compute()
+    assert calls["n"] == after_first, "cached compute must not re-gather"
+    for key in first:
+        np.testing.assert_array_equal(np.asarray(first[key]), np.asarray(second[key]))
+
+
 def test_collection_eager_alias_rolls_back_on_sync_failure():
     """A failure while adopting a LATER class must restore members of the
     classes adopted before it (states and sync flags) — otherwise they hold
