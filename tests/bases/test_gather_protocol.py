@@ -1,0 +1,153 @@
+"""Unit-level simulation of the ragged gather protocol.
+
+``gather_all_arrays`` normally needs real ``jax.distributed`` processes
+(covered end-to-end in ``test_multiprocess.py``); here the collective layer
+is simulated with N threads exchanging data at a barrier, which makes every
+edge of the descriptor protocol — empty ranks, ndim/dtype alignment, error
+paths, random-shape fuzz — testable in-process in milliseconds.
+"""
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.utilities.distributed as dist_mod
+from metrics_tpu.utilities.distributed import gather_all_arrays
+
+
+def run_ranks(locals_per_rank):
+    """Run ``gather_all_arrays`` on N simulated ranks; returns per-rank results.
+
+    Each rank runs in its own thread; a barrier-backed fake
+    ``_process_allgather`` collects every rank's argument and hands back the
+    stacked exchange — the protocol's real data flow, without processes.
+    """
+    nprocs = len(locals_per_rank)
+    barrier = threading.Barrier(nprocs)
+    exchange = {}
+    lock = threading.Lock()
+    rank_of_thread = {}
+    generation = [0]
+
+    def fake_allgather(x):
+        rank = rank_of_thread[threading.get_ident()]
+        with lock:
+            exchange[rank] = np.asarray(x)
+        barrier.wait()
+        stacked = np.stack([exchange[r] for r in range(nprocs)])
+        barrier.wait()  # everyone has read before the next exchange reuses the dict
+        return stacked
+
+    results = [None] * nprocs
+    errors = [None] * nprocs
+
+    def worker(rank):
+        rank_of_thread[threading.get_ident()] = rank
+        try:
+            results[rank] = gather_all_arrays(jnp.asarray(locals_per_rank[rank]))
+        except Exception as err:  # surfaced to the test
+            errors[rank] = err
+            # release peers blocked on the barrier
+            barrier.abort()
+
+    # patch the module's collective + distributed detection for the call
+    orig = (dist_mod._process_allgather, dist_mod.distributed_available, dist_mod.world_size, dist_mod.jax.process_index)
+    dist_mod._process_allgather = fake_allgather
+    dist_mod.distributed_available = lambda: True
+    dist_mod.world_size = lambda: nprocs
+    dist_mod.jax.process_index = lambda: rank_of_thread[threading.get_ident()]
+    try:
+        threads = [threading.Thread(target=worker, args=(r,)) for r in range(nprocs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        (dist_mod._process_allgather, dist_mod.distributed_available, dist_mod.world_size, dist_mod.jax.process_index) = orig
+    return results, errors
+
+
+def test_equal_shapes_round_trip():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = a + 10
+    results, errors = run_ranks([a, b])
+    assert errors == [None, None]
+    for res in results:
+        np.testing.assert_array_equal(np.asarray(res[0]), a)
+        np.testing.assert_array_equal(np.asarray(res[1]), b)
+
+
+def test_ragged_rows_pad_and_trim():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    b = np.arange(6, dtype=np.float32).reshape(2, 3) + 100
+    results, errors = run_ranks([a, b])
+    assert errors == [None, None]
+    for res in results:
+        assert [r.shape for r in res] == [(4, 3), (2, 3)]
+        np.testing.assert_array_equal(np.asarray(res[1]), b)
+
+
+def test_empty_rank_aligns_ndim_and_dtype():
+    # rank 1 never updated: 1-D f32 placeholder vs the peers' (N, 3) int64
+    a = np.arange(9, dtype=np.int64).reshape(3, 3)
+    placeholder = np.zeros((0,), np.float32)
+    results, errors = run_ranks([a, placeholder])
+    assert errors == [None, None]
+    for res in results:
+        np.testing.assert_array_equal(np.asarray(res[0]), a)
+        assert res[1].shape == (0, 3) and res[1].dtype == a.dtype
+
+
+def test_all_ranks_empty():
+    results, errors = run_ranks([np.zeros((0,), np.float32)] * 3)
+    assert errors == [None, None, None]
+    for res in results:
+        assert all(r.shape[0] == 0 for r in res)
+
+
+def test_ndim_mismatch_with_data_raises():
+    a = np.ones((4, 3), np.float32)
+    b = np.ones((4,), np.float32)  # non-empty, different rank: real incompatibility
+    _, errors = run_ranks([a, b])
+    assert any(isinstance(e, ValueError) and "different ranks" in str(e) for e in errors if e)
+
+
+def test_dtype_mismatch_with_data_raises():
+    a = np.ones((4, 3), np.float32)
+    b = np.ones((4, 3), np.int32)
+    _, errors = run_ranks([a, b])
+    assert any(isinstance(e, ValueError) and "dtypes" in str(e) for e in errors if e)
+
+
+def test_scalar_fast_path():
+    results, errors = run_ranks([np.float32(1.5), np.float32(2.5)])
+    assert errors == [None, None]
+    for res in results:
+        assert [float(r) for r in res] == [1.5, 2.5]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_random_ragged_mixes(seed):
+    """Random per-rank row counts (including zero) over a shared trailing
+    shape: every rank must recover every rank's exact rows."""
+    rng = np.random.RandomState(seed)
+    nprocs = int(rng.randint(2, 5))
+    trailing = tuple(rng.randint(1, 4, size=rng.randint(0, 2)))
+    dtype = rng.choice([np.float32, np.int32, np.float64])
+    locals_ = []
+    for _ in range(nprocs):
+        rows = int(rng.randint(0, 5))
+        if rows == 0:
+            locals_.append(np.zeros((0,), np.float32))  # never-updated placeholder
+        else:
+            locals_.append((rng.rand(rows, *trailing) * 100).astype(dtype))
+    results, errors = run_ranks(locals_)
+    assert errors == [None] * nprocs, errors
+    for res in results:
+        for r, local in zip(res, locals_):
+            got = np.asarray(r)
+            if local.shape[0] == 0:
+                assert got.shape[0] == 0
+            else:
+                np.testing.assert_array_equal(got, local)
