@@ -37,6 +37,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 for _p in (REPO_ROOT, os.path.join(REPO_ROOT, "scripts")):
@@ -94,8 +95,6 @@ def _measure(name: str, meta) -> dict:
     degraded lines the one with the healthiest probe wins (closest to the
     truth, still flagged).
     """
-    import time
-
     timeout = TIMEOUT_FID_S if name == "bench_fid_compute" else TIMEOUT_S
     attempts = MAX_ATTEMPTS
     if _START is not None and time.monotonic() - _START > TOTAL_DEADLINE_S:
@@ -103,6 +102,9 @@ def _measure(name: str, meta) -> dict:
             f"# total bench deadline exceeded; {name} runs single-attempt", file=sys.stderr
         )
         attempts = 1
+    def worst_probe(ln):  # a mid-config sickening corrupts the slope too
+        return max(ln.get("probe_us") or 1e9, ln.get("probe_us_after") or 1e9)
+
     best = None
     for attempt in range(1, attempts + 1):
         line = _run_config_subprocess(name, timeout)
@@ -118,9 +120,6 @@ def _measure(name: str, meta) -> dict:
             + (" — retrying on a fresh tunnel session" if attempt < attempts else ""),
             file=sys.stderr,
         )
-        def worst_probe(ln):  # a mid-config sickening corrupts the slope too
-            return max(ln.get("probe_us") or 1e9, ln.get("probe_us_after") or 1e9)
-
         if best is None or worst_probe(line) < worst_probe(best):
             best = line
     if best is not None:
@@ -130,8 +129,6 @@ def _measure(name: str, meta) -> dict:
 
 
 def main() -> None:
-    import time
-
     import bench_suite
 
     global _START

@@ -88,6 +88,15 @@ class MetricCollection:
             else:
                 m.update(*args, **m._filter_kwargs(**kwargs))
 
+    def _class_groups(self) -> Dict[Tuple, list]:
+        """Member names per shared-update equivalence key (insertion order)."""
+        groups: Dict[Tuple, list] = {}
+        for name, m in self.items(keep_base=True):
+            key = m._shared_update_key()
+            if key is not None:
+                groups.setdefault(key, []).append(name)
+        return groups
+
     def _shared_deltas(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Per-batch partial statistics computed ONCE per equivalence class.
 
@@ -96,19 +105,14 @@ class MetricCollection:
         canonicalization + one tp/fp/tn/fn pass instead of one each — the
         collection-level fusion the reference leaves on the table (every
         member keeps private states, SURVEY §3.3)."""
-        groups: Dict[Tuple, list] = {}
-        for name, m in self.items(keep_base=True):
-            key = m._shared_update_key()
-            if key is not None:
-                groups.setdefault(key, []).append((name, m))
         deltas: Dict[str, Any] = {}
-        for members in groups.values():
-            if len(members) < 2:
+        for names in self._class_groups().values():
+            if len(names) < 2:
                 continue
-            rep = members[0][1]
+            rep = self._metrics[names[0]]
             with compiled_scope(f"{type(rep).__name__}.shared_update"):
                 value = rep._batch_deltas(*args, **rep._filter_kwargs(**kwargs))
-            for name, _ in members:
+            for name in names:
                 deltas[name] = value
         return deltas
 
@@ -139,13 +143,7 @@ class MetricCollection:
         members at the synced values; appends restore records to ``adopted``
         AS THEY HAPPEN (so a mid-way failure is fully restorable). No-op
         when not distributed — each member then syncs (trivially) itself."""
-        groups: Dict[Tuple, list] = {}
-        for name, m in self.items(keep_base=True):
-            key = m._shared_update_key()
-            if key is not None:
-                groups.setdefault(key, []).append(name)
-
-        for names in groups.values():
+        for names in self._class_groups().values():
             if len(names) < 2:
                 continue
             if all(self._metrics[n]._computed is not None for n in names):
@@ -236,14 +234,8 @@ class MetricCollection:
         the collection state contract — states come from this collection's
         ``init_state``/``apply_update`` chain; hand-divergent states for
         same-class members are outside it."""
-        groups: Dict[Tuple, list] = {}
-        for name, m in self.items(keep_base=True):
-            key = m._shared_update_key()
-            if key is not None:
-                groups.setdefault(key, []).append(name)
-
         presynced: Dict[str, StateDict] = {}
-        for names in groups.values():
+        for names in self._class_groups().values():
             if len(names) < 2:
                 continue
             rep = self._metrics[names[0]]
@@ -283,15 +275,16 @@ class MetricCollection:
         aliasing, alongside :meth:`compute` and :meth:`apply_compute`."""
         batch_state = self.apply_update(self.init_state(), *args, **kwargs)
 
+        # regroup by (class, resolved axis), keeping only on-step syncers
         groups: Dict[Tuple, list] = {}
-        for name, m in self.items(keep_base=True):
-            key = m._shared_update_key()
-            if key is None or not m.dist_sync_on_step:
-                continue
-            axis = m.process_group if axis_name is AXIS_UNSET else axis_name
-            if axis is None:
-                continue
-            groups.setdefault((key, axis), []).append(name)
+        for key, names in self._class_groups().items():
+            for name in names:
+                m = self._metrics[name]
+                if not m.dist_sync_on_step:
+                    continue
+                axis = m.process_group if axis_name is AXIS_UNSET else axis_name
+                if axis is not None:
+                    groups.setdefault((key, axis), []).append(name)
         presynced: Dict[str, StateDict] = {}
         for (_, axis), names in groups.items():
             if len(names) < 2:

@@ -148,17 +148,12 @@ def _mean_cov(features: Array) -> Tuple[Array, Array]:
 
 
 def _feature_dim_of(feature: Union[int, str, Callable], feature_dim: Optional[int]) -> int:
-    """Resolve the feature dimensionality for fixed-shape streaming states."""
-    if feature_dim is not None:
-        return int(feature_dim)
-    if isinstance(feature, int):
-        return feature
-    if feature == "logits_unbiased":
-        return 1008
-    raise ValueError(
-        "`streaming=True`/`capacity=` needs the feature dimensionality to size"
-        " fixed-shape states; pass `feature_dim=` when `feature` is a callable."
-    )
+    """Resolve the feature dimensionality for fixed-shape streaming states
+    (thin alias of :func:`metrics_tpu.image.inception_net.feature_dim_of`,
+    which owns the tap-width knowledge)."""
+    from metrics_tpu.image.inception_net import feature_dim_of
+
+    return feature_dim_of(feature, feature_dim)
 
 
 def resolve_sqrtm_method(n_min, d: int, method: str = "auto") -> str:

@@ -83,10 +83,10 @@ class IS(Metric):
 
         self.capacity = capacity
         if capacity is not None:
-            from metrics_tpu.image.fid import _feature_dim_of
+            from metrics_tpu.image.inception_net import feature_dim_of
             from metrics_tpu.utilities.capped_buffer import init_feature_buffer
 
-            d = _feature_dim_of(feature, feature_dim)
+            d = feature_dim_of(feature, feature_dim)
             self.feature_dim = d
             buf, self._buf_slack = init_feature_buffer(capacity, d)
             self.add_state("features_buf", buf, dist_reduce_fx="cat")

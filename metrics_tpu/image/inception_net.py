@@ -39,7 +39,30 @@ else:  # pragma: no cover - flax is baked into the target image
 
 VALID_FEATURE_TAPS = ("logits_unbiased", 64, 192, 768, 2048)
 
+#: feature width of the TF-compat logits tap
+_LOGITS_DIM = 1008
+
 _WEIGHTS_ENV_VAR = "METRICS_TPU_INCEPTION_WEIGHTS"
+
+
+def feature_dim_of(feature: Any, feature_dim: Optional[int] = None) -> int:
+    """Resolve a ``feature`` argument's output dimensionality.
+
+    Used by the fixed-shape metric modes (streaming FID moments, KID/IS
+    capacity buffers) to size their states: int taps name their own width,
+    the logits tap is ``_LOGITS_DIM`` wide, and callables must declare
+    ``feature_dim=`` explicitly.
+    """
+    if feature_dim is not None:
+        return int(feature_dim)
+    if isinstance(feature, int):
+        return feature
+    if feature == "logits_unbiased":
+        return _LOGITS_DIM
+    raise ValueError(
+        "`streaming=True`/`capacity=` needs the feature dimensionality to size"
+        " fixed-shape states; pass `feature_dim=` when `feature` is a callable."
+    )
 
 
 def _inception_weights_path() -> Optional[str]:

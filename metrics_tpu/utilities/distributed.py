@@ -183,7 +183,7 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
         shapes[i, :nd] = all_desc[i, 1 : 1 + nd]
     max_shape = shapes[nonempty].max(axis=0) if nonempty.any() else np.ones(ref_ndim, np.int64)
     for i in np.where(~nonempty)[0]:
-        shapes[i] = np.concatenate([[0], max_shape[1:]]) if ref_ndim else shapes[i]
+        shapes[i] = np.concatenate([[0], max_shape[1:]])  # 0 rows of the peers' trailing dims
 
     rank = jax.process_index()
     local = result.astype(target_dtype)

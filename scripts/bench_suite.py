@@ -84,6 +84,29 @@ _PROBE_DIM = 1024
 _PROBE_SHORT, _PROBE_LONG = 300, 1500
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _probe_epoch(steps: int):
+    """Jitted probe program, cached per length — the probe runs twice per
+    config and must not pay a fresh trace/compile-cache lookup each time."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def epoch(a):
+        def body(c, _):
+            c = jnp.dot(c, a, precision="float32")
+            # renormalize so the chain stays finite at any length
+            return c * jax.lax.rsqrt(jnp.mean(c * c) + 1e-9), None
+
+        c, _ = jax.lax.scan(body, a, None, length=steps)
+        return jnp.sum(c)
+
+    return epoch
+
+
 def probe_endpoint() -> dict:
     """Measure the bench endpoint's health: the two-length-slope cost of a
     fixed known-cost matmul-chain kernel (``probe_us``) plus the link's
@@ -111,20 +134,7 @@ def probe_endpoint() -> dict:
         float(ident(jnp.zeros(())))
         rtts.append(time.perf_counter() - t0)
 
-    def make_epoch(steps):
-        @jax.jit
-        def epoch(a):
-            def body(c, _):
-                c = jnp.dot(c, a, precision="float32")
-                # renormalize so the chain stays finite at any length
-                return c * jax.lax.rsqrt(jnp.mean(c * c) + 1e-9), None
-
-            c, _ = jax.lax.scan(body, a, None, length=steps)
-            return jnp.sum(c)
-
-        return epoch
-
-    e_short, e_long = make_epoch(_PROBE_SHORT), make_epoch(_PROBE_LONG)
+    e_short, e_long = _probe_epoch(_PROBE_SHORT), _probe_epoch(_PROBE_LONG)
     a = jax.random.normal(jax.random.PRNGKey(0), (_PROBE_DIM, _PROBE_DIM), jnp.float32)
 
     def run(epoch):

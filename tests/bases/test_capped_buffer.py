@@ -96,9 +96,6 @@ def test_feature_buffer_read_handles_post_sync_multi_shard_state():
     rows across ranks and stacks the counts to (world,) — read must split
     the shards back apart and take each shard's valid prefix (regression:
     it crashed on the (world,) count)."""
-    import jax.numpy as jnp
-    import numpy as np
-
     from metrics_tpu.utilities.capped_buffer import (
         feature_buffer_read,
         feature_buffer_write,
@@ -137,9 +134,6 @@ def test_feature_buffer_write_chunked_oversized_batch():
     """A batch larger than the slack zone appends in slack-sized chunks;
     the first `capacity` rows survive exactly and the counter keeps the
     true total."""
-    import jax.numpy as jnp
-    import numpy as np
-
     from metrics_tpu.utilities.capped_buffer import (
         feature_buffer_read,
         feature_buffer_write,
@@ -152,8 +146,6 @@ def test_feature_buffer_write_chunked_oversized_batch():
     rows = jnp.arange(11 * dim, dtype=jnp.float32).reshape(11, dim)  # > slack
     buf, count = feature_buffer_write(buf, jnp.zeros((), jnp.int32), rows, capacity, slack)
     assert int(count) == 11
-    import pytest
-
     with pytest.warns(UserWarning, match="dropped 7"):
         got = feature_buffer_read(buf, count, capacity, slack, "T")
     np.testing.assert_array_equal(np.asarray(got), np.asarray(rows[:capacity]))

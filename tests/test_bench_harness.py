@@ -11,7 +11,8 @@ import sys
 
 import pytest
 
-sys.path.insert(0, "scripts") if "scripts" not in sys.path else None
+if "scripts" not in sys.path:
+    sys.path.insert(0, "scripts")
 import bench  # noqa: E402
 import bench_suite  # noqa: E402
 
