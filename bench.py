@@ -106,9 +106,17 @@ def _measure(name: str, meta) -> dict:
         return max(ln.get("probe_us") or 1e9, ln.get("probe_us_after") or 1e9)
 
     best = None
+    misses = 0
     for attempt in range(1, attempts + 1):
         line = _run_config_subprocess(name, timeout)
-        if line is None:  # crash/timeout — a fresh process is the only retry lever
+        if line is None:
+            # crash/timeout: retry ONCE on a fresh process (a sick endpoint
+            # can crash or stall a config too), then stop — a
+            # deterministically-broken config must not burn attempts x
+            # timeout of the capture's total budget
+            misses += 1
+            if misses >= 2:
+                break
             continue
         if not line.get("degraded"):
             if attempt > 1:

@@ -309,8 +309,10 @@ class FID(Metric):
                 )
             mean1, cov1 = _streaming_mean_cov(self.real_n, self.real_sum, self.real_outer)
             mean2, cov2 = _streaming_mean_cov(self.fake_n, self.fake_sum, self.fake_outer)
-            method = self._resolve_method(jnp.minimum(self.real_n, self.fake_n), cov1.shape[0])
-            return _compute_fid(mean1, cov1, mean2, cov2, method=method).astype(jnp.float32)
+            method = self._resolve_method(n_min, cov1.shape[0])
+            # keep the moment dtype (f64 under x64), matching the buffered
+            # path's precision instead of truncating to f32
+            return _compute_fid(mean1, cov1, mean2, cov2, method=method)
 
         real_features = dim_zero_cat(self.real_features)
         fake_features = dim_zero_cat(self.fake_features)

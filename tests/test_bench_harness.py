@@ -7,12 +7,14 @@ separates healthy from degraded, and ``bench._measure`` retries degraded
 configs on fresh processes and never returns an unflagged sick-endpoint
 line.
 """
+import os
 import sys
 
 import pytest
 
-if "scripts" not in sys.path:
-    sys.path.insert(0, "scripts")
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts")
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
 import bench  # noqa: E402
 import bench_suite  # noqa: E402
 
@@ -85,11 +87,25 @@ def test_measure_mid_config_degradation_is_flagged(monkeypatch):
     assert out["degraded"] is True
 
 
-def test_measure_survives_crashed_attempts(monkeypatch):
-    lines = iter([None, None, None])
+def test_measure_stops_after_two_crashed_attempts(monkeypatch):
+    """A config with no JSON line gets ONE fresh-process retry, then nulls —
+    a deterministically-broken config must not burn attempts x timeout of
+    the capture's total budget."""
+    calls = []
+    lines = iter([None, None, _line(70.0)])  # a 3rd attempt would have "succeeded"
+    monkeypatch.setattr(
+        bench, "_run_config_subprocess", lambda n, t: calls.append(n) or next(lines)
+    )
+    out = bench._measure("bench_x", ("m", "us/step"))
+    assert len(calls) == 2
+    assert out == {"metric": "m", "value": None, "unit": "us/step", "vs_baseline": None}
+
+
+def test_measure_recovers_from_one_crash(monkeypatch):
+    lines = iter([None, _line(70.0)])
     monkeypatch.setattr(bench, "_run_config_subprocess", lambda n, t: next(lines))
     out = bench._measure("bench_x", ("m", "us/step"))
-    assert out == {"metric": "m", "value": None, "unit": "us/step", "vs_baseline": None}
+    assert out["degraded"] is False and out["value"] == 10.0
 
 
 def test_every_config_has_meta_and_resolves():
