@@ -7,15 +7,17 @@ random metric configuration — and streams identical data through both
 libraries (dtype varies in the regression family; classification sticks to
 the reference's float32-probs convention). 40 seeds x 4 families
 (classification, regression, curve scalars under randomized tie density,
-retrieval under adversarial group layouts); failures reproduce from the
-seed alone.
+retrieval under adversarial group layouts) plus 25 seeds of random
+``MetricCollection`` member sets; failures reproduce from the seed alone.
 """
+import jax.numpy as jnp
 import numpy as np
 import pytest
+import torch
 
 import metrics_tpu
 
-from tests.parity.helpers import stream_both
+from tests.parity.helpers import assert_close, stream_both
 
 SEEDS = list(range(40))
 
@@ -127,6 +129,100 @@ def test_fuzz_curves(torchmetrics_ref, seed):
         getattr(torchmetrics_ref, name)(**kwargs),
         [(preds[i], target[i]) for i in range(batches)],
     )
+
+
+def _random_collection_spec(rng, nc, kind):
+    """A random member pool drawn to stress the shared-update machinery:
+    stat-scores-family members with differing ``average`` configs land in one
+    equivalence class, confmat-family members in another, plus members whose
+    configs differ enough to be excluded from any class."""
+    avg = lambda: str(rng.choice(["micro", "macro", "weighted"]))
+
+    def _avg_kwargs():
+        a = avg()
+        return {"average": a, **({} if a == "micro" else {"num_classes": nc})}
+
+    pool = [
+        ("Accuracy", {}),
+        ("Precision", _avg_kwargs()),
+        ("Recall", _avg_kwargs()),
+        ("F1", _avg_kwargs()),
+        ("Specificity", _avg_kwargs()),
+        ("FBeta", {"beta": float(rng.choice([0.5, 2.0])), **_avg_kwargs()}),
+        ("StatScores", {"reduce": "micro"}),
+        ("HammingDistance", {}),
+        ("ConfusionMatrix", {"num_classes": nc}),
+        ("ConfusionMatrix", {"num_classes": nc, "normalize": "true"}),
+        ("CohenKappa", {"num_classes": nc}),
+        ("MatthewsCorrcoef", {"num_classes": nc}),
+        ("IoU", {"num_classes": nc}),
+    ]
+    if kind == "probs" and nc > 2:
+        pool.append(("Accuracy", {"top_k": 2}))
+    picks = rng.choice(len(pool), size=int(rng.randint(3, 7)), replace=False)
+    return [pool[i] for i in picks]
+
+
+@pytest.mark.parametrize("seed", SEEDS[:25])
+def test_fuzz_metric_collection(torchmetrics_ref, seed):
+    """Random member sets through ``MetricCollection`` vs the reference's.
+
+    The collection is where this build diverges most from the reference
+    internally (shared-update fusion per equivalence class, sync aliasing,
+    fused forward), so this battery pins that none of it is observable:
+    random members (same class under different configs included), random
+    dict names, random prefix/postfix, and both streaming styles —
+    ``update()`` only, or ``forward()`` with every per-step dict compared
+    too — must match the reference key-for-key and value-for-value."""
+    rng = np.random.RandomState(5000 + seed)
+    nc = int(rng.randint(2, 6))
+    batch = int(rng.choice([1, 16, 64]))
+    batches = int(rng.randint(1, 5))
+    kind = str(rng.choice(["probs", "labels"]))
+
+    if kind == "probs":
+        preds = rng.rand(batches, batch, nc).astype(np.float32)
+        preds /= preds.sum(-1, keepdims=True)
+    else:
+        preds = rng.randint(0, nc, (batches, batch))
+    target = rng.randint(0, nc, (batches, batch))
+    if rng.rand() < 0.2:
+        target[-1] = 0  # one batch dominated by a single class
+
+    spec = _random_collection_spec(rng, nc, kind)
+    names = [f"m{i}_{cls.lower()}" for i, (cls, _) in enumerate(spec)]
+    collection_kwargs = {}
+    if rng.rand() < 0.3:
+        collection_kwargs["prefix"] = "fuzz/"
+    if rng.rand() < 0.3:
+        collection_kwargs["postfix"] = "_v"
+
+    ours = metrics_tpu.MetricCollection(
+        {n: getattr(metrics_tpu, cls)(**dict(kw)) for n, (cls, kw) in zip(names, spec)},
+        **collection_kwargs,
+    )
+    theirs = torchmetrics_ref.MetricCollection(
+        {n: getattr(torchmetrics_ref, cls)(**dict(kw)) for n, (cls, kw) in zip(names, spec)},
+        **collection_kwargs,
+    )
+
+    use_forward = rng.rand() < 0.5
+    for i in range(batches):
+        if use_forward:
+            step_ours = ours(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            step_theirs = theirs(torch.from_numpy(np.asarray(preds[i])), torch.from_numpy(np.asarray(target[i])))
+            assert set(step_ours) == set(step_theirs)
+            for key in step_theirs:
+                assert_close(step_ours[key], step_theirs[key], atol=1e-5, rtol=1e-4)
+        else:
+            ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            theirs.update(torch.from_numpy(np.asarray(preds[i])), torch.from_numpy(np.asarray(target[i])))
+
+    ours_vals = ours.compute()
+    theirs_vals = theirs.compute()
+    assert set(ours_vals) == set(theirs_vals)
+    for key in theirs_vals:
+        assert_close(ours_vals[key], theirs_vals[key], atol=1e-5, rtol=1e-4)
 
 
 @pytest.mark.parametrize("seed", SEEDS)
