@@ -5,11 +5,15 @@ varies EVERYTHING per seed — batch size, class count, batch count, dtype,
 degenerate label distributions (all-one-class, single-sample batches) and a
 random metric configuration — and streams identical data through both
 libraries (dtype varies in the regression family; classification sticks to
-the reference's float32-probs convention). 40 seeds x 4 families
+the reference's float32-probs convention). 40 seeds x 6 batteries
 (classification, regression, curve scalars under randomized tie density,
-retrieval under adversarial group layouts) plus 25 seeds of random
+retrieval under adversarial group layouts, random composition expression
+trees, random lifecycle op sequences) plus 25 seeds of random
 ``MetricCollection`` member sets; failures reproduce from the seed alone.
+``METRICS_TPU_FUZZ_SEEDS=N`` widens every battery for deep sweeps.
 """
+import os
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -19,7 +23,15 @@ import metrics_tpu
 
 from tests.parity.helpers import assert_close, stream_both
 
-SEEDS = list(range(40))
+#: CI runs the fixed default; METRICS_TPU_FUZZ_SEEDS=N widens every battery
+#: to N seeds for out-of-CI deep sweeps (failures still reproduce from the
+#: seed alone — the env var only ever extends the range, never narrows it).
+try:
+    _N = int(os.environ.get("METRICS_TPU_FUZZ_SEEDS", "0"))
+except ValueError as err:
+    raise ValueError("METRICS_TPU_FUZZ_SEEDS must be an integer seed count") from err
+SEEDS = list(range(max(_N, 40)))
+COLLECTION_SEEDS = list(range(max(_N, 25)))
 
 
 def _random_classification_case(rng):
@@ -163,7 +175,7 @@ def _random_collection_spec(rng, nc, kind):
     return [pool[i] for i in picks]
 
 
-@pytest.mark.parametrize("seed", SEEDS[:25])
+@pytest.mark.parametrize("seed", COLLECTION_SEEDS)
 def test_fuzz_metric_collection(torchmetrics_ref, seed):
     """Random member sets through ``MetricCollection`` vs the reference's.
 
@@ -223,6 +235,140 @@ def test_fuzz_metric_collection(torchmetrics_ref, seed):
     assert set(ours_vals) == set(theirs_vals)
     for key in theirs_vals:
         assert_close(ours_vals[key], theirs_vals[key], atol=1e-5, rtol=1e-4)
+
+
+_BINARY_OPS = [
+    ("add", lambda a, b: a + b),
+    ("sub", lambda a, b: a - b),
+    ("mul", lambda a, b: a * b),
+    ("truediv", lambda a, b: a / b),
+    ("floordiv", lambda a, b: a // b),
+    ("mod", lambda a, b: a % b),
+    ("pow", lambda a, b: a**b),
+]
+#: comparisons yield Bool tensors torch can't do further arithmetic on
+#: (``abs_cpu not implemented for 'Bool'``), so they only appear at the root
+_COMPARE_OPS = [
+    ("gt", lambda a, b: a > b),
+    ("ge", lambda a, b: a >= b),
+    ("lt", lambda a, b: a < b),
+    ("le", lambda a, b: a <= b),
+    ("eq", lambda a, b: a == b),
+    ("ne", lambda a, b: a != b),
+]
+_UNARY_OPS = [("neg", lambda a: -a), ("abs", abs), ("pos", lambda a: +a)]
+_SCALARS = [0.5, 2.0, 3.0, -1.5]
+
+
+def _random_expr(rng, make_leaf, depth=0):
+    """A random compositional-metric expression, built identically over both
+    libraries — returns an ``(ours, theirs)`` pair of composed metrics."""
+    if depth >= 2 or rng.rand() < 0.35:
+        return make_leaf()
+    if rng.rand() < 0.25:
+        _, op = _UNARY_OPS[rng.randint(len(_UNARY_OPS))]
+        ours, theirs = _random_expr(rng, make_leaf, depth + 1)
+        return op(ours), op(theirs)
+    if depth == 0 and rng.rand() < 0.25:
+        _, op = _COMPARE_OPS[rng.randint(len(_COMPARE_OPS))]
+    else:
+        _, op = _BINARY_OPS[rng.randint(len(_BINARY_OPS))]
+    ours, theirs = _random_expr(rng, make_leaf, depth + 1)
+    if rng.rand() < 0.4:
+        scalar = float(rng.choice(_SCALARS))
+        return op(ours, scalar), op(theirs, scalar)
+    ours_r, theirs_r = _random_expr(rng, make_leaf, depth + 1)
+    return op(ours, ours_r), op(theirs, theirs_r)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_composition(torchmetrics_ref, seed):
+    """Random metric-arithmetic expression trees vs the reference.
+
+    The 36 operator dunders are covered one-by-one in
+    ``tests/bases/test_composition.py``; this battery pins their NESTED
+    semantics — update fan-out through shared leaves, compute-time operator
+    evaluation order, scalar partners — on random trees up to depth 3.
+    NaN/inf escapes (0-division, fractional powers of negatives) must agree
+    too; ``assert_close`` is NaN-equal by design."""
+    rng = np.random.RandomState(6000 + seed)
+    nc = 3
+    batches = int(rng.randint(1, 4))
+    preds = rng.rand(batches, 32, nc).astype(np.float32)
+    preds /= preds.sum(-1, keepdims=True)
+    target = rng.randint(0, nc, (batches, 32))
+
+    leaf_pool = [
+        ("Accuracy", {}),
+        ("Precision", {"average": "micro"}),
+        ("Recall", {"average": "micro"}),
+    ]
+
+    def make_leaf():
+        cls, kw = leaf_pool[rng.randint(len(leaf_pool))]
+        return getattr(metrics_tpu, cls)(**kw), getattr(torchmetrics_ref, cls)(**kw)
+
+    ours, theirs = _random_expr(rng, make_leaf)
+    for i in range(batches):
+        ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        theirs.update(torch.from_numpy(preds[i]), torch.from_numpy(target[i]))
+    assert_close(ours.compute(), theirs.compute(), atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_lifecycle(torchmetrics_ref, seed):
+    """Random op sequences — update / forward / compute / reset in any
+    order — through both libraries, comparing every observable value.
+
+    This is the cache-semantics battery: compute-after-compute must serve
+    the cached value, reset must clear it, forward must both return the
+    batch value and leave the accumulator consistent, and compute with no
+    update since reset must agree with the reference's
+    computed-on-defaults value (the warning both libraries emit for that
+    case is pinned deterministically below; the random sequence then only
+    compares values)."""
+    rng = np.random.RandomState(7000 + seed)
+    nc = 3
+    name, kwargs = [
+        ("Accuracy", {}),
+        ("Precision", {"average": "macro", "num_classes": nc}),
+        ("MeanSquaredError", {}),
+        ("ConfusionMatrix", {"num_classes": nc}),
+    ][rng.randint(4)]
+    regression = name == "MeanSquaredError"
+
+    ours = getattr(metrics_tpu, name)(**kwargs)
+    theirs = getattr(torchmetrics_ref, name)(**kwargs)
+
+    with pytest.warns(UserWarning, match="called before"):
+        fresh_ours = getattr(metrics_tpu, name)(**kwargs).compute()
+    with pytest.warns(UserWarning, match="called before"):
+        fresh_theirs = getattr(torchmetrics_ref, name)(**kwargs).compute()
+    assert_close(fresh_ours, fresh_theirs, atol=1e-5, rtol=1e-4)
+
+    def batch():
+        if regression:
+            p = rng.randn(16).astype(np.float32)
+            return p, (p * 0.8 + 0.2 * rng.randn(16)).astype(np.float32)
+        p = rng.rand(16, nc).astype(np.float32)
+        return p / p.sum(-1, keepdims=True), rng.randint(0, nc, 16)
+
+    ops = rng.choice(["update", "forward", "compute", "reset"], size=int(rng.randint(4, 11)), p=[0.4, 0.25, 0.25, 0.1])
+    for op in ops:
+        if op == "update":
+            p, t = batch()
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            theirs.update(torch.from_numpy(np.asarray(p)), torch.from_numpy(np.asarray(t)))
+        elif op == "forward":
+            p, t = batch()
+            step_ours = ours(jnp.asarray(p), jnp.asarray(t))
+            step_theirs = theirs(torch.from_numpy(np.asarray(p)), torch.from_numpy(np.asarray(t)))
+            assert_close(step_ours, step_theirs, atol=1e-5, rtol=1e-4)
+        elif op == "compute":
+            assert_close(ours.compute(), theirs.compute(), atol=1e-5, rtol=1e-4)
+        else:
+            ours.reset()
+            theirs.reset()
 
 
 @pytest.mark.parametrize("seed", SEEDS)
