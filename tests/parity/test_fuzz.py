@@ -5,12 +5,13 @@ varies EVERYTHING per seed — batch size, class count, batch count, dtype,
 degenerate label distributions (all-one-class, single-sample batches) and a
 random metric configuration — and streams identical data through both
 libraries (dtype varies in the regression family; classification sticks to
-the reference's float32-probs convention). 40 seeds x 6 batteries
+the reference's float32-probs convention). 40 seeds x 7 batteries
 (classification, regression, curve scalars under randomized tie density,
 retrieval under adversarial group layouts, random composition expression
-trees, random lifecycle op sequences) plus 25 seeds of random
-``MetricCollection`` member sets; failures reproduce from the seed alone.
-``METRICS_TPU_FUZZ_SEEDS=N`` widens every battery for deep sweeps.
+trees, random lifecycle op sequences, image/audio/binned/misc configs)
+plus 25 seeds of random ``MetricCollection`` member sets; failures
+reproduce from the seed alone. ``METRICS_TPU_FUZZ_SEEDS=N`` widens every
+battery for deep sweeps.
 """
 import os
 
@@ -29,7 +30,10 @@ from tests.parity.helpers import assert_close, stream_both
 try:
     _N = int(os.environ.get("METRICS_TPU_FUZZ_SEEDS", "0"))
 except ValueError as err:
-    raise ValueError("METRICS_TPU_FUZZ_SEEDS must be an integer seed count") from err
+    raise ValueError(
+        "METRICS_TPU_FUZZ_SEEDS must be an integer seed count, got "
+        f"{os.environ['METRICS_TPU_FUZZ_SEEDS']!r}"
+    ) from err
 SEEDS = list(range(max(_N, 40)))
 COLLECTION_SEEDS = list(range(max(_N, 25)))
 
@@ -238,25 +242,25 @@ def test_fuzz_metric_collection(torchmetrics_ref, seed):
 
 
 _BINARY_OPS = [
-    ("add", lambda a, b: a + b),
-    ("sub", lambda a, b: a - b),
-    ("mul", lambda a, b: a * b),
-    ("truediv", lambda a, b: a / b),
-    ("floordiv", lambda a, b: a // b),
-    ("mod", lambda a, b: a % b),
-    ("pow", lambda a, b: a**b),
+    lambda a, b: a + b,
+    lambda a, b: a - b,
+    lambda a, b: a * b,
+    lambda a, b: a / b,
+    lambda a, b: a // b,
+    lambda a, b: a % b,
+    lambda a, b: a**b,
 ]
 #: comparisons yield Bool tensors torch can't do further arithmetic on
 #: (``abs_cpu not implemented for 'Bool'``), so they only appear at the root
 _COMPARE_OPS = [
-    ("gt", lambda a, b: a > b),
-    ("ge", lambda a, b: a >= b),
-    ("lt", lambda a, b: a < b),
-    ("le", lambda a, b: a <= b),
-    ("eq", lambda a, b: a == b),
-    ("ne", lambda a, b: a != b),
+    lambda a, b: a > b,
+    lambda a, b: a >= b,
+    lambda a, b: a < b,
+    lambda a, b: a <= b,
+    lambda a, b: a == b,
+    lambda a, b: a != b,
 ]
-_UNARY_OPS = [("neg", lambda a: -a), ("abs", abs), ("pos", lambda a: +a)]
+_UNARY_OPS = [lambda a: -a, abs, lambda a: +a]
 _SCALARS = [0.5, 2.0, 3.0, -1.5]
 
 
@@ -266,13 +270,13 @@ def _random_expr(rng, make_leaf, depth=0):
     if depth >= 2 or rng.rand() < 0.35:
         return make_leaf()
     if rng.rand() < 0.25:
-        _, op = _UNARY_OPS[rng.randint(len(_UNARY_OPS))]
+        op = _UNARY_OPS[rng.randint(len(_UNARY_OPS))]
         ours, theirs = _random_expr(rng, make_leaf, depth + 1)
         return op(ours), op(theirs)
     if depth == 0 and rng.rand() < 0.25:
-        _, op = _COMPARE_OPS[rng.randint(len(_COMPARE_OPS))]
+        op = _COMPARE_OPS[rng.randint(len(_COMPARE_OPS))]
     else:
-        _, op = _BINARY_OPS[rng.randint(len(_BINARY_OPS))]
+        op = _BINARY_OPS[rng.randint(len(_BINARY_OPS))]
     ours, theirs = _random_expr(rng, make_leaf, depth + 1)
     if rng.rand() < 0.4:
         scalar = float(rng.choice(_SCALARS))
@@ -369,6 +373,108 @@ def test_fuzz_lifecycle(torchmetrics_ref, seed):
         else:
             ours.reset()
             theirs.reset()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_image_audio_misc(torchmetrics_ref, seed):
+    """SSIM / PSNR / audio / binned-curve / Hinge / KLDivergence under
+    random configurations — the families the other batteries don't reach.
+
+    SSIM draws random kernel sizes and sigmas (the custom MXU band-matrix
+    smoothing path must agree with the reference's gaussian conv for every
+    kernel config, not just the default 11x11), PSNR random data ranges,
+    audio random shapes and zero_mean, BinnedPrecisionRecallCurve random
+    threshold counts, Hinge every multiclass_mode, KLDivergence both input
+    conventions."""
+    rng = np.random.RandomState(8000 + seed)
+    family = str(rng.choice(["ssim", "psnr", "audio", "binned", "hinge", "kld"]))
+
+    if family == "ssim":
+        k = int(rng.choice([3, 5, 7, 11]))
+        sigma = float(rng.choice([0.8, 1.5, 2.2]))
+        side = int(rng.choice([13, 17, 24]))
+        batches = int(rng.randint(1, 3))
+        imgs_p = rng.rand(batches, 2, 1, side, side).astype(np.float32)
+        imgs_t = np.clip(imgs_p * 0.8 + 0.1 * rng.rand(*imgs_p.shape), 0, 1).astype(np.float32)
+        kwargs = {"kernel_size": (k, k), "sigma": (sigma, sigma), "data_range": 1.0}
+        stream_both(
+            metrics_tpu.SSIM(**kwargs),
+            torchmetrics_ref.SSIM(**kwargs),
+            [(imgs_p[i], imgs_t[i]) for i in range(batches)],
+            atol=1e-4,
+        )
+    elif family == "psnr":
+        scale = float(10.0 ** rng.randint(-1, 3))
+        batches = int(rng.randint(1, 4))
+        preds = (rng.rand(batches, 5, 12) * scale).astype(np.float32)
+        target = (preds + 0.05 * scale * rng.randn(*preds.shape)).astype(np.float32)
+        kwargs = {"data_range": scale} if rng.rand() < 0.7 else {}
+        stream_both(
+            metrics_tpu.PSNR(**kwargs),
+            torchmetrics_ref.PSNR(**kwargs),
+            [(preds[i], target[i]) for i in range(batches)],
+            atol=1e-4,
+        )
+    elif family == "audio":
+        name = str(rng.choice(["SI_SDR", "SI_SNR", "SNR"]))
+        kwargs = {"zero_mean": bool(rng.rand() < 0.5)} if name in ("SI_SDR", "SNR") else {}
+        batches = int(rng.randint(1, 4))
+        n = int(rng.choice([50, 200]))
+        sig = rng.randn(batches, 4, n).astype(np.float32)
+        noisy = (sig + float(rng.choice([0.1, 0.5])) * rng.randn(*sig.shape)).astype(np.float32)
+        stream_both(
+            getattr(metrics_tpu, name)(**kwargs),
+            getattr(torchmetrics_ref, name)(**kwargs),
+            [(noisy[i], sig[i]) for i in range(batches)],
+            atol=1e-3,
+        )
+    elif family == "binned":
+        nc = int(rng.randint(1, 5))
+        nt = int(rng.choice([5, 25, 101]))
+        batches = int(rng.randint(1, 4))
+        preds = rng.rand(batches, 24, nc).astype(np.float32)
+        target = rng.randint(0, 2, (batches, 24, nc))
+        name = str(rng.choice(["BinnedPrecisionRecallCurve", "BinnedAveragePrecision"]))
+        stream_both(
+            getattr(metrics_tpu, name)(num_classes=nc, num_thresholds=nt),
+            getattr(torchmetrics_ref, name)(num_classes=nc, num_thresholds=nt),
+            [(preds[i], target[i]) for i in range(batches)],
+            atol=1e-5,
+        )
+    elif family == "hinge":
+        mode = rng.choice([None, "crammer-singer", "one-vs-all"])
+        kwargs = {"squared": bool(rng.rand() < 0.5)}
+        batches = int(rng.randint(1, 4))
+        if mode is None:
+            preds = (rng.randn(batches, 32) * 2).astype(np.float32)
+            target = rng.randint(0, 2, (batches, 32))
+        else:
+            kwargs["multiclass_mode"] = str(mode)
+            nc = int(rng.randint(2, 5))
+            preds = (rng.randn(batches, 32, nc) * 2).astype(np.float32)
+            target = rng.randint(0, nc, (batches, 32))
+        stream_both(
+            metrics_tpu.Hinge(**kwargs),
+            torchmetrics_ref.Hinge(**kwargs),
+            [(preds[i], target[i]) for i in range(batches)],
+            atol=1e-4,
+        )
+    else:
+        log_prob = bool(rng.rand() < 0.5)
+        reduction = str(rng.choice(["mean", "sum"]))
+        batches = int(rng.randint(1, 4))
+        p = rng.rand(batches, 16, 6).astype(np.float32) + 1e-3
+        q = rng.rand(batches, 16, 6).astype(np.float32) + 1e-3
+        p /= p.sum(-1, keepdims=True)
+        q /= q.sum(-1, keepdims=True)
+        if log_prob:
+            p, q = np.log(p), np.log(q)
+        stream_both(
+            metrics_tpu.KLDivergence(log_prob=log_prob, reduction=reduction),
+            torchmetrics_ref.KLDivergence(log_prob=log_prob, reduction=reduction),
+            [(p[i], q[i]) for i in range(batches)],
+            atol=1e-5,
+        )
 
 
 @pytest.mark.parametrize("seed", SEEDS)
