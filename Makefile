@@ -1,8 +1,8 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun bench export-weights
+.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights
 
-ci: test doctest doctest-docs dryrun
+ci: test doctest doctest-docs dryrun examples
 
 # Full suite on the virtual 8-device CPU mesh (tests/conftest.py), including
 # the real 2-process jax.distributed sync test (tests/bases/test_multiprocess.py).
@@ -23,6 +23,13 @@ doctest-docs:
 # 8-device mesh (falls back to virtual CPU devices when chips are missing).
 dryrun:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('DRYRUN OK')"
+
+# Every example script end to end (CPU; the distributed one on the virtual
+# 8-device mesh) — examples are user-facing docs and must not rot.
+examples:
+	JAX_PLATFORMS=cpu python examples/train_eval.py
+	JAX_PLATFORMS=cpu python examples/generative_eval.py
+	METRICS_TPU_FORCE_CPU_MESH=1 python examples/distributed_train.py
 
 # Full benchmark suite on the default backend (the real TPU chip under axon).
 bench:
