@@ -25,10 +25,12 @@ dryrun:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('DRYRUN OK')"
 
 # Every example script end to end (CPU; the distributed one on the virtual
-# 8-device mesh) — examples are user-facing docs and must not rot.
+# 8-device mesh) — examples are user-facing docs and must not rot. The
+# flag pins the CPU backend even where site config force-registers an
+# accelerator (a plain JAX_PLATFORMS=cpu env var cannot).
 examples:
-	JAX_PLATFORMS=cpu python examples/train_eval.py
-	JAX_PLATFORMS=cpu python examples/generative_eval.py
+	METRICS_TPU_FORCE_CPU_MESH=1 python examples/train_eval.py
+	METRICS_TPU_FORCE_CPU_MESH=1 python examples/generative_eval.py
 	METRICS_TPU_FORCE_CPU_MESH=1 python examples/distributed_train.py
 
 # Full benchmark suite on the default backend (the real TPU chip under axon).

@@ -12,11 +12,22 @@ the three integration patterns from ``docs/integration.md``:
 Usage::
 
     python examples/train_eval.py
+
+``METRICS_TPU_FORCE_CPU_MESH=1`` pins the CPU backend even on machines
+whose site config force-registers an accelerator platform (plain
+``JAX_PLATFORMS=cpu`` env vars don't override those — see
+``tests/conftest.py``); CI uses it so examples never contend for a chip.
 """
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("METRICS_TPU_FORCE_CPU_MESH"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,63 @@
+"""Half-precision smoke tests for the regression/image stack (reference
+pattern: ``run_precision_test_cpu``, ``testers.py:416-462`` — fp16/bf16
+inputs must flow through every kernel and land near the f32 result).
+
+The classification analogue is ``tests/classification/test_dtypes.py``;
+audio runs through ``MetricTester.run_precision_test``. Together the three
+cover every family the reference precision-tests.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import (
+    cosine_similarity,
+    explained_variance,
+    mean_absolute_error,
+    mean_squared_error,
+    pearson_corrcoef,
+    psnr,
+    r2score,
+    spearman_corrcoef,
+    ssim,
+)
+
+_rng = np.random.RandomState(33)
+_N = 256
+_preds = _rng.randn(_N).astype(np.float32)
+_target = (_preds * 0.8 + 0.1 * _rng.randn(_N)).astype(np.float32)
+_imgs_p = _rng.rand(2, 1, 24, 24).astype(np.float32)
+_imgs_t = np.clip(_imgs_p * 0.9 + 0.05, 0, 1).astype(np.float32)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float16, jnp.bfloat16])
+@pytest.mark.parametrize(
+    "fn, shape, kwargs",
+    [
+        (mean_squared_error, (_N,), {}),
+        (mean_absolute_error, (_N,), {}),
+        (explained_variance, (_N,), {}),
+        (r2score, (_N,), {}),
+        (pearson_corrcoef, (_N,), {}),
+        (spearman_corrcoef, (_N,), {}),
+        (cosine_similarity, (16, 16), {}),
+        (psnr, (_N,), {"data_range": 4.0}),
+    ],
+)
+def test_half_precision_matches_f32(dtype, fn, shape, kwargs):
+    p, t = _preds.reshape(shape), _target.reshape(shape)
+    full = fn(jnp.asarray(p), jnp.asarray(t), **kwargs)
+    half = fn(jnp.asarray(p, dtype=dtype), jnp.asarray(t, dtype=dtype), **kwargs)
+    assert bool(jnp.all(jnp.isfinite(jnp.asarray(half, jnp.float32))))
+    # half-precision rounding moves sums, not semantics: 2% slack on the
+    # value (relative for the scale-carrying metrics, absolute for [0,1])
+    np.testing.assert_allclose(
+        np.asarray(half, np.float64), np.asarray(full, np.float64), rtol=0.02, atol=0.02
+    )
+
+
+@pytest.mark.parametrize("dtype", [jnp.float16, jnp.bfloat16])
+def test_half_precision_ssim(dtype):
+    full = ssim(jnp.asarray(_imgs_p), jnp.asarray(_imgs_t), data_range=1.0)
+    half = ssim(jnp.asarray(_imgs_p, dtype=dtype), jnp.asarray(_imgs_t, dtype=dtype), data_range=1.0)
+    np.testing.assert_allclose(float(half), float(full), atol=0.02)
