@@ -455,6 +455,41 @@ def test_inception_score_statistical_parity_splits(torchmetrics_ref):
     )
 
 
+def test_nlp_self_supervised_parity(torchmetrics_ref):
+    """The functional-only exports (bleu / embedding_similarity /
+    image_gradients) across their NON-default option axes — the
+    default-arg cases are pinned by ``test_bleu_parity`` and
+    ``test_remaining_functional_parity`` above; this extends the pin to
+    n_gram/smooth, every similarity x reduction combination, and
+    multi-channel image gradients."""
+    from metrics_tpu.functional import bleu_score, embedding_similarity, image_gradients
+
+    hyp = ["the cat sat on the mat".split(), "there is a cat here".split()]
+    refs = [["the cat sat on a mat".split(), "a cat sat on the mat".split()], ["a cat is here".split()]]
+    for n_gram in (2, 4):
+        for smooth in (False, True):
+            ours = float(bleu_score(hyp, refs, n_gram=n_gram, smooth=smooth))
+            theirs = float(torchmetrics_ref.functional.bleu_score(hyp, refs, n_gram=n_gram, smooth=smooth))
+            np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+    emb = _rng.randn(6, 8).astype(np.float32)
+    for similarity in ("cosine", "dot"):
+        for reduction in ("none", "mean", "sum"):
+            ours = embedding_similarity(
+                jnp.asarray(emb), similarity=similarity, reduction=reduction, zero_diagonal=True
+            )
+            theirs = torchmetrics_ref.functional.embedding_similarity(
+                torch.from_numpy(emb), similarity=similarity, reduction=reduction, zero_diagonal=True
+            )
+            np.testing.assert_allclose(np.asarray(ours), theirs.numpy(), atol=1e-5)
+
+    img = _rng.rand(2, 3, 12, 16).astype(np.float32)
+    dy_ours, dx_ours = image_gradients(jnp.asarray(img))
+    dy_ref, dx_ref = torchmetrics_ref.functional.image_gradients(torch.from_numpy(img))
+    np.testing.assert_allclose(np.asarray(dy_ours), dy_ref.numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx_ours), dx_ref.numpy(), atol=1e-6)
+
+
 def test_hash_semantics_parity(torchmetrics_ref):
     """Hash semantics match the reference exactly: identity-based per state
     object. In BOTH libraries a deepcopy with identical state values hashes
