@@ -695,6 +695,28 @@ def _neg(value: Array) -> Array:
     return -jnp.abs(value)
 
 
+def _floor_divide(a: Any, b: Any) -> Array:
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if not jnp.issubdtype(jnp.result_type(a, b), jnp.floating):
+        return jnp.floor_divide(a, b)
+    # Float floor division with torch/numpy semantics (the reference
+    # composes torch.floor_divide, metric.py:493-494): x//0.0 is ±inf
+    # where jnp.floor_divide gives NaN, and the fmod-based fixup (ATen's
+    # div_floor / numpy's npy_divmod) recovers the true floor when the
+    # rounded quotient lands just across an integer — plain floor(a/b)
+    # is off by one there. 0/450k random cases diverge from torch; the
+    # residual is inputs where XLA's rem is itself inexact (1.0 // 0.1).
+    # XLA's rem also gives NaN for fmod(finite, ±inf) where IEEE keeps
+    # the dividend — guard so finite // ±inf lands at 0/-1 like torch.
+    mod = jnp.where(jnp.isinf(b) & jnp.isfinite(a), a, jnp.fmod(a, b))
+    div = (a - mod) / b
+    div = div - jnp.where((mod != 0) & ((b < 0) != (mod < 0)), 1, 0).astype(div.dtype)
+    floordiv = jnp.floor(div)
+    floordiv = floordiv + (div - floordiv > 0.5).astype(div.dtype)
+    floordiv = jnp.where(div != 0, floordiv, jnp.copysign(jnp.zeros_like(div), a / b))
+    return jnp.where(b == 0, a / b, floordiv)
+
+
 class CompositionalMetric(Metric):
     """Lazy composition of two metrics under an operator, evaluated at compute().
 
@@ -821,7 +843,7 @@ def _install_operators() -> None:
         "sub": jnp.subtract,
         "mul": jnp.multiply,
         "truediv": jnp.true_divide,
-        "floordiv": jnp.floor_divide,
+        "floordiv": _floor_divide,
         "mod": jnp.fmod,
         "pow": jnp.power,
         "matmul": jnp.matmul,

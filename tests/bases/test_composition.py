@@ -92,6 +92,29 @@ def test_metrics_floordiv_mod_pow():
         np.testing.assert_allclose(np.asarray(op.compute()), expected)
 
 
+def test_metrics_floordiv_matches_torch_semantics():
+    """Float // follows torch/numpy: x // 0.0 is ±inf (jnp.floor_divide
+    alone gives NaN — found by the composition fuzz battery, seed 449:
+    recall // (accuracy - recall) with micro recall == accuracy),
+    0.0 // 0.0 is NaN, and finite quotients get the fmod-based fixup so
+    a rounded quotient just across an integer still floors correctly.
+    Integer operands keep integer floor-division semantics."""
+    cases = [(5.0, 0.0, np.inf), (-5.0, 0.0, -np.inf), (0.0, 0.0, np.nan),
+             (8.754882, -0.09516175, -93.0),  # fixup case: floor(a/b) would give -92
+             (7.0, 2.0, 3.0), (-7.0, 2.0, -4.0),
+             # finite // ±inf: IEEE fmod keeps the dividend (XLA's rem
+             # gives NaN unguarded) — torch floors to 0 / -1 by sign
+             (5.0, np.inf, 0.0), (-5.0, np.inf, -1.0), (5.0, -np.inf, -1.0)]
+    for val, divisor, expected in cases:
+        op = DummyMetric(val) // divisor
+        op.update()
+        np.testing.assert_array_equal(np.asarray(op.compute()), expected, err_msg=f"{val} // {divisor}")
+    int_op = DummyMetric(5) // 2
+    int_op.update()
+    result = int_op.compute()
+    assert jnp.issubdtype(result.dtype, jnp.integer) and int(result) == 2
+
+
 def test_metrics_matmul():
     first = DummyMetric([2.0, 2.0, 2.0])
     final_matmul = first @ jnp.asarray([2.0, 2.0, 2.0])
