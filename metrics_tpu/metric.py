@@ -695,6 +695,15 @@ def _neg(value: Array) -> Array:
     return -jnp.abs(value)
 
 
+def _fmod(a: Any, b: Any) -> Array:
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if not jnp.issubdtype(jnp.result_type(a, b), jnp.floating):
+        return jnp.fmod(a, b)
+    # XLA's rem gives NaN for fmod(finite, ±inf); IEEE (and the reference's
+    # torch.fmod, metric.py:511-512) keeps the dividend, signed zero intact.
+    return jnp.where(jnp.isinf(b) & jnp.isfinite(a), a, jnp.fmod(a, b))
+
+
 def _floor_divide(a: Any, b: Any) -> Array:
     a, b = jnp.asarray(a), jnp.asarray(b)
     if not jnp.issubdtype(jnp.result_type(a, b), jnp.floating):
@@ -706,9 +715,7 @@ def _floor_divide(a: Any, b: Any) -> Array:
     # rounded quotient lands just across an integer — plain floor(a/b)
     # is off by one there. 0/450k random cases diverge from torch; the
     # residual is inputs where XLA's rem is itself inexact (1.0 // 0.1).
-    # XLA's rem also gives NaN for fmod(finite, ±inf) where IEEE keeps
-    # the dividend — guard so finite // ±inf lands at 0/-1 like torch.
-    mod = jnp.where(jnp.isinf(b) & jnp.isfinite(a), a, jnp.fmod(a, b))
+    mod = _fmod(a, b)  # its inf-divisor guard makes finite // ±inf land at 0/-1
     div = (a - mod) / b
     div = div - jnp.where((mod != 0) & ((b < 0) != (mod < 0)), 1, 0).astype(div.dtype)
     floordiv = jnp.floor(div)
@@ -844,7 +851,7 @@ def _install_operators() -> None:
         "mul": jnp.multiply,
         "truediv": jnp.true_divide,
         "floordiv": _floor_divide,
-        "mod": jnp.fmod,
+        "mod": _fmod,
         "pow": jnp.power,
         "matmul": jnp.matmul,
         "and": jnp.bitwise_and,

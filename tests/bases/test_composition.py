@@ -115,6 +115,19 @@ def test_metrics_floordiv_matches_torch_semantics():
     assert jnp.issubdtype(result.dtype, jnp.integer) and int(result) == 2
 
 
+def test_metrics_mod_matches_torch_semantics():
+    """Float % is C-style fmod like the reference's torch.fmod (sign of
+    the dividend), and x % ±inf keeps the dividend per IEEE — XLA's rem
+    gives NaN there unguarded. x % 0.0 is NaN in both libraries."""
+    cases = [(5.0, 3.0, 2.0), (-5.0, 3.0, -2.0), (5.0, -3.0, 2.0),
+             (5.0, np.inf, 5.0), (-5.0, np.inf, -5.0), (5.0, -np.inf, 5.0),
+             (0.0, np.inf, 0.0), (5.0, 0.0, np.nan)]
+    for val, divisor, expected in cases:
+        op = DummyMetric(val) % divisor
+        op.update()
+        np.testing.assert_array_equal(np.asarray(op.compute()), expected, err_msg=f"{val} % {divisor}")
+
+
 def test_metrics_matmul():
     first = DummyMetric([2.0, 2.0, 2.0])
     final_matmul = first @ jnp.asarray([2.0, 2.0, 2.0])
