@@ -42,7 +42,7 @@ def _random_classification_case(rng):
     nc = int(rng.randint(2, 7))
     batch = int(rng.choice([1, 3, 17, 64]))
     batches = int(rng.randint(1, 5))
-    kind = rng.choice(["probs", "labels", "binary", "multilabel"])
+    kind = rng.choice(["probs", "labels", "binary", "multilabel", "multidim"])
     degenerate = rng.rand() < 0.25
 
     if kind == "binary":
@@ -54,6 +54,14 @@ def _random_classification_case(rng):
     elif kind == "labels":
         preds = rng.randint(0, nc, (batches, batch))
         target = rng.randint(0, nc, (batches, batch))
+    elif kind == "multidim":
+        extra = int(rng.randint(2, 6))
+        if rng.rand() < 0.5:
+            preds = rng.rand(batches, batch, nc, extra).astype(np.float32)
+            preds /= preds.sum(2, keepdims=True)  # class axis is 1 per sample
+        else:
+            preds = rng.randint(0, nc, (batches, batch, extra))
+        target = rng.randint(0, nc, (batches, batch, extra))
     else:
         preds = rng.rand(batches, batch, nc).astype(np.float32)
         preds /= preds.sum(-1, keepdims=True)
@@ -71,6 +79,23 @@ def _random_classification_case(rng):
         kwargs["reduce"] = str(rng.choice(["micro", "macro"]))
         if kwargs["reduce"] == "macro":
             kwargs["num_classes"] = nc if kind != "binary" else 1
+
+    # option axes the fixed matrices sweep on fixed data; here they ride
+    # random data/shape draws (mismatched combos exercise error parity —
+    # stream_both requires our side to raise whenever the reference does)
+    if kind in ("binary", "multilabel") and name != "StatScores" and rng.rand() < 0.4:
+        kwargs["threshold"] = float(rng.choice([0.25, 0.5, 0.75]))
+    if kind == "probs" and name != "HammingDistance" and rng.rand() < 0.3:
+        kwargs["top_k"] = int(rng.choice([1, 2]))
+    if kind in ("probs", "labels") and name != "HammingDistance" and rng.rand() < 0.25:
+        kwargs["ignore_index"] = int(rng.randint(0, nc))
+    if kind == "multidim" and name != "HammingDistance":
+        mdmc = rng.choice([None, "global", "samplewise"], p=[0.2, 0.4, 0.4])
+        key = "mdmc_reduce" if name == "StatScores" else "mdmc_average"
+        if mdmc is not None:
+            kwargs[key] = str(mdmc)
+        elif name == "Accuracy":
+            kwargs[key] = None  # Accuracy defaults to 'global'; pin the None case
     return name, kwargs, preds, target
 
 
