@@ -7,14 +7,17 @@ parity included. Covers the reference's AUROC/AP/ROC/PR-curve option axes
 family's ``empty_target_action`` × ``k`` grid with adversarial group
 layouts (empty-target and empty-negative queries).
 """
+import warnings
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 import torch
 
 import metrics_tpu
+import metrics_tpu.functional as F
 
-from tests.parity.helpers import stream_both
+from tests.parity.helpers import assert_close, stream_both
 
 _rng = np.random.RandomState(53)
 NUM_BATCHES = 4
@@ -67,6 +70,37 @@ def test_curve_option_matrix(torchmetrics_ref, name, kwargs, kind):
         getattr(torchmetrics_ref, name)(**kwargs),
         batches,
     )
+
+
+_weights = (_rng.rand(BATCH) * 3).astype(np.float32)
+
+
+@pytest.mark.parametrize("fn_name", ["roc", "precision_recall_curve", "auroc", "average_precision"])
+@pytest.mark.parametrize("kind", ["binary", "multiclass", "ties"])
+def test_curve_sample_weights_parity(torchmetrics_ref, fn_name, kind):
+    """The curve functionals' ``sample_weights`` axis — weighted cumulative
+    counts through the sort-scan kernel vs the reference, including a
+    tie-heavy stream where weights must aggregate within threshold groups."""
+    if kind == "binary":
+        p, t = _bin_probs[0], _bin_target[0]
+        kwargs = {}
+    elif kind == "ties":
+        p = (np.round(_bin_probs[0] * 4) / 4).astype(np.float32)
+        t = _bin_target[0]
+        kwargs = {}
+    else:
+        p, t = _mc_probs[0], _mc_target[0]
+        kwargs = {"num_classes": NC}
+        if fn_name == "auroc":
+            kwargs["average"] = "macro"
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ours = getattr(F, fn_name)(jnp.asarray(p), jnp.asarray(t), sample_weights=_weights, **kwargs)
+        theirs = getattr(torchmetrics_ref.functional, fn_name)(
+            torch.from_numpy(p), torch.from_numpy(np.asarray(t)), sample_weights=_weights.tolist(), **kwargs
+        )
+    assert_close(ours, theirs)
 
 
 # ---------------------------------------------------------------- retrieval
