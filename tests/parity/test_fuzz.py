@@ -117,18 +117,42 @@ def test_fuzz_regression(torchmetrics_ref, seed):
     batches = int(rng.randint(1, 5))
     scale = float(10.0 ** rng.randint(-3, 4))  # exercise extreme magnitudes
     dtype = np.float64 if rng.rand() < 0.3 else np.float32
-    preds = (rng.randn(batches, batch) * scale).astype(dtype)
-    target = (preds * 0.9 + 0.1 * scale * rng.randn(batches, batch)).astype(dtype)
 
     name = rng.choice(
         ["MeanSquaredError", "MeanAbsoluteError", "ExplainedVariance", "R2Score", "PearsonCorrcoef"]
     )
+    if name in ("ExplainedVariance", "R2Score"):
+        # at n=2 the SS_tot cancellation amplifies the reference's f32
+        # accumulation to ~1e-4 relative (ours is f64 under the suite's
+        # x64 config — seed 551); parity at 1e-5 is unreasonable there
+        batch = max(batch, 5)
+    # option axes: multioutput streams for the metrics that support them
+    # (the reference requires 2-D (N, outputs) inputs there), adjusted R²,
+    # and RMSE via squared=False
+    kwargs = {}
+    outputs = 1
+    if name in ("ExplainedVariance", "R2Score") and rng.rand() < 0.5:
+        outputs = int(rng.randint(2, 5))
+        kwargs["multioutput"] = str(rng.choice(["uniform_average", "raw_values", "variance_weighted"]))
+        if name == "R2Score":
+            kwargs["num_outputs"] = outputs
+    if name == "R2Score" and rng.rand() < 0.3:
+        kwargs["adjusted"] = int(rng.randint(1, max(2, batch - 2)))
+    if name == "MeanSquaredError" and rng.rand() < 0.3:
+        kwargs["squared"] = False
+
+    shape = (batches, batch, outputs) if outputs > 1 else (batches, batch)
+    preds = (rng.randn(*shape) * scale).astype(dtype)
+    target = (preds * 0.9 + 0.1 * scale * rng.randn(*shape)).astype(dtype)
+
     # tolerance must follow each metric's output magnitude, or large scales
     # make the assertion vacuous for the scale-free metrics
     value_scale = {"MeanSquaredError": scale * scale, "MeanAbsoluteError": scale}.get(name, 1.0)
+    if kwargs.get("squared") is False:
+        value_scale = scale  # RMSE is linear in the data scale
     stream_both(
-        getattr(metrics_tpu, name)(),
-        getattr(torchmetrics_ref, name)(),
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
         [(preds[i], target[i]) for i in range(batches)],
         atol=1e-4 * max(value_scale, 1e-4),
     )
