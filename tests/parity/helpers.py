@@ -27,14 +27,19 @@ def assert_close(ours, theirs, atol=1e-5, rtol=1e-5):
     )
 
 
-def stream_both(ours, theirs, batches, atol=1e-5, rtol=1e-5):
+def stream_both(ours, theirs, batches, atol=1e-5, rtol=1e-5, theirs_batches=None):
     """Run identical batch streams through both libraries.
 
     If the reference raises (at update or compute), our side must raise too —
     any exception type; the messages differ by design.
+
+    ``theirs_batches``: a value-identical stream pre-converted for the
+    reference side, for when our side consumes a dtype torch lacks kernels
+    for (bf16 activations are fed to the reference as the identical
+    post-rounding f32 values).
     """
     try:
-        for args in batches:
+        for args in batches if theirs_batches is None else theirs_batches:
             theirs.update(*[torch.from_numpy(np.asarray(a)) for a in args])
         theirs_val = theirs.compute()
     except Exception:

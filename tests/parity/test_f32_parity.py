@@ -115,7 +115,6 @@ def test_bf16_inputs_classification(torchmetrics_ref, seed):
     kernels for these). Thresholding/argmax decisions resolve on the same
     values either way and the counts are integer-exact, so parity is exact."""
     import jax.numpy as jnp
-    import torch
 
     rng = np.random.RandomState(7000 + seed)
     name, kwargs, preds, target = _random_classification_case(rng)
@@ -126,16 +125,14 @@ def test_bf16_inputs_classification(torchmetrics_ref, seed):
         bf16 = jnp.asarray(preds)  # label predictions: no float dtype in play
         ref_preds = np.asarray(preds)
 
-    ours = getattr(metrics_tpu, name)(**kwargs)
-    theirs = getattr(torchmetrics_ref, name)(**kwargs)
-    for i in range(preds.shape[0]):
-        ours.update(bf16[i], jnp.asarray(target[i]))
-        theirs.update(torch.from_numpy(ref_preds[i]), torch.from_numpy(np.asarray(target[i])))
-    np.testing.assert_allclose(
-        np.asarray(jnp.asarray(ours.compute()), np.float64),
-        np.asarray(theirs.compute().detach().numpy(), np.float64),
-        atol=1e-5,
-        rtol=1e-5,
+    # the shared generator draws option combos the libraries reject (e.g.
+    # out-of-range ignore_index), so route through stream_both — it owns
+    # the error-parity contract; theirs_batches carries the f32 twin stream
+    stream_both(
+        getattr(metrics_tpu, name)(**kwargs),
+        getattr(torchmetrics_ref, name)(**kwargs),
+        [(bf16[i], target[i]) for i in range(preds.shape[0])],
+        theirs_batches=[(ref_preds[i], target[i]) for i in range(preds.shape[0])],
     )
 
 
