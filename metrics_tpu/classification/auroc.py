@@ -132,6 +132,7 @@ class AUROC(CappedBufferMixin, Metric):
         """AUROC over everything seen so far."""
         if self.capacity is not None:
             preds, target, valid = self._buffer_flatten()
+            self._check_degenerate_classes(target, valid)
             if self._capacity_multiclass or self._capacity_multilabel:
                 per_class = self._one_vs_rest(masked_binary_auroc, preds, target, valid)
                 if self.average == "weighted":

@@ -64,8 +64,11 @@ def masked_binary_auroc(preds: Array, target: Array, valid: Array) -> Array:
     """
     fps, tps, pos_total = _masked_curve_points(preds, target, valid)
     neg_total = jnp.sum(valid) - pos_total
-    tpr = tps / jnp.maximum(pos_total, 1.0)
-    fpr = fps / jnp.maximum(neg_total, 1.0)
+    # single-class streams divide 0/0 -> NaN, exactly like the reference's
+    # roc (tps/tps[-1], fps/fps[-1]) and our own cat path — a guard here
+    # would silently turn the degenerate case into 0 (fuzz seed 3001)
+    tpr = tps / pos_total
+    fpr = fps / neg_total
     # prepend the (0, 0) point; duplicates add zero area
     tpr = jnp.concatenate([jnp.zeros((1,)), tpr])
     fpr = jnp.concatenate([jnp.zeros((1,)), fpr])
@@ -79,7 +82,12 @@ def masked_binary_average_precision(preds: Array, target: Array, valid: Array) -
     thresholds; tie-group duplicates and padding carry ``Δrecall = 0``.
     """
     fps, tps, pos_total = _masked_curve_points(preds, target, valid)
+    # the METRIC_EPS guard stays: zero-denominator positions are padding
+    # duplicates whose Δrecall is 0, so their precision value is irrelevant
+    # — unless it were NaN, which would poison the sum
     precision = tps / jnp.maximum(tps + fps, METRIC_EPS)
-    recall = tps / jnp.maximum(pos_total, 1.0)
+    # no-positive streams divide 0/0 -> NaN like the reference's recall
+    # (tps/pos_total) and our own cat path (fuzz seed 3001)
+    recall = tps / pos_total
     recall_prev = jnp.concatenate([jnp.zeros((1,)), recall[:-1]])
     return jnp.sum((recall - recall_prev) * precision)

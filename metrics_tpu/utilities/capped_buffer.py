@@ -304,6 +304,34 @@ class CappedBufferMixin:
             per_label = lambda c: kernel(preds[:, c], (target == c).astype(jnp.int32), valid)  # noqa: E731
         return jax.vmap(per_label)(jnp.arange(self.num_classes))
 
+    def _check_degenerate_classes(self, target: Array, valid: Array) -> None:
+        """Mirror the cat path's single-class raises (``roc.py:46,50``) on the
+        eager capacity path. Inside jit/shard_map raising is impossible — the
+        masked kernels return the same 0/0 NaN the reference's arithmetic
+        would produce instead; callers whose reference analogue *returns* NaN
+        rather than raising (average precision) skip this check.
+
+        The reductions run on device so only C+1 scalars cross to host (the
+        buffers this mode is built for are ~200k samples). An empty buffer is
+        NOT a single-class stream — compute-before-update already warns, and
+        the kernels return NaN for it."""
+        if _is_traced(target, valid):
+            return
+        import numpy as np
+
+        n_valid = float(jnp.sum(valid))
+        if n_valid == 0:
+            return
+        if target.ndim == 2 or getattr(self, "_capacity_multiclass", False):
+            pos_counts = np.atleast_1d(np.asarray(self._class_supports(target, valid)))
+        else:
+            pos_counts = np.asarray([jnp.sum(jnp.where(valid, (target == 1).astype(jnp.float32), 0.0))])
+        for pos in pos_counts:
+            if pos == n_valid:  # negatives-first, like the reference
+                raise ValueError("No negative samples in targets, false positive value should be meaningless")
+            if pos == 0:
+                raise ValueError("No positive samples in targets, true positive value should be meaningless")
+
     def _class_supports(self, target: Array, valid: Array) -> Array:
         """Valid positive count per class/label (for weighted averaging)."""
         if target.ndim == 2:

@@ -157,6 +157,50 @@ def test_capacity_rejects_out_of_range_pos_label():
         AUROC(capacity=16, pos_label=2)
 
 
+class TestCapacityDegenerateStreams:
+    """Degenerate-stream parity with the cat path (found by the curve
+    fuzz): single-class AUROC raises the roc errors eagerly, no-positive
+    AP is NaN, and an empty buffer is NaN — never a misleading raise."""
+
+    def test_binary_all_positive_raises(self):
+        m = AUROC(capacity=16)
+        m.update(jnp.asarray([0.2, 0.8]), jnp.asarray([1, 1]))
+        with pytest.raises(ValueError, match="No negative samples"):
+            m.compute()
+
+    def test_binary_all_negative_raises(self):
+        m = AUROC(capacity=16)
+        m.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 0]))
+        with pytest.raises(ValueError, match="No positive samples"):
+            m.compute()
+
+    def test_multiclass_absent_class_raises(self):
+        m = AUROC(capacity=16, num_classes=3)
+        probs = _normalize_rows(_rng.rand(8, 3).astype(np.float32))
+        m.update(jnp.asarray(probs), jnp.asarray(np.array([0, 1] * 4)))  # class 2 absent
+        with pytest.raises(ValueError, match="No positive samples"):
+            m.compute()
+
+    def test_multilabel_constant_column_raises(self):
+        m = AUROC(capacity=16, num_classes=3, multilabel=True)
+        preds = _rng.rand(8, 3).astype(np.float32)
+        target = _rng.randint(0, 2, (8, 3))
+        target[:, 1] = 1  # one label always on
+        m.update(jnp.asarray(preds), jnp.asarray(target))
+        with pytest.raises(ValueError, match="No negative samples"):
+            m.compute()
+
+    def test_ap_all_negative_is_nan(self):
+        m = AveragePrecision(capacity=16)
+        m.update(jnp.asarray([0.2, 0.8, 0.4]), jnp.asarray([0, 0, 0]))
+        assert np.isnan(float(m.compute()))
+
+    def test_empty_buffer_is_nan_not_a_raise(self):
+        m = AUROC(capacity=16)
+        with pytest.warns(UserWarning, match="called before"):
+            assert np.isnan(float(m.compute()))
+
+
 class TestMulticlassCapacity:
     def _data(self, n=200, c=4):
         logits = _rng.rand(n, c).astype(np.float32)
