@@ -132,11 +132,11 @@ class AUROC(CappedBufferMixin, Metric):
         """AUROC over everything seen so far."""
         if self.capacity is not None:
             preds, target, valid = self._buffer_flatten()
-            self._check_degenerate_classes(target, valid)
+            supports = self._check_degenerate_classes(target, valid)
             if self._capacity_multiclass or self._capacity_multilabel:
                 per_class = self._one_vs_rest(masked_binary_auroc, preds, target, valid)
                 if self.average == "weighted":
-                    support = self._class_supports(target, valid)
+                    support = supports if supports is not None else self._class_supports(target, valid)
                     return jnp.sum(per_class * support / jnp.maximum(jnp.sum(support), 1.0))
                 return jnp.mean(per_class)
             return masked_binary_auroc(preds, target, valid)

@@ -314,16 +314,22 @@ class CappedBufferMixin:
         The reductions run on device so only C+1 scalars cross to host (the
         buffers this mode is built for are ~200k samples). An empty buffer is
         NOT a single-class stream — compute-before-update already warns, and
-        the kernels return NaN for it."""
+        the kernels return NaN for it.
+
+        Returns the on-device per-class support vector for multiclass/
+        multilabel buffers (``None`` otherwise) so a weighted-average caller
+        doesn't reduce the buffer a second time."""
         if _is_traced(target, valid):
-            return
+            return None
         import numpy as np
 
         n_valid = float(jnp.sum(valid))
         if n_valid == 0:
-            return
+            return None
+        supports = None
         if target.ndim == 2 or getattr(self, "_capacity_multiclass", False):
-            pos_counts = np.atleast_1d(np.asarray(self._class_supports(target, valid)))
+            supports = self._class_supports(target, valid)
+            pos_counts = np.atleast_1d(np.asarray(supports))
         else:
             pos_counts = np.asarray([jnp.sum(jnp.where(valid, (target == 1).astype(jnp.float32), 0.0))])
         for pos in pos_counts:
@@ -331,6 +337,7 @@ class CappedBufferMixin:
                 raise ValueError("No negative samples in targets, false positive value should be meaningless")
             if pos == 0:
                 raise ValueError("No positive samples in targets, true positive value should be meaningless")
+        return supports
 
     def _class_supports(self, target: Array, valid: Array) -> Array:
         """Valid positive count per class/label (for weighted averaging)."""

@@ -183,9 +183,10 @@ class TestCapacityDegenerateStreams:
 
     def test_multilabel_constant_column_raises(self):
         m = AUROC(capacity=16, num_classes=3, multilabel=True)
-        preds = _rng.rand(8, 3).astype(np.float32)
-        target = _rng.randint(0, 2, (8, 3))
-        target[:, 1] = 1  # one label always on
+        preds = _rng.rand(4, 3).astype(np.float32)
+        # fixed pattern: columns 0/2 mixed, column 1 always on — the raise
+        # must be deterministic regardless of shared-_rng state
+        target = np.array([[0, 1, 1], [1, 1, 0], [0, 1, 1], [1, 1, 0]])
         m.update(jnp.asarray(preds), jnp.asarray(target))
         with pytest.raises(ValueError, match="No negative samples"):
             m.compute()

@@ -119,7 +119,8 @@ def test_fuzz_regression(torchmetrics_ref, seed):
     dtype = np.float64 if rng.rand() < 0.3 else np.float32
 
     name = rng.choice(
-        ["MeanSquaredError", "MeanAbsoluteError", "ExplainedVariance", "R2Score", "PearsonCorrcoef"]
+        ["MeanSquaredError", "MeanAbsoluteError", "ExplainedVariance", "R2Score",
+         "PearsonCorrcoef", "SpearmanCorrcoef", "CosineSimilarity"]
     )
     if name in ("ExplainedVariance", "R2Score"):
         # at n=2 the SS_tot cancellation amplifies the reference's f32
@@ -141,17 +142,54 @@ def test_fuzz_regression(torchmetrics_ref, seed):
     if name == "MeanSquaredError" and rng.rand() < 0.3:
         kwargs["squared"] = False
 
+    # our-side-only modes: the fixed-shape streaming/capacity states must be
+    # observably identical to the reference's cat design
+    ours_kwargs = {}
+    if name == "CosineSimilarity":
+        outputs = int(rng.randint(2, 6))  # (N, d) embedding rows
+        kwargs["reduction"] = str(rng.choice(["mean", "sum", "none"]))
+        if kwargs["reduction"] != "none" and rng.rand() < 0.5:
+            ours_kwargs["streaming"] = True
+    if name == "PearsonCorrcoef" and rng.rand() < 0.5:
+        ours_kwargs["streaming"] = True
+    if name == "SpearmanCorrcoef" and rng.rand() < 0.5:
+        # capacity == stream length -> exact; one compiled program per combo
+        ours_kwargs["capacity"] = batches * batch
+
     shape = (batches, batch, outputs) if outputs > 1 else (batches, batch)
     preds = (rng.randn(*shape) * scale).astype(dtype)
     target = (preds * 0.9 + 0.1 * scale * rng.randn(*shape)).astype(dtype)
+    if name == "SpearmanCorrcoef" and rng.rand() < 0.4:
+        # quantize relative to the scale so rank ties actually occur. The
+        # 0.4 reference ranks ties ordinally and disagrees with scipy on
+        # tied data; ours averages tie ranks like scipy (pinned in
+        # tests/regression) — so tied draws compare our capacity/cat modes
+        # to each other and to the scipy oracle instead of the reference.
+        from scipy import stats as sstats
+
+        preds = (np.round(preds / scale * 4) * scale / 4).astype(dtype)
+        target = (np.round(target / scale * 4) * scale / 4).astype(dtype)
+        # always capacity-vs-cat here (not the earlier 50% draw): every tied
+        # draw must exercise the masked rank kernel's tie averaging
+        modes = metrics_tpu.SpearmanCorrcoef(capacity=batches * batch), metrics_tpu.SpearmanCorrcoef()
+        for i in range(batches):
+            for m in modes:
+                m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        values = [float(m.compute()) for m in modes]
+        expected = sstats.spearmanr(preds.reshape(-1), target.reshape(-1)).statistic
+        np.testing.assert_allclose(values[0], values[1], atol=1e-6)
+        np.testing.assert_allclose(values[0], expected, atol=1e-4)
+        return
 
     # tolerance must follow each metric's output magnitude, or large scales
     # make the assertion vacuous for the scale-free metrics
     value_scale = {"MeanSquaredError": scale * scale, "MeanAbsoluteError": scale}.get(name, 1.0)
     if kwargs.get("squared") is False:
         value_scale = scale  # RMSE is linear in the data scale
+    if name == "CosineSimilarity" and kwargs["reduction"] == "sum":
+        value_scale = batches * batch  # similarity in [-1, 1] summed over N rows
     stream_both(
-        getattr(metrics_tpu, name)(**kwargs),
+        getattr(metrics_tpu, name)(**kwargs, **ours_kwargs),
         getattr(torchmetrics_ref, name)(**kwargs),
         [(preds[i], target[i]) for i in range(batches)],
         atol=1e-4 * max(value_scale, 1e-4),
