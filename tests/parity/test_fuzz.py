@@ -611,3 +611,28 @@ def test_fuzz_retrieval(torchmetrics_ref, seed):
         getattr(torchmetrics_ref, name)(**kwargs),
         stream,
     )
+
+    if rng.rand() < 0.5:
+        # padded in-graph twin: the same stream scattered into (Q, D) rows
+        # with a validity mask must score identically to the flat mode
+        # (itself reference-pinned above). Group ids are remapped to be
+        # globally unique first: a row IS a complete query in the padded
+        # layout, whereas flat mode merges same-id groups across batches.
+        # (No raising configs reach here — the policy pool excludes 'error'.)
+        stream = [(p, t, i + 100 * b) for b, (p, t, i) in enumerate(stream)]
+        flat = getattr(metrics_tpu, name)(**kwargs)
+        padded = getattr(metrics_tpu, name)(padded=True, **kwargs)
+        width = max(int(np.max(np.bincount(b[2]))) for b in stream)
+        for preds, target, idx in stream:
+            flat.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(idx))
+            uniq = np.unique(idx)
+            rows_p = np.zeros((uniq.size, width), np.float32)
+            rows_t = np.zeros((uniq.size, width), np.int32)
+            mask = np.zeros((uniq.size, width), bool)
+            for q, g in enumerate(uniq):
+                members = np.where(idx == g)[0]
+                rows_p[q, : members.size] = preds[members]
+                rows_t[q, : members.size] = target[members]
+                mask[q, : members.size] = True
+            padded.update(jnp.asarray(rows_p), jnp.asarray(rows_t), mask=jnp.asarray(mask))
+        np.testing.assert_allclose(float(padded.compute()), float(flat.compute()), atol=1e-5, rtol=1e-5)
