@@ -176,9 +176,17 @@ def test_fuzz_regression(torchmetrics_ref, seed):
             for m in modes:
                 m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
         values = [float(m.compute()) for m in modes]
-        expected = sstats.spearmanr(preds.reshape(-1), target.reshape(-1)).statistic
         np.testing.assert_allclose(values[0], values[1], atol=1e-6)
-        np.testing.assert_allclose(values[0], expected, atol=1e-4)
+        flat_p, flat_t = preds.reshape(-1), target.reshape(-1)
+        if np.ptp(flat_p) > 0 and np.ptp(flat_t) > 0:
+            # constant arrays are excluded from the scipy compare: scipy
+            # gives NaN (undefined correlation) where BOTH libraries return
+            # 0 by the reference's own +eps denominator design
+            # (reference spearman.py:80; found by seed 1660 at 4000 seeds)
+            expected = sstats.spearmanr(flat_p, flat_t).statistic
+            np.testing.assert_allclose(values[0], expected, atol=1e-4)
+        else:
+            np.testing.assert_allclose(values[0], 0.0, atol=1e-3)  # the documented +eps behavior
         return
 
     # tolerance must follow each metric's output magnitude, or large scales
