@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Cut the InceptionV3 golden-feature fixture.
+
+Default (egress-free) mode uses the numpy-seeded deterministic checkpoint;
+with ``--checkpoint`` a real torchvision ``Inception3`` state_dict is used
+instead, upgrading the committed goldens to real-weights numerics:
+
+    python scripts/make_inception_goldens.py                       # seeded
+    python scripts/make_inception_goldens.py --checkpoint iv3.pth  # real
+
+The golden values are the TORCH oracle's per-tap features (frozen at cut
+time), so the always-on test compares the live Flax+converter pipeline
+against a fixed reference even if both sides were to drift together.
+Before writing, the script asserts the current Flax pipeline agrees with
+those goldens — a fixture that fails its own test is never cut.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "image", "golden", "inception_goldens.npz",
+)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--checkpoint", default=None, help="real torchvision Inception3 state_dict (.pth)")
+    parser.add_argument("--output", default=DEFAULT_OUT)
+    args = parser.parse_args()
+
+    from tests.helpers.inception_goldens import (
+        CHECKPOINT_SEED,
+        GOLDEN_VERSION,
+        TAPS,
+        canonical_state_sha,
+        flax_taps_through_converter,
+        golden_images,
+        images_sha,
+        numpy_seeded_state_dict,
+        torch_taps,
+    )
+
+    if args.checkpoint:
+        import torch
+
+        state = torch.load(args.checkpoint, map_location="cpu", weights_only=True)
+        source = "torchvision"
+    else:
+        state = numpy_seeded_state_dict()
+        source = f"numpy-seeded:{CHECKPOINT_SEED}"
+
+    imgs = golden_images()
+    golden = torch_taps(state, imgs)
+    ours = flax_taps_through_converter(state, imgs)
+
+    payload = {
+        "version": np.int64(GOLDEN_VERSION),
+        "source": np.str_(source),
+        "checkpoint_sha": np.str_(canonical_state_sha(state)),
+        "images_sha": np.str_(images_sha(imgs)),
+    }
+    for tap in TAPS:
+        stored = golden[tap].astype(np.float16)
+        # self-check: current Flax pipeline must reproduce what we are about
+        # to pin (same tolerance the always-on test uses)
+        np.testing.assert_allclose(
+            ours[tap], stored.astype(np.float32), rtol=1e-2, atol=5e-3,
+            err_msg=f"Flax pipeline disagrees with the golden being cut (tap {tap})",
+        )
+        err = np.max(np.abs(ours[tap] - stored.astype(np.float32)) / (np.abs(stored.astype(np.float32)) + 5e-3))
+        print(f"tap {tap:>15}: shape {stored.shape}, max scaled error vs flax {err:.2e}")
+        payload[f"tap_{tap}"] = stored
+
+    os.makedirs(os.path.dirname(args.output), exist_ok=True)
+    np.savez_compressed(args.output, **payload)
+    size = os.path.getsize(args.output)
+    print(f"wrote {args.output} ({size / 1024:.1f} KiB, source={source})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

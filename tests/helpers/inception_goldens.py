@@ -1,0 +1,151 @@
+"""Shared machinery for the InceptionV3 golden-feature fixtures.
+
+The reference ships pretrained features out of the box via torch_fidelity
+(``torchmetrics/image/fid.py:34-52``); this environment has no network egress
+and no torchvision, so a genuine pretrained checkpoint cannot be downloaded.
+The golden fixtures are the egress-free substitute: a checkpoint whose every
+tensor is generated from ``numpy.random.RandomState`` (frozen-by-policy
+bitstream, stable across numpy AND torch releases — unlike ``torch.manual_seed``
+init, whose sampling kernels may change) is pushed through the FULL production
+conversion path (``torch_state_dict_to_flat`` -> ``.npz`` schema -> Flax
+forward), and the resulting per-tap features on four deterministic images are
+committed as a small float16 ``.npz``. The always-on CI test
+(``tests/image/test_inception_goldens.py``) regenerates the checkpoint,
+verifies its canonical SHA, and re-runs the pipeline against the committed
+goldens — so ANY numerics change in the converter, the name map, or the Flax
+topology trips CI without shipping 95 MB of weights.
+
+When a real torchvision checkpoint becomes available, re-cut the goldens from
+it (``python scripts/make_inception_goldens.py --checkpoint inception_v3.pth``)
+and the same test pins real-weights numerics instead.
+"""
+import hashlib
+
+import numpy as np
+
+#: bump when the golden format changes
+GOLDEN_VERSION = 1
+
+#: seed for the numpy-filled checkpoint (recorded in the fixture)
+CHECKPOINT_SEED = 2026
+
+TAPS = ("64", "192", "768", "2048", "logits_unbiased")
+
+
+def golden_images() -> np.ndarray:
+    """Four deterministic uint8 images, (4, 3, 299, 299): two structured
+    (gradients, checkerboard) to exercise spatial layers coherently, two
+    RandomState noise to exercise the full dynamic range."""
+    yy, xx = np.mgrid[0:299, 0:299].astype(np.float64) / 298.0
+    grad = np.stack([yy, xx, (yy + xx) / 2.0], axis=0) * 255.0
+    checker = np.stack([((yy * 298 // 16) + (xx * 298 // 16)) % 2] * 3, axis=0) * 255.0
+    rng = np.random.RandomState(20260731)
+    noise = rng.randint(0, 256, (2, 3, 299, 299)).astype(np.float64)
+    imgs = np.stack([grad, checker, noise[0], noise[1]], axis=0)
+    return np.clip(np.round(imgs), 0, 255).astype(np.uint8)
+
+
+def numpy_seeded_state_dict(seed: int = CHECKPOINT_SEED):
+    """A torchvision-named ``Inception3`` state_dict filled entirely from
+    ``numpy.random.RandomState`` — deterministic across torch versions.
+
+    Fill mirrors :func:`tests.helpers.torch_inception.randomized_inception`
+    so activations stay in a healthy range through all 17 stages: He-scaled
+    conv kernels, non-identity batch-norm affine + running stats (layout
+    mistakes cannot hide behind identity defaults).
+    """
+    import torch
+
+    from tests.helpers.torch_inception import Inception3Scratch
+
+    net = Inception3Scratch(num_logits=1008)
+    rng = np.random.RandomState(seed)
+    state = net.state_dict()
+    new_state = {}
+    for key in sorted(state):
+        ref = state[key]
+        shape = tuple(ref.shape)
+        if key.endswith("conv.weight"):
+            # torch-default kaiming_uniform(a=sqrt(5)) scale: keeps activation
+            # growth (and hence cross-backend fp divergence) as mild as the
+            # random-weights topology tests that pass at 2e-3
+            fan_in = int(np.prod(shape[1:]))
+            value = rng.standard_normal(shape) * np.sqrt(1.0 / (3.0 * fan_in))
+        elif key.endswith("bn.weight"):
+            value = rng.uniform(0.5, 1.5, shape)
+        elif key.endswith("bn.bias"):
+            value = rng.uniform(-0.2, 0.2, shape)
+        elif key.endswith("running_mean"):
+            value = rng.standard_normal(shape) * 0.1
+        elif key.endswith("running_var"):
+            value = rng.uniform(0.5, 1.5, shape)
+        elif key == "fc.weight":
+            value = rng.standard_normal(shape) * 0.01
+        elif key == "fc.bias":
+            value = np.zeros(shape)
+        else:  # num_batches_tracked bookkeeping
+            new_state[key] = ref
+            continue
+        new_state[key] = torch.from_numpy(value.astype(np.float32))
+    return new_state
+
+
+def canonical_state_sha(state) -> str:
+    """SHA256 over the checkpoint's float tensors in sorted-name order.
+
+    Canonical (name + float32 little-endian bytes), so the digest is
+    independent of serialization format — the same function fingerprints a
+    numpy-seeded state_dict and a real downloaded one.
+    """
+    digest = hashlib.sha256()
+    for key in sorted(state):
+        if key.endswith("num_batches_tracked"):
+            continue
+        arr = np.ascontiguousarray(np.asarray(state[key], dtype=np.float32))
+        digest.update(key.encode())
+        digest.update(b":")
+        digest.update(arr.astype("<f4").tobytes())
+    return digest.hexdigest()
+
+
+def images_sha(imgs: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(imgs).tobytes()).hexdigest()
+
+
+def flax_taps_through_converter(state, imgs: np.ndarray):
+    """Run ``imgs`` through the Flax net loaded via the production converter
+    (the exact pipeline a user's exported ``.npz`` goes through) and return
+    ``{tap: (N, d) float32 ndarray}``."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.image.inception_net import (
+        InceptionV3,
+        _unflatten_params,
+        torch_state_dict_to_flat,
+    )
+
+    flat = torch_state_dict_to_flat(state)
+    variables = _unflatten_params(flat)
+    # the checkpoint's fc width decides the head (1008 TF-compat, 1000
+    # torchvision) — same inference the production extractor does
+    net = InceptionV3(num_logits=flat["params/Dense_0/kernel"].shape[-1])
+    scaled = (imgs.astype(np.float32) - 128.0) / 128.0
+    flax_out = net.apply(variables, jnp.transpose(jnp.asarray(scaled), (0, 2, 3, 1)))
+    return {tap: np.asarray(flax_out[tap], dtype=np.float32) for tap in TAPS}
+
+
+def torch_taps(state, imgs: np.ndarray):
+    """The torch-oracle forward on the same checkpoint/images."""
+    import torch
+
+    from tests.helpers.torch_inception import Inception3Scratch
+
+    net = Inception3Scratch(num_logits=state["fc.weight"].shape[0])
+    # real torchvision checkpoints carry AuxLogits.* the trunk lacks;
+    # only MISSING keys would invalidate the oracle
+    missing, _unexpected = net.load_state_dict(state, strict=False)
+    assert not missing, f"checkpoint lacks keys the oracle needs: {missing[:5]}"
+    net.eval()
+    with torch.no_grad():
+        out = net((torch.from_numpy(imgs.astype(np.float32)) - 128.0) / 128.0)
+    return {tap: out[tap].numpy().astype(np.float32) for tap in TAPS}

@@ -1,0 +1,104 @@
+"""Always-on golden-feature pin for the InceptionV3 converter + Flax net.
+
+Closes VERDICT round-4 gap #1: the conversion pipeline
+(OIHW->HWIO transposes, batch-stats name map, Flax topology — the most
+numerically fragile code in the repo, ``metrics_tpu/image/inception_net.py``)
+previously had NO in-CI evidence against a fixed checkpoint: the real-weights
+battery (``test_real_inception_weights.py``) skips without a downloaded
+checkpoint, and the random-weights topology tests regenerate both sides each
+run, so a coordinated drift would pass.
+
+Here the committed fixture (``golden/inception_goldens.npz``, ~10 KiB, cut by
+``scripts/make_inception_goldens.py``) freezes the torch oracle's per-tap
+features for a SHA-pinned deterministic checkpoint; every CI run rebuilds the
+checkpoint from its numpy seed and pushes it through the LIVE production
+converter + Flax forward. Any numerics change anywhere in that chain fails
+here against values that cannot drift. When a real torchvision checkpoint is
+available the same fixture format is re-cut from it (``--checkpoint``), and
+the opt-in battery then certifies real-weights parity on top.
+"""
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from tests.helpers.inception_goldens import (  # noqa: E402
+    GOLDEN_VERSION,
+    TAPS,
+    canonical_state_sha,
+    flax_taps_through_converter,
+    golden_images,
+    images_sha,
+    numpy_seeded_state_dict,
+    torch_taps,
+)
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "inception_goldens.npz")
+
+REGEN_HINT = (
+    "If this change is INTENTIONAL, re-cut the fixture with "
+    "`python scripts/make_inception_goldens.py` and commit the diff; the new "
+    "numbers become the pinned contract."
+)
+
+
+@pytest.fixture(scope="module")
+def golden():
+    data = dict(np.load(GOLDEN_PATH))
+    assert int(data["version"]) == GOLDEN_VERSION
+    return data
+
+
+@pytest.fixture(scope="module")
+def state(golden):
+    if str(golden["source"]).startswith("numpy-seeded"):
+        return numpy_seeded_state_dict()
+    real = os.environ.get("METRICS_TPU_INCEPTION_WEIGHTS", "")
+    if not (real and os.path.exists(real) and not real.endswith(".npz")):
+        pytest.skip("goldens were cut from a real checkpoint; set METRICS_TPU_INCEPTION_WEIGHTS to it")
+    return torch.load(real, map_location="cpu", weights_only=True)
+
+
+def test_checkpoint_regenerates_bit_exactly(golden, state):
+    """The numpy-RandomState fill must reproduce the EXACT checkpoint the
+    goldens were cut from — numpy's frozen bitstream guarantees this across
+    numpy/torch versions. A SHA change means the generator drifted: the
+    goldens no longer describe the weights under test."""
+    assert canonical_state_sha(state) == str(golden["checkpoint_sha"]), (
+        "checkpoint fingerprint drifted from the committed goldens. " + REGEN_HINT
+    )
+
+
+def test_golden_images_regenerate_bit_exactly(golden):
+    assert images_sha(golden_images()) == str(golden["images_sha"]), (
+        "golden input images drifted. " + REGEN_HINT
+    )
+
+
+def test_flax_converter_pipeline_matches_goldens(golden, state):
+    """THE pin: live converter + Flax forward vs frozen torch features.
+    Tolerance carries ~5x headroom over the observed cross-backend fp
+    divergence at cut time (scripts/make_inception_goldens.py prints it)."""
+    ours = flax_taps_through_converter(state, golden_images())
+    for tap in TAPS:
+        ref = golden[f"tap_{tap}"].astype(np.float32)
+        assert ours[tap].shape == ref.shape
+        np.testing.assert_allclose(
+            ours[tap], ref, rtol=1e-2, atol=5e-3,
+            err_msg=f"tap {tap} diverged from the golden fixture. " + REGEN_HINT,
+        )
+
+
+def test_torch_oracle_matches_goldens(golden, state):
+    """The oracle itself is evidence (it is what real-weights parity will be
+    judged against), so its forward is pinned too: float16 storage is the
+    only permitted difference."""
+    ref = torch_taps(state, golden_images())
+    for tap in TAPS:
+        stored = golden[f"tap_{tap}"].astype(np.float32)
+        np.testing.assert_allclose(
+            ref[tap], stored, rtol=2e-3, atol=1e-3,
+            err_msg=f"torch oracle drifted on tap {tap}. " + REGEN_HINT,
+        )
