@@ -14,8 +14,7 @@ histogram's one-hot-contraction bincount does ``N·C²·T`` work, a factor C
 more than the fused compare, so it can never win). The kernel was removed;
 the compiler's fusion is the right tool here.
 """
-import warnings
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,22 +24,13 @@ def binned_tp_fp_fn(
     preds: jax.Array,
     target: jax.Array,
     thresholds: jax.Array,
-    use_pallas: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Binned TP/FP/FN counts: three ``(C, T)`` float32 count tensors.
 
-    ``use_pallas`` is deprecated and ignored (one-release shim for 0.3.x
-    callers): the Pallas histogram kernel it selected measurably lost to
-    XLA's fused compare at every size and was removed — see the module
-    docstring. It will be dropped in 0.5.0.
+    (The 0.3.x ``use_pallas`` kwarg was deprecated in 0.4.0 and removed in
+    0.5.0 as its deprecation warning promised — see the module docstring for
+    why the Pallas histogram kernel lost.)
     """
-    if use_pallas is not None:
-        warnings.warn(
-            "`use_pallas` is deprecated and ignored: the Pallas binned-count"
-            " kernel was removed (XLA's fused broadcast-compare is faster at"
-            " every size). The argument will be removed in 0.5.0.",
-            DeprecationWarning,
-        )
     t = (target == 1)[:, :, None]  # (N, C, 1)
     p = preds[:, :, None] >= thresholds[None, None, :]  # (N, C, T)
     tps = jnp.sum(t & p, axis=0).astype(jnp.float32)

@@ -29,16 +29,37 @@ def _load_about() -> dict:
 
 _about = _load_about()
 
+
+def _load_readme() -> str:
+    with open(os.path.join(_PATH_ROOT, "README.md"), encoding="utf-8") as fh:
+        return fh.read()
+
+
 setup(
     name="metrics-tpu",
     version=_about["__version__"],
     description=_about["__docs__"],
+    long_description=_load_readme(),
+    long_description_content_type="text/markdown",
+    author=_about["__author__"],
     license=_about["__license__"],
     packages=find_packages(exclude=["tests", "tests.*"]),
+    include_package_data=True,
+    package_data={"metrics_tpu": ["py.typed"]},
+    zip_safe=False,
     python_requires=">=3.9",
     install_requires=_load_requirements(_PATH_ROOT),
     extras_require={
         name: _load_requirements(os.path.join(_PATH_ROOT, "requirements"), f"{name}.txt")
         for name in ("image", "test", "integrate")
     },
+    classifiers=[
+        "Development Status :: 4 - Beta",
+        "Intended Audience :: Developers",
+        "Intended Audience :: Science/Research",
+        "License :: OSI Approved :: Apache Software License",
+        "Operating System :: OS Independent",
+        "Programming Language :: Python :: 3",
+        "Topic :: Scientific/Engineering :: Artificial Intelligence",
+    ],
 )

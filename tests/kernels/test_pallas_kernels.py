@@ -63,16 +63,13 @@ class TestBinnedCounts:
             assert arr.shape == (3, 5)
             np.testing.assert_array_equal(np.asarray(arr), 0.0)
 
-    def test_use_pallas_kwarg_deprecated_but_accepted(self):
-        # one-release shim for 0.3.x callers of the removed Pallas kernel
-        import pytest
+    def test_use_pallas_kwarg_removed_in_050(self):
+        # the 0.4.x deprecation shim promised removal in 0.5.0 — pin that the
+        # promise was kept (a reinstated kwarg would silently un-break 0.3.x
+        # callers who must migrate)
+        import inspect
 
-        preds = jnp.asarray([[0.7], [0.2]], jnp.float32)
-        target = jnp.asarray([[1], [0]])
-        thresholds = jnp.asarray([0.5], jnp.float32)
-        with pytest.warns(DeprecationWarning, match="use_pallas"):
-            tp, fp, fn = binned_tp_fp_fn(preds, target, thresholds, use_pallas=False)
-        np.testing.assert_array_equal(np.asarray(tp), [[1.0]])
+        assert "use_pallas" not in inspect.signature(binned_tp_fp_fn).parameters
 
     def test_nan_preds_never_fire(self):
         # nan >= thr is False at every threshold
