@@ -132,7 +132,12 @@ class Metric(ABC):
             default ``axis_name`` of :meth:`apply_compute`/:meth:`apply_forward`
             (an explicit ``axis_name=`` argument wins). ``None`` means "all
             participants" (and no in-graph sync unless a call site passes an
-            axis).
+            axis). A collection of process indices (e.g. ``[0, 1]``) instead
+            scopes the EAGER ``compute()`` gather to that subset of
+            processes — disjoint groups sync independently and concurrently
+            (``utilities/distributed.py:gather_all_arrays``), matching the
+            reference's sub-group semantics
+            (``torchmetrics/utilities/distributed.py:113-135``).
         dist_sync_fn: override for the eager gather used at ``compute()``;
             receives one state array and returns the per-participant list.
     """

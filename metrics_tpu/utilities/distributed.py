@@ -120,29 +120,64 @@ _GATHER_DTYPES = (
 )
 
 
-def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]:
-    """Gather one array from every process into a list (eager, epoch-boundary path).
+def _resolve_group(group: Optional[Any], nprocs: int) -> List[int]:
+    """Resolve a ``process_group`` argument to the member process indices.
 
-    Handles per-process shape raggedness with the pad-to-max/trim protocol the
-    reference uses (``utilities/distributed.py:126-149``): gather all shape
-    descriptors, pad each local tensor to the elementwise max, all-gather,
-    then trim each result back to its true shape. A rank with NO data (a
+    ``None`` -> all processes. A collection of ints -> that subgroup (the
+    eager analogue of the reference's ``torch.distributed`` group handle,
+    ``utilities/distributed.py:113-135``). Mesh-axis names (a str, or a
+    collection of strs) are the IN-GRAPH sub-group mechanism; on the eager
+    path they cannot name a process subset, so they gather everything —
+    the documented fallback for metrics whose ``process_group`` is an axis.
+    """
+    if group is None or isinstance(group, str):
+        return list(range(nprocs))
+    try:
+        items = list(group)
+    except TypeError:
+        raise TypeError(
+            f"group must be None, a mesh-axis name, or a collection of process indices; got {group!r}"
+        )
+    if all(isinstance(i, str) for i in items) and items:
+        return list(range(nprocs))  # tuple of mesh-axis names
+    members = sorted({int(i) for i in items})
+    if not members:
+        raise ValueError("group must name at least one process index")
+    if members[0] < 0 or members[-1] >= nprocs:
+        raise ValueError(f"group {group!r} names process indices outside [0, {nprocs})")
+    return members
+
+
+def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]:
+    """Gather one array per group member into a list (eager, epoch-boundary path).
+
+    The analogue of the reference's ``gather_all_tensors``
+    (``utilities/distributed.py:113-149``), including its ragged protocol:
+    shape descriptors are exchanged first, then payloads, and each member's
+    result is restored to its true shape. A member with NO data (a
     never-updated list state — 0 elements, possibly of a different rank and
     placeholder dtype, the reference's 0-length case
-    ``tests/bases/test_ddp.py:63-81``) still participates: the descriptor
-    exchange aligns its contribution to the peers' ndim/dtype and its
-    trimmed result is a 0-row tensor. ``group`` is accepted for API parity;
-    use mesh-axis names with the in-graph path for sub-group reductions.
+    ``tests/bases/test_ddp.py:63-81``) still participates: its contribution
+    is a 0-row tensor aligned to the peers' ndim/dtype (a 0-length vector
+    when the peers are 0-d scalars, which have no row axis to borrow).
+
+    ``group`` restricts the RESULT to a subset of processes (see
+    :func:`_resolve_group`): only members' arrays are returned, in ascending
+    process order, and non-members' data never enters the output. Because
+    JAX's ``process_allgather`` is a global collective, the underlying
+    transport always spans all processes — so disjoint groups sync
+    *concurrently*: every process must call ``gather_all_arrays`` the same
+    number of times (each with its own group), and one transport round
+    serves all groups at once. Payloads ride a byte-level buffer, so
+    different groups may hold data of entirely different shapes, ndims and
+    dtypes in the same round; consistency is only required *within* a group.
     """
     result = jnp.asarray(result)
     if not distributed_available():
         return [result]
 
     nprocs = world_size()
-
-    if result.ndim == 0:
-        gathered = _process_allgather(result)
-        return [jnp.asarray(gathered[i]) for i in range(nprocs)]
+    members = _resolve_group(group, nprocs)
 
     if result.ndim > _MAX_GATHER_NDIM:
         raise ValueError(f"gather_all_arrays supports up to {_MAX_GATHER_NDIM} dims, got {result.ndim}")
@@ -157,50 +192,76 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
     all_desc = _process_allgather(desc)  # (nprocs, 10)
 
     ndims = all_desc[:, 0].astype(int)
-    counts = np.array(
-        [int(np.prod(all_desc[i, 1 : 1 + ndims[i]])) if ndims[i] else 0 for i in range(nprocs)]
-    )
-    nonempty = counts > 0
-    if nonempty.any():
-        ref_ranks = np.where(nonempty)[0]
-        if len({int(ndims[i]) for i in ref_ranks}) > 1:
-            raise ValueError(
-                f"gather_all_arrays: ranks hold data of different ranks (ndims {ndims.tolist()})"
+    # np.prod([]) == 1.0, so a 0-d scalar naturally counts as one element
+    counts = np.array([int(np.prod(all_desc[i, 1 : 1 + ndims[i]])) for i in range(nprocs)])
+    dtype_codes = all_desc[:, -1].astype(int)
+    itemsizes = np.array([_GATHER_DTYPES[c].itemsize for c in dtype_codes])
+
+    # intra-group alignment: consistency is required over the NONEMPTY members
+    # of MY group only — other groups may hold anything in the same round. A
+    # violation must NOT raise before the payload round below: other (valid)
+    # groups are already committed to that global collective, and a rank that
+    # bails early would leave them hung. Record the error, keep marching
+    # through the transport, raise after.
+    group_error: Optional[str] = None
+    member_nonempty = [i for i in members if counts[i] > 0]
+    if member_nonempty:
+        if len({int(ndims[i]) for i in member_nonempty}) > 1:
+            group_error = (
+                "gather_all_arrays: group members hold data of different ranks"
+                f" (ndims {[int(ndims[i]) for i in members]})"
             )
-        if len({int(all_desc[i, -1]) for i in ref_ranks}) > 1:
-            raise ValueError("gather_all_arrays: ranks hold data of different dtypes")
-        ref_ndim = int(ndims[ref_ranks[0]])
-        target_dtype = _GATHER_DTYPES[int(all_desc[ref_ranks[0], -1])]
-    else:  # every rank is empty: any consistent alignment works
-        ref_ndim = int(ndims.max())
-        target_dtype = _GATHER_DTYPES[int(all_desc[0, -1])]
+        elif len({int(dtype_codes[i]) for i in member_nonempty}) > 1:
+            group_error = "gather_all_arrays: group members hold data of different dtypes"
+        ref_ndim = int(ndims[member_nonempty[0]])
+        target_dtype = _GATHER_DTYPES[int(dtype_codes[member_nonempty[0]])]
+    else:  # every member is empty: any consistent alignment works
+        ref_ndim = int(max(ndims[i] for i in members))
+        target_dtype = _GATHER_DTYPES[int(dtype_codes[members[0]])]
 
-    # per-rank true shapes aligned to ref_ndim; an empty rank's contribution
-    # becomes 0 rows of the peers' trailing dims
-    shapes = np.zeros((nprocs, ref_ndim), dtype=np.int64)
-    for i in range(nprocs):
+    # per-member true shapes aligned to ref_ndim; an empty member's
+    # contribution becomes 0 rows of the peers' trailing dims (0-d peers
+    # have no row axis to borrow, so it degrades to a 0-length vector —
+    # never a fabricated scalar)
+    shapes = {}
+    for i in members:
+        s = np.zeros(ref_ndim, dtype=np.int64)
         nd = min(int(ndims[i]), ref_ndim)
-        shapes[i, :nd] = all_desc[i, 1 : 1 + nd]
-    max_shape = shapes[nonempty].max(axis=0) if nonempty.any() else np.ones(ref_ndim, np.int64)
-    for i in np.where(~nonempty)[0]:
-        shapes[i] = np.concatenate([[0], max_shape[1:]])  # 0 rows of the peers' trailing dims
+        s[:nd] = all_desc[i, 1 : 1 + nd]
+        shapes[i] = s
+    if member_nonempty:
+        max_shape = np.stack([shapes[i] for i in member_nonempty]).max(axis=0)
+    else:
+        max_shape = np.ones(ref_ndim, dtype=np.int64)
+    for i in members:
+        if counts[i] == 0:
+            shapes[i] = np.concatenate([[0], max_shape[1:]]) if ref_ndim > 0 else np.array([0])
 
-    rank = jax.process_index()
-    local = result.astype(target_dtype)
-    if counts[rank] == 0:
-        local = jnp.zeros(tuple(shapes[rank]), target_dtype)
+    # byte-level transport: ONE global payload round carries every process's
+    # raw data (each group decodes only its own members), padded to the
+    # global max byte length — at most the volume of the reference's
+    # pad-to-elementwise-max, and shape/dtype-heterogeneous across groups
+    nbytes = counts * itemsizes
+    max_bytes = int(nbytes.max())
+    if max_bytes == 0:
+        gathered = None
+    else:
+        buf = np.zeros(max_bytes, dtype=np.uint8)
+        local_bytes = np.frombuffer(np.ascontiguousarray(np.asarray(result)).tobytes(), np.uint8)
+        buf[: local_bytes.size] = local_bytes
+        gathered = _process_allgather(buf)  # (nprocs, max_bytes)
 
-    if bool((shapes == max_shape[None, :]).all()):
-        gathered = _process_allgather(local)
-        return [jnp.asarray(gathered[i]) for i in range(nprocs)]
+    if group_error is not None:
+        raise ValueError(group_error)
 
-    pad_width = [(0, int(m - s)) for s, m in zip(local.shape, max_shape)]
-    padded = jnp.pad(local, pad_width)
-    gathered = _process_allgather(padded)
     out = []
-    for i in range(nprocs):
-        trim = tuple(slice(int(d)) for d in shapes[i])
-        out.append(jnp.asarray(gathered[i][trim]))
+    for i in members:
+        shape = tuple(int(d) for d in shapes[i])
+        if counts[i] == 0:
+            out.append(jnp.zeros(shape, target_dtype))
+            continue
+        raw = np.frombuffer(gathered[i].tobytes(), dtype=target_dtype, count=int(counts[i]))
+        out.append(jnp.asarray(raw.reshape(shape)))
     return out
 
 

@@ -352,3 +352,124 @@ def test_two_process_2d_mesh_data_axis_scoped_sync(tmp_path):
     ]
     flags = " ".join(kept + ["--xla_force_host_platform_device_count=4"])
     _run_process_workers(tmp_path, _SPMD_2D_WORKER, nprocs=2, extra_env={"XLA_FLAGS": flags})
+
+
+_DISJOINT_GROUPS_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank, port = int(sys.argv[1]), sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=4, process_id=rank
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from sklearn.metrics import accuracy_score, roc_auc_score
+
+    from metrics_tpu import Accuracy, AUROC
+    from metrics_tpu.utilities.distributed import gather_all_arrays
+
+    GROUP = [0, 1] if rank < 2 else [2, 3]
+    PEER = GROUP.index(rank)
+
+    # ---- metric-level independence: each group syncs ONLY its own data.
+    # Groups hold entirely different streams; a leak across the boundary
+    # would shift both groups' values. Calls interleave on the global
+    # transport, so every rank makes the same compute() sequence.
+    NB, B, NC = 4, 16, 4
+    rng = np.random.RandomState(100 + GROUP[0])  # same stream WITHIN a group
+    probs = rng.rand(NB, B, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.randint(0, NC, (NB, B))
+    bin_probs = rng.rand(NB, B).astype(np.float32)
+    bin_target = rng.randint(0, 2, (NB, B))
+
+    acc = Accuracy(process_group=GROUP)   # scalar sum states
+    auroc = AUROC(process_group=GROUP)    # ragged cat states
+    for i in range(PEER, NB, 2):
+        acc.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        auroc.update(jnp.asarray(bin_probs[i]), jnp.asarray(bin_target[i]))
+
+    got_acc = float(acc.compute())
+    want_acc = accuracy_score(target.reshape(-1), probs.argmax(-1).reshape(-1))
+    np.testing.assert_allclose(got_acc, want_acc, atol=1e-6)
+
+    got_auroc = float(auroc.compute())
+    want_auroc = roc_auc_score(bin_target.reshape(-1), bin_probs.reshape(-1))
+    np.testing.assert_allclose(got_auroc, want_auroc, atol=1e-6)
+
+    # ---- transport-level: ONE round may carry different ndims AND dtypes
+    # per group (group A: ragged 1-D float32; group B: 2-D int64)
+    if rank < 2:
+        mine = jnp.arange(3 * (PEER + 1), dtype=jnp.float32) + 10 * rank
+        out = gather_all_arrays(mine, group=GROUP)
+        assert len(out) == 2, len(out)
+        np.testing.assert_array_equal(np.asarray(out[0]), np.arange(3, dtype=np.float32))
+        np.testing.assert_array_equal(np.asarray(out[1]), np.arange(6, dtype=np.float32) + 10)
+    else:
+        mine = jnp.full((2, 2), rank, dtype=jnp.int64)
+        out = gather_all_arrays(mine, group=GROUP)
+        assert len(out) == 2, len(out)
+        np.testing.assert_array_equal(np.asarray(out[0]), np.full((2, 2), 2, np.int64))
+        np.testing.assert_array_equal(np.asarray(out[1]), np.full((2, 2), 3, np.int64))
+
+    # ---- empty member in one group, scalars in the other, same round
+    if rank < 2:
+        mine = jnp.arange(6, dtype=jnp.float32).reshape(2, 3) if rank == 0 else jnp.zeros((0,), jnp.float32)
+        out = gather_all_arrays(mine, group=GROUP)
+        assert np.asarray(out[0]).shape == (2, 3)
+        assert np.asarray(out[1]).shape == (0, 3), np.asarray(out[1]).shape
+    else:
+        out = gather_all_arrays(jnp.asarray(float(rank)), group=GROUP)
+        assert np.asarray(out[0]).shape == ()
+        np.testing.assert_allclose([float(v) for v in out], [2.0, 3.0])
+
+    # ---- non-member masking: everyone names group [0, 1]; ranks 2/3 are
+    # bystanders whose payload must NOT appear in anyone's result
+    out = gather_all_arrays(jnp.asarray([100.0 + rank]), group=[0, 1])
+    assert len(out) == 2, len(out)
+    np.testing.assert_allclose(np.asarray(out[0]), [100.0])
+    np.testing.assert_allclose(np.asarray(out[1]), [101.0])
+
+    # ---- empty member whose peers are 0-d scalars: no row axis to borrow,
+    # so the contribution degrades to a 0-length vector, never a phantom 0.0
+    if rank < 2:
+        mine = jnp.asarray(7.5) if rank == 0 else jnp.zeros((0,), jnp.float32)
+        out = gather_all_arrays(mine, group=GROUP)
+        assert np.asarray(out[0]).shape == () and float(out[0]) == 7.5
+        assert np.asarray(out[1]).shape == (0,), np.asarray(out[1]).shape
+    else:
+        out = gather_all_arrays(jnp.full((3,), rank, jnp.int32), group=GROUP)
+        assert [int(v[0]) for v in out] == [2, 3]
+
+    # ---- intra-group ndim mismatch raises on the BAD group only, AFTER the
+    # payload round — the valid group must complete, not hang
+    raised = False
+    try:
+        if rank == 0:
+            gather_all_arrays(jnp.zeros((2,), jnp.float32), group=GROUP)
+        elif rank == 1:
+            gather_all_arrays(jnp.zeros((2, 2), jnp.float32), group=GROUP)
+        else:
+            out = gather_all_arrays(jnp.asarray([float(rank)]), group=GROUP)
+            np.testing.assert_allclose(np.concatenate([np.asarray(v) for v in out]), [2.0, 3.0])
+    except ValueError as err:
+        assert "different ranks" in str(err)
+        raised = True
+    assert raised == (rank < 2), (rank, raised)
+
+    print(f"PARITY_OK rank={rank}", flush=True)
+    """
+)
+
+
+def test_four_process_disjoint_group_sync(tmp_path):
+    """Two DISJOINT 2-process groups sync independently and concurrently on
+    the eager path (``process_group=[0,1]`` vs ``[2,3]``) — the reference
+    threads its group handle the same way
+    (``torchmetrics/utilities/distributed.py:113-135``). Also pins the
+    byte-transport properties: per-round heterogeneous ndim/dtype across
+    groups, an empty member inside one group, and non-member masking."""
+    _run_process_workers(tmp_path, _DISJOINT_GROUPS_WORKER, nprocs=4)
