@@ -22,7 +22,8 @@ doctest-docs:
 # The driver's multi-chip sharding gate: full distributed metric step on an
 # 8-device mesh (falls back to virtual CPU devices when chips are missing).
 dryrun:
-	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('DRYRUN OK')"
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('DRYRUN 8 OK')"
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(5); print('DRYRUN 5 OK')"
 
 # Every example script end to end (CPU; the distributed one on the virtual
 # 8-device mesh) — examples are user-facing docs and must not rot. The

@@ -53,6 +53,8 @@ class MetricCollection:
         self.add_metrics(metrics, *additional_metrics)
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
+        self._jit_forward_enabled = False
+        self._jit_forward_fn: Optional[Any] = None
 
     # ------------------------------------------------------------------
     # stateful interface
@@ -65,6 +67,8 @@ class MetricCollection:
         """Call forward on every metric; positional args broadcast, kwargs are
         filtered per metric signature. Shared-update classes (see
         :meth:`_shared_deltas`) run their partial-statistics pass once."""
+        if self._jit_forward_enabled:
+            return self._forward_jitted(*args, **kwargs)
         shared = self._shared_deltas(*args, **kwargs)
         out = {}
         for name, m in self.items(keep_base=True):
@@ -87,6 +91,57 @@ class MetricCollection:
                 m._update_from_deltas(*shared[name])
             else:
                 m.update(*args, **m._filter_kwargs(**kwargs))
+
+    def jit_forward(self, enable: bool = True) -> "MetricCollection":
+        """Compile the collection's stateful ``forward`` into ONE XLA program.
+
+        Same contract and trades as :meth:`Metric.jit_forward` (host-side
+        value validation skipped, one recompile per new input shape), with
+        the collection-level wins on top: the shared-update classes
+        canonicalize once inside the single program, and XLA fuses across
+        members. Every member must individually satisfy the
+        :meth:`Metric.jit_forward` constraints (no unbounded list states, no
+        ``dist_sync_on_step``)."""
+        if not enable:
+            self._jit_forward_enabled = False
+            self._jit_forward_fn = None
+            return self
+        for name, m in self.items(keep_base=True):
+            try:
+                # side-effect-free member validation: a member's OWN
+                # jit_forward enablement (and built cache) stays untouched
+                m._jit_forward_gate()
+            except ValueError as err:
+                raise ValueError(f"member {name!r}: {err}") from None
+        self._jit_forward_enabled = True
+        self._jit_forward_fn = None
+        return self
+
+    def _forward_jitted(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        import functools
+
+        import jax
+
+        if self._jit_forward_fn is None:
+            self._jit_forward_fn = jax.jit(functools.partial(self.apply_forward, axis_name=None))
+        state = {name: m._get_states() for name, m in self.items(keep_base=True)}
+        new_state, values = self._jit_forward_fn(state, *args, **kwargs)
+        for name, m in self.items(keep_base=True):
+            m._set_states(new_state[name])
+            m._update_called = True
+            m._computed = None
+            if not m.compute_on_step:
+                # eager-contract parity: such members return None on step
+                values[self._set_name(name)] = None
+            m._forward_cache = values[self._set_name(name)]
+        return values
+
+    def __getstate__(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if k != "_jit_forward_fn"}
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self._jit_forward_fn = None
 
     def _class_groups(self) -> Dict[Tuple, list]:
         """Member names per shared-update equivalence key (insertion order)."""

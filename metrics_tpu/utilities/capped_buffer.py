@@ -304,12 +304,14 @@ class CappedBufferMixin:
             per_label = lambda c: kernel(preds[:, c], (target == c).astype(jnp.int32), valid)  # noqa: E731
         return jax.vmap(per_label)(jnp.arange(self.num_classes))
 
-    def _check_degenerate_classes(self, target: Array, valid: Array) -> None:
-        """Mirror the cat path's single-class raises (``roc.py:46,50``) on the
-        eager capacity path. Inside jit/shard_map raising is impossible — the
-        masked kernels return the same 0/0 NaN the reference's arithmetic
-        would produce instead; callers whose reference analogue *returns* NaN
-        rather than raising (average precision) skip this check.
+    def _check_degenerate_classes(self, target: Array, valid: Array) -> Optional[Array]:
+        """Raise on degenerate (single-class) eager buffers; return per-class
+        supports for reuse. Mirrors the cat path's single-class raises
+        (``roc.py:46,50``) on the eager capacity path. Inside jit/shard_map
+        raising is impossible — the masked kernels return the same 0/0 NaN
+        the reference's arithmetic would produce instead; callers whose
+        reference analogue *returns* NaN rather than raising (average
+        precision) skip this check.
 
         The reductions run on device so only C+1 scalars cross to host (the
         buffers this mode is built for are ~200k samples). An empty buffer is

@@ -654,7 +654,49 @@ def bench_train_overhead():
     return "train_step_metric_overhead", ours, ref, "pct"
 
 
-def run_config(cfg, probe: bool = True) -> dict:
+def bench_eager_forward():
+    """First-contact stateful UX: ``metric(preds, target)`` per step,
+    host-driven on the CPU backend for BOTH sides — the README quickstart
+    loop (reference ``README.md:100-120``). Every other config times the
+    pure compiled path; this one tracks the torch-like stateful API
+    (VERDICT r4 #8). The headline value is ``Accuracy().jit_forward()`` —
+    the library's recommended form of this exact API (same call, same
+    state, one compiled program per step); the plain eager-dispatch time
+    ships alongside as ``eager_us`` (per-op jnp dispatch is host-bound,
+    the documented reason jit_forward exists). CPU-pinned via
+    ``_force_cpu`` because each eager step pays a host->device link
+    round-trip on the tunnel backend, which would measure the tunnel, not
+    the library."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    rng = np.random.RandomState(0)
+    p_np = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    t_np = rng.randint(0, NUM_CLASSES, BATCH)
+    preds, target = jnp.asarray(p_np), jnp.asarray(t_np)
+    # materialize the on-step value each iteration: jax dispatch is async
+    # even on CPU, torch's loop below is synchronous
+    eager = Accuracy()
+    eager_s = _time_eager_loop(lambda: jax.block_until_ready(eager(preds, target)))
+    jitted = Accuracy().jit_forward()
+    ours = _time_eager_loop(lambda: jax.block_until_ready(jitted(preds, target)))
+
+    def ref(torchmetrics, torch):
+        m = torchmetrics.Accuracy()
+        p = torch.from_numpy(p_np)
+        t = torch.from_numpy(t_np)
+        return _time_eager_loop(lambda: m(p, t))
+
+    return "stateful_forward_step_cpu", ours, ref, "us/step", {"eager_us": round(eager_s * 1e6, 3)}
+
+
+#: run on the CPU backend (see bench_eager_forward docstring)
+bench_eager_forward._force_cpu = True
+
+
+def run_config(cfg, probe: bool = True, _repinned: bool = False) -> dict:
     """Run one bench config and shape the driver JSON line (NaN-safe).
 
     When ``probe`` is on (the default on the TPU backend), the endpoint is
@@ -668,6 +710,22 @@ def run_config(cfg, probe: bool = True) -> dict:
     (fresh tunnel session ⇒ fresh endpoint assignment).
     """
     import jax
+
+    if getattr(cfg, "_force_cpu", False) and not _repinned:
+        # the tunnel platform is force-registered via jax.config, so env
+        # vars alone don't switch backends; repin AND restore afterwards so
+        # a same-process all-config run (main() without --config) cannot
+        # leak the CPU pin into the configs that follow
+        import jax.extend.backend as _jeb
+
+        prev_platforms = jax.config.jax_platforms
+        jax.config.update("jax_platforms", "cpu")
+        _jeb.clear_backends()
+        try:
+            return run_config(cfg, probe=False, _repinned=True)
+        finally:
+            _jeb.clear_backends()
+            jax.config.update("jax_platforms", prev_platforms)
 
     probe = probe and jax.default_backend() == "tpu"
     health = probe_endpoint() if probe else None
@@ -725,6 +783,7 @@ CONFIG_META = {
     "bench_fid_compute": ("fid_epoch_compute_2048d", "us/step"),
     "bench_pallas_confmat": ("confmat_pallas_vs_xla_step", "us/step"),
     "bench_train_overhead": ("train_step_metric_overhead", "pct"),
+    "bench_eager_forward": ("stateful_forward_step_cpu", "us/step"),
 }
 
 #: driver order — the flagship collection config LAST (the driver's headline)
@@ -737,6 +796,7 @@ CONFIGS = [
     bench_fid_compute,
     bench_pallas_confmat,
     bench_train_overhead,
+    bench_eager_forward,
     bench_collection,
 ]
 

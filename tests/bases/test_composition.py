@@ -98,7 +98,13 @@ def test_metrics_floordiv_matches_torch_semantics():
     recall // (accuracy - recall) with micro recall == accuracy),
     0.0 // 0.0 is NaN, and finite quotients get the fmod-based fixup so
     a rounded quotient just across an integer still floors correctly.
-    Integer operands keep integer floor-division semantics."""
+    Integer operands keep integer floor-division semantics.
+
+    Version assumption: these expectations (and the fuzz battery's use of
+    the installed torch as oracle) presume torch >= 1.13, where
+    ``floor_divide`` floors; pre-1.13 torch TRUNCATED, so e.g.
+    ``-7.0 // 2.0`` would be -3 there and this parity claim would change
+    meaning if the reference pin ever moved that far back."""
     cases = [(5.0, 0.0, np.inf), (-5.0, 0.0, -np.inf), (0.0, 0.0, np.nan),
              (8.754882, -0.09516175, -93.0),  # fixup case: floor(a/b) would give -92
              (7.0, 2.0, 3.0), (-7.0, 2.0, -4.0),

@@ -1,0 +1,206 @@
+"""``jit_forward``: the opt-in compiled stateful forward.
+
+The eager ``m(preds, target)`` loop dispatches every jnp op individually —
+host-bound at millisecond scale. ``jit_forward()`` swaps in a cached
+``jax.jit`` of the pure ``apply_forward`` behind the unchanged stateful API
+(``metrics_tpu/metric.py``); these tests pin value/state parity with the
+eager path, the lifecycle interactions (pickle, clone, reset, disable), and
+the documented refusals (unbounded list states, ``dist_sync_on_step``,
+compositional metrics).
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    AverageMeter,
+    F1,
+    MetricCollection,
+    Precision,
+    Recall,
+)
+
+NB, B, NC = 5, 64, 7
+
+
+@pytest.fixture()
+def stream():
+    rng = np.random.RandomState(3)
+    probs = rng.rand(NB, B, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    return probs, rng.randint(0, NC, (NB, B))
+
+
+def test_matches_eager_forward_values_and_epoch(stream):
+    probs, target = stream
+    eager, jitted = Accuracy(), Accuracy().jit_forward()
+    for i in range(NB):
+        ve = eager(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        vj = jitted(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        np.testing.assert_allclose(np.asarray(ve), np.asarray(vj), atol=1e-7)
+    np.testing.assert_allclose(float(eager.compute()), float(jitted.compute()), atol=1e-7)
+
+
+def test_compute_on_step_false_accumulates_only(stream):
+    probs, target = stream
+    m = Accuracy(compute_on_step=False).jit_forward()
+    for i in range(NB):
+        assert m(jnp.asarray(probs[i]), jnp.asarray(target[i])) is None
+    oracle = Accuracy()
+    for i in range(NB):
+        oracle.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    np.testing.assert_allclose(float(m.compute()), float(oracle.compute()), atol=1e-7)
+
+
+def test_pickle_keeps_enablement_and_rebuilds_cache(stream):
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))  # build the cache
+    clone = pickle.loads(pickle.dumps(m))
+    assert clone._jit_forward_enabled and clone._jit_forward_fn is None
+    np.testing.assert_allclose(float(clone.compute()), float(m.compute()), atol=1e-7)
+    clone(jnp.asarray(probs[1]), jnp.asarray(target[1]))  # rebuilds and runs
+
+
+def test_reset_clone_disable(stream):
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    m.reset()
+    c = m.clone()
+    assert c._jit_forward_enabled
+    m.jit_forward(False)
+    assert not m._jit_forward_enabled and m._jit_forward_fn is None
+    # still works eagerly after disable
+    v = m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    assert np.asarray(v).shape == ()
+
+
+def test_weighted_kwarg_stream():
+    # kwargs ride the jitted call as traced pytree leaves
+    rng = np.random.RandomState(5)
+    eager, jitted = AverageMeter(), AverageMeter().jit_forward()
+    for _ in range(3):
+        v = jnp.asarray(rng.rand(16).astype(np.float32))
+        w = jnp.asarray(rng.rand(16).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(eager(v, w)), np.asarray(jitted(v, w)), atol=1e-6
+        )
+    np.testing.assert_allclose(float(eager.compute()), float(jitted.compute()), atol=1e-6)
+
+
+def test_refuses_unbounded_list_states():
+    with pytest.raises(ValueError, match="list states"):
+        AUROC().jit_forward()
+
+
+def test_capacity_mode_is_jittable(stream):
+    # the documented remedy: the fixed-shape capacity mode compiles
+    rng = np.random.RandomState(6)
+    scores = rng.rand(NB, B).astype(np.float32)
+    labels = rng.randint(0, 2, (NB, B))
+    eager = AUROC(capacity=NB * B)
+    jitted = AUROC(capacity=NB * B).jit_forward()
+    for i in range(NB):
+        eager(jnp.asarray(scores[i]), jnp.asarray(labels[i]))
+        jitted(jnp.asarray(scores[i]), jnp.asarray(labels[i]))
+    np.testing.assert_allclose(float(eager.compute()), float(jitted.compute()), atol=1e-6)
+
+
+def test_refuses_dist_sync_on_step():
+    with pytest.raises(ValueError, match="dist_sync_on_step"):
+        Accuracy(dist_sync_on_step=True).jit_forward()
+
+
+def test_refuses_compositional_but_disable_is_noop():
+    comp = Accuracy() + 1.0
+    with pytest.raises(ValueError, match="Compositional"):
+        comp.jit_forward()
+    comp.jit_forward(False)  # generic teardown idiom must not crash
+
+
+def test_refuses_custom_pure_state_wrappers():
+    # BootStrapper owns a {'key', children...} pure-state layout that the
+    # stateful _get_states/_set_states pair does not round-trip — accepted
+    # then crashing at first call was the round-5 review catch
+    from metrics_tpu import BootStrapper
+
+    with pytest.raises(ValueError, match="pure-state protocol"):
+        BootStrapper(Accuracy(), num_bootstraps=4).jit_forward()
+
+
+def test_collection_single_program_parity(stream):
+    probs, target = stream
+    members = lambda: [
+        Accuracy(),
+        Precision(average="macro", num_classes=NC),
+        Recall(average="macro", num_classes=NC),
+        F1(average="macro", num_classes=NC),
+    ]
+    eager = MetricCollection(members())
+    jitted = MetricCollection(members()).jit_forward()
+    for i in range(NB):
+        ve = eager(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        vj = jitted(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        assert set(ve) == set(vj)
+        for k in ve:
+            np.testing.assert_allclose(np.asarray(ve[k]), np.asarray(vj[k]), atol=1e-6, err_msg=k)
+    ce, cj = eager.compute(), jitted.compute()
+    for k in ce:
+        np.testing.assert_allclose(np.asarray(ce[k]), np.asarray(cj[k]), atol=1e-6, err_msg=k)
+
+
+def test_collection_rejects_ineligible_member():
+    with pytest.raises(ValueError, match="AUROC"):
+        MetricCollection([Accuracy(), AUROC()]).jit_forward()
+
+
+def test_collection_validation_preserves_member_enablement(stream):
+    probs, target = stream
+    acc = Accuracy().jit_forward()
+    acc(jnp.asarray(probs[0]), jnp.asarray(target[0]))  # build member cache
+    fn = acc._jit_forward_fn
+    col = MetricCollection([acc]).jit_forward()
+    col.jit_forward(False)
+    # member-level enablement and cache survive the collection's validation
+    assert acc._jit_forward_enabled and acc._jit_forward_fn is fn
+
+
+def test_collection_member_compute_on_step_false_returns_none(stream):
+    probs, target = stream
+    col = MetricCollection(
+        {"on": Accuracy(), "off": Accuracy(compute_on_step=False)}
+    ).jit_forward()
+    out = col(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    assert out["off"] is None  # eager-contract parity
+    assert np.asarray(out["on"]).shape == ()
+    np.testing.assert_allclose(float(col.compute()["off"]), float(col.compute()["on"]), atol=1e-7)
+
+
+def test_collection_pickle(stream):
+    probs, target = stream
+    c = MetricCollection([Accuracy()]).jit_forward()
+    c(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    c2 = pickle.loads(pickle.dumps(c))
+    assert c2._jit_forward_enabled and c2._jit_forward_fn is None
+    c2(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+
+
+def test_jitted_is_actually_compiled(stream):
+    """The jitted path must not re-dispatch eagerly: one traced call, then
+    cached executions (trace counting via a wrapped update)."""
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))  # trace + compile
+    fn = m._jit_forward_fn
+    m(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    assert m._jit_forward_fn is fn  # cache retained
+    # same shape -> no retrace: jax's jit cache hit means update isn't re-run
+    # at the Python level; assert via jit cache size stability
+    assert fn._cache_size() == 1

@@ -196,6 +196,31 @@ class TestCapacityDegenerateStreams:
         m.update(jnp.asarray([0.2, 0.8, 0.4]), jnp.asarray([0, 0, 0]))
         assert np.isnan(float(m.compute()))
 
+    def test_in_graph_single_class_is_nan_not_zero(self):
+        """The IN-GRAPH contract behind the eager raises above: under jit the
+        host check cannot run, and a single-class buffer must propagate the
+        reference-arithmetic 0/0 NaN — a guard silently returning 0 is the
+        regression this pins (ADVICE r4; fuzz seed 3001 found the eager
+        analogue)."""
+        m = AUROC(capacity=16)
+        state = m.apply_update(
+            m.init_state(), jnp.asarray([0.2, 0.8]), jnp.asarray([1, 1])
+        )
+        value = jax.jit(m.apply_compute)(state)
+        assert np.isnan(float(value)), float(value)
+
+    def test_in_graph_multiclass_absent_class_is_nan_not_zero(self):
+        """Macro and support-weighted averages must carry the absent-class
+        NaN through (NaN*0 weight included), not zero it."""
+        for average in ("macro", "weighted"):
+            m = AUROC(capacity=16, num_classes=3, average=average)
+            probs = _normalize_rows(_rng.rand(8, 3).astype(np.float32))
+            state = m.apply_update(
+                m.init_state(), jnp.asarray(probs), jnp.asarray(np.array([0, 1] * 4))
+            )
+            value = np.asarray(jax.jit(m.apply_compute)(state))
+            assert np.isnan(value).any(), (average, value)
+
     def test_empty_buffer_is_nan_not_a_raise(self):
         m = AUROC(capacity=16)
         with pytest.warns(UserWarning, match="called before"):

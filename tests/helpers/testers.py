@@ -170,10 +170,16 @@ class MetricTester:
 
             result = sharded_compute(rank_metrics[0], rank_metrics)
 
-            total_preds = np.concatenate([np.asarray(p) for p in preds])
-            total_target = np.concatenate([np.asarray(t) for t in target])
+            # the synced cat state is rank-major (rank 0's batches, then rank
+            # 1's, ...), so feed the oracle in the SAME stripe order: exact
+            # for per-sample ``reduction='none'`` outputs (the reference's
+            # harness runs this leg too, testers.py:154-157) and a no-op for
+            # order-insensitive reductions
+            order = [i for r in range(world) for i in range(r, NUM_BATCHES, world)]
+            total_preds = np.concatenate([np.asarray(preds[i]) for i in order])
+            total_target = np.concatenate([np.asarray(target[i]) for i in order])
             total_kwargs = {
-                k: (np.concatenate([np.asarray(v[i]) for i in range(NUM_BATCHES)]) if hasattr(v, "__getitem__") and not np.isscalar(v) else v)
+                k: (np.concatenate([np.asarray(v[i]) for i in order]) if hasattr(v, "__getitem__") and not np.isscalar(v) else v)
                 for k, v in kwargs_update.items()
             }
             sk_result = sk_metric(total_preds, total_target, **total_kwargs)
