@@ -7,6 +7,7 @@ edge of the descriptor protocol — empty ranks, ndim/dtype alignment, error
 paths, random-shape fuzz — testable in-process in milliseconds.
 """
 import threading
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -16,19 +17,19 @@ import metrics_tpu.utilities.distributed as dist_mod
 from metrics_tpu.utilities.distributed import gather_all_arrays
 
 
-def run_ranks(locals_per_rank):
+def run_ranks(locals_per_rank, groups=None):
     """Run ``gather_all_arrays`` on N simulated ranks; returns per-rank results.
 
     Each rank runs in its own thread; a barrier-backed fake
     ``_process_allgather`` collects every rank's argument and hands back the
     stacked exchange — the protocol's real data flow, without processes.
+    ``groups`` optionally supplies the per-rank ``group=`` argument.
     """
     nprocs = len(locals_per_rank)
     barrier = threading.Barrier(nprocs)
     exchange = {}
     lock = threading.Lock()
     rank_of_thread = {}
-    generation = [0]
 
     def fake_allgather(x):
         rank = rank_of_thread[threading.get_ident()]
@@ -45,10 +46,20 @@ def run_ranks(locals_per_rank):
     def worker(rank):
         rank_of_thread[threading.get_ident()] = rank
         try:
-            results[rank] = gather_all_arrays(jnp.asarray(locals_per_rank[rank]))
+            group = groups[rank] if groups is not None else None
+            results[rank] = gather_all_arrays(jnp.asarray(locals_per_rank[rank]), group=group)
         except Exception as err:  # surfaced to the test
             errors[rank] = err
-            # release peers blocked on the barrier
+            # The real transport completes its collectives before any local
+            # raise, so peers that already satisfied the barrier must be
+            # allowed to drain (Barrier.abort() can break same-generation
+            # waiters that haven't woken yet); abort only for peers that are
+            # genuinely stuck awaiting a round this rank will never join.
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                if all(results[r] is not None or errors[r] is not None for r in range(nprocs)):
+                    return
+                time.sleep(0.01)
             barrier.abort()
 
     # patch the module's collective + distributed detection for the call
@@ -151,3 +162,92 @@ def test_fuzz_random_ragged_mixes(seed):
                 assert got.shape[0] == 0
             else:
                 np.testing.assert_array_equal(got, local)
+
+
+def test_disjoint_groups_heterogeneous_round():
+    """Two disjoint groups in one transport round with different ndims AND
+    dtypes; each rank sees exactly its group's members."""
+    locals_ = [
+        np.arange(3, dtype=np.float32),
+        np.arange(6, dtype=np.float32) + 10,
+        np.full((2, 2), 2, np.int64),
+        np.full((2, 2), 3, np.int64),
+    ]
+    groups = [[0, 1], [0, 1], [2, 3], [2, 3]]
+    results, errors = run_ranks(locals_, groups=groups)
+    assert errors == [None] * 4, errors
+    for rank in (0, 1):
+        assert len(results[rank]) == 2
+        np.testing.assert_array_equal(np.asarray(results[rank][0]), locals_[0])
+        np.testing.assert_array_equal(np.asarray(results[rank][1]), locals_[1])
+    for rank in (2, 3):
+        assert len(results[rank]) == 2
+        np.testing.assert_array_equal(np.asarray(results[rank][0]), locals_[2])
+        np.testing.assert_array_equal(np.asarray(results[rank][1]), locals_[3])
+
+
+def test_group_mismatch_raises_only_on_bad_group():
+    """ndim mismatch inside group A raises on A's ranks AFTER the payload
+    round; group B completes normally in the same round."""
+    locals_ = [np.zeros((2,), np.float32), np.zeros((2, 2), np.float32),
+               np.asarray([5.0], np.float32), np.asarray([6.0], np.float32)]
+    groups = [[0, 1], [0, 1], [2, 3], [2, 3]]
+    results, errors = run_ranks(locals_, groups=groups)
+    assert errors[0] is not None and "different ranks" in str(errors[0])
+    assert errors[1] is not None
+    assert errors[2] is None and errors[3] is None
+    np.testing.assert_array_equal(np.asarray(results[2][1]), [6.0])
+
+
+def test_mesh_axis_name_group_gathers_all():
+    """A str (mesh-axis) group is the in-graph mechanism; eagerly it keeps
+    the gather-everything fallback."""
+    locals_ = [np.asarray([1.0]), np.asarray([2.0])]
+    results, errors = run_ranks(locals_, groups=["data", "data"])
+    assert errors == [None, None]
+    for res in results:
+        assert [float(np.asarray(r)[0]) for r in res] == [1.0, 2.0]
+
+
+def test_invalid_group_rejected():
+    results, errors = run_ranks(
+        [np.asarray([1.0]), np.asarray([2.0])], groups=[[0, 5], [0, 5]]
+    )
+    assert all(e is not None and "outside" in str(e) for e in errors)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_random_group_partitions(seed):
+    """Random partition of ranks into groups, random (possibly heterogeneous)
+    shapes/dtypes per group, random empty members: each rank must recover
+    exactly its group members' data in ascending rank order."""
+    rng = np.random.RandomState(1000 + seed)
+    nprocs = int(rng.randint(2, 6))
+    ranks = list(rng.permutation(nprocs))
+    parts = []
+    while ranks:
+        take = int(rng.randint(1, len(ranks) + 1))
+        parts.append(sorted(int(r) for r in ranks[:take]))
+        ranks = ranks[take:]
+    group_of = {r: part for part in parts for r in part}
+    locals_ = [None] * nprocs
+    for part in parts:
+        trailing = tuple(rng.randint(1, 4, size=rng.randint(0, 2)))
+        dtype = rng.choice([np.float32, np.int64, np.float16])
+        for r in part:
+            rows = int(rng.randint(0, 4))
+            if rows == 0 and len(part) > 1:
+                locals_[r] = np.zeros((0,), np.float32)
+            else:
+                locals_[r] = (rng.rand(max(rows, 1), *trailing) * 50).astype(dtype)
+    results, errors = run_ranks(locals_, groups=[group_of[r] for r in range(nprocs)])
+    assert errors == [None] * nprocs, errors
+    for r in range(nprocs):
+        part = group_of[r]
+        assert len(results[r]) == len(part)
+        for got, member in zip(results[r], part):
+            got = np.asarray(got)
+            if locals_[member].shape[0] == 0:
+                assert got.shape[0] == 0
+            else:
+                np.testing.assert_array_equal(got, locals_[member])
