@@ -441,8 +441,12 @@ class Metric(ABC):
 
         The trade, inherent to tracing: host-side input *validation* is
         skipped (shape/dtype errors still surface from XLA; value checks
-        like out-of-range targets do not), and every new input shape pays
-        one recompile. Not available — raises ``ValueError`` — for metrics
+        like out-of-range targets do not), every new input shape pays one
+        recompile, and configuration the eager path infers from concrete
+        input VALUES must be passed explicitly — e.g. integer label
+        predictions need ``num_classes=`` at construction, or the first
+        jitted call raises the pure API's documented trace-time error.
+        Not available — raises ``ValueError`` — for metrics
         with unbounded list states (their state pytree grows per step,
         forcing a retrace each call; use the fixed-shape
         ``capacity=``/``streaming=`` modes), or with

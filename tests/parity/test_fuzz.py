@@ -328,9 +328,25 @@ def test_fuzz_metric_collection(torchmetrics_ref, seed):
     )
 
     use_forward = rng.rand() < 0.5
+    if use_forward and rng.rand() < 0.5:
+        # the compiled stateful path must be just as unobservable; every
+        # pool member is eligible (fixed-shape states — the ineligible-member
+        # refusal is pinned by test_jit_forward.py)
+        ours.jit_forward()
     for i in range(batches):
         if use_forward:
-            step_ours = ours(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            try:
+                step_ours = ours(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            except ValueError as err:
+                # configuration that must be inferred from concrete input
+                # VALUES (num_classes from integer label preds) cannot be
+                # read under tracing: the pure API's documented trace-time
+                # error surfaces at the first jitted call. Pin the message,
+                # drop back to the (equivalent) eager path, and continue.
+                assert "traced" in str(err), err
+                assert getattr(ours, "_jit_forward_enabled", False), err
+                ours.jit_forward(False)
+                step_ours = ours(jnp.asarray(preds[i]), jnp.asarray(target[i]))
             step_theirs = theirs(torch.from_numpy(np.asarray(preds[i])), torch.from_numpy(np.asarray(target[i])))
             assert set(step_ours) == set(step_theirs)
             for key in step_theirs:
