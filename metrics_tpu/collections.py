@@ -13,7 +13,8 @@ from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Union
 
-from metrics_tpu.metric import AXIS_UNSET, Metric, StateDict
+from metrics_tpu.metric import AXIS_UNSET, Metric, StateDict, _note_compiled_dispatch, _observed_forward
+from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.utilities.prints import rank_zero_warn
 from metrics_tpu.utilities.profiling import compiled_scope, eager_span
 
@@ -60,6 +61,15 @@ class MetricCollection:
     # stateful interface
     # ------------------------------------------------------------------
 
+    @property
+    def telemetry_key(self) -> str:
+        """Per-instance telemetry key (see :attr:`Metric.telemetry_key`)."""
+        key = self.__dict__.get("_telemetry_key")
+        if key is None:
+            key = TELEMETRY.register(self)
+            self._telemetry_key = key
+        return key
+
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
 
@@ -75,10 +85,14 @@ class MetricCollection:
             deltas = shared.get(name)
             if deltas is not None and m._states_mergeable():
                 with eager_span(f"{type(m).__name__}.forward"):
-                    out[self._set_name(name)] = m._forward_fused(
-                        *args,
-                        _update_thunk=lambda m=m, d=deltas: m._accumulate(*d),
-                        **m._filter_kwargs(**kwargs),
+                    out[self._set_name(name)] = _observed_forward(
+                        m,
+                        "forward_fused_calls",
+                        lambda m=m, d=deltas: m._forward_fused(
+                            *args,
+                            _update_thunk=lambda: m._accumulate(*d),
+                            **m._filter_kwargs(**kwargs),
+                        ),
                     )
             else:
                 out[self._set_name(name)] = m(*args, **m._filter_kwargs(**kwargs))
@@ -124,12 +138,20 @@ class MetricCollection:
 
         if self._jit_forward_fn is None:
             self._jit_forward_fn = jax.jit(functools.partial(self.apply_forward, axis_name=None))
+            self._jit_cache_seen = 0
         state = {name: m._get_states() for name, m in self.items(keep_base=True)}
         new_state, values = self._jit_forward_fn(state, *args, **kwargs)
+        record = TELEMETRY.enabled
+        if record:
+            # one compiled program serves every member: the collection key
+            # carries the compile/retrace ledger, members count the dispatch
+            _note_compiled_dispatch(self, self._jit_forward_fn, args, kwargs)
         for name, m in self.items(keep_base=True):
             m._set_states(new_state[name])
             m._update_called = True
             m._computed = None
+            if record:
+                TELEMETRY.inc(m.telemetry_key, "forward_compiled_calls")
             if not m.compute_on_step:
                 # eager-contract parity: such members return None on step
                 values[self._set_name(name)] = None
@@ -137,10 +159,17 @@ class MetricCollection:
         return values
 
     def __getstate__(self) -> dict:
-        return {k: v for k, v in self.__dict__.items() if k != "_jit_forward_fn"}
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("_jit_forward_fn", "_telemetry_key", "_jit_cache_seen")
+        }
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)
+        # pickles from before the compiled stateful forward (0.4.0) predate
+        # this flag; default it off so their first forward() stays eager
+        self.__dict__.setdefault("_jit_forward_enabled", False)
         self._jit_forward_fn = None
 
     def _class_groups(self) -> Dict[Tuple, list]:
@@ -364,10 +393,66 @@ class MetricCollection:
         return new_state, values
 
     # ------------------------------------------------------------------
+    # observability reports
+    # ------------------------------------------------------------------
+
+    def state_memory_report(self) -> Dict[str, Any]:
+        """Bytes held by every member's states right now (see
+        :meth:`Metric.state_memory_report`)."""
+        per_metric = {name: m.state_memory_report() for name, m in self.items(keep_base=True)}
+        return {
+            "per_metric": per_metric,
+            "total_bytes": int(sum(r["total_bytes"] for r in per_metric.values())),
+        }
+
+    def cost_report(self, *example_batch: Any, **kwargs: Any) -> Dict[str, Any]:
+        """XLA cost estimate for the collection on an example batch.
+
+        ``fused_update`` costs the collection's single shared-update program
+        (what a scanned/jitted train step actually pays — shared-update
+        equivalence classes canonicalize once); ``members`` carries each
+        metric's individual :meth:`Metric.cost_report`, whose sum is the cost
+        the same metrics would pay UNFUSED. The gap between the two is the
+        collection-level fusion win, now measurable per workload.
+        """
+        from metrics_tpu.observability.cost import program_cost
+
+        members = {
+            name: m.cost_report(*example_batch, **m._filter_kwargs(**kwargs))
+            for name, m in self.items(keep_base=True)
+        }
+        return {
+            "fused_update": program_cost(self.apply_update, self.init_state(), *example_batch, **kwargs),
+            "members": members,
+            "state_memory": self.state_memory_report(),
+        }
+
+    # ------------------------------------------------------------------
     # container protocol
     # ------------------------------------------------------------------
 
     def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        before = set(self._metrics) if getattr(self, "_jit_forward_enabled", False) else None
+        self._add_metrics(metrics, *additional_metrics)
+        if before is not None:
+            # a previously-built jitted forward baked in the OLD member set;
+            # keeping it would silently drop the new members from every step.
+            # Invalidate the cache and re-run the member eligibility gate —
+            # atomically: an ineligible addition is rolled back, so the
+            # documented ValueError fires instead of a per-step retrace.
+            self._jit_forward_fn = None
+            new_names = [n for n in self._metrics if n not in before]
+            for name in new_names:
+                try:
+                    self._metrics[name]._jit_forward_gate()
+                except ValueError as err:
+                    for n in new_names:
+                        del self._metrics[n]
+                    raise ValueError(f"member {name!r}: {err}") from None
+
+    def _add_metrics(
         self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
     ) -> None:
         if isinstance(metrics, Metric):
@@ -426,6 +511,11 @@ class MetricCollection:
     def __setitem__(self, key: str, value: Metric) -> None:
         if not isinstance(value, Metric):
             raise ValueError(f"Value {value} is not an instance of `Metric`")
+        if getattr(self, "_jit_forward_enabled", False):
+            # same staleness hazard as add_metrics: the cached program bakes
+            # in the replaced member's update
+            value._jit_forward_gate()
+            self._jit_forward_fn = None
         self._metrics[key] = value
 
     def __contains__(self, key: str) -> bool:

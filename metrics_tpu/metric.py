@@ -31,6 +31,7 @@ Capability parity with the reference's ``torchmetrics/metric.py`` (the
 import functools
 import inspect
 import os
+import time
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
@@ -49,6 +50,8 @@ from metrics_tpu.utilities.data import (
     dim_zero_min,
     dim_zero_sum,
 )
+from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.observability.retrace import MONITOR, arg_signature, is_tracing
 from metrics_tpu.utilities.distributed import (
     distributed_available,
     gather_all_arrays,
@@ -104,6 +107,42 @@ def _resolve_reduction(fx: Optional[Union[str, Callable]]) -> Optional[Callable]
 
 def jit_distributed_available() -> bool:  # pragma: no cover - thin alias
     return distributed_available()
+
+
+def _observed_forward(obj: Any, counter: str, thunk: Callable) -> Any:
+    """Run one eager forward under telemetry: path counter + wall-time
+    histogram. Host-side only — the thunk itself is the (un-traced) eager
+    dispatch path."""
+    if not TELEMETRY.enabled:
+        return thunk()
+    start = time.perf_counter()
+    try:
+        return thunk()
+    finally:
+        key = obj.telemetry_key
+        TELEMETRY.inc(key, counter)
+        TELEMETRY.observe(key, "forward", time.perf_counter() - start)
+
+
+def _note_compiled_dispatch(obj: Any, fn: Any, args: Tuple, kwargs: Dict) -> None:
+    """Telemetry for one dispatch of a cached jitted forward: count the call
+    and detect fresh XLA compiles via jit cache-size deltas. A growth in the
+    cache means THIS call's signature forced a recompile — it is recorded (and
+    warned about past the threshold) with that signature."""
+    key = obj.telemetry_key
+    TELEMETRY.inc(key, "forward_compiled_calls")
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is None:  # pragma: no cover - private jit API moved
+        return
+    try:
+        size = int(cache_size())
+    except Exception:  # pragma: no cover - private jit API moved
+        return
+    seen = obj.__dict__.get("_jit_cache_seen", 0)
+    if size > seen:
+        obj._jit_cache_seen = size
+        TELEMETRY.inc(key, "jit_forward_compiles", size - seen)
+        MONITOR.note_compile(key, arg_signature(*args, **kwargs), count=size - seen)
 
 
 class Metric(ABC):
@@ -175,6 +214,18 @@ class Metric(ABC):
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+
+    @property
+    def telemetry_key(self) -> str:
+        """Stable per-instance telemetry key (``"<Class>#<ordinal>"``), under
+        which this metric's counters/timers appear in
+        ``observability.snapshot()``. Assigned lazily on first use; clones and
+        unpickled copies get fresh keys (their counters start at zero)."""
+        key = self.__dict__.get("_telemetry_key")
+        if key is None:
+            key = TELEMETRY.register(self)
+            self._telemetry_key = key
+        return key
 
     # ------------------------------------------------------------------
     # state registry
@@ -251,6 +302,12 @@ class Metric(ABC):
 
     def apply_update(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
         """Pure update: return the state advanced by this batch. Trace-safe."""
+        # trace-entry hook: under jit/scan tracing this body runs once per
+        # COMPILE, not per step — counting those entries host-side measures
+        # compile churn without adding a single traced op
+        if TELEMETRY.enabled and is_tracing(state, args, kwargs):
+            TELEMETRY.inc(self.telemetry_key, "update_traces")
+            MONITOR.note_trace(self.telemetry_key, arg_signature(*args, **kwargs))
         with compiled_scope(f"{self.__class__.__name__}.update"):
             with self._bound_state({k: (list(v) if isinstance(v, list) else v) for k, v in state.items()}):
                 self._unwrapped_update(*args, **kwargs)
@@ -268,6 +325,8 @@ class Metric(ABC):
         """
         if axis_name is AXIS_UNSET:
             axis_name = self.process_group
+        if TELEMETRY.enabled and is_tracing(state):
+            TELEMETRY.inc(self.telemetry_key, "compute_traces")
         with compiled_scope(f"{self.__class__.__name__}.compute"):
             state = self.sync_state(state, axis_name)
             with self._bound_state(state):
@@ -361,6 +420,8 @@ class Metric(ABC):
         as the :meth:`_wrap_update` wrapper."""
         self._computed = None
         self._update_called = True
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "update_calls")
         self._accumulate(*deltas)
 
     def _apply_accumulate(self, state: StateDict, deltas: Tuple) -> StateDict:
@@ -420,8 +481,12 @@ class Metric(ABC):
             if self._jit_forward_enabled:
                 return self._forward_jitted(*args, **kwargs)
             if self._states_mergeable():
-                return self._forward_fused(*args, **kwargs)
-            return self._forward_double_update(*args, **kwargs)
+                return _observed_forward(
+                    self, "forward_fused_calls", lambda: self._forward_fused(*args, **kwargs)
+                )
+            return _observed_forward(
+                self, "forward_double_update_calls", lambda: self._forward_double_update(*args, **kwargs)
+            )
 
     def jit_forward(self, enable: bool = True) -> "Metric":
         """Compile the stateful ``forward`` into one XLA program (opt-in).
@@ -494,7 +559,10 @@ class Metric(ABC):
                 self._jit_forward_fn = jax.jit(functools.partial(self.apply_forward, axis_name=None))
             else:
                 self._jit_forward_fn = jax.jit(self.apply_update)
+            self._jit_cache_seen = 0
         out = self._jit_forward_fn(self._get_states(), *args, **kwargs)
+        if TELEMETRY.enabled:
+            _note_compiled_dispatch(self, self._jit_forward_fn, args, kwargs)
         new_state, value = out if self.compute_on_step else (out, None)
         self._set_states(new_state)
         self._update_called = True
@@ -558,7 +626,15 @@ class Metric(ABC):
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
             self._computed = None
             self._update_called = True
-            return update(*args, **kwargs)
+            if not TELEMETRY.enabled:
+                return update(*args, **kwargs)
+            start = time.perf_counter()
+            try:
+                return update(*args, **kwargs)
+            finally:
+                key = self.telemetry_key
+                TELEMETRY.inc(key, "update_calls")
+                TELEMETRY.observe(key, "update", time.perf_counter() - start)
 
         return wrapped_func
 
@@ -572,14 +648,19 @@ class Metric(ABC):
                     " as metric states have not yet been updated.",
                     UserWarning,
                 )
+            if TELEMETRY.enabled:
+                TELEMETRY.inc(self.telemetry_key, "compute_calls")
             if self._computed is not None:
                 return self._computed
+            start = time.perf_counter() if TELEMETRY.enabled else None
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 restore_cache=self._restore_cache,
             ):
                 self._computed = compute(*args, **kwargs)
+            if start is not None:
+                TELEMETRY.observe(self.telemetry_key, "compute", time.perf_counter() - start)
             return self._computed
 
         return wrapped_func
@@ -605,6 +686,13 @@ class Metric(ABC):
                 states[name] = (
                     [dim_zero_cat(value)] if value else [jnp.zeros((0,), jnp.float32)]
                 )
+
+        if TELEMETRY.enabled:
+            from metrics_tpu.observability.cost import pytree_nbytes
+
+            key = self.telemetry_key
+            TELEMETRY.inc(key, "sync_calls")
+            TELEMETRY.inc(key, "sync_payload_bytes", pytree_nbytes(states))
 
         gathered = apply_to_collection(states, ArrayTypes, dist_sync_fn, group=process_group or self.process_group)
 
@@ -684,6 +772,8 @@ class Metric(ABC):
 
     def reset(self) -> None:
         """Restore every state to its default."""
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "reset_calls")
         self._reset_flags()
         self._set_states(self.init_state())
 
@@ -730,6 +820,61 @@ class Metric(ABC):
                         setattr(self, key, jnp.asarray(value))
 
     # ------------------------------------------------------------------
+    # observability reports
+    # ------------------------------------------------------------------
+
+    def state_memory_report(self) -> Dict[str, Any]:
+        """Bytes held by each registered state right now.
+
+        Reads array metadata only (shape x itemsize) — no device->host
+        transfer. List accumulators report their element count alongside the
+        summed bytes, which is how unbounded "cat" states show their growth.
+        """
+        from metrics_tpu.observability.cost import leaf_nbytes
+
+        per_state: Dict[str, Any] = {}
+        total = 0
+        for name in self._defaults:
+            value = getattr(self, name)
+            nbytes = leaf_nbytes(value)
+            entry: Dict[str, Any] = {"bytes": int(nbytes)}
+            if isinstance(value, list):
+                entry["elements"] = len(value)
+            per_state[name] = entry
+            total += nbytes
+        return {"per_state": per_state, "total_bytes": int(total)}
+
+    def cost_report(self, *example_batch: Any, **kwargs: Any) -> Dict[str, Any]:
+        """XLA cost estimate of this metric's per-step programs on an example
+        batch: FLOPs, bytes accessed, and compiled memory sizes for the
+        ``apply_update`` step (and the epoch-end ``apply_compute``), plus the
+        current :meth:`state_memory_report`.
+
+        Built on ``jit(...).lower().compile().cost_analysis()`` — nothing is
+        executed, only compiled. Metrics that infer configuration from input
+        VALUES (the documented jit constraint) report
+        ``{"available": False, "error": ...}`` for the affected program
+        instead of raising; construct them with explicit config
+        (``num_classes=``, ...) to get numbers.
+        """
+        from metrics_tpu.observability.cost import program_cost
+
+        state = self.init_state()
+        report: Dict[str, Any] = {
+            "metric": type(self).__name__,
+            "update": program_cost(self.apply_update, state, *example_batch, **kwargs),
+            "state_memory": self.state_memory_report(),
+        }
+        try:
+            updated = jax.eval_shape(self.apply_update, state, *example_batch, **kwargs)
+            report["compute"] = program_cost(
+                functools.partial(self.apply_compute, axis_name=None), updated
+            )
+        except Exception as err:
+            report["compute"] = {"available": False, "error": f"{type(err).__name__}: {err}"}
+        return report
+
+    # ------------------------------------------------------------------
     # misc protocol
     # ------------------------------------------------------------------
 
@@ -741,17 +886,23 @@ class Metric(ABC):
         return filtered if filtered else kwargs
 
     def __getstate__(self) -> dict:
-        # the cached jitted forward is rebuilt lazily (unpicklable, device-bound)
+        # the cached jitted forward is rebuilt lazily (unpicklable,
+        # device-bound); the telemetry key/cache-watermark stay with the
+        # ORIGINAL instance — clones and unpickled copies register fresh
         state = {
             k: v
             for k, v in self.__dict__.items()
-            if k not in ("update", "compute", "_update_signature", "_jit_forward_fn")
+            if k not in ("update", "compute", "_update_signature", "_jit_forward_fn",
+                         "_telemetry_key", "_jit_cache_seen")
         }
         # jax arrays serialize as host numpy and are restored on the default device
         return apply_to_collection(state, jax.Array, np.asarray)
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(apply_to_collection(state, np.ndarray, jnp.asarray))
+        # pickles from before the compiled stateful forward (0.4.0) predate
+        # this flag; default it off so their first forward() stays eager
+        self.__dict__.setdefault("_jit_forward_enabled", False)
         self._jit_forward_fn = None
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
@@ -878,6 +1029,20 @@ class CompositionalMetric(Metric):
             self.metric_a.persistent(mode=mode)
         if isinstance(self.metric_b, Metric):
             self.metric_b.persistent(mode=mode)
+
+    def state_memory_report(self) -> Dict[str, Any]:
+        # the composition owns no states; report the children's (keyed like
+        # the pure-state layout, aliased child counted once)
+        report: Dict[str, Any] = {"per_state": {}, "total_bytes": 0}
+        if isinstance(self.metric_a, Metric):
+            sub = self.metric_a.state_memory_report()
+            report["per_state"]["a"] = sub
+            report["total_bytes"] += sub["total_bytes"]
+        if isinstance(self.metric_b, Metric) and self.metric_b is not self.metric_a:
+            sub = self.metric_b.state_memory_report()
+            report["per_state"]["b"] = sub
+            report["total_bytes"] += sub["total_bytes"]
+        return report
 
     # ------------------------------------------------------------------
     # pure (jit-native) API: child states keyed "a"/"b" — without this the

@@ -1,0 +1,68 @@
+"""Runtime telemetry for the metric lifecycle (see ``docs/observability.md``).
+
+Four pieces, one snapshot:
+
+* :mod:`~metrics_tpu.observability.registry` — thread-safe per-metric
+  counters (update/forward/compute/reset/sync, eager vs. compiled path) and
+  eager wall-time histograms, plus collective-sync transport stats.
+* :mod:`~metrics_tpu.observability.retrace` — per-metric XLA compile counts
+  with an actionable warning when a metric recompiles past a configurable
+  threshold.
+* :mod:`~metrics_tpu.observability.cost` — ``jit(...).lower().compile()``
+  cost/memory analysis behind ``Metric.cost_report()`` and
+  ``state_memory_report()``.
+* :mod:`~metrics_tpu.observability.export` — :func:`snapshot` (JSON dict) and
+  :func:`render_prometheus` (text exposition format).
+
+Everything is recorded host-side; the compiled hot paths carry zero extra
+traced ops. Typical scrape::
+
+    from metrics_tpu import observability
+    snap = observability.snapshot()           # JSON-serializable dict
+    text = observability.render_prometheus()  # Prometheus text format
+"""
+from metrics_tpu.observability.cost import program_cost, pytree_nbytes  # noqa: F401
+from metrics_tpu.observability.export import dumps, render_prometheus, snapshot  # noqa: F401
+from metrics_tpu.observability.registry import TELEMETRY, TelemetryRegistry  # noqa: F401
+from metrics_tpu.observability.retrace import (  # noqa: F401
+    MONITOR,
+    RetraceMonitor,
+    arg_signature,
+    get_retrace_threshold,
+    set_retrace_threshold,
+)
+
+
+def enable(on: bool = True) -> None:
+    """Turn telemetry recording on (the default) or off process-wide."""
+    TELEMETRY.enable(on)
+
+
+def disable() -> None:
+    """Stop recording; instrumented call sites reduce to one attribute read."""
+    TELEMETRY.disable()
+
+
+def reset() -> None:
+    """Clear all recorded counters, timers, sync stats and retrace ledgers."""
+    TELEMETRY.reset()
+    MONITOR.reset()
+
+
+__all__ = [
+    "TELEMETRY",
+    "MONITOR",
+    "TelemetryRegistry",
+    "RetraceMonitor",
+    "arg_signature",
+    "disable",
+    "dumps",
+    "enable",
+    "get_retrace_threshold",
+    "program_cost",
+    "pytree_nbytes",
+    "render_prometheus",
+    "reset",
+    "set_retrace_threshold",
+    "snapshot",
+]

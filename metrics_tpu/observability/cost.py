@@ -1,0 +1,90 @@
+"""XLA cost reports: FLOPs / bytes-accessed / memory per compiled program.
+
+Thin, backend-tolerant wrappers over ``jit(fn).lower(...).compile()``'s
+``cost_analysis()`` and ``memory_analysis()`` — the compiler's own estimate of
+a program's arithmetic and memory traffic. ``Metric.cost_report()`` and
+``MetricCollection.cost_report()`` (in ``metric.py``/``collections.py``) build
+on :func:`program_cost`; :func:`pytree_nbytes` backs the state-memory reports.
+
+``cost_analysis`` availability varies by backend and jaxlib version (a list of
+per-device dicts on CPU/TPU, sometimes ``None`` elsewhere); every helper here
+degrades to ``{"available": False, ...}`` instead of raising, so a cost report
+is safe to call in any environment.
+"""
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+
+def _normalize_analysis(analysis: Any) -> Dict[str, float]:
+    """Flatten a ``cost_analysis()`` result (dict, or list of per-device
+    dicts) to one ``{str: float}`` dict; empty when unavailable."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return {}
+    out = {}
+    for k, v in analysis.items():
+        try:
+            out[str(k)] = float(v)
+        except (TypeError, ValueError):  # pragma: no cover - non-numeric entry
+            continue
+    return out
+
+
+def program_cost(fn: Callable, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+    """Lower+compile ``fn(*args, **kwargs)`` and return its XLA cost estimate.
+
+    Arguments may be concrete arrays or ``jax.ShapeDtypeStruct`` pytrees (no
+    computation runs — the program is only compiled). Returns::
+
+        {"available": True, "flops": float, "bytes_accessed": float,
+         "argument_bytes": int, "output_bytes": int, "temp_bytes": int,
+         "generated_code_bytes": int, "raw": {...}}
+
+    or ``{"available": False, "error": "..."}`` when the backend exposes no
+    analysis (or lowering fails).
+    """
+    import jax
+
+    try:
+        compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+        raw = _normalize_analysis(compiled.cost_analysis())
+        report: Dict[str, Any] = {
+            "available": True,
+            "flops": raw.get("flops", 0.0),
+            "bytes_accessed": raw.get("bytes accessed", 0.0),
+            "raw": raw,
+        }
+        try:
+            mem = compiled.memory_analysis()
+            report.update(
+                argument_bytes=int(mem.argument_size_in_bytes),
+                output_bytes=int(mem.output_size_in_bytes),
+                temp_bytes=int(mem.temp_size_in_bytes),
+                generated_code_bytes=int(mem.generated_code_size_in_bytes),
+            )
+        except Exception:  # pragma: no cover - memory_analysis backend-optional
+            pass
+        return report
+    except Exception as err:
+        return {"available": False, "error": f"{type(err).__name__}: {err}"}
+
+
+def leaf_nbytes(value: Any) -> int:
+    """Bytes held by one state leaf (array, or list of arrays), without
+    forcing a device->host transfer."""
+    if isinstance(value, (list, tuple)):
+        return sum(leaf_nbytes(v) for v in value)
+    nbytes = getattr(value, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    return int(np.asarray(value).nbytes)  # pragma: no cover - exotic leaf
+
+
+def pytree_nbytes(tree: Any) -> int:
+    """Total bytes across every array leaf of a pytree (host-side metadata
+    only — shapes and dtypes, no data movement)."""
+    import jax
+
+    return sum(leaf_nbytes(leaf) for leaf in jax.tree_util.tree_leaves(tree))

@@ -1,0 +1,115 @@
+"""Snapshot assembly and export renderers.
+
+:func:`snapshot` merges the telemetry registry (counters, timers, state
+memory, sync stats) with the retrace monitor's ledger into one
+JSON-serializable dict — the structure a serving loop scrapes, the bench
+harness attaches to its records, and the tests pin. :func:`render_prometheus`
+renders the same data in the Prometheus text exposition format so a scrape
+endpoint can serve it directly.
+"""
+import json
+from typing import Any, Dict, Optional
+
+from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.observability.retrace import MONITOR
+
+#: bumped when the snapshot layout changes incompatibly
+SCHEMA_VERSION = 1
+
+_PROM_PREFIX = "metrics_tpu"
+
+
+def snapshot(include_timers: bool = True) -> Dict[str, Any]:
+    """One structured view of everything the runtime has recorded.
+
+    Layout (``schema`` = 1)::
+
+        {
+          "schema": 1,
+          "enabled": bool,
+          "metrics": {"Accuracy#0": {"counters": {...}, "timers": {...},
+                                      "state_memory": {...}}, ...},
+          "retrace": {"threshold": int, "metrics": {key: {"compiles": int,
+                       "traces": int, "warned": bool, "signatures": [...]}}},
+          "sync": {"gathers": int, "payload_bytes_out": int, ...,
+                   "groups": {...}, "in_graph": {...}},
+        }
+
+    Always JSON-serializable (``json.dumps(snapshot())`` round-trips).
+    """
+    snap = TELEMETRY.snapshot(include_timers=include_timers)
+    snap["schema"] = SCHEMA_VERSION
+    snap["retrace"] = MONITOR.snapshot()
+    return snap
+
+
+def _prom_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Render a snapshot in the Prometheus text exposition format (0.0.4)."""
+    if snap is None:
+        snap = snapshot()
+    lines = []
+
+    def emit(name: str, labels: Dict[str, str], value: Any, type_: Optional[str] = None) -> None:
+        full = f"{_PROM_PREFIX}_{name}"
+        if type_ is not None:
+            lines.append(f"# TYPE {full} {type_}")
+        label_str = ",".join(f'{k}="{_prom_label(str(v))}"' for k, v in labels.items())
+        lines.append(f"{full}{{{label_str}}} {value}" if label_str else f"{full} {value}")
+
+    first_counter = True
+    first_hist = True
+    for key, entry in sorted(snap.get("metrics", {}).items()):
+        for counter, value in sorted(entry.get("counters", {}).items()):
+            emit(
+                "calls_total",
+                {"metric": key, "op": counter},
+                value,
+                type_="counter" if first_counter else None,
+            )
+            first_counter = False
+        for phase, hist in sorted(entry.get("timers", {}).items()):
+            labels = {"metric": key, "phase": phase}
+            if first_hist:
+                lines.append(f"# TYPE {_PROM_PREFIX}_eager_seconds histogram")
+                first_hist = False
+            cumulative = 0
+            for bound, count in hist["buckets"].items():
+                cumulative += count
+                le = bound[len("le_"):].rstrip("s").replace("inf", "+Inf")
+                emit("eager_seconds_bucket", {**labels, "le": le}, cumulative)
+            emit("eager_seconds_sum", labels, hist["sum_s"])
+            emit("eager_seconds_count", labels, hist["count"])
+        mem = entry.get("state_memory")
+        if mem is not None:
+            emit("state_bytes", {"metric": key}, mem.get("total_bytes", 0), type_="gauge")
+
+    retrace = snap.get("retrace", {})
+    for key, rec in sorted(retrace.get("metrics", {}).items()):
+        emit("retrace_compiles_total", {"metric": key}, rec["compiles"], type_="counter")
+        emit("retrace_traces_total", {"metric": key}, rec["traces"])
+
+    sync = snap.get("sync", {})
+    for field in (
+        "gathers",
+        "gather_errors",
+        "payload_bytes_out",
+        "payload_bytes_in",
+        "transport_bytes",
+        "descriptor_rounds",
+        "payload_rounds",
+    ):
+        if field in sync:
+            emit(f"sync_{field}_total", {}, sync[field], type_="counter")
+    in_graph = sync.get("in_graph", {})
+    for kind, n in sorted(in_graph.get("collectives", {}).items()):
+        emit("sync_in_graph_collectives_total", {"kind": kind}, n)
+    return "\n".join(lines) + "\n"
+
+
+def dumps(include_timers: bool = True, **json_kwargs: Any) -> str:
+    """``json.dumps`` of :func:`snapshot` — one line unless told otherwise."""
+    return json.dumps(snapshot(include_timers=include_timers), **json_kwargs)

@@ -1,0 +1,241 @@
+"""Telemetry registry: host-side per-metric counters, timers, and sync stats.
+
+The single source of runtime observability truth. Every instrumented point in
+the library (``metric.py`` forward/update/compute/reset, the collection's
+compiled forward, ``utilities/distributed.py``'s gather transport) records
+into the process-global :data:`TELEMETRY` instance; ``observability.snapshot()``
+reads it back out as one JSON-serializable dict.
+
+Design constraints, in order:
+
+* **Never inside the traced program.** All state is plain Python under a
+  ``threading.Lock``; instrumented call sites record from host code only
+  (wrappers, dispatch paths, trace-entry hooks that run once per trace). The
+  compiled hot path — ``apply_update`` scanned inside ``jit`` — executes zero
+  telemetry ops per step.
+* **Cheap when enabled, free-ish when disabled.** Call sites gate on the
+  lock-free :attr:`TelemetryRegistry.enabled` read before doing any timing or
+  signature work; a disabled registry costs one attribute read per call.
+* **Instance-keyed.** Metrics are keyed ``"<ClassName>#<ordinal>"`` so two
+  ``Accuracy`` instances in one process stay distinguishable; the registry
+  holds only a ``weakref`` to each instance (for the snapshot's state-memory
+  report), never a strong reference that would leak metrics.
+"""
+import threading
+import weakref
+from typing import Any, Dict, Optional
+
+#: histogram bucket upper bounds (seconds) for eager wall-time observations;
+#: log-spaced from 10 µs to 1 s, with +inf implicit
+HISTOGRAM_BUCKETS_S = (1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0)
+
+
+class _Histogram:
+    """Fixed-bucket wall-time histogram (Prometheus ``le`` semantics)."""
+
+    __slots__ = ("counts", "count", "sum_s")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(HISTOGRAM_BUCKETS_S) + 1)
+        self.count = 0
+        self.sum_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.sum_s += seconds
+        for i, bound in enumerate(HISTOGRAM_BUCKETS_S):
+            if seconds <= bound:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        buckets = {f"le_{bound:g}s": c for bound, c in zip(HISTOGRAM_BUCKETS_S, self.counts)}
+        buckets["le_inf"] = self.counts[-1]
+        return {"count": self.count, "sum_s": round(self.sum_s, 9), "buckets": buckets}
+
+
+def _fresh_sync_stats() -> Dict[str, Any]:
+    return {
+        # eager (host) gather transport — gather_all_arrays
+        "gathers": 0,
+        "gather_errors": 0,
+        "payload_bytes_out": 0,
+        "payload_bytes_in": 0,
+        "transport_bytes": 0,
+        "descriptor_rounds": 0,
+        "payload_rounds": 0,
+        "groups": {},
+        # in-graph (trace-time) collective composition — sync_in_graph
+        "in_graph": {"syncs": 0, "states": 0, "bytes_traced": 0, "collectives": {}, "axes": {}},
+    }
+
+
+class TelemetryRegistry:
+    """Thread-safe registry of per-metric counters/timers plus global sync stats.
+
+    One process-global instance (:data:`TELEMETRY`) backs the whole library;
+    constructing private instances is supported for tests.
+    """
+
+    def __init__(self, enabled: bool = True) -> None:
+        self._lock = threading.Lock()
+        self._enabled = enabled
+        self._ordinals: Dict[str, int] = {}
+        self._instances: Dict[str, "weakref.ref"] = {}
+        self._metrics: Dict[str, Dict[str, Any]] = {}
+        self._sync = _fresh_sync_stats()
+
+    # -- enablement (lock-free read: call sites gate on this every call) ----
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, on: bool = True) -> None:
+        self._enabled = bool(on)
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    # -- key management ------------------------------------------------------
+
+    def register(self, obj: Any) -> str:
+        """Assign ``obj`` its stable instance key (``"<Class>#<ordinal>"``)."""
+        cls = type(obj).__name__
+        with self._lock:
+            ordinal = self._ordinals.get(cls, 0)
+            self._ordinals[cls] = ordinal + 1
+            key = f"{cls}#{ordinal}"
+            try:
+                self._instances[key] = weakref.ref(obj)
+            except TypeError:  # pragma: no cover - non-weakrefable object
+                pass
+            return key
+
+    def _entry(self, key: str) -> Dict[str, Any]:
+        entry = self._metrics.get(key)
+        if entry is None:
+            entry = {"counters": {}, "timers": {}}
+            self._metrics[key] = entry
+        return entry
+
+    # -- recording -----------------------------------------------------------
+
+    def inc(self, key: str, counter: str, n: int = 1) -> None:
+        if not self._enabled:
+            return
+        with self._lock:
+            counters = self._entry(key)["counters"]
+            counters[counter] = counters.get(counter, 0) + n
+
+    def observe(self, key: str, phase: str, seconds: float) -> None:
+        if not self._enabled:
+            return
+        with self._lock:
+            timers = self._entry(key)["timers"]
+            hist = timers.get(phase)
+            if hist is None:
+                hist = timers[phase] = _Histogram()
+            hist.observe(seconds)
+
+    def record_gather(
+        self,
+        *,
+        bytes_out: int,
+        bytes_in: int,
+        transport_bytes: int,
+        descriptor_rounds: int,
+        payload_rounds: int,
+        world: int,
+        members: Any,
+        error: bool = False,
+    ) -> None:
+        """One completed ``gather_all_arrays`` transport (host sync path)."""
+        if not self._enabled:
+            return
+        group_label = ",".join(str(m) for m in members)
+        with self._lock:
+            s = self._sync
+            s["gathers"] += 1
+            if error:
+                s["gather_errors"] += 1
+            s["payload_bytes_out"] += int(bytes_out)
+            s["payload_bytes_in"] += int(bytes_in)
+            s["transport_bytes"] += int(transport_bytes)
+            s["descriptor_rounds"] += int(descriptor_rounds)
+            s["payload_rounds"] += int(payload_rounds)
+            g = s["groups"].setdefault(group_label, {"gathers": 0, "world": int(world)})
+            g["gathers"] += 1
+            g["world"] = int(world)
+
+    def record_in_graph_sync(self, axis_name: Any, kinds: Dict[str, int], bytes_traced: int) -> None:
+        """Trace-time record of one ``sync_in_graph`` lowering: which XLA
+        collectives the state bundle compiles to and the (pre-collective)
+        payload size. Runs once per trace, never per step."""
+        if not self._enabled:
+            return
+        with self._lock:
+            ig = self._sync["in_graph"]
+            ig["syncs"] += 1
+            ig["states"] += sum(kinds.values())
+            ig["bytes_traced"] += int(bytes_traced)
+            for kind, n in kinds.items():
+                ig["collectives"][kind] = ig["collectives"].get(kind, 0) + n
+            axis = repr(axis_name)
+            ig["axes"][axis] = ig["axes"].get(axis, 0) + 1
+
+    # -- reading -------------------------------------------------------------
+
+    def _state_memory(self, key: str) -> Optional[Dict[str, Any]]:
+        ref = self._instances.get(key)
+        obj = ref() if ref is not None else None
+        report_fn = getattr(obj, "state_memory_report", None)
+        if report_fn is None:
+            return None
+        try:
+            return report_fn()
+        except Exception:  # pragma: no cover - snapshot must never raise
+            return None
+
+    def snapshot(self, include_timers: bool = True) -> Dict[str, Any]:
+        """JSON-serializable view: per-metric counters (+timers, +live state
+        memory) and the global sync stats."""
+        with self._lock:
+            metrics: Dict[str, Any] = {}
+            for key, entry in self._metrics.items():
+                out: Dict[str, Any] = {"counters": dict(entry["counters"])}
+                if include_timers and entry["timers"]:
+                    out["timers"] = {phase: h.to_dict() for phase, h in entry["timers"].items()}
+                metrics[key] = out
+            sync = {
+                k: (dict(v) if isinstance(v, dict) and k != "in_graph" else v)
+                for k, v in self._sync.items()
+            }
+            sync["groups"] = {k: dict(v) for k, v in self._sync["groups"].items()}
+            ig = self._sync["in_graph"]
+            sync["in_graph"] = {
+                "syncs": ig["syncs"],
+                "states": ig["states"],
+                "bytes_traced": ig["bytes_traced"],
+                "collectives": dict(ig["collectives"]),
+                "axes": dict(ig["axes"]),
+            }
+        # state memory reads live objects outside the lock (it may touch
+        # arbitrary metric code)
+        for key, out in metrics.items():
+            mem = self._state_memory(key)
+            if mem is not None:
+                out["state_memory"] = mem
+        return {"enabled": self._enabled, "metrics": metrics, "sync": sync}
+
+    def reset(self) -> None:
+        """Clear all recorded data (keys/ordinals survive: live metrics keep
+        their identity across a reset)."""
+        with self._lock:
+            self._metrics.clear()
+            self._sync = _fresh_sync_stats()
+
+
+#: the process-global registry every instrumented call site records into
+TELEMETRY = TelemetryRegistry()

@@ -1,0 +1,158 @@
+"""Retrace detection: count XLA compilations per metric, warn on churn.
+
+Every new input shape/dtype (or config captured by closure) costs a metric a
+full re-trace + XLA compile — silently, at step latency. This module keeps a
+host-side ledger of compilations per telemetry key, fed from two sources:
+
+* **cache-size deltas** on the jitted stateful forward
+  (``Metric.jit_forward`` / ``MetricCollection.jit_forward``): after each
+  dispatch the jit cache size is compared to the last seen value; growth is a
+  compile, recorded with the offending call's argument signature.
+* **trace-entry hooks** on the pure API (``apply_update``/``apply_compute``
+  called with tracer arguments): each trace is counted per metric, so compile
+  churn in user-jitted programs shows up in the same snapshot.
+
+Crossing the configurable threshold emits ONE actionable warning naming the
+metric and the recent input signatures that forced the recompiles — the
+shape/config churn to fix. Only the jitted-forward compile counter feeds the
+warning; pure-path traces are recorded but never warn (test harnesses and
+multi-length benches legitimately trace one program several times).
+"""
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, Optional
+
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+#: default compile budget per metric before the churn warning fires; override
+#: via the env var or :func:`set_retrace_threshold`
+DEFAULT_RETRACE_THRESHOLD = int(os.environ.get("METRICS_TPU_RETRACE_THRESHOLD", "3"))
+
+#: how many recent argument signatures each record keeps for the warning
+_SIGNATURE_WINDOW = 4
+
+
+def arg_signature(*args: Any, **kwargs: Any) -> str:
+    """Compact shape/dtype signature of a call, e.g. ``(float32[8,3], int32[8])``."""
+
+    def one(x: Any) -> str:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            dims = ",".join(str(d) for d in shape)
+            return f"{dtype}[{dims}]"
+        if isinstance(x, dict):
+            return "{" + ", ".join(f"{k}: {one(v)}" for k, v in x.items()) + "}"
+        if isinstance(x, (list, tuple)):
+            return "[" + ", ".join(one(v) for v in x) + "]"
+        return type(x).__name__
+    parts = [one(a) for a in args] + [f"{k}={one(v)}" for k, v in sorted(kwargs.items())]
+    return "(" + ", ".join(parts) + ")"
+
+
+def is_tracing(*trees: Any) -> bool:
+    """True when any leaf of the given pytrees is a JAX tracer — i.e. the
+    caller is executing under ``jit``/``scan``/``vmap`` tracing right now."""
+    import jax
+
+    tracer_cls = jax.core.Tracer
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if isinstance(leaf, tracer_cls):
+                return True
+    return False
+
+
+class RetraceMonitor:
+    """Per-key compile/trace ledger with a threshold-crossing warning."""
+
+    def __init__(self, threshold: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._threshold = DEFAULT_RETRACE_THRESHOLD if threshold is None else int(threshold)
+        self._records: Dict[str, Dict[str, Any]] = {}
+
+    def set_threshold(self, n: int) -> None:
+        if n < 1:
+            raise ValueError(f"retrace threshold must be >= 1, got {n}")
+        self._threshold = int(n)
+
+    def get_threshold(self) -> int:
+        return self._threshold
+
+    def _record(self, key: str) -> Dict[str, Any]:
+        rec = self._records.get(key)
+        if rec is None:
+            rec = self._records[key] = {
+                "compiles": 0,
+                "traces": 0,
+                "signatures": deque(maxlen=_SIGNATURE_WINDOW),
+                "warned": False,
+            }
+        return rec
+
+    def note_compile(self, key: str, signature: Optional[str] = None, count: int = 1) -> None:
+        """Record ``count`` fresh compiles of ``key``'s jitted forward; warn
+        once when the total crosses the threshold."""
+        warn_msg = None
+        with self._lock:
+            rec = self._record(key)
+            rec["compiles"] += count
+            if signature:
+                rec["signatures"].append(signature)
+            if rec["compiles"] > self._threshold and not rec["warned"]:
+                rec["warned"] = True
+                recent = ", ".join(rec["signatures"]) or "<no signatures captured>"
+                warn_msg = (
+                    f"Metric {key} has compiled its jitted forward {rec['compiles']} times"
+                    f" (threshold {self._threshold}). Each new input shape/dtype pays a full"
+                    f" XLA recompile at step latency. Recent input signatures: {recent}."
+                    " Pad batches to a fixed shape (or bucket to a few shapes), keep dtypes"
+                    " stable, and construct one metric per distinct configuration; raise the"
+                    " threshold with metrics_tpu.observability.set_retrace_threshold(n) if"
+                    " this churn is intended."
+                )
+        if warn_msg is not None:
+            rank_zero_warn(warn_msg, UserWarning)
+
+    def note_trace(self, key: str, signature: Optional[str] = None) -> None:
+        """Record one pure-API trace for ``key`` (no warning: re-tracing a pure
+        function across several programs is often deliberate). The signature
+        window is fed by :meth:`note_compile` only — the jitted-forward path
+        also hits the trace hook, and recording both would double every
+        entry."""
+        with self._lock:
+            rec = self._record(key)
+            rec["traces"] += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "threshold": self._threshold,
+                "metrics": {
+                    key: {
+                        "compiles": rec["compiles"],
+                        "traces": rec["traces"],
+                        "warned": rec["warned"],
+                        "signatures": list(rec["signatures"]),
+                    }
+                    for key, rec in self._records.items()
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+
+#: the process-global monitor the instrumented jit paths feed
+MONITOR = RetraceMonitor()
+
+
+def set_retrace_threshold(n: int) -> None:
+    """Set the per-metric compile budget before the churn warning fires."""
+    MONITOR.set_threshold(n)
+
+
+def get_retrace_threshold() -> int:
+    return MONITOR.get_threshold()

@@ -129,6 +129,12 @@ def _resolve_group(group: Optional[Any], nprocs: int) -> List[int]:
     collection of strs) are the IN-GRAPH sub-group mechanism; on the eager
     path they cannot name a process subset, so they gather everything —
     the documented fallback for metrics whose ``process_group`` is an axis.
+    A collection MIXING axis names and indices (e.g. ``("data", 0)``) is
+    ambiguous and raises ``TypeError``.
+
+    Raises eagerly when called directly; :func:`gather_all_arrays` defers
+    these raises until after its collective rounds so a bad argument on one
+    rank cannot hang peers mid-collective.
     """
     if group is None or isinstance(group, str):
         return list(range(nprocs))
@@ -138,9 +144,19 @@ def _resolve_group(group: Optional[Any], nprocs: int) -> List[int]:
         raise TypeError(
             f"group must be None, a mesh-axis name, or a collection of process indices; got {group!r}"
         )
-    if all(isinstance(i, str) for i in items) and items:
-        return list(range(nprocs))  # tuple of mesh-axis names
-    members = sorted({int(i) for i in items})
+    if any(isinstance(i, str) for i in items):
+        if all(isinstance(i, str) for i in items):
+            return list(range(nprocs))  # tuple of mesh-axis names
+        raise TypeError(
+            "group mixes mesh-axis names and process indices; pass either a (tuple of)"
+            f" mesh-axis name(s) or a collection of ints, got {group!r}"
+        )
+    try:
+        members = sorted({int(i) for i in items})
+    except (TypeError, ValueError):
+        raise TypeError(
+            f"group must be None, a mesh-axis name, or a collection of process indices; got {group!r}"
+        )
     if not members:
         raise ValueError("group must name at least one process index")
     if members[0] < 0 or members[-1] >= nprocs:
@@ -177,7 +193,18 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
         return [result]
 
     nprocs = world_size()
-    members = _resolve_group(group, nprocs)
+    # A bad group ARGUMENT must not desync the transport: peers with valid
+    # groups are already committed to the global descriptor/payload
+    # collectives below, and a rank that raises before them leaves those
+    # peers hung mid-collective. Fall back to the all-process group for the
+    # rounds, record the error, and raise it after the last collective —
+    # the same discipline as the intra-group alignment `group_error` below.
+    arg_error: Optional[Exception] = None
+    try:
+        members = _resolve_group(group, nprocs)
+    except (TypeError, ValueError) as err:
+        arg_error = err
+        members = list(range(nprocs))
 
     if result.ndim > _MAX_GATHER_NDIM:
         raise ValueError(f"gather_all_arrays supports up to {_MAX_GATHER_NDIM} dims, got {result.ndim}")
@@ -251,6 +278,19 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
         buf[: local_bytes.size] = local_bytes
         gathered = _process_allgather(buf)  # (nprocs, max_bytes)
 
+    _record_gather_telemetry(
+        result=result,
+        members=members,
+        counts=counts,
+        itemsizes=itemsizes,
+        nprocs=nprocs,
+        desc_bytes=int(desc.nbytes),
+        max_bytes=max_bytes,
+        error=arg_error is not None or group_error is not None,
+    )
+
+    if arg_error is not None:
+        raise arg_error
     if group_error is not None:
         raise ValueError(group_error)
 
@@ -265,12 +305,48 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
     return out
 
 
+def _record_gather_telemetry(
+    *,
+    result: Array,
+    members: List[int],
+    counts: "np.ndarray",
+    itemsizes: "np.ndarray",
+    nprocs: int,
+    desc_bytes: int,
+    max_bytes: int,
+    error: bool,
+) -> None:
+    """Record one gather transport into the telemetry registry (host-side;
+    the gather itself is already complete). Never raises."""
+    try:
+        from metrics_tpu.observability.registry import TELEMETRY
+
+        if not TELEMETRY.enabled:
+            return
+        payload_rounds = 1 if max_bytes else 0
+        TELEMETRY.record_gather(
+            bytes_out=int(result.nbytes),
+            bytes_in=int(sum(int(counts[i]) * int(itemsizes[i]) for i in members)),
+            transport_bytes=nprocs * desc_bytes + payload_rounds * nprocs * max_bytes,
+            descriptor_rounds=1,
+            payload_rounds=payload_rounds,
+            world=nprocs,
+            members=members,
+            error=error,
+        )
+    except Exception:  # pragma: no cover - telemetry must never break a sync
+        pass
+
+
 # ---------------------------------------------------------------------------
 # In-graph (mesh-axis) sync — the TPU-native hot path
 # ---------------------------------------------------------------------------
 
 #: reduction spec accepted by ``add_state`` and resolved here
 ReduceFx = Optional[Union[str, Callable]]
+
+#: which XLA collective each string reduction lowers to (telemetry labels)
+_COLLECTIVE_KIND = {"sum": "psum", "mean": "pmean", "max": "pmax", "min": "pmin", "cat": "all_gather", None: "all_gather"}
 
 
 def sync_value_in_graph(value: Array, reduce_fx: ReduceFx, axis_name: AxisName) -> Array:
@@ -311,10 +387,16 @@ def sync_in_graph(
     List states ("cat"/gather-only accumulators) are pre-concatenated into one
     array so each costs exactly one collective, matching the reference's
     pre-concatenation optimization (``metric.py:203-206``).
+
+    Each lowering records its collective composition (which psum/pmax/
+    all_gather kinds, pre-collective payload bytes) into the telemetry
+    registry — host-side at trace time, once per compile, never per step.
     """
     from metrics_tpu.utilities.data import dim_zero_cat
 
     synced: Dict[str, Union[Array, List[Array]]] = {}
+    kinds: Dict[str, int] = {}
+    bytes_traced = 0
     for name, value in state.items():
         fx = reductions.get(name)
         if isinstance(value, (list, tuple)):
@@ -324,6 +406,20 @@ def sync_in_graph(
             value = dim_zero_cat(list(value))
             gathered = sync_value_in_graph(value, "cat" if fx in ("cat", None) else fx, axis_name)
             synced[name] = [gathered] if fx in ("cat", None) else gathered
+            kind = "all_gather" if fx in ("cat", None) else _COLLECTIVE_KIND.get(fx, "all_gather")
         else:
             synced[name] = sync_value_in_graph(value, fx, axis_name)
+            kind = _COLLECTIVE_KIND.get(fx, "all_gather") if not callable(fx) else "all_gather"
+        kinds[kind] = kinds.get(kind, 0) + 1
+        size = getattr(value, "size", None)
+        itemsize = getattr(getattr(value, "dtype", None), "itemsize", None)
+        if size is not None and itemsize is not None:
+            bytes_traced += int(size) * int(itemsize)
+    if kinds:
+        try:
+            from metrics_tpu.observability.registry import TELEMETRY
+
+            TELEMETRY.record_in_graph_sync(axis_name, kinds, bytes_traced)
+        except Exception:  # pragma: no cover - telemetry must never break a sync
+            pass
     return synced

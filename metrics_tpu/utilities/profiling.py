@@ -12,6 +12,16 @@ Enable a trace with the standard JAX tooling, e.g.::
 
     with jax.profiler.trace("/tmp/metrics-trace"):
         state = step(state, preds, target)   # annotated regions appear per metric
+
+These hooks are the TRACE-TIME half of observability: they label compiled
+regions for offline profiler inspection. The RUNTIME half — per-metric call
+counters, eager wall-time histograms, retrace detection, XLA cost reports,
+and collective-sync payload accounting, all scrapeable live via
+``metrics_tpu.observability.snapshot()`` — lives in
+:mod:`metrics_tpu.observability` (see ``docs/observability.md``). The two
+compose: a scanned program measured by :func:`measure_scan_slope` shows up in
+the telemetry registry as one ``update_traces`` entry per compiled length,
+never as per-step counts, because all counters live host-side.
 """
 import time
 from contextlib import contextmanager

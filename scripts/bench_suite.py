@@ -761,6 +761,16 @@ def run_config(cfg, probe: bool = True, _repinned: bool = False) -> dict:
     }
     if extra:
         line.update(extra)
+    # runtime telemetry rides every record: trace counts per metric prove the
+    # measured program compiled exactly as many times as the harness intends
+    # (2 lengths), and a snapshot full of unexpected retraces explains a slow
+    # line without a re-run. Timers are dropped to keep the line compact.
+    try:
+        from metrics_tpu import observability
+
+        line["telemetry"] = observability.snapshot(include_timers=False)
+    except Exception as err:  # pragma: no cover - telemetry must not kill a bench
+        print(f"# telemetry snapshot unavailable: {err!r}", file=sys.stderr)
     if probe:
         line.update(
             probe_us=health["probe_us"],

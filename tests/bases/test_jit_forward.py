@@ -192,6 +192,78 @@ def test_collection_pickle(stream):
     c2(jnp.asarray(probs[1]), jnp.asarray(target[1]))
 
 
+def test_collection_add_metrics_after_jit_forward_invalidates_cache(stream):
+    """A member added after jit_forward() must flow into the compiled program:
+    the stale cache (which baked in the old member set) is cleared and the new
+    member's values appear from the next call."""
+    probs, target = stream
+    col = MetricCollection([Accuracy()]).jit_forward()
+    col(jnp.asarray(probs[0]), jnp.asarray(target[0]))  # build the cache
+    assert col._jit_forward_fn is not None
+    col.add_metrics(Precision(average="macro", num_classes=NC))
+    assert col._jit_forward_fn is None  # stale program dropped
+    out = col(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    assert set(out) == {"Accuracy", "Precision"}
+    # parity with an eagerly-updated oracle for the new member
+    oracle = Precision(average="macro", num_classes=NC)
+    oracle.update(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    np.testing.assert_allclose(
+        float(col["Precision"].compute()), float(oracle.compute()), atol=1e-6
+    )
+
+
+def test_collection_add_metrics_after_jit_forward_rejects_ineligible(stream):
+    """An ineligible member added post-enablement raises the documented
+    ValueError (instead of silently retracing every step) and rolls back."""
+    probs, target = stream
+    col = MetricCollection([Accuracy()]).jit_forward()
+    col(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    with pytest.raises(ValueError, match="AUROC"):
+        col.add_metrics(AUROC())
+    assert "AUROC" not in col  # rollback: the bad member is not half-added
+    # the collection still works compiled afterwards
+    col(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+
+
+def test_collection_setitem_after_jit_forward_invalidates_cache(stream):
+    probs, target = stream
+    col = MetricCollection([Accuracy()]).jit_forward()
+    col(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    col["Accuracy"] = Accuracy()
+    assert col._jit_forward_fn is None
+    with pytest.raises(ValueError, match="list states"):
+        col["Accuracy"] = AUROC()
+
+
+def test_metric_pickle_from_0_4_0_loads(stream):
+    """A 0.4.0 pickle predates ``_jit_forward_enabled``; __setstate__ must
+    default it off instead of crashing at the first forward()."""
+    probs, target = stream
+    m = Accuracy()
+    m.update(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    legacy = m.__getstate__()
+    legacy.pop("_jit_forward_enabled")  # simulate the 0.4.0 layout
+    clone = Accuracy.__new__(Accuracy)
+    clone.__setstate__(legacy)
+    assert clone._jit_forward_enabled is False
+    v = clone(jnp.asarray(probs[1]), jnp.asarray(target[1]))  # no AttributeError
+    assert np.asarray(v).shape == ()
+    m.update(jnp.asarray(probs[1]), jnp.asarray(target[1]))  # same stream on both
+    np.testing.assert_allclose(float(clone.compute()), float(m.compute()), atol=1e-7)
+
+
+def test_collection_pickle_from_0_4_0_loads(stream):
+    probs, target = stream
+    col = MetricCollection([Accuracy()])
+    legacy = col.__getstate__()
+    legacy.pop("_jit_forward_enabled")
+    clone = MetricCollection.__new__(MetricCollection)
+    clone.__setstate__(legacy)
+    assert clone._jit_forward_enabled is False
+    out = clone(jnp.asarray(probs[0]), jnp.asarray(target[0]))  # no AttributeError
+    assert np.asarray(out["Accuracy"]).shape == ()
+
+
 def test_jitted_is_actually_compiled(stream):
     """The jitted path must not re-dispatch eagerly: one traced call, then
     cached executions (trace counting via a wrapped update)."""

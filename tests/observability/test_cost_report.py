@@ -1,0 +1,109 @@
+"""XLA cost reports on the CPU backend: shape of ``Metric.cost_report`` /
+``MetricCollection.cost_report``, state-memory accounting (including list
+accumulators), and the graceful-degradation contract."""
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import AUROC, Accuracy, F1, MetricCollection, Precision, observability
+from metrics_tpu.observability.cost import program_cost, pytree_nbytes
+
+NC = 3
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    observability.reset()
+    yield
+    observability.reset()
+
+
+@pytest.fixture()
+def batch():
+    rng = np.random.RandomState(0)
+    probs = rng.rand(16, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(rng.randint(0, NC, 16))
+
+
+def test_metric_cost_report_shape(batch):
+    preds, target = batch
+    rep = Accuracy().cost_report(preds, target)
+    assert rep["metric"] == "Accuracy"
+    for program in ("update", "compute"):
+        section = rep[program]
+        assert section["available"], section
+        assert section["flops"] > 0
+        assert section["bytes_accessed"] > 0
+        assert isinstance(section["raw"], dict)
+    # compiled memory sizes come from memory_analysis
+    assert rep["update"]["argument_bytes"] > 0
+    assert rep["update"]["output_bytes"] > 0
+    assert json.dumps(rep)  # JSON-serializable end to end
+
+
+def test_state_memory_report_fixed_and_list_states(batch):
+    preds, target = batch
+    acc = Accuracy()
+    rep = acc.state_memory_report()
+    assert set(rep["per_state"]) == set(acc._defaults)
+    assert rep["total_bytes"] == sum(e["bytes"] for e in rep["per_state"].values())
+
+    auroc = AUROC()  # unbounded list states
+    assert auroc.state_memory_report()["total_bytes"] == 0
+    scores, labels = preds[:, 0], (target > 0).astype(jnp.int32)
+    auroc.update(scores, labels)
+    auroc.update(scores, labels)
+    rep = auroc.state_memory_report()
+    assert rep["total_bytes"] > 0
+    for entry in rep["per_state"].values():
+        assert entry["elements"] == 2  # list growth is visible
+
+
+def test_collection_cost_report_fused_vs_members(batch):
+    preds, target = batch
+    col = MetricCollection(
+        [Accuracy(), Precision(average="macro", num_classes=NC), F1(average="macro", num_classes=NC)]
+    )
+    rep = col.cost_report(preds, target)
+    assert set(rep["members"]) == {"Accuracy", "Precision", "F1"}
+    assert rep["fused_update"]["available"]
+    member_flops = sum(m["update"]["flops"] for m in rep["members"].values())
+    # the fused program shares the stat-scores pass across P/F1: it must not
+    # cost more than the members run separately
+    assert rep["fused_update"]["flops"] <= member_flops
+    assert rep["state_memory"]["total_bytes"] == sum(
+        m["state_memory"]["total_bytes"] for m in rep["members"].values()
+    )
+    assert json.dumps(rep)
+
+
+def test_program_cost_accepts_shape_structs():
+    import jax
+
+    spec = jax.ShapeDtypeStruct((128, 64), jnp.float32)
+    rep = program_cost(lambda x: (x * 2).sum(), spec)
+    assert rep["available"] and rep["flops"] > 0
+
+
+def test_program_cost_degrades_instead_of_raising():
+    rep = program_cost(lambda x: undefined_name + x, jnp.zeros(()))  # noqa: F821
+    assert rep == {"available": False, "error": rep["error"]}
+    assert "NameError" in rep["error"]
+
+
+def test_pytree_nbytes():
+    tree = {"a": jnp.zeros((4,), jnp.float32), "b": [jnp.zeros((2, 2), jnp.int32)] * 3}
+    assert pytree_nbytes(tree) == 4 * 4 + 3 * 4 * 4
+
+
+def test_cost_report_on_compositional(batch):
+    preds, target = batch
+    comp = Accuracy() + 1.0
+    mem = comp.state_memory_report()
+    assert "a" in mem["per_state"] and mem["total_bytes"] > 0
+    rep = comp.cost_report(preds, target)
+    assert rep["update"]["available"]

@@ -1,0 +1,291 @@
+"""Telemetry registry: counter correctness across the eager, compiled and
+forward paths, histogram/timer behavior, enable/disable gating, thread
+safety, and the snapshot's JSON/export contracts."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall, observability
+from metrics_tpu.observability.registry import TelemetryRegistry
+
+NB, B, NC = 3, 32, 3
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    observability.reset()
+    observability.enable()
+    yield
+    observability.reset()
+    observability.enable()
+
+
+@pytest.fixture()
+def stream():
+    rng = np.random.RandomState(0)
+    probs = rng.rand(NB, B, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    return probs, rng.randint(0, NC, (NB, B))
+
+
+def _counters(snap, key):
+    return snap["metrics"][key]["counters"]
+
+
+def test_eager_forward_counts_and_timers(stream):
+    probs, target = stream
+    m = Accuracy()
+    key = m.telemetry_key
+    for i in range(NB):
+        m(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    m.compute()
+    m.reset()
+
+    snap = observability.snapshot()
+    counters = _counters(snap, key)
+    assert counters["forward_fused_calls"] == NB
+    # fused forward computes the on-step value through compute(): NB on-step
+    # calls + the epoch compute
+    assert counters["compute_calls"] == NB + 1
+    assert counters["reset_calls"] == 1
+    timers = snap["metrics"][key]["timers"]
+    assert timers["forward"]["count"] == NB
+    assert timers["forward"]["sum_s"] > 0
+    assert sum(timers["forward"]["buckets"].values()) == NB
+
+
+def test_update_path_counts(stream):
+    probs, target = stream
+    m = Accuracy()
+    key = m.telemetry_key
+    for i in range(NB):
+        m.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    counters = _counters(observability.snapshot(), key)
+    assert counters["update_calls"] == NB
+
+
+def test_double_update_forward_path_counts():
+    from metrics_tpu import Metric
+
+    class CustomReduce(Metric):
+        # a custom dist_reduce_fx is not mergeable -> reference double-update
+        def __init__(self):
+            super().__init__()
+            self.add_state("vals", jnp.zeros(()), dist_reduce_fx=lambda x: x.sum(0))
+
+        def update(self, x):
+            self.vals = self.vals + jnp.sum(x)
+
+        def compute(self):
+            return self.vals
+
+    m = CustomReduce()
+    key = m.telemetry_key
+    m(jnp.asarray([1.0, 2.0]))
+    counters = _counters(observability.snapshot(), key)
+    assert counters["forward_double_update_calls"] == 1
+    assert counters["update_calls"] == 2  # the documented two update() calls
+    assert counters["reset_calls"] == 1  # the protocol's mid-forward reset
+
+
+def test_compiled_forward_counts(stream):
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    key = m.telemetry_key
+    for i in range(NB):
+        m(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    counters = _counters(observability.snapshot(), key)
+    assert counters["forward_compiled_calls"] == NB
+    assert counters["jit_forward_compiles"] == 1  # one shape -> one compile
+    assert counters["update_traces"] == 1  # trace-entry hook: once per compile
+    # the compiled path records no eager wall-time histograms
+    assert "timers" not in observability.snapshot()["metrics"][key]
+
+
+def test_collection_member_counters_all_three_paths(stream):
+    probs, target = stream
+    members = lambda: [
+        Accuracy(),
+        Precision(average="macro", num_classes=NC),
+        Recall(average="macro", num_classes=NC),
+        F1(average="macro", num_classes=NC),
+    ]
+    eager = MetricCollection(members())
+    keys = [m.telemetry_key for m in eager.values()]
+    for i in range(NB):
+        eager(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    eager.update(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    snap = observability.snapshot()
+    for key in keys:
+        assert _counters(snap, key)["forward_fused_calls"] == NB, key
+        assert _counters(snap, key)["update_calls"] == 1, key
+
+    jitted = MetricCollection(members()).jit_forward()
+    jkeys = [m.telemetry_key for m in jitted.values()]
+    for i in range(NB):
+        jitted(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    snap = observability.snapshot()
+    for key in jkeys:
+        assert _counters(snap, key)["forward_compiled_calls"] == NB, key
+    ckey = jitted.telemetry_key
+    assert _counters(snap, ckey)["forward_compiled_calls"] == NB
+    assert _counters(snap, ckey)["jit_forward_compiles"] == 1
+
+
+def test_snapshot_includes_state_memory_of_live_metrics(stream):
+    probs, target = stream
+    m = Accuracy()
+    key = m.telemetry_key
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    snap = observability.snapshot()
+    mem = snap["metrics"][key]["state_memory"]
+    assert mem["total_bytes"] > 0
+    assert set(mem["per_state"]) == set(m._defaults)
+
+
+def test_snapshot_json_serializable_and_schema(stream):
+    probs, target = stream
+    m = Accuracy()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    snap = observability.snapshot()
+    assert snap["schema"] == 1
+    round_tripped = json.loads(json.dumps(snap))
+    assert round_tripped["metrics"] == snap["metrics"]
+    assert json.loads(observability.dumps()) == snap
+
+
+def test_disable_stops_recording(stream):
+    probs, target = stream
+    m = Accuracy()
+    key = m.telemetry_key
+    observability.disable()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    observability.enable()
+    snap = observability.snapshot()
+    assert key not in snap["metrics"] or not _counters(snap, key)
+
+
+def test_instance_keys_are_distinct_and_stable():
+    a, b = Accuracy(), Accuracy()
+    assert a.telemetry_key != b.telemetry_key
+    assert a.telemetry_key == a.telemetry_key  # stable across accesses
+    assert a.telemetry_key.startswith("Accuracy#")
+
+
+def test_clone_and_pickle_get_fresh_keys(stream):
+    import pickle
+
+    probs, target = stream
+    m = Accuracy()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    original_key = m.telemetry_key
+    assert m.clone().telemetry_key != original_key
+    assert pickle.loads(pickle.dumps(m)).telemetry_key != original_key
+
+
+def test_registry_thread_safety():
+    reg = TelemetryRegistry()
+    n_threads, n_incs = 8, 500
+
+    def work():
+        for _ in range(n_incs):
+            reg.inc("K#0", "c")
+            reg.observe("K#0", "p", 1e-4)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["metrics"]["K#0"]["counters"]["c"] == n_threads * n_incs
+    assert snap["metrics"]["K#0"]["timers"]["p"]["count"] == n_threads * n_incs
+
+
+def test_prometheus_render_contains_counters_and_histograms(stream):
+    probs, target = stream
+    m = Accuracy()
+    key = m.telemetry_key
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    text = observability.render_prometheus()
+    assert f'metrics_tpu_calls_total{{metric="{key}",op="forward_fused_calls"}} 1' in text
+    assert "# TYPE metrics_tpu_eager_seconds histogram" in text
+    assert f'metrics_tpu_eager_seconds_count{{metric="{key}",phase="forward"}} 1' in text
+    assert 'le="+Inf"' in text
+    assert f'metrics_tpu_state_bytes{{metric="{key}"}}' in text
+
+
+def test_acceptance_snapshot_covers_all_dimensions(stream):
+    """The ISSUE's acceptance shape: one collection exercised through eager,
+    jit_forward, and synced paths; the snapshot must cover call counters,
+    retrace counts, state memory, and sync payload bytes — JSON-serializable."""
+    probs, target = stream
+    world = lambda x, group=None: [x, x]  # forces the eager sync path locally
+    coll = MetricCollection(
+        [
+            Accuracy(dist_sync_fn=world),
+            Precision(average="macro", num_classes=NC, dist_sync_fn=world),
+        ]
+    )
+    for i in range(NB):  # eager path
+        coll(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    coll.compute()  # synced path
+    coll.jit_forward()  # compiled path
+    coll(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+
+    snap = json.loads(json.dumps(observability.snapshot()))
+    for m in coll.values():
+        entry = snap["metrics"][m.telemetry_key]
+        counters = entry["counters"]
+        assert counters["forward_fused_calls"] == NB
+        assert counters["forward_compiled_calls"] == 1
+        assert counters["sync_calls"] >= 1
+        assert counters["sync_payload_bytes"] > 0
+        assert entry["state_memory"]["total_bytes"] > 0
+    assert snap["retrace"]["metrics"][coll.telemetry_key]["compiles"] >= 1
+
+
+def test_compiled_program_identical_with_telemetry_on_and_off(stream):
+    """The hard guarantee behind "no measurable regression": telemetry must
+    not change the traced program AT ALL — same jaxpr with recording on/off."""
+    import jax
+
+    probs, target = stream
+    coll = MetricCollection([Accuracy(), Precision(average="macro", num_classes=NC)])
+    state = coll.init_state()
+    p, t = jnp.asarray(probs[0]), jnp.asarray(target[0])
+    observability.enable()
+    jaxpr_on = str(jax.make_jaxpr(coll.apply_update)(state, p, t))
+    observability.disable()
+    jaxpr_off = str(jax.make_jaxpr(coll.apply_update)(state, p, t))
+    assert jaxpr_on == jaxpr_off
+
+
+def test_no_traced_ops_added_to_compiled_update(stream):
+    """The acceptance guard: instrumentation must live host-side. The trace
+    hook fires once per compile — a scanned epoch of N steps records exactly
+    one update trace, not N."""
+    import jax
+
+    probs, target = stream
+    m = Accuracy()
+    key = m.telemetry_key
+
+    @jax.jit
+    def epoch(state, ps, ts):
+        def body(s, xs):
+            return m.apply_update(s, *xs), None
+
+        return jax.lax.scan(body, state, (ps, ts))[0]
+
+    state = epoch(m.init_state(), jnp.asarray(probs), jnp.asarray(target))
+    counters = _counters(observability.snapshot(), key)
+    assert counters["update_traces"] == 1
+    # and the result is still correct
+    got = float(m.apply_compute(state, axis_name=None))
+    want = float(np.mean(probs.argmax(-1) == target))
+    np.testing.assert_allclose(got, want, atol=1e-6)

@@ -1,0 +1,119 @@
+"""Retrace detector: compile counting via jit cache-size deltas, the
+threshold warning on deliberate shape churn, and the configuration knobs."""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, MetricCollection, observability
+from metrics_tpu.observability.retrace import RetraceMonitor, arg_signature
+
+NC = 3
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    observability.reset()
+    observability.enable()
+    prev = observability.get_retrace_threshold()
+    yield
+    observability.set_retrace_threshold(prev)
+    observability.reset()
+    observability.enable()
+
+
+def _batches(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    for n in sizes:
+        probs = rng.rand(n, NC).astype(np.float32)
+        yield jnp.asarray(probs / probs.sum(-1, keepdims=True)), jnp.asarray(rng.randint(0, NC, n))
+
+
+def test_shape_churn_fires_threshold_warning():
+    observability.set_retrace_threshold(2)
+    m = Accuracy().jit_forward()
+    with pytest.warns(UserWarning, match="compiled its jitted forward"):
+        for preds, target in _batches([8, 9, 10]):  # 3 shapes > threshold 2
+            m(preds, target)
+    rec = observability.snapshot()["retrace"]["metrics"][m.telemetry_key]
+    assert rec["compiles"] == 3 and rec["warned"]
+    # the warning names the churning signatures
+    assert any("float32[10,3]" in s for s in rec["signatures"])
+
+
+def test_warning_fires_once():
+    observability.set_retrace_threshold(1)
+    m = Accuracy().jit_forward()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for preds, target in _batches([8, 9, 10, 11, 12]):
+            m(preds, target)
+    churn = [w for w in caught if "compiled its jitted forward" in str(w.message)]
+    assert len(churn) == 1
+
+
+def test_stable_shapes_do_not_warn():
+    observability.set_retrace_threshold(1)
+    m = Accuracy().jit_forward()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for preds, target in _batches([16, 16, 16, 16]):
+            m(preds, target)
+    assert not [w for w in caught if "compiled its jitted forward" in str(w.message)]
+    rec = observability.snapshot()["retrace"]["metrics"][m.telemetry_key]
+    assert rec["compiles"] == 1 and not rec["warned"]
+
+
+def test_collection_shape_churn_detected_on_collection_key():
+    observability.set_retrace_threshold(2)
+    col = MetricCollection([Accuracy()]).jit_forward()
+    with pytest.warns(UserWarning, match="MetricCollection#"):
+        for preds, target in _batches([8, 9, 10]):
+            col(preds, target)
+    rec = observability.snapshot()["retrace"]["metrics"][col.telemetry_key]
+    assert rec["compiles"] == 3
+
+
+def test_pure_api_traces_counted_but_never_warn():
+    import jax
+
+    observability.set_retrace_threshold(1)
+    m = Accuracy()
+    key = m.telemetry_key
+    fn = jax.jit(m.apply_update)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for preds, target in _batches([8, 9, 10, 11]):
+            fn(m.init_state(), preds, target)
+    assert not [w for w in caught if "compiled its jitted forward" in str(w.message)]
+    rec = observability.snapshot()["retrace"]["metrics"][key]
+    assert rec["traces"] == 4 and rec["compiles"] == 0
+
+
+def test_threshold_knobs():
+    observability.set_retrace_threshold(7)
+    assert observability.get_retrace_threshold() == 7
+    with pytest.raises(ValueError):
+        observability.set_retrace_threshold(0)
+
+
+def test_monitor_unit_behavior():
+    mon = RetraceMonitor(threshold=2)
+    mon.note_compile("X#0", "(float32[4])")
+    mon.note_compile("X#0", "(float32[5])")
+    snap = mon.snapshot()
+    assert snap["metrics"]["X#0"]["compiles"] == 2
+    assert not snap["metrics"]["X#0"]["warned"]
+    with pytest.warns(UserWarning, match="X#0"):
+        mon.note_compile("X#0", "(float32[6])")
+    assert mon.snapshot()["metrics"]["X#0"]["warned"]
+    mon.reset()
+    assert mon.snapshot()["metrics"] == {}
+
+
+def test_arg_signature_shapes_dtypes_and_fallbacks():
+    sig = arg_signature(jnp.zeros((4, 2), jnp.float32), jnp.zeros((4,), jnp.int32), flag=True)
+    assert "float32[4,2]" in sig and "int32[4]" in sig and "flag=bool" in sig
+    assert arg_signature({"a": jnp.zeros(())}, [1, 2]) .startswith("(")

@@ -32,3 +32,21 @@ def test_every_functional_documented():
     documented = _documented("functional.md", "metrics_tpu.functional")
     missing = public - documented
     assert not missing, f"exports missing from docs/functional.md: {sorted(missing)}"
+
+
+def test_every_observability_export_documented():
+    import metrics_tpu.observability as obs
+
+    public = set(obs.__all__)
+    documented = _documented("observability.md", "metrics_tpu.observability")
+    missing = public - documented
+    assert not missing, f"exports missing from docs/observability.md: {sorted(missing)}"
+
+
+def test_observability_page_cross_linked():
+    """The page must be reachable from the performance guide and the README
+    (the two places a user hunting for runtime numbers starts from)."""
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        assert "observability.md" in fh.read()
+    with open(os.path.join(os.path.dirname(DOCS_DIR), "README.md")) as fh:
+        assert "docs/observability.md" in fh.read()
