@@ -11,11 +11,14 @@ prints LAST, and the full line set is re-emitted as a final block.
 
 Each line is ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
 "probe_us": ..., "probe_us_after": ..., "link_rtt_ms": ..., "degraded":
-bool, "telemetry": {...}}`` — ``telemetry`` is the runtime observability
-snapshot (``metrics_tpu.observability.snapshot()``: per-metric call/trace
-counters, retrace ledger, sync payload stats) captured in the config's own
-process, so a slow line carries the compile-churn evidence to explain
-itself. ``vs_baseline`` is baseline_time / our_time (higher is
+bool, "telemetry": {...}, "health": {...}, "events_high_water": N}`` —
+``telemetry`` is the runtime observability snapshot
+(``metrics_tpu.observability.snapshot()``: per-metric call/trace counters,
+retrace ledger, sync payload stats, event-log and health summaries)
+captured in the config's own process, so a slow line carries the
+compile-churn evidence to explain itself; ``health`` and
+``events_high_water`` surface the numerical-health summary and event-log
+retention high-water mark beside it. ``vs_baseline`` is baseline_time / our_time (higher is
 better; >1 = faster than the baseline — the reference library on torch-CPU
 for the parity configs, our own XLA formulation for the Pallas config, the
 1% target for the overhead config). Values are NaN-safe: a failed

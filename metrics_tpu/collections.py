@@ -14,6 +14,7 @@ from copy import deepcopy
 from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Union
 
 from metrics_tpu.metric import AXIS_UNSET, Metric, StateDict, _note_compiled_dispatch, _observed_forward
+from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.utilities.prints import rank_zero_warn
 from metrics_tpu.utilities.profiling import compiled_scope, eager_span
@@ -133,6 +134,7 @@ class MetricCollection:
 
     def _forward_jitted(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         import functools
+        import time
 
         import jax
 
@@ -140,7 +142,17 @@ class MetricCollection:
             self._jit_forward_fn = jax.jit(functools.partial(self.apply_forward, axis_name=None))
             self._jit_cache_seen = 0
         state = {name: m._get_states() for name, m in self.items(keep_base=True)}
+        start = time.perf_counter() if EVENTS.enabled else None
         new_state, values = self._jit_forward_fn(state, *args, **kwargs)
+        if start is not None:
+            EVENTS.record(
+                "forward",
+                self.telemetry_key,
+                dur_s=time.perf_counter() - start,
+                t_start=start,
+                path="compiled",
+                members=len(self._metrics),
+            )
         record = TELEMETRY.enabled
         if record:
             # one compiled program serves every member: the collection key
@@ -395,6 +407,19 @@ class MetricCollection:
     # ------------------------------------------------------------------
     # observability reports
     # ------------------------------------------------------------------
+
+    def check_health(self, state: Optional[Dict[str, StateDict]] = None) -> Dict[str, Any]:
+        """Numerical health report of every member (see
+        :meth:`Metric.check_health`), keyed by base name, plus the
+        collection-level ``healthy`` conjunction."""
+        state = state or {}
+        members = {
+            name: m.check_health(state.get(name)) for name, m in self.items(keep_base=True)
+        }
+        return {
+            "healthy": all(r["healthy"] for r in members.values()),
+            "members": members,
+        }
 
     def state_memory_report(self) -> Dict[str, Any]:
         """Bytes held by every member's states right now (see

@@ -50,6 +50,8 @@ from metrics_tpu.utilities.data import (
     dim_zero_min,
     dim_zero_sum,
 )
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.health import HEALTH, MetricHealthError, guard_state  # noqa: F401
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import MONITOR, arg_signature, is_tracing
 from metrics_tpu.utilities.distributed import (
@@ -111,17 +113,21 @@ def jit_distributed_available() -> bool:  # pragma: no cover - thin alias
 
 def _observed_forward(obj: Any, counter: str, thunk: Callable) -> Any:
     """Run one eager forward under telemetry: path counter + wall-time
-    histogram. Host-side only — the thunk itself is the (un-traced) eager
-    dispatch path."""
-    if not TELEMETRY.enabled:
+    histogram + timeline event. Host-side only — the thunk itself is the
+    (un-traced) eager dispatch path."""
+    if not (TELEMETRY.enabled or EVENTS.enabled):
         return thunk()
     start = time.perf_counter()
     try:
         return thunk()
     finally:
+        dur = time.perf_counter() - start
         key = obj.telemetry_key
-        TELEMETRY.inc(key, counter)
-        TELEMETRY.observe(key, "forward", time.perf_counter() - start)
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(key, counter)
+            TELEMETRY.observe(key, "forward", dur)
+        if EVENTS.enabled:
+            EVENTS.record("forward", key, dur_s=dur, t_start=start, path=counter)
 
 
 def _note_compiled_dispatch(obj: Any, fn: Any, args: Tuple, kwargs: Dict) -> None:
@@ -311,7 +317,10 @@ class Metric(ABC):
         with compiled_scope(f"{self.__class__.__name__}.update"):
             with self._bound_state({k: (list(v) if isinstance(v, list) else v) for k, v in state.items()}):
                 self._unwrapped_update(*args, **kwargs)
-                return self._get_states()
+                new_state = self._get_states()
+        if HEALTH.enabled:
+            guard_state(self, new_state, source="apply_update")
+        return new_state
 
     def apply_compute(self, state: StateDict, axis_name: Any = AXIS_UNSET) -> Any:
         """Pure compute: final value from ``state``.
@@ -386,6 +395,11 @@ class Metric(ABC):
             )
         if self._states_mergeable():
             new_state = self.merge_states(state, batch_state)
+            # the merged accumulator never passes through apply_update's
+            # guard; check it here or a NaN already in `state` (the
+            # jit_forward accumulator) would go unwatched
+            if HEALTH.enabled:
+                guard_state(self, new_state, source="apply_forward")
         else:
             new_state = self.apply_update(state, *args, **kwargs)
         return new_state, value
@@ -422,14 +436,21 @@ class Metric(ABC):
         self._update_called = True
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, "update_calls")
+        if EVENTS.enabled:
+            EVENTS.record("update", self.telemetry_key, path="shared_deltas")
         self._accumulate(*deltas)
+        if HEALTH.enabled:
+            guard_state(self, self._get_states(), source="update")
 
     def _apply_accumulate(self, state: StateDict, deltas: Tuple) -> StateDict:
         """Pure analogue of :meth:`_accumulate`: state advanced by precomputed deltas."""
         with compiled_scope(f"{self.__class__.__name__}.update"):
             with self._bound_state({k: (list(v) if isinstance(v, list) else v) for k, v in state.items()}):
                 self._accumulate(*deltas)
-                return self._get_states()
+                new_state = self._get_states()
+        if HEALTH.enabled:
+            guard_state(self, new_state, source="apply_update")
+        return new_state
 
     def _states_mergeable(self) -> bool:
         if not self._fusable:
@@ -560,7 +581,18 @@ class Metric(ABC):
             else:
                 self._jit_forward_fn = jax.jit(self.apply_update)
             self._jit_cache_seen = 0
+        start = time.perf_counter() if EVENTS.enabled else None
         out = self._jit_forward_fn(self._get_states(), *args, **kwargs)
+        if start is not None:
+            # wall time of the (async) dispatch, not the device step — the
+            # device cost lives in the profiler trace this timeline rides next to
+            EVENTS.record(
+                "forward",
+                self.telemetry_key,
+                dur_s=time.perf_counter() - start,
+                t_start=start,
+                path="compiled",
+            )
         if TELEMETRY.enabled:
             _note_compiled_dispatch(self, self._jit_forward_fn, args, kwargs)
         new_state, value = out if self.compute_on_step else (out, None)
@@ -598,6 +630,10 @@ class Metric(ABC):
         self._restore_cache = True
         self._to_sync = True
         self._computed = None
+        if HEALTH.enabled:
+            # eager accumulator after the merge: concrete values, so policy
+            # "raise" surfaces MetricHealthError from this forward call
+            guard_state(self, self._get_states(), source="forward")
         return result
 
     def _forward_double_update(self, *args: Any, **kwargs: Any) -> Any:
@@ -626,15 +662,24 @@ class Metric(ABC):
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
             self._computed = None
             self._update_called = True
-            if not TELEMETRY.enabled:
+            observed = TELEMETRY.enabled or EVENTS.enabled
+            if not observed and not HEALTH.enabled:
                 return update(*args, **kwargs)
             start = time.perf_counter()
             try:
-                return update(*args, **kwargs)
+                result = update(*args, **kwargs)
             finally:
-                key = self.telemetry_key
-                TELEMETRY.inc(key, "update_calls")
-                TELEMETRY.observe(key, "update", time.perf_counter() - start)
+                if observed:
+                    dur = time.perf_counter() - start
+                    key = self.telemetry_key
+                    if TELEMETRY.enabled:
+                        TELEMETRY.inc(key, "update_calls")
+                        TELEMETRY.observe(key, "update", dur)
+                    if EVENTS.enabled:
+                        EVENTS.record("update", key, dur_s=dur, t_start=start)
+            if HEALTH.enabled:
+                guard_state(self, self._get_states(), source="update")
+            return result
 
         return wrapped_func
 
@@ -652,7 +697,7 @@ class Metric(ABC):
                 TELEMETRY.inc(self.telemetry_key, "compute_calls")
             if self._computed is not None:
                 return self._computed
-            start = time.perf_counter() if TELEMETRY.enabled else None
+            start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
@@ -660,7 +705,11 @@ class Metric(ABC):
             ):
                 self._computed = compute(*args, **kwargs)
             if start is not None:
-                TELEMETRY.observe(self.telemetry_key, "compute", time.perf_counter() - start)
+                dur = time.perf_counter() - start
+                if TELEMETRY.enabled:
+                    TELEMETRY.observe(self.telemetry_key, "compute", dur)
+                if EVENTS.enabled:
+                    EVENTS.record("compute", self.telemetry_key, dur_s=dur, t_start=start)
             return self._computed
 
         return wrapped_func
@@ -687,14 +736,26 @@ class Metric(ABC):
                     [dim_zero_cat(value)] if value else [jnp.zeros((0,), jnp.float32)]
                 )
 
-        if TELEMETRY.enabled:
+        payload_bytes = None
+        if TELEMETRY.enabled or EVENTS.enabled:
             from metrics_tpu.observability.cost import pytree_nbytes
 
-            key = self.telemetry_key
-            TELEMETRY.inc(key, "sync_calls")
-            TELEMETRY.inc(key, "sync_payload_bytes", pytree_nbytes(states))
+            payload_bytes = pytree_nbytes(states)
+            if TELEMETRY.enabled:
+                key = self.telemetry_key
+                TELEMETRY.inc(key, "sync_calls")
+                TELEMETRY.inc(key, "sync_payload_bytes", payload_bytes)
 
+        sync_start = time.perf_counter() if EVENTS.enabled else None
         gathered = apply_to_collection(states, ArrayTypes, dist_sync_fn, group=process_group or self.process_group)
+        if sync_start is not None:
+            EVENTS.record(
+                "sync",
+                self.telemetry_key,
+                dur_s=time.perf_counter() - sync_start,
+                t_start=sync_start,
+                payload_bytes=payload_bytes,
+            )
 
         for name, fx in self._reductions.items():
             value = gathered[name]
@@ -822,6 +883,23 @@ class Metric(ABC):
     # ------------------------------------------------------------------
     # observability reports
     # ------------------------------------------------------------------
+
+    def check_health(self, state: Optional[StateDict] = None) -> Dict[str, Any]:
+        """Numerical health report of ``state`` (default: the live stateful
+        states): per-state NaN/Inf element counts plus the zero total-weight
+        flag for mean-style denominators. Works at any health policy — an
+        explicit check never raises or warns, but an unhealthy result records
+        a ``health`` event and the per-metric ``health_events`` counter.
+        Eager only: values are read to the host (pass concrete states).
+
+        The automatic per-update guard — the policy-driven, jit-compatible
+        version of this check — is enabled with
+        ``observability.set_health_policy("record" | "warn" | "raise")``;
+        see :mod:`metrics_tpu.observability.health`.
+        """
+        from metrics_tpu.observability.health import check_state
+
+        return check_state(self, self._get_states() if state is None else state)
 
     def state_memory_report(self) -> Dict[str, Any]:
         """Bytes held by each registered state right now.
@@ -1029,6 +1107,21 @@ class CompositionalMetric(Metric):
             self.metric_a.persistent(mode=mode)
         if isinstance(self.metric_b, Metric):
             self.metric_b.persistent(mode=mode)
+
+    def check_health(self, state: Optional[StateDict] = None) -> Dict[str, Any]:
+        # the composition owns no states; fan the check to the children
+        # (keyed like the pure-state layout, aliased child checked once)
+        state = state or {}
+        children: Dict[str, Any] = {}
+        if isinstance(self.metric_a, Metric):
+            children["a"] = self.metric_a.check_health(state.get("a"))
+        if isinstance(self.metric_b, Metric) and self.metric_b is not self.metric_a:
+            children["b"] = self.metric_b.check_health(state.get("b"))
+        return {
+            "metric": self.telemetry_key,
+            "healthy": all(c["healthy"] for c in children.values()),
+            "children": children,
+        }
 
     def state_memory_report(self) -> Dict[str, Any]:
         # the composition owns no states; report the children's (keyed like

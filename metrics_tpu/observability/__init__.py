@@ -1,6 +1,6 @@
 """Runtime telemetry for the metric lifecycle (see ``docs/observability.md``).
 
-Four pieces, one snapshot:
+Seven pieces, one snapshot:
 
 * :mod:`~metrics_tpu.observability.registry` — thread-safe per-metric
   counters (update/forward/compute/reset/sync, eager vs. compiled path) and
@@ -11,18 +11,45 @@ Four pieces, one snapshot:
 * :mod:`~metrics_tpu.observability.cost` — ``jit(...).lower().compile()``
   cost/memory analysis behind ``Metric.cost_report()`` and
   ``state_memory_report()``.
+* :mod:`~metrics_tpu.observability.events` — the bounded, step-correlated
+  structured event log (:data:`EVENTS`, :func:`set_step` /
+  :func:`step_context`) every instrumented point feeds.
+* :mod:`~metrics_tpu.observability.timeline` — Chrome-trace/Perfetto JSON
+  export of the event log (``timeline.export(path)``).
+* :mod:`~metrics_tpu.observability.health` — on-device NaN/Inf/zero-weight
+  monitoring: ``Metric.check_health()`` plus the opt-in per-update guard
+  (:func:`set_health_policy`).
 * :mod:`~metrics_tpu.observability.export` — :func:`snapshot` (JSON dict) and
   :func:`render_prometheus` (text exposition format).
 
 Everything is recorded host-side; the compiled hot paths carry zero extra
-traced ops. Typical scrape::
+traced ops unless the (opt-in) health guard is armed — and
+``scripts/check_zero_overhead.py`` gates that the disabled-state jaxprs stay
+byte-identical to the uninstrumented baseline. Typical scrape::
 
     from metrics_tpu import observability
     snap = observability.snapshot()           # JSON-serializable dict
     text = observability.render_prometheus()  # Prometheus text format
+    observability.timeline.export("/tmp/metrics-timeline.json")
 """
+from metrics_tpu.observability import timeline  # noqa: F401
 from metrics_tpu.observability.cost import program_cost, pytree_nbytes  # noqa: F401
+from metrics_tpu.observability.events import (  # noqa: F401
+    EVENTS,
+    Event,
+    EventLog,
+    get_step,
+    set_step,
+    step_context,
+)
 from metrics_tpu.observability.export import dumps, render_prometheus, snapshot  # noqa: F401
+from metrics_tpu.observability.health import (  # noqa: F401
+    HEALTH,
+    HealthMonitor,
+    MetricHealthError,
+    get_health_policy,
+    set_health_policy,
+)
 from metrics_tpu.observability.registry import TELEMETRY, TelemetryRegistry  # noqa: F401
 from metrics_tpu.observability.retrace import (  # noqa: F401
     MONITOR,
@@ -34,35 +61,54 @@ from metrics_tpu.observability.retrace import (  # noqa: F401
 
 
 def enable(on: bool = True) -> None:
-    """Turn telemetry recording on (the default) or off process-wide."""
+    """Turn telemetry AND event recording on (the default) or off
+    process-wide. The health guard is governed separately by
+    :func:`set_health_policy` (default ``"off"``)."""
     TELEMETRY.enable(on)
+    EVENTS.enable(on)
 
 
 def disable() -> None:
-    """Stop recording; instrumented call sites reduce to one attribute read."""
+    """Stop recording; instrumented call sites reduce to attribute reads."""
     TELEMETRY.disable()
+    EVENTS.disable()
 
 
 def reset() -> None:
-    """Clear all recorded counters, timers, sync stats and retrace ledgers."""
+    """Clear all recorded counters, timers, sync stats, retrace ledgers,
+    events, and health records (enablement, policy, step tag survive)."""
     TELEMETRY.reset()
     MONITOR.reset()
+    EVENTS.clear()
+    HEALTH.reset()
 
 
 __all__ = [
-    "TELEMETRY",
+    "EVENTS",
+    "Event",
+    "EventLog",
+    "HEALTH",
+    "HealthMonitor",
     "MONITOR",
-    "TelemetryRegistry",
+    "MetricHealthError",
     "RetraceMonitor",
+    "TELEMETRY",
+    "TelemetryRegistry",
     "arg_signature",
     "disable",
     "dumps",
     "enable",
+    "get_health_policy",
     "get_retrace_threshold",
+    "get_step",
     "program_cost",
     "pytree_nbytes",
     "render_prometheus",
     "reset",
+    "set_health_policy",
     "set_retrace_threshold",
+    "set_step",
     "snapshot",
+    "step_context",
+    "timeline",
 ]

@@ -10,6 +10,8 @@ endpoint can serve it directly.
 import json
 from typing import Any, Dict, Optional
 
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.health import HEALTH
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import MONITOR
 
@@ -33,6 +35,13 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
                        "traces": int, "warned": bool, "signatures": [...]}}},
           "sync": {"gathers": int, "payload_bytes_out": int, ...,
                    "groups": {...}, "in_graph": {...}},
+          "events": {"capacity": int, "size": int, "high_water": int,
+                     "recorded_total": int, "dropped": int, "step": int,
+                     "by_kind": {...}},
+          "health": {"policy": str, "unhealthy_total": int,
+                     "metrics": {key: {"checks": int, "unhealthy": int,
+                                        "nan": int, "inf": int,
+                                        "zero_weight": int}}},
         }
 
     Always JSON-serializable (``json.dumps(snapshot())`` round-trips).
@@ -40,11 +49,15 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
     snap = TELEMETRY.snapshot(include_timers=include_timers)
     snap["schema"] = SCHEMA_VERSION
     snap["retrace"] = MONITOR.snapshot()
+    snap["events"] = EVENTS.summary()
+    snap["health"] = HEALTH.summary()
     return snap
 
 
 def _prom_label(value: str) -> str:
-    return value.replace("\\", "\\\\").replace('"', '\\"')
+    # the exposition format requires \\, \" and \n escaped in label values —
+    # an unescaped newline splits the sample line and corrupts the scrape
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
 def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
@@ -107,6 +120,34 @@ def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
     in_graph = sync.get("in_graph", {})
     for kind, n in sorted(in_graph.get("collectives", {}).items()):
         emit("sync_in_graph_collectives_total", {"kind": kind}, n)
+
+    events = snap.get("events", {})
+    if events:
+        emit("events_recorded_total", {}, events.get("recorded_total", 0), type_="counter")
+        emit("events_dropped_total", {}, events.get("dropped", 0), type_="counter")
+        emit("events_high_water", {}, events.get("high_water", 0), type_="gauge")
+        first_kind = True
+        for kind, n in sorted(events.get("by_kind", {}).items()):
+            emit(
+                "events_by_kind_total",
+                {"kind": kind},
+                n,
+                type_="counter" if first_kind else None,
+            )
+            first_kind = False
+
+    health = snap.get("health", {})
+    first_check = True
+    for key, rec in sorted(health.get("metrics", {}).items()):
+        emit(
+            "health_checks_total",
+            {"metric": key},
+            rec["checks"],
+            type_="counter" if first_check else None,
+        )
+        first_check = False
+        for kind in ("unhealthy", "nan", "inf", "zero_weight"):
+            emit(f"health_{kind}_total", {"metric": key}, rec[kind])
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,374 @@
+"""On-device numerical health monitoring for metric states.
+
+A NaN poisoned into a metric accumulator is the worst kind of bug: ``sum``
+merges propagate it silently, every ``compute()`` until ``reset()`` returns
+garbage, and by the time anyone looks the offending step is long gone. This
+module watches the *values* flowing through metric states and catches
+corruption **at the step it enters**:
+
+* :meth:`Metric.check_health` — explicit, eager scan of the current states
+  (NaN/Inf counts per state, zero total-weight for mean-style metrics);
+  always available, policy or not.
+* the **per-update guard** — opt-in via :func:`set_health_policy`; after every
+  state advance the new state's leaves are reduced to a tiny boolean flag
+  array. On eager paths the flags are read directly; under ``jit`` /
+  ``jit_forward()`` they leave the program through ``jax.debug.callback`` —
+  an async host callback, so detection works from compiled steps **without
+  forcing a host sync**.
+
+Policies (:func:`set_health_policy`):
+
+========== ==============================================================
+``"off"``  the default: the guard inserts **zero traced ops** — compiled
+           programs are byte-identical to an uninstrumented build (the
+           ``scripts/check_zero_overhead.py`` gate pins this)
+``"record"`` unhealthy updates record a ``health`` event + per-metric
+           ``health_events`` counter, nothing else
+``"warn"`` record + one ``UserWarning`` per metric naming the states
+``"raise"`` record + :class:`MetricHealthError` on the **eager** paths;
+           compiled paths cannot raise into a running program and degrade
+           to the warn-once behavior
+========== ==============================================================
+
+Zero total-weight: metrics that divide by an accumulated denominator (a
+scalar ``"sum"``-reduced state named ``total`` or ``weight`` — ``Accuracy``,
+``AverageMeter``, every mean-style metric) produce NaN at ``compute()`` when
+that denominator is 0. The guard flags a denominator still at zero *after an
+update* — the step that contributed no weight — before the division ever
+happens.
+"""
+import functools
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+#: accepted health policies, least to most intrusive
+POLICIES = ("off", "record", "warn", "raise")
+
+#: flag columns in the guard's packed boolean array, in order
+_FLAG_KINDS = ("nan", "inf", "zero_weight")
+
+
+class MetricHealthError(RuntimeError):
+    """Raised (policy ``"raise"``, eager paths only) when a metric state
+    update produced NaN/Inf values or a zero total-weight."""
+
+
+class HealthMonitor:
+    """Thread-safe per-metric health ledger plus the process-wide policy.
+
+    One process-global instance (:data:`HEALTH`) backs the library;
+    private instances are supported for tests. The policy read is
+    lock-free — with the default ``"off"`` every guard call site reduces
+    to one attribute read and no traced ops.
+    """
+
+    def __init__(self, policy: str = "off") -> None:
+        self._lock = threading.Lock()
+        self._policy = policy
+        self._records: Dict[str, Dict[str, int]] = {}
+        self._warned: set = set()
+
+    # -- policy (lock-free read: guards gate on this every call) ------------
+
+    @property
+    def policy(self) -> str:
+        return self._policy
+
+    @property
+    def enabled(self) -> bool:
+        return self._policy != "off"
+
+    def set_policy(self, policy: str) -> None:
+        if policy not in POLICIES:
+            raise ValueError(f"health policy must be one of {POLICIES}, got {policy!r}")
+        self._policy = policy
+
+    # -- recording ----------------------------------------------------------
+
+    def note(
+        self,
+        key: str,
+        flagged: Dict[str, List[str]],
+        *,
+        source: str,
+        escalate: bool = False,
+        force: bool = False,
+    ) -> bool:
+        """Record one health check of metric ``key``. ``flagged`` maps each
+        flag kind to the state names that tripped it (all empty = healthy).
+        ``escalate`` marks a caller that will raise on unhealthy (suppresses
+        the warn here so the exception isn't doubled by a warning);
+        ``force`` records even under policy ``"off"`` (explicit
+        ``check_health()`` calls). Returns whether the check was unhealthy;
+        never raises."""
+        if not (self.enabled or force):
+            return False
+        unhealthy = any(flagged.get(kind) for kind in _FLAG_KINDS)
+        warn_msg = None
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is None:
+                rec = self._records[key] = {
+                    "checks": 0, "unhealthy": 0, "nan": 0, "inf": 0, "zero_weight": 0
+                }
+            rec["checks"] += 1
+            if unhealthy:
+                rec["unhealthy"] += 1
+                for kind in _FLAG_KINDS:
+                    if flagged.get(kind):
+                        rec[kind] += 1
+                if self._policy in ("warn", "raise") and not escalate and key not in self._warned:
+                    self._warned.add(key)
+                    warn_msg = (
+                        f"Metric {key} is numerically unhealthy: "
+                        + _describe(flagged)
+                        + ". The corrupted state will poison every compute() until reset()."
+                        " First detection only; the full ledger is in"
+                        " observability.snapshot()['health']."
+                    )
+        if unhealthy:
+            TELEMETRY.inc(key, "health_events")
+            EVENTS.record(
+                "health",
+                key,
+                source=source,
+                **{kind: list(flagged.get(kind, ())) for kind in _FLAG_KINDS},
+            )
+        if warn_msg is not None:
+            rank_zero_warn(warn_msg, UserWarning)
+        return unhealthy
+
+    # -- reading ------------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        """JSON view for ``snapshot()`` / bench records: the policy plus the
+        per-metric check/unhealthy ledger."""
+        with self._lock:
+            return {
+                "policy": self._policy,
+                "unhealthy_total": sum(r["unhealthy"] for r in self._records.values()),
+                "metrics": {k: dict(r) for k, r in self._records.items()},
+            }
+
+    def reset(self) -> None:
+        """Clear the ledger and the warn-once memory (the policy survives)."""
+        with self._lock:
+            self._records.clear()
+            self._warned.clear()
+
+
+#: the process-global health monitor every guard records into
+HEALTH = HealthMonitor()
+
+
+def set_health_policy(policy: str) -> None:
+    """Set the process-wide health policy: ``"off"`` (default), ``"record"``,
+    ``"warn"``, or ``"raise"`` (see the module docstring's policy table)."""
+    HEALTH.set_policy(policy)
+
+
+def get_health_policy() -> str:
+    return HEALTH.policy
+
+
+def _describe(flagged: Dict[str, List[str]]) -> str:
+    parts = []
+    for kind in _FLAG_KINDS:
+        names = flagged.get(kind)
+        if names:
+            parts.append(f"{kind} in state(s) {sorted(names)}")
+    return "; ".join(parts) or "healthy"
+
+
+def _denominator_states(metric: Any) -> Tuple[str, ...]:
+    """Mean-style denominators: scalar ``"sum"``-reduced states named
+    ``total``/``weight`` — zero after an update means a division by zero is
+    waiting at ``compute()``.
+
+    The flag itself only fires when the *whole* state pytree is still zero
+    (see the guard): metrics with mode-dependent state usage (``Accuracy``
+    accumulates tp/fp/tn/fn in probs mode and leaves ``total`` untouched)
+    legitimately keep a zero denominator while other states carry the
+    evidence; zero-everything after an update is the genuinely unhealthy
+    "this step contributed no weight" signal."""
+    names = []
+    for name, fx in getattr(metric, "_reductions", {}).items():
+        if fx != "sum" or name not in ("total", "weight"):
+            continue
+        default = metric._defaults.get(name)
+        if getattr(default, "ndim", None) == 0:
+            names.append(name)
+    return tuple(names)
+
+
+def _iter_array_states(state: Dict[str, Any]) -> Iterator[Tuple[str, str, Any]]:
+    """Yield ``(label, base_name, array)`` per array leaf; list accumulators
+    contribute one labeled entry per element."""
+    for name, value in state.items():
+        if isinstance(value, (list, tuple)):
+            for i, item in enumerate(value):
+                if hasattr(item, "dtype"):
+                    yield f"{name}[{i}]", name, item
+        elif hasattr(value, "dtype"):
+            yield name, name, value
+
+
+def _flag_exprs(metric: Any, state: Dict[str, Any]) -> Tuple[List[str], Optional[Any]]:
+    """Per-leaf ``(nan, inf, zero_weight)`` boolean reductions, packed into
+    one tiny ``(n_leaves, 3)`` array — the only data that ever leaves the
+    device, whether eagerly or through the debug callback."""
+    import jax.numpy as jnp
+
+    denoms = _denominator_states(metric)
+    names: List[str] = []
+    rows = []
+    false = jnp.asarray(False)
+    # zero total-weight is a whole-pytree condition: denominator(s) at zero
+    # with every other state also still zero (updates ran, nothing
+    # accumulated) — see _denominator_states
+    all_zero = jnp.asarray(True) if denoms else false
+    leaves = list(_iter_array_states(state))
+    if denoms:
+        for _, _, value in leaves:
+            all_zero = all_zero & jnp.all(value == 0)
+    for label, base, value in leaves:
+        inexact = jnp.issubdtype(value.dtype, jnp.inexact)
+        nan = jnp.isnan(value).any() if inexact else false
+        inf = jnp.isinf(value).any() if inexact else false
+        zero = all_zero if base in denoms else false
+        names.append(label)
+        rows.append(jnp.stack([nan, inf, zero]))
+    if not rows:
+        return names, None
+    return names, jnp.stack(rows)
+
+
+def _flags_to_dict(names: Sequence[str], flags: Any) -> Dict[str, List[str]]:
+    flags = np.asarray(flags)
+    return {
+        kind: [name for name, row in zip(names, flags) if bool(row[col])]
+        for col, kind in enumerate(_FLAG_KINDS)
+    }
+
+
+#: backends whose runtime cannot execute ``jax.debug.callback`` (host
+#: send/recv UNIMPLEMENTED — e.g. the axon TPU tunnel); the traced guard
+#: degrades to a warned no-op there instead of crashing every compiled step.
+#: Override the set via the env var (comma-separated platform names).
+_NO_CALLBACK_PLATFORMS = frozenset(
+    p for p in os.environ.get("METRICS_TPU_HEALTH_NO_CALLBACK_PLATFORMS", "axon").split(",") if p
+)
+
+_warned_no_callback = False
+
+
+def _callbacks_supported() -> bool:
+    """Whether the active backend can run debug callbacks (the compiled-path
+    guard's transport). Warns once per process when it cannot."""
+    import jax
+
+    global _warned_no_callback
+    if jax.default_backend() not in _NO_CALLBACK_PLATFORMS:
+        return True
+    if not _warned_no_callback:
+        _warned_no_callback = True
+        rank_zero_warn(
+            f"health policy {HEALTH.policy!r} is armed but backend"
+            f" {jax.default_backend()!r} does not support jax.debug.callback"
+            " (host send/recv unimplemented): compiled-path health detection is"
+            " disabled on this backend; eager paths still check.",
+            UserWarning,
+        )
+    return False
+
+
+def _on_device_flags(key: str, names: Tuple[str, ...], source: str, flags: Any) -> None:
+    """Host side of the compiled-path guard (runs inside ``jax.debug.callback``,
+    possibly long after dispatch). Must never raise — an exception here would
+    surface asynchronously in an unrelated stack."""
+    try:
+        HEALTH.note(key, _flags_to_dict(names, flags), source=source)
+    except Exception:  # pragma: no cover - callback must never kill the program
+        pass
+
+
+def guard_state(metric: Any, state: Dict[str, Any], source: str = "update") -> None:
+    """The per-update guard: scan ``state``'s leaves and apply the policy.
+
+    Call sites gate on ``HEALTH.enabled`` so policy ``"off"`` costs one
+    attribute read and inserts **zero traced ops**. With a policy set, the
+    scan lowers to a handful of fused reductions; under tracing the packed
+    flags exit through an async ``jax.debug.callback`` (no host sync), on
+    eager paths they are read directly and ``"raise"`` raises
+    :class:`MetricHealthError` from the offending call."""
+    if not HEALTH.enabled:
+        return
+    import jax
+
+    from metrics_tpu.observability.retrace import is_tracing
+
+    key = metric.telemetry_key
+    names, flags = _flag_exprs(metric, state)
+    if flags is None:
+        HEALTH.note(key, {}, source=source)
+        return
+    if is_tracing(flags):
+        if _callbacks_supported():
+            jax.debug.callback(
+                functools.partial(_on_device_flags, key, tuple(names), source), flags
+            )
+        return
+    escalate = HEALTH.policy == "raise"
+    flagged = _flags_to_dict(names, flags)
+    unhealthy = HEALTH.note(key, flagged, source=source, escalate=escalate)
+    if unhealthy and escalate:
+        raise MetricHealthError(f"Metric {key}: {_describe(flagged)} (after {source})")
+
+
+def check_state(metric: Any, state: Dict[str, Any]) -> Dict[str, Any]:
+    """Eager health report of ``state`` (the engine of
+    :meth:`Metric.check_health`): per-state NaN/Inf element counts and the
+    zero total-weight flag. Works at any policy (including ``"off"``);
+    records a ``health`` event + counter when something is wrong, never
+    raises or warns. Requires concrete (non-tracer) state values."""
+    import jax.numpy as jnp
+
+    key = metric.telemetry_key
+    denoms = _denominator_states(metric)
+    updated = bool(getattr(metric, "_update_called", True))
+    leaves = list(_iter_array_states(state))
+    # a fresh (never-updated) metric legitimately holds total==0; only an
+    # updated one whose WHOLE state is still zero accumulated no weight
+    all_zero = bool(denoms) and updated and all(
+        bool(jnp.all(value == 0)) for _, _, value in leaves
+    )
+    states: Dict[str, Any] = {}
+    flagged: Dict[str, List[str]] = {kind: [] for kind in _FLAG_KINDS}
+    for label, base, value in leaves:
+        inexact = jnp.issubdtype(value.dtype, jnp.inexact)
+        entry = {
+            "nan": int(jnp.isnan(value).sum()) if inexact else 0,
+            "inf": int(jnp.isinf(value).sum()) if inexact else 0,
+        }
+        if base in denoms:
+            entry["zero_weight"] = all_zero
+        for kind in _FLAG_KINDS:
+            if entry.get(kind):
+                flagged[kind].append(label)
+        states[label] = entry
+    healthy = not any(flagged.values())
+    if not healthy:
+        HEALTH.note(key, flagged, source="check_health", escalate=True, force=True)
+    return {
+        "metric": key,
+        "healthy": healthy,
+        "policy": HEALTH.policy,
+        "states": states,
+    }

@@ -200,14 +200,29 @@ class TelemetryRegistry:
 
     def snapshot(self, include_timers: bool = True) -> Dict[str, Any]:
         """JSON-serializable view: per-metric counters (+timers, +live state
-        memory) and the global sync stats."""
+        memory) and the global sync stats.
+
+        Entries whose metric instance has been garbage-collected appear in
+        THIS snapshot one final time marked ``"dead": true``, then are
+        evicted from the registry — long-running sessions that churn through
+        metric instances stay bounded instead of accumulating counters for
+        objects that no longer exist. (Entries recorded directly by key,
+        with no registered instance, are never evicted: the registry cannot
+        know they are gone.)
+        """
         with self._lock:
+            dead = {key for key, ref in self._instances.items() if ref() is None}
             metrics: Dict[str, Any] = {}
             for key, entry in self._metrics.items():
                 out: Dict[str, Any] = {"counters": dict(entry["counters"])}
                 if include_timers and entry["timers"]:
                     out["timers"] = {phase: h.to_dict() for phase, h in entry["timers"].items()}
+                if key in dead:
+                    out["dead"] = True
                 metrics[key] = out
+            for key in dead:
+                del self._instances[key]
+                self._metrics.pop(key, None)
             sync = {
                 k: (dict(v) if isinstance(v, dict) and k != "in_graph" else v)
                 for k, v in self._sync.items()

@@ -23,6 +23,7 @@ import threading
 from collections import deque
 from typing import Any, Dict, Optional
 
+from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 #: default compile budget per metric before the churn warning fires; override
@@ -112,6 +113,8 @@ class RetraceMonitor:
                     " threshold with metrics_tpu.observability.set_retrace_threshold(n) if"
                     " this churn is intended."
                 )
+        if EVENTS.enabled:
+            EVENTS.record("retrace", key, source="jit_forward", count=count, signature=signature)
         if warn_msg is not None:
             rank_zero_warn(warn_msg, UserWarning)
 
@@ -124,6 +127,8 @@ class RetraceMonitor:
         with self._lock:
             rec = self._record(key)
             rec["traces"] += 1
+        if EVENTS.enabled:
+            EVENTS.record("retrace", key, source="trace", signature=signature)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

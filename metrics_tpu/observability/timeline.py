@@ -1,0 +1,141 @@
+"""Chrome-trace/Perfetto JSON export of the structured event log.
+
+Renders :mod:`~metrics_tpu.observability.events` as per-metric tracks in the
+`Trace Event Format`_ — the JSON that ``chrome://tracing``, Perfetto, and
+``jax.profiler``'s own dumps all speak — so a whole run's metric activity
+(updates, forwards, computes, gather rounds, retraces, health flags) is
+inspectable on one timeline next to an XLA device trace::
+
+    from metrics_tpu.observability import timeline
+    timeline.export("/tmp/metrics-timeline.json")   # load in ui.perfetto.dev
+
+Mapping: each distinct metric key becomes one named thread-track (global
+events such as gather transports ride the ``<global>`` track); interval
+events (``dur_s > 0``) render as complete ``"X"`` slices, instantaneous ones
+(retrace, trace-time sync, health) as thread-scoped ``"i"`` instants; the
+user's step counter additionally renders as a ``"C"`` counter track so slices
+line up against step boundaries. Timestamps are microseconds on the event
+log's shared monotonic clock.
+
+.. _Trace Event Format:
+   https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU
+"""
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from metrics_tpu.observability.events import EVENTS, Event, EventLog
+
+#: track name for events not owned by a single metric (gather transports)
+GLOBAL_TRACK = "<global>"
+
+
+def _json_safe(value: Any) -> Any:
+    """Best-effort coercion of payload values the recorders hand us (tuples,
+    numpy scalars) into plain JSON types; unknown objects degrade to repr."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, dict):
+        return {str(k): _json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    item = getattr(value, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:  # pragma: no cover - exotic array-likes
+            pass
+    return repr(value)
+
+
+def to_chrome_trace(
+    events: Optional[Sequence[Event]] = None, log: Optional[EventLog] = None
+) -> Dict[str, Any]:
+    """Build the Chrome-trace dict (``{"traceEvents": [...], ...}``) from
+    ``events`` (default: the global log's retained events)."""
+    log = EVENTS if log is None else log
+    if events is None:
+        events = log.events()
+    pid = os.getpid()
+
+    trace: List[Dict[str, Any]] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": "metrics_tpu"},
+        }
+    ]
+    tids: Dict[str, int] = {}
+
+    def tid_for(metric: Optional[str]) -> int:
+        track = metric if metric is not None else GLOBAL_TRACK
+        tid = tids.get(track)
+        if tid is None:
+            tid = tids[track] = len(tids) + 1
+            trace.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": track},
+                }
+            )
+        return tid
+
+    last_step: Optional[int] = None
+    for ev in sorted(events, key=lambda e: (e.ts_s, e.seq)):
+        tid = tid_for(ev.metric)
+        if ev.step is not None and ev.step != last_step:
+            last_step = ev.step
+            trace.append(
+                {
+                    "ph": "C",
+                    "name": "step",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": round(ev.ts_s * 1e6, 3),
+                    "args": {"step": ev.step},
+                }
+            )
+        args = {str(k): _json_safe(v) for k, v in ev.payload.items()}
+        if ev.step is not None:
+            args["step"] = ev.step
+        record: Dict[str, Any] = {
+            "name": f"{ev.metric}.{ev.kind}" if ev.metric else ev.kind,
+            "cat": ev.kind,
+            "pid": pid,
+            "tid": tid,
+            "ts": round(ev.ts_s * 1e6, 3),
+            "args": args,
+        }
+        if ev.dur_s > 0:
+            record["ph"] = "X"
+            record["dur"] = round(ev.dur_s * 1e6, 3)
+        else:
+            record["ph"] = "i"
+            record["s"] = "t"
+        trace.append(record)
+
+    return {
+        "traceEvents": trace,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "metrics_tpu.observability.timeline",
+            "epoch_unix_s": log.epoch_unix,
+            "events_summary": log.summary(),
+        },
+    }
+
+
+def export(
+    path: str, events: Optional[Sequence[Event]] = None, log: Optional[EventLog] = None
+) -> str:
+    """Write the Chrome-trace JSON to ``path`` and return ``path``. The file
+    loads directly in ``chrome://tracing`` and https://ui.perfetto.dev."""
+    trace = to_chrome_trace(events, log=log)
+    with open(path, "w") as fh:
+        json.dump(trace, fh)
+    return path

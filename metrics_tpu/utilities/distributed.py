@@ -18,6 +18,7 @@ re-designed TPU-first with two complementary sync paths:
   ``jax.experimental.multihost_utils`` since XLA collectives need static,
   equal shapes across participants.
 """
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
@@ -192,6 +193,7 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
     if not distributed_available():
         return [result]
 
+    transport_start = time.perf_counter()
     nprocs = world_size()
     # A bad group ARGUMENT must not desync the transport: peers with valid
     # groups are already committed to the global descriptor/payload
@@ -287,6 +289,8 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
         desc_bytes=int(desc.nbytes),
         max_bytes=max_bytes,
         error=arg_error is not None or group_error is not None,
+        dur_s=time.perf_counter() - transport_start,
+        t_start=transport_start,
     )
 
     if arg_error is not None:
@@ -315,25 +319,48 @@ def _record_gather_telemetry(
     desc_bytes: int,
     max_bytes: int,
     error: bool,
+    dur_s: float = 0.0,
+    t_start: Optional[float] = None,
 ) -> None:
-    """Record one gather transport into the telemetry registry (host-side;
-    the gather itself is already complete). Never raises."""
+    """Record one gather transport into the telemetry registry and the event
+    timeline (host-side; the gather itself is already complete). Never
+    raises."""
     try:
+        from metrics_tpu.observability.events import EVENTS
         from metrics_tpu.observability.registry import TELEMETRY
 
-        if not TELEMETRY.enabled:
-            return
         payload_rounds = 1 if max_bytes else 0
-        TELEMETRY.record_gather(
-            bytes_out=int(result.nbytes),
-            bytes_in=int(sum(int(counts[i]) * int(itemsizes[i]) for i in members)),
-            transport_bytes=nprocs * desc_bytes + payload_rounds * nprocs * max_bytes,
-            descriptor_rounds=1,
-            payload_rounds=payload_rounds,
-            world=nprocs,
-            members=members,
-            error=error,
-        )
+        bytes_in = int(sum(int(counts[i]) * int(itemsizes[i]) for i in members))
+        transport_bytes = nprocs * desc_bytes + payload_rounds * nprocs * max_bytes
+        if TELEMETRY.enabled:
+            TELEMETRY.record_gather(
+                bytes_out=int(result.nbytes),
+                bytes_in=bytes_in,
+                transport_bytes=transport_bytes,
+                descriptor_rounds=1,
+                payload_rounds=payload_rounds,
+                world=nprocs,
+                members=members,
+                error=error,
+            )
+        if EVENTS.enabled:
+            # the gather rounds on the global timeline: one interval per
+            # transport, with the descriptor/payload round composition
+            EVENTS.record(
+                "sync",
+                None,
+                dur_s=dur_s,
+                t_start=t_start,
+                transport="gather",
+                bytes_out=int(result.nbytes),
+                bytes_in=bytes_in,
+                transport_bytes=transport_bytes,
+                descriptor_rounds=1,
+                payload_rounds=payload_rounds,
+                world=nprocs,
+                members=[int(m) for m in members],
+                error=bool(error),
+            )
     except Exception:  # pragma: no cover - telemetry must never break a sync
         pass
 
@@ -417,9 +444,21 @@ def sync_in_graph(
             bytes_traced += int(size) * int(itemsize)
     if kinds:
         try:
+            from metrics_tpu.observability.events import EVENTS
             from metrics_tpu.observability.registry import TELEMETRY
 
             TELEMETRY.record_in_graph_sync(axis_name, kinds, bytes_traced)
+            if EVENTS.enabled:
+                # instant event at TRACE time (once per compile, never per
+                # step): which collectives this state bundle lowers to
+                EVENTS.record(
+                    "sync",
+                    None,
+                    in_graph=True,
+                    axis=repr(axis_name),
+                    collectives=dict(kinds),
+                    bytes_traced=int(bytes_traced),
+                )
         except Exception:  # pragma: no cover - telemetry must never break a sync
             pass
     return synced

@@ -765,10 +765,16 @@ def run_config(cfg, probe: bool = True, _repinned: bool = False) -> dict:
     # measured program compiled exactly as many times as the harness intends
     # (2 lengths), and a snapshot full of unexpected retraces explains a slow
     # line without a re-run. Timers are dropped to keep the line compact.
+    # The health summary and event-log high-water mark ride as top-level keys
+    # so a corrupted-state or event-pressure signal is greppable without
+    # digging into the nested snapshot.
     try:
         from metrics_tpu import observability
 
-        line["telemetry"] = observability.snapshot(include_timers=False)
+        snap = observability.snapshot(include_timers=False)
+        line["telemetry"] = snap
+        line["health"] = snap.get("health")
+        line["events_high_water"] = snap.get("events", {}).get("high_water")
     except Exception as err:  # pragma: no cover - telemetry must not kill a bench
         print(f"# telemetry snapshot unavailable: {err!r}", file=sys.stderr)
     if probe:

@@ -1,0 +1,208 @@
+"""Zero-overhead gate: observability must not touch the compiled hot paths.
+
+The library's observability stack (telemetry counters, the event timeline,
+retrace detection, the health guard at its default ``"off"`` policy) promises
+**zero traced ops** on the compiled hot paths. This gate makes that promise
+un-regressable: it traces the canonical hot programs — ``apply_update`` and
+the ``jit_forward()`` program, for a single metric and a collection — and
+
+1. asserts the jaxprs are **byte-identical** with observability fully
+   enabled, fully disabled, and with the health policy off (the states a
+   production loop actually runs in), and that arming the health guard
+   *does* change the update program (so the gate cannot pass vacuously);
+2. compares each jaxpr's SHA-256 against the checked-in baseline
+   (``scripts/zero_overhead_baseline.json``, captured from the
+   pre-instrumentation seed programs), so future instrumentation cannot
+   silently add traced ops — a mismatch means the hot path changed and the
+   baseline must be *consciously* regenerated with ``--update``.
+
+Runnable standalone (``python scripts/check_zero_overhead.py``; exit 1 on
+violation) and as a test (``tests/observability/test_zero_overhead.py``).
+The digest comparison is keyed to the jax version that produced the
+baseline — jaxpr text is not stable across jax releases — and reports
+``skipped_digests`` instead of failing on a version mismatch; the identity
+checks run (and gate) everywhere.
+"""
+import argparse
+import hashlib
+import json
+import os
+import sys
+from typing import Callable, Dict
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "zero_overhead_baseline.json")
+
+
+def _programs() -> Dict[str, Callable[[], str]]:
+    """The pinned hot programs, name -> thunk returning the jaxpr text.
+
+    Fixed shapes/dtypes (and x64 enabled, matching the test suite) so the
+    text is deterministic within one jax version.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MetricCollection, Precision
+
+    jax.config.update("jax_enable_x64", True)
+    preds = jnp.zeros((8, 3), jnp.float32)
+    target = jnp.zeros((8,), jnp.int32)
+
+    def metric_update() -> str:
+        m = Accuracy()
+        return str(jax.make_jaxpr(m.apply_update)(m.init_state(), preds, target))
+
+    def metric_jit_forward() -> str:
+        m = Accuracy()
+        fn = functools.partial(m.apply_forward, axis_name=None)
+        return str(jax.make_jaxpr(fn)(m.init_state(), preds, target))
+
+    def collection_update() -> str:
+        coll = MetricCollection([Accuracy(), Precision(average="macro", num_classes=3)])
+        return str(jax.make_jaxpr(coll.apply_update)(coll.init_state(), preds, target))
+
+    def collection_jit_forward() -> str:
+        coll = MetricCollection([Accuracy(), Precision(average="macro", num_classes=3)])
+        fn = functools.partial(coll.apply_forward, axis_name=None)
+        return str(jax.make_jaxpr(fn)(coll.init_state(), preds, target))
+
+    return {
+        "metric_update": metric_update,
+        "metric_jit_forward": metric_jit_forward,
+        "collection_update": collection_update,
+        "collection_jit_forward": collection_jit_forward,
+    }
+
+
+def _sha256(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def current_jaxprs() -> Dict[str, str]:
+    """Jaxpr text per pinned program in the disabled-observability state
+    (which the identity check proves equals the enabled state)."""
+    return {name: thunk() for name, thunk in _programs().items()}
+
+
+def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
+    """Run the gate; returns ``{"violations": [...], "skipped_digests": [...]}``.
+
+    An empty ``violations`` list is a pass.
+    """
+    import jax
+
+    from metrics_tpu import observability
+
+    violations, skipped = [], []
+    programs = _programs()
+
+    prev_enabled = observability.TELEMETRY.enabled
+    prev_policy = observability.get_health_policy()
+    texts: Dict[str, str] = {}
+    try:
+        for name, thunk in programs.items():
+            observability.set_health_policy("off")
+            observability.enable()
+            enabled_text = thunk()
+            observability.disable()
+            disabled_text = thunk()
+            if enabled_text != disabled_text:
+                violations.append(
+                    f"{name}: jaxpr differs between observability enabled and disabled —"
+                    " an instrumented call site leaked traced ops into the hot path"
+                )
+            texts[name] = disabled_text
+        # the gate must not pass vacuously: arming the guard has to change
+        # the update program (if it doesn't, the guard is silently dead and
+        # the identity checks above prove nothing about it)
+        observability.enable()
+        observability.set_health_policy("record")
+        armed = programs["metric_update"]()
+        if armed == texts["metric_update"]:
+            violations.append(
+                "metric_update: health policy 'record' left the jaxpr unchanged —"
+                " the per-update guard is not arming"
+            )
+    finally:
+        observability.set_health_policy(prev_policy)
+        observability.TELEMETRY.enable(prev_enabled)
+        observability.EVENTS.enable(prev_enabled)
+
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as fh:
+            baseline = json.load(fh)
+        if baseline.get("jax_version") != jax.__version__:
+            skipped.append(
+                f"digest comparison skipped: baseline from jax {baseline.get('jax_version')},"
+                f" running jax {jax.__version__} (jaxpr text is version-specific)"
+            )
+        else:
+            for name, text in texts.items():
+                pinned = baseline.get("programs", {}).get(name)
+                if pinned is None:
+                    violations.append(f"{name}: program missing from baseline (run --update)")
+                elif pinned["sha256"] != _sha256(text):
+                    violations.append(
+                        f"{name}: jaxpr digest drifted from the pinned baseline —"
+                        " instrumentation (or a hot-path change) altered the traced program."
+                        " If the change is intentional, regenerate with"
+                        " `python scripts/check_zero_overhead.py --update`."
+                    )
+    else:
+        skipped.append(f"no baseline at {baseline_path} (run --update to create it)")
+    return {"violations": violations, "skipped_digests": skipped}
+
+
+def update_baseline(baseline_path: str = BASELINE_PATH) -> str:
+    import jax
+
+    from metrics_tpu import observability
+
+    prev_policy = observability.get_health_policy()
+    observability.set_health_policy("off")
+    try:
+        texts = current_jaxprs()
+    finally:
+        observability.set_health_policy(prev_policy)
+    payload = {
+        "jax_version": jax.__version__,
+        "x64": True,
+        "programs": {
+            name: {"sha256": _sha256(text), "jaxpr": text} for name, text in texts.items()
+        },
+    }
+    with open(baseline_path, "w") as fh:
+        json.dump(payload, fh, indent=1)
+        fh.write("\n")
+    return baseline_path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--update", action="store_true", help="regenerate the pinned baseline digests"
+    )
+    args = parser.parse_args(argv)
+    if args.update:
+        path = update_baseline()
+        print(f"baseline written: {path}")
+        return 0
+    result = check()
+    for note in result["skipped_digests"]:
+        print(f"# {note}")
+    if result["violations"]:
+        for v in result["violations"]:
+            print(f"VIOLATION: {v}")
+        return 1
+    print("zero-overhead gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,241 @@
+"""Structured event log: record/bound/evict semantics, step correlation,
+enable gating, thread safety, and the instrumentation feeds from the real
+metric lifecycle (update/forward/compute/sync/retrace)."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, MetricCollection, Precision, observability
+from metrics_tpu.observability.events import EventLog
+
+NB, B, NC = 3, 16, 3
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    observability.set_step(None)
+    yield
+    observability.reset()
+    observability.enable()
+    observability.set_step(None)
+
+
+@pytest.fixture()
+def stream():
+    rng = np.random.RandomState(0)
+    probs = rng.rand(NB, B, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    return probs, rng.randint(0, NC, (NB, B))
+
+
+def _kinds(log=None):
+    log = log or observability.EVENTS
+    return [e.kind for e in log.events()]
+
+
+# ---------------------------------------------------------------------------
+# EventLog unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_record_and_read_back():
+    log = EventLog(capacity=16)
+    log.record("update", "Accuracy#0", dur_s=0.5, foo=1)
+    (ev,) = log.events()
+    assert ev.kind == "update" and ev.metric == "Accuracy#0"
+    assert ev.dur_s == 0.5 and ev.payload == {"foo": 1}
+    assert ev.step is None and ev.seq == 0
+    # without an explicit start, the interval is anchored dur_s before "now"
+    assert ev.ts_s < 1.0
+
+
+def test_bounded_eviction_and_high_water():
+    log = EventLog(capacity=4)
+    for i in range(10):
+        log.record("update", payload_i=i)
+    events = log.events()
+    assert len(events) == 4
+    assert [e.payload["payload_i"] for e in events] == [6, 7, 8, 9]  # newest kept
+    summary = log.summary()
+    assert summary["recorded_total"] == 10
+    assert summary["dropped"] == 6
+    assert summary["high_water"] == 4
+    assert summary["by_kind"] == {"update": 10}
+
+
+def test_set_capacity_rebounds_keeping_newest():
+    log = EventLog(capacity=8)
+    for i in range(8):
+        log.record("update", i=i)
+    log.set_capacity(3)
+    assert [e.payload["i"] for e in log.events()] == [5, 6, 7]
+    assert log.summary()["dropped"] == 5
+    with pytest.raises(ValueError):
+        log.set_capacity(0)
+
+
+def test_step_tagging_and_context_nesting():
+    log = EventLog()
+    log.record("update")
+    log.set_step(7)
+    log.record("update")
+    with log.step_context() as s:  # auto-increment from the current tag
+        assert s == 8
+        log.record("forward")
+        with log.step_context(100) as inner:
+            assert inner == 100
+            log.record("compute")
+    log.record("update")  # restored to 7 after the contexts unwind
+    steps = [e.step for e in log.events()]
+    assert steps == [None, 7, 8, 100, 7]
+
+
+def test_module_level_step_helpers():
+    with observability.step_context(3):
+        assert observability.get_step() == 3
+    assert observability.get_step() is None
+    observability.set_step(9)
+    assert observability.get_step() == 9
+
+
+def test_disable_stops_recording_and_costs_nothing():
+    log = EventLog()
+    log.disable()
+    log.record("update", x=1)
+    assert log.events() == [] and log.summary()["recorded_total"] == 0
+    log.enable()
+    log.record("update", x=1)
+    assert len(log.events()) == 1
+
+
+def test_clear_keeps_step_and_capacity():
+    log = EventLog(capacity=5)
+    log.set_step(4)
+    for _ in range(9):
+        log.record("update")
+    log.clear()
+    summary = log.summary()
+    assert summary["size"] == summary["recorded_total"] == summary["dropped"] == 0
+    assert summary["high_water"] == 0
+    assert summary["step"] == 4 and summary["capacity"] == 5
+
+
+def test_summary_json_serializable():
+    log = EventLog()
+    log.record("health", "M#0", nan=["value"], inf=[])
+    assert json.loads(json.dumps(log.summary())) == log.summary()
+
+
+def test_thread_safety_under_concurrent_recording():
+    log = EventLog(capacity=64)
+    n_threads, n_records = 8, 300
+
+    def work():
+        for i in range(n_records):
+            log.record("update", i=i)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    summary = log.summary()
+    assert summary["recorded_total"] == n_threads * n_records
+    assert summary["size"] == 64
+    assert summary["dropped"] == n_threads * n_records - 64
+    seqs = [e.seq for e in log.events()]
+    assert seqs == sorted(seqs)  # append order preserved under the lock
+
+
+# ---------------------------------------------------------------------------
+# instrumentation feeds (the real metric lifecycle)
+# ---------------------------------------------------------------------------
+
+
+def test_eager_lifecycle_feeds_events(stream):
+    probs, target = stream
+    m = Accuracy()
+    key = m.telemetry_key
+    for i in range(NB):
+        with observability.step_context(i):
+            m(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    m.compute()
+
+    events = observability.EVENTS.events()
+    kinds = [e.kind for e in events]
+    assert kinds.count("forward") == NB
+    assert "compute" in kinds
+    forwards = [e for e in events if e.kind == "forward"]
+    assert [e.step for e in forwards] == list(range(NB))
+    assert all(e.metric == key and e.dur_s > 0 for e in forwards)
+
+
+def test_update_events_carry_duration(stream):
+    probs, target = stream
+    m = Accuracy()
+    m.update(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    (ev,) = [e for e in observability.EVENTS.events() if e.kind == "update"]
+    assert ev.metric == m.telemetry_key and ev.dur_s > 0
+
+
+def test_jit_forward_feeds_forward_and_retrace_events(stream):
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    key = m.telemetry_key
+    for i in range(NB):
+        m(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    events = observability.EVENTS.events()
+    compiled = [e for e in events if e.kind == "forward" and e.payload.get("path") == "compiled"]
+    assert len(compiled) == NB and all(e.metric == key for e in compiled)
+    retraces = [e for e in events if e.kind == "retrace"]
+    # one compile (cache-delta source) + one pure-API trace-entry record
+    assert {e.payload["source"] for e in retraces} == {"jit_forward", "trace"}
+
+
+def test_eager_sync_feeds_sync_event(stream):
+    probs, target = stream
+    m = Accuracy(dist_sync_fn=lambda x, group=None: [x, x])
+    m.update(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    m.compute()
+    (ev,) = [e for e in observability.EVENTS.events() if e.kind == "sync"]
+    assert ev.metric == m.telemetry_key
+    assert ev.payload["payload_bytes"] > 0 and ev.dur_s > 0
+
+
+def test_collection_compiled_forward_records_collection_event(stream):
+    probs, target = stream
+    coll = MetricCollection([Accuracy(), Precision(average="macro", num_classes=NC)])
+    coll.jit_forward()
+    coll(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    compiled = [
+        e
+        for e in observability.EVENTS.events()
+        if e.kind == "forward" and e.payload.get("path") == "compiled"
+    ]
+    assert any(e.metric == coll.telemetry_key for e in compiled)
+
+
+def test_snapshot_carries_events_summary(stream):
+    probs, target = stream
+    m = Accuracy()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    snap = json.loads(json.dumps(observability.snapshot()))
+    assert snap["events"]["recorded_total"] >= 1
+    assert snap["events"]["by_kind"]["forward"] >= 1
+    assert snap["events"]["capacity"] >= snap["events"]["high_water"]
+
+
+def test_prometheus_renders_event_series(stream):
+    probs, target = stream
+    m = Accuracy()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    text = observability.render_prometheus()
+    assert "# TYPE metrics_tpu_events_recorded_total counter" in text
+    assert 'metrics_tpu_events_by_kind_total{kind="forward"}' in text
+    assert "metrics_tpu_events_high_water" in text

@@ -1,0 +1,296 @@
+"""Numerical health monitoring: check_health reports, the per-update guard
+under every policy on the eager and compiled paths, the zero-traced-ops
+guarantee with the policy off, and the acceptance scenario (NaN under
+jit_forward -> health event; eager -> MetricHealthError)."""
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, AverageMeter, MetricCollection, Precision, observability
+from metrics_tpu.observability import MetricHealthError, set_health_policy
+from metrics_tpu.observability.health import HEALTH, HealthMonitor
+
+NC = 3
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    set_health_policy("off")
+    yield
+    observability.reset()
+    observability.enable()
+    set_health_policy("off")
+
+
+@pytest.fixture()
+def batch():
+    rng = np.random.RandomState(0)
+    probs = rng.rand(8, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(rng.randint(0, NC, (8,)))
+
+
+def _health_events():
+    return [e for e in observability.EVENTS.events() if e.kind == "health"]
+
+
+# ---------------------------------------------------------------------------
+# check_health (explicit, policy-independent)
+# ---------------------------------------------------------------------------
+
+
+def test_check_health_healthy_metric(batch):
+    m = Accuracy()
+    m(*batch)
+    report = m.check_health()
+    assert report["healthy"] is True
+    assert report["metric"] == m.telemetry_key
+    assert set(report["states"]) == set(m._defaults)
+    assert _health_events() == []
+
+
+def test_check_health_counts_nan_and_inf():
+    avg = AverageMeter()
+    avg.update(jnp.asarray([1.0, 2.0]))
+    avg.value = jnp.asarray([jnp.nan, jnp.inf, 1.0, jnp.nan])
+    report = avg.check_health()
+    assert report["healthy"] is False
+    assert report["states"]["value"] == {"nan": 2, "inf": 1}
+    # an unhealthy explicit check records the event + counter even at "off"
+    assert len(_health_events()) == 1
+    snap = observability.snapshot()
+    assert snap["metrics"][avg.telemetry_key]["counters"]["health_events"] == 1
+    assert snap["health"]["metrics"][avg.telemetry_key]["nan"] == 1
+
+
+def test_check_health_zero_weight_only_after_update():
+    avg = AverageMeter()
+    assert avg.check_health()["healthy"] is True  # fresh total==0 is legitimate
+    avg.update(jnp.asarray([1.0, 2.0]), jnp.asarray([0.0, 0.0]))
+    report = avg.check_health()
+    assert report["healthy"] is False
+    assert report["states"]["weight"]["zero_weight"] is True
+
+
+def test_check_health_mode_dependent_zero_denominator_is_healthy(batch):
+    # Accuracy in probs mode accumulates tp/fp/tn/fn and leaves `total` at
+    # zero — a zero denominator with nonzero evidence elsewhere is healthy
+    m = Accuracy()
+    m(*batch)
+    assert m.check_health()["healthy"] is True
+
+
+def test_check_health_accepts_explicit_state(batch):
+    m = Accuracy()
+    state = m.apply_update(m.init_state(), *batch)
+    assert m.check_health(state)["healthy"] is True
+
+
+def test_check_health_list_states_and_collection(batch):
+    coll = MetricCollection([Accuracy(), Precision(average="macro", num_classes=NC)])
+    coll(*batch)
+    report = coll.check_health()
+    assert report["healthy"] is True
+    assert set(report["members"]) == {"Accuracy", "Precision"}
+    assert json.loads(json.dumps(report)) == report
+
+
+# ---------------------------------------------------------------------------
+# the per-update guard: eager paths
+# ---------------------------------------------------------------------------
+
+
+def test_policy_raise_on_eager_update():
+    set_health_policy("raise")
+    avg = AverageMeter()
+    with pytest.raises(MetricHealthError, match="nan in state"):
+        avg.update(jnp.asarray([jnp.nan]))
+
+
+def test_policy_raise_on_eager_forward():
+    set_health_policy("raise")
+    avg = AverageMeter()
+    avg(jnp.asarray([1.0, 2.0]))  # healthy forward passes
+    with pytest.raises(MetricHealthError):
+        avg(jnp.asarray([jnp.nan, 1.0]))
+
+
+def test_policy_warn_warns_once_per_metric():
+    set_health_policy("warn")
+    avg = AverageMeter()
+    with pytest.warns(UserWarning, match="numerically unhealthy"):
+        avg.update(jnp.asarray([jnp.nan]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        avg.update(jnp.asarray([jnp.nan]))  # second hit: recorded, not re-warned
+    assert HEALTH.summary()["metrics"][avg.telemetry_key]["unhealthy"] == 2
+
+
+def test_policy_record_is_silent_but_recorded():
+    set_health_policy("record")
+    avg = AverageMeter()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        avg.update(jnp.asarray([jnp.inf]))
+    rec = HEALTH.summary()["metrics"][avg.telemetry_key]
+    assert rec == {"checks": 1, "unhealthy": 1, "nan": 0, "inf": 1, "zero_weight": 0}
+    (ev,) = _health_events()
+    assert ev.payload["inf"] == ["value"]
+
+
+def test_policy_off_records_nothing(batch):
+    m = Accuracy()
+    m(*batch)
+    assert HEALTH.summary() == {"policy": "off", "unhealthy_total": 0, "metrics": {}}
+
+
+def test_healthy_updates_count_checks_only(batch):
+    set_health_policy("record")
+    m = Accuracy()
+    m.update(*batch)
+    rec = HEALTH.summary()["metrics"][m.telemetry_key]
+    assert rec["checks"] == 1 and rec["unhealthy"] == 0
+    assert _health_events() == []
+
+
+def test_invalid_policy_rejected():
+    with pytest.raises(ValueError, match="health policy"):
+        set_health_policy("explode")
+
+
+# ---------------------------------------------------------------------------
+# the per-update guard: compiled paths (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+
+def test_nan_under_jit_forward_produces_health_event(batch):
+    """Acceptance: a NaN injected into a metric state under jit_forward()
+    produces a health event under policy "record"."""
+    set_health_policy("record")
+    avg = AverageMeter().jit_forward()
+    avg.value = jnp.asarray(jnp.nan)  # poison the accumulator
+    avg(jnp.asarray([1.0, 2.0]))
+    jax.effects_barrier()  # the callback is async by design
+    events = _health_events()
+    assert events, "no health event from the compiled path"
+    assert any("value" in e.payload["nan"] for e in events)
+    key = avg.telemetry_key
+    assert observability.snapshot()["metrics"][key]["counters"]["health_events"] >= 1
+
+
+def test_nan_detected_at_the_step_it_enters_in_scan():
+    """A scanned epoch flags the poisoned step, not just epoch end: the
+    callback fires per step, and only steps at/after the corruption record."""
+    set_health_policy("record")
+    m = AverageMeter()
+    values = jnp.asarray([1.0, 2.0, jnp.nan, 3.0, 4.0])
+
+    @jax.jit
+    def epoch(state, xs):
+        def body(s, x):
+            return m.apply_update(s, x), None
+
+        return jax.lax.scan(body, state, xs)[0]
+
+    epoch(m.init_state(), values)
+    jax.effects_barrier()
+    rec = HEALTH.summary()["metrics"][m.telemetry_key]
+    assert rec["checks"] == 5  # every step checked
+    assert rec["unhealthy"] == 3  # steps 2, 3, 4 (NaN sticks in the sum)
+
+
+def test_guard_degrades_gracefully_without_callback_support(monkeypatch, batch):
+    """Backends that cannot host jax.debug.callback (the axon TPU tunnel:
+    host send/recv UNIMPLEMENTED) must not crash an armed compiled step —
+    the traced guard warns once and disarms; eager paths still check."""
+    from metrics_tpu.observability import health as health_mod
+
+    monkeypatch.setattr(health_mod, "_NO_CALLBACK_PLATFORMS", frozenset({"cpu"}))
+    monkeypatch.setattr(health_mod, "_warned_no_callback", False)
+    set_health_policy("record")
+    m = AverageMeter()
+    with pytest.warns(UserWarning, match="does not support jax.debug.callback"):
+        state = jax.jit(m.apply_update)(m.init_state(), jnp.asarray([jnp.nan]))
+    jax.block_until_ready(state)  # compiled step ran, no crash
+    assert HEALTH.summary()["metrics"] == {}  # nothing recorded from jit
+    # eager path still guards on the same backend
+    m2 = AverageMeter()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m2.update(jnp.asarray([jnp.nan]))
+    assert HEALTH.summary()["metrics"][m2.telemetry_key]["nan"] == 1
+
+
+def test_policy_raise_degrades_to_warn_under_jit(batch):
+    # a compiled program cannot raise into the host; "raise" warns once
+    set_health_policy("raise")
+    avg = AverageMeter().jit_forward()
+    avg.value = jnp.asarray(jnp.nan)
+    with pytest.warns(UserWarning, match="numerically unhealthy"):
+        avg(jnp.asarray([1.0]))
+        jax.effects_barrier()
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead guarantee
+# ---------------------------------------------------------------------------
+
+
+def test_jaxpr_identical_with_health_off_and_distinct_when_armed(batch):
+    m = Accuracy()
+    state = m.init_state()
+    baseline = str(jax.make_jaxpr(m.apply_update)(state, *batch))
+
+    observability.disable()
+    disabled = str(jax.make_jaxpr(m.apply_update)(state, *batch))
+    observability.enable()
+    assert disabled == baseline
+
+    set_health_policy("record")
+    armed = str(jax.make_jaxpr(m.apply_update)(state, *batch))
+    set_health_policy("off")
+    off_again = str(jax.make_jaxpr(m.apply_update)(state, *batch))
+    assert armed != baseline  # the guard really inserts its reductions
+    assert off_again == baseline  # and vanishes without trace when disarmed
+
+
+def test_guard_result_unchanged(batch):
+    # the guard observes, never alters: same numbers with and without it
+    m = Accuracy()
+    plain = float(m.apply_compute(m.apply_update(m.init_state(), *batch), axis_name=None))
+    set_health_policy("record")
+    guarded = float(m.apply_compute(m.apply_update(m.init_state(), *batch), axis_name=None))
+    assert plain == guarded
+
+
+# ---------------------------------------------------------------------------
+# monitor plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_reset_keeps_policy():
+    mon = HealthMonitor(policy="warn")
+    with pytest.warns(UserWarning):
+        mon.note("M#0", {"nan": ["v"]}, source="update")
+    mon.reset()
+    assert mon.summary() == {"policy": "warn", "unhealthy_total": 0, "metrics": {}}
+
+
+def test_summary_joins_snapshot_and_prometheus():
+    set_health_policy("record")
+    avg = AverageMeter()
+    avg.update(jnp.asarray([jnp.nan]))
+    snap = json.loads(json.dumps(observability.snapshot()))
+    key = avg.telemetry_key
+    assert snap["health"]["policy"] == "record"
+    assert snap["health"]["metrics"][key]["nan"] == 1
+    text = observability.render_prometheus()
+    assert f'metrics_tpu_health_checks_total{{metric="{key}"}} 1' in text
+    assert f'metrics_tpu_health_nan_total{{metric="{key}"}} 1' in text

@@ -1,0 +1,148 @@
+"""Chrome-trace export: the acceptance contract (valid JSON, >= one event
+per instrumented phase), track naming, interval vs. instant rendering, and
+the step counter series."""
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, observability
+from metrics_tpu.observability import timeline
+from metrics_tpu.observability.events import EventLog
+
+NC = 3
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    observability.set_step(None)
+    yield
+    observability.reset()
+    observability.enable()
+    observability.set_health_policy("off")
+    observability.set_step(None)
+
+
+def _exercise_every_phase():
+    """Drive one metric through every instrumented phase: update, forward,
+    compute, sync (via a local fan-out dist_sync_fn), retrace (jit_forward
+    compile), and health (a poisoned state under policy "record")."""
+    rng = np.random.RandomState(0)
+    probs = jnp.asarray(rng.rand(8, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, (8,)))
+
+    m = Accuracy(dist_sync_fn=lambda x, group=None: [x, x])
+    with observability.step_context(0):
+        m.update(probs, target)       # update
+        m(probs, target)              # forward
+    m.compute()                       # compute + sync
+    jitted = Accuracy().jit_forward()
+    with observability.step_context(1):
+        jitted(probs, target)         # retrace (fresh compile)
+    observability.set_health_policy("record")
+    from metrics_tpu import AverageMeter
+
+    avg = AverageMeter()
+    avg.value = jnp.asarray(jnp.nan)
+    avg._update_called = True
+    avg.check_health()                # health
+    observability.set_health_policy("off")
+
+
+def test_export_is_valid_chrome_trace_with_every_phase(tmp_path):
+    _exercise_every_phase()
+    path = timeline.export(str(tmp_path / "trace.json"))
+    with open(path) as fh:
+        trace = json.load(fh)  # valid JSON — the acceptance bar
+    events = trace["traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+    cats = {e.get("cat") for e in events}
+    for phase in ("update", "forward", "compute", "sync", "retrace", "health"):
+        assert phase in cats, f"no {phase} event on the exported timeline"
+    # minimal structural validity: every non-metadata record carries the
+    # required keys, with ts/dur in microseconds
+    for e in events:
+        if e["ph"] == "M":
+            continue
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+
+
+def test_per_metric_tracks_are_named():
+    log = EventLog()
+    log.record("update", "Accuracy#0", dur_s=0.001)
+    log.record("update", "Precision#0", dur_s=0.001)
+    log.record("sync", None, transport="gather")
+    trace = timeline.to_chrome_trace(log=log)
+    names = {
+        e["args"]["name"]: e["tid"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert set(names) == {"Accuracy#0", "Precision#0", timeline.GLOBAL_TRACK}
+    assert len(set(names.values())) == 3  # distinct tracks
+    by_name = {e["name"]: e for e in trace["traceEvents"] if e["ph"] != "M"}
+    assert by_name["Accuracy#0.update"]["tid"] == names["Accuracy#0"]
+    assert by_name["sync"]["tid"] == names[timeline.GLOBAL_TRACK]
+
+
+def test_interval_vs_instant_rendering():
+    log = EventLog()
+    log.record("forward", "M#0", dur_s=0.25, t_start=None)
+    log.record("retrace", "M#0", signature="(f32[8])")
+    trace = timeline.to_chrome_trace(log=log)
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert len(slices) == 1 and slices[0]["dur"] == pytest.approx(0.25e6)
+    assert len(instants) == 1 and instants[0]["s"] == "t"
+    assert instants[0]["args"]["signature"] == "(f32[8])"
+
+
+def test_step_counter_track_emitted_on_step_changes():
+    log = EventLog()
+    with log.step_context(0):
+        log.record("update", "M#0", dur_s=0.001)
+        log.record("update", "M#0", dur_s=0.001)
+    with log.step_context(1):
+        log.record("update", "M#0", dur_s=0.001)
+    counters = [e for e in timeline.to_chrome_trace(log=log)["traceEvents"] if e["ph"] == "C"]
+    assert [c["args"]["step"] for c in counters] == [0, 1]  # once per change
+    # and the slices themselves carry the step in args
+    slices = [e for e in timeline.to_chrome_trace(log=log)["traceEvents"] if e["ph"] == "X"]
+    assert [s["args"]["step"] for s in slices] == [0, 0, 1]
+
+
+def test_payloads_are_coerced_json_safe():
+    log = EventLog()
+    log.record("sync", None, members=(0, 1), bytes_out=np.int64(128), axis=("data",))
+    trace = timeline.to_chrome_trace(log=log)
+    json.dumps(trace)  # must not raise
+    (ev,) = [e for e in trace["traceEvents"] if e["ph"] != "M" and e["ph"] != "C"]
+    assert ev["args"]["members"] == [0, 1]
+    assert ev["args"]["bytes_out"] == 128
+
+
+def test_events_are_time_ordered():
+    log = EventLog()
+    # record out of order via explicit t_start anchors
+    import time
+
+    now = time.perf_counter()
+    log.record("update", "M#0", dur_s=0.001, t_start=now)
+    log.record("update", "M#0", dur_s=0.001, t_start=now - 1.0)
+    ts = [e["ts"] for e in timeline.to_chrome_trace(log=log)["traceEvents"] if e["ph"] == "X"]
+    assert ts == sorted(ts)
+
+
+def test_export_summary_metadata(tmp_path):
+    log = EventLog()
+    log.record("update", "M#0", dur_s=0.001)
+    path = timeline.export(str(tmp_path / "t.json"), log=log)
+    other = json.load(open(path))["otherData"]
+    assert other["events_summary"]["recorded_total"] == 1
+    assert other["epoch_unix_s"] > 0

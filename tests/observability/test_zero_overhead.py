@@ -1,0 +1,61 @@
+"""The zero-overhead CI gate (``scripts/check_zero_overhead.py``) run as a
+test: observability must add zero traced ops to the hot paths, and the
+disabled-state jaxprs must match the pinned seed baseline digests."""
+import os
+import sys
+
+import pytest
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "scripts")
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+import check_zero_overhead  # noqa: E402
+
+
+def test_gate_passes():
+    result = check_zero_overhead.check()
+    assert result["violations"] == []
+    # within one jax version the digest comparison must actually run; a skip
+    # only happens when the baseline was pinned on a different jax release
+    import jax, json  # noqa: E401
+
+    with open(check_zero_overhead.BASELINE_PATH) as fh:
+        baseline = json.load(fh)
+    if baseline["jax_version"] == jax.__version__:
+        assert result["skipped_digests"] == []
+
+
+def test_baseline_file_is_pinned():
+    assert os.path.exists(check_zero_overhead.BASELINE_PATH), (
+        "scripts/zero_overhead_baseline.json is missing — regenerate with"
+        " `python scripts/check_zero_overhead.py --update`"
+    )
+    import json
+
+    with open(check_zero_overhead.BASELINE_PATH) as fh:
+        baseline = json.load(fh)
+    assert set(baseline["programs"]) == {
+        "metric_update",
+        "metric_jit_forward",
+        "collection_update",
+        "collection_jit_forward",
+    }
+    for rec in baseline["programs"].values():
+        assert rec["sha256"] and rec["jaxpr"]
+
+
+def test_digest_mismatch_is_reported(tmp_path):
+    """A drifted digest must surface as a violation, not pass silently."""
+    import json
+
+    import jax
+
+    with open(check_zero_overhead.BASELINE_PATH) as fh:
+        baseline = json.load(fh)
+    if baseline["jax_version"] != jax.__version__:
+        pytest.skip("baseline pinned on a different jax version")
+    baseline["programs"]["metric_update"]["sha256"] = "0" * 64
+    bad = tmp_path / "baseline.json"
+    bad.write_text(json.dumps(baseline))
+    result = check_zero_overhead.check(str(bad))
+    assert any("metric_update" in v and "drifted" in v for v in result["violations"])

@@ -114,6 +114,26 @@ def test_every_config_has_meta_and_resolves():
         assert getattr(bench_suite, cfg.__name__) is cfg
 
 
+def test_bench_record_schema_round_trips_json():
+    """Every bench line must survive json.dumps/loads intact and carry the
+    observability evidence keys: the telemetry snapshot plus the health
+    summary and event-log high-water mark beside it."""
+    import json
+
+    def bench_dummy():
+        return "dummy_metric", 1e-6, lambda torchmetrics, torch: float("nan")
+
+    line = bench_suite.run_config(bench_dummy, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "dummy_metric" and line["value"] == 1.0
+    assert "telemetry" in line
+    assert line["health"] == line["telemetry"]["health"]
+    assert line["health"]["policy"] in ("off", "record", "warn", "raise")
+    assert line["events_high_water"] == line["telemetry"]["events"]["high_water"]
+    assert isinstance(line["events_high_water"], int)
+
+
 def test_measure_single_attempt_after_total_deadline(monkeypatch):
     calls = []
     monkeypatch.setattr(
