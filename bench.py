@@ -4,10 +4,13 @@ Emits every config from ``scripts/bench_suite.py`` — the five BASELINE.md
 rows (Accuracy loop; the fused Accuracy+P/R/F1 MetricCollection; AUROC/AP;
 retrieval MAP+NDCG; SSIM+PSNR+SI-SDR), the epoch-end compute configs
 (AUROC 200k sort-scan, FID 2048-d), the Pallas-vs-XLA confusion-matrix
-kernel config run on the real TPU backend, and the north-star
-``train_step_metric_overhead`` (% overhead of the 10-metric collection
-fused into a Flax train step, target <1%). The flagship collection config
-prints LAST, and the full line set is re-emitted as a final block.
+kernel config run on the real TPU backend, the packed-collective sync
+configs (``collection_sync_in_graph_step`` / ``collection_sync_eager_epoch``,
+whose records carry ``collectives_before``/``collectives_after`` — the
+bucketed-fusion win), and the north-star ``train_step_metric_overhead``
+(% overhead of the 10-metric collection fused into a Flax train step,
+target <1%). The flagship collection config prints LAST, and the full line
+set is re-emitted as a final block.
 
 Each line is ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
 "probe_us": ..., "probe_us_after": ..., "link_rtt_ms": ..., "degraded":

@@ -9,6 +9,7 @@ and syncs inside one jitted program: XLA then fuses the per-metric psum
 collectives into a single staged bundle over the mesh, which is how a
 10-metric collection stays at ~one collective of step overhead.
 """
+import time
 from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Union
@@ -213,20 +214,23 @@ class MetricCollection:
         return deltas
 
     def compute(self) -> Dict[str, Any]:
-        """Compute every metric; shared-update equivalence classes sync ONCE.
+        """Compute every metric; the whole collection syncs in ONE transport.
 
-        The eager epoch-boundary sync costs one gather per state per metric
-        (the reference's ~(1 barrier + 2 gathers) × states cost model,
-        SURVEY §3.3); class members hold identical states by construction,
-        so the representative's synced states are adopted by the members for
-        the duration of the compute — A+P+R+F1 gathers one tp/fp/tn/fn
-        quartet instead of three extra copies. Restores every member's local
-        state and sync flag afterwards."""
+        On the default distributed gather, every member's states (one bundle
+        per shared-update equivalence class — class members hold identical
+        states by construction, so A+P+R+F1 ship one tp/fp/tn/fn quartet)
+        ride a single packed ``gather_all_pytrees`` call: one descriptor
+        round + one payload round for the entire collection, instead of two
+        transport rounds per state per metric (the reference's ~(1 barrier +
+        2 gathers) × states cost model, SURVEY §3.3). Members with injected
+        ``dist_sync_fn`` gathers or overridden sync protocols keep syncing
+        themselves. Restores every member's local state and sync flag
+        afterwards."""
         adopted: list = []
         try:
             # adoption runs INSIDE the try so a failure while syncing a later
             # class still restores members already pointed at synced states
-            self._adopt_class_synced_states(adopted)
+            self._adopt_packed_synced_states(adopted)
             return {k: m.compute() for k, m in self.items()}
         finally:
             for m, cache, prev_to_sync in adopted:
@@ -234,12 +238,109 @@ class MetricCollection:
                     m._set_states(cache)
                 m._to_sync = prev_to_sync
 
-    def _adopt_class_synced_states(self, adopted: list) -> None:
+    def _adopt_packed_synced_states(self, adopted: list) -> None:
+        """Sync every packable member's states in ONE packed transport per
+        gather group and point the members at the synced values; appends
+        restore records to ``adopted`` AS THEY HAPPEN (so a mid-way failure
+        is fully restorable).
+
+        Packable means: default ``gather_all_arrays`` transport (no injected
+        ``dist_sync_fn``), the base ``Metric._sync_dist`` protocol, at least
+        one registered state, and sync not already disabled. Shared-update
+        equivalence classes contribute their representative's bundle once;
+        the members adopt the synced result, exactly as the per-class
+        adoption did. Everything else (custom gathers, overridden sync)
+        falls back to the per-class adoption + per-member self-sync."""
+        from metrics_tpu.utilities import distributed as _dist
+
+        if not _dist.distributed_available():
+            # no packed transport to save; class adoption still dedups
+            # injected-gather classes
+            return self._adopt_class_synced_states(adopted)
+
+        alias: Dict[str, list] = {}  # rep name -> all class member names
+        aliased = set()
+        for names in self._class_groups().values():
+            if len(names) < 2:
+                continue
+            if all(self._metrics[n]._computed is not None for n in names):
+                continue  # every member returns its cached value; don't re-gather
+            rep = self._metrics[names[0]]
+            if any(
+                self._metrics[n]._reductions != rep._reductions
+                or self._metrics[n].process_group != rep.process_group
+                or self._metrics[n].dist_sync_fn is not rep.dist_sync_fn
+                for n in names[1:]
+            ):
+                continue
+            alias[names[0]] = names
+            aliased.update(names[1:])
+
+        # one bundle per gather group (metrics naming different process
+        # subsets cannot share a decode, but each bundle is still one
+        # descriptor + one payload round, and rounds across bundles stay
+        # aligned rank-to-rank because membership derives from SPMD state)
+        bundles: Dict[str, Tuple[Any, list]] = {}
+        for name, m in self.items(keep_base=True):
+            if name in aliased:
+                continue
+            if m._computed is not None and name not in alias:
+                continue  # cached value; compute() will not sync anyway
+            if (
+                m.dist_sync_fn is not None
+                or type(m)._sync_dist is not Metric._sync_dist
+                or not m._defaults
+                or not m._to_sync
+            ):
+                continue
+            key = repr(m.process_group)
+            bundles.setdefault(key, (m.process_group, []))[1].append(name)
+
+        for group, names in bundles.values():
+            pre = [self._metrics[n]._pre_sync_states() for n in names]
+            sync_start = time.perf_counter() if EVENTS.enabled else None
+            gathered = _dist.gather_all_pytrees([states for states, _ in pre], group=group)
+            if sync_start is not None:
+                EVENTS.record(
+                    "sync",
+                    self.telemetry_key,
+                    dur_s=time.perf_counter() - sync_start,
+                    t_start=sync_start,
+                    members=list(names),
+                    packed=True,
+                )
+            for n, (states, list_dtypes), g in zip(names, pre, gathered):
+                m = self._metrics[n]
+                m._note_sync_telemetry(states)
+                adopted.append((m, m._get_states(), m._to_sync))
+                m._apply_gathered_states(g, list_dtypes)
+                m._to_sync = False  # already synced; don't re-gather inside compute()
+                if n in alias:
+                    synced = m._get_states()
+                    for member in alias[n][1:]:
+                        mm = self._metrics[member]
+                        adopted.append((mm, mm._get_states(), mm._to_sync))
+                        # fresh list shells so no member can mutate a shared one
+                        mm._set_states(
+                            {k: (list(v) if isinstance(v, list) else v) for k, v in synced.items()}
+                        )
+                        mm._to_sync = False
+
+        # anything not packable (injected gathers, overridden sync) still
+        # gets the per-class dedup it had before
+        remaining: list = []
+        self._adopt_class_synced_states(remaining, skip={n for _, ns in bundles.values() for n in ns} | aliased)
+        adopted.extend(remaining)
+
+    def _adopt_class_synced_states(self, adopted: list, skip: Optional[set] = None) -> None:
         """Sync one representative per shared-update class and point the
         members at the synced values; appends restore records to ``adopted``
         AS THEY HAPPEN (so a mid-way failure is fully restorable). No-op
-        when not distributed — each member then syncs (trivially) itself."""
+        when not distributed — each member then syncs (trivially) itself.
+        ``skip`` names members the packed adoption already handled."""
         for names in self._class_groups().values():
+            if skip and any(n in skip for n in names):
+                continue
             if len(names) < 2:
                 continue
             if all(self._metrics[n]._computed is not None for n in names):
@@ -316,38 +417,31 @@ class MetricCollection:
         }
 
     def apply_compute(self, state: Dict[str, StateDict], axis_name: Any = AXIS_UNSET) -> Dict[str, Any]:
-        """Compute every metric from its state; with ``axis_name`` the per-metric
-        collectives are emitted into one program for XLA to fuse/stage. When
-        omitted, each member falls back to its own declared ``process_group``.
+        """Compute every metric from its state; with ``axis_name`` the whole
+        collection's sync lowers to ONE packed collective per (kind, dtype)
+        bucket. When omitted, each member falls back to its own declared
+        ``process_group``.
 
-        Shared-update equivalence classes sync ONE state bundle: the
-        collection's update fans identical deltas to every member of a class
-        (:meth:`_shared_deltas` / :meth:`apply_update`), so their states are
-        equal by construction and syncing each would multiply the combined
-        all-reduce payload by the class size for no information (A+P+R+F1
-        would ship 4 private tp/fp/tn/fn quartets). The representative's
-        synced bundle is fanned out to the members instead. This leans on
-        the collection state contract — states come from this collection's
-        ``init_state``/``apply_update`` chain; hand-divergent states for
-        same-class members are outside it."""
-        presynced: Dict[str, StateDict] = {}
-        for names in self._class_groups().values():
-            if len(names) < 2:
-                continue
-            rep = self._metrics[names[0]]
-            # alias only when the members' state specs (and, with axis_name
-            # unset, their fallback axes) genuinely coincide
-            if any(self._metrics[n]._reductions != rep._reductions for n in names[1:]):
-                continue
-            if axis_name is AXIS_UNSET and any(
-                self._metrics[n].process_group != rep.process_group for n in names[1:]
-            ):
-                continue
-            axis = rep.process_group if axis_name is AXIS_UNSET else axis_name
-            synced = rep.sync_state(state[names[0]], axis)
-            for n in names:
-                presynced[n] = synced
+        Two fusion layers compose here:
 
+        * **class aliasing** — shared-update equivalence classes sync ONE
+          state bundle: the collection's update fans identical deltas to
+          every member of a class (:meth:`_shared_deltas` /
+          :meth:`apply_update`), so their states are equal by construction
+          and syncing each would multiply the collective payload by the
+          class size for no information (A+P+R+F1 would ship 4 private
+          tp/fp/tn/fn quartets). The representative's synced bundle is
+          fanned out to the members instead. This leans on the collection
+          state contract — states come from this collection's
+          ``init_state``/``apply_update`` chain; hand-divergent states for
+          same-class members are outside it.
+        * **cross-member bucketing** — every surviving bundle (class
+          representatives + unshared members) over the same axis is packed
+          into ONE :func:`~metrics_tpu.utilities.distributed.sync_state_packed`
+          call, so a 10-metric classification collection lowers to one
+          ``psum`` (plus at most a ``pmax``/``all_gather`` bucket) instead
+          of one collective per state per metric."""
+        presynced = self._presync_in_graph(state, axis_name)
         out = {}
         for name, m in self.items(keep_base=True):
             if name in presynced:
@@ -355,6 +449,92 @@ class MetricCollection:
             else:
                 out[self._set_name(name)] = m.apply_compute(state[name], axis_name=axis_name)
         return out
+
+    def _in_graph_alias(self, axis_name: Any) -> Dict[str, list]:
+        """Shared-update classes whose members may alias ONE synced bundle
+        in-graph: rep name -> all member names. Alias only when the members'
+        state specs (and, with ``axis_name`` unset, their fallback axes)
+        genuinely coincide."""
+        alias: Dict[str, list] = {}
+        for names in self._class_groups().values():
+            if len(names) < 2:
+                continue
+            rep = self._metrics[names[0]]
+            if any(self._metrics[n]._reductions != rep._reductions for n in names[1:]):
+                continue
+            if axis_name is AXIS_UNSET and any(
+                self._metrics[n].process_group != rep.process_group for n in names[1:]
+            ):
+                continue
+            alias[names[0]] = names
+        return alias
+
+    def _packable_in_graph(self, m: Metric, member_state: StateDict) -> bool:
+        """True when the member's state bundle can join a cross-member packed
+        sync: base pure-state protocol (custom layouts like BootStrapper's
+        sync inside their own ``apply_compute``) and a state dict whose keys
+        match the registered reductions."""
+        return (
+            type(m).apply_compute is Metric.apply_compute
+            and type(m).sync_state is Metric.sync_state
+            and bool(m._reductions)
+            and set(member_state) == set(m._reductions)
+        )
+
+    def _packed_presync(
+        self, state: Dict[str, StateDict], names: list, axis: Any
+    ) -> Dict[str, StateDict]:
+        """One packed in-graph sync over ``axis`` for the named members'
+        bundles: leaves from EVERY bundle share the (kind, dtype) buckets."""
+        from metrics_tpu.utilities.distributed import sync_state_packed
+
+        flat_state: Dict[str, Any] = {}
+        flat_reductions: Dict[str, Any] = {}
+        for n in names:
+            m = self._metrics[n]
+            for k, v in state[n].items():
+                flat_state[f"{n}\x1f{k}"] = v
+                flat_reductions[f"{n}\x1f{k}"] = m._reductions[k]
+        try:
+            synced_flat = sync_state_packed(flat_state, flat_reductions, axis)
+        except NameError as err:  # unbound collective axis — mirror Metric.sync_state
+            raise NameError(
+                f"{err}. The collection members resolve to mesh axis {axis!r} — collectives"
+                " over it only work inside shard_map/pmap binding that axis. To compute"
+                " eagerly (single-device, no sync), pass `axis_name=None` explicitly."
+            ) from err
+        return {n: {k: synced_flat[f"{n}\x1f{k}"] for k in state[n]} for n in names}
+
+    def _presync_in_graph(self, state: Dict[str, StateDict], axis_name: Any) -> Dict[str, StateDict]:
+        """The collection-wide packed sync behind :meth:`apply_compute`:
+        group class representatives and unshared members by their resolved
+        axis, pack each group's bundles into shared buckets, fan class
+        results out to the aliased members."""
+        alias = self._in_graph_alias(axis_name)
+        aliased = {n for names in alias.values() for n in names[1:]}
+
+        bundles: Dict[str, Tuple[Any, list]] = {}
+        presynced: Dict[str, StateDict] = {}
+        for name, m in self.items(keep_base=True):
+            if name in aliased:
+                continue
+            axis = m.process_group if axis_name is AXIS_UNSET else axis_name
+            if axis is None:
+                continue
+            if self._packable_in_graph(m, state[name]):
+                bundles.setdefault(repr(axis), (axis, []))[1].append(name)
+            elif name in alias:
+                # unpackable class rep: sync its bundle alone, still aliased
+                synced = m.sync_state(state[name], axis)
+                for n in alias[name]:
+                    presynced[n] = synced
+
+        for axis, names in bundles.values():
+            synced_bundles = self._packed_presync(state, names, axis)
+            for n, synced in synced_bundles.items():
+                for member in alias.get(n, [n]):
+                    presynced[member] = synced
+        return presynced
 
     def apply_forward(
         self, state: Dict[str, StateDict], *args: Any, axis_name: Any = AXIS_UNSET, **kwargs: Any
@@ -364,33 +544,58 @@ class MetricCollection:
         The batch-local states come from a single :meth:`apply_update` (so
         shared-update classes canonicalize once for the whole collection);
         each metric then merges its batch state into the accumulator the same
-        way :meth:`Metric.apply_forward` would. When members of a
-        shared-update class sync their on-step value
-        (``dist_sync_on_step=True`` over the same axis), the batch bundle is
-        synced ONCE and fanned out — the third sync path with class
-        aliasing, alongside :meth:`compute` and :meth:`apply_compute`."""
+        way :meth:`Metric.apply_forward` would. EVERY on-step syncer
+        (``dist_sync_on_step=True`` over a resolved axis) joins the packed
+        batch-bundle sync: shared-update classes contribute one bundle
+        (synced once, fanned out), and all bundles over the same axis share
+        the (kind, dtype) collective buckets — the third sync path with
+        class aliasing AND cross-member bucketing, alongside :meth:`compute`
+        and :meth:`apply_compute`."""
         batch_state = self.apply_update(self.init_state(), *args, **kwargs)
 
-        # regroup by (class, resolved axis), keeping only on-step syncers
-        groups: Dict[Tuple, list] = {}
-        for key, names in self._class_groups().items():
-            for name in names:
-                m = self._metrics[name]
-                if not m.dist_sync_on_step:
-                    continue
-                axis = m.process_group if axis_name is AXIS_UNSET else axis_name
-                if axis is not None:
-                    groups.setdefault((key, axis), []).append(name)
+        # class aliasing among on-step syncers: a class bundle syncs once
+        alias: Dict[str, list] = {}
+        aliased: set = set()
+        for names in self._class_groups().values():
+            syncers = [
+                n
+                for n in names
+                if self._metrics[n].dist_sync_on_step
+                and (self._metrics[n].process_group if axis_name is AXIS_UNSET else axis_name)
+                is not None
+            ]
+            if len(syncers) < 2:
+                continue
+            rep = self._metrics[syncers[0]]
+            if any(self._metrics[n]._reductions != rep._reductions for n in syncers[1:]):
+                continue
+            if axis_name is AXIS_UNSET and any(
+                self._metrics[n].process_group != rep.process_group for n in syncers[1:]
+            ):
+                continue
+            alias[syncers[0]] = syncers
+            aliased.update(syncers[1:])
+
+        # pack every surviving on-step bundle per resolved axis
+        bundles: Dict[str, Tuple[Any, list]] = {}
         presynced: Dict[str, StateDict] = {}
-        for (_, axis), names in groups.items():
-            if len(names) < 2:
+        for name, m in self.items(keep_base=True):
+            if name in aliased or not m.dist_sync_on_step:
                 continue
-            rep = self._metrics[names[0]]
-            if any(self._metrics[n]._reductions != rep._reductions for n in names[1:]):
+            axis = m.process_group if axis_name is AXIS_UNSET else axis_name
+            if axis is None:
                 continue
-            synced = rep.sync_state(batch_state[names[0]], axis)
-            for n in names:
-                presynced[n] = synced
+            if self._packable_in_graph(m, batch_state[name]):
+                bundles.setdefault(repr(axis), (axis, []))[1].append(name)
+            elif name in alias:
+                synced = m.sync_state(batch_state[name], axis)
+                for n in alias[name]:
+                    presynced[n] = synced
+        for axis, names in bundles.values():
+            synced_bundles = self._packed_presync(batch_state, names, axis)
+            for n, synced in synced_bundles.items():
+                for member in alias.get(n, [n]):
+                    presynced[member] = synced
 
         new_state, values = {}, {}
         for name, m in self.items(keep_base=True):

@@ -57,7 +57,9 @@ from metrics_tpu.observability.retrace import MONITOR, arg_signature, is_tracing
 from metrics_tpu.utilities.distributed import (
     distributed_available,
     gather_all_arrays,
-    sync_in_graph,
+    gather_all_pytrees,
+    sync_in_graph,  # noqa: F401 - re-exported; the per-leaf path tests use it
+    sync_state_packed,
 )
 from metrics_tpu.utilities.profiling import compiled_scope, eager_span
 from metrics_tpu.utilities.prints import rank_zero_warn
@@ -346,12 +348,17 @@ class Metric(ABC):
         ``None`` returns the state untouched. Exposed so a caller holding
         several metrics with IDENTICAL states (a shared-update equivalence
         class in a :class:`MetricCollection`) can sync one bundle and fan it
-        out instead of paying the collective payload once per member."""
+        out instead of paying the collective payload once per member.
+
+        Lowers through the bucketed engine
+        (:func:`~metrics_tpu.utilities.distributed.sync_state_packed`): one
+        collective per (kind, dtype) bucket instead of one per state leaf;
+        callable custom reductions keep the per-leaf gather."""
         if axis_name is None:
             return state
         with compiled_scope(f"{self.__class__.__name__}.sync"):
             try:
-                return sync_in_graph(state, self._reductions, axis_name)
+                return sync_state_packed(state, self._reductions, axis_name)
             except NameError as err:  # unbound collective axis
                 raise NameError(
                     f"{err}. This metric declares process_group={self.process_group!r}, which is"
@@ -718,45 +725,36 @@ class Metric(ABC):
     # cross-process sync (eager / epoch-boundary path)
     # ------------------------------------------------------------------
 
-    def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays, process_group: Optional[Any] = None) -> None:
-        states = self._get_states()
+    def _pre_sync_states(self) -> Tuple[StateDict, Dict[str, Any]]:
+        """The gather-ready view of the live states, plus dtype notes.
 
-        # Pre-concatenate EVERY list state — regardless of its reduction, as
-        # the reference does (metric.py:203-206) — so each costs exactly one
-        # gather. This is also what keeps ranks with different per-rank batch
-        # counts issuing the same NUMBER of collectives: un-concatenated
-        # None-reduce lists would gather once per batch and deadlock on the
-        # rank with fewer batches. A never-updated (empty) list state still
-        # participates with a 0-length placeholder; the gather protocol
-        # aligns its ndim/dtype to the peers'.
-        for name, fx in self._reductions.items():
+        Pre-concatenates EVERY list state — regardless of its reduction, as
+        the reference does (metric.py:203-206) — so each costs exactly one
+        gather. This is also what keeps ranks with different per-rank batch
+        counts issuing the same NUMBER of collectives: un-concatenated
+        None-reduce lists would gather once per batch and deadlock on the
+        rank with fewer batches. A never-updated (empty) list state still
+        participates with a 0-length placeholder; the gather protocol
+        aligns its ndim/dtype to the peers'. The returned dtype notes record
+        each non-empty list state's element dtype so an all-ranks-empty sync
+        can restore it (the placeholder is float32 regardless of the data)."""
+        states = self._get_states()
+        list_dtypes: Dict[str, Any] = {}
+        for name in self._reductions:
             value = states[name]
             if isinstance(value, list):
-                states[name] = (
-                    [dim_zero_cat(value)] if value else [jnp.zeros((0,), jnp.float32)]
-                )
+                if value:
+                    cat = dim_zero_cat(value)
+                    list_dtypes[name] = cat.dtype
+                    states[name] = [cat]
+                else:
+                    states[name] = [jnp.zeros((0,), jnp.float32)]
+        return states, list_dtypes
 
-        payload_bytes = None
-        if TELEMETRY.enabled or EVENTS.enabled:
-            from metrics_tpu.observability.cost import pytree_nbytes
-
-            payload_bytes = pytree_nbytes(states)
-            if TELEMETRY.enabled:
-                key = self.telemetry_key
-                TELEMETRY.inc(key, "sync_calls")
-                TELEMETRY.inc(key, "sync_payload_bytes", payload_bytes)
-
-        sync_start = time.perf_counter() if EVENTS.enabled else None
-        gathered = apply_to_collection(states, ArrayTypes, dist_sync_fn, group=process_group or self.process_group)
-        if sync_start is not None:
-            EVENTS.record(
-                "sync",
-                self.telemetry_key,
-                dur_s=time.perf_counter() - sync_start,
-                t_start=sync_start,
-                payload_bytes=payload_bytes,
-            )
-
+    def _apply_gathered_states(self, gathered: StateDict, list_dtypes: Dict[str, Any]) -> None:
+        """Reduce the per-member gather results into the live states
+        (stack + reduction for tensor states, flatten + cat for list states,
+        empty-shard dropping, all-empty dtype restore)."""
         for name, fx in self._reductions.items():
             value = gathered[name]
             if isinstance(value[0], ArrayTypes):
@@ -768,10 +766,55 @@ class Metric(ABC):
                 filled = [v for v in value if jnp.asarray(v).size > 0]
                 if len(filled) < len(value):
                     value = filled or value[:1]
+                if not filled and name in list_dtypes:
+                    # every rank was empty: the kept entry is the float32
+                    # 0-length placeholder, but THIS rank's (zero-row) data
+                    # declared a dtype — restore it so the synced state
+                    # cannot silently flip dtype under compute()
+                    value = [jnp.asarray(v, list_dtypes[name]) for v in value]
             reduction_fn = _resolve_reduction(fx)
             if not (callable(reduction_fn) or reduction_fn is None):
                 raise TypeError("reduction_fn must be callable or None")
             setattr(self, name, reduction_fn(value) if reduction_fn is not None else value)
+
+    def _note_sync_telemetry(self, states: StateDict) -> Optional[int]:
+        """Per-metric sync counters; returns the payload byte count (or None
+        when nothing records)."""
+        if not (TELEMETRY.enabled or EVENTS.enabled):
+            return None
+        from metrics_tpu.observability.cost import pytree_nbytes
+
+        payload_bytes = pytree_nbytes(states)
+        if TELEMETRY.enabled:
+            key = self.telemetry_key
+            TELEMETRY.inc(key, "sync_calls")
+            TELEMETRY.inc(key, "sync_payload_bytes", payload_bytes)
+        return payload_bytes
+
+    def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays, process_group: Optional[Any] = None) -> None:
+        states, list_dtypes = self._pre_sync_states()
+        payload_bytes = self._note_sync_telemetry(states)
+
+        sync_start = time.perf_counter() if EVENTS.enabled else None
+        group = process_group or self.process_group
+        if dist_sync_fn is gather_all_arrays:
+            # the default transport: pack EVERY leaf of this metric into one
+            # descriptor round + one payload round instead of two transport
+            # rounds per state (see gather_all_pytrees)
+            gathered = gather_all_pytrees([states], group=group)[0]
+        else:
+            # injected custom gathers keep the documented per-leaf contract
+            gathered = apply_to_collection(states, ArrayTypes, dist_sync_fn, group=group)
+        if sync_start is not None:
+            EVENTS.record(
+                "sync",
+                self.telemetry_key,
+                dur_s=time.perf_counter() - sync_start,
+                t_start=sync_start,
+                payload_bytes=payload_bytes,
+            )
+
+        self._apply_gathered_states(gathered, list_dtypes)
 
     def sync(
         self,

@@ -109,6 +109,7 @@ def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
     for field in (
         "gathers",
         "gather_errors",
+        "gather_leaves",
         "payload_bytes_out",
         "payload_bytes_in",
         "transport_bytes",
@@ -120,6 +121,11 @@ def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
     in_graph = sync.get("in_graph", {})
     for kind, n in sorted(in_graph.get("collectives", {}).items()):
         emit("sync_in_graph_collectives_total", {"kind": kind}, n)
+    for bucket, n in sorted(in_graph.get("buckets", {}).items()):
+        emit("sync_in_graph_bucket_states_total", {"bucket": bucket}, n)
+    for field in ("collectives_before", "collectives_after"):
+        if field in in_graph:
+            emit(f"sync_in_graph_{field}_total", {}, in_graph[field], type_="counter")
 
     events = snap.get("events", {})
     if events:

@@ -57,17 +57,31 @@ class _Histogram:
 
 def _fresh_sync_stats() -> Dict[str, Any]:
     return {
-        # eager (host) gather transport — gather_all_arrays
+        # eager (host) gather transport — gather_all_arrays / gather_all_pytrees
         "gathers": 0,
         "gather_errors": 0,
+        "gather_leaves": 0,
         "payload_bytes_out": 0,
         "payload_bytes_in": 0,
         "transport_bytes": 0,
         "descriptor_rounds": 0,
         "payload_rounds": 0,
         "groups": {},
-        # in-graph (trace-time) collective composition — sync_in_graph
-        "in_graph": {"syncs": 0, "states": 0, "bytes_traced": 0, "collectives": {}, "axes": {}},
+        # in-graph (trace-time) collective composition — sync_in_graph /
+        # sync_state_packed. "collectives" counts STATES per collective kind;
+        # "buckets" counts states per packed "<kind>/<dtype>" bucket;
+        # collectives_before/after are the per-leaf vs actually-issued
+        # collective counts, so before/after quantifies the bucketing win.
+        "in_graph": {
+            "syncs": 0,
+            "states": 0,
+            "bytes_traced": 0,
+            "collectives": {},
+            "axes": {},
+            "buckets": {},
+            "collectives_before": 0,
+            "collectives_after": 0,
+        },
     }
 
 
@@ -150,8 +164,12 @@ class TelemetryRegistry:
         world: int,
         members: Any,
         error: bool = False,
+        leaves: int = 1,
     ) -> None:
-        """One completed ``gather_all_arrays`` transport (host sync path)."""
+        """One completed ``gather_all_arrays``/``gather_all_pytrees``
+        transport (host sync path). ``leaves`` is how many state arrays the
+        packed descriptor/payload rounds carried — the bundling win is
+        ``gather_leaves / gathers`` leaves per transport."""
         if not self._enabled:
             return
         group_label = ",".join(str(m) for m in members)
@@ -160,6 +178,7 @@ class TelemetryRegistry:
             s["gathers"] += 1
             if error:
                 s["gather_errors"] += 1
+            s["gather_leaves"] += int(leaves)
             s["payload_bytes_out"] += int(bytes_out)
             s["payload_bytes_in"] += int(bytes_in)
             s["transport_bytes"] += int(transport_bytes)
@@ -169,10 +188,21 @@ class TelemetryRegistry:
             g["gathers"] += 1
             g["world"] = int(world)
 
-    def record_in_graph_sync(self, axis_name: Any, kinds: Dict[str, int], bytes_traced: int) -> None:
-        """Trace-time record of one ``sync_in_graph`` lowering: which XLA
-        collectives the state bundle compiles to and the (pre-collective)
-        payload size. Runs once per trace, never per step."""
+    def record_in_graph_sync(
+        self,
+        axis_name: Any,
+        kinds: Dict[str, int],
+        bytes_traced: int,
+        *,
+        buckets: Optional[Dict[str, int]] = None,
+        collectives_before: int = 0,
+        collectives_after: int = 0,
+    ) -> None:
+        """Trace-time record of one ``sync_in_graph``/``sync_state_packed``
+        lowering: which XLA collectives the state bundle compiles to, the
+        (pre-collective) payload size, the packed bucket composition
+        (``"<kind>/<dtype>" -> state count``), and the per-leaf vs issued
+        collective counts. Runs once per trace, never per step."""
         if not self._enabled:
             return
         with self._lock:
@@ -180,8 +210,12 @@ class TelemetryRegistry:
             ig["syncs"] += 1
             ig["states"] += sum(kinds.values())
             ig["bytes_traced"] += int(bytes_traced)
+            ig["collectives_before"] += int(collectives_before)
+            ig["collectives_after"] += int(collectives_after)
             for kind, n in kinds.items():
                 ig["collectives"][kind] = ig["collectives"].get(kind, 0) + n
+            for label, n in (buckets or {}).items():
+                ig["buckets"][label] = ig["buckets"].get(label, 0) + n
             axis = repr(axis_name)
             ig["axes"][axis] = ig["axes"].get(axis, 0) + 1
 
@@ -235,6 +269,9 @@ class TelemetryRegistry:
                 "bytes_traced": ig["bytes_traced"],
                 "collectives": dict(ig["collectives"]),
                 "axes": dict(ig["axes"]),
+                "buckets": dict(ig["buckets"]),
+                "collectives_before": ig["collectives_before"],
+                "collectives_after": ig["collectives_after"],
             }
         # state memory reads live objects outside the lock (it may touch
         # arbitrary metric code)

@@ -696,6 +696,217 @@ def bench_eager_forward():
 bench_eager_forward._force_cpu = True
 
 
+# ------------------------------------------------ packed collective sync
+#: scan length for the in-graph sync config (tiny per-step states -> the
+#: sync program itself is the signal; shorter than STEPS is plenty)
+SYNC_STEPS = 400
+#: epochs for the eager sync config's host-protocol loop
+SYNC_EAGER_EPOCHS = 50
+
+
+def _ten_metric_classification_collection(nc=5):
+    from metrics_tpu import (
+        IoU,
+        Accuracy,
+        CohenKappa,
+        ConfusionMatrix,
+        F1,
+        HammingDistance,
+        MatthewsCorrcoef,
+        MetricCollection,
+        Precision,
+        Recall,
+        Specificity,
+    )
+
+    return MetricCollection(
+        [
+            Accuracy(),
+            Precision(average="macro", num_classes=nc),
+            Recall(average="macro", num_classes=nc),
+            F1(average="macro", num_classes=nc),
+            Specificity(average="macro", num_classes=nc),
+            HammingDistance(),
+            ConfusionMatrix(num_classes=nc),
+            CohenKappa(num_classes=nc),
+            MatthewsCorrcoef(num_classes=nc),
+            IoU(num_classes=nc),
+        ]
+    )
+
+
+def bench_collection_sync_in_graph():
+    """In-graph metric-state sync of the 10-metric classification collection,
+    per scanned step: the packed (bucketed) engine — one collective per
+    (kind, dtype) bucket — against our own per-leaf lowering (one collective
+    per state leaf) as the baseline, on the same backend. The line carries
+    ``collectives_before``/``collectives_after`` (collective-primitive counts
+    of the two traced programs) so the record shows the fusion that produced
+    the time."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from check_zero_overhead import _count_collectives, _shard_map
+    from metrics_tpu.utilities.distributed import sync_in_graph, sync_state_packed
+
+    nc = 5
+    coll = _ten_metric_classification_collection(nc)
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(256, nc).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, nc, 256))
+    state = coll.apply_update(coll.init_state(), preds, target)
+    # the full member bundle, flattened (no class dedup here: this config
+    # isolates the transport-layer bucketing win itself)
+    flat_state = {
+        f"{n}.{k}": v for n, m in coll.items(keep_base=True) for k, v in state[n].items()
+    }
+    flat_reductions = {
+        f"{n}.{k}": m._reductions[k]
+        for n, m in coll.items(keep_base=True)
+        for k in state[n]
+    }
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    xs = jnp.arange(SYNC_STEPS, dtype=jnp.int32)
+
+    def make_update(sync_fn):
+        body = _shard_map(
+            lambda s: sync_fn(s, flat_reductions, "data"), mesh, (P(),), P()
+        )
+
+        def update(acc, x):
+            # per-step perturbation so XLA cannot hoist the sync out of the scan
+            s = {k: v + x.astype(v.dtype) for k, v in flat_state.items()}
+            synced = body(s)
+            folded = sum(
+                jnp.sum(leaf).astype(jnp.float32) for leaf in jax.tree.leaves(synced)
+            )
+            return acc + folded
+
+        return update
+
+    packed_update = make_update(sync_state_packed)
+    per_leaf_update = make_update(sync_in_graph)
+
+    zero = lambda: jnp.zeros(())  # noqa: E731
+    ours = _time_scan_epoch((xs,), zero, packed_update)
+
+    before = _count_collectives(
+        jax.make_jaxpr(lambda a, x: per_leaf_update(a, x))(jnp.zeros(()), xs[0]).jaxpr
+    )
+    after = _count_collectives(
+        jax.make_jaxpr(lambda a, x: packed_update(a, x))(jnp.zeros(()), xs[0]).jaxpr
+    )
+
+    def ref(torchmetrics, torch):  # our own per-leaf lowering is the baseline
+        return _time_scan_epoch((xs,), zero, per_leaf_update)
+
+    extra = {
+        "collectives_before": int(sum(before.values())),
+        "collectives_after": int(sum(after.values())),
+        "bucket_kinds": {k: int(v) for k, v in sorted(after.items())},
+    }
+    return "collection_sync_in_graph_step", ours, ref, "us/step", extra
+
+
+def bench_collection_sync_eager():
+    """Eager epoch-end collection sync over a loopback world-2 transport:
+    the packed path (ONE descriptor + ONE payload round for the whole
+    collection, class bundles deduped) against the per-leaf protocol (two
+    transport rounds per state per metric). The loopback isolates the host
+    protocol cost (descriptor building, byte packing, decode); on a real
+    multi-host link every round additionally pays the ~100 µs RTT the
+    round counts multiply — ``collectives_before``/``collectives_after``
+    carry the per-epoch transport-round counts so the record quantifies
+    that win too."""
+    import jax.numpy as jnp
+
+    import metrics_tpu.utilities.distributed as dist_mod
+    from metrics_tpu.utilities.distributed import gather_all_arrays
+
+    nc = 5
+    coll = _ten_metric_classification_collection(nc)
+    rng = np.random.RandomState(0)
+    probs = rng.rand(256, nc).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    coll.update(jnp.asarray(probs), jnp.asarray(rng.randint(0, nc, 256)))
+
+    rounds = [0]
+
+    def loopback_allgather(x):
+        rounds[0] += 1
+        return np.stack([np.asarray(x), np.asarray(x)])
+
+    def packed_epoch():
+        adopted = []
+        try:
+            coll._adopt_packed_synced_states(adopted)
+        finally:
+            for m, cache, prev in adopted:
+                if cache is not None:
+                    m._set_states(cache)
+                m._to_sync = prev
+
+    # a fresh wrapper defeats the `dist_sync_fn is gather_all_arrays`
+    # fast-path check, forcing the documented per-leaf protocol
+    per_leaf_gather = lambda x, group=None: gather_all_arrays(x, group)  # noqa: E731
+
+    def per_leaf_epoch():
+        for m in coll.values():
+            with m.sync_context(dist_sync_fn=per_leaf_gather, distributed_available=lambda: True):
+                pass
+
+    orig = (
+        dist_mod._process_allgather,
+        dist_mod.distributed_available,
+        dist_mod.world_size,
+        dist_mod.jax.process_index,
+    )
+    dist_mod._process_allgather = loopback_allgather
+    dist_mod.distributed_available = lambda: True
+    dist_mod.world_size = lambda: 2
+    dist_mod.jax.process_index = lambda: 0
+    try:
+        rounds[0] = 0
+        packed_epoch()
+        rounds_after = rounds[0]
+        rounds[0] = 0
+        per_leaf_epoch()
+        rounds_before = rounds[0]
+        # both sides measured inside the patch scope (the transport must be
+        # the loopback for the whole loop); the ref closure replays the value
+        ours = _time_eager_loop(packed_epoch, steps=SYNC_EAGER_EPOCHS)
+        ref_time = _time_eager_loop(per_leaf_epoch, steps=SYNC_EAGER_EPOCHS)
+    finally:
+        (
+            dist_mod._process_allgather,
+            dist_mod.distributed_available,
+            dist_mod.world_size,
+            dist_mod.jax.process_index,
+        ) = orig
+
+    extra = {
+        "collectives_before": int(rounds_before),
+        "collectives_after": int(rounds_after),
+        "transport": "loopback_world2",
+    }
+    # our own per-leaf protocol is the baseline; torch args are unused
+    return (
+        "collection_sync_eager_epoch",
+        ours,
+        lambda torchmetrics, torch: ref_time,
+        "us/epoch",
+        extra,
+    )
+
+
+#: loopback protocol cost is host-bound; the tunnel backend would charge a
+#: device round-trip per tiny state op (see bench_eager_forward)
+bench_collection_sync_eager._force_cpu = True
+
+
 def run_config(cfg, probe: bool = True, _repinned: bool = False) -> dict:
     """Run one bench config and shape the driver JSON line (NaN-safe).
 
@@ -800,6 +1011,8 @@ CONFIG_META = {
     "bench_pallas_confmat": ("confmat_pallas_vs_xla_step", "us/step"),
     "bench_train_overhead": ("train_step_metric_overhead", "pct"),
     "bench_eager_forward": ("stateful_forward_step_cpu", "us/step"),
+    "bench_collection_sync_in_graph": ("collection_sync_in_graph_step", "us/step"),
+    "bench_collection_sync_eager": ("collection_sync_eager_epoch", "us/epoch"),
 }
 
 #: driver order — the flagship collection config LAST (the driver's headline)
@@ -813,6 +1026,8 @@ CONFIGS = [
     bench_pallas_confmat,
     bench_train_overhead,
     bench_eager_forward,
+    bench_collection_sync_in_graph,
+    bench_collection_sync_eager,
     bench_collection,
 ]
 

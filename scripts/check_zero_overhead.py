@@ -22,6 +22,17 @@ The digest comparison is keyed to the jax version that produced the
 baseline — jaxpr text is not stable across jax releases — and reports
 ``skipped_digests`` instead of failing on a version mismatch; the identity
 checks run (and gate) everywhere.
+
+The gate additionally pins the **packed in-graph sync lowering**: the
+collective-primitive count per kind (psum/pmax/pmin/all_gather) of the
+canonical sync programs — a 10-metric classification collection's
+``apply_compute`` over a mesh axis, and a single metric's ``sync_state``.
+Bucketed fusion (``sync_state_packed``) keeps these at one collective per
+(kind, dtype) bucket; a regression back to per-leaf collectives inflates the
+counts and fails the gate. Collective counts are version-independent (they
+come from the traced jaxpr's primitives, not its text), so this check runs
+regardless of the baseline's jax version; regenerate with ``--update`` after
+an intentional lowering change.
 """
 import argparse
 import hashlib
@@ -82,6 +93,94 @@ def _programs() -> Dict[str, Callable[[], str]]:
 
 def _sha256(text: str) -> str:
     return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _count_collectives(jaxpr, counts: Dict[str, int] = None) -> Dict[str, int]:
+    """Collective-primitive counts in a (possibly nested) jaxpr."""
+    counts = {} if counts is None else counts
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("psum", "pmax", "pmin", "all_gather", "all_to_all"):
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+        for v in eqn.params.values():
+            if hasattr(v, "eqns"):
+                _count_collectives(v, counts)
+            elif hasattr(v, "jaxpr"):
+                _count_collectives(v.jaxpr, counts)
+    return counts
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    import jax
+
+    if hasattr(jax, "shard_map"):  # pragma: no cover - newer jax
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def sync_collective_counts() -> Dict[str, Dict[str, int]]:
+    """Collective counts per kind for the pinned packed-sync programs.
+
+    Traced over a 1-device ``("data",)`` mesh — collective COUNTS in the
+    jaxpr are device-count-independent (the shard_map body is per-shard), so
+    the gate runs identically on a laptop and the 8-device test mesh.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu import (
+        Accuracy,
+        CohenKappa,
+        ConfusionMatrix,
+        F1,
+        HammingDistance,
+        IoU,
+        MatthewsCorrcoef,
+        MetricCollection,
+        Precision,
+        Recall,
+        Specificity,
+    )
+
+    jax.config.update("jax_enable_x64", True)
+    nc = 5
+    coll = MetricCollection(
+        [
+            Accuracy(),
+            Precision(average="macro", num_classes=nc),
+            Recall(average="macro", num_classes=nc),
+            F1(average="macro", num_classes=nc),
+            Specificity(average="macro", num_classes=nc),
+            HammingDistance(),
+            ConfusionMatrix(num_classes=nc),
+            CohenKappa(num_classes=nc),
+            MatthewsCorrcoef(num_classes=nc),
+            IoU(num_classes=nc),
+        ]
+    )
+    preds = jnp.zeros((8, nc), jnp.float32)
+    target = jnp.zeros((8,), jnp.int32)
+    state = coll.apply_update(coll.init_state(), preds, target)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+
+    coll_jaxpr = jax.make_jaxpr(
+        _shard_map(lambda s: coll.apply_compute(s, axis_name="data"), mesh, (P(),), P())
+    )(state)
+
+    acc = Accuracy()
+    acc_state = acc.apply_update(acc.init_state(), preds, target)
+    metric_jaxpr = jax.make_jaxpr(
+        _shard_map(lambda s: acc.sync_state(s, "data"), mesh, (P(),), P())
+    )(acc_state)
+
+    return {
+        "collection_sync_packed": _count_collectives(coll_jaxpr.jaxpr),
+        "metric_sync_packed": _count_collectives(metric_jaxpr.jaxpr),
+    }
 
 
 def current_jaxprs() -> Dict[str, str]:
@@ -154,6 +253,24 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                         " If the change is intentional, regenerate with"
                         " `python scripts/check_zero_overhead.py --update`."
                     )
+        # the packed-sync collective counts are version-independent: check
+        # them even when the digest comparison is skipped
+        pinned_sync = baseline.get("sync_collectives")
+        if pinned_sync is None:
+            violations.append("sync_collectives missing from baseline (run --update)")
+        else:
+            current = sync_collective_counts()
+            for name, counts in current.items():
+                want = pinned_sync.get(name)
+                if want is None:
+                    violations.append(f"{name}: sync program missing from baseline (run --update)")
+                elif want != counts:
+                    violations.append(
+                        f"{name}: in-graph sync lowers to {counts}, baseline pins {want} —"
+                        " the packed (bucketed) sync regressed toward per-leaf collectives"
+                        " (or the bucket layout changed). If intentional, regenerate with"
+                        " `python scripts/check_zero_overhead.py --update`."
+                    )
     else:
         skipped.append(f"no baseline at {baseline_path} (run --update to create it)")
     return {"violations": violations, "skipped_digests": skipped}
@@ -176,6 +293,9 @@ def update_baseline(baseline_path: str = BASELINE_PATH) -> str:
         "programs": {
             name: {"sha256": _sha256(text), "jaxpr": text} for name, text in texts.items()
         },
+        # packed in-graph sync lowering: collective count per kind; a
+        # regression back to per-leaf collectives inflates these and fails
+        "sync_collectives": sync_collective_counts(),
     }
     with open(baseline_path, "w") as fh:
         json.dump(payload, fh, indent=1)

@@ -1,0 +1,476 @@
+"""Packed eager gather: ``gather_all_pytrees`` protocol simulation.
+
+The bundle-level extension of the ragged descriptor/payload protocol
+(``tests/bases/test_gather_protocol.py`` covers the per-array form): an
+entire state bundle — every leaf of every metric in a collection — rides ONE
+descriptor round + ONE payload round. Simulated with the same N-thread
+barrier transport, which makes the transport-round accounting, the
+deadlock-safety discipline (deferred raises for unalignable leaves), and the
+collection-level end-to-end path testable in-process.
+"""
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.utilities.distributed as dist_mod
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.distributed import gather_all_arrays, gather_all_pytrees
+
+
+def run_rank_fns(fns):
+    """Run one callable per simulated rank over a barrier-backed fake
+    ``_process_allgather``; returns (results, errors, transport_calls)."""
+    nprocs = len(fns)
+    barrier = threading.Barrier(nprocs)
+    exchange = {}
+    lock = threading.Lock()
+    rank_of_thread = {}
+    calls = [0] * nprocs
+
+    def fake_allgather(x):
+        rank = rank_of_thread[threading.get_ident()]
+        calls[rank] += 1
+        with lock:
+            exchange[rank] = np.asarray(x)
+        barrier.wait()
+        stacked = np.stack([exchange[r] for r in range(nprocs)])
+        barrier.wait()  # everyone has read before the next exchange reuses the dict
+        return stacked
+
+    results = [None] * nprocs
+    errors = [None] * nprocs
+
+    def worker(rank):
+        rank_of_thread[threading.get_ident()] = rank
+        try:
+            results[rank] = fns[rank]()
+        except Exception as err:  # surfaced to the test
+            errors[rank] = err
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                if all(results[r] is not None or errors[r] is not None for r in range(nprocs)):
+                    return
+                time.sleep(0.01)
+            barrier.abort()
+
+    orig = (
+        dist_mod._process_allgather,
+        dist_mod.distributed_available,
+        dist_mod.world_size,
+        dist_mod.jax.process_index,
+    )
+    dist_mod._process_allgather = fake_allgather
+    dist_mod.distributed_available = lambda: True
+    dist_mod.world_size = lambda: nprocs
+    dist_mod.jax.process_index = lambda: rank_of_thread[threading.get_ident()]
+    try:
+        threads = [threading.Thread(target=worker, args=(r,)) for r in range(nprocs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        (
+            dist_mod._process_allgather,
+            dist_mod.distributed_available,
+            dist_mod.world_size,
+            dist_mod.jax.process_index,
+        ) = orig
+    return results, errors, calls
+
+
+# ---------------------------------------------------------------------------
+# gather_all_pytrees protocol
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_rides_two_transport_rounds():
+    """A whole multi-leaf, multi-tree bundle costs exactly ONE descriptor
+    round + ONE payload round per rank — not two rounds per leaf."""
+
+    def make(rank):
+        trees = [
+            {"a": jnp.asarray([1.0 + rank, 2.0], jnp.float32), "b": jnp.asarray(rank, jnp.int32)},
+            {"c": [jnp.asarray([[rank, rank]], jnp.int64)]},
+        ]
+        return lambda: gather_all_pytrees(trees)
+
+    results, errors, calls = run_rank_fns([make(0), make(1)])
+    assert errors == [None, None]
+    assert calls == [2, 2], calls  # 5 leaves would have cost 10 rounds per-leaf
+    for res in results:
+        np.testing.assert_array_equal(np.asarray(res[0]["a"][0]), [1.0, 2.0])
+        np.testing.assert_array_equal(np.asarray(res[0]["a"][1]), [2.0, 2.0])
+        assert [int(v) for v in res[0]["b"]] == [0, 1]
+        inner = res[1]["c"][0]  # the list shell holds the per-member list
+        np.testing.assert_array_equal(np.asarray(inner[0]), [[0, 0]])
+        np.testing.assert_array_equal(np.asarray(inner[1]), [[1, 1]])
+
+
+def test_bundle_matches_per_leaf_gather():
+    """Leaf by leaf, the packed bundle must return exactly what
+    ``gather_all_arrays`` returns — including ragged rows and an empty
+    member aligned to the peers' ndim/dtype."""
+    rank_leaves = [
+        {"x": np.arange(12, dtype=np.float32).reshape(4, 3), "y": np.zeros((0,), np.float32)},
+        {"x": np.arange(6, dtype=np.float32).reshape(2, 3) + 100, "y": np.arange(4, dtype=np.int64)},
+    ]
+
+    packed_results, errors, _ = run_rank_fns(
+        [lambda r=r: gather_all_pytrees([rank_leaves[r]]) for r in range(2)]
+    )
+    assert errors == [None, None]
+    leaf_results, errors2, _ = run_rank_fns(
+        [
+            lambda r=r: {
+                "x": gather_all_arrays(jnp.asarray(rank_leaves[r]["x"])),
+                "y": gather_all_arrays(jnp.asarray(rank_leaves[r]["y"])),
+            }
+            for r in range(2)
+        ]
+    )
+    assert errors2 == [None, None]
+    for packed, per_leaf in zip(packed_results, leaf_results):
+        for name in ("x", "y"):
+            got, want = packed[0][name], per_leaf[name]
+            assert len(got) == len(want)
+            for g, w in zip(got, want):
+                g, w = np.asarray(g), np.asarray(w)
+                assert g.dtype == w.dtype and g.shape == w.shape
+                np.testing.assert_array_equal(g, w)
+
+
+def test_all_empty_bundle_skips_payload_round_on_every_rank():
+    trees = [{"a": jnp.zeros((0,), jnp.float32), "b": jnp.zeros((0, 2), jnp.int32)}]
+    results, errors, calls = run_rank_fns([lambda: gather_all_pytrees(trees)] * 2)
+    assert errors == [None, None]
+    assert calls == [1, 1], calls  # descriptor round only, aligned on both ranks
+    for res in results:
+        assert all(np.asarray(v).size == 0 for leaf in res[0].values() for v in leaf)
+
+
+def test_disjoint_groups_share_the_bundle_rounds():
+    """Two disjoint groups with different bundle shapes/dtypes decode their
+    own members from the same two global rounds. The leaf COUNT must agree
+    across ranks — the packed analogue of the per-leaf protocol's
+    equal-call-count invariant (per-leaf, 2 leaves = 2 gather calls on every
+    rank; packed, 2 leaves = one 2-leaf bundle on every rank)."""
+
+    def group_a(rank):
+        return lambda: gather_all_pytrees(
+            [{"v": jnp.arange(3 + rank, dtype=jnp.float32), "w": jnp.asarray([rank], jnp.int32)}],
+            group=[0, 1],
+        )
+
+    def group_b(rank):
+        return lambda: gather_all_pytrees(
+            [{"m": jnp.full((2, 2), rank, jnp.int64), "n": jnp.asarray(float(rank))}], group=[2, 3]
+        )
+
+    results, errors, calls = run_rank_fns([group_a(0), group_a(1), group_b(2), group_b(3)])
+    assert errors == [None] * 4
+    assert calls == [2, 2, 2, 2], calls
+    for rank in (0, 1):
+        got = results[rank][0]["v"]
+        assert [v.shape[0] for v in got] == [3, 4]
+    for rank in (2, 3):
+        got = results[rank][0]["m"]
+        np.testing.assert_array_equal(np.asarray(got[0]), np.full((2, 2), 2))
+        np.testing.assert_array_equal(np.asarray(got[1]), np.full((2, 2), 3))
+        assert [float(v) for v in results[rank][0]["n"]] == [2.0, 3.0]
+
+
+def test_group_mismatch_raises_after_rounds_without_hanging_peers():
+    locals_ = [
+        {"v": jnp.zeros((2,), jnp.float32)},
+        {"v": jnp.zeros((2, 2), jnp.float32)},
+        {"v": jnp.asarray([5.0], jnp.float32)},
+        {"v": jnp.asarray([6.0], jnp.float32)},
+    ]
+    groups = [[0, 1], [0, 1], [2, 3], [2, 3]]
+    results, errors, _ = run_rank_fns(
+        [lambda r=r: gather_all_pytrees([locals_[r]], group=groups[r]) for r in range(4)]
+    )
+    assert errors[0] is not None and "different ranks" in str(errors[0])
+    assert errors[1] is not None
+    assert errors[2] is None and errors[3] is None
+    np.testing.assert_array_equal(np.asarray(results[2][0]["v"][1]), [6.0])
+
+
+# ---------------------------------------------------------------------------
+# deferred local-leaf validation (satellite regression: a bad rank must not
+# hang its peers mid-collective)
+# ---------------------------------------------------------------------------
+
+
+def test_ndim_limit_error_is_deferred_until_after_transport():
+    """Rank 0 holds a 9-dim array (over the descriptor limit); rank 1 gathers
+    normally. Both ranks must complete the SAME transport rounds, then rank 0
+    raises. Before the fix rank 0 raised before the descriptor round and
+    rank 1 hung mid-collective."""
+    bad = jnp.zeros((1,) * 9, jnp.float32)
+    good = jnp.asarray([1.0, 2.0], jnp.float32)
+    results, errors, calls = run_rank_fns(
+        [lambda: gather_all_arrays(bad), lambda: gather_all_arrays(good)]
+    )
+    assert isinstance(errors[0], ValueError) and "supports up to" in str(errors[0])
+    assert errors[1] is None
+    assert calls[0] == calls[1], calls  # identical round count on both ranks
+    # the bad rank participated as an EMPTY member: rank 1 sees a 0-length
+    # contribution aligned to its own dtype, plus its own data intact
+    got = results[1]
+    assert np.asarray(got[0]).size == 0
+    np.testing.assert_array_equal(np.asarray(got[1]), [1.0, 2.0])
+
+
+def test_unsupported_dtype_error_is_deferred_until_after_transport():
+    bad = jnp.zeros((3,), jnp.complex64)
+    good = jnp.asarray([4.0], jnp.float32)
+    results, errors, calls = run_rank_fns(
+        [lambda: gather_all_arrays(bad), lambda: gather_all_arrays(good)]
+    )
+    assert isinstance(errors[0], ValueError) and "cannot align dtype" in str(errors[0])
+    assert errors[1] is None
+    assert calls[0] == calls[1], calls
+    np.testing.assert_array_equal(np.asarray(results[1][1]), [4.0])
+
+
+def test_bad_leaf_inside_bundle_defers_and_peers_complete():
+    """One bad leaf inside a multi-leaf bundle: the rank's OTHER leaves are
+    still shipped (peers decode them), the rounds stay aligned, the raise
+    lands after."""
+
+    def rank0():
+        return gather_all_pytrees(
+            [{"ok": jnp.asarray([1.0], jnp.float32), "bad": jnp.zeros((2,), jnp.complex64)}]
+        )
+
+    def rank1_valid():  # rank 1's "bad" leaf is valid, so only rank 0 errors
+        return gather_all_pytrees(
+            [{"ok": jnp.asarray([2.0], jnp.float32), "bad": jnp.asarray([9.0], jnp.float32)}]
+        )
+
+    results, errors, calls = run_rank_fns([rank0, rank1_valid])
+    assert isinstance(errors[0], ValueError) and "cannot align dtype" in str(errors[0])
+    assert errors[1] is None
+    assert calls[0] == calls[1], calls
+    got = results[1][0]
+    np.testing.assert_array_equal(np.asarray(got["ok"][0]), [1.0])  # rank 0's good leaf arrived
+    np.testing.assert_array_equal(np.asarray(got["ok"][1]), [2.0])
+    assert np.asarray(got["bad"][0]).size == 0  # rank 0's bad leaf became empty
+
+
+# ---------------------------------------------------------------------------
+# list-state dtype restore (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+class IntCatMetric(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("rows", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.rows.append(jnp.asarray(x, jnp.int32))
+
+    def compute(self):
+        from metrics_tpu.utilities.data import dim_zero_cat
+
+        return dim_zero_cat(self.rows)
+
+
+def test_all_empty_sync_restores_list_state_dtype():
+    """A list state holding ZERO-ROW int32 data synced against all-empty
+    peers must come back int32 — not silently flipped to the float32
+    0-length placeholder (the gather's alignment keeps rank 0's dtype, which
+    may be the placeholder's)."""
+    m = IntCatMetric(
+        # peer rank 0 never updated: its contribution is the f32 placeholder,
+        # and it sorts FIRST in the gathered list
+        dist_sync_fn=lambda x, group=None: [jnp.zeros((0,), jnp.float32), x]
+    )
+    m.update(jnp.zeros((0,), jnp.int32))  # updated, but with an empty batch
+    with m.sync_context(dist_sync_fn=m.dist_sync_fn):
+        synced = m.rows
+        assert np.asarray(synced).dtype == np.int32, np.asarray(synced).dtype
+        assert np.asarray(synced).size == 0
+
+
+def test_all_empty_sync_restores_dtype_on_packed_transport():
+    """Same regression through the real packed transport: both ranks hold
+    zero-row data, rank 0 never updated (f32 placeholder), rank 1 declared
+    int32 — after sync each rank's state keeps ITS declared dtype."""
+
+    def rank0():
+        m = IntCatMetric()
+        # never updated: placeholder rides the gather (distributed_available
+        # is injected — the threaded fake patches the module, not the
+        # parameter default metric.py captured)
+        with m.sync_context(distributed_available=lambda: True):
+            return np.asarray(m.rows).dtype if not isinstance(m.rows, list) else None
+
+    def rank1():
+        m = IntCatMetric()
+        m.update(jnp.zeros((0,), jnp.int32))
+        with m.sync_context(distributed_available=lambda: True):
+            return np.asarray(m.rows).dtype if not isinstance(m.rows, list) else None
+
+    results, errors, _ = run_rank_fns([rank0, rank1])
+    assert errors == [None, None]
+    assert results[1] == np.int32, results  # declared dtype restored
+    assert results[0] == np.float32, results  # nothing declared; placeholder
+
+
+# ---------------------------------------------------------------------------
+# collection-level end-to-end: the acceptance criterion
+# ---------------------------------------------------------------------------
+
+
+def _make_collection():
+    from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+
+    NC = 3
+    return MetricCollection(
+        [
+            Accuracy(),
+            Precision(average="macro", num_classes=NC),
+            Recall(average="macro", num_classes=NC),
+            F1(average="macro", num_classes=NC),
+        ]
+    )
+
+
+def test_collection_eager_sync_is_exactly_two_transport_rounds():
+    """The acceptance criterion: a whole MetricCollection's eager epoch-end
+    sync issues exactly 2 ``process_allgather`` transport rounds total (one
+    descriptor + one payload for the packed bundle of every member), with
+    results bit-identical to the sequential oracle."""
+    NC = 3
+    rng = np.random.RandomState(0)
+    probs = rng.rand(2, 32, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.randint(0, NC, (2, 32))
+
+    def make_rank(rank):
+        def run():
+            coll = _make_collection()
+            coll.update(jnp.asarray(probs[rank]), jnp.asarray(target[rank]))
+            return {k: np.asarray(v) for k, v in coll.compute().items()}
+
+        return run
+
+    results, errors, calls = run_rank_fns([make_rank(0), make_rank(1)])
+    assert errors == [None, None]
+    assert calls == [2, 2], calls
+
+    oracle = _make_collection()
+    oracle.update(
+        jnp.asarray(np.concatenate([probs[0], probs[1]])),
+        jnp.asarray(np.concatenate([target[0], target[1]])),
+    )
+    want = {k: np.asarray(v) for k, v in oracle.compute().items()}
+    for res in results:
+        for key in want:
+            np.testing.assert_array_equal(res[key], want[key], err_msg=key)
+
+
+def test_collection_sync_restores_local_state_and_flags():
+    """After the packed collection compute, every member's local (unsynced)
+    states and sync flags are restored so accumulation can continue."""
+    rng = np.random.RandomState(1)
+    NC = 3
+    probs = rng.rand(2, 16, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.randint(0, NC, (2, 16))
+
+    def run():
+        coll = _make_collection()
+        coll.update(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+        before = {n: {k: np.asarray(v) for k, v in m._get_states().items() if not isinstance(v, list)}
+                  for n, m in coll.items(keep_base=True)}
+        coll.compute()
+        after = {n: {k: np.asarray(v) for k, v in m._get_states().items() if not isinstance(v, list)}
+                 for n, m in coll.items(keep_base=True)}
+        flags = [m._to_sync for _, m in coll.items(keep_base=True)]
+        return before, after, flags
+
+    results, errors, _ = run_rank_fns([run, run])
+    assert errors == [None, None]
+    for before, after, flags in results:
+        assert all(flags)
+        for n in before:
+            for k in before[n]:
+                np.testing.assert_array_equal(before[n][k], after[n][k], err_msg=f"{n}.{k}")
+
+
+def test_collection_member_with_custom_gather_keeps_per_leaf_path():
+    """A member with an injected dist_sync_fn is excluded from the packed
+    bundle and syncs itself through its own gather."""
+    from metrics_tpu import MetricCollection
+    from tests.helpers.testers import DummyMetricSum
+
+    seen = []
+
+    def spy_gather(x, group=None):
+        seen.append(np.asarray(x))
+        return [x, x]
+
+    custom = DummyMetricSum(dist_sync_fn=spy_gather)
+    plain = DummyMetricSum()
+    coll = MetricCollection({"custom": custom, "plain": plain})
+    custom.update(jnp.asarray(3.0))
+    plain.update(jnp.asarray(2.0))
+
+    def run():
+        return {k: float(v) for k, v in coll.compute().items()}
+
+    results, errors, calls = run_rank_fns([run])
+    assert errors == [None]
+    assert len(seen) == 1  # the custom gather ran, per-leaf
+    assert results[0]["custom"] == 6.0
+    assert results[0]["plain"] == 2.0  # single simulated rank: world of 1 via packed rounds
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_bundles_match_per_leaf(seed):
+    """Random multi-tree bundles (mixed dtypes/shapes/empties) must decode to
+    exactly what the per-leaf protocol produces."""
+    rng = np.random.RandomState(3000 + seed)
+    nprocs = int(rng.randint(2, 4))
+    n_leaves = int(rng.randint(2, 6))
+    specs = []
+    for _ in range(n_leaves):
+        trailing = tuple(rng.randint(1, 4, size=rng.randint(0, 2)))
+        dtype = rng.choice([np.float32, np.int32, np.int64])
+        specs.append((trailing, dtype))
+    per_rank = []
+    for r in range(nprocs):
+        tree = {}
+        for j, (trailing, dtype) in enumerate(specs):
+            rows = int(rng.randint(0, 4))
+            if rows == 0:
+                tree[f"l{j}"] = np.zeros((0,), np.float32)
+            else:
+                tree[f"l{j}"] = (np.asarray(rng.rand(rows, *trailing)) * 50).astype(dtype)
+        per_rank.append(tree)
+
+    packed, errors, calls = run_rank_fns(
+        [lambda r=r: gather_all_pytrees([per_rank[r]]) for r in range(nprocs)]
+    )
+    assert errors == [None] * nprocs, errors
+    assert all(c <= 2 for c in calls), calls
+
+    def leafwise(r):
+        return {k: gather_all_arrays(jnp.asarray(v)) for k, v in per_rank[r].items()}
+
+    per_leaf, errors2, _ = run_rank_fns([lambda r=r: leafwise(r) for r in range(nprocs)])
+    assert errors2 == [None] * nprocs, errors2
+    for p, l in zip(packed, per_leaf):
+        for k in l:
+            for g, w in zip(p[0][k], l[k]):
+                g, w = np.asarray(g), np.asarray(w)
+                assert g.dtype == w.dtype and g.shape == w.shape
+                np.testing.assert_array_equal(g, w)

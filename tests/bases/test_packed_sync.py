@@ -1,0 +1,377 @@
+"""Packed (bucketed) in-graph sync: equivalence with the per-leaf path and
+the collective-count guarantees.
+
+``sync_state_packed`` groups state leaves by (collective kind, dtype) and
+issues one collective per bucket — DDP-gradient-bucketing/Horovod-tensor-
+fusion applied to metric state. These tests pin:
+
+* bit-identical results vs the per-leaf ``sync_in_graph`` across mixed-dtype
+  bundles (f32/i32/bf16), list states (including never-updated empty ones),
+  and callable custom reductions (which must BYPASS the buckets — their
+  contract is the per-leaf stacked gather);
+* the acceptance bound: a 10-metric classification ``MetricCollection``'s
+  in-graph sync lowers to <=4 collectives in the compiled HLO;
+* shared-update-group dedup inside ``MetricCollection.apply_compute`` — one
+  synced bundle per equivalence class rides the packed buckets;
+* the trace-time bucket-composition telemetry.
+
+Runs on the virtual 8-device CPU mesh the rest of the sync suite uses.
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
+    F1,
+    HammingDistance,
+    IoU,
+    MatthewsCorrcoef,
+    MetricCollection,
+    Precision,
+    Recall,
+    Specificity,
+    observability,
+)
+from metrics_tpu.utilities.distributed import sync_in_graph, sync_state_packed
+
+NC = 5
+WORLD = 4
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    # this environment's jax predates the top-level jax.shard_map
+    if hasattr(jax, "shard_map"):  # pragma: no cover - newer jax
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def _mesh(world=WORLD):
+    return Mesh(np.array(jax.devices()[:world]), ("data",))
+
+
+def _run_sync(sync_fn, per_rank_states, reductions, world=WORLD):
+    """Run ``sync_fn(state, reductions, "data")`` over a virtual mesh, one
+    rank per device, and return the (replicated) synced pytree."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *per_rank_states)
+
+    def body(state):
+        state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
+        return sync_fn(state, reductions, "data")
+
+    fn = jax.jit(_shard_map(body, _mesh(world), (P("data"),), P()))
+    return fn(stacked)
+
+
+def _assert_tree_identical(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype, (x.dtype, y.dtype)
+        assert x.shape == y.shape, (x.shape, y.shape)
+        np.testing.assert_array_equal(x, y)
+
+
+def _mixed_dtype_states(rank):
+    rng = np.random.RandomState(100 + rank)
+    return {
+        "sum_f32": jnp.asarray(rng.rand(3).astype(np.float32)),
+        "sum_i32": jnp.asarray(rng.randint(0, 9, (2, 2)), jnp.int32),
+        "sum_bf16": jnp.asarray(rng.rand(4).astype(np.float32)).astype(jnp.bfloat16),
+        "peak": jnp.asarray(rng.rand(3).astype(np.float32)),
+        "low": jnp.asarray(float(rank), jnp.float32),
+        "avg": jnp.asarray(rng.rand(2).astype(np.float32)),
+        "cat_rows": jnp.asarray(rng.rand(2, 3).astype(np.float32)),
+        "gathered": jnp.asarray(rng.randint(0, 5, (2,)), jnp.int32),
+        "lst": [jnp.asarray(rng.rand(2).astype(np.float32))],
+    }
+
+
+_MIXED_REDUCTIONS = {
+    "sum_f32": "sum",
+    "sum_i32": "sum",
+    "sum_bf16": "sum",
+    "peak": "max",
+    "low": "min",
+    "avg": "mean",
+    "cat_rows": "cat",
+    "gathered": None,
+    "lst": "cat",
+}
+
+
+def test_packed_matches_per_leaf_mixed_dtypes():
+    """Bit-identical packed vs per-leaf results on a mixed f32/i32/bf16
+    bundle spanning every string reduction plus a gather-only state."""
+    states = [_mixed_dtype_states(r) for r in range(WORLD)]
+    packed = _run_sync(sync_state_packed, states, _MIXED_REDUCTIONS)
+    per_leaf = _run_sync(sync_in_graph, states, _MIXED_REDUCTIONS)
+    _assert_tree_identical(packed, per_leaf)
+
+
+def test_packed_empty_list_state_passes_through():
+    """A never-updated (empty) list state rides through both sync paths
+    untouched while its siblings sync — traced with the empty list closed
+    over, exactly as a real never-updated accumulator reaches the sync."""
+    reductions = {"total": "sum", "vals": "cat"}
+    mesh = _mesh(2)
+
+    def body_packed(t):
+        return sync_state_packed({"total": t, "vals": []}, reductions, "data")
+
+    def body_per_leaf(t):
+        return sync_in_graph({"total": t, "vals": []}, reductions, "data")
+
+    t = jnp.asarray([1.0, 2.0])
+    got_p = jax.jit(_shard_map(body_packed, mesh, (P("data"),), P()))(t)
+    got_l = jax.jit(_shard_map(body_per_leaf, mesh, (P("data"),), P()))(t)
+    assert got_p["vals"] == [] and got_l["vals"] == []
+    np.testing.assert_array_equal(np.asarray(got_p["total"]), np.asarray(got_l["total"]))
+
+
+def test_packed_callable_reduction_bypasses_buckets():
+    """A callable dist_reduce_fx must see the stacked per-leaf gather (its
+    documented contract) — packing may not reroute it through a bucket."""
+    take_max = lambda stacked: jnp.max(stacked, axis=0)  # noqa: E731
+    reductions = {"a": "sum", "custom": take_max, "b": "sum"}
+    states = [
+        {
+            "a": jnp.asarray(float(r)),
+            "custom": jnp.asarray([float(r), 10.0 - r]),
+            "b": jnp.asarray(2.0 * r),
+        }
+        for r in range(WORLD)
+    ]
+    packed = _run_sync(sync_state_packed, states, reductions)
+    per_leaf = _run_sync(sync_in_graph, states, reductions)
+    _assert_tree_identical(packed, per_leaf)
+    np.testing.assert_array_equal(np.asarray(packed["custom"]), [WORLD - 1.0, 10.0])
+    # the two sum leaves bucket into ONE psum; the callable keeps its gather
+    mesh = _mesh(2)
+
+    def body(state):
+        state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
+        return sync_state_packed(state, reductions, "data")
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states[:2])
+    traced = jax.make_jaxpr(_shard_map(body, mesh, (P("data"),), P()))(stacked)
+    counts = _count_collective_eqns(traced.jaxpr)
+    assert counts.get("psum", 0) == 1, counts  # a+b fused into one bucket
+    assert counts.get("all_gather", 0) == 1, counts  # the callable's own gather
+
+
+def _count_collective_eqns(jaxpr, counts=None):
+    counts = {} if counts is None else counts
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("psum", "pmax", "pmin", "all_gather", "all_to_all"):
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+        for v in eqn.params.values():
+            if hasattr(v, "eqns"):
+                _count_collective_eqns(v, counts)
+            elif hasattr(v, "jaxpr"):
+                _count_collective_eqns(v.jaxpr, counts)
+    return counts
+
+
+def _ten_metric_collection():
+    return MetricCollection(
+        [
+            Accuracy(),
+            Precision(average="macro", num_classes=NC),
+            Recall(average="macro", num_classes=NC),
+            F1(average="macro", num_classes=NC),
+            Specificity(average="macro", num_classes=NC),
+            HammingDistance(),
+            ConfusionMatrix(num_classes=NC),
+            CohenKappa(num_classes=NC),
+            MatthewsCorrcoef(num_classes=NC),
+            IoU(num_classes=NC),
+        ]
+    )
+
+
+def _collective_counts(compiled_text):
+    counts = {}
+    for op in ("all-reduce", "all-gather", "all-to-all", "collective-permute"):
+        counts[op] = len(re.findall(rf"{op}(?:-start)?\(", compiled_text))
+    return counts
+
+
+def test_ten_metric_collection_sync_lowers_to_at_most_four_collectives():
+    """The acceptance bound: the whole 10-metric classification collection's
+    in-graph epoch sync compiles to <=4 collectives (one per packed bucket),
+    not one per state leaf (~14 here, 25-45 in the reference's cost model)."""
+    coll = _ten_metric_collection()
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(64, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, 64))
+    state = coll.apply_update(coll.init_state(), preds, target)
+
+    fn = jax.jit(
+        _shard_map(
+            lambda s: coll.apply_compute(s, axis_name="data"),
+            _mesh(),
+            (P(),),
+            P(),
+        )
+    )
+    compiled = fn.lower(state).compile().as_text()
+    counts = _collective_counts(compiled)
+    total = sum(counts.values())
+    assert total <= 4, counts
+    assert counts["all-gather"] == 0, counts
+
+    # and at the JAX level: exactly one collective primitive per bucket
+    traced = jax.make_jaxpr(
+        _shard_map(lambda s: coll.apply_compute(s, axis_name="data"), _mesh(), (P(),), P())
+    )(state)
+    eqn_counts = _count_collective_eqns(traced.jaxpr)
+    assert sum(eqn_counts.values()) <= 4, eqn_counts
+
+
+def test_ten_metric_collection_packed_values_match_unsharded():
+    coll = _ten_metric_collection()
+    rng = np.random.RandomState(1)
+    preds = jnp.asarray(rng.rand(64, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, 64))
+
+    def sharded(p, t):
+        state = coll.apply_update(coll.init_state(), p, t)
+        return coll.apply_compute(state, axis_name="data")
+
+    fn = jax.jit(_shard_map(sharded, _mesh(), (P("data"), P("data")), P()))
+    values = jax.tree.map(np.asarray, fn(preds, target))
+
+    seq_state = coll.apply_update(coll.init_state(), preds, target)
+    expected = jax.tree.map(np.asarray, coll.apply_compute(seq_state))
+    for key in expected:
+        np.testing.assert_allclose(values[key], expected[key], atol=1e-6, err_msg=key)
+
+
+def test_shared_update_classes_sync_one_bundle_through_buckets():
+    """P/R/F1/Specificity alias ONE stat-scores quartet and CM/Kappa/MCC/IoU
+    ONE confusion matrix: the packed buckets must carry the deduped leaf
+    count (13 for the 10-metric collection), not every member's private
+    copy (28)."""
+    observability.reset()
+    observability.enable()
+    coll = _ten_metric_collection()
+    rng = np.random.RandomState(2)
+    preds = jnp.asarray(rng.rand(64, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, 64))
+    state = coll.apply_update(coll.init_state(), preds, target)
+    jax.make_jaxpr(
+        _shard_map(lambda s: coll.apply_compute(s, axis_name="data"), _mesh(), (P(),), P())
+    )(state)
+    ig = observability.snapshot()["sync"]["in_graph"]
+    observability.reset()
+    assert ig["collectives_before"] == 14, ig  # 13 deduped leaves + Accuracy's pmax
+    assert ig["collectives_after"] <= 4, ig
+    assert sum(ig["buckets"].values()) == 14, ig
+    assert all("/" in label for label in ig["buckets"]), ig
+
+
+def test_packed_telemetry_bucket_composition():
+    observability.reset()
+    observability.enable()
+    reductions = {"a": "sum", "b": "sum", "peak": "max", "rows": "cat"}
+    states = [
+        {
+            "a": jnp.asarray(1.0 * r, jnp.float32),
+            "b": jnp.asarray([2.0 * r], jnp.float32),
+            "peak": jnp.asarray(float(r), jnp.float32),
+            "rows": jnp.asarray([[float(r)]], jnp.float32),
+        }
+        for r in range(2)
+    ]
+    _run_sync(sync_state_packed, states, reductions, world=2)
+    ig = observability.snapshot()["sync"]["in_graph"]
+    observability.reset()
+    assert ig["buckets"] == {"psum/float32": 2, "pmax/float32": 1, "all_gather/float32": 1}, ig
+    assert ig["collectives_before"] == 4 and ig["collectives_after"] == 3, ig
+    assert ig["collectives"] == {"psum": 2, "pmax": 1, "all_gather": 1}, ig
+
+
+def test_capacity_auroc_packed_sync_is_bounded():
+    """Cat-capacity states (buffer f32 + count i32) pack into one all_gather
+    bucket per dtype — bounded, never one per accumulated batch."""
+    auroc = AUROC(capacity=256)
+    rng = np.random.RandomState(2)
+    preds = jnp.asarray(rng.rand(64).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, 64))
+    state = auroc.apply_update(auroc.init_state(), preds, target)
+
+    traced = jax.make_jaxpr(
+        _shard_map(
+            lambda s: auroc.sync_state(s, "data"),
+            _mesh(),
+            (P(),),
+            P(),
+        )
+    )(state)
+    counts = _count_collective_eqns(traced.jaxpr)
+    assert counts.get("all_gather", 0) <= 2, counts
+    assert counts.get("psum", 0) <= 1, counts
+
+
+def test_apply_forward_on_step_packs_across_members():
+    """dist_sync_on_step members — class bundles AND singles — share the
+    packed buckets for the on-step value sync, and the values match the
+    unsharded oracle."""
+    members = dict(average="macro", num_classes=NC, dist_sync_on_step=True)
+    coll = MetricCollection(
+        [Precision(**members), Recall(**members), F1(**members), Accuracy(dist_sync_on_step=True)]
+    )
+    rng = np.random.RandomState(3)
+    preds = jnp.asarray(rng.rand(64, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, 64))
+
+    def fwd(p, t):
+        _, values = coll.apply_forward(coll.init_state(), p, t, axis_name="data")
+        return values
+
+    traced = jax.make_jaxpr(_shard_map(fwd, _mesh(), (P("data"), P("data")), P()))(preds, target)
+    eqn_counts = _count_collective_eqns(traced.jaxpr)
+    # one P/R/F1 quartet + Accuracy's 6 psum + 1 pmax state: 2 buckets
+    assert sum(eqn_counts.values()) <= 2, eqn_counts
+
+    fn = jax.jit(_shard_map(fwd, _mesh(), (P("data"), P("data")), P()))
+    values = jax.tree.map(np.asarray, fn(preds, target))
+    seq_state = coll.apply_update(coll.init_state(), preds, target)
+    expected = jax.tree.map(np.asarray, coll.apply_compute(seq_state))
+    for key in expected:
+        np.testing.assert_allclose(values[key], expected[key], atol=1e-6, err_msg=key)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_packed_equivalence_random_bundles(seed):
+    """Random mixed bundles (dtypes, ranks, reductions): packed must stay
+    bit-identical to per-leaf."""
+    rng = np.random.RandomState(2000 + seed)
+    reductions, per_rank = {}, [{} for _ in range(WORLD)]
+    for i in range(int(rng.randint(3, 9))):
+        name = f"s{i}"
+        fx = rng.choice(["sum", "max", "min", "mean", "cat", "none"])
+        reductions[name] = None if fx == "none" else str(fx)
+        dtype = rng.choice([np.float32, np.int32, np.float64])
+        if reductions[name] in ("mean",):
+            dtype = np.float32  # mean over ints differs per-leaf too; keep float
+        shape = tuple(rng.randint(1, 4, size=rng.randint(0, 3)))
+        for r in range(WORLD):
+            data = (np.asarray(rng.rand(*shape)) * 8).astype(dtype)
+            per_rank[r][name] = jnp.asarray(data)
+    packed = _run_sync(sync_state_packed, per_rank, reductions)
+    per_leaf = _run_sync(sync_in_graph, per_rank, reductions)
+    _assert_tree_identical(packed, per_leaf)

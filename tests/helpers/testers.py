@@ -86,12 +86,11 @@ def sharded_compute(metric: Metric, rank_metrics: Sequence[Metric]) -> Any:
         return fn(stacked)
 
     # curve-style metrics (dynamic epoch-end math): collectives in-graph,
-    # final compute eager — the same split a real deployment uses
+    # final compute eager — the same split a real deployment uses; the
+    # shipped sync path is the packed (bucketed) engine behind sync_state
     def _sync(state):
         state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
-        from metrics_tpu.utilities.distributed import sync_in_graph
-
-        return sync_in_graph(state, metric._reductions, "procs")
+        return metric.sync_state(state, "procs")
 
     fn = jax.jit(jax.shard_map(_sync, mesh=mesh, in_specs=P("procs"), out_specs=P(), check_vma=False))
     synced = fn(stacked)

@@ -197,8 +197,11 @@ _TELEMETRY_WORKER = textwrap.dedent(
     assert counters["sync_calls"] == 1, counters
     assert counters["sync_payload_bytes"] > 0, counters
     sync = snap["sync"]
-    # one gather per fixed-shape state, each through the real transport
-    assert sync["gathers"] == len(acc._defaults), sync
+    # the packed transport: ONE gather carries every fixed-shape state
+    # (one descriptor round + one payload round for the whole bundle)
+    assert sync["gathers"] == 1, sync
+    assert sync["gather_leaves"] == len(acc._defaults), sync
+    assert sync["descriptor_rounds"] == 1 and sync["payload_rounds"] == 1, sync
     assert sync["payload_bytes_out"] > 0 and sync["payload_bytes_in"] > 0, sync
     assert sync["groups"]["0,1"]["world"] == 2, sync
 

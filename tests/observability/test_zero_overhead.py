@@ -42,6 +42,42 @@ def test_baseline_file_is_pinned():
     }
     for rec in baseline["programs"].values():
         assert rec["sha256"] and rec["jaxpr"]
+    # the packed-sync collective counts are pinned alongside the digests
+    assert set(baseline["sync_collectives"]) == {
+        "collection_sync_packed",
+        "metric_sync_packed",
+    }
+    for counts in baseline["sync_collectives"].values():
+        assert counts and all(isinstance(n, int) for n in counts.values())
+
+
+def test_packed_sync_baseline_is_bucketed_not_per_leaf():
+    """The pinned counts must reflect BUCKETED lowering: the 10-metric
+    collection (14 deduped state leaves) stays at <=4 collectives total."""
+    import json
+
+    with open(check_zero_overhead.BASELINE_PATH) as fh:
+        baseline = json.load(fh)
+    coll = baseline["sync_collectives"]["collection_sync_packed"]
+    assert sum(coll.values()) <= 4, coll
+    metric = baseline["sync_collectives"]["metric_sync_packed"]
+    assert sum(metric.values()) <= 3, metric
+
+
+def test_per_leaf_sync_regression_is_reported(tmp_path):
+    """Inflated collective counts (a regression back to per-leaf sync) must
+    surface as a violation."""
+    import json
+
+    with open(check_zero_overhead.BASELINE_PATH) as fh:
+        baseline = json.load(fh)
+    baseline["sync_collectives"]["collection_sync_packed"] = {"psum": 1}  # stale pin
+    bad = tmp_path / "baseline.json"
+    bad.write_text(json.dumps(baseline))
+    result = check_zero_overhead.check(str(bad))
+    assert any(
+        "collection_sync_packed" in v and "per-leaf" in v for v in result["violations"]
+    ), result["violations"]
 
 
 def test_digest_mismatch_is_reported(tmp_path):

@@ -134,6 +134,26 @@ def test_bench_record_schema_round_trips_json():
     assert isinstance(line["events_high_water"], int)
 
 
+def test_sync_bench_records_round_trip_with_collective_counts(monkeypatch):
+    """The packed-sync configs' records must survive json round-trips and
+    carry ``collectives_before``/``collectives_after`` — the before/after
+    evidence of the bucketed fusion — with before strictly greater."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "SYNC_STEPS", 8)
+    monkeypatch.setattr(bench_suite, "SYNC_EAGER_EPOCHS", 2)
+    for cfg in (bench_suite.bench_collection_sync_eager, bench_suite.bench_collection_sync_in_graph):
+        line = bench_suite.run_config(cfg, probe=False)
+        round_tripped = json.loads(json.dumps(line))
+        assert round_tripped == line
+        assert isinstance(line["collectives_before"], int)
+        assert isinstance(line["collectives_after"], int)
+        assert line["collectives_before"] > line["collectives_after"], line["metric"]
+        assert "telemetry" in line
+    assert "bench_collection_sync_in_graph" in bench_suite.CONFIG_META
+    assert "bench_collection_sync_eager" in bench_suite.CONFIG_META
+
+
 def test_measure_single_attempt_after_total_deadline(monkeypatch):
     calls = []
     monkeypatch.setattr(
