@@ -1,8 +1,8 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights
+.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead
 
-ci: test doctest doctest-docs dryrun examples
+ci: test doctest doctest-docs dryrun examples zero-overhead
 
 # Full suite on the virtual 8-device CPU mesh (tests/conftest.py), including
 # the real 2-process jax.distributed sync test (tests/bases/test_multiprocess.py).
@@ -33,6 +33,14 @@ examples:
 	METRICS_TPU_FORCE_CPU_MESH=1 python examples/train_eval.py
 	METRICS_TPU_FORCE_CPU_MESH=1 python examples/generative_eval.py
 	METRICS_TPU_FORCE_CPU_MESH=1 python examples/distributed_train.py
+
+# Zero-overhead + zero-copy gate (scripts/check_zero_overhead.py): the
+# observability stack must add zero traced ops to the compiled hot paths,
+# the packed sync must stay bucketed, and the donated jit_forward /
+# update_many lowerings must alias every state buffer (no per-step copies).
+# Also runs inside the suite as tests/observability/test_zero_overhead.py.
+zero-overhead:
+	python scripts/check_zero_overhead.py
 
 # Full benchmark suite on the default backend (the real TPU chip under axon).
 bench:

@@ -7,7 +7,11 @@ retrieval MAP+NDCG; SSIM+PSNR+SI-SDR), the epoch-end compute configs
 kernel config run on the real TPU backend, the packed-collective sync
 configs (``collection_sync_in_graph_step`` / ``collection_sync_eager_epoch``,
 whose records carry ``collectives_before``/``collectives_after`` — the
-bucketed-fusion win), and the north-star ``train_step_metric_overhead``
+bucketed-fusion win), the donated/scan-fused stateful configs
+(``stateful_forward_donated_step`` / ``forward_scan_microbatch``, whose
+records carry ``bytes_copied_avoided`` and ``dispatches_per_update`` —
+the zero-copy and dispatch-amortization wins), and the north-star
+``train_step_metric_overhead``
 (% overhead of the 10-metric collection fused into a Flax train step,
 target <1%). The flagship collection config prints LAST, and the full line
 set is re-emitted as a final block.

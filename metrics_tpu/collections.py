@@ -9,14 +9,29 @@ and syncs inside one jitted program: XLA then fuses the per-metric psum
 collectives into a single staged bundle over the mesh, which is how a
 10-metric collection stays at ~one collective of step overhead.
 """
+import functools
+import sys
 import time
 from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Union
 
-from metrics_tpu.metric import AXIS_UNSET, Metric, StateDict, _note_compiled_dispatch, _observed_forward
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import (
+    AXIS_UNSET,
+    ArrayTypes,
+    Metric,
+    StateDict,
+    _microbatch_len,
+    _note_compiled_dispatch,
+    _observed_forward,
+)
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.observability.retrace import arg_signature
+from metrics_tpu.utilities.aot import CompiledDispatch
 from metrics_tpu.utilities.prints import rank_zero_warn
 from metrics_tpu.utilities.profiling import compiled_scope, eager_span
 
@@ -57,7 +72,12 @@ class MetricCollection:
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
         self._jit_forward_enabled = False
-        self._jit_forward_fn: Optional[Any] = None
+        self._jit_forward_fn: Optional[CompiledDispatch] = None
+        self._jit_forward_donate = True
+        self._jit_forward_copy_fn: Optional[CompiledDispatch] = None
+        self._update_many_fn: Optional[CompiledDispatch] = None
+        self._update_many_copy_fn: Optional[CompiledDispatch] = None
+        self._donation_warned = False
 
     # ------------------------------------------------------------------
     # stateful interface
@@ -108,19 +128,22 @@ class MetricCollection:
             else:
                 m.update(*args, **m._filter_kwargs(**kwargs))
 
-    def jit_forward(self, enable: bool = True) -> "MetricCollection":
+    def jit_forward(self, enable: bool = True, donate: bool = True) -> "MetricCollection":
         """Compile the collection's stateful ``forward`` into ONE XLA program.
 
-        Same contract and trades as :meth:`Metric.jit_forward` (host-side
-        value validation skipped, one recompile per new input shape), with
-        the collection-level wins on top: the shared-update classes
-        canonicalize once inside the single program, and XLA fuses across
-        members. Every member must individually satisfy the
+        Same contract and trades as :meth:`Metric.jit_forward` — including
+        **state donation**: the single executable donates the whole
+        collection state pytree, so every member's buffers update in place
+        (``donate=False`` opts out; an externally-held member state falls
+        back to the copying executable for that step, with a one-shot
+        warning). The collection-level wins ride on top: the shared-update
+        classes canonicalize once inside the single program, and XLA fuses
+        across members. Every member must individually satisfy the
         :meth:`Metric.jit_forward` constraints (no unbounded list states, no
         ``dist_sync_on_step``)."""
         if not enable:
             self._jit_forward_enabled = False
-            self._jit_forward_fn = None
+            self._drop_compiled_dispatch()
             return self
         for name, m in self.items(keep_base=True):
             try:
@@ -130,21 +153,90 @@ class MetricCollection:
             except ValueError as err:
                 raise ValueError(f"member {name!r}: {err}") from None
         self._jit_forward_enabled = True
-        self._jit_forward_fn = None
+        self._jit_forward_donate = bool(donate)
+        self._drop_compiled_dispatch()
         return self
 
-    def _forward_jitted(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        import functools
-        import time
+    def _drop_compiled_dispatch(self) -> None:
+        """Invalidate every cached compiled-dispatch executable (member set
+        or donation flag changed, enablement toggled, unpickled copy)."""
+        self._jit_forward_fn = None
+        self._jit_forward_copy_fn = None
+        self._update_many_fn = None
+        self._update_many_copy_fn = None
 
-        import jax
-
+    def _forward_dispatch(self) -> CompiledDispatch:
         if self._jit_forward_fn is None:
-            self._jit_forward_fn = jax.jit(functools.partial(self.apply_forward, axis_name=None))
+            self._jit_forward_fn = CompiledDispatch(
+                functools.partial(self.apply_forward, axis_name=None),
+                donate_state=self._jit_forward_donate,
+            )
             self._jit_cache_seen = 0
-        state = {name: m._get_states() for name, m in self.items(keep_base=True)}
+        return self._jit_forward_fn
+
+    def _forward_copy_dispatch(self) -> CompiledDispatch:
+        if self._jit_forward_copy_fn is None:
+            self._jit_forward_copy_fn = CompiledDispatch(
+                functools.partial(self.apply_forward, axis_name=None), donate_state=False
+            )
+        return self._jit_forward_copy_fn
+
+    def _donation_safe_state(
+        self, state: Dict[str, StateDict]
+    ) -> Tuple[Dict[str, StateDict], bool]:
+        """Collection-wide :meth:`Metric._donation_safe_state`: default-aliased
+        member leaves are defensively copied; ANY externally-held member leaf
+        sends the whole dispatch to the copying executable (the executable is
+        one program — donation is all-or-nothing per step)."""
+        aliased = None
+        for name, m in self.items(keep_base=True):
+            member = state[name]
+            for sname in member:
+                v = member[sname]
+                if not isinstance(v, ArrayTypes):
+                    continue
+                if v is m._defaults.get(sname):
+                    member[sname] = jnp.asarray(v).copy()
+                    continue
+                # expected references: the member's attribute slot, this
+                # member-state dict, the loop variable, getrefcount's argument
+                if sys.getrefcount(v) > 4:
+                    aliased = f"{name}.{sname}"
+                    break
+            if aliased is not None:
+                break
+        if aliased is None:
+            return state, True
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "jit_forward_alias_fallbacks")
+        if not self.__dict__.get("_donation_warned", False):
+            self._donation_warned = True
+            rank_zero_warn(
+                f"MetricCollection.jit_forward: member state `{aliased}` is referenced"
+                " outside its metric, so this step dispatches through the copying"
+                " executable instead of donating the state buffers. Drop external"
+                " references to member states to restore zero-copy updates, or call"
+                " jit_forward(donate=False) to keep the copying path silently.",
+                UserWarning,
+            )
+        return state, False
+
+    def _forward_jitted(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        fn = self._forward_dispatch()
+        state = {}
+        for name, m in self.items(keep_base=True):
+            # invalidated by the incoming batch anyway; clearing BEFORE the
+            # alias check keeps a cached compute() result that aliases a
+            # state leaf from being donated out from under a caller
+            m._computed = None
+            m._forward_cache = None
+            state[name] = m._get_states()
+        if fn.donate_state:
+            state, donatable = self._donation_safe_state(state)
+            if not donatable:
+                fn = self._forward_copy_dispatch()
         start = time.perf_counter() if EVENTS.enabled else None
-        new_state, values = self._jit_forward_fn(state, *args, **kwargs)
+        new_state, values = fn(state, *args, **kwargs)
         if start is not None:
             EVENTS.record(
                 "forward",
@@ -153,12 +245,14 @@ class MetricCollection:
                 t_start=start,
                 path="compiled",
                 members=len(self._metrics),
+                compiled_this_call=bool(fn.last_compiled),
+                donated=fn.donate_state,
             )
         record = TELEMETRY.enabled
         if record:
             # one compiled program serves every member: the collection key
             # carries the compile/retrace ledger, members count the dispatch
-            _note_compiled_dispatch(self, self._jit_forward_fn, args, kwargs)
+            _note_compiled_dispatch(self, fn, args, kwargs)
         for name, m in self.items(keep_base=True):
             m._set_states(new_state[name])
             m._update_called = True
@@ -171,19 +265,145 @@ class MetricCollection:
             m._forward_cache = values[self._set_name(name)]
         return values
 
+    def warmup(self, *sample_batch: Any, **kwargs: Any) -> Dict[str, Any]:
+        """AOT lower+compile the collection's single ``jit_forward``
+        executable for this batch shape (see :meth:`Metric.warmup`):
+        first-step latency becomes a deliberate, observable ``compile``
+        event instead of a surprise inside step 0. Enables
+        :meth:`jit_forward` if not already enabled. Returns the cost report
+        for the compiled collection program."""
+        if not self._jit_forward_enabled:
+            self.jit_forward(donate=self._jit_forward_donate)
+        fn = self._forward_dispatch()
+        state = {name: m._get_states() for name, m in self.items(keep_base=True)}
+        start = time.perf_counter()
+        compiled, fresh = fn.warm(state, *sample_batch, **kwargs)
+        key = self.telemetry_key
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(key, "warmup_calls")
+            if fresh:
+                TELEMETRY.inc(key, "warmup_compiles")
+        if EVENTS.enabled:
+            EVENTS.record(
+                "compile",
+                key,
+                dur_s=fn.last_compile_s,
+                t_start=start,
+                path="warmup",
+                fresh=fresh,
+                donated=fn.donate_state,
+                members=len(self._metrics),
+                signature=arg_signature(*sample_batch, **kwargs),
+            )
+        from metrics_tpu.observability.cost import executable_cost
+
+        return {
+            "metric": type(self).__name__,
+            "members": len(self._metrics),
+            "compiled_this_call": fresh,
+            "compile_seconds": round(fn.last_compile_s, 6),
+            "donated": fn.donate_state,
+            "executables_cached": fn._cache_size(),
+            "forward": executable_cost(compiled),
+            "state_memory": self.state_memory_report(),
+        }
+
+    def _scan_update_many(
+        self, state: Dict[str, StateDict], stacked: Tuple, stacked_kwargs: Dict
+    ) -> Dict[str, StateDict]:
+        """One ``lax.scan`` of the collection's shared :meth:`apply_update`
+        over the stacked leading axis (see :meth:`Metric._scan_update_many`)."""
+        leaves, treedef = jax.tree_util.tree_flatten((stacked, stacked_kwargs))
+        scanned_ix = [i for i, leaf in enumerate(leaves) if getattr(leaf, "ndim", 0) >= 1]
+
+        def body(s: Dict[str, StateDict], xs: Tuple) -> Tuple[Dict[str, StateDict], None]:
+            merged = list(leaves)
+            for i, x in zip(scanned_ix, xs):
+                merged[i] = x
+            args, kw = jax.tree_util.tree_unflatten(treedef, merged)
+            return self.apply_update(s, *args, **kw), None
+
+        new_state, _ = jax.lax.scan(body, state, tuple(leaves[i] for i in scanned_ix))
+        return new_state
+
+    def update_many(self, *stacked: Any, **stacked_kwargs: Any) -> None:
+        """Accumulate K stacked micro-batches across EVERY member in ONE
+        compiled dispatch (see :meth:`Metric.update_many`): a single
+        ``lax.scan`` of the collection's shared update — shared-update
+        classes canonicalize once per micro-batch inside it — over the
+        donated collection state. One dispatch amortized over K × members
+        updates; works with or without :meth:`jit_forward` enabled."""
+        for name, m in self.items(keep_base=True):
+            try:
+                m._compiled_state_gate()
+            except ValueError as err:
+                raise ValueError(f"member {name!r}: {err}") from None
+        k = _microbatch_len(stacked, stacked_kwargs)
+        state = {}
+        for name, m in self.items(keep_base=True):
+            m._computed = None
+            m._forward_cache = None
+            state[name] = m._get_states()
+        donatable = True
+        if self._jit_forward_donate:
+            state, donatable = self._donation_safe_state(state)
+        if donatable and self._jit_forward_donate:
+            if self._update_many_fn is None:
+                self._update_many_fn = CompiledDispatch(self._scan_update_many, donate_state=True)
+            fn = self._update_many_fn
+        else:
+            if self._update_many_copy_fn is None:
+                self._update_many_copy_fn = CompiledDispatch(
+                    self._scan_update_many, donate_state=False
+                )
+            fn = self._update_many_copy_fn
+        start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
+        new_state = fn(state, stacked, stacked_kwargs)
+        if start is not None:
+            dur = time.perf_counter() - start
+            key = self.telemetry_key
+            if TELEMETRY.enabled:
+                TELEMETRY.inc(key, "update_many_calls")
+                TELEMETRY.inc(key, "update_many_batches", k)
+                _note_compiled_dispatch(
+                    self, fn, stacked, stacked_kwargs, counter="update_many_dispatches"
+                )
+            if EVENTS.enabled:
+                EVENTS.record(
+                    "update",
+                    key,
+                    dur_s=dur,
+                    t_start=start,
+                    path="scan_microbatch",
+                    batches=k,
+                    members=len(self._metrics),
+                    compiled_this_call=bool(fn.last_compiled),
+                    donated=fn.donate_state,
+                )
+        for name, m in self.items(keep_base=True):
+            m._set_states(new_state[name])
+            m._update_called = True
+            m._computed = None
+
     def __getstate__(self) -> dict:
         return {
             k: v
             for k, v in self.__dict__.items()
-            if k not in ("_jit_forward_fn", "_telemetry_key", "_jit_cache_seen")
+            if k not in ("_jit_forward_fn", "_jit_forward_copy_fn", "_update_many_fn",
+                         "_update_many_copy_fn", "_telemetry_key", "_jit_cache_seen",
+                         "_donation_warned")
         }
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)
         # pickles from before the compiled stateful forward (0.4.0) predate
-        # this flag; default it off so their first forward() stays eager
+        # this flag; default it off so their first forward() stays eager.
+        # Donation (0.6.0) defaults on for enabled pickles — enablement
+        # survives, the executable cache is rebuilt on first dispatch.
         self.__dict__.setdefault("_jit_forward_enabled", False)
-        self._jit_forward_fn = None
+        self.__dict__.setdefault("_jit_forward_donate", True)
+        self._donation_warned = False
+        self._drop_compiled_dispatch()
 
     def _class_groups(self) -> Dict[Tuple, list]:
         """Member names per shared-update equivalence key (insertion order)."""
@@ -666,6 +886,10 @@ class MetricCollection:
     ) -> None:
         before = set(self._metrics) if getattr(self, "_jit_forward_enabled", False) else None
         self._add_metrics(metrics, *additional_metrics)
+        # any cached update_many executable baked in the OLD member set too —
+        # and it exists independently of jit_forward enablement
+        self._update_many_fn = None
+        self._update_many_copy_fn = None
         if before is not None:
             # a previously-built jitted forward baked in the OLD member set;
             # keeping it would silently drop the new members from every step.
@@ -673,6 +897,7 @@ class MetricCollection:
             # atomically: an ineligible addition is rolled back, so the
             # documented ValueError fires instead of a per-step retrace.
             self._jit_forward_fn = None
+            self._jit_forward_copy_fn = None
             new_names = [n for n in self._metrics if n not in before]
             for name in new_names:
                 try:
@@ -746,6 +971,9 @@ class MetricCollection:
             # in the replaced member's update
             value._jit_forward_gate()
             self._jit_forward_fn = None
+            self._jit_forward_copy_fn = None
+        self._update_many_fn = None
+        self._update_many_copy_fn = None
         self._metrics[key] = value
 
     def __contains__(self, key: str) -> bool:

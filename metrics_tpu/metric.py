@@ -31,6 +31,7 @@ Capability parity with the reference's ``torchmetrics/metric.py`` (the
 import functools
 import inspect
 import os
+import sys
 import time
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
@@ -54,6 +55,7 @@ from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.health import HEALTH, MetricHealthError, guard_state  # noqa: F401
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import MONITOR, arg_signature, is_tracing
+from metrics_tpu.utilities.aot import CompiledDispatch
 from metrics_tpu.utilities.distributed import (
     distributed_available,
     gather_all_arrays,
@@ -132,13 +134,25 @@ def _observed_forward(obj: Any, counter: str, thunk: Callable) -> Any:
             EVENTS.record("forward", key, dur_s=dur, t_start=start, path=counter)
 
 
-def _note_compiled_dispatch(obj: Any, fn: Any, args: Tuple, kwargs: Dict) -> None:
-    """Telemetry for one dispatch of a cached jitted forward: count the call
-    and detect fresh XLA compiles via jit cache-size deltas. A growth in the
-    cache means THIS call's signature forced a recompile — it is recorded (and
-    warned about past the threshold) with that signature."""
+def _note_compiled_dispatch(
+    obj: Any, fn: Any, args: Tuple, kwargs: Dict, counter: str = "forward_compiled_calls"
+) -> None:
+    """Telemetry for one dispatch of a cached compiled forward: count the
+    call and record fresh XLA compiles. The :class:`CompiledDispatch` cache
+    reports a compile exactly (``last_compiled``); a plain jit fallback is
+    inferred from cache-size deltas. A fresh compile means THIS call's
+    signature forced it — it is recorded (and warned about past the
+    threshold) with that signature. AOT warmup compiles are deliberate and
+    bypass this path entirely (``Metric.warmup`` counts them separately)."""
     key = obj.telemetry_key
-    TELEMETRY.inc(key, "forward_compiled_calls")
+    TELEMETRY.inc(key, counter)
+    fresh = getattr(fn, "last_compiled", None)
+    if fresh is not None:
+        if fresh:
+            obj._jit_cache_seen = obj.__dict__.get("_jit_cache_seen", 0) + 1
+            TELEMETRY.inc(key, "jit_forward_compiles")
+            MONITOR.note_compile(key, arg_signature(*args, **kwargs), count=1)
+        return
     cache_size = getattr(fn, "_cache_size", None)
     if cache_size is None:  # pragma: no cover - private jit API moved
         return
@@ -151,6 +165,32 @@ def _note_compiled_dispatch(obj: Any, fn: Any, args: Tuple, kwargs: Dict) -> Non
         obj._jit_cache_seen = size
         TELEMETRY.inc(key, "jit_forward_compiles", size - seen)
         MONITOR.note_compile(key, arg_signature(*args, **kwargs), count=size - seen)
+
+
+def _microbatch_len(args: Tuple, kwargs: Dict) -> int:
+    """The micro-batch count K of an ``update_many`` call: the shared leading
+    axis of every stacked array argument. Scalar (0-d, python-number, bool)
+    leaves broadcast to all K micro-batches and don't vote."""
+    import jax
+
+    lengths = set()
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        shape = getattr(leaf, "shape", None)
+        if shape is None or len(shape) == 0:
+            continue
+        lengths.add(int(shape[0]))
+    if not lengths:
+        raise ValueError(
+            "update_many expects at least one stacked array argument whose leading"
+            " axis is the micro-batch count K"
+        )
+    if len(lengths) > 1:
+        raise ValueError(
+            "update_many: stacked arguments disagree on the micro-batch count"
+            f" (leading axes {sorted(lengths)}); every array argument must carry"
+            " the same leading K"
+        )
+    return lengths.pop()
 
 
 class Metric(ABC):
@@ -212,7 +252,12 @@ class Metric(ABC):
         self._forward_cache = None
         self._update_called = False
         self._jit_forward_enabled = False
-        self._jit_forward_fn: Optional[Callable] = None
+        self._jit_forward_fn: Optional[CompiledDispatch] = None
+        self._jit_forward_donate = True
+        self._jit_forward_copy_fn: Optional[CompiledDispatch] = None
+        self._update_many_fn: Optional[CompiledDispatch] = None
+        self._update_many_copy_fn: Optional[CompiledDispatch] = None
+        self._donation_warned = False
 
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
@@ -516,60 +561,80 @@ class Metric(ABC):
                 self, "forward_double_update_calls", lambda: self._forward_double_update(*args, **kwargs)
             )
 
-    def jit_forward(self, enable: bool = True) -> "Metric":
+    def jit_forward(self, enable: bool = True, donate: bool = True) -> "Metric":
         """Compile the stateful ``forward`` into one XLA program (opt-in).
 
         The default eager ``m(preds, target)`` dispatches each jnp op to the
         backend individually — convenient and fully validated, but host-bound
         (milliseconds per step of pure dispatch overhead). After
-        ``m.jit_forward()`` the same call runs a cached :func:`jax.jit` of the
-        pure :meth:`apply_forward`, so update + on-step value execute as one
-        compiled program (microseconds per step) behind the unchanged
+        ``m.jit_forward()`` the same call runs an AOT-compiled executable of
+        the pure :meth:`apply_forward`, so update + on-step value execute as
+        one compiled program (microseconds per step) behind the unchanged
         stateful API::
 
             acc = Accuracy().jit_forward()
+            acc.warmup(preds0, target0)          # optional: compile NOW
             for preds, target in loader:
                 batch_acc = acc(preds, target)   # one compiled step
             acc.compute()                        # epoch sync as usual
 
+        The executable **donates the state argument** (``donate_argnums=(0,)``
+        in user terms — the ``docs/performance.md`` guidance, applied to our
+        own hot path): XLA reuses the state buffers in place instead of
+        copying the full pytree every step, which is megabytes/step for
+        ``capacity=N`` curve buffers and ``FID(streaming=True)``'s O(d²)
+        moment sums. The metric owns its state arrays afterwards — a state
+        leaf still referenced outside the metric (a kept handle to
+        ``m.some_state``) is detected per dispatch and that step transparently
+        uses the copying executable instead, with a one-shot warning (counted
+        under ``jit_forward_alias_fallbacks``). ``donate=False`` opts out of
+        donation entirely (always-copying lowering, bit-identical results).
+
         The trade, inherent to tracing: host-side input *validation* is
         skipped (shape/dtype errors still surface from XLA; value checks
         like out-of-range targets do not), every new input shape pays one
-        recompile, and configuration the eager path infers from concrete
-        input VALUES must be passed explicitly — e.g. integer label
-        predictions need ``num_classes=`` at construction, or the first
-        jitted call raises the pure API's documented trace-time error.
-        Not available — raises ``ValueError`` — for metrics
-        with unbounded list states (their state pytree grows per step,
-        forcing a retrace each call; use the fixed-shape
-        ``capacity=``/``streaming=`` modes), or with
+        recompile (see :meth:`warmup` to pay it deliberately), and
+        configuration the eager path infers from concrete input VALUES must
+        be passed explicitly — e.g. integer label predictions need
+        ``num_classes=`` at construction, or the first jitted call raises
+        the pure API's documented trace-time error. Python ``bool`` (and
+        string) arguments are STATIC — baked into the executable per value,
+        the ``FID(...)(imgs, real=True)`` flag pattern. Not available —
+        raises ``ValueError`` — for metrics with unbounded list states
+        (their state pytree grows per step, forcing a retrace each call; use
+        the fixed-shape ``capacity=``/``streaming=`` modes), or with
         ``dist_sync_on_step=True`` (the eager on-step gather is host-side;
         use :meth:`apply_forward` with a mesh axis instead).
         """
         if not enable:
             self._jit_forward_enabled = False
-            self._jit_forward_fn = None
+            self._drop_compiled_dispatch()
             return self
         self._jit_forward_gate()
         self._jit_forward_enabled = True
-        self._jit_forward_fn = None
+        self._jit_forward_donate = bool(donate)
+        self._drop_compiled_dispatch()
         return self
 
-    def _jit_forward_gate(self) -> None:
-        """Raise ``ValueError`` if this metric cannot back a jitted stateful
-        forward — side-effect free, so callers (MetricCollection) can
-        validate members without touching their own enablement."""
+    def _drop_compiled_dispatch(self) -> None:
+        """Invalidate every cached compiled-dispatch executable (donation
+        flag changed, enablement toggled, unpickled copy)."""
+        self._jit_forward_fn = None
+        self._jit_forward_copy_fn = None
+        self._update_many_fn = None
+        self._update_many_copy_fn = None
+
+    def _compiled_state_gate(self) -> None:
+        """Raise ``ValueError`` if the state pytree cannot thread a compiled
+        stateful dispatch generically — shared by :meth:`jit_forward` and
+        :meth:`update_many`; side-effect free, so callers (MetricCollection)
+        can validate members without touching their own enablement."""
         if any(isinstance(v, list) for v in self._defaults.values()):
             raise ValueError(
                 f"{self.__class__.__name__} holds unbounded list states, whose pytree grows"
                 " every step under jit (a retrace per call); use the fixed-shape"
                 " `capacity=`/`streaming=` mode of this metric with jit_forward, or keep the"
                 " eager forward."
-            )
-        if self.dist_sync_on_step:
-            raise ValueError(
-                "jit_forward cannot trace the eager on-step gather of dist_sync_on_step=True;"
-                " use apply_forward with a mesh axis for compiled on-step sync."
             )
         if set(self.init_state()) != set(self._defaults):
             # wrappers like BootStrapper own a custom pure-state layout the
@@ -581,15 +646,94 @@ class Metric(ABC):
                 " API instead."
             )
 
-    def _forward_jitted(self, *args: Any, **kwargs: Any) -> Any:
+    def _jit_forward_gate(self) -> None:
+        """The :meth:`_compiled_state_gate` plus the forward-only refusal."""
+        self._compiled_state_gate()
+        if self.dist_sync_on_step:
+            raise ValueError(
+                "jit_forward cannot trace the eager on-step gather of dist_sync_on_step=True;"
+                " use apply_forward with a mesh axis for compiled on-step sync."
+            )
+
+    # -- compiled dispatch plumbing (donation + AOT executable cache) -------
+
+    def _forward_dispatch(self) -> CompiledDispatch:
         if self._jit_forward_fn is None:
             if self.compute_on_step:
-                self._jit_forward_fn = jax.jit(functools.partial(self.apply_forward, axis_name=None))
+                fn: Callable = functools.partial(self.apply_forward, axis_name=None)
             else:
-                self._jit_forward_fn = jax.jit(self.apply_update)
+                fn = self.apply_update
+            self._jit_forward_fn = CompiledDispatch(fn, donate_state=self._jit_forward_donate)
             self._jit_cache_seen = 0
+        return self._jit_forward_fn
+
+    def _forward_copy_dispatch(self) -> CompiledDispatch:
+        """The non-donating fallback executable for externally-aliased states."""
+        if self._jit_forward_copy_fn is None:
+            if self.compute_on_step:
+                fn: Callable = functools.partial(self.apply_forward, axis_name=None)
+            else:
+                fn = self.apply_update
+            self._jit_forward_copy_fn = CompiledDispatch(fn, donate_state=False)
+        return self._jit_forward_copy_fn
+
+    def _donation_safe_state(self, state: StateDict) -> Tuple[StateDict, bool]:
+        """Make ``state`` safe to donate, or report that it is not.
+
+        Two hazards. (1) A leaf that IS the registered default — a fresh or
+        just-reset metric — would, donated, invalidate every future
+        ``reset()``; such leaves are defensively copied (one copy, once per
+        epoch — exactly the copy donation saves on every other step).
+        (2) A leaf some caller still holds a handle to: donating it would
+        invalidate the caller's array mid-use, so the dispatch must fall
+        back to the copying executable. Detection is by reference count —
+        beyond the metric's own references (the attribute slot, this
+        ``state`` dict, the loop variable, and ``getrefcount``'s argument)
+        any extra reference is an external handle.
+        """
+        aliased = None
+        for name in state:
+            v = state[name]
+            if not isinstance(v, ArrayTypes):
+                continue  # list states never reach the compiled path (the gate)
+            if v is self._defaults.get(name):
+                state[name] = jnp.asarray(v).copy()
+                continue
+            if sys.getrefcount(v) > 4:
+                aliased = name
+                break
+        if aliased is None:
+            return state, True
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "jit_forward_alias_fallbacks")
+        if not self.__dict__.get("_donation_warned", False):
+            self._donation_warned = True
+            rank_zero_warn(
+                f"{self.__class__.__name__}.jit_forward: state `{aliased}` is referenced"
+                " outside the metric, so this step dispatches through the copying"
+                " executable instead of donating the state buffers (donation would"
+                " invalidate the external handle). Drop external references to metric"
+                " states to restore zero-copy updates, or call jit_forward(donate=False)"
+                " to keep the copying path silently.",
+                UserWarning,
+            )
+        return state, False
+
+    def _forward_jitted(self, *args: Any, **kwargs: Any) -> Any:
+        fn = self._forward_dispatch()
+        # ownership discipline for donation: these caches are invalidated by
+        # the incoming batch anyway; clearing them BEFORE the alias check
+        # means a cached compute() result that aliases a state leaf cannot be
+        # donated out from under a caller still holding it
+        self._computed = None
+        self._forward_cache = None
+        state = self._get_states()
+        if fn.donate_state:
+            state, donatable = self._donation_safe_state(state)
+            if not donatable:
+                fn = self._forward_copy_dispatch()
         start = time.perf_counter() if EVENTS.enabled else None
-        out = self._jit_forward_fn(self._get_states(), *args, **kwargs)
+        out = fn(state, *args, **kwargs)
         if start is not None:
             # wall time of the (async) dispatch, not the device step — the
             # device cost lives in the profiler trace this timeline rides next to
@@ -599,15 +743,160 @@ class Metric(ABC):
                 dur_s=time.perf_counter() - start,
                 t_start=start,
                 path="compiled",
+                compiled_this_call=bool(fn.last_compiled),
+                donated=fn.donate_state,
             )
         if TELEMETRY.enabled:
-            _note_compiled_dispatch(self, self._jit_forward_fn, args, kwargs)
+            _note_compiled_dispatch(self, fn, args, kwargs)
         new_state, value = out if self.compute_on_step else (out, None)
         self._set_states(new_state)
         self._update_called = True
         self._computed = None
         self._forward_cache = value
         return value
+
+    def warmup(self, *sample_batch: Any, **kwargs: Any) -> Dict[str, Any]:
+        """AOT lower+compile the ``jit_forward`` executable for this batch
+        shape, ahead of the first step.
+
+        Without warmup the first ``m(preds, target)`` after
+        :meth:`jit_forward` pays trace+compile at an uncontrolled moment
+        inside the step; ``m.warmup(*sample_batch)`` pays it here — nothing
+        executes, no state changes — records a ``compile`` timeline event,
+        and caches the executable keyed by the arguments' avals, so the
+        first real step is a cache hit. Enables :meth:`jit_forward` if not
+        already enabled (same eligibility ``ValueError``\\ s). Idempotent per
+        shape: a second warmup on the same avals is a no-op hit.
+
+        Returns the cost report of the compiled program (the
+        :meth:`cost_report` structure for the forward executable, from the
+        compiler's own ``cost_analysis`` — no extra compile), plus the
+        compile bookkeeping::
+
+            {"metric": ..., "compiled_this_call": bool, "compile_seconds": s,
+             "donated": bool, "executables_cached": n,
+             "forward": {"available": True, "flops": ..., ...},
+             "state_memory": {...}}
+        """
+        if not self._jit_forward_enabled:
+            self.jit_forward(donate=self._jit_forward_donate)
+        fn = self._forward_dispatch()
+        state = self._get_states()
+        # lowering only reads avals: no execution, no donation hazard
+        start = time.perf_counter()
+        compiled, fresh = fn.warm(state, *sample_batch, **kwargs)
+        key = self.telemetry_key
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(key, "warmup_calls")
+            if fresh:
+                TELEMETRY.inc(key, "warmup_compiles")
+        if EVENTS.enabled:
+            EVENTS.record(
+                "compile",
+                key,
+                dur_s=fn.last_compile_s,
+                t_start=start,
+                path="warmup",
+                fresh=fresh,
+                donated=fn.donate_state,
+                signature=arg_signature(*sample_batch, **kwargs),
+            )
+        from metrics_tpu.observability.cost import executable_cost
+
+        return {
+            "metric": type(self).__name__,
+            "compiled_this_call": fresh,
+            "compile_seconds": round(fn.last_compile_s, 6),
+            "donated": fn.donate_state,
+            "executables_cached": fn._cache_size(),
+            "forward": executable_cost(compiled),
+            "state_memory": self.state_memory_report(),
+        }
+
+    # -- scan-fused micro-batching ------------------------------------------
+
+    def _scan_update_many(self, state: StateDict, stacked: Tuple, stacked_kwargs: Dict) -> StateDict:
+        """Pure K-micro-batch update: one ``lax.scan`` of :meth:`apply_update`
+        over the stacked leading axis. Leaves with rank >= 1 are scanned;
+        0-d leaves (python numbers, flags) broadcast to every micro-batch."""
+        leaves, treedef = jax.tree_util.tree_flatten((stacked, stacked_kwargs))
+        scanned_ix = [i for i, leaf in enumerate(leaves) if getattr(leaf, "ndim", 0) >= 1]
+
+        def body(s: StateDict, xs: Tuple) -> Tuple[StateDict, None]:
+            merged = list(leaves)
+            for i, x in zip(scanned_ix, xs):
+                merged[i] = x
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, merged)
+            return self.apply_update(s, *args, **kwargs), None
+
+        new_state, _ = jax.lax.scan(body, state, tuple(leaves[i] for i in scanned_ix))
+        return new_state
+
+    def _update_many_dispatch(self, donatable: bool) -> CompiledDispatch:
+        if donatable and self._jit_forward_donate:
+            if self._update_many_fn is None:
+                self._update_many_fn = CompiledDispatch(self._scan_update_many, donate_state=True)
+            return self._update_many_fn
+        if self._update_many_copy_fn is None:
+            self._update_many_copy_fn = CompiledDispatch(self._scan_update_many, donate_state=False)
+        return self._update_many_copy_fn
+
+    def update_many(self, *stacked: Any, **stacked_kwargs: Any) -> None:
+        """Accumulate K stacked micro-batches in ONE compiled dispatch.
+
+        Every array argument (positional or keyword) carries a leading axis
+        of size K — ``update_many(preds_KBC, target_KB)`` is equivalent to K
+        successive ``update(preds, target)`` calls, but runs as a single
+        ``lax.scan`` over the donated state: one host dispatch amortized
+        over K updates. This is the missing middle ground between the
+        per-call compiled step (:meth:`jit_forward`, one dispatch per batch)
+        and fusing a whole epoch into your own scanned program
+        (``docs/performance.md``) — reach for it when batches arrive in
+        chunks (a prefetch queue, a K-step evaluation window) but the epoch
+        loop stays host-driven. Scalar python/0-d leaves broadcast to every
+        micro-batch; ``bool`` flags are static, so
+        ``fid.update_many(imgs_K, real=True)`` works.
+
+        No per-batch values are produced (this is ``update``, not
+        ``forward``); ``compute()`` afterwards sees all K batches. Shares
+        :meth:`jit_forward`'s state-donation discipline and its
+        ``donate=False`` opt-out; the same eligibility rules apply
+        (``ValueError`` for unbounded list states).
+        """
+        self._compiled_state_gate()
+        k = _microbatch_len(stacked, stacked_kwargs)
+        self._computed = None
+        self._forward_cache = None
+        state = self._get_states()
+        donatable = True
+        if self._jit_forward_donate:
+            state, donatable = self._donation_safe_state(state)
+        fn = self._update_many_dispatch(donatable)
+        start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
+        new_state = fn(state, stacked, stacked_kwargs)
+        if start is not None:
+            dur = time.perf_counter() - start
+            key = self.telemetry_key
+            if TELEMETRY.enabled:
+                TELEMETRY.inc(key, "update_many_calls")
+                TELEMETRY.inc(key, "update_many_batches", k)
+                _note_compiled_dispatch(
+                    self, fn, stacked, stacked_kwargs, counter="update_many_dispatches"
+                )
+            if EVENTS.enabled:
+                EVENTS.record(
+                    "update",
+                    key,
+                    dur_s=dur,
+                    t_start=start,
+                    path="scan_microbatch",
+                    batches=k,
+                    compiled_this_call=bool(fn.last_compiled),
+                    donated=fn.donate_state,
+                )
+        self._set_states(new_state)
+        self._update_called = True
+        self._computed = None
 
     def _forward_fused(self, *args: Any, _update_thunk: Optional[Callable] = None, **kwargs: Any) -> Any:
         accumulated = self._get_states()
@@ -1007,14 +1296,16 @@ class Metric(ABC):
         return filtered if filtered else kwargs
 
     def __getstate__(self) -> dict:
-        # the cached jitted forward is rebuilt lazily (unpicklable,
-        # device-bound); the telemetry key/cache-watermark stay with the
-        # ORIGINAL instance — clones and unpickled copies register fresh
+        # the cached compiled executables are rebuilt lazily (unpicklable,
+        # device-bound); the telemetry key/cache-watermark/one-shot warning
+        # stay with the ORIGINAL instance — clones and unpickled copies
+        # register (and, if it comes to it, warn) fresh
         state = {
             k: v
             for k, v in self.__dict__.items()
             if k not in ("update", "compute", "_update_signature", "_jit_forward_fn",
-                         "_telemetry_key", "_jit_cache_seen")
+                         "_jit_forward_copy_fn", "_update_many_fn", "_update_many_copy_fn",
+                         "_telemetry_key", "_jit_cache_seen", "_donation_warned")
         }
         # jax arrays serialize as host numpy and are restored on the default device
         return apply_to_collection(state, jax.Array, np.asarray)
@@ -1022,9 +1313,13 @@ class Metric(ABC):
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(apply_to_collection(state, np.ndarray, jnp.asarray))
         # pickles from before the compiled stateful forward (0.4.0) predate
-        # this flag; default it off so their first forward() stays eager
+        # this flag; default it off so their first forward() stays eager.
+        # Donation (0.6.0) defaults on for enabled pickles — enablement
+        # survives, the executable cache is rebuilt on first dispatch.
         self.__dict__.setdefault("_jit_forward_enabled", False)
-        self._jit_forward_fn = None
+        self.__dict__.setdefault("_jit_forward_donate", True)
+        self._donation_warned = False
+        self._drop_compiled_dispatch()
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]
@@ -1114,13 +1409,15 @@ class CompositionalMetric(Metric):
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
         pass  # children sync themselves
 
-    def jit_forward(self, enable: bool = True) -> "Metric":
+    def jit_forward(self, enable: bool = True, donate: bool = True) -> "Metric":
         if not enable:  # disabling is a safe no-op everywhere, here included
             return self
         self._jit_forward_gate()
         return self  # pragma: no cover - the gate always raises
 
-    def _jit_forward_gate(self) -> None:
+    def _compiled_state_gate(self) -> None:
+        # also refuses update_many: the children own the states, so the
+        # generic stateful scan cannot thread them either
         raise ValueError(
             "CompositionalMetric cannot jit its forward (children own the state); call"
             " jit_forward() on the child metrics, or jit a function over their pure API."

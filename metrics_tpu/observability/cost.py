@@ -32,23 +32,20 @@ def _normalize_analysis(analysis: Any) -> Dict[str, float]:
     return out
 
 
-def program_cost(fn: Callable, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-    """Lower+compile ``fn(*args, **kwargs)`` and return its XLA cost estimate.
-
-    Arguments may be concrete arrays or ``jax.ShapeDtypeStruct`` pytrees (no
-    computation runs — the program is only compiled). Returns::
+def executable_cost(compiled: Any) -> Dict[str, Any]:
+    """XLA cost estimate of an ALREADY-compiled ``jax.stages.Compiled``
+    program — the report :func:`program_cost` builds, without paying a fresh
+    lower+compile. ``Metric.warmup`` attaches this for the executable it just
+    built, so the warmup's cost report is free. Returns::
 
         {"available": True, "flops": float, "bytes_accessed": float,
          "argument_bytes": int, "output_bytes": int, "temp_bytes": int,
          "generated_code_bytes": int, "raw": {...}}
 
     or ``{"available": False, "error": "..."}`` when the backend exposes no
-    analysis (or lowering fails).
+    analysis.
     """
-    import jax
-
     try:
-        compiled = jax.jit(fn).lower(*args, **kwargs).compile()
         raw = _normalize_analysis(compiled.cost_analysis())
         report: Dict[str, Any] = {
             "available": True,
@@ -69,6 +66,23 @@ def program_cost(fn: Callable, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return report
     except Exception as err:
         return {"available": False, "error": f"{type(err).__name__}: {err}"}
+
+
+def program_cost(fn: Callable, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+    """Lower+compile ``fn(*args, **kwargs)`` and return its XLA cost estimate.
+
+    Arguments may be concrete arrays or ``jax.ShapeDtypeStruct`` pytrees (no
+    computation runs — the program is only compiled). Returns the
+    :func:`executable_cost` report, or ``{"available": False, "error": ...}``
+    when lowering itself fails.
+    """
+    import jax
+
+    try:
+        compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    except Exception as err:
+        return {"available": False, "error": f"{type(err).__name__}: {err}"}
+    return executable_cost(compiled)
 
 
 def leaf_nbytes(value: Any) -> int:

@@ -4,7 +4,7 @@ The registry (:mod:`~metrics_tpu.observability.registry`) answers "how many
 times / how long in total"; this module answers "**when**, relative to the
 training step". Every instrumented point in the library appends a typed
 :class:`Event` — ``update`` / ``forward`` / ``compute`` / ``sync`` /
-``retrace`` / ``health`` — carrying the user's step counter, a wall-clock
+``retrace`` / ``health`` / ``compile`` — carrying the user's step counter, a wall-clock
 interval on one shared clock, the owning metric's telemetry key, and a
 JSON-serializable payload. The log is bounded (old events are evicted, with
 an eviction counter, so a serving loop can run forever), thread-safe, and
@@ -31,8 +31,10 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, NamedTuple, Optional
 
-#: the closed set of event kinds the timeline knows how to render
-EVENT_KINDS = ("update", "forward", "compute", "sync", "retrace", "health")
+#: the closed set of event kinds the timeline knows how to render;
+#: ``compile`` marks a deliberate AOT lower+compile (``Metric.warmup``) so a
+#: first-dispatch trace+compile slice is distinguishable from steady state
+EVENT_KINDS = ("update", "forward", "compute", "sync", "retrace", "health", "compile")
 
 #: default bound on retained events; ~100 bytes each, so the default log
 #: tops out near half a megabyte of host memory

@@ -1,0 +1,194 @@
+"""AOT-compiled stateful dispatch: the executable cache behind ``jit_forward``.
+
+The compiled stateful hot path (``Metric.jit_forward`` / ``update_many`` and
+the collection variants) dispatches through ONE of these per program: an
+aval-keyed cache of ``jax.stages`` executables built with
+``jit(fn).lower(...).compile()`` — the same AOT pipeline
+``observability/cost.py`` uses read-only for cost reports, here driving the
+serving path. Owning the lower/compile step (instead of letting ``jax.jit``
+compile lazily inside a dispatch) buys three things:
+
+* **Donation.** The executable is built with ``donate_argnums=(0,)`` so XLA
+  reuses the state pytree's buffers in place — zero-copy state updates. The
+  caller owns the discipline (the donated input arrays are invalidated by the
+  dispatch); ``donate_state=False`` builds the copying lowering instead.
+* **Warmup.** :meth:`warm` lowers and compiles for a given batch shape
+  WITHOUT executing, so first-step latency becomes a deliberate, observable
+  event (``Metric.warmup``) instead of a surprise inside step 0 — and the
+  returned executable exposes ``cost_analysis()`` for the compile-time cost
+  report.
+* **Exact compile accounting.** A dispatch either hits the cache or compiles
+  — :attr:`last_compiled` says which, with no jit-cache-size inference.
+
+Host-side argument handling mirrors the eager call as closely as tracing
+allows: python ``bool``/``str`` leaves are STATIC (baked into the executable
+and part of the cache key — the ``FID(...)(imgs, real=True)`` flag pattern,
+which branches host-side in ``update``), while python ``int``/``float``
+leaves are traced as weak-typed scalars (so a stream of varying python
+numbers costs one compile, not one per value).
+"""
+import time
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+__all__ = ["CompiledDispatch"]
+
+#: leaf-layout markers: traced (device data) vs static (baked into the program)
+_TRACED = 0
+_STATIC = 1
+
+
+class CompiledDispatch:
+    """Aval-keyed cache of AOT-compiled executables for one stateful program.
+
+    ``fn(state, *args, **kwargs)`` is the pure program; ``__call__`` runs it
+    through a compiled executable, compiling on the first sight of each
+    (state avals, argument avals, static values) signature. With
+    ``donate_state=True`` the executable donates the ``state`` argument:
+    every dispatch invalidates the state arrays passed in (the caller must
+    hand over ownership — see ``Metric._donation_safe_state``).
+
+    Not thread-safe (same contract as the jit cache it replaces).
+    """
+
+    def __init__(self, fn: Callable, donate_state: bool = True) -> None:
+        self._fn = fn
+        self.donate_state = bool(donate_state)
+        self._cache: Dict[Any, Any] = {}
+        #: True when the most recent warm()/__call__ compiled a fresh executable
+        self.last_compiled = False
+        #: lower+compile wall seconds of that fresh executable (0.0 on a hit)
+        self.last_compile_s = 0.0
+
+    # -- argument canonicalization ------------------------------------------
+
+    @staticmethod
+    def _split(args: Tuple, kwargs: Dict) -> Tuple[Any, Tuple, List, Tuple]:
+        """Flatten ``(args, kwargs)`` and partition the leaves into traced
+        (arrays, plus python numbers coerced to weak-typed scalars) and
+        static (bools/strings/other host objects, baked into the program)."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        layout: List[int] = []
+        traced: List[Any] = []
+        static: List[Any] = []
+        for leaf in leaves:
+            if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+                layout.append(_TRACED)
+                traced.append(leaf)
+            elif isinstance(leaf, bool) or isinstance(leaf, str):
+                # bool before int (bool is an int subclass): flags like
+                # FID's `real=` drive host-side branches in update()
+                layout.append(_STATIC)
+                static.append(leaf)
+            elif isinstance(leaf, (int, float, complex)):
+                layout.append(_TRACED)
+                traced.append(jnp.asarray(leaf))
+            else:
+                layout.append(_STATIC)
+                static.append(leaf)
+        return treedef, tuple(layout), traced, tuple(static)
+
+    @staticmethod
+    def _sig(leaf: Any) -> Tuple:
+        return (
+            tuple(leaf.shape),
+            str(leaf.dtype),
+            bool(getattr(leaf, "weak_type", False)),
+        )
+
+    def _key(self, state: Any, treedef: Any, layout: Tuple, traced: List, static: Tuple) -> Tuple:
+        import jax
+
+        state_leaves, state_def = jax.tree_util.tree_flatten(state)
+        try:
+            hash(static)
+            static_key: Tuple = static
+        except TypeError:  # unhashable static leaf: degrade to repr identity
+            static_key = tuple(repr(s) for s in static)
+        return (
+            state_def,
+            tuple(self._sig(leaf) for leaf in state_leaves),
+            treedef,
+            layout,
+            static_key,
+            tuple(self._sig(leaf) for leaf in traced),
+        )
+
+    # -- lowering -----------------------------------------------------------
+
+    def _build_jit(self, treedef: Any, layout: Tuple, static: Tuple) -> Callable:
+        """The jit-wrapped program for one (structure, static-values) binding:
+        takes ``(state, traced_leaves)`` and reassembles the original call."""
+        import jax
+
+        fn = self._fn
+
+        def call(state: Any, traced_leaves: Tuple) -> Any:
+            merged: List[Any] = []
+            t = iter(traced_leaves)
+            s = iter(static)
+            for kind in layout:
+                merged.append(next(t) if kind == _TRACED else next(s))
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, merged)
+            return fn(state, *args, **kwargs)
+
+        return jax.jit(call, donate_argnums=(0,) if self.donate_state else ())
+
+    def _lookup(self, state: Any, args: Tuple, kwargs: Dict) -> Tuple[Any, Any, bool, List]:
+        treedef, layout, traced, static = self._split(args, kwargs)
+        key = self._key(state, treedef, layout, traced, static)
+        compiled = self._cache.get(key)
+        fresh = compiled is None
+        if fresh:
+            jitted = self._build_jit(treedef, layout, static)
+            start = time.perf_counter()
+            compiled = jitted.lower(state, tuple(traced)).compile()
+            self.last_compile_s = time.perf_counter() - start
+            self._cache[key] = compiled
+        else:
+            self.last_compile_s = 0.0
+        return key, compiled, fresh, traced
+
+    # -- public surface -----------------------------------------------------
+
+    def warm(self, state: Any, *args: Any, **kwargs: Any) -> Tuple[Any, bool]:
+        """Lower+compile (without executing) the executable for these
+        arguments' avals; returns ``(compiled, fresh)``. A cache hit returns
+        the existing executable with ``fresh=False``."""
+        _, compiled, fresh, _ = self._lookup(state, args, kwargs)
+        self.last_compiled = fresh
+        return compiled, fresh
+
+    def lower_text(self, state: Any, *args: Any, **kwargs: Any) -> str:
+        """StableHLO text of the lowering for these arguments, WITHOUT
+        compiling or caching — the zero-copy gate counts buffer-donation
+        aliasing attributes (``tf.aliasing_output``) in it."""
+        treedef, layout, traced, static = self._split(args, kwargs)
+        jitted = self._build_jit(treedef, layout, static)
+        return jitted.lower(state, tuple(traced)).as_text()
+
+    def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        key, compiled, fresh, traced = self._lookup(state, args, kwargs)
+        self.last_compiled = fresh
+        try:
+            return compiled(state, tuple(traced))
+        except TypeError:
+            if fresh:
+                raise
+            # aval drift the host-side key cannot see (a device_put moved the
+            # states, a committed-sharding change): drop the stale executable
+            # and recompile once, mirroring jit's transparent behavior.
+            # The type check precedes execution, so no donated buffer was
+            # consumed by the failed attempt.
+            del self._cache[key]
+            _, compiled, _, traced = self._lookup(state, args, kwargs)
+            self.last_compiled = True
+            return compiled(state, tuple(traced))
+
+    def _cache_size(self) -> int:
+        """Compiled-executable count (the retrace ledger's cache watermark)."""
+        return len(self._cache)

@@ -696,6 +696,130 @@ def bench_eager_forward():
 bench_eager_forward._force_cpu = True
 
 
+# ------------------------------------------- donated / scan-fused stateful
+#: capacity of the curve metric in the donated-forward config: its flat
+#: score/target buffer is the megabyte-scale state donation exists for
+DONATED_CAPACITY = 200_000
+#: micro-batches per update_many dispatch in the scan-fused config
+MICROBATCH_K = 32
+
+
+def bench_stateful_forward_donated():
+    """Donated vs copying compiled stateful forward on a capacity-curve
+    metric — the zero-copy win isolated. Both sides run the SAME traced
+    program through the same AOT executable cache (``jit_forward``); the
+    baseline is ``jit_forward(donate=False)``, whose executable re-
+    materializes the full state pytree every step, while ours donates it so
+    XLA updates the buffers in place. ``bytes_copied_avoided`` carries the
+    per-step state footprint the donated path stops copying;
+    ``dispatches_per_update`` documents the dispatch granularity (1 here —
+    the scan-fused config below amortizes it further). Both sides AOT-warmed
+    (``warmup``), so neither pays trace+compile inside the timed loop.
+    CPU-pinned like the other stateful config (per-step host dispatch
+    through the tunnel would measure the link)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import AUROC
+
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(BATCH).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, BATCH))
+
+    # accumulate-only (compute_on_step=False): the measured program is the
+    # donated state update itself, not a per-step 200k-sample curve compute
+    donated = AUROC(capacity=DONATED_CAPACITY, compute_on_step=False).jit_forward()
+    copying = AUROC(capacity=DONATED_CAPACITY, compute_on_step=False).jit_forward(donate=False)
+    donated.warmup(p, t)
+    copying.warmup(p, t)
+    state_bytes = donated.state_memory_report()["total_bytes"]
+
+    def donated_step():
+        donated(p, t)
+        jax.block_until_ready(donated.buf)  # the dispatch is async even on CPU
+
+    def copying_step():
+        copying(p, t)
+        jax.block_until_ready(copying.buf)
+
+    ours = _time_eager_loop(donated_step)
+
+    def ref(torchmetrics, torch):  # our own copying lowering is the baseline
+        return _time_eager_loop(copying_step)
+
+    extra = {
+        "bytes_copied_avoided": int(state_bytes),
+        "dispatches_per_update": 1.0,
+        "capacity": DONATED_CAPACITY,
+    }
+    return "stateful_forward_donated_step", ours, ref, "us/step", extra
+
+
+bench_stateful_forward_donated._force_cpu = True
+
+
+def bench_forward_scan_microbatch():
+    """Scan-fused micro-batching: ``update_many`` runs K stacked batches as
+    ONE compiled ``lax.scan`` over the donated state, against the per-call
+    compiled forward (K AOT-warmed ``jit_forward`` dispatches) as baseline.
+    Values are per UPDATE (one micro-batch), so ``vs_baseline`` is the
+    dispatch-amortization win directly. ``dispatches_per_update`` is
+    MEASURED from the telemetry counters (``update_many_calls`` /
+    ``update_many_batches``), not declared — the acceptance pin that one
+    dispatch serves exactly K updates."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, observability
+
+    k = MICROBATCH_K
+    rng = np.random.RandomState(0)
+    sp = jnp.asarray(rng.rand(k, BATCH, NUM_CLASSES).astype(np.float32))
+    st = jnp.asarray(rng.randint(0, NUM_CLASSES, (k, BATCH)))
+
+    many = Accuracy()
+    per_call = Accuracy(compute_on_step=False).jit_forward()
+    per_call.warmup(sp[0], st[0])
+
+    snap_before = observability.snapshot(include_timers=False)
+    many.update_many(sp, st)  # warm (compiles the scan)
+
+    def one_dispatch():
+        many.update_many(sp, st)
+        jax.block_until_ready(many.correct)
+
+    ours = _time_eager_loop(one_dispatch) / k  # per-update cost
+
+    snap_after = observability.snapshot(include_timers=False)
+
+    def counter(snap, name):
+        for entry in snap.get("metrics", {}).values():
+            if name in entry.get("counters", {}):
+                return entry["counters"][name]
+        return 0
+
+    calls = counter(snap_after, "update_many_calls") - counter(snap_before, "update_many_calls")
+    batches = counter(snap_after, "update_many_batches") - counter(snap_before, "update_many_batches")
+
+    def ref(torchmetrics, torch):  # our own per-batch compiled forward
+        def k_dispatches():
+            for i in range(k):
+                per_call(sp[i], st[i])
+            jax.block_until_ready(per_call.correct)
+
+        return _time_eager_loop(k_dispatches, steps=REF_STEPS // 4) / k
+
+    extra = {
+        "dispatches_per_update": round(calls / batches, 6) if batches else None,
+        "microbatches": k,
+        "bytes_copied_avoided": int(many.state_memory_report()["total_bytes"]),
+    }
+    return "forward_scan_microbatch", ours, ref, "us/step", extra
+
+
+bench_forward_scan_microbatch._force_cpu = True
+
+
 # ------------------------------------------------ packed collective sync
 #: scan length for the in-graph sync config (tiny per-step states -> the
 #: sync program itself is the signal; shorter than STEPS is plenty)
@@ -1011,6 +1135,8 @@ CONFIG_META = {
     "bench_pallas_confmat": ("confmat_pallas_vs_xla_step", "us/step"),
     "bench_train_overhead": ("train_step_metric_overhead", "pct"),
     "bench_eager_forward": ("stateful_forward_step_cpu", "us/step"),
+    "bench_stateful_forward_donated": ("stateful_forward_donated_step", "us/step"),
+    "bench_forward_scan_microbatch": ("forward_scan_microbatch", "us/step"),
     "bench_collection_sync_in_graph": ("collection_sync_in_graph_step", "us/step"),
     "bench_collection_sync_eager": ("collection_sync_eager_epoch", "us/epoch"),
 }
@@ -1026,6 +1152,8 @@ CONFIGS = [
     bench_pallas_confmat,
     bench_train_overhead,
     bench_eager_forward,
+    bench_stateful_forward_donated,
+    bench_forward_scan_microbatch,
     bench_collection_sync_in_graph,
     bench_collection_sync_eager,
     bench_collection,

@@ -33,6 +33,16 @@ counts and fails the gate. Collective counts are version-independent (they
 come from the traced jaxpr's primitives, not its text), so this check runs
 regardless of the baseline's jax version; regenerate with ``--update`` after
 an intentional lowering change.
+
+Third pin: the **donated stateful lowering is zero-copy**. The compiled
+stateful hot paths (``jit_forward``, ``update_many``, and the collection
+variants) donate the state argument; XLA must alias EVERY state buffer to an
+output (``tf.aliasing_output`` on each donated leaf in the lowered module) —
+a leaf that fails to alias is a buffer XLA will copy every step, exactly the
+copy donation exists to remove. The aliased-leaf counts are checked for
+self-consistency (aliased == state leaves, version-independent) and pinned
+against the baseline (``donation_aliasing``) so a lowering change that
+silently reintroduces copies fails the gate.
 """
 import argparse
 import hashlib
@@ -183,6 +193,67 @@ def sync_collective_counts() -> Dict[str, Dict[str, int]]:
     }
 
 
+def donation_aliasing() -> Dict[str, Dict[str, int]]:
+    """Buffer-donation aliasing audit of the donated stateful hot paths.
+
+    For each pinned program, lowers the REAL dispatch executable (the
+    ``CompiledDispatch`` a ``jit_forward()``/``update_many`` call builds,
+    with ``donate_argnums=(0,)``) and counts the ``tf.aliasing_output``
+    attributes XLA attached — one per donated input buffer it will update in
+    place. ``aliased == state_leaves`` means the lowering introduces no
+    state copies; anything less is a buffer copied every step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import AUROC, Accuracy, MetricCollection, Precision
+
+    jax.config.update("jax_enable_x64", True)
+    preds = jnp.zeros((8, 3), jnp.float32)
+    target = jnp.zeros((8,), jnp.int32)
+
+    def leaves(state) -> int:
+        return len(jax.tree_util.tree_leaves(state))
+
+    out: Dict[str, Dict[str, int]] = {}
+
+    m = Accuracy().jit_forward()
+    state = m._get_states()
+    txt = m._forward_dispatch().lower_text(state, preds, target)
+    out["metric_jit_forward_donated"] = {
+        "state_leaves": leaves(state), "aliased": txt.count("tf.aliasing_output")
+    }
+
+    # the capacity-curve case donation exists for: the flat score/target
+    # buffer is the megabyte-scale state that must update in place
+    auroc = AUROC(capacity=1024, compute_on_step=False).jit_forward()
+    astate = auroc._get_states()
+    txt = auroc._forward_dispatch().lower_text(
+        astate, jnp.zeros((8,), jnp.float32), jnp.zeros((8,), jnp.int32)
+    )
+    out["capacity_jit_forward_donated"] = {
+        "state_leaves": leaves(astate), "aliased": txt.count("tf.aliasing_output")
+    }
+
+    coll = MetricCollection([Accuracy(), Precision(average="macro", num_classes=3)]).jit_forward()
+    cstate = {name: mm._get_states() for name, mm in coll.items(keep_base=True)}
+    txt = coll._forward_dispatch().lower_text(cstate, preds, target)
+    out["collection_jit_forward_donated"] = {
+        "state_leaves": leaves(cstate), "aliased": txt.count("tf.aliasing_output")
+    }
+
+    m2 = Accuracy()
+    m2._update_many_dispatch(True)  # build the donating scan dispatcher
+    ustate = m2._get_states()
+    txt = m2._update_many_fn.lower_text(
+        ustate, (jnp.zeros((4, 8, 3), jnp.float32), jnp.zeros((4, 8), jnp.int32)), {}
+    )
+    out["metric_update_many_donated"] = {
+        "state_leaves": leaves(ustate), "aliased": txt.count("tf.aliasing_output")
+    }
+    return out
+
+
 def current_jaxprs() -> Dict[str, str]:
     """Jaxpr text per pinned program in the disabled-observability state
     (which the identity check proves equals the enabled state)."""
@@ -233,6 +304,17 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
         observability.TELEMETRY.enable(prev_enabled)
         observability.EVENTS.enable(prev_enabled)
 
+    # the donated lowering must be zero-copy regardless of any baseline: every
+    # donated state leaf aliases an output buffer, or XLA copies it per step
+    donation = donation_aliasing()
+    for name, rec in donation.items():
+        if rec["aliased"] < rec["state_leaves"]:
+            violations.append(
+                f"{name}: only {rec['aliased']}/{rec['state_leaves']} donated state"
+                " buffers alias an output — the un-aliased leaves are copied every"
+                " step, defeating the zero-copy stateful hot path"
+            )
+
     if os.path.exists(baseline_path):
         with open(baseline_path) as fh:
             baseline = json.load(fh)
@@ -271,6 +353,23 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                         " (or the bucket layout changed). If intentional, regenerate with"
                         " `python scripts/check_zero_overhead.py --update`."
                     )
+        # donated-lowering aliasing counts are version-independent too: pin
+        # them so a layout change that sheds aliased buffers is conscious
+        pinned_donation = baseline.get("donation_aliasing")
+        if pinned_donation is None:
+            violations.append("donation_aliasing missing from baseline (run --update)")
+        else:
+            for name, rec in donation.items():
+                want = pinned_donation.get(name)
+                if want is None:
+                    violations.append(f"{name}: donated program missing from baseline (run --update)")
+                elif want != rec:
+                    violations.append(
+                        f"{name}: donated lowering aliases {rec}, baseline pins {want} —"
+                        " the zero-copy layout of the stateful hot path changed. If"
+                        " intentional, regenerate with"
+                        " `python scripts/check_zero_overhead.py --update`."
+                    )
     else:
         skipped.append(f"no baseline at {baseline_path} (run --update to create it)")
     return {"violations": violations, "skipped_digests": skipped}
@@ -296,6 +395,9 @@ def update_baseline(baseline_path: str = BASELINE_PATH) -> str:
         # packed in-graph sync lowering: collective count per kind; a
         # regression back to per-leaf collectives inflates these and fails
         "sync_collectives": sync_collective_counts(),
+        # donated stateful lowering: every state leaf must alias an output
+        # buffer (zero-copy in-place updates); fewer means per-step copies
+        "donation_aliasing": donation_aliasing(),
     }
     with open(baseline_path, "w") as fh:
         json.dump(payload, fh, indent=1)

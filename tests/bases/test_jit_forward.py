@@ -276,3 +276,298 @@ def test_jitted_is_actually_compiled(stream):
     # same shape -> no retrace: jax's jit cache hit means update isn't re-run
     # at the Python level; assert via jit cache size stability
     assert fn._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# state donation: zero-copy updates, the aliasing fallback, warmup
+# ---------------------------------------------------------------------------
+
+
+def _assert_equal_states(a, b):
+    for name in a._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)), err_msg=name
+        )
+
+
+def test_donated_bit_identical_to_copying_classification(stream):
+    """Donation changes buffer assignment, never the traced math: the
+    donated and copying executables must agree BITWISE on every step value,
+    every state leaf, and the epoch compute."""
+    probs, target = stream
+    donated = Accuracy().jit_forward()
+    copying = Accuracy().jit_forward(donate=False)
+    for i in range(NB):
+        vd = donated(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        vc = copying(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        np.testing.assert_array_equal(np.asarray(vd), np.asarray(vc))
+    _assert_equal_states(donated, copying)
+    np.testing.assert_array_equal(
+        np.asarray(donated.compute()), np.asarray(copying.compute())
+    )
+
+
+def test_donated_bit_identical_capacity_curve(stream):
+    rng = np.random.RandomState(7)
+    scores = rng.rand(NB, B).astype(np.float32)
+    labels = rng.randint(0, 2, (NB, B))
+    donated = AUROC(capacity=NB * B).jit_forward()
+    copying = AUROC(capacity=NB * B).jit_forward(donate=False)
+    for i in range(NB):
+        donated(jnp.asarray(scores[i]), jnp.asarray(labels[i]))
+        copying(jnp.asarray(scores[i]), jnp.asarray(labels[i]))
+    _assert_equal_states(donated, copying)
+    np.testing.assert_array_equal(
+        np.asarray(donated.compute()), np.asarray(copying.compute())
+    )
+
+
+def test_donated_bit_identical_streaming_fid():
+    """FID(streaming=True): the O(d^2) moment sums are the state donation is
+    for — and its `real=` flag exercises the static-bool dispatch (one
+    executable per flag value, host-side branch preserved)."""
+    from metrics_tpu.image.fid import FID
+
+    feats = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8]  # noqa: E731
+    mk = lambda: FID(feature=feats, streaming=True, feature_dim=8)  # noqa: E731
+    rng = np.random.RandomState(3)
+    imgs = [jnp.asarray(rng.rand(4, 3, 4, 4).astype(np.float32)) for _ in range(4)]
+    donated, copying, eager = mk().jit_forward(), mk().jit_forward(donate=False), mk()
+    for i, im in enumerate(imgs):
+        donated(im, real=i % 2 == 0)
+        copying(im, real=i % 2 == 0)
+        eager(im, real=i % 2 == 0)
+    assert donated._jit_forward_fn._cache_size() == 2  # one executable per flag
+    _assert_equal_states(donated, copying)
+    _assert_equal_states(donated, eager)
+    np.testing.assert_array_equal(
+        np.asarray(donated.compute()), np.asarray(copying.compute())
+    )
+
+
+def test_donation_reuses_state_buffers_in_place(stream):
+    """The zero-copy claim itself: after the donated dispatch, the new state
+    leaf lives in the SAME device buffer; the copying path allocates fresh."""
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))  # step 1 owns fresh buffers
+    ptr = m.correct.unsafe_buffer_pointer()
+    m(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    assert m.correct.unsafe_buffer_pointer() == ptr
+
+    c = Accuracy().jit_forward(donate=False)
+    c(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    cptr = c.correct.unsafe_buffer_pointer()
+    c(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    assert c.correct.unsafe_buffer_pointer() != cptr
+
+
+def test_donation_invalidates_consumed_state(stream):
+    """Ownership discipline: the state arrays handed to a donated dispatch
+    are dead afterwards — and the metric must never touch them again (the
+    live attributes always point at the new buffers)."""
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    import weakref  # the old leaf must not be kept alive by the metric
+
+    state_before = {n: getattr(m, n) for n in m._defaults}
+    refs = {n: weakref.ref(v) for n, v in state_before.items()}
+    del state_before  # our handle gone -> donation proceeds
+    m(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    for n in m._defaults:
+        assert getattr(m, n) is not refs[n]()  # live attrs point at new buffers
+    v = m(jnp.asarray(probs[2]), jnp.asarray(target[2]))  # no stale access
+    assert np.asarray(v).shape == ()
+
+
+def test_donation_defaults_survive_reset(stream):
+    """Donating the default arrays would corrupt every future reset(); the
+    dispatch defensively copies default-aliased leaves instead."""
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    for i in range(3):
+        m(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    for name, default in m._defaults.items():
+        assert not default.is_deleted(), name
+    m.reset()
+    v = m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    oracle = Accuracy()
+    ve = oracle(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(ve))
+
+
+def test_alias_fallback_protects_external_handle(stream):
+    """A state leaf referenced outside the metric must NOT be invalidated:
+    the dispatch falls back to the copying executable with a one-shot
+    warning, and donation resumes once the handle is dropped."""
+    import warnings
+
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    handle = m.correct  # external alias
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        v1 = m(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+        assert len(w) == 1 and "referenced" in str(w[0].message)
+        m(jnp.asarray(probs[2]), jnp.asarray(target[2]))
+        assert len(w) == 1  # one-shot
+    assert not handle.is_deleted()  # the caller's array survived
+    np.testing.assert_array_equal(np.asarray(handle), np.asarray(handle))  # readable
+    # parity is unaffected by the fallback
+    oracle = Accuracy()
+    for i in range(4):
+        oracle.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    del handle
+    m(jnp.asarray(probs[3]), jnp.asarray(target[3]))  # donation resumes
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(oracle.compute()))
+
+
+def test_alias_fallback_counted_in_telemetry(stream):
+    from metrics_tpu import observability
+
+    probs, target = stream
+    observability.reset()
+    m = Accuracy().jit_forward()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    handle = m.correct
+    with pytest.warns(UserWarning, match="referenced"):
+        m(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    del handle
+    snap = observability.snapshot()
+    counters = snap["metrics"][m.telemetry_key]["counters"]
+    assert counters["jit_forward_alias_fallbacks"] == 1
+    observability.reset()
+
+
+def test_collection_alias_fallback_and_parity(stream):
+    import warnings
+
+    probs, target = stream
+    members = lambda: [Accuracy(), Precision(average="macro", num_classes=NC)]  # noqa: E731
+    col = MetricCollection(members()).jit_forward()
+    col(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    handle = col["Accuracy"].correct
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        col(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+        assert len(w) == 1 and "Accuracy.correct" in str(w[0].message)
+    assert not handle.is_deleted()
+    del handle
+    col(jnp.asarray(probs[2]), jnp.asarray(target[2]))
+    oracle = MetricCollection(members())
+    for i in range(NB):
+        oracle.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    col(jnp.asarray(probs[3]), jnp.asarray(target[3]))
+    col(jnp.asarray(probs[4]), jnp.asarray(target[4]))
+    for k, v in oracle.compute().items():
+        np.testing.assert_array_equal(np.asarray(col.compute()[k]), np.asarray(v), err_msg=k)
+
+
+def test_donation_pickle_round_trip(stream):
+    """Satellite: donation enablement survives pickling, the executable
+    cache is dropped and rebuilt, and the first post-load forward touches no
+    stale buffer."""
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))  # build the donated cache
+    clone = pickle.loads(pickle.dumps(m))
+    assert clone._jit_forward_enabled and clone._jit_forward_donate
+    assert clone._jit_forward_fn is None and clone._update_many_fn is None
+    v = clone(jnp.asarray(probs[1]), jnp.asarray(target[1]))  # rebuild + dispatch
+    assert np.asarray(v).shape == ()
+    m(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    np.testing.assert_array_equal(np.asarray(clone.compute()), np.asarray(m.compute()))
+    # the opt-out survives too
+    c = Accuracy().jit_forward(donate=False)
+    c2 = pickle.loads(pickle.dumps(c))
+    assert c2._jit_forward_enabled and not c2._jit_forward_donate
+
+
+def test_donation_collection_pickle_round_trip(stream):
+    probs, target = stream
+    col = MetricCollection([Accuracy(), Precision(average="macro", num_classes=NC)]).jit_forward()
+    col(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    c2 = pickle.loads(pickle.dumps(col))
+    assert c2._jit_forward_enabled and c2._jit_forward_donate
+    assert c2._jit_forward_fn is None
+    out = c2(jnp.asarray(probs[1]), jnp.asarray(target[1]))  # no stale-buffer access
+    assert set(out) == {"Accuracy", "Precision"}
+    out2 = c2(jnp.asarray(probs[2]), jnp.asarray(target[2]))
+    assert set(out2) == {"Accuracy", "Precision"}
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_precompiles_and_first_step_hits_cache(stream):
+    from metrics_tpu import observability
+
+    probs, target = stream
+    observability.reset()
+    m = Accuracy().jit_forward()
+    report = m.warmup(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    assert report["compiled_this_call"] and report["donated"]
+    assert report["compile_seconds"] > 0
+    assert report["forward"]["available"]  # the compiled program's own cost
+    assert report["state_memory"]["total_bytes"] > 0
+    # warmup did not touch the state
+    assert not m._update_called
+    # the first real step is a cache hit: no dispatch-time compile counted
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    snap = observability.snapshot()
+    counters = snap["metrics"][m.telemetry_key]["counters"]
+    assert counters["warmup_calls"] == 1 and counters["warmup_compiles"] == 1
+    assert counters.get("jit_forward_compiles", 0) == 0
+    assert m._jit_forward_fn._cache_size() == 1
+    # repeat warmup on the same avals is a no-op hit
+    again = m.warmup(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    assert not again["compiled_this_call"] and again["compile_seconds"] == 0.0
+    observability.reset()
+
+
+def test_warmup_enables_jit_forward():
+    m = Accuracy()
+    m.warmup(jnp.zeros((4, NC), jnp.float32), jnp.zeros((4,), jnp.int32))
+    assert m._jit_forward_enabled
+    with pytest.raises(ValueError, match="list states"):
+        AUROC().warmup(jnp.zeros((4,)), jnp.zeros((4,), jnp.int32))
+
+
+def test_warmup_collection(stream):
+    probs, target = stream
+    col = MetricCollection([Accuracy(), Precision(average="macro", num_classes=NC)])
+    report = col.warmup(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    assert col._jit_forward_enabled
+    assert report["compiled_this_call"] and report["members"] == 2
+    out = col(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    assert set(out) == {"Accuracy", "Precision"}
+    assert col._jit_forward_fn._cache_size() == 1  # the warmed executable served
+
+
+def test_computed_cache_never_donated_out_from_under_caller(stream):
+    """ConfusionMatrix.compute() returns the state array itself. A caller
+    holding that result is an external alias -> the fallback protects it; a
+    discarded result (the internal `_computed` cache alone) is cleared before
+    the alias check, so donation proceeds silently."""
+    import warnings
+
+    from metrics_tpu import ConfusionMatrix
+
+    probs, target = stream
+    m = ConfusionMatrix(num_classes=NC).jit_forward()
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    m.compute()  # result discarded: only the internal cache aliases the state
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        m(jnp.asarray(probs[1]), jnp.asarray(target[1]))  # donates, no warning
+
+    m2 = ConfusionMatrix(num_classes=NC).jit_forward()
+    m2(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    held = m2.compute()  # the caller keeps the state-aliasing result
+    with pytest.warns(UserWarning, match="referenced"):
+        m2(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    assert not held.is_deleted()  # the caller's array survived the step

@@ -1,0 +1,292 @@
+"""``update_many``: scan-fused micro-batch accumulation.
+
+K stacked batches run as ONE compiled ``lax.scan`` over the donated state —
+one host dispatch amortized over K updates (``metrics_tpu/metric.py`` /
+``collections.py``). These tests pin parity with K eager updates, the
+one-dispatch-per-K accounting, the donation discipline shared with
+``jit_forward`` (in-place buffers, default safety, aliasing fallback,
+``donate=False``), input validation, and lifecycle (pickle, member changes).
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    AverageMeter,
+    F1,
+    MetricCollection,
+    Precision,
+    Recall,
+    observability,
+)
+
+K, B, NC = 5, 32, 3
+
+
+@pytest.fixture()
+def stacked():
+    rng = np.random.RandomState(11)
+    probs = rng.rand(K, B, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(rng.randint(0, NC, (K, B)))
+
+
+def test_matches_k_eager_updates(stacked):
+    sp, st = stacked
+    many, oracle = Accuracy(), Accuracy()
+    many.update_many(sp, st)
+    for i in range(K):
+        oracle.update(sp[i], st[i])
+    for name in many._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(many, name)), np.asarray(getattr(oracle, name)), err_msg=name
+        )
+    np.testing.assert_array_equal(np.asarray(many.compute()), np.asarray(oracle.compute()))
+
+
+def test_repeated_calls_accumulate(stacked):
+    sp, st = stacked
+    many, oracle = Accuracy(), Accuracy()
+    many.update_many(sp, st)
+    many.update_many(sp, st)
+    for i in range(K):
+        oracle.update(sp[i], st[i])
+        oracle.update(sp[i], st[i])
+    np.testing.assert_array_equal(np.asarray(many.compute()), np.asarray(oracle.compute()))
+
+
+def test_capacity_curve_metric(stacked):
+    rng = np.random.RandomState(2)
+    scores = jnp.asarray(rng.rand(K, B).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 2, (K, B)))
+    many = AUROC(capacity=K * B)
+    oracle = AUROC(capacity=K * B)
+    many.update_many(scores, labels)
+    for i in range(K):
+        oracle.update(scores[i], labels[i])
+    np.testing.assert_array_equal(np.asarray(many.compute()), np.asarray(oracle.compute()))
+
+
+def test_stacked_kwargs_and_scalar_broadcast():
+    """Array kwargs scan like positional args; 0-d leaves broadcast."""
+    rng = np.random.RandomState(4)
+    values = jnp.asarray(rng.rand(K, B).astype(np.float32))
+    weights = jnp.asarray(rng.rand(K, B).astype(np.float32))
+    many, oracle = AverageMeter(), AverageMeter()
+    many.update_many(values, weight=weights)
+    for i in range(K):
+        oracle.update(values[i], weight=weights[i])
+    np.testing.assert_allclose(
+        np.asarray(many.compute()), np.asarray(oracle.compute()), rtol=1e-6
+    )
+    # a scalar weight broadcasts to every micro-batch
+    many2, oracle2 = AverageMeter(), AverageMeter()
+    many2.update_many(values, weight=2.0)
+    for i in range(K):
+        oracle2.update(values[i], weight=jnp.full((B,), 2.0))
+    np.testing.assert_allclose(
+        np.asarray(many2.compute()), np.asarray(oracle2.compute()), rtol=1e-6
+    )
+
+
+def test_static_bool_flag_streaming_fid():
+    from metrics_tpu.image.fid import FID
+
+    feats = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8]  # noqa: E731
+    mk = lambda: FID(feature=feats, streaming=True, feature_dim=8)  # noqa: E731
+    rng = np.random.RandomState(5)
+    real = jnp.asarray(rng.rand(3, 4, 3, 4, 4).astype(np.float32))
+    fake = jnp.asarray(rng.rand(3, 4, 3, 4, 4).astype(np.float32))
+    many, oracle = mk(), mk()
+    many.update_many(real, real=True)
+    many.update_many(fake, real=False)
+    for i in range(3):
+        oracle.update(real[i], real=True)
+        oracle.update(fake[i], real=False)
+    np.testing.assert_array_equal(np.asarray(many.compute()), np.asarray(oracle.compute()))
+
+
+def test_one_dispatch_per_k_updates(stacked):
+    """The acceptance pin: K updates ride exactly one compiled dispatch."""
+    sp, st = stacked
+    observability.reset()
+    m = Accuracy()
+    m.update_many(sp, st)
+    m.update_many(sp, st)
+    snap = observability.snapshot()
+    counters = snap["metrics"][m.telemetry_key]["counters"]
+    assert counters["update_many_calls"] == 2
+    assert counters["update_many_batches"] == 2 * K
+    assert counters["update_many_dispatches"] == 2
+    # one executable serves both calls (no retrace on a stable shape)
+    assert m._update_many_fn._cache_size() == 1
+    observability.reset()
+
+
+def test_donation_in_place_and_opt_out(stacked):
+    sp, st = stacked
+    m = Accuracy()
+    m.update_many(sp, st)  # first call: default-aliased leaves copied
+    ptr = m.correct.unsafe_buffer_pointer()
+    m.update_many(sp, st)
+    assert m.correct.unsafe_buffer_pointer() == ptr  # in-place reuse
+    for name, default in m._defaults.items():
+        assert not default.is_deleted(), name  # defaults never donated
+
+    c = Accuracy().jit_forward(donate=False)
+    c.update_many(sp, st)
+    cptr = c.correct.unsafe_buffer_pointer()
+    c.update_many(sp, st)
+    assert c.correct.unsafe_buffer_pointer() != cptr  # copying lowering
+    for name in m._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(m, name)), np.asarray(getattr(c, name)), err_msg=name
+        )
+
+
+def test_alias_fallback(stacked):
+    sp, st = stacked
+    m = Accuracy()
+    m.update_many(sp, st)
+    handle = m.total
+    with pytest.warns(UserWarning, match="referenced"):
+        m.update_many(sp, st)
+    assert not handle.is_deleted()
+    del handle
+    m.update_many(sp, st)
+    oracle = Accuracy()
+    for _ in range(3):
+        for i in range(K):
+            oracle.update(sp[i], st[i])
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(oracle.compute()))
+
+
+def test_reset_between_calls(stacked):
+    sp, st = stacked
+    m = Accuracy()
+    m.update_many(sp, st)
+    m.reset()
+    m.update_many(sp, st)
+    oracle = Accuracy()
+    for i in range(K):
+        oracle.update(sp[i], st[i])
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(oracle.compute()))
+
+
+def test_validation_errors(stacked):
+    sp, st = stacked
+    m = Accuracy()
+    with pytest.raises(ValueError, match="at least one stacked array"):
+        m.update_many()
+    with pytest.raises(ValueError, match="disagree on the micro-batch count"):
+        m.update_many(sp, st[: K - 1])
+    with pytest.raises(ValueError, match="list states"):
+        AUROC().update_many(jnp.zeros((2, 4)), jnp.zeros((2, 4), jnp.int32))
+    comp = Accuracy() + 1.0
+    with pytest.raises(ValueError, match="Compositional"):
+        comp.update_many(sp, st)
+
+
+def test_pickle_drops_and_rebuilds_cache(stacked):
+    sp, st = stacked
+    m = Accuracy()
+    m.update_many(sp, st)
+    clone = pickle.loads(pickle.dumps(m))
+    assert clone._update_many_fn is None
+    clone.update_many(sp, st)  # rebuilds, no stale-buffer access
+    m.update_many(sp, st)
+    np.testing.assert_array_equal(np.asarray(clone.compute()), np.asarray(m.compute()))
+
+
+# ---------------------------------------------------------------------------
+# collection
+# ---------------------------------------------------------------------------
+
+
+def _members():
+    return [
+        Accuracy(),
+        Precision(average="macro", num_classes=NC),
+        Recall(average="macro", num_classes=NC),
+        F1(average="macro", num_classes=NC),
+    ]
+
+
+def test_collection_matches_k_eager_updates(stacked):
+    sp, st = stacked
+    many, oracle = MetricCollection(_members()), MetricCollection(_members())
+    many.update_many(sp, st)
+    for i in range(K):
+        oracle.update(sp[i], st[i])
+    mc, oc = many.compute(), oracle.compute()
+    assert set(mc) == set(oc)
+    for k in mc:
+        np.testing.assert_array_equal(np.asarray(mc[k]), np.asarray(oc[k]), err_msg=k)
+
+
+def test_collection_one_dispatch(stacked):
+    sp, st = stacked
+    observability.reset()
+    col = MetricCollection(_members())
+    col.update_many(sp, st)
+    snap = observability.snapshot()
+    counters = snap["metrics"][col.telemetry_key]["counters"]
+    assert counters["update_many_calls"] == 1
+    assert counters["update_many_batches"] == K
+    assert col._update_many_fn._cache_size() == 1
+    observability.reset()
+
+
+def test_collection_rejects_ineligible_member(stacked):
+    sp, st = stacked
+    col = MetricCollection([Accuracy(), AUROC()])
+    with pytest.raises(ValueError, match="AUROC"):
+        col.update_many(sp, st)
+
+
+def test_collection_member_change_invalidates_cache(stacked):
+    sp, st = stacked
+    col = MetricCollection([Accuracy()])
+    col.update_many(sp, st)
+    assert col._update_many_fn is not None
+    col.add_metrics(Precision(average="macro", num_classes=NC))
+    assert col._update_many_fn is None  # stale member set dropped
+    col.update_many(sp, st)  # recompiles with the new member
+    oracle = Precision(average="macro", num_classes=NC)
+    for i in range(K):
+        oracle.update(sp[i], st[i])
+    np.testing.assert_array_equal(
+        np.asarray(col["Precision"].compute()), np.asarray(oracle.compute())
+    )
+
+
+def test_collection_donation_in_place(stacked):
+    sp, st = stacked
+    col = MetricCollection(_members())
+    col.update_many(sp, st)
+    ptrs = {n: col[n].tp.unsafe_buffer_pointer() for n in ("Precision", "Recall")}
+    col.update_many(sp, st)
+    for n, p in ptrs.items():
+        assert col[n].tp.unsafe_buffer_pointer() == p, n
+
+
+def test_mixed_update_many_and_jit_forward(stacked):
+    """The two compiled paths share one live state: interleaving them must
+    accumulate exactly like the eager stream."""
+    sp, st = stacked
+    m = Accuracy().jit_forward()
+    oracle = Accuracy()
+    m(sp[0], st[0])
+    m.update_many(sp[1:], st[1:])
+    m(sp[0], st[0])
+    oracle.update(sp[0], st[0])
+    for i in range(1, K):
+        oracle.update(sp[i], st[i])
+    oracle.update(sp[0], st[0])
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(oracle.compute()))

@@ -239,3 +239,63 @@ def test_prometheus_renders_event_series(stream):
     assert "# TYPE metrics_tpu_events_recorded_total counter" in text
     assert 'metrics_tpu_events_by_kind_total{kind="forward"}' in text
     assert "metrics_tpu_events_high_water" in text
+
+
+# ---------------------------------------------------------------------------
+# compile events and the compiled_this_call tag (donated AOT hot path)
+# ---------------------------------------------------------------------------
+
+
+def test_forward_events_tag_compiled_this_call(stream):
+    """The first dispatch of a jitted forward pays trace+compile; steady
+    -state dispatches are cache hits — the event payload must say which, so
+    the Perfetto export separates the compile slice from the steady state."""
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    for i in range(3):
+        m(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    compiled = [
+        e
+        for e in observability.EVENTS.events()
+        if e.kind == "forward" and e.payload.get("path") == "compiled"
+    ]
+    assert [e.payload["compiled_this_call"] for e in compiled] == [True, False, False]
+    assert all(e.payload["donated"] for e in compiled)
+
+
+def test_warmup_records_compile_event(stream):
+    probs, target = stream
+    m = Accuracy().jit_forward()
+    m.warmup(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    (ev,) = [e for e in observability.EVENTS.events() if e.kind == "compile"]
+    assert ev.metric == m.telemetry_key
+    assert ev.payload["path"] == "warmup" and ev.payload["fresh"]
+    assert ev.dur_s > 0 and "float32" in ev.payload["signature"]
+    # the warmed first dispatch is a cache hit, tagged so
+    m(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    fwd = [e for e in observability.EVENTS.events() if e.kind == "forward"][-1]
+    assert fwd.payload["compiled_this_call"] is False
+
+
+def test_update_many_records_scan_microbatch_event(stream):
+    probs, target = stream
+    m = Accuracy()
+    m.update_many(jnp.asarray(probs), jnp.asarray(target))
+    (ev,) = [
+        e
+        for e in observability.EVENTS.events()
+        if e.kind == "update" and e.payload.get("path") == "scan_microbatch"
+    ]
+    assert ev.metric == m.telemetry_key
+    assert ev.payload["batches"] == NB and ev.payload["compiled_this_call"]
+
+
+def test_compile_events_render_on_timeline(stream, tmp_path):
+    from metrics_tpu.observability import timeline
+
+    probs, target = stream
+    m = Accuracy()
+    m.warmup(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    trace = timeline.to_chrome_trace()
+    slices = [t for t in trace["traceEvents"] if t.get("cat") == "compile"]
+    assert len(slices) == 1 and slices[0]["ph"] == "X"  # a real interval slice

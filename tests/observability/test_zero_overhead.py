@@ -95,3 +95,56 @@ def test_digest_mismatch_is_reported(tmp_path):
     bad.write_text(json.dumps(baseline))
     result = check_zero_overhead.check(str(bad))
     assert any("metric_update" in v and "drifted" in v for v in result["violations"])
+
+
+# ---------------------------------------------------------------------------
+# donated-lowering zero-copy pins
+# ---------------------------------------------------------------------------
+
+
+def test_donated_lowerings_alias_every_state_buffer():
+    """Self-consistency leg of the zero-copy gate, version-independent: XLA
+    aliases EVERY donated state leaf to an output in the real dispatch
+    executables — an un-aliased leaf is a buffer copied per step."""
+    donation = check_zero_overhead.donation_aliasing()
+    assert set(donation) == {
+        "metric_jit_forward_donated",
+        "capacity_jit_forward_donated",
+        "collection_jit_forward_donated",
+        "metric_update_many_donated",
+    }
+    for name, rec in donation.items():
+        assert rec["state_leaves"] > 0, name
+        assert rec["aliased"] == rec["state_leaves"], (name, rec)
+
+
+def test_donation_aliasing_is_pinned_in_baseline():
+    import json
+
+    with open(check_zero_overhead.BASELINE_PATH) as fh:
+        baseline = json.load(fh)
+    pinned = baseline["donation_aliasing"]
+    assert set(pinned) == {
+        "metric_jit_forward_donated",
+        "capacity_jit_forward_donated",
+        "collection_jit_forward_donated",
+        "metric_update_many_donated",
+    }
+    for rec in pinned.values():
+        assert rec["aliased"] == rec["state_leaves"] > 0
+
+
+def test_donation_aliasing_drift_is_reported(tmp_path):
+    import json
+
+    with open(check_zero_overhead.BASELINE_PATH) as fh:
+        baseline = json.load(fh)
+    baseline["donation_aliasing"]["metric_jit_forward_donated"] = {
+        "state_leaves": 99, "aliased": 99,
+    }
+    bad = tmp_path / "baseline.json"
+    bad.write_text(json.dumps(baseline))
+    result = check_zero_overhead.check(str(bad))
+    assert any(
+        "metric_jit_forward_donated" in v and "zero-copy" in v for v in result["violations"]
+    ), result["violations"]

@@ -165,3 +165,33 @@ def test_measure_single_attempt_after_total_deadline(monkeypatch):
     out = bench._measure("bench_x", ("m", "us/step"))
     # degraded line, but no retries once the capture's total budget is spent
     assert len(calls) == 1 and out["degraded"] is True
+
+
+def test_donation_microbatch_bench_records_round_trip(monkeypatch):
+    """The donated/micro-batch configs' records must survive json round-trips
+    and carry the new evidence keys: ``bytes_copied_avoided`` (the per-step
+    state footprint donation stops copying) and ``dispatches_per_update``
+    (1 for the donated per-call config; measured 1/K for the scan-fused
+    config — the one-dispatch-per-K-updates acceptance pin)."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "DONATED_CAPACITY", 4096)
+    monkeypatch.setattr(bench_suite, "MICROBATCH_K", 4)
+
+    line = bench_suite.run_config(bench_suite.bench_stateful_forward_donated, probe=False)
+    assert json.loads(json.dumps(line)) == line
+    assert line["metric"] == "stateful_forward_donated_step"
+    assert line["dispatches_per_update"] == 1.0
+    assert isinstance(line["bytes_copied_avoided"], int) and line["bytes_copied_avoided"] > 0
+    assert "telemetry" in line
+
+    line = bench_suite.run_config(bench_suite.bench_forward_scan_microbatch, probe=False)
+    assert json.loads(json.dumps(line)) == line
+    assert line["metric"] == "forward_scan_microbatch"
+    assert line["microbatches"] == 4
+    assert line["dispatches_per_update"] == 0.25  # exactly 1 dispatch per K updates
+    assert isinstance(line["bytes_copied_avoided"], int)
+    assert "telemetry" in line
+
+    assert "bench_stateful_forward_donated" in bench_suite.CONFIG_META
+    assert "bench_forward_scan_microbatch" in bench_suite.CONFIG_META
