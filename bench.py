@@ -10,8 +10,11 @@ whose records carry ``collectives_before``/``collectives_after`` — the
 bucketed-fusion win), the donated/scan-fused stateful configs
 (``stateful_forward_donated_step`` / ``forward_scan_microbatch``, whose
 records carry ``bytes_copied_avoided`` and ``dispatches_per_update`` —
-the zero-copy and dispatch-amortization wins), and the north-star
-``train_step_metric_overhead``
+the zero-copy and dispatch-amortization wins), the compute-group dedup
+config (``collection_update_compute_groups``, whose record carries
+``groups``/``updates_per_step``/``sync_leaves_before``/``sync_leaves_after``
+— one donated update per trace-fingerprinted group instead of one per
+member), and the north-star ``train_step_metric_overhead``
 (% overhead of the 10-metric collection fused into a Flax train step,
 target <1%). The flagship collection config prints LAST, and the full line
 set is re-emitted as a final block.

@@ -8,6 +8,14 @@ prefix/postfix) — plus the pure-state fan-out API (:meth:`init_state` /
 and syncs inside one jitted program: XLA then fuses the per-metric psum
 collectives into a single staged bundle over the mesh, which is how a
 10-metric collection stays at ~one collective of step overhead.
+
+On top of that rides the **compute-group engine** (on by default,
+``compute_groups=False`` opts out): members whose per-batch update traces to
+the EXACT same program over the same state layout — compared by jaxpr
+fingerprint, not runtime heuristics — share one live state, so each compiled
+step runs one donated update per group and ``compute()`` fans the shared
+state out to every member's own ``compute``. See
+``MetricCollection.build_compute_groups`` and ``docs/performance.md``.
 """
 import functools
 import sys
@@ -24,14 +32,16 @@ from metrics_tpu.metric import (
     ArrayTypes,
     Metric,
     StateDict,
+    _ComputeGroup,
     _microbatch_len,
     _note_compiled_dispatch,
     _observed_forward,
 )
 from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.health import HEALTH, guard_state
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import arg_signature
-from metrics_tpu.utilities.aot import CompiledDispatch
+from metrics_tpu.utilities.aot import CompiledDispatch, trace_fingerprint
 from metrics_tpu.utilities.prints import rank_zero_warn
 from metrics_tpu.utilities.profiling import compiled_scope, eager_span
 
@@ -46,6 +56,21 @@ class MetricCollection:
         additional_metrics: further metrics when ``metrics`` is not a dict.
         prefix: string prepended to every output key.
         postfix: string appended to every output key.
+        compute_groups: deduplicate provably-identical member updates (default
+            True). At the first compiled dispatch (``jit_forward`` /
+            ``update_many`` / ``warmup``) — or explicitly via
+            :meth:`build_compute_groups` — each member's ``apply_update`` is
+            traced against the batch avals and members whose (update-jaxpr
+            fingerprint, state layout, static dispatch args) match EXACTLY
+            are grouped onto one shared state: each step then runs ONE
+            donated update per group, and ``compute()`` fans the shared state
+            out to every member's own ``compute``. A
+            ``MetricCollection([Precision, Recall, F1, Specificity,
+            StatScores])`` issues 1 update computation and donates 1 state
+            bundle per step instead of 5. Exact jaxpr equality means no
+            heuristic false merges; direct writes to a grouped member's state
+            copy-on-write detach it (see ``docs/performance.md``). Pass
+            ``False`` to keep fully private per-member states.
 
     Example::
 
@@ -66,8 +91,11 @@ class MetricCollection:
         *additional_metrics: Metric,
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
+        compute_groups: bool = True,
     ) -> None:
         self._metrics: "OrderedDict[str, Metric]" = OrderedDict()
+        self._compute_groups_enabled = bool(compute_groups)
+        self._compute_groups_built = False
         self.add_metrics(metrics, *additional_metrics)
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
@@ -92,18 +120,228 @@ class MetricCollection:
             self._telemetry_key = key
         return key
 
+    # ------------------------------------------------------------------
+    # compute groups: trace-fingerprinted shared-state update dedup
+    # ------------------------------------------------------------------
+
+    def build_compute_groups(self, *sample_batch: Any, **kwargs: Any) -> Dict[str, list]:
+        """Trace every member's ``apply_update`` against this batch's avals
+        and group members whose programs match EXACTLY onto one shared state.
+
+        Grouping is by program identity, not runtime heuristics: the
+        fingerprint is the member's update jaxpr text + closed-over constant
+        digest + static dispatch args + state layout (tree structure, avals,
+        reductions) + ``process_group``
+        (:func:`~metrics_tpu.utilities.aot.trace_fingerprint`). Two metrics
+        that merely hold equal state VALUES but run different update programs
+        never merge — the false-merge class the reference's runtime-heuristic
+        compute groups admit. Members whose current states have already
+        diverged (e.g. after a partial ``load_state_dict``) are left
+        ungrouped even on a fingerprint match, so restored per-member states
+        are honored.
+
+        Called automatically at the first compiled dispatch (``jit_forward``
+        / ``update_many`` / ``warmup``); call it explicitly to group ahead of
+        time or to regroup after mutating members. Returns ``{owner_name:
+        [member names]}`` for the multi-member groups formed (empty when
+        grouping is disabled or nothing matches).
+        """
+        self._dissolve_compute_groups()
+        if not self._compute_groups_enabled:
+            return {}
+        self._compute_groups_built = True
+        if len(self._metrics) < 2:
+            return {}
+        buckets: "OrderedDict[Tuple, list]" = OrderedDict()
+        for name, m in self.items(keep_base=True):
+            fp = self._member_group_fingerprint(m, sample_batch, kwargs)
+            if fp is not None:
+                buckets.setdefault(fp, []).append(name)
+        groups: Dict[str, list] = {}
+        for names in buckets.values():
+            if len(names) < 2:
+                continue
+            owner = self._metrics[names[0]]
+            members = [names[0]] + [
+                n for n in names[1:] if self._states_equal(owner, self._metrics[n])
+            ]
+            if len(members) < 2:
+                continue
+            self._form_group(members)
+            groups[members[0]] = list(members)
+        if TELEMETRY.enabled:
+            key = self.telemetry_key
+            TELEMETRY.inc(key, "compute_group_count", len(groups))
+            TELEMETRY.set_info(
+                key,
+                "compute_groups",
+                {"groups": {o: list(ns) for o, ns in groups.items()}, "members": len(self._metrics)},
+            )
+        if EVENTS.enabled:
+            EVENTS.record(
+                "compile",
+                self.telemetry_key,
+                path="compute_groups",
+                groups=[list(ns) for ns in groups.values()],
+                members=len(self._metrics),
+            )
+        return groups
+
+    def _member_group_fingerprint(self, m: Metric, args: Tuple, kwargs: Dict) -> Optional[Tuple]:
+        """The member's exact-trace group key, or ``None`` when it cannot
+        share a state: custom sync protocols, non-base pure-state layouts
+        (wrappers, compositions), or updates that refuse to trace against
+        these avals (value-dependent canonicalization) all stay private."""
+        if m.dist_sync_on_step or m.dist_sync_fn is not None or not m._defaults:
+            return None
+        cls = type(m)
+        if (
+            cls.apply_update is not Metric.apply_update
+            or cls.apply_compute is not Metric.apply_compute
+            or cls.sync_state is not Metric.sync_state
+            or cls.init_state is not Metric.init_state
+            or cls._get_states is not Metric._get_states
+            or cls._set_states is not Metric._set_states
+        ):
+            return None
+        state = m.init_state()
+        if set(state) != set(m._defaults):
+            return None
+        try:
+            fkw = m._filter_kwargs(**kwargs)
+            trace_key = trace_fingerprint(m.apply_update, state, args, fkw)
+        except Exception:
+            return None
+        state_spec = tuple(
+            (
+                k,
+                "list"
+                if isinstance(m._defaults[k], list)
+                else (tuple(m._defaults[k].shape), str(m._defaults[k].dtype)),
+                m._reductions[k] if isinstance(m._reductions[k], (str, type(None))) else repr(m._reductions[k]),
+            )
+            for k in sorted(m._defaults)
+        )
+        return trace_key + (state_spec, repr(m.process_group))
+
+    @staticmethod
+    def _states_equal(a: Metric, b: Metric) -> bool:
+        """Element-wise equality of two members' CURRENT states (the group
+        precondition: a shared state can only adopt members that agree)."""
+        import numpy as np
+
+        for name in a._defaults:
+            va, vb = getattr(a, name), getattr(b, name)
+            if isinstance(va, list) != isinstance(vb, list):
+                return False
+            pairs = list(zip(va, vb)) if isinstance(va, list) else [(va, vb)]
+            if isinstance(va, list) and len(va) != len(vb):
+                return False
+            for x, y in pairs:
+                x, y = np.asarray(x), np.asarray(y)
+                if x.shape != y.shape or x.dtype != y.dtype or not np.array_equal(x, y):
+                    return False
+        return True
+
+    def _form_group(self, names: list) -> None:
+        members = [self._metrics[n] for n in names]
+        group = _ComputeGroup(
+            owner=members[0], members=members, collection=self, collection_key=self.telemetry_key
+        )
+        for m in members:
+            m.__dict__["_compute_group"] = group
+        for m in members[1:]:
+            # followers hold NO state attributes: reads delegate to the owner
+            for sname in m._defaults:
+                m.__dict__.pop(sname, None)
+            # a follower's own compiled caches baked its private state
+            m._drop_compiled_dispatch()
+
+    def _dissolve_compute_groups(self) -> None:
+        """Silently ungroup every member (administrative: member-set change,
+        ``load_state_dict``, explicit rebuild). Each member keeps the state
+        it currently observes."""
+        for _, m in self.items(keep_base=True):
+            if m.__dict__.get("_compute_group") is not None:
+                m._group_cow_detach(None)
+        self._compute_groups_built = False
+
+    def _ensure_compute_groups(self, args: Tuple, kwargs: Dict) -> None:
+        if self._compute_groups_enabled and not self._compute_groups_built:
+            self.build_compute_groups(*args, **kwargs)
+
+    def _group_layout(self) -> list:
+        """``[(owner_name, [member names]), ...]`` in member order: one entry
+        per compute group plus one singleton entry per ungrouped member.
+        Derived from the live group objects, so copy-on-write detaches are
+        reflected immediately. Groups formed by a DIFFERENT collection are
+        treated as singletons here (and detached at dispatch time)."""
+        layout: list = []
+        seen: set = set()
+        for name, m in self.items(keep_base=True):
+            g = m.__dict__.get("_compute_group")
+            if g is None or g.collection_ref() is not self:
+                layout.append((name, [name]))
+                continue
+            if id(g) in seen:
+                continue
+            seen.add(id(g))
+            names = [
+                n
+                for n, mm in self.items(keep_base=True)
+                if mm.__dict__.get("_compute_group") is g
+            ]
+            owner_name = next((n for n in names if self._metrics[n] is g.owner), None)
+            if owner_name is None:  # pragma: no cover - defensive: owner replaced
+                layout.extend((n, [n]) for n in names)
+            else:
+                layout.append((owner_name, [owner_name] + [n for n in names if n != owner_name]))
+        return layout
+
+    def _group_signature(self) -> Optional[Tuple]:
+        """Hashable group-layout key mixed into every compiled-dispatch cache
+        entry (``CompiledDispatch(context_fn=...)``): a group rebuild or CoW
+        detach re-keys the executable instead of serving a stale program."""
+        if not self.__dict__.get("_compute_groups_built", False):
+            return None
+        return tuple((owner, tuple(names)) for owner, names in self._group_layout())
+
+    def _has_compute_groups(self) -> bool:
+        return self.__dict__.get("_compute_groups_built", False) and any(
+            len(names) > 1 for _, names in self._group_layout()
+        )
+
+    def compute_group_report(self) -> Dict[str, Any]:
+        """The current group composition: ``{"built": bool, "groups":
+        {owner: [members]}, "ungrouped": [...]}`` — also attached to
+        ``observability.snapshot()`` under the collection's key at build."""
+        layout = self._group_layout() if self.__dict__.get("_compute_groups_built", False) else []
+        groups = {owner: list(names) for owner, names in layout if len(names) > 1}
+        grouped = {n for ns in groups.values() for n in ns}
+        return {
+            "built": bool(self.__dict__.get("_compute_groups_built", False)),
+            "enabled": bool(self.__dict__.get("_compute_groups_enabled", True)),
+            "groups": groups,
+            "ungrouped": [n for n in self._metrics if n not in grouped],
+        }
+
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Call forward on every metric; positional args broadcast, kwargs are
-        filtered per metric signature. Shared-update classes (see
+        filtered per metric signature. Compute groups (once built) run ONE
+        update on their shared state; shared-update classes (see
         :meth:`_shared_deltas`) run their partial-statistics pass once."""
         if self._jit_forward_enabled:
             return self._forward_jitted(*args, **kwargs)
-        shared = self._shared_deltas(*args, **kwargs)
+        grouped_vals, handled = self._forward_grouped_eager(args, kwargs)
+        shared = self._shared_deltas(args, kwargs, exclude=handled)
         out = {}
         for name, m in self.items(keep_base=True):
+            if name in handled:
+                out[self._set_name(name)] = grouped_vals[name]
+                continue
             deltas = shared.get(name)
             if deltas is not None and m._states_mergeable():
                 with eager_span(f"{type(m).__name__}.forward"):
@@ -120,9 +358,82 @@ class MetricCollection:
                 out[self._set_name(name)] = m(*args, **m._filter_kwargs(**kwargs))
         return out
 
+    def _forward_grouped_eager(self, args: Tuple, kwargs: Dict) -> Tuple[Dict[str, Any], set]:
+        """One eager step per multi-member compute group: a single update
+        pass advances the shared state, each member's on-step value comes
+        from its own ``compute`` over the shared batch state. Returns
+        ``(values by base name, handled names)`` — empty until groups are
+        built (a compiled dispatch or :meth:`build_compute_groups`)."""
+        vals: Dict[str, Any] = {}
+        handled: set = set()
+        if not self.__dict__.get("_compute_groups_built", False):
+            return vals, handled
+        for owner_name, names in self._group_layout():
+            if len(names) < 2:
+                continue
+            owner = self._metrics[owner_name]
+            fkw = owner._filter_kwargs(**kwargs)
+            with eager_span(f"{type(owner).__name__}.forward"):
+                start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
+                batch_state = owner.apply_update(owner.init_state(), *args, **fkw)
+                if owner._states_mergeable():
+                    new_state = owner.merge_states(owner._get_states(), batch_state)
+                else:
+                    new_state = owner.apply_update(owner._get_states(), *args, **fkw)
+                owner._set_states(new_state)
+                if HEALTH.enabled:
+                    guard_state(owner, new_state, source="forward")
+                for n in names:
+                    m = self._metrics[n]
+                    m._update_called = True
+                    m._computed = None
+                    value = m.apply_compute(batch_state, axis_name=None) if m.compute_on_step else None
+                    m._forward_cache = value
+                    vals[n] = value
+                handled.update(names)
+                if start is not None:
+                    dur = time.perf_counter() - start
+                    if TELEMETRY.enabled:
+                        TELEMETRY.inc(owner.telemetry_key, "update_calls")
+                        TELEMETRY.inc(self.telemetry_key, "update_dedup_skipped", len(names) - 1)
+                    if EVENTS.enabled:
+                        EVENTS.record(
+                            "forward",
+                            owner.telemetry_key,
+                            dur_s=dur,
+                            t_start=start,
+                            path="compute_group",
+                            members=list(names),
+                        )
+        return vals, handled
+
     def update(self, *args: Any, **kwargs: Any) -> None:
-        shared = self._shared_deltas(*args, **kwargs)
+        handled: set = set()
+        if self.__dict__.get("_compute_groups_built", False):
+            for owner_name, names in self._group_layout():
+                if len(names) < 2:
+                    continue
+                owner = self._metrics[owner_name]
+                # ONE update pass on the shared state serves every member
+                owner._set_states(
+                    owner.apply_update(owner._get_states(), *args, **owner._filter_kwargs(**kwargs))
+                )
+                for n in names:
+                    m = self._metrics[n]
+                    m._update_called = True
+                    m._computed = None
+                handled.update(names)
+                if TELEMETRY.enabled:
+                    TELEMETRY.inc(owner.telemetry_key, "update_calls")
+                    TELEMETRY.inc(self.telemetry_key, "update_dedup_skipped", len(names) - 1)
+                if EVENTS.enabled:
+                    EVENTS.record(
+                        "update", owner.telemetry_key, path="compute_group", members=list(names)
+                    )
+        shared = self._shared_deltas(args, kwargs, exclude=handled)
         for name, m in self.items(keep_base=True):
+            if name in handled:
+                continue
             if name in shared:
                 m._update_from_deltas(*shared[name])
             else:
@@ -168,8 +479,9 @@ class MetricCollection:
     def _forward_dispatch(self) -> CompiledDispatch:
         if self._jit_forward_fn is None:
             self._jit_forward_fn = CompiledDispatch(
-                functools.partial(self.apply_forward, axis_name=None),
+                functools.partial(self._grouped_apply_forward, axis_name=None),
                 donate_state=self._jit_forward_donate,
+                context_fn=self._group_signature,
             )
             self._jit_cache_seen = 0
         return self._jit_forward_fn
@@ -177,9 +489,109 @@ class MetricCollection:
     def _forward_copy_dispatch(self) -> CompiledDispatch:
         if self._jit_forward_copy_fn is None:
             self._jit_forward_copy_fn = CompiledDispatch(
-                functools.partial(self.apply_forward, axis_name=None), donate_state=False
+                functools.partial(self._grouped_apply_forward, axis_name=None),
+                donate_state=False,
+                context_fn=self._group_signature,
             )
         return self._jit_forward_copy_fn
+
+    def _grouped_apply_forward(
+        self, state: Dict[str, StateDict], *args: Any, axis_name: Any = AXIS_UNSET, **kwargs: Any
+    ) -> Tuple[Dict[str, StateDict], Dict[str, Any]]:
+        """:meth:`apply_forward` over the GROUP-DEDUPED state layout: one
+        state bundle (and one update pass) per compute group, keyed by the
+        group owner's name; every member still gets its own on-step value,
+        computed from the shared batch state. With no multi-member groups
+        this IS :meth:`apply_forward` — byte-identical program, per-member
+        state keys."""
+        layout = self._group_layout()
+        if all(len(names) == 1 for _, names in layout):
+            return self.apply_forward(state, *args, axis_name=axis_name, **kwargs)
+        grouped = {n for _, ns in layout if len(ns) > 1 for n in ns}
+        deltas = self._shared_deltas(args, kwargs, exclude=grouped)
+        batch: Dict[str, StateDict] = {}
+        for owner_name, _ in layout:
+            m = self._metrics[owner_name]
+            if owner_name in deltas:
+                batch[owner_name] = m._apply_accumulate(m.init_state(), deltas[owner_name])
+            else:
+                batch[owner_name] = m.apply_update(
+                    m.init_state(), *args, **m._filter_kwargs(**kwargs)
+                )
+        new_state: Dict[str, StateDict] = {}
+        values: Dict[str, Any] = {}
+        for owner_name, names in layout:
+            m = self._metrics[owner_name]
+            new_state[owner_name], values[self._set_name(owner_name)] = m.apply_forward(
+                state[owner_name],
+                *args,
+                axis_name=axis_name,
+                batch_state=batch[owner_name],
+                **m._filter_kwargs(**kwargs),
+            )
+            for n in names[1:]:
+                mm = self._metrics[n]
+                values[self._set_name(n)] = (
+                    mm.apply_compute(batch[owner_name], axis_name=None)
+                    if mm.compute_on_step
+                    else None
+                )
+        return new_state, values
+
+    def _grouped_apply_update(
+        self, state: Dict[str, StateDict], *args: Any, **kwargs: Any
+    ) -> Dict[str, StateDict]:
+        """:meth:`apply_update` over the group-deduped state layout (one
+        update per group); identical to :meth:`apply_update` when no
+        multi-member groups exist."""
+        layout = self._group_layout()
+        if all(len(names) == 1 for _, names in layout):
+            return self.apply_update(state, *args, **kwargs)
+        grouped = {n for _, ns in layout if len(ns) > 1 for n in ns}
+        deltas = self._shared_deltas(args, kwargs, exclude=grouped)
+        out: Dict[str, StateDict] = {}
+        for owner_name, _ in layout:
+            m = self._metrics[owner_name]
+            if owner_name in deltas:
+                out[owner_name] = m._apply_accumulate(state[owner_name], deltas[owner_name])
+            else:
+                out[owner_name] = m.apply_update(
+                    state[owner_name], *args, **m._filter_kwargs(**kwargs)
+                )
+        return out
+
+    def _collect_dispatch_state(self) -> Dict[str, StateDict]:
+        """The live state bundles a compiled dispatch threads: ONE per
+        compute group (keyed by owner name) plus one per ungrouped member —
+        the 5-member stat-scores collection donates 4 leaves, not 20.
+        Members grouped by a DIFFERENT collection are detached first (their
+        shared state cannot be donated out from under the other group)."""
+        state: Dict[str, StateDict] = {}
+        for name, m in self.items(keep_base=True):
+            g = m.__dict__.get("_compute_group")
+            if g is not None and g.collection_ref() is not self:
+                m._group_cow_detach("compiled dispatch through another collection")
+        for owner_name, names in self._group_layout():
+            for n in names:
+                m = self._metrics[n]
+                m._computed = None
+                m._forward_cache = None
+            state[owner_name] = self._metrics[owner_name]._get_states()
+        return state
+
+    def _writeback_dispatch_state(self, new_state: Dict[str, StateDict]) -> int:
+        """Adopt a dispatch's output states (one bundle per layout entry) and
+        refresh every member's step flags; returns the number of per-member
+        updates the group dedup skipped this dispatch."""
+        skipped = 0
+        for owner_name, names in self._group_layout():
+            self._metrics[owner_name]._set_states(new_state[owner_name])
+            skipped += len(names) - 1
+            for n in names:
+                m = self._metrics[n]
+                m._update_called = True
+                m._computed = None
+        return skipped
 
     def _donation_safe_state(
         self, state: Dict[str, StateDict]
@@ -187,9 +599,11 @@ class MetricCollection:
         """Collection-wide :meth:`Metric._donation_safe_state`: default-aliased
         member leaves are defensively copied; ANY externally-held member leaf
         sends the whole dispatch to the copying executable (the executable is
-        one program — donation is all-or-nothing per step)."""
+        one program — donation is all-or-nothing per step). ``state`` is
+        keyed by layout entry (group owners + ungrouped members)."""
         aliased = None
-        for name, m in self.items(keep_base=True):
+        for name in state:
+            m = self._metrics[name]
             member = state[name]
             for sname in member:
                 v = member[sname]
@@ -222,15 +636,13 @@ class MetricCollection:
         return state, False
 
     def _forward_jitted(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        self._ensure_compute_groups(args, kwargs)
         fn = self._forward_dispatch()
-        state = {}
-        for name, m in self.items(keep_base=True):
-            # invalidated by the incoming batch anyway; clearing BEFORE the
-            # alias check keeps a cached compute() result that aliases a
-            # state leaf from being donated out from under a caller
-            m._computed = None
-            m._forward_cache = None
-            state[name] = m._get_states()
+        # _collect_dispatch_state clears the members' cached compute()/step
+        # values BEFORE the alias check (they're invalidated by the incoming
+        # batch anyway), so a cached result that aliases a state leaf cannot
+        # be donated out from under a caller still holding it
+        state = self._collect_dispatch_state()
         if fn.donate_state:
             state, donatable = self._donation_safe_state(state)
             if not donatable:
@@ -245,6 +657,7 @@ class MetricCollection:
                 t_start=start,
                 path="compiled",
                 members=len(self._metrics),
+                state_bundles=len(state),
                 compiled_this_call=bool(fn.last_compiled),
                 donated=fn.donate_state,
             )
@@ -253,10 +666,10 @@ class MetricCollection:
             # one compiled program serves every member: the collection key
             # carries the compile/retrace ledger, members count the dispatch
             _note_compiled_dispatch(self, fn, args, kwargs)
+        skipped = self._writeback_dispatch_state(new_state)
+        if record and skipped:
+            TELEMETRY.inc(self.telemetry_key, "update_dedup_skipped", skipped)
         for name, m in self.items(keep_base=True):
-            m._set_states(new_state[name])
-            m._update_called = True
-            m._computed = None
             if record:
                 TELEMETRY.inc(m.telemetry_key, "forward_compiled_calls")
             if not m.compute_on_step:
@@ -274,8 +687,9 @@ class MetricCollection:
         for the compiled collection program."""
         if not self._jit_forward_enabled:
             self.jit_forward(donate=self._jit_forward_donate)
+        self._ensure_compute_groups(sample_batch, kwargs)
         fn = self._forward_dispatch()
-        state = {name: m._get_states() for name, m in self.items(keep_base=True)}
+        state = self._collect_dispatch_state()
         start = time.perf_counter()
         compiled, fresh = fn.warm(state, *sample_batch, **kwargs)
         key = self.telemetry_key
@@ -312,7 +726,8 @@ class MetricCollection:
         self, state: Dict[str, StateDict], stacked: Tuple, stacked_kwargs: Dict
     ) -> Dict[str, StateDict]:
         """One ``lax.scan`` of the collection's shared :meth:`apply_update`
-        over the stacked leading axis (see :meth:`Metric._scan_update_many`)."""
+        over the stacked leading axis (see :meth:`Metric._scan_update_many`);
+        compute groups advance ONE shared state per group inside the scan."""
         leaves, treedef = jax.tree_util.tree_flatten((stacked, stacked_kwargs))
         scanned_ix = [i for i, leaf in enumerate(leaves) if getattr(leaf, "ndim", 0) >= 1]
 
@@ -321,40 +736,50 @@ class MetricCollection:
             for i, x in zip(scanned_ix, xs):
                 merged[i] = x
             args, kw = jax.tree_util.tree_unflatten(treedef, merged)
-            return self.apply_update(s, *args, **kw), None
+            return self._grouped_apply_update(s, *args, **kw), None
 
         new_state, _ = jax.lax.scan(body, state, tuple(leaves[i] for i in scanned_ix))
         return new_state
+
+    @staticmethod
+    def _microbatch_slice(stacked: Tuple, stacked_kwargs: Dict) -> Tuple[Tuple, Dict]:
+        """One micro-batch's avals out of ``update_many``'s stacked arguments
+        (rank >= 1 leaves lose their leading K axis; scalars broadcast)."""
+        slice0 = lambda x: x[0] if getattr(x, "ndim", 0) >= 1 else x  # noqa: E731
+        return (
+            jax.tree_util.tree_map(slice0, stacked),
+            jax.tree_util.tree_map(slice0, stacked_kwargs),
+        )
 
     def update_many(self, *stacked: Any, **stacked_kwargs: Any) -> None:
         """Accumulate K stacked micro-batches across EVERY member in ONE
         compiled dispatch (see :meth:`Metric.update_many`): a single
         ``lax.scan`` of the collection's shared update — shared-update
-        classes canonicalize once per micro-batch inside it — over the
-        donated collection state. One dispatch amortized over K × members
-        updates; works with or without :meth:`jit_forward` enabled."""
+        classes canonicalize once per micro-batch inside it, and compute
+        groups run one update per group — over the donated collection state.
+        One dispatch amortized over K × members updates; works with or
+        without :meth:`jit_forward` enabled."""
         for name, m in self.items(keep_base=True):
             try:
                 m._compiled_state_gate()
             except ValueError as err:
                 raise ValueError(f"member {name!r}: {err}") from None
         k = _microbatch_len(stacked, stacked_kwargs)
-        state = {}
-        for name, m in self.items(keep_base=True):
-            m._computed = None
-            m._forward_cache = None
-            state[name] = m._get_states()
+        self._ensure_compute_groups(*self._microbatch_slice(stacked, stacked_kwargs))
+        state = self._collect_dispatch_state()
         donatable = True
         if self._jit_forward_donate:
             state, donatable = self._donation_safe_state(state)
         if donatable and self._jit_forward_donate:
             if self._update_many_fn is None:
-                self._update_many_fn = CompiledDispatch(self._scan_update_many, donate_state=True)
+                self._update_many_fn = CompiledDispatch(
+                    self._scan_update_many, donate_state=True, context_fn=self._group_signature
+                )
             fn = self._update_many_fn
         else:
             if self._update_many_copy_fn is None:
                 self._update_many_copy_fn = CompiledDispatch(
-                    self._scan_update_many, donate_state=False
+                    self._scan_update_many, donate_state=False, context_fn=self._group_signature
                 )
             fn = self._update_many_copy_fn
         start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
@@ -377,21 +802,24 @@ class MetricCollection:
                     path="scan_microbatch",
                     batches=k,
                     members=len(self._metrics),
+                    state_bundles=len(state),
                     compiled_this_call=bool(fn.last_compiled),
                     donated=fn.donate_state,
                 )
-        for name, m in self.items(keep_base=True):
-            m._set_states(new_state[name])
-            m._update_called = True
-            m._computed = None
+        skipped = self._writeback_dispatch_state(new_state)
+        if TELEMETRY.enabled and skipped:
+            TELEMETRY.inc(self.telemetry_key, "update_dedup_skipped", skipped * k)
 
     def __getstate__(self) -> dict:
+        # group objects never serialize: each member's own __getstate__
+        # materializes the shared state (byte-compatible with an ungrouped
+        # 0.6.0 checkpoint), and groups rebuild at the next compiled dispatch
         return {
             k: v
             for k, v in self.__dict__.items()
             if k not in ("_jit_forward_fn", "_jit_forward_copy_fn", "_update_many_fn",
                          "_update_many_copy_fn", "_telemetry_key", "_jit_cache_seen",
-                         "_donation_warned")
+                         "_donation_warned", "_compute_groups_built")
         }
 
     def __setstate__(self, state: dict) -> None:
@@ -400,8 +828,12 @@ class MetricCollection:
         # this flag; default it off so their first forward() stays eager.
         # Donation (0.6.0) defaults on for enabled pickles — enablement
         # survives, the executable cache is rebuilt on first dispatch.
+        # Compute groups (0.7.0): the opt-out survives, the grouping itself
+        # is rebuilt (value-checked) at the next compiled dispatch.
         self.__dict__.setdefault("_jit_forward_enabled", False)
         self.__dict__.setdefault("_jit_forward_donate", True)
+        self.__dict__.setdefault("_compute_groups_enabled", True)
+        self._compute_groups_built = False
         self._donation_warned = False
         self._drop_compiled_dispatch()
 
@@ -414,16 +846,22 @@ class MetricCollection:
                 groups.setdefault(key, []).append(name)
         return groups
 
-    def _shared_deltas(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+    def _shared_deltas(
+        self, args: Tuple, kwargs: Dict, exclude: Optional[set] = None
+    ) -> Dict[str, Any]:
         """Per-batch partial statistics computed ONCE per equivalence class.
 
         Metrics advertising the same :meth:`Metric._shared_update_key` (e.g.
         Precision/Recall/F1 with identical stat-scores settings) get one
         canonicalization + one tp/fp/tn/fn pass instead of one each — the
         collection-level fusion the reference leaves on the table (every
-        member keeps private states, SURVEY §3.3)."""
+        member keeps private states, SURVEY §3.3). ``exclude`` names members
+        a compute group already serves (their shared state advances without
+        any per-member deltas at all)."""
         deltas: Dict[str, Any] = {}
         for names in self._class_groups().values():
+            if exclude:
+                names = [n for n in names if n not in exclude]
             if len(names) < 2:
                 continue
             rep = self._metrics[names[0]]
@@ -449,7 +887,17 @@ class MetricCollection:
         adopted: list = []
         try:
             # adoption runs INSIDE the try so a failure while syncing a later
-            # class still restores members already pointed at synced states
+            # class still restores members already pointed at synced states.
+            # Compute-group followers never sync themselves: their reads
+            # delegate to the owner, whose bundle is gathered once — flip
+            # their _to_sync off (restored by the finally) so their compute()
+            # cannot issue a duplicate gather of the shared state.
+            if self.__dict__.get("_compute_groups_built", False):
+                for _, names in self._group_layout():
+                    for n in names[1:]:
+                        mm = self._metrics[n]
+                        adopted.append((mm, None, mm._to_sync))
+                        mm._to_sync = False
             self._adopt_packed_synced_states(adopted)
             return {k: m.compute() for k, m in self.items()}
         finally:
@@ -473,16 +921,30 @@ class MetricCollection:
         falls back to the per-class adoption + per-member self-sync."""
         from metrics_tpu.utilities import distributed as _dist
 
+        # compute-group members share ONE live state: the owner's bundle
+        # gathers once for the whole group (the followers' _to_sync is
+        # already off, see compute()), and the class-alias fan-out below must
+        # not point a follower at a private state copy
+        cg_members: set = set()
+        cg_sizes: Dict[str, int] = {}
+        if self.__dict__.get("_compute_groups_built", False):
+            for owner_name, names in self._group_layout():
+                if len(names) > 1:
+                    cg_members.update(names)
+                    cg_sizes[owner_name] = len(names)
+
         if not _dist.distributed_available():
             # no packed transport to save; class adoption still dedups
             # injected-gather classes
-            return self._adopt_class_synced_states(adopted)
+            return self._adopt_class_synced_states(adopted, skip=cg_members or None)
 
         alias: Dict[str, list] = {}  # rep name -> all class member names
         aliased = set()
         for names in self._class_groups().values():
             if len(names) < 2:
                 continue
+            if cg_members and any(n in cg_members for n in names):
+                continue  # served by a compute group's shared state
             if all(self._metrics[n]._computed is not None for n in names):
                 continue  # every member returns its cached value; don't re-gather
             rep = self._metrics[names[0]]
@@ -521,6 +983,8 @@ class MetricCollection:
             sync_start = time.perf_counter() if EVENTS.enabled else None
             gathered = _dist.gather_all_pytrees([states for states, _ in pre], group=group)
             if sync_start is not None:
+                # compute_groups: how many members each gathered bundle
+                # serves (owner -> group size) — the transport-dedup evidence
                 EVENTS.record(
                     "sync",
                     self.telemetry_key,
@@ -528,6 +992,7 @@ class MetricCollection:
                     t_start=sync_start,
                     members=list(names),
                     packed=True,
+                    compute_groups={n: cg_sizes[n] for n in names if n in cg_sizes},
                 )
             for n, (states, list_dtypes), g in zip(names, pre, gathered):
                 m = self._metrics[n]
@@ -549,7 +1014,10 @@ class MetricCollection:
         # anything not packable (injected gathers, overridden sync) still
         # gets the per-class dedup it had before
         remaining: list = []
-        self._adopt_class_synced_states(remaining, skip={n for _, ns in bundles.values() for n in ns} | aliased)
+        self._adopt_class_synced_states(
+            remaining,
+            skip={n for _, ns in bundles.values() for n in ns} | aliased | cg_members,
+        )
         adopted.extend(remaining)
 
     def _adopt_class_synced_states(self, adopted: list, skip: Optional[set] = None) -> None:
@@ -587,8 +1055,23 @@ class MetricCollection:
                 m._to_sync = False
 
     def reset(self) -> None:
-        for _, m in self.items(keep_base=True):
-            m.reset()
+        if not self.__dict__.get("_compute_groups_built", False):
+            for _, m in self.items(keep_base=True):
+                m.reset()
+            return
+        # group-aware: the shared state resets ONCE per group and the group
+        # stays intact (a member-level reset() would CoW-detach itself)
+        for owner_name, names in self._group_layout():
+            if len(names) == 1:
+                self._metrics[owner_name].reset()
+                continue
+            owner = self._metrics[owner_name]
+            owner._set_states(owner.init_state())
+            for n in names:
+                m = self._metrics[n]
+                m._reset_flags()
+                if TELEMETRY.enabled:
+                    TELEMETRY.inc(m.telemetry_key, "reset_calls")
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
@@ -609,6 +1092,11 @@ class MetricCollection:
         return destination
 
     def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        # restored per-member states must be honored: dissolve the groups
+        # first (each member materializes, then loads its own values); the
+        # next compiled dispatch regroups only members whose restored states
+        # still agree (build_compute_groups value-checks)
+        self._dissolve_compute_groups()
         for name, m in self.items(keep_base=True):
             m.load_state_dict(state_dict, prefix=f"{prefix}{name}.")
 
@@ -626,7 +1114,7 @@ class MetricCollection:
         Metrics in the same shared-update equivalence class get their partial
         statistics computed once and fanned out (one canonicalization + one
         stat-scores kernel for e.g. Precision+Recall+F1)."""
-        shared = self._shared_deltas(*args, **kwargs)
+        shared = self._shared_deltas(args, kwargs)
         return {
             name: (
                 m._apply_accumulate(state[name], shared[name])
@@ -671,12 +1159,23 @@ class MetricCollection:
         return out
 
     def _in_graph_alias(self, axis_name: Any) -> Dict[str, list]:
-        """Shared-update classes whose members may alias ONE synced bundle
-        in-graph: rep name -> all member names. Alias only when the members'
-        state specs (and, with ``axis_name`` unset, their fallback axes)
-        genuinely coincide."""
+        """Shared-update classes AND built compute groups whose members may
+        alias ONE synced bundle in-graph: rep name -> all member names.
+        Class aliases apply only when the members' state specs (and, with
+        ``axis_name`` unset, their fallback axes) genuinely coincide;
+        compute groups guarantee both by fingerprint, so every built group
+        aliases directly — their states are identical by the exact-trace
+        construction whenever they come from this collection's
+        ``init_state``/``apply_update`` chain."""
         alias: Dict[str, list] = {}
+        taken: set = set()
+        if self.__dict__.get("_compute_groups_built", False):
+            for owner_name, names in self._group_layout():
+                if len(names) > 1:
+                    alias[owner_name] = names
+                    taken.update(names)
         for names in self._class_groups().values():
+            names = [n for n in names if n not in taken]
             if len(names) < 2:
                 continue
             rep = self._metrics[names[0]]
@@ -702,10 +1201,13 @@ class MetricCollection:
         )
 
     def _packed_presync(
-        self, state: Dict[str, StateDict], names: list, axis: Any
+        self, state: Dict[str, StateDict], names: list, axis: Any,
+        group_sizes: Optional[Dict[str, int]] = None,
     ) -> Dict[str, StateDict]:
         """One packed in-graph sync over ``axis`` for the named members'
-        bundles: leaves from EVERY bundle share the (kind, dtype) buckets."""
+        bundles: leaves from EVERY bundle share the (kind, dtype) buckets.
+        ``group_sizes`` annotates how many members each bundle serves
+        (compute groups / class aliases) for the sync telemetry."""
         from metrics_tpu.utilities.distributed import sync_state_packed
 
         flat_state: Dict[str, Any] = {}
@@ -716,7 +1218,9 @@ class MetricCollection:
                 flat_state[f"{n}\x1f{k}"] = v
                 flat_reductions[f"{n}\x1f{k}"] = m._reductions[k]
         try:
-            synced_flat = sync_state_packed(flat_state, flat_reductions, axis)
+            synced_flat = sync_state_packed(
+                flat_state, flat_reductions, axis, group_composition=group_sizes
+            )
         except NameError as err:  # unbound collective axis — mirror Metric.sync_state
             raise NameError(
                 f"{err}. The collection members resolve to mesh axis {axis!r} — collectives"
@@ -750,7 +1254,12 @@ class MetricCollection:
                     presynced[n] = synced
 
         for axis, names in bundles.values():
-            synced_bundles = self._packed_presync(state, names, axis)
+            synced_bundles = self._packed_presync(
+                state,
+                names,
+                axis,
+                group_sizes={n: len(alias[n]) for n in names if len(alias.get(n, ())) > 1},
+            )
             for n, synced in synced_bundles.items():
                 for member in alias.get(n, [n]):
                     presynced[member] = synced
@@ -887,7 +1396,11 @@ class MetricCollection:
         before = set(self._metrics) if getattr(self, "_jit_forward_enabled", False) else None
         self._add_metrics(metrics, *additional_metrics)
         # any cached update_many executable baked in the OLD member set too —
-        # and it exists independently of jit_forward enablement
+        # and it exists independently of jit_forward enablement. Compute
+        # groups likewise baked the old member set: dissolve, rebuild at the
+        # next compiled dispatch against the grown membership.
+        if getattr(self, "_compute_groups_built", False):
+            self._dissolve_compute_groups()
         self._update_many_fn = None
         self._update_many_copy_fn = None
         if before is not None:
@@ -972,6 +1485,10 @@ class MetricCollection:
             value._jit_forward_gate()
             self._jit_forward_fn = None
             self._jit_forward_copy_fn = None
+        if getattr(self, "_compute_groups_built", False):
+            # the replaced member may own (or belong to) a group: dissolve
+            # all assignments; the next compiled dispatch regroups
+            self._dissolve_compute_groups()
         self._update_many_fn = None
         self._update_many_copy_fn = None
         self._metrics[key] = value

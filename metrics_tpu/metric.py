@@ -33,6 +33,7 @@ import inspect
 import os
 import sys
 import time
+import weakref
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
@@ -193,6 +194,35 @@ def _microbatch_len(args: Tuple, kwargs: Dict) -> int:
     return lengths.pop()
 
 
+#: sentinel for "attribute absent" in the bound-state save/restore protocol
+_ABSENT = object()
+
+
+class _ComputeGroup:
+    """One shared live state serving several provably-identical metrics.
+
+    Built by ``MetricCollection.build_compute_groups`` from exact update-trace
+    fingerprints (:func:`~metrics_tpu.utilities.aot.trace_fingerprint`):
+    every member's per-batch update lowers to the same program over the same
+    state layout, so ONE update on ``owner``'s state advances all of them and
+    each member's ``compute()`` reads the shared state through attribute
+    delegation (``Metric.__getattr__``). Followers hold no state attributes
+    of their own; any out-of-band mutation of a member (a direct state write,
+    a standalone ``update()``/``forward()``) copy-on-write detaches that
+    member (:meth:`Metric._group_cow_detach`) instead of corrupting siblings.
+    """
+
+    __slots__ = ("owner", "members", "collection_ref", "collection_key", "warned")
+
+    def __init__(self, owner: "Metric", members: List["Metric"], collection: Any = None,
+                 collection_key: Optional[str] = None) -> None:
+        self.owner = owner
+        self.members = list(members)
+        self.collection_ref = weakref.ref(collection) if collection is not None else (lambda: None)
+        self.collection_key = collection_key
+        self.warned = False
+
+
 class Metric(ABC):
     """Base class of all metrics.
 
@@ -258,6 +288,7 @@ class Metric(ABC):
         self._update_many_fn: Optional[CompiledDispatch] = None
         self._update_many_copy_fn: Optional[CompiledDispatch] = None
         self._donation_warned = False
+        self._compute_group: Optional[_ComputeGroup] = None
 
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
@@ -279,6 +310,90 @@ class Metric(ABC):
             key = TELEMETRY.register(self)
             self._telemetry_key = key
         return key
+
+    # ------------------------------------------------------------------
+    # compute-group state sharing (see _ComputeGroup / collections.py)
+    # ------------------------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        # fires only when normal lookup fails: a grouped follower holds NO
+        # state attributes of its own — reads delegate to the group owner's
+        # live state, so five grouped metrics hold ONE state pytree
+        d = object.__getattribute__(self, "__dict__")
+        group = d.get("_compute_group")
+        if group is not None and name in d.get("_defaults", ()):
+            owner = group.owner
+            if owner is not self:
+                return getattr(owner, name)
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        # copy-on-write guard: a DIRECT write to a grouped member's state
+        # (``precision.tp = 0``, including via a collection's items()/values())
+        # detaches the member from its group first — siblings keep the
+        # pre-write shared state — instead of silently corrupting them.
+        # Pure-API calls are exempt (``_bound_state`` swaps a temporary state
+        # in and out at dict level and raises the ``_group_bound`` depth);
+        # internal machinery writes through ``_set_states``/``__dict__``.
+        d = self.__dict__
+        if (
+            d.get("_compute_group") is not None
+            and not d.get("_group_bound", 0)
+            and name in d.get("_defaults", ())
+        ):
+            self._group_cow_detach(f"direct write to state `{name}`")
+        object.__setattr__(self, name, value)
+
+    def _group_cow_detach(self, reason: Optional[str]) -> None:
+        """Leave the compute group, keeping every party's state intact.
+
+        A detaching FOLLOWER materializes the current shared state into its
+        own attributes; a detaching OWNER first hands the live state to the
+        next member (ownership transfer), so siblings continue unaffected
+        either way. With a ``reason`` this is a user-visible copy-on-write
+        event (one-shot warning per group + ``group_cow_detach`` counters);
+        ``None`` is the silent administrative form (group dissolution,
+        ``load_state_dict``). A group shrunk to one member dissolves.
+        """
+        group = self.__dict__.get("_compute_group")
+        if group is None:
+            return
+        owner = group.owner
+        if owner is self:
+            heirs = [m for m in group.members if m is not self]
+            if heirs:
+                new_owner = heirs[0]
+                for name in self._defaults:
+                    value = self.__dict__.get(name)
+                    new_owner.__dict__[name] = list(value) if isinstance(value, list) else value
+                group.owner = new_owner
+        else:
+            for name in self._defaults:
+                value = getattr(owner, name)
+                self.__dict__[name] = list(value) if isinstance(value, list) else value
+        group.members = [m for m in group.members if m is not self]
+        self.__dict__["_compute_group"] = None
+        if len(group.members) == 1:
+            group.members[0].__dict__["_compute_group"] = None
+            group.members = []
+        if reason is None:
+            return
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "group_cow_detach")
+            if group.collection_key is not None:
+                TELEMETRY.inc(group.collection_key, "group_cow_detach")
+        if EVENTS.enabled:
+            EVENTS.record("update", self.telemetry_key, path="group_cow_detach", reason=reason)
+        if not group.warned:
+            group.warned = True
+            rank_zero_warn(
+                f"{type(self).__name__} was detached from its compute group ({reason}):"
+                " grouped metrics share ONE state, so out-of-band mutations apply to a"
+                " private copy instead of corrupting the sibling metrics. The remaining"
+                " members keep sharing their state; pass compute_groups=False to"
+                " MetricCollection to disable grouping entirely.",
+                UserWarning,
+            )
 
     # ------------------------------------------------------------------
     # state registry
@@ -338,19 +453,40 @@ class Metric(ABC):
         return {name: getattr(self, name) for name in self._defaults}
 
     def _set_states(self, state: StateDict) -> None:
+        # internal write path: bypasses the compute-group copy-on-write guard
+        # (library machinery — dispatch writebacks, sync adoption, reset —
+        # owns the group discipline; only USER-facing mutations detach)
         for name, value in state.items():
-            setattr(self, name, value)
+            object.__setattr__(self, name, value)
 
     @contextmanager
     def _bound_state(self, state: StateDict):
-        """Temporarily swap ``state`` in as the live state (pure-call plumbing)."""
-        saved = self._get_states()
+        """Temporarily swap ``state`` in as the live state (pure-call plumbing).
+
+        Operates on ``__dict__`` directly so a grouped member round-trips
+        exactly: a follower's saved "state" is the ABSENCE of the attribute
+        (reads delegate to the group owner), and restoring re-establishes
+        that absence instead of materializing a stale private copy. The
+        ``_group_bound`` depth marks update-body writes (``self.tp = ...``)
+        as pure-call internals for the copy-on-write guard.
+        """
+        d = self.__dict__
+        names = set(state) | set(self._defaults)
+        saved = {name: d.get(name, _ABSENT) for name in names}
         saved_flags = (self._computed, self._update_called, self._forward_cache)
-        self._set_states(state)
+        depth = d.get("_group_bound", 0)
+        for name, value in state.items():
+            d[name] = value
+        d["_group_bound"] = depth + 1
         try:
             yield
         finally:
-            self._set_states(saved)
+            for name, value in saved.items():
+                if value is _ABSENT:
+                    d.pop(name, None)
+                else:
+                    d[name] = value
+            d["_group_bound"] = depth
             self._computed, self._update_called, self._forward_cache = saved_flags
 
     def apply_update(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
@@ -550,6 +686,10 @@ class Metric(ABC):
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate this batch and (if ``compute_on_step``) return its value."""
+        if self.__dict__.get("_compute_group") is not None:
+            # out-of-band accumulation: a standalone forward() on a grouped
+            # member would advance the SHARED state for every sibling
+            self._group_cow_detach("standalone forward() on a grouped member")
         with eager_span(f"{self.__class__.__name__}.forward"):
             if self._jit_forward_enabled:
                 return self._forward_jitted(*args, **kwargs)
@@ -863,6 +1003,8 @@ class Metric(ABC):
         ``donate=False`` opt-out; the same eligibility rules apply
         (``ValueError`` for unbounded list states).
         """
+        if self.__dict__.get("_compute_group") is not None:
+            self._group_cow_detach("standalone update_many() on a grouped member")
         self._compiled_state_gate()
         k = _microbatch_len(stacked, stacked_kwargs)
         self._computed = None
@@ -956,6 +1098,8 @@ class Metric(ABC):
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if self.__dict__.get("_compute_group") is not None and not self.__dict__.get("_group_bound", 0):
+                self._group_cow_detach("standalone update() on a grouped member")
             self._computed = None
             self._update_called = True
             observed = TELEMETRY.enabled or EVENTS.enabled
@@ -1165,6 +1309,11 @@ class Metric(ABC):
 
     def reset(self) -> None:
         """Restore every state to its default."""
+        if self.__dict__.get("_compute_group") is not None:
+            # a standalone reset on one grouped member must not wipe the
+            # siblings' accumulation; MetricCollection.reset() resets the
+            # shared state once per group without detaching anyone
+            self._group_cow_detach("standalone reset() on a grouped member")
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, "reset_calls")
         self._reset_flags()
@@ -1202,6 +1351,14 @@ class Metric(ABC):
             return True
 
     def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        if self.__dict__.get("_compute_group") is not None and any(
+            prefix + key in state_dict for key in self._defaults
+        ):
+            # the restored per-member state must be honored even when it
+            # diverges from the group's shared state: silent detach, then
+            # load into this member's own attributes. The owning collection's
+            # next compiled dispatch rebuilds groups (value-checked).
+            self._group_cow_detach(None)
         for key in self._defaults:
             name = prefix + key
             if name in state_dict:
@@ -1305,8 +1462,17 @@ class Metric(ABC):
             for k, v in self.__dict__.items()
             if k not in ("update", "compute", "_update_signature", "_jit_forward_fn",
                          "_jit_forward_copy_fn", "_update_many_fn", "_update_many_copy_fn",
-                         "_telemetry_key", "_jit_cache_seen", "_donation_warned")
+                         "_telemetry_key", "_jit_cache_seen", "_donation_warned",
+                         "_compute_group", "_group_bound")
         }
+        if self.__dict__.get("_compute_group") is not None:
+            # a grouped member's dict may hold no state attributes at all
+            # (follower) — MATERIALIZE the shared values so the serialized
+            # form is byte-compatible with an ungrouped 0.6.0 checkpoint and
+            # the unpickled copy stands alone
+            for name in self._defaults:
+                value = getattr(self, name)
+                state[name] = list(value) if isinstance(value, list) else value
         # jax arrays serialize as host numpy and are restored on the default device
         return apply_to_collection(state, jax.Array, np.asarray)
 
@@ -1318,6 +1484,10 @@ class Metric(ABC):
         # survives, the executable cache is rebuilt on first dispatch.
         self.__dict__.setdefault("_jit_forward_enabled", False)
         self.__dict__.setdefault("_jit_forward_donate", True)
+        # compute groups (0.7.0) never serialize: the unpickled copy stands
+        # alone with materialized states, and 0.6.0-and-earlier pickles
+        # predate the attribute entirely
+        self.__dict__.setdefault("_compute_group", None)
         self._donation_warned = False
         self._drop_compiled_dispatch()
         self._update_signature = inspect.signature(self.update)

@@ -99,6 +99,18 @@ def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
         mem = entry.get("state_memory")
         if mem is not None:
             emit("state_bytes", {"metric": key}, mem.get("total_bytes", 0), type_="gauge")
+        cg = entry.get("info", {}).get("compute_groups")
+        if cg is not None:
+            # group composition as gauges: group count, plus members served
+            # per group (labeled by the group owner's member name)
+            emit("compute_groups", {"metric": key}, len(cg.get("groups", {})), type_="gauge")
+            for owner, members in sorted(cg.get("groups", {}).items()):
+                emit(
+                    "compute_group_members",
+                    {"metric": key, "group": owner},
+                    len(members),
+                    type_="gauge",
+                )
 
     retrace = snap.get("retrace", {})
     for key, rec in sorted(retrace.get("metrics", {}).items()):
@@ -123,7 +135,7 @@ def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
         emit("sync_in_graph_collectives_total", {"kind": kind}, n)
     for bucket, n in sorted(in_graph.get("buckets", {}).items()):
         emit("sync_in_graph_bucket_states_total", {"bucket": bucket}, n)
-    for field in ("collectives_before", "collectives_after"):
+    for field in ("collectives_before", "collectives_after", "dedup_groups", "dedup_members"):
         if field in in_graph:
             emit(f"sync_in_graph_{field}_total", {}, in_graph[field], type_="counter")
 

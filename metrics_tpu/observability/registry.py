@@ -81,6 +81,11 @@ def _fresh_sync_stats() -> Dict[str, Any]:
             "buckets": {},
             "collectives_before": 0,
             "collectives_after": 0,
+            # deduped bundles riding the packed buckets: how many bundle
+            # syncs served >1 member (compute groups / shared-update
+            # classes), and how many member states they served in total
+            "dedup_groups": 0,
+            "dedup_members": 0,
         },
     }
 
@@ -143,6 +148,16 @@ class TelemetryRegistry:
             counters = self._entry(key)["counters"]
             counters[counter] = counters.get(counter, 0) + n
 
+    def set_info(self, key: str, name: str, value: Any) -> None:
+        """Attach a JSON-serializable info blob to ``key``'s snapshot entry
+        (latest value wins — a gauge-like annotation, not a counter). Used
+        for structured composition data, e.g. a collection's compute-group
+        layout."""
+        if not self._enabled:
+            return
+        with self._lock:
+            self._entry(key).setdefault("info", {})[name] = value
+
     def observe(self, key: str, phase: str, seconds: float) -> None:
         if not self._enabled:
             return
@@ -197,12 +212,15 @@ class TelemetryRegistry:
         buckets: Optional[Dict[str, int]] = None,
         collectives_before: int = 0,
         collectives_after: int = 0,
+        groups: Optional[Dict[str, int]] = None,
     ) -> None:
         """Trace-time record of one ``sync_in_graph``/``sync_state_packed``
         lowering: which XLA collectives the state bundle compiles to, the
         (pre-collective) payload size, the packed bucket composition
-        (``"<kind>/<dtype>" -> state count``), and the per-leaf vs issued
-        collective counts. Runs once per trace, never per step."""
+        (``"<kind>/<dtype>" -> state count``), the per-leaf vs issued
+        collective counts, and the deduped-bundle composition (``groups``:
+        bundle label -> member count it serves — compute groups and
+        shared-update classes). Runs once per trace, never per step."""
         if not self._enabled:
             return
         with self._lock:
@@ -212,6 +230,9 @@ class TelemetryRegistry:
             ig["bytes_traced"] += int(bytes_traced)
             ig["collectives_before"] += int(collectives_before)
             ig["collectives_after"] += int(collectives_after)
+            for n in (groups or {}).values():
+                ig["dedup_groups"] += 1
+                ig["dedup_members"] += int(n)
             for kind, n in kinds.items():
                 ig["collectives"][kind] = ig["collectives"].get(kind, 0) + n
             for label, n in (buckets or {}).items():
@@ -251,6 +272,8 @@ class TelemetryRegistry:
                 out: Dict[str, Any] = {"counters": dict(entry["counters"])}
                 if include_timers and entry["timers"]:
                     out["timers"] = {phase: h.to_dict() for phase, h in entry["timers"].items()}
+                if entry.get("info"):
+                    out["info"] = dict(entry["info"])
                 if key in dead:
                     out["dead"] = True
                 metrics[key] = out
@@ -272,6 +295,8 @@ class TelemetryRegistry:
                 "buckets": dict(ig["buckets"]),
                 "collectives_before": ig["collectives_before"],
                 "collectives_after": ig["collectives_after"],
+                "dedup_groups": ig["dedup_groups"],
+                "dedup_members": ig["dedup_members"],
             }
         # state memory reads live objects outside the lock (it may touch
         # arbitrary metric code)

@@ -27,12 +27,13 @@ which branches host-side in ``update``), while python ``int``/``float``
 leaves are traced as weak-typed scalars (so a stream of varying python
 numbers costs one compile, not one per value).
 """
+import hashlib
 import time
-from typing import Any, Callable, Dict, List, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["CompiledDispatch"]
+__all__ = ["CompiledDispatch", "trace_fingerprint"]
 
 #: leaf-layout markers: traced (device data) vs static (baked into the program)
 _TRACED = 0
@@ -52,9 +53,17 @@ class CompiledDispatch:
     Not thread-safe (same contract as the jit cache it replaces).
     """
 
-    def __init__(self, fn: Callable, donate_state: bool = True) -> None:
+    def __init__(
+        self, fn: Callable, donate_state: bool = True, context_fn: Optional[Callable[[], Any]] = None
+    ) -> None:
         self._fn = fn
         self.donate_state = bool(donate_state)
+        #: optional hashable-context provider mixed into every cache key —
+        #: the compute-group engine passes the collection's group signature
+        #: here, so a group rebuild dispatches to a matching executable
+        #: (and a rebuild back to a previous layout is a cache HIT, not a
+        #: recompile) without dropping the whole dispatch cache
+        self._context_fn = context_fn
         self._cache: Dict[Any, Any] = {}
         #: True when the most recent warm()/__call__ compiled a fresh executable
         self.last_compiled = False
@@ -110,6 +119,7 @@ class CompiledDispatch:
         except TypeError:  # unhashable static leaf: degrade to repr identity
             static_key = tuple(repr(s) for s in static)
         return (
+            self._context_fn() if self._context_fn is not None else None,
             state_def,
             tuple(self._sig(leaf) for leaf in state_leaves),
             treedef,
@@ -192,3 +202,46 @@ class CompiledDispatch:
     def _cache_size(self) -> int:
         """Compiled-executable count (the retrace ledger's cache watermark)."""
         return len(self._cache)
+
+
+def trace_fingerprint(fn: Callable, state: Any, args: Tuple, kwargs: Dict) -> Tuple:
+    """Exact trace identity of ``fn(state, *args, **kwargs)`` under the SAME
+    traced/static argument partition a :class:`CompiledDispatch` would use.
+
+    Returns a hashable tuple ``(jaxpr_text, const_digest, static_leaves,
+    layout, treedef_repr)``. Two calls fingerprint equal **iff** they lower to
+    the same program for the same dispatch signature: the canonical jaxpr
+    pretty-print captures every traced op and literal (two metrics differing
+    only in a baked-in ``threshold`` print different jaxprs), the SHA-256 over
+    the closed-over constants catches programs whose text coincides but whose
+    captured arrays differ (e.g. different binned-threshold buffers), and the
+    static leaves/layout/treedef pin the host-side half of the dispatch key.
+    This is what lets ``MetricCollection`` build compute groups *exactly* —
+    by program identity — rather than by the reference's runtime heuristics.
+    """
+    import jax
+
+    treedef, layout, traced, static = CompiledDispatch._split(args, kwargs)
+
+    def call(state: Any, traced_leaves: Tuple) -> Any:
+        merged: List[Any] = []
+        t = iter(traced_leaves)
+        s = iter(static)
+        for kind in layout:
+            merged.append(next(t) if kind == _TRACED else next(s))
+        a, kw = jax.tree_util.tree_unflatten(treedef, merged)
+        return fn(state, *a, **kw)
+
+    closed = jax.make_jaxpr(call)(state, tuple(traced))
+    digest = hashlib.sha256()
+    for const in closed.consts:
+        arr = np.asarray(const)
+        digest.update(str(arr.dtype).encode())
+        digest.update(str(arr.shape).encode())
+        digest.update(np.ascontiguousarray(arr).tobytes())
+    try:
+        hash(static)
+        static_key: Tuple = static
+    except TypeError:
+        static_key = tuple(repr(s) for s in static)
+    return (str(closed.jaxpr), digest.hexdigest(), static_key, layout, repr(treedef))

@@ -593,12 +593,15 @@ def _record_in_graph_telemetry(
     buckets: Optional[Dict[str, int]] = None,
     collectives_before: int = 0,
     collectives_after: int = 0,
+    groups: Optional[Dict[str, int]] = None,
 ) -> None:
     """Trace-time record of one in-graph sync lowering (registry + event
     timeline). ``kinds`` counts STATES per collective kind; ``buckets`` maps
     ``"<kind>/<dtype>"`` labels to the leaf count each packed bucket carries;
-    before/after are the per-leaf vs actually-issued collective counts.
-    Never raises."""
+    before/after are the per-leaf vs actually-issued collective counts;
+    ``groups`` maps each deduped bundle (a compute group or shared-update
+    class) to the member count it serves — the leaf-set the transport did
+    NOT have to carry. Never raises."""
     try:
         from metrics_tpu.observability.events import EVENTS
         from metrics_tpu.observability.registry import TELEMETRY
@@ -610,6 +613,7 @@ def _record_in_graph_telemetry(
             buckets=buckets,
             collectives_before=collectives_before,
             collectives_after=collectives_after,
+            groups=groups,
         )
         if EVENTS.enabled:
             # instant event at TRACE time (once per compile, never per
@@ -625,6 +629,8 @@ def _record_in_graph_telemetry(
             }
             if buckets is not None:
                 payload["buckets"] = dict(buckets)
+            if groups:
+                payload["compute_groups"] = dict(groups)
             EVENTS.record("sync", None, **payload)
     except Exception:  # pragma: no cover - telemetry must never break a sync
         pass
@@ -653,6 +659,8 @@ def sync_state_packed(
     state: Dict[str, Union[Array, List[Array]]],
     reductions: Dict[str, ReduceFx],
     axis_name: AxisName,
+    *,
+    group_composition: Optional[Dict[str, int]] = None,
 ) -> Dict[str, Union[Array, List[Array]]]:
     """Bucketed in-graph sync: ONE collective per (collective kind, dtype).
 
@@ -681,6 +689,11 @@ def sync_state_packed(
     Telemetry (trace-time, once per compile): bucket composition
     (``"<kind>/<dtype>" -> leaf count``) and the before/after collective
     counts land in ``snapshot()["sync"]["in_graph"]`` and the sync event.
+    ``group_composition`` (``bundle label -> members served``) annotates
+    bundles a caller already deduplicated — a ``MetricCollection``'s compute
+    groups or shared-update classes syncing ONE leaf-set for several
+    members — so the sync event and ``in_graph`` stats carry the group
+    composition alongside the bucket packing.
     """
     from metrics_tpu.utilities.data import dim_zero_cat
 
@@ -761,5 +774,6 @@ def sync_state_packed(
             buckets=bucket_compo,
             collectives_before=per_leaf_collectives,
             collectives_after=len(buckets) + callable_leaves,
+            groups=group_composition,
         )
     return synced

@@ -820,6 +820,74 @@ def bench_forward_scan_microbatch():
 bench_forward_scan_microbatch._force_cpu = True
 
 
+def bench_collection_compute_groups():
+    """Trace-fingerprinted compute groups: the canonical 5-member stat-scores
+    collection (Precision/Recall/F1/Specificity/StatScores, same config) runs
+    ONE donated update on ONE shared state per step, against the
+    ``compute_groups=False`` baseline whose compiled step still runs five
+    identical updates over five private state bundles. Both sides AOT-warmed
+    ``jit_forward`` dispatches of the same batch. The record carries the
+    dedup evidence: ``groups`` (multi-member groups formed),
+    ``updates_per_step`` (state bundles the compiled step threads), and
+    ``sync_leaves_before``/``sync_leaves_after`` (state leaves the epoch
+    sync would ship ungrouped vs grouped). CPU-pinned like the other
+    stateful configs (per-step host dispatch through the tunnel would
+    measure the link)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import F1, MetricCollection, Precision, Recall, Specificity, StatScores
+
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH))
+
+    def members():
+        kw = dict(average="macro", num_classes=NUM_CLASSES)
+        return [
+            Precision(**kw),
+            Recall(**kw),
+            F1(**kw),
+            Specificity(**kw),
+            StatScores(reduce="macro", num_classes=NUM_CLASSES),
+        ]
+
+    grouped = MetricCollection(members()).jit_forward()
+    ungrouped = MetricCollection(members(), compute_groups=False).jit_forward()
+    grouped.warmup(p, t)  # builds the compute groups, then AOT-compiles
+    ungrouped.warmup(p, t)
+
+    layout = grouped._group_layout()
+    leaves_after = len(jax.tree_util.tree_leaves(grouped._collect_dispatch_state()))
+    leaves_before = len(
+        jax.tree_util.tree_leaves({n: m._get_states() for n, m in ungrouped.items(keep_base=True)})
+    )
+
+    def grouped_step():
+        grouped(p, t)
+        jax.block_until_ready(grouped["Precision"].tp)
+
+    def ungrouped_step():
+        ungrouped(p, t)
+        jax.block_until_ready(ungrouped["Precision"].tp)
+
+    ours = _time_eager_loop(grouped_step)
+
+    def ref(torchmetrics, torch):  # our own ungrouped compiled step is the baseline
+        return _time_eager_loop(ungrouped_step)
+
+    extra = {
+        "groups": sum(1 for _, ns in layout if len(ns) > 1),
+        "updates_per_step": len(layout),
+        "sync_leaves_before": int(leaves_before),
+        "sync_leaves_after": int(leaves_after),
+    }
+    return "collection_update_compute_groups", ours, ref, "us/step", extra
+
+
+bench_collection_compute_groups._force_cpu = True
+
+
 # ------------------------------------------------ packed collective sync
 #: scan length for the in-graph sync config (tiny per-step states -> the
 #: sync program itself is the signal; shorter than STEPS is plenty)
@@ -1137,6 +1205,7 @@ CONFIG_META = {
     "bench_eager_forward": ("stateful_forward_step_cpu", "us/step"),
     "bench_stateful_forward_donated": ("stateful_forward_donated_step", "us/step"),
     "bench_forward_scan_microbatch": ("forward_scan_microbatch", "us/step"),
+    "bench_collection_compute_groups": ("collection_update_compute_groups", "us/step"),
     "bench_collection_sync_in_graph": ("collection_sync_in_graph_step", "us/step"),
     "bench_collection_sync_eager": ("collection_sync_eager_epoch", "us/epoch"),
 }
@@ -1154,6 +1223,7 @@ CONFIGS = [
     bench_eager_forward,
     bench_stateful_forward_donated,
     bench_forward_scan_microbatch,
+    bench_collection_compute_groups,
     bench_collection_sync_in_graph,
     bench_collection_sync_eager,
     bench_collection,

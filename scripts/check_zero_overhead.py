@@ -43,6 +43,16 @@ copy donation exists to remove. The aliased-leaf counts are checked for
 self-consistency (aliased == state leaves, version-independent) and pinned
 against the baseline (``donation_aliasing``) so a lowering change that
 silently reintroduces copies fails the gate.
+
+Fourth pin: **compute-group fusion**. The canonical stat-scores collection
+(``Precision/Recall/F1/Specificity/StatScores``, same config) must
+trace-fingerprint into ONE compute group, so its compiled step runs exactly
+one update program over one donated 4-leaf state bundle (vs five), and its
+in-graph epoch sync lowers to one collective for the whole quintet. The
+group count, per-step update count, donated leaf/alias counts, and packed
+collective counts are pinned (``compute_groups`` in the baseline) — a dedup
+regression (members falling out of the group, extra donated bundles,
+per-member collectives reappearing) fails ``make zero-overhead``.
 """
 import argparse
 import hashlib
@@ -254,6 +264,63 @@ def donation_aliasing() -> Dict[str, Dict[str, int]]:
     return out
 
 
+def compute_group_fusion() -> Dict[str, Dict]:
+    """Pins of the trace-fingerprinted compute-group engine on the canonical
+    classification collection.
+
+    Measures the REAL artifacts, not the bookkeeping: the group layout after
+    ``build_compute_groups``, the donated state bundle the compiled
+    ``jit_forward`` dispatch actually threads (leaf + ``tf.aliasing_output``
+    counts from the lowering — "1 donated state bundle per step"), and the
+    collective-primitive counts of the grouped in-graph epoch sync. All
+    version-independent (jaxpr structure, not text)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu import F1, MetricCollection, Precision, Recall, Specificity, StatScores
+
+    jax.config.update("jax_enable_x64", True)
+    nc = 5
+    coll = MetricCollection(
+        [
+            Precision(average="macro", num_classes=nc),
+            Recall(average="macro", num_classes=nc),
+            F1(average="macro", num_classes=nc),
+            Specificity(average="macro", num_classes=nc),
+            StatScores(reduce="macro", num_classes=nc),
+        ]
+    )
+    preds = jnp.zeros((8, nc), jnp.float32)
+    target = jnp.zeros((8,), jnp.int32)
+    coll.build_compute_groups(preds, target)
+    layout = coll._group_layout()
+    groups = [names for _, names in layout if len(names) > 1]
+
+    coll.jit_forward()
+    state = coll._collect_dispatch_state()
+    txt = coll._forward_dispatch().lower_text(state, preds, target)
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    sync_state = coll.apply_update(coll.init_state(), preds, target)
+    sync_jaxpr = jax.make_jaxpr(
+        _shard_map(lambda s: coll.apply_compute(s, axis_name="data"), mesh, (P(),), P())
+    )(sync_state)
+
+    return {
+        "canonical_stat_scores": {
+            "groups": len(groups),
+            "grouped_members": sum(len(g) for g in groups),
+            "updates_per_step": len(layout),
+            "donated_state_leaves": len(jax.tree_util.tree_leaves(state)),
+            "aliased": txt.count("tf.aliasing_output"),
+            "sync_collectives": _count_collectives(sync_jaxpr.jaxpr),
+        }
+    }
+
+
 def current_jaxprs() -> Dict[str, str]:
     """Jaxpr text per pinned program in the disabled-observability state
     (which the identity check proves equals the enabled state)."""
@@ -315,6 +382,23 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                 " step, defeating the zero-copy stateful hot path"
             )
 
+    # compute-group self-consistency (baseline-independent): the canonical
+    # quintet must fuse into one group whose one donated bundle is zero-copy
+    fusion = compute_group_fusion()
+    for name, rec in fusion.items():
+        if rec["updates_per_step"] != rec["groups"] + (5 - rec["grouped_members"]):
+            violations.append(
+                f"{name}: {rec['updates_per_step']} update programs per step for"
+                f" {rec['groups']} groups over {rec['grouped_members']} grouped members —"
+                " the compute-group dedup is not collapsing to one update per group"
+            )
+        if rec["aliased"] < rec["donated_state_leaves"]:
+            violations.append(
+                f"{name}: only {rec['aliased']}/{rec['donated_state_leaves']} grouped"
+                " donated state buffers alias an output — the shared group state is"
+                " being copied every step"
+            )
+
     if os.path.exists(baseline_path):
         with open(baseline_path) as fh:
             baseline = json.load(fh)
@@ -351,6 +435,25 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                         f"{name}: in-graph sync lowers to {counts}, baseline pins {want} —"
                         " the packed (bucketed) sync regressed toward per-leaf collectives"
                         " (or the bucket layout changed). If intentional, regenerate with"
+                        " `python scripts/check_zero_overhead.py --update`."
+                    )
+        # compute-group fusion counts are version-independent too: pin them
+        # so a dedup regression (group falling apart, extra donated bundles,
+        # per-member sync collectives reappearing) is conscious
+        pinned_fusion = baseline.get("compute_groups")
+        if pinned_fusion is None:
+            violations.append("compute_groups missing from baseline (run --update)")
+        else:
+            for name, rec in fusion.items():
+                want = pinned_fusion.get(name)
+                if want is None:
+                    violations.append(f"{name}: fusion pin missing from baseline (run --update)")
+                elif want != rec:
+                    violations.append(
+                        f"{name}: compute-group fusion measures {rec}, baseline pins {want} —"
+                        " the trace-fingerprinted dedup regressed (fewer grouped members,"
+                        " extra update programs/donated bundles, or per-member sync"
+                        " collectives). If intentional, regenerate with"
                         " `python scripts/check_zero_overhead.py --update`."
                     )
         # donated-lowering aliasing counts are version-independent too: pin
@@ -398,6 +501,10 @@ def update_baseline(baseline_path: str = BASELINE_PATH) -> str:
         # donated stateful lowering: every state leaf must alias an output
         # buffer (zero-copy in-place updates); fewer means per-step copies
         "donation_aliasing": donation_aliasing(),
+        # compute-group fusion: the canonical stat-scores quintet groups into
+        # ONE update program over ONE donated 4-leaf bundle, syncing as one
+        # collective; a dedup regression inflates these
+        "compute_groups": compute_group_fusion(),
     }
     with open(baseline_path, "w") as fh:
         json.dump(payload, fh, indent=1)

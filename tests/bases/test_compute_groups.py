@@ -1,0 +1,499 @@
+"""Trace-fingerprinted compute groups: shared-state update dedup.
+
+``MetricCollection`` traces each member's ``apply_update`` at the first
+compiled dispatch and groups members whose (update jaxpr, state layout,
+static dispatch args) match EXACTLY onto one shared state: one donated
+update per group per step, ``compute()`` fanned out from the shared state.
+These tests pin:
+
+* the canonical ``[Precision, Recall, F1, Specificity, StatScores]``
+  collection forms ONE group — one update program, one donated 4-leaf state
+  bundle per step — with step values, states, and epoch computes
+  bit-identical to ``compute_groups=False``;
+* exact-trace semantics: differing configs (threshold, averaging) never
+  merge, while duplicate same-config instances group even without a
+  hand-written ``_shared_update_key``;
+* copy-on-write safety: a direct state write on a grouped member (owner or
+  follower, including via ``items()``/``values()``) detaches THAT member
+  with a one-shot warning and the ``group_cow_detach`` counter — siblings
+  keep the pre-write shared state;
+* serialization: ``state_dict``/pickle materialize per-member states
+  (byte-compatible with ungrouped 0.6.0 checkpoints), ``load_state_dict``
+  dissolves groups so restored per-member states are honored, and 0.6.0
+  pickles load under the new version;
+* group invalidation on member mutation (``add_metrics``/``__setitem__``)
+  and group-keyed executable caching across rebuilds.
+"""
+import pickle
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    F1,
+    Accuracy,
+    CosineSimilarity,
+    MetricCollection,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+    observability,
+)
+
+NC = 5
+
+
+@pytest.fixture
+def stream():
+    rng = np.random.RandomState(42)
+    probs = rng.rand(6, 32, NC).astype(np.float32)
+    target = rng.randint(0, NC, (6, 32))
+    return jnp.asarray(probs), jnp.asarray(target)
+
+
+def _quintet(**extra):
+    kw = dict(average="macro", num_classes=NC, **extra)
+    return [
+        Precision(**kw),
+        Recall(**kw),
+        F1(**kw),
+        Specificity(**kw),
+        StatScores(reduce="macro", num_classes=NC, **extra),
+    ]
+
+
+def _multi_groups(coll):
+    return {o: ns for o, ns in coll._group_layout() if len(ns) > 1}
+
+
+# ---------------------------------------------------------------------------
+# grouping + equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_quintet_forms_one_group(stream):
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])  # first compiled dispatch builds the groups
+    report = coll.compute_group_report()
+    assert report["built"] and report["ungrouped"] == []
+    assert list(report["groups"].values()) == [
+        ["Precision", "Recall", "F1", "Specificity", "StatScores"]
+    ]
+    # ONE shared state: every member reads the same arrays
+    assert coll["Recall"].tp is coll["Precision"].tp
+    assert coll["StatScores"].fn is coll["Precision"].fn
+    # ONE donated state bundle per step: 4 leaves, not 20
+    assert len(jax.tree_util.tree_leaves(coll._collect_dispatch_state())) == 4
+
+
+def test_grouped_bit_identical_to_opted_out(stream):
+    probs, target = stream
+    grouped = MetricCollection(_quintet()).jit_forward()
+    plain = MetricCollection(_quintet(), compute_groups=False).jit_forward()
+    assert plain.compute_group_report()["enabled"] is False
+    for i in range(4):
+        vg = grouped(probs[i], target[i])
+        vp = plain(probs[i], target[i])
+        for k in vp:
+            np.testing.assert_array_equal(np.asarray(vg[k]), np.asarray(vp[k]), err_msg=k)
+    assert _multi_groups(grouped) and not _multi_groups(plain)
+    cg, cp = grouped.compute(), plain.compute()
+    for k in cp:
+        np.testing.assert_array_equal(np.asarray(cg[k]), np.asarray(cp[k]), err_msg=k)
+    for (_, mg), (_, mp) in zip(grouped.items(keep_base=True), plain.items(keep_base=True)):
+        for s in ("tp", "fp", "tn", "fn"):
+            np.testing.assert_array_equal(np.asarray(getattr(mg, s)), np.asarray(getattr(mp, s)))
+
+
+def test_update_many_grouped_matches_eager(stream):
+    probs, target = stream
+    many = MetricCollection(_quintet())
+    oracle = MetricCollection(_quintet(), compute_groups=False)
+    many.update_many(probs[:4], target[:4])
+    assert _multi_groups(many)
+    for i in range(4):
+        oracle.update(probs[i], target[i])
+    mc, oc = many.compute(), oracle.compute()
+    for k in mc:
+        np.testing.assert_array_equal(np.asarray(mc[k]), np.asarray(oc[k]), err_msg=k)
+
+
+def test_eager_paths_after_grouping_match(stream):
+    """forward()/update()/compute() on an already-grouped collection keep the
+    shared state coherent and the values exact."""
+    probs, target = stream
+    coll = MetricCollection(_quintet())
+    coll.build_compute_groups(probs[0], target[0])
+    oracle = MetricCollection(_quintet(), compute_groups=False)
+    v = coll(probs[0], target[0])
+    ov = oracle(probs[0], target[0])
+    for k in ov:
+        np.testing.assert_array_equal(np.asarray(v[k]), np.asarray(ov[k]), err_msg=k)
+    coll.update(probs[1], target[1])
+    oracle.update(probs[1], target[1])
+    cc, oc = coll.compute(), oracle.compute()
+    for k in oc:
+        np.testing.assert_array_equal(np.asarray(cc[k]), np.asarray(oc[k]), err_msg=k)
+
+
+def test_exact_trace_no_false_merges():
+    """Different update programs never group: a differing threshold (a
+    literal baked into the binary-input jaxpr) or averaging config keeps
+    members private — the TorchMetrics-style value-equality heuristic would
+    merge freshly-constructed instances of all of these."""
+    rng = np.random.RandomState(3)
+    probs = jnp.asarray(rng.rand(32).astype(np.float32))  # binary: threshold applies
+    target = jnp.asarray(rng.randint(0, 2, 32))
+    coll = MetricCollection(
+        {
+            "p_a": Precision(),
+            "p_b": Precision(threshold=0.3),
+            "p_macro": Precision(average="macro", num_classes=2),
+            "r_a": Recall(),
+        }
+    )
+    groups = coll.build_compute_groups(probs, target)
+    # only the two metrics with IDENTICAL programs group: Precision() and
+    # Recall() default to the same micro stat-scores update; the 0.3
+    # threshold and the macro reduce are different traced programs
+    assert list(groups.values()) == [["p_a", "r_a"]]
+
+
+def test_trace_identity_is_per_input_shape(stream):
+    """The same two configs CAN legitimately group for inputs where their
+    differing option is dead code: multiclass probabilities go through
+    argmax, so the threshold literal never enters the traced program —
+    exact-trace grouping keys on the program actually run, per batch aval."""
+    probs, target = stream
+    coll = MetricCollection({"p_a": Precision(), "p_b": Precision(threshold=0.3)})
+    groups = coll.build_compute_groups(probs[0], target[0])
+    assert list(groups.values()) == [["p_a", "p_b"]]
+
+
+def test_duplicate_instances_group_without_shared_update_key(stream):
+    """Compute groups reach beyond the hand-written _shared_update_key
+    protocol: two identically-configured metrics of a class with no sharing
+    protocol at all still dedup by trace identity."""
+    probs, target = stream
+    coll = MetricCollection({"a": CosineSimilarity(), "b": CosineSimilarity()})
+    assert all(m._shared_update_key() is None for m in coll.values())
+    x = jnp.asarray(np.random.RandomState(0).rand(8, 16).astype(np.float32))
+    y = jnp.asarray(np.random.RandomState(1).rand(8, 16).astype(np.float32))
+    groups = coll.build_compute_groups(x, y)
+    assert list(groups.values()) == [["a", "b"]]
+    coll.update(x, y)
+    solo = CosineSimilarity()
+    solo.update(x, y)
+    out = coll.compute()
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.asarray(solo.compute()))
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.asarray(out["b"]))
+
+
+def test_divergent_states_block_grouping(stream):
+    """A fingerprint match is not enough: members whose CURRENT states
+    already disagree (one updated out-of-band before grouping) stay
+    private, so no accumulated data is silently discarded."""
+    probs, target = stream
+    coll = MetricCollection(_quintet())
+    coll["Recall"].update(probs[5], target[5])  # Recall diverges pre-build
+    groups = coll.build_compute_groups(probs[0], target[0])
+    assert "Recall" not in {n for ns in groups.values() for n in ns}
+    assert list(groups.values()) == [["Precision", "F1", "Specificity", "StatScores"]]
+
+
+def test_warmup_builds_groups_and_compiles(stream):
+    probs, target = stream
+    coll = MetricCollection(_quintet())
+    report = coll.warmup(probs[0], target[0])
+    assert report["compiled_this_call"] is True
+    assert _multi_groups(coll)
+    # the warmed executable serves the first step without a fresh compile
+    coll(probs[0], target[0])
+    assert coll._forward_dispatch().last_compiled is False
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write safety
+# ---------------------------------------------------------------------------
+
+
+def test_cow_detach_on_owner_write(stream):
+    """The regression the satellite names: a user zeroes precision.tp
+    mid-epoch. Precision is the group OWNER — ownership transfers to the
+    next member, siblings keep the accumulated counts, Precision computes
+    from its own (zeroed) copy, and the detach is warned + counted."""
+    probs, target = stream
+    observability.reset()
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])
+    recall_tp = np.asarray(coll["Recall"].tp)
+    with pytest.warns(UserWarning, match="detached from its compute group"):
+        coll["Precision"].tp = jnp.zeros_like(coll["Precision"].tp)
+    assert np.asarray(coll["Precision"].tp).sum() == 0
+    np.testing.assert_array_equal(np.asarray(coll["Recall"].tp), recall_tp)
+    groups = _multi_groups(coll)
+    assert list(groups.values()) == [["Recall", "F1", "Specificity", "StatScores"]]
+    counters = observability.snapshot()["metrics"][coll.telemetry_key]["counters"]
+    assert counters["group_cow_detach"] == 1
+    observability.reset()
+    # the collection keeps working compiled; siblings stay coherent
+    oracle = Recall(average="macro", num_classes=NC)
+    oracle.update(probs[0], target[0])
+    oracle.update(probs[1], target[1])
+    coll(probs[1], target[1])
+    np.testing.assert_array_equal(
+        np.asarray(coll["Recall"].compute()), np.asarray(oracle.compute())
+    )
+
+
+def test_cow_detach_on_follower_write_via_values(stream):
+    """Mutation through values()/items() handles detaches only the written
+    member; the warning is one-shot per group."""
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])
+    follower = dict(coll.items(keep_base=True))["F1"]
+    with pytest.warns(UserWarning, match="detached from its compute group"):
+        follower.fp = follower.fp + 1
+    assert follower.__dict__.get("_compute_group") is None
+    assert "F1" not in {n for ns in _multi_groups(coll).values() for n in ns}
+    # the pre-write shared value was materialized BEFORE the write applied
+    np.testing.assert_array_equal(
+        np.asarray(follower.fp), np.asarray(coll["Precision"].fp) + 1
+    )
+    with warnings.catch_warnings(record=True) as seen:
+        warnings.simplefilter("always")
+        dict(coll.items(keep_base=True))["Specificity"].tn = jnp.zeros(
+            (NC,), coll["Precision"].tn.dtype
+        )
+    assert not any("compute group" in str(w.message) for w in seen)  # one-shot
+
+
+def test_standalone_calls_on_grouped_member_detach(stream):
+    """A direct update()/forward()/reset() on ONE grouped member is
+    out-of-band accumulation: it detaches that member instead of silently
+    advancing (or wiping) every sibling's shared state."""
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])
+    sibling_tp = np.asarray(coll["Precision"].tp)
+    with pytest.warns(UserWarning, match="detached"):
+        coll["StatScores"].update(probs[1], target[1])
+    np.testing.assert_array_equal(np.asarray(coll["Precision"].tp), sibling_tp)
+    assert "StatScores" not in {n for ns in _multi_groups(coll).values() for n in ns}
+    # a later detach from the SAME group is silent (one-shot warning) but
+    # still isolates the member: reset() wipes only Specificity's copy
+    coll["Specificity"].reset()
+    np.testing.assert_array_equal(np.asarray(coll["Precision"].tp), sibling_tp)
+    assert np.asarray(coll["Specificity"].tp).sum() == 0
+    assert "Specificity" not in {n for ns in _multi_groups(coll).values() for n in ns}
+
+
+def test_collection_reset_keeps_groups(stream):
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])
+    coll.reset()
+    assert _multi_groups(coll)  # the group survives
+    assert np.asarray(coll["Recall"].tp).sum() == 0
+    # and accumulation restarts cleanly on the shared state
+    oracle = MetricCollection(_quintet(), compute_groups=False)
+    oracle.update(probs[1], target[1])
+    coll(probs[1], target[1])
+    cc, oc = coll.compute(), oracle.compute()
+    for k in oc:
+        np.testing.assert_array_equal(np.asarray(cc[k]), np.asarray(oc[k]), err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+
+
+def test_state_dict_matches_ungrouped(stream):
+    probs, target = stream
+    grouped = MetricCollection(_quintet()).jit_forward()
+    plain = MetricCollection(_quintet(), compute_groups=False)
+    grouped.persistent(True)
+    plain.persistent(True)
+    grouped(probs[0], target[0])
+    plain.update(probs[0], target[0])
+    sg, sp = grouped.state_dict(), plain.state_dict()
+    assert set(sg) == set(sp)
+    for k in sp:
+        np.testing.assert_array_equal(np.asarray(sg[k]), np.asarray(sp[k]), err_msg=k)
+
+
+def test_pickle_materializes_and_regroups(stream):
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])
+    clone = pickle.loads(pickle.dumps(coll))
+    # unpickled: ungrouped, every member standalone with materialized state
+    assert clone.compute_group_report()["built"] is False
+    for _, m in clone.items(keep_base=True):
+        assert m.__dict__.get("_compute_group") is None
+        assert all(s in m.__dict__ for s in ("tp", "fp", "tn", "fn"))
+    cc, oc = clone.compute(), coll.compute()
+    for k in oc:
+        np.testing.assert_array_equal(np.asarray(cc[k]), np.asarray(oc[k]), err_msg=k)
+    # the next compiled dispatch regroups (values still exact)
+    clone(probs[1], target[1])
+    assert _multi_groups(clone)
+    coll(probs[1], target[1])
+    cc, oc = clone.compute(), coll.compute()
+    for k in oc:
+        np.testing.assert_array_equal(np.asarray(cc[k]), np.asarray(oc[k]), err_msg=k)
+
+
+def test_grouped_member_pickles_standalone(stream):
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])
+    follower = coll["F1"]
+    clone = pickle.loads(pickle.dumps(follower))
+    assert clone.__dict__.get("_compute_group") is None
+    np.testing.assert_array_equal(np.asarray(clone.tp), np.asarray(follower.tp))
+    np.testing.assert_array_equal(np.asarray(clone.compute()), np.asarray(follower.compute()))
+    assert coll["Recall"].tp is coll["Precision"].tp  # original untouched
+
+
+def test_load_state_dict_honors_divergent_member_states(stream):
+    """grouped -> save -> load divergent per-member states: the groups
+    dissolve, each member keeps ITS restored values, and the next dispatch
+    does not re-merge unequal states."""
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll.persistent(True)
+    coll(probs[0], target[0])
+    saved = coll.state_dict()
+    divergent = {k: (np.asarray(v) + i) for i, (k, v) in enumerate(sorted(saved.items()))}
+    coll.load_state_dict(divergent)
+    assert coll.compute_group_report()["built"] is False
+    for k, v in divergent.items():
+        name, state = k.split(".")
+        np.testing.assert_array_equal(np.asarray(getattr(coll[name], state)), v, err_msg=k)
+    coll(probs[1], target[1])  # rebuild attempt value-checks and stays apart
+    assert not _multi_groups(coll)
+
+
+def test_load_state_dict_round_trip_regroups(stream):
+    """grouped -> save -> load the SAME states: ungrouped-equal restore, and
+    the value check lets the next dispatch regroup."""
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll.persistent(True)
+    coll(probs[0], target[0])
+    saved = coll.state_dict()
+    fresh = MetricCollection(_quintet()).jit_forward()
+    fresh.persistent(True)
+    fresh.load_state_dict(saved)
+    oracle = MetricCollection(_quintet(), compute_groups=False)
+    oracle.update(probs[0], target[0])
+    fc, oc = fresh.compute(), oracle.compute()
+    for k in oc:
+        np.testing.assert_array_equal(np.asarray(fc[k]), np.asarray(oc[k]), err_msg=k)
+    fresh(probs[1], target[1])
+    assert _multi_groups(fresh)  # equal restored states regrouped
+
+
+def test_collection_pickle_from_0_6_0_loads(stream):
+    """A 0.6.0 pickle predates the compute-group attributes; __setstate__
+    must default them (enabled, unbuilt) instead of crashing."""
+    probs, target = stream
+    coll = MetricCollection(_quintet())
+    legacy = coll.__getstate__()
+    legacy.pop("_compute_groups_enabled")
+    legacy.pop("_compute_groups_built", None)
+    clone = MetricCollection.__new__(MetricCollection)
+    clone.__setstate__(legacy)
+    assert clone._compute_groups_enabled is True and clone._compute_groups_built is False
+    out = clone(probs[0], target[0])
+    assert set(out) == {"Precision", "Recall", "F1", "Specificity", "StatScores"}
+
+
+def test_metric_pickle_from_0_6_0_loads(stream):
+    probs, target = stream
+    m = Precision(average="macro", num_classes=NC)
+    m.update(probs[0], target[0])
+    legacy = m.__getstate__()
+    assert "_compute_group" not in legacy  # never serialized in the first place
+    clone = Precision.__new__(Precision)
+    clone.__setstate__(legacy)
+    assert clone.__dict__.get("_compute_group") is None
+    np.testing.assert_array_equal(np.asarray(clone.compute()), np.asarray(m.compute()))
+
+
+# ---------------------------------------------------------------------------
+# invalidation + executable caching
+# ---------------------------------------------------------------------------
+
+
+def test_add_metrics_after_grouping_dissolves_and_regroups(stream):
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])
+    assert _multi_groups(coll)
+    coll.add_metrics(Accuracy())
+    assert coll.compute_group_report()["built"] is False  # stale groups dropped
+    for _, m in coll.items(keep_base=True):
+        assert m.__dict__.get("_compute_group") is None
+    out = coll(probs[1], target[1])  # regroups against the grown member set
+    assert "Accuracy" in out
+    assert _multi_groups(coll)
+
+
+def test_setitem_after_grouping_dissolves(stream):
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])
+    coll["Recall"] = Recall(average="macro", num_classes=NC)
+    assert coll.compute_group_report()["built"] is False
+    coll(probs[1], target[1])
+    groups = _multi_groups(coll)
+    # the replacement holds a fresh (divergent) state: it stays out until
+    # its values re-converge, while the equal-state members regroup
+    assert groups and "Recall" not in {n for ns in groups.values() for n in ns}
+
+
+def test_group_rebuild_to_same_layout_hits_executable_cache(stream):
+    """The dispatch cache is keyed by the group signature: dissolving and
+    rebuilding to the SAME layout must re-dispatch the cached executable,
+    not recompile."""
+    probs, target = stream
+    coll = MetricCollection(_quintet()).jit_forward()
+    coll(probs[0], target[0])
+    fn = coll._forward_dispatch()
+    assert fn._cache_size() == 1
+    coll._dissolve_compute_groups()
+    coll.reset()  # equal (default) states so the rebuild regroups identically
+    coll(probs[1], target[1])
+    assert coll._forward_dispatch() is fn
+    assert fn._cache_size() == 1 and fn.last_compiled is False
+
+
+def test_telemetry_counters_and_snapshot_info(stream):
+    probs, target = stream
+    observability.reset()
+    coll = MetricCollection(_quintet()).jit_forward()
+    for i in range(3):
+        coll(probs[i], target[i])
+    snap = observability.snapshot()
+    entry = snap["metrics"][coll.telemetry_key]
+    assert entry["counters"]["compute_group_count"] == 1
+    # 4 of 5 member updates deduped away, every step
+    assert entry["counters"]["update_dedup_skipped"] == 3 * 4
+    info = entry["info"]["compute_groups"]
+    assert info["members"] == 5
+    assert list(info["groups"].values()) == [
+        ["Precision", "Recall", "F1", "Specificity", "StatScores"]
+    ]
+    text = observability.render_prometheus(snap)
+    assert 'metrics_tpu_compute_groups{metric="%s"} 1' % coll.telemetry_key in text
+    assert "metrics_tpu_compute_group_members{" in text
+    observability.reset()

@@ -235,6 +235,45 @@ def test_collection_setitem_after_jit_forward_invalidates_cache(stream):
         col["Accuracy"] = AUROC()
 
 
+def test_collection_add_metrics_after_grouped_jit_forward(stream):
+    """PR-4 invalidation, extended: growing a GROUPED jitted collection must
+    invalidate the compute-group assignments alongside the executable cache
+    — the stale group baked in the old member set — and the regrown
+    collection regroups with the new member folded in."""
+    probs, target = stream
+    members = dict(average="macro", num_classes=NC)
+    col = MetricCollection([Precision(**members), Recall(**members)]).jit_forward()
+    col(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    assert col.compute_group_report()["groups"]  # P+R grouped
+    col.add_metrics(F1(**members))
+    assert col._jit_forward_fn is None
+    assert col.compute_group_report()["built"] is False  # stale groups dropped
+    out = col(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    assert set(out) == {"Precision", "Recall", "F1"}
+    # the pre-existing members regrouped; the fresh F1 (divergent state:
+    # it missed batch 0) stays out until its values converge
+    groups = col.compute_group_report()["groups"]
+    assert list(groups.values()) == [["Precision", "Recall"]]
+    oracle = F1(**members)
+    oracle.update(jnp.asarray(probs[1]), jnp.asarray(target[1]))
+    np.testing.assert_allclose(float(col["F1"].compute()), float(oracle.compute()), atol=1e-6)
+
+
+def test_collection_setitem_after_grouped_jit_forward(stream):
+    probs, target = stream
+    members = dict(average="macro", num_classes=NC)
+    col = MetricCollection([Precision(**members), Recall(**members)]).jit_forward()
+    col(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    replaced = col["Recall"]
+    col["Recall"] = Recall(**members)
+    assert col._jit_forward_fn is None
+    assert col.compute_group_report()["built"] is False
+    # the evicted member left the group with its state materialized
+    assert replaced.__dict__.get("_compute_group") is None
+    assert "tp" in replaced.__dict__
+    col(jnp.asarray(probs[1]), jnp.asarray(target[1]))  # recompiles + regroups
+
+
 def test_metric_pickle_from_0_4_0_loads(stream):
     """A 0.4.0 pickle predates ``_jit_forward_enabled``; __setstate__ must
     default it off instead of crashing at the first forward()."""

@@ -304,6 +304,60 @@ def test_packed_telemetry_bucket_composition():
     assert ig["collectives"] == {"psum": 2, "pmax": 1, "all_gather": 1}, ig
 
 
+def test_compute_groups_shrink_packed_sync_leaves():
+    """Trace-fingerprinted compute groups reach where class aliasing cannot:
+    duplicate same-config instances of a class with NO _shared_update_key
+    still sync ONE bundle once grouped — the packed buckets carry half the
+    leaves, and the dedup composition lands in the sync telemetry."""
+    from metrics_tpu import CosineSimilarity
+
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.rand(16, 8).astype(np.float32))
+    y = jnp.asarray(rng.rand(16, 8).astype(np.float32))
+
+    def build():
+        return {"a": CosineSimilarity(), "b": CosineSimilarity()}
+
+    grouped = MetricCollection(build())
+    assert all(m._shared_update_key() is None for m in grouped.values())
+    grouped.build_compute_groups(x, y)
+    assert grouped.compute_group_report()["groups"] == {"a": ["a", "b"]}
+    plain = MetricCollection(build(), compute_groups=False)
+
+    def presync_leaves(coll):
+        observability.reset()
+        observability.enable()
+        state = coll.apply_update(coll.init_state(), x, y)
+        jax.make_jaxpr(
+            _shard_map(lambda s: coll.apply_compute(s, axis_name="data"), _mesh(2), (P(),), P())
+        )(state)
+        ig = observability.snapshot()["sync"]["in_graph"]
+        observability.reset()
+        return ig
+
+    ig_grouped = presync_leaves(grouped)
+    ig_plain = presync_leaves(plain)
+    # one bundle for the group: half the per-leaf collectives enter the buckets
+    assert ig_grouped["collectives_before"] * 2 == ig_plain["collectives_before"]
+    assert ig_grouped["collectives_after"] <= ig_plain["collectives_after"]
+    assert sum(ig_grouped["buckets"].values()) * 2 == sum(ig_plain["buckets"].values())
+    # the dedup composition: one group bundle served 2 members
+    assert ig_grouped["dedup_groups"] == 1 and ig_grouped["dedup_members"] == 2
+    assert ig_plain["dedup_groups"] == 0
+
+    # and the grouped in-graph values still match the unsharded oracle
+    def sharded(p, t):
+        state = grouped.apply_update(grouped.init_state(), p, t)
+        return grouped.apply_compute(state, axis_name="data")
+
+    fn = jax.jit(_shard_map(sharded, _mesh(2), (P("data"), P("data")), P()))
+    values = jax.tree.map(np.asarray, fn(x, y))
+    solo = CosineSimilarity()
+    solo.update(x, y)
+    for key in ("a", "b"):
+        np.testing.assert_allclose(values[key], np.asarray(solo.compute()), atol=1e-6)
+
+
 def test_capacity_auroc_packed_sync_is_bounded():
     """Cat-capacity states (buffer f32 + count i32) pack into one all_gather
     bucket per dtype — bounded, never one per accumulated batch."""

@@ -266,6 +266,32 @@ def test_collection_member_change_invalidates_cache(stacked):
     )
 
 
+def test_collection_member_change_invalidates_groups(stacked):
+    """PR-4 invalidation, extended: update_many builds compute groups, and
+    growing the collection afterwards must drop the group assignments along
+    with the stale scan executable."""
+    sp, st = stacked
+    members = dict(average="macro", num_classes=NC)
+    col = MetricCollection([Precision(**members), Recall(**members)])
+    col.update_many(sp, st)
+    assert col.compute_group_report()["groups"]  # P+R grouped in the scan
+    assert col["Recall"].tp is col["Precision"].tp
+    col.add_metrics(Accuracy())
+    assert col._update_many_fn is None
+    assert col.compute_group_report()["built"] is False
+    for _, m in col.items(keep_base=True):
+        assert m.__dict__.get("_compute_group") is None
+    col.update_many(sp, st)  # rebuilds groups + executable with the new member
+    oracle = MetricCollection(
+        [Precision(**members), Recall(**members)], compute_groups=False
+    )
+    for i in range(2 * K):
+        oracle.update(sp[i % K], st[i % K])
+    np.testing.assert_array_equal(
+        np.asarray(col["Precision"].compute()), np.asarray(oracle.compute()["Precision"])
+    )
+
+
 def test_collection_donation_in_place(stacked):
     sp, st = stacked
     col = MetricCollection(_members())

@@ -195,3 +195,23 @@ def test_donation_microbatch_bench_records_round_trip(monkeypatch):
 
     assert "bench_stateful_forward_donated" in bench_suite.CONFIG_META
     assert "bench_forward_scan_microbatch" in bench_suite.CONFIG_META
+
+
+def test_compute_group_bench_record_round_trips(monkeypatch):
+    """The compute-group config's record must survive json round-trips and
+    carry the dedup evidence: exactly one group over the stat-scores quintet
+    (one update program and one donated state bundle per step) and the
+    5x-reduced epoch-sync leaf count."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "BATCH", 64)
+
+    line = bench_suite.run_config(bench_suite.bench_collection_compute_groups, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "collection_update_compute_groups"
+    assert line["groups"] == 1  # P/R/F1/Specificity/StatScores: one fingerprint
+    assert line["updates_per_step"] == 1  # one update program, one donated bundle
+    assert line["sync_leaves_before"] == 20 and line["sync_leaves_after"] == 4
+    assert "telemetry" in line
+    assert "bench_collection_compute_groups" in bench_suite.CONFIG_META

@@ -43,6 +43,23 @@ def test_every_observability_export_documented():
     assert not missing, f"exports missing from docs/observability.md: {sorted(missing)}"
 
 
+def test_compute_groups_documented_and_cross_linked():
+    """The compute-group engine's user contract lives in two places: the
+    performance guide (trigger, exact-trace guarantee, opt-out, CoW
+    semantics) and the observability guide (its counters + group
+    composition), cross-linked."""
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "### Compute groups" in perf
+    for phrase in ("compute_groups=False", "build_compute_groups", "group_cow_detach"):
+        assert phrase in perf, phrase
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    for counter in ("compute_group_count", "update_dedup_skipped", "group_cow_detach"):
+        assert counter in obs, counter
+    assert "performance.md#compute-groups" in obs
+
+
 def test_observability_page_cross_linked():
     """The page must be reachable from the performance guide and the README
     (the two places a user hunting for runtime numbers starts from)."""
