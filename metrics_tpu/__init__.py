@@ -67,7 +67,7 @@ from metrics_tpu.retrieval import (  # noqa: F401 E402
     RetrievalPrecision,
     RetrievalRecall,
 )
-from metrics_tpu.wrappers import BootStrapper  # noqa: F401 E402
+from metrics_tpu.wrappers import BootStrapper, KeyedMetric, MultiTenantCollection  # noqa: F401 E402
 
 __all__ = [
     "AUC",
@@ -93,6 +93,7 @@ __all__ = [
     "IS",
     "KID",
     "KLDivergence",
+    "KeyedMetric",
     "MatthewsCorrcoef",
     "MeanAbsoluteError",
     "MeanAbsolutePercentageError",
@@ -100,6 +101,7 @@ __all__ = [
     "MeanSquaredLogError",
     "Metric",
     "MetricCollection",
+    "MultiTenantCollection",
     "PearsonCorrcoef",
     "Precision",
     "PrecisionRecallCurve",

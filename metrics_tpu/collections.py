@@ -1073,6 +1073,16 @@ class MetricCollection:
                 if TELEMETRY.enabled:
                     TELEMETRY.inc(m.telemetry_key, "reset_calls")
 
+    def keyed(self, num_tenants: int, **kwargs: Any) -> Any:
+        """An N-tenant stacked view of this collection: one
+        :class:`~metrics_tpu.wrappers.multitenant.MultiTenantCollection`
+        holding one stacked state bundle per compute-group layout entry,
+        all bundles advanced by a single donated dispatch per step. State
+        starts fresh at the defaults."""
+        from metrics_tpu.wrappers.multitenant import MultiTenantCollection
+
+        return MultiTenantCollection(self, num_tenants, **kwargs)
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
         if prefix:

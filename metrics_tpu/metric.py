@@ -1322,6 +1322,17 @@ class Metric(ABC):
     def clone(self) -> "Metric":
         return deepcopy(self)
 
+    def keyed(self, num_tenants: int, **kwargs: Any) -> "Metric":
+        """An N-tenant stacked view of this metric: one
+        :class:`~metrics_tpu.wrappers.multitenant.KeyedMetric` holding the
+        state for ``num_tenants`` logical streams on a leading tenant axis,
+        updated by a single donated segment-scatter dispatch per step. The
+        keyed state starts fresh at the defaults (this instance's accumulated
+        state is not inherited)."""
+        from metrics_tpu.wrappers.multitenant import KeyedMetric
+
+        return KeyedMetric(self, num_tenants, **kwargs)
+
     def persistent(self, mode: bool = False) -> None:
         for key in self._persistent:
             if not self._buffers.get(key, False):

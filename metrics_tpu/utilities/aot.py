@@ -69,6 +69,9 @@ class CompiledDispatch:
         self.last_compiled = False
         #: lower+compile wall seconds of that fresh executable (0.0 on a hit)
         self.last_compile_s = 0.0
+        #: lifetime dispatch accounting (see :meth:`cache_info`)
+        self._hits = 0
+        self._misses = 0
 
     # -- argument canonicalization ------------------------------------------
 
@@ -154,12 +157,14 @@ class CompiledDispatch:
         compiled = self._cache.get(key)
         fresh = compiled is None
         if fresh:
+            self._misses += 1
             jitted = self._build_jit(treedef, layout, static)
             start = time.perf_counter()
             compiled = jitted.lower(state, tuple(traced)).compile()
             self.last_compile_s = time.perf_counter() - start
             self._cache[key] = compiled
         else:
+            self._hits += 1
             self.last_compile_s = 0.0
         return key, compiled, fresh, traced
 
@@ -202,6 +207,15 @@ class CompiledDispatch:
     def _cache_size(self) -> int:
         """Compiled-executable count (the retrace ledger's cache watermark)."""
         return len(self._cache)
+
+    def cache_info(self) -> Dict[str, int]:
+        """Lifetime dispatch accounting: ``{"entries", "hits", "misses"}``.
+
+        ``hits``/``misses`` count every ``warm()``/``__call__`` lookup, so a
+        serving loop can verify its steady state re-uses one executable
+        (``misses`` stops growing) — the evidence the multi-tenant bench and
+        ``warmup`` reports attach beside ``executables_cached``."""
+        return {"entries": len(self._cache), "hits": self._hits, "misses": self._misses}
 
 
 def trace_fingerprint(fn: Callable, state: Any, args: Tuple, kwargs: Dict) -> Tuple:

@@ -777,3 +777,22 @@ def sync_state_packed(
             groups=group_composition,
         )
     return synced
+
+
+def tenant_axis_sharding(mesh: Any, axis_name: AxisName) -> Any:
+    """A sharding that splits the leading (tenant) axis over ``axis_name``.
+
+    The multi-tenant wrappers (``metrics_tpu/wrappers/multitenant.py``) hold
+    metric state stacked on a leading tenant axis; pass this as their
+    ``tenant_sharding=`` to spread that axis across ``mesh`` — every stacked
+    leaf's dim 0 is partitioned on ``axis_name``, all other dims replicated,
+    so N tenants' state occupies ``1/len(mesh[axis_name])`` of each device.
+    The tenant count must divide the axis size. Cross-PROCESS sync of the
+    stacked leaves is orthogonal: elementwise reductions ride the packed
+    collective buckets unchanged (one ``psum`` per (kind, dtype) bucket,
+    regardless of N or the tenant sharding).
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis_name))

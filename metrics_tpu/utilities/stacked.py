@@ -1,0 +1,115 @@
+"""Stacked child states: one pure metric program over a leading replica axis.
+
+Two wrappers hold many logical copies of one metric as a SINGLE state pytree
+whose every leaf carries an extra leading axis — ``BootStrapper`` (the axis is
+bootstrap replicas) and ``KeyedMetric``/``MultiTenantCollection`` (the axis is
+tenants). Both need the same three pieces, extracted here so the pattern is
+written once:
+
+* **stack build** — :func:`stack_pytrees` (stack N concrete child states) and
+  :func:`broadcast_stack` (N identical fresh copies without N inits);
+* **vmapped update** — :func:`vmap_update`, the child's pure ``apply_update``
+  mapped over the stack axis, with a pluggable per-replica body (the
+  bootstrapper derives a resample from a PRNG key, the multi-tenant router
+  updates each stack row with its own event rows);
+* **vmapped compute** — :func:`vmap_compute`, the child's pure
+  ``apply_compute`` fanned out per stack row.
+
+:func:`row_states` is the multi-tenant router's first half: the child's
+update evaluated on every EVENT ROW of a batch independently (a vmap over the
+leading event axis, each row kept as a length-1 batch so the child sees the
+layout it was written for), producing per-row partial states that a
+segment-reduction then routes to their tenants.
+"""
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "broadcast_stack",
+    "row_states",
+    "stack_pytrees",
+    "vmap_compute",
+    "vmap_update",
+]
+
+
+def stack_pytrees(trees: Sequence[Any]) -> Any:
+    """Stack equal-structure pytrees leaf-wise along a new leading axis."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves, axis=0), *trees)
+
+
+def broadcast_stack(tree: Any, n: int) -> Any:
+    """``n`` identical copies of ``tree`` stacked on a new leading axis.
+
+    Value-identical to ``stack_pytrees([tree] * n)`` but materializes one
+    broadcast per leaf instead of an ``n``-way stack — the cheap form for
+    replicating a fresh ``init_state()`` to thousands of tenants."""
+    return jax.tree.map(
+        lambda leaf: jnp.broadcast_to(jnp.asarray(leaf), (n,) + jnp.shape(leaf)),
+        tree,
+    )
+
+
+def vmap_update(metric: Any, body: Optional[Callable] = None) -> Callable:
+    """``jax.vmap`` of one child's pure update over the leading stack axis.
+
+    Returns ``(stacked_state, xs) -> stacked_state`` where ``xs`` carries one
+    entry per stack row. ``body(child_state, x)`` defaults to
+    ``metric.apply_update(child_state, *x)``; wrappers that derive each
+    replica's inputs from ``x`` (the bootstrapper resamples from a per-child
+    PRNG key) pass their own body."""
+    if body is None:
+        body = lambda s, x: metric.apply_update(s, *x)  # noqa: E731
+    return jax.vmap(body)
+
+
+def vmap_compute(metric: Any, axis_name: Any = None) -> Callable:
+    """``jax.vmap`` of one child's pure compute over the leading stack axis:
+    ``stacked_state -> stacked values``. ``axis_name`` is forwarded to every
+    row's ``apply_compute`` (the stack axis itself is never reduced over)."""
+    return jax.vmap(lambda s: metric.apply_compute(s, axis_name=axis_name))
+
+
+def row_states(metric: Any, args: Tuple, kwargs: Dict) -> Dict[str, Any]:
+    """The child's update evaluated on every event row independently.
+
+    Every array argument of rank >= 1 must share the same leading event axis
+    ``B``; rank-0 and python-scalar leaves broadcast to every row. Each row is
+    presented to ``metric.apply_update`` as a length-1 batch (shape
+    ``(1, ...)``), so the child runs the exact program it was written for.
+    Returns the per-row batch-local states stacked to ``(B, ...)`` leaves —
+    the input of a segment reduction routing rows to stacked replicas."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    mapped = [getattr(leaf, "ndim", 0) >= 1 for leaf in leaves]
+    lengths = {int(leaf.shape[0]) for leaf, m in zip(leaves, mapped) if m}
+    if not lengths:
+        raise ValueError(
+            "keyed update expects at least one array argument whose leading axis"
+            " is the event-row axis (aligned with `tenant_ids`)"
+        )
+    if len(lengths) > 1:
+        raise ValueError(
+            "keyed update: array arguments disagree on the event-row axis"
+            f" (leading axes {sorted(lengths)}); every array argument must carry"
+            " the same leading row count as `tenant_ids`"
+        )
+    b = lengths.pop()
+    # keep a length-1 batch axis per row: (B, ...) -> (B, 1, ...)
+    expanded = [
+        leaf.reshape((b, 1) + tuple(leaf.shape[1:])) if m else leaf
+        for leaf, m in zip(leaves, mapped)
+    ]
+    init = metric.init_state()
+
+    def one(row_leaves: Tuple) -> Dict[str, Any]:
+        merged = list(expanded)
+        it = iter(row_leaves)
+        for i, m in enumerate(mapped):
+            if m:
+                merged[i] = next(it)
+        row_args, row_kwargs = jax.tree_util.tree_unflatten(treedef, merged)
+        return metric.apply_update(init, *row_args, **row_kwargs)
+
+    return jax.vmap(one)(tuple(leaf for leaf, m in zip(expanded, mapped) if m))

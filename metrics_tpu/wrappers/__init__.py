@@ -1,1 +1,2 @@
 from metrics_tpu.wrappers.bootstrapping import BootStrapper  # noqa: F401
+from metrics_tpu.wrappers.multitenant import KeyedMetric, MultiTenantCollection  # noqa: F401

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.metric import AXIS_UNSET, Array, ArrayTypes, Metric
 from metrics_tpu.utilities.data import apply_to_collection
+from metrics_tpu.utilities.stacked import stack_pytrees, vmap_compute, vmap_update
 
 
 def _bootstrap_sampler(
@@ -195,10 +196,7 @@ class BootStrapper(Metric):
         Under ``jit`` the ``'poisson'`` strategy uses the fixed-length
         resample (see :func:`_bootstrap_sampler`): exactly ``size`` draws per
         child, the static-shape reading of the Poisson bootstrap."""
-        stacked = jax.tree.map(
-            lambda *leaves: jnp.stack(leaves, axis=0),
-            *[m.init_state() for m in self.metrics],
-        )
+        stacked = stack_pytrees([m.init_state() for m in self.metrics])
         return {"children": stacked, "key": jax.random.PRNGKey(self._seed)}
 
     def apply_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
@@ -219,14 +217,14 @@ class BootStrapper(Metric):
             new_kwargs = apply_to_collection(kwargs, ArrayTypes, jnp.take, idx, axis=0)
             return child.apply_update(child_state, *new_args, **new_kwargs)
 
-        children = jax.vmap(one)(state["children"], jax.random.split(sub, self.num_bootstraps))
+        children = vmap_update(child, one)(
+            state["children"], jax.random.split(sub, self.num_bootstraps)
+        )
         return {"children": children, "key": key}
 
     def apply_compute(self, state: Dict[str, Any], axis_name: Any = AXIS_UNSET) -> Dict[str, Array]:
         if axis_name is AXIS_UNSET and self.process_group is not None:
             axis_name = self.process_group  # wrapper-declared axis wins; else children resolve theirs
         child = self.metrics[0]
-        computed_vals = jax.vmap(lambda s: child.apply_compute(s, axis_name=axis_name))(
-            state["children"]
-        )
+        computed_vals = vmap_compute(child, axis_name=axis_name)(state["children"])
         return self._stats_dict(computed_vals)

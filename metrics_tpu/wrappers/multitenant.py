@@ -1,0 +1,1061 @@
+"""Vectorized multi-tenant metric state: one donated dispatch for N streams.
+
+The reference (TorchMetrics v0.4.0) serves N logical streams — users,
+segments, model variants — with N metric objects: N updates, N state pytrees,
+N sync payloads per step. :class:`KeyedMetric` lifts the state onto a keyed
+leading **tenant axis** instead: one metric wrapper holds the child's state
+stacked to ``(N, ...)`` leaves, and ``update(tenant_ids, *batch)`` routes a
+single mixed event batch to every tenant's partial statistics in ONE donated
+XLA dispatch:
+
+1. **per-row states** — the child's pure ``apply_update`` is vmapped over the
+   event-row axis (:func:`~metrics_tpu.utilities.stacked.row_states`), giving
+   each row's batch-local state delta;
+2. **segment routing** — add-reduced (``"sum"``) leaves ride one
+   ``segment_sum`` into the stacked accumulator; ``"max"``/``"min"`` leaves
+   ride a ``segment_max``/``segment_min`` masked by per-tenant row counts so
+   empty segments leave their tenants untouched;
+3. **donated dispatch** — the whole program runs through the PR-4
+   :class:`~metrics_tpu.utilities.aot.CompiledDispatch` donation cache: the
+   stacked state is donated (zero-copy in place), executables are keyed by
+   the state avals (which carry N) + batch avals, and ``warmup()`` /
+   ``update_many()`` compose exactly as on a plain metric.
+
+Cost model: the dispatch does O(rows) work plus O(N) segment output —
+amortized per-tenant cost is the single-stream step cost divided by N (the
+``multitenant_update_step`` bench config measures it at N ∈ {100, 1000,
+10000}).
+
+:class:`MultiTenantCollection` is the collection form: one stacked state
+bundle per compute-group layout entry (PR-5 machinery — the
+Precision/Recall/F1/Specificity/StatScores quintet over 10k tenants is still
+ONE update on ONE shared stacked state), all bundles advanced by a single
+donated dispatch, ``compute()`` fanning out per-member × per-tenant values.
+
+Sync: the stacked leaves keep the child's reductions, so the existing packed
+bucket engine ships one ``psum`` per (kind, dtype) bucket **regardless of
+N**; an optional tenant-axis sharding spec
+(:func:`~metrics_tpu.utilities.distributed.tenant_axis_sharding`) spreads the
+stacked state across a device mesh.
+
+Tenant-id safety: the eager ``update`` raises a descriptive error on
+out-of-range or negative ids (``validate_ids=True``, the default); with
+``validate_ids=False`` — and always on the pure ``apply_update`` path, which
+cannot raise from inside a compiled program — invalid rows are clipped to a
+discard bucket and dropped, counted under the ``invalid_tenant_ids``
+telemetry counter (a trace-time hook in the health-guard style: zero traced
+ops when telemetry is off). Scatter corruption is never silent.
+"""
+import functools
+import sys
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import (
+    AXIS_UNSET,
+    Array,
+    ArrayTypes,
+    Metric,
+    StateDict,
+    _microbatch_len,
+    _note_compiled_dispatch,
+)
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.health import HEALTH, guard_state
+from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.observability.retrace import arg_signature, is_tracing
+from metrics_tpu.utilities.aot import CompiledDispatch
+from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.utilities.profiling import compiled_scope
+from metrics_tpu.utilities.stacked import broadcast_stack, row_states, vmap_compute
+
+__all__ = ["KeyedMetric", "MultiTenantCollection"]
+
+#: reductions the segment router can route exactly (see :func:`_keyed_gate`)
+_SEGMENT_REDUCTIONS = ("sum", "max", "min")
+
+
+def _keyed_gate(metric: Metric, what: str = "base_metric") -> None:
+    """Raise a descriptive ``ValueError`` when ``metric`` cannot be keyed.
+
+    Keying needs a base pure-state protocol over fixed-shape leaves whose
+    reductions the segment router can express: ``"sum"`` leaves route through
+    ``segment_sum``, ``"max"``/``"min"`` through masked segment extremes.
+    Unbounded list states (pytree grows per step), ``"cat"``/``"mean"``/
+    custom-callable reductions, custom pure-state layouts (wrappers like
+    ``BootStrapper``), and ``dist_sync_on_step`` all stay single-stream.
+    """
+    if not isinstance(metric, Metric):
+        raise ValueError(f"Expected {what} to be a metrics_tpu.Metric, got {metric!r}")
+    name = type(metric).__name__
+    if not metric._defaults:
+        raise ValueError(
+            f"{what} {name} registers no states, so there is nothing to key per"
+            " tenant (compositions key their children instead)."
+        )
+    if any(isinstance(v, list) for v in metric._defaults.values()):
+        raise ValueError(
+            f"{what} {name} holds unbounded list states, whose pytree grows every"
+            " step under jit; keyed state must be fixed-shape — use the metric's"
+            " `capacity=`/`streaming=` mode, or keep per-tenant instances."
+        )
+    bad = {
+        k: fx
+        for k, fx in metric._reductions.items()
+        if not (isinstance(fx, str) and fx in _SEGMENT_REDUCTIONS)
+    }
+    if bad:
+        raise ValueError(
+            f"{what} {name} has state reductions the segment router cannot route"
+            f" exactly: {bad}. Keyed updates support"
+            f" {list(_SEGMENT_REDUCTIONS)} leaves ('sum' via segment_sum,"
+            " 'max'/'min' via masked segment extremes); 'cat'/'mean'/callable"
+            " reductions stay single-stream."
+        )
+    if set(metric.init_state()) != set(metric._defaults):
+        raise ValueError(
+            f"{what} {name} overrides the pure-state protocol (its init_state keys"
+            " differ from the registered states), so its state cannot be stacked"
+            " generically on a tenant axis."
+        )
+    if metric.dist_sync_on_step:
+        raise ValueError(
+            f"{what} {name} uses dist_sync_on_step=True, whose eager on-step gather"
+            " cannot run inside the keyed compiled dispatch; sync at compute()"
+            " instead (stacked leaves ride the packed collectives)."
+        )
+
+
+def _note_invalid_ids(key: str, count: Any) -> None:
+    """Host side of the compiled invalid-id counter (``jax.debug.callback``)."""
+    c = int(count)
+    if c and TELEMETRY.enabled:
+        TELEMETRY.inc(key, "invalid_tenant_ids", c)
+
+
+def _invalid_counter_hook(key: str, invalid: Any) -> None:
+    """Attach the trace-time invalid-id counter to the running program.
+
+    Gated on telemetry AND the backend's ability to execute
+    ``jax.debug.callback`` (host send/recv is UNIMPLEMENTED on e.g. the axon
+    TPU tunnel — the same platform set the health guard consults); on such
+    backends the counter silently skips rather than crashing every dispatch.
+    Zero traced ops when telemetry is off."""
+    if not TELEMETRY.enabled:
+        return
+    from metrics_tpu.observability import health as _health
+
+    if jax.default_backend() in _health._NO_CALLBACK_PLATFORMS:
+        return
+    jax.debug.callback(functools.partial(_note_invalid_ids, key), invalid)
+
+
+class KeyedMetric(Metric):
+    """Hold one metric's state for ``num_tenants`` logical streams, stacked
+    on a leading tenant axis and advanced by ONE donated dispatch per step.
+
+    Args:
+        base_metric: the metric to key. Its pure update/compute programs are
+            reused; the instance itself is cloned, and its accumulated state
+            is NOT inherited — the keyed state starts at the defaults, like
+            constructing ``num_tenants`` fresh instances.
+        num_tenants: tenant-axis size N. Executables are keyed by the state
+            avals, so N is part of every dispatch-cache key.
+        validate_ids: eager ``update`` raises a descriptive ``ValueError`` on
+            out-of-range/negative ids (default). ``False`` skips the host
+            check: invalid rows are clipped to a discard bucket and dropped,
+            counted under the ``invalid_tenant_ids`` telemetry counter — the
+            only behavior available on the pure ``apply_update`` path, which
+            cannot raise from inside a compiled program.
+        donate: donate the stacked state to the update executable (zero-copy
+            in-place advance; the PR-4 ownership discipline applies).
+        tenant_sharding: optional ``jax.sharding.Sharding`` placed on every
+            stacked leaf (see
+            :func:`~metrics_tpu.utilities.distributed.tenant_axis_sharding`)
+            so the tenant axis spreads across a device mesh.
+        compute_on_step: default ``False`` — per-step per-tenant values are
+            rarely wanted and cost a full compute fan-out; ``True`` restores
+            the usual ``forward`` contract (batch-local per-tenant values).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.wrappers import KeyedMetric
+        >>> m = KeyedMetric(Accuracy(), num_tenants=3)
+        >>> m.update(jnp.array([0, 2, 0, 2]),
+        ...          jnp.array([0.9, 0.1, 0.4, 0.8]), jnp.array([1, 1, 0, 1]))
+        >>> [round(float(v), 2) for v in m.compute()[jnp.array([0, 2])]]
+        [1.0, 0.5]
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_tenants: int,
+        *,
+        validate_ids: bool = True,
+        donate: bool = True,
+        tenant_sharding: Optional[Any] = None,
+        compute_on_step: bool = False,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        _keyed_gate(base_metric)
+        if int(num_tenants) < 1:
+            raise ValueError(f"num_tenants must be >= 1, got {num_tenants}")
+        self._child = base_metric.clone()
+        self.num_tenants = int(num_tenants)
+        self.validate_ids = bool(validate_ids)
+        self._jit_forward_donate = bool(donate)
+        self.tenant_sharding = tenant_sharding
+        stacked_defaults = broadcast_stack(
+            {k: v for k, v in self._child._defaults.items()}, self.num_tenants
+        )
+        for name, stacked in stacked_defaults.items():
+            if tenant_sharding is not None:
+                stacked = jax.device_put(stacked, tenant_sharding)
+            self.add_state(
+                name,
+                stacked,
+                dist_reduce_fx=self._child._reductions[name],
+                persistent=self._child._persistent[name],
+                buffer=self._child._buffers[name],
+            )
+        self._keyed_update_fn: Optional[CompiledDispatch] = None
+        self._keyed_update_copy_fn: Optional[CompiledDispatch] = None
+
+    # ------------------------------------------------------------------
+    # tenant-id canonicalization / validation
+    # ------------------------------------------------------------------
+
+    def _canonical_ids(self, tenant_ids: Any) -> Array:
+        ids = jnp.asarray(tenant_ids)
+        if not jnp.issubdtype(ids.dtype, jnp.integer):
+            raise ValueError(
+                f"tenant_ids must be an integer array, got dtype {ids.dtype}"
+            )
+        if ids.ndim != 1:
+            raise ValueError(
+                f"tenant_ids must be rank-1 (one id per event row), got shape {ids.shape}"
+            )
+        return ids
+
+    def _validate_ids_eager(self, ids: Array) -> None:
+        """Host-side id check for the eager path: descriptive raise."""
+        concrete = np.asarray(ids)
+        bad = (concrete < 0) | (concrete >= self.num_tenants)
+        if bad.any():
+            first = int(np.argmax(bad))
+            raise ValueError(
+                f"tenant_ids contains {int(bad.sum())} id(s) outside the valid range"
+                f" [0, {self.num_tenants}) — first offender: index {first} ="
+                f" {int(concrete[first])}. Fix the routing, raise num_tenants, or"
+                " construct with validate_ids=False to clip-and-drop invalid rows"
+                " (counted under the `invalid_tenant_ids` telemetry counter)."
+            )
+
+    # ------------------------------------------------------------------
+    # the segment-scatter program (pure)
+    # ------------------------------------------------------------------
+
+    def _segment_scatter(
+        self, state: StateDict, tenant_ids: Any, args: Tuple, kwargs: Dict
+    ) -> Tuple[StateDict, Array]:
+        """Pure keyed update core: ``(new_stacked_state, invalid_count)``.
+
+        Invalid ids (negative / >= N) are clipped to a discard bucket — row
+        ``N`` of an ``N+1``-segment reduction that is sliced away — so they
+        can never scatter into a real tenant.
+        """
+        child = self._child
+        n = self.num_tenants
+        ids = jnp.asarray(tenant_ids)
+        valid = (ids >= 0) & (ids < n)
+        safe = jnp.where(valid, ids, n)
+        per_row = row_states(child, args, kwargs)
+        counts = jax.ops.segment_sum(
+            valid.astype(jnp.int32), safe, num_segments=n + 1
+        )[:n]
+        new: StateDict = {}
+        for name, fx in child._reductions.items():
+            default = jnp.asarray(child._defaults[name])
+            rows = per_row[name]
+            if fx == "sum":
+                delta = jax.ops.segment_sum(rows - default, safe, num_segments=n + 1)[:n]
+                new[name] = state[name] + delta.astype(state[name].dtype)
+            else:
+                seg_fn = jax.ops.segment_max if fx == "max" else jax.ops.segment_min
+                pick = jnp.maximum if fx == "max" else jnp.minimum
+                seg = seg_fn(rows, safe, num_segments=n + 1)[:n]
+                has_rows = (counts > 0).reshape((n,) + (1,) * (rows.ndim - 1))
+                new[name] = jnp.where(
+                    has_rows, pick(state[name], seg.astype(state[name].dtype)), state[name]
+                )
+        invalid = jnp.sum(jnp.logical_not(valid)).astype(jnp.int32)
+        return new, invalid
+
+    def _dispatch_scatter(
+        self, state: StateDict, tenant_ids: Any, *args: Any, **kwargs: Any
+    ) -> Tuple[StateDict, Array]:
+        """The program behind the eager ``update`` dispatch: scatter + the
+        trace-time invalid-id counter hook (health-guard style — zero traced
+        ops when telemetry is off)."""
+        new_state, invalid = self._segment_scatter(state, tenant_ids, args, kwargs)
+        _invalid_counter_hook(self.telemetry_key, invalid)
+        return new_state, invalid
+
+    # ------------------------------------------------------------------
+    # pure API
+    # ------------------------------------------------------------------
+
+    def apply_update(self, state: StateDict, tenant_ids: Any, *args: Any, **kwargs: Any) -> StateDict:
+        """Pure keyed update: the stacked state advanced by one mixed event
+        batch. Trace-safe; invalid ids clip-and-drop (counted under
+        ``invalid_tenant_ids`` when telemetry is on — this path cannot raise
+        from inside a compiled program)."""
+        if TELEMETRY.enabled and is_tracing(state, args, kwargs):
+            TELEMETRY.inc(self.telemetry_key, "update_traces")
+        with compiled_scope(f"{type(self._child).__name__}.keyed_update"):
+            new_state, invalid = self._segment_scatter(state, tenant_ids, args, kwargs)
+            _invalid_counter_hook(self.telemetry_key, invalid)
+        if HEALTH.enabled:
+            guard_state(self, new_state, source="apply_update")
+        return new_state
+
+    # base apply_compute works verbatim: it syncs the stacked leaves over the
+    # resolved axis (packed buckets — one psum per (kind, dtype) regardless of
+    # N) and binds them for compute(), which fans out below.
+
+    # ------------------------------------------------------------------
+    # stateful API
+    # ------------------------------------------------------------------
+
+    def _keyed_dispatch(self, donatable: bool) -> CompiledDispatch:
+        if donatable and self._jit_forward_donate:
+            if self._keyed_update_fn is None:
+                self._keyed_update_fn = CompiledDispatch(self._dispatch_scatter, donate_state=True)
+            return self._keyed_update_fn
+        if self._keyed_update_copy_fn is None:
+            self._keyed_update_copy_fn = CompiledDispatch(self._dispatch_scatter, donate_state=False)
+        return self._keyed_update_copy_fn
+
+    def _drop_compiled_dispatch(self) -> None:
+        super()._drop_compiled_dispatch()
+        self._keyed_update_fn = None
+        self._keyed_update_copy_fn = None
+
+    def update(self, tenant_ids: Any, *args: Any, **kwargs: Any) -> None:
+        """Route one mixed event batch to every tenant in ONE donated dispatch.
+
+        ``tenant_ids`` is a rank-1 integer array aligned with the leading
+        event-row axis of every array argument. With ``validate_ids=True``
+        (default) out-of-range ids raise here, host-side, before anything is
+        dispatched; with ``False`` they clip-and-drop inside the program.
+        """
+        ids = self._canonical_ids(tenant_ids)
+        if self.validate_ids:
+            self._validate_ids_eager(ids)
+        state = self._get_states()
+        donatable = True
+        if self._jit_forward_donate:
+            state, donatable = self._donation_safe_state(state)
+        fn = self._keyed_dispatch(donatable)
+        start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
+        new_state, _ = fn(state, ids, *args, **kwargs)
+        if start is not None:
+            dur = time.perf_counter() - start
+            key = self.telemetry_key
+            if TELEMETRY.enabled:
+                TELEMETRY.inc(key, "keyed_update_rows", int(ids.shape[0]))
+                _note_compiled_dispatch(
+                    self, fn, (ids,) + args, kwargs, counter="keyed_update_dispatches"
+                )
+            if EVENTS.enabled:
+                EVENTS.record(
+                    "update",
+                    key,
+                    dur_s=dur,
+                    t_start=start,
+                    path="keyed_scatter",
+                    tenants=self.num_tenants,
+                    rows=int(ids.shape[0]),
+                    compiled_this_call=bool(fn.last_compiled),
+                    donated=fn.donate_state,
+                )
+        self._set_states(new_state)
+
+    def update_many(self, tenant_ids: Any, *stacked: Any, **stacked_kwargs: Any) -> None:
+        """K stacked keyed micro-batches in ONE compiled dispatch
+        (:meth:`Metric.update_many` over the keyed ``apply_update``).
+        ``tenant_ids`` carries shape ``(K, B)``; the eager id check applies
+        to the whole stack up front."""
+        ids = jnp.asarray(tenant_ids)
+        if self.validate_ids:
+            self._validate_ids_eager(ids.reshape(-1))
+        super().update_many(ids, *stacked, **stacked_kwargs)
+
+    def warmup(self, tenant_ids: Any, *sample_batch: Any, **kwargs: Any) -> Dict[str, Any]:
+        """AOT lower+compile the keyed update executable for this batch shape
+        (see :meth:`Metric.warmup` — same contract, applied to the keyed
+        dispatch). Returns the compiled program's cost report plus the
+        dispatch-cache accounting."""
+        fn = self._keyed_dispatch(True)
+        state = self._get_states()
+        ids = self._canonical_ids(tenant_ids)
+        start = time.perf_counter()
+        compiled, fresh = fn.warm(state, ids, *sample_batch, **kwargs)
+        key = self.telemetry_key
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(key, "warmup_calls")
+            if fresh:
+                TELEMETRY.inc(key, "warmup_compiles")
+        if EVENTS.enabled:
+            EVENTS.record(
+                "compile",
+                key,
+                dur_s=fn.last_compile_s,
+                t_start=start,
+                path="warmup",
+                fresh=fresh,
+                donated=fn.donate_state,
+                tenants=self.num_tenants,
+                signature=arg_signature(ids, *sample_batch, **kwargs),
+            )
+        from metrics_tpu.observability.cost import executable_cost
+
+        return {
+            "metric": f"KeyedMetric({type(self._child).__name__})",
+            "tenants": self.num_tenants,
+            "compiled_this_call": fresh,
+            "compile_seconds": round(fn.last_compile_s, 6),
+            "donated": fn.donate_state,
+            "executables_cached": fn._cache_size(),
+            "dispatch_cache": fn.cache_info(),
+            "update": executable_cost(compiled),
+            "state_memory": self.state_memory_report(),
+        }
+
+    # ------------------------------------------------------------------
+    # compute fan-out + rollups
+    # ------------------------------------------------------------------
+
+    def compute(self) -> Any:
+        """Per-tenant values: the child's compute fanned out over the tenant
+        axis of the (synced) stacked state. Tenants that never received a row
+        compute on the default state — typically NaN for ratio metrics."""
+        return vmap_compute(self._child, axis_name=None)(self._get_states())
+
+    def _scalar_values(self, key: Optional[str] = None) -> Array:
+        vals = self.compute()
+        if isinstance(vals, dict):
+            if key is None:
+                raise ValueError(
+                    f"{type(self._child).__name__}.compute returns a dict; pass"
+                    f" key=<one of {sorted(vals)}> to select the rollup series."
+                )
+            vals = vals[key]
+        vals = jnp.asarray(vals)
+        if vals.ndim != 1:
+            raise ValueError(
+                "rollups need one scalar per tenant; this child computes"
+                f" per-tenant values of shape {vals.shape[1:]}"
+            )
+        return vals
+
+    def compute_topk(
+        self, k: int, *, largest: bool = True, key: Optional[str] = None
+    ) -> Tuple[Array, Array]:
+        """``(values, tenant_ids)`` of the ``k`` extreme tenants by computed
+        value — one vectorized ``top_k`` over the tenant axis, no per-tenant
+        host loop. ``largest=False`` selects the bottom-k. Note ``top_k``
+        sorts NaN values (never-updated tenants) unpredictably; reset or
+        filter them first when segments may be empty."""
+        if not 1 <= int(k) <= self.num_tenants:
+            raise ValueError(f"k must be in [1, {self.num_tenants}], got {k}")
+        vals = self._scalar_values(key)
+        scores = vals if largest else -vals
+        top_vals, top_ids = jax.lax.top_k(scores, int(k))
+        return (top_vals if largest else -top_vals), top_ids
+
+    def compute_percentiles(self, q: Any, *, key: Optional[str] = None) -> Array:
+        """Percentile(s) ``q`` (in [0, 100]) of the per-tenant values over the
+        tenant axis, NaN-skipping so never-updated tenants don't poison the
+        distribution."""
+        return jnp.nanpercentile(self._scalar_values(key), jnp.asarray(q))
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def reset(self, tenant_ids: Optional[Any] = None) -> None:
+        """Restore every tenant — or only ``tenant_ids`` — to the defaults.
+
+        The partial form scatters the child defaults into the named rows of
+        every stacked leaf, leaving all other tenants' accumulation intact
+        (ids always validate here: reset is host-side administration)."""
+        if tenant_ids is None:
+            return super().reset()
+        ids = self._canonical_ids(tenant_ids)
+        self._validate_ids_eager(ids)
+        new: StateDict = {}
+        for name, default in self._child._defaults.items():
+            new[name] = getattr(self, name).at[ids].set(jnp.asarray(default))
+        self._set_states(new)
+        self._computed = None
+        self._forward_cache = None
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "reset_calls")
+
+    def __getstate__(self) -> dict:
+        state = super().__getstate__()
+        for k in ("_keyed_update_fn", "_keyed_update_copy_fn"):
+            state.pop(k, None)
+        return state
+
+    def __repr__(self) -> str:
+        return f"KeyedMetric({self._child!r}, num_tenants={self.num_tenants})"
+
+
+class MultiTenantCollection:
+    """A whole :class:`~metrics_tpu.collections.MetricCollection` keyed by
+    tenant: one stacked state bundle per compute-group layout entry, ALL
+    bundles advanced by a single donated dispatch per step.
+
+    The underlying collection's trace-fingerprinted compute groups (PR-5)
+    collapse provably-identical members onto one stacked state before the
+    tenant axis is even added — a ``[Precision, Recall, F1, Specificity,
+    StatScores]`` quintet over 10 000 tenants is still ONE segment-scatter
+    update on ONE ``(10000, ...)`` state bundle. ``compute()`` fans out
+    ``{member: per-tenant values}``; :meth:`compute_topk` /
+    :meth:`compute_percentiles` roll up any member's series.
+
+    Groups are built from the first batch's avals (the first ``update`` /
+    ``update_many`` / ``warmup``, or explicitly via :meth:`build`). Member
+    states start at the defaults — accumulated state of the wrapped
+    collection is not inherited.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric], MetricCollection],
+        num_tenants: int,
+        *,
+        validate_ids: bool = True,
+        donate: bool = True,
+        tenant_sharding: Optional[Any] = None,
+        compute_groups: bool = True,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+    ) -> None:
+        if isinstance(metrics, MetricCollection):
+            self._collection = metrics.clone(prefix=prefix, postfix=postfix)
+        else:
+            self._collection = MetricCollection(
+                metrics, prefix=prefix, postfix=postfix, compute_groups=compute_groups
+            )
+        for name, m in self._collection.items(keep_base=True):
+            _keyed_gate(m, what=f"member {name!r}")
+        if int(num_tenants) < 1:
+            raise ValueError(f"num_tenants must be >= 1, got {num_tenants}")
+        self.num_tenants = int(num_tenants)
+        self.validate_ids = bool(validate_ids)
+        self._donate = bool(donate)
+        self.tenant_sharding = tenant_sharding
+        self._keyed: Optional["OrderedDict[str, KeyedMetric]"] = None
+        self._layout: List[Tuple[str, list]] = []
+        self._update_fn: Optional[CompiledDispatch] = None
+        self._update_copy_fn: Optional[CompiledDispatch] = None
+        self._update_many_fn: Optional[CompiledDispatch] = None
+        self._update_many_copy_fn: Optional[CompiledDispatch] = None
+        self._donation_warned = False
+
+    @property
+    def telemetry_key(self) -> str:
+        """Per-instance telemetry key (see :attr:`Metric.telemetry_key`)."""
+        key = self.__dict__.get("_telemetry_key")
+        if key is None:
+            key = TELEMETRY.register(self)
+            self._telemetry_key = key
+        return key
+
+    # ------------------------------------------------------------------
+    # build: compute-group layout -> stacked bundles
+    # ------------------------------------------------------------------
+
+    def build(self, *sample_batch: Any, **kwargs: Any) -> Dict[str, list]:
+        """Group the members by update-trace fingerprint against this batch's
+        avals and allocate one stacked state bundle per layout entry. Called
+        automatically at the first ``update``/``update_many``/``warmup``;
+        idempotent afterwards. Returns ``{owner: [member names]}`` for the
+        multi-member groups formed."""
+        if self._keyed is not None:
+            return {o: list(ns) for o, ns in self._layout if len(ns) > 1}
+        coll = self._collection
+        if coll._compute_groups_enabled and not coll._compute_groups_built:
+            coll.build_compute_groups(*sample_batch, **kwargs)
+        self._layout = coll._group_layout()
+        self._keyed = OrderedDict()
+        for owner_name, _ in self._layout:
+            self._keyed[owner_name] = KeyedMetric(
+                coll[owner_name],
+                self.num_tenants,
+                validate_ids=False,  # the collection validates once, up front
+                donate=self._donate,
+                tenant_sharding=self.tenant_sharding,
+            )
+        groups = {o: list(ns) for o, ns in self._layout if len(ns) > 1}
+        if TELEMETRY.enabled:
+            key = self.telemetry_key
+            TELEMETRY.set_info(
+                key,
+                "keyed",
+                {
+                    "tenants": self.num_tenants,
+                    "state_bundles": len(self._keyed),
+                    "members": len(coll),
+                    "groups": groups,
+                },
+            )
+        if EVENTS.enabled:
+            EVENTS.record(
+                "compile",
+                self.telemetry_key,
+                path="keyed_build",
+                tenants=self.num_tenants,
+                state_bundles=len(self._keyed),
+                members=len(coll),
+                groups=[list(ns) for ns in groups.values()],
+            )
+        return groups
+
+    def _require_built(self) -> "OrderedDict[str, KeyedMetric]":
+        if self._keyed is None:
+            raise RuntimeError(
+                "MultiTenantCollection has no state bundles yet: call build("
+                "*sample_batch) — or run one update/update_many/warmup — first."
+            )
+        return self._keyed
+
+    @property
+    def state_bundles(self) -> int:
+        """Stacked state bundles one dispatch threads (groups + singletons)."""
+        return len(self._require_built())
+
+    def _layout_signature(self) -> Tuple:
+        return tuple((owner, tuple(names)) for owner, names in self._layout)
+
+    # ------------------------------------------------------------------
+    # one donated dispatch for every bundle
+    # ------------------------------------------------------------------
+
+    def _scatter_all(
+        self, state: Dict[str, StateDict], tenant_ids: Any, *args: Any, **kwargs: Any
+    ) -> Tuple[Dict[str, StateDict], Array]:
+        new: Dict[str, StateDict] = {}
+        invalid = None
+        for owner, keyed in self._keyed.items():
+            member = self._collection[owner]
+            fkw = member._filter_kwargs(**kwargs)
+            new[owner], inv = keyed._segment_scatter(state[owner], tenant_ids, args, fkw)
+            if invalid is None:
+                invalid = inv
+        if invalid is None:  # pragma: no cover - empty collections are rejected
+            invalid = jnp.zeros((), jnp.int32)
+        _invalid_counter_hook(self.telemetry_key, invalid)
+        return new, invalid
+
+    def _apply_update_all(
+        self, state: Dict[str, StateDict], tenant_ids: Any, *args: Any, **kwargs: Any
+    ) -> Dict[str, StateDict]:
+        """Pure keyed update of every bundle (the ``update_many`` scan body
+        and the user-facing pure API)."""
+        return self._scatter_all(state, tenant_ids, *args, **kwargs)[0]
+
+    # pure API mirrors of the collection ------------------------------------
+
+    def init_state(self) -> Dict[str, StateDict]:
+        """Fresh stacked state bundles, keyed by layout-entry owner name."""
+        return {owner: keyed.init_state() for owner, keyed in self._require_built().items()}
+
+    def apply_update(
+        self, state: Dict[str, StateDict], tenant_ids: Any, *args: Any, **kwargs: Any
+    ) -> Dict[str, StateDict]:
+        """Pure keyed update (trace-safe; invalid ids clip-and-drop). The
+        layout must be built (:meth:`build`) before tracing."""
+        self._require_built()
+        return self._apply_update_all(state, tenant_ids, *args, **kwargs)
+
+    def apply_compute(
+        self, state: Dict[str, StateDict], axis_name: Any = AXIS_UNSET
+    ) -> Dict[str, Any]:
+        """Per-member × per-tenant values from the stacked bundles; with a
+        resolved mesh axis each bundle's leaves sync through the packed
+        collectives first (one psum per bucket regardless of N)."""
+        out: Dict[str, Any] = {}
+        for owner, names in self._layout:
+            keyed = self._require_built()[owner]
+            axis = keyed.process_group if axis_name is AXIS_UNSET else axis_name
+            synced = keyed.sync_state(state[owner], axis)
+            for n in names:
+                member = self._collection[n]
+                out[self._collection._set_name(n)] = vmap_compute(member, axis_name=None)(synced)
+        return out
+
+    # stateful API ----------------------------------------------------------
+
+    def _collect_state(self) -> Dict[str, StateDict]:
+        keyed = self._require_built()
+        state: Dict[str, StateDict] = {}
+        for owner, km in keyed.items():
+            km._computed = None
+            km._forward_cache = None
+            state[owner] = km._get_states()
+        return state
+
+    def _donation_safe_state(
+        self, state: Dict[str, StateDict]
+    ) -> Tuple[Dict[str, StateDict], bool]:
+        """Collection-wide donation audit (see
+        :meth:`MetricCollection._donation_safe_state`): default-aliased leaves
+        are defensively copied, ANY externally-held leaf routes the whole
+        dispatch to the copying executable."""
+        aliased = None
+        for owner in state:
+            km = self._keyed[owner]
+            bundle = state[owner]
+            for sname in bundle:
+                v = bundle[sname]
+                if not isinstance(v, ArrayTypes):  # pragma: no cover - gate bars lists
+                    continue
+                if v is km._defaults.get(sname):
+                    bundle[sname] = jnp.asarray(v).copy()
+                    continue
+                if sys.getrefcount(v) > 4:
+                    aliased = f"{owner}.{sname}"
+                    break
+            if aliased is not None:
+                break
+        if aliased is None:
+            return state, True
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "jit_forward_alias_fallbacks")
+        if not self._donation_warned:
+            self._donation_warned = True
+            rank_zero_warn(
+                f"MultiTenantCollection: stacked state `{aliased}` is referenced"
+                " outside the collection, so this step dispatches through the"
+                " copying executable instead of donating the state buffers. Drop"
+                " external references to restore zero-copy updates, or construct"
+                " with donate=False to keep the copying path silently.",
+                UserWarning,
+            )
+        return state, False
+
+    def _dispatch(self, donatable: bool) -> CompiledDispatch:
+        if donatable and self._donate:
+            if self._update_fn is None:
+                self._update_fn = CompiledDispatch(
+                    self._scatter_all, donate_state=True, context_fn=self._layout_signature
+                )
+            return self._update_fn
+        if self._update_copy_fn is None:
+            self._update_copy_fn = CompiledDispatch(
+                self._scatter_all, donate_state=False, context_fn=self._layout_signature
+            )
+        return self._update_copy_fn
+
+    def _writeback(self, new_state: Dict[str, StateDict]) -> None:
+        for owner, km in self._keyed.items():
+            km._set_states(new_state[owner])
+            km._update_called = True
+            km._computed = None
+
+    def _canonical_ids(self, tenant_ids: Any) -> Array:
+        return next(iter(self._require_built().values()))._canonical_ids(tenant_ids)
+
+    def update(self, tenant_ids: Any, *args: Any, **kwargs: Any) -> None:
+        """Advance EVERY member's stacked state with one mixed event batch in
+        ONE donated dispatch: grouped members share a bundle, so the update
+        count per step is the layout size, not the member count."""
+        if self._keyed is None:
+            self.build(*args, **kwargs)
+        ids = self._canonical_ids(tenant_ids)
+        if self.validate_ids:
+            next(iter(self._keyed.values()))._validate_ids_eager(ids)
+        state = self._collect_state()
+        donatable = True
+        if self._donate:
+            state, donatable = self._donation_safe_state(state)
+        fn = self._dispatch(donatable)
+        start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
+        new_state, _ = fn(state, ids, *args, **kwargs)
+        if start is not None:
+            dur = time.perf_counter() - start
+            key = self.telemetry_key
+            if TELEMETRY.enabled:
+                TELEMETRY.inc(key, "update_calls")
+                TELEMETRY.inc(key, "keyed_update_rows", int(ids.shape[0]))
+                skipped = sum(len(ns) - 1 for _, ns in self._layout)
+                if skipped:
+                    TELEMETRY.inc(key, "update_dedup_skipped", skipped)
+                _note_compiled_dispatch(
+                    self, fn, (ids,) + args, kwargs, counter="keyed_update_dispatches"
+                )
+            if EVENTS.enabled:
+                EVENTS.record(
+                    "update",
+                    key,
+                    dur_s=dur,
+                    t_start=start,
+                    path="keyed_scatter",
+                    tenants=self.num_tenants,
+                    rows=int(ids.shape[0]),
+                    members=len(self._collection),
+                    state_bundles=len(state),
+                    compiled_this_call=bool(fn.last_compiled),
+                    donated=fn.donate_state,
+                )
+        self._writeback(new_state)
+
+    def _scan_update_many(
+        self, state: Dict[str, StateDict], stacked: Tuple, stacked_kwargs: Dict
+    ) -> Dict[str, StateDict]:
+        """One ``lax.scan`` of the keyed update over K stacked micro-batches
+        (``stacked[0]`` is the ``(K, B)`` tenant-id stack)."""
+        leaves, treedef = jax.tree_util.tree_flatten((stacked, stacked_kwargs))
+        scanned_ix = [i for i, leaf in enumerate(leaves) if getattr(leaf, "ndim", 0) >= 1]
+
+        def body(s: Dict[str, StateDict], xs: Tuple) -> Tuple[Dict[str, StateDict], None]:
+            merged = list(leaves)
+            for i, x in zip(scanned_ix, xs):
+                merged[i] = x
+            (ids, *args), kw = jax.tree_util.tree_unflatten(treedef, merged)
+            return self._apply_update_all(s, ids, *args, **kw), None
+
+        new_state, _ = jax.lax.scan(body, state, tuple(leaves[i] for i in scanned_ix))
+        return new_state
+
+    def update_many(self, tenant_ids: Any, *stacked: Any, **stacked_kwargs: Any) -> None:
+        """K stacked keyed micro-batches in ONE compiled dispatch: a single
+        ``lax.scan`` over the donated bundles (see :meth:`Metric.update_many`).
+        ``tenant_ids`` carries shape ``(K, B)``, every array argument a
+        matching leading K."""
+        ids = jnp.asarray(tenant_ids)
+        if self._keyed is None:
+            slice0 = lambda x: x[0] if getattr(x, "ndim", 0) >= 1 else x  # noqa: E731
+            self.build(
+                *jax.tree_util.tree_map(slice0, stacked),
+                **jax.tree_util.tree_map(slice0, stacked_kwargs),
+            )
+        k = _microbatch_len((ids,) + stacked, stacked_kwargs)
+        if self.validate_ids:
+            next(iter(self._keyed.values()))._validate_ids_eager(ids.reshape(-1))
+        state = self._collect_state()
+        donatable = True
+        if self._donate:
+            state, donatable = self._donation_safe_state(state)
+        if donatable and self._donate:
+            if self._update_many_fn is None:
+                self._update_many_fn = CompiledDispatch(
+                    self._scan_update_many, donate_state=True, context_fn=self._layout_signature
+                )
+            fn = self._update_many_fn
+        else:
+            if self._update_many_copy_fn is None:
+                self._update_many_copy_fn = CompiledDispatch(
+                    self._scan_update_many, donate_state=False, context_fn=self._layout_signature
+                )
+            fn = self._update_many_copy_fn
+        new_state = fn(state, (ids,) + stacked, stacked_kwargs)
+        if TELEMETRY.enabled:
+            key = self.telemetry_key
+            TELEMETRY.inc(key, "update_many_calls")
+            TELEMETRY.inc(key, "update_many_batches", k)
+            _note_compiled_dispatch(
+                self, fn, (ids,) + stacked, stacked_kwargs, counter="update_many_dispatches"
+            )
+        self._writeback(new_state)
+
+    def warmup(self, tenant_ids: Any, *sample_batch: Any, **kwargs: Any) -> Dict[str, Any]:
+        """AOT lower+compile the single keyed dispatch for this batch shape
+        (building the layout first if needed); see :meth:`Metric.warmup`."""
+        if self._keyed is None:
+            self.build(*sample_batch, **kwargs)
+        ids = self._canonical_ids(tenant_ids)
+        fn = self._dispatch(True)
+        state = self._collect_state()
+        start = time.perf_counter()
+        compiled, fresh = fn.warm(state, ids, *sample_batch, **kwargs)
+        key = self.telemetry_key
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(key, "warmup_calls")
+            if fresh:
+                TELEMETRY.inc(key, "warmup_compiles")
+        if EVENTS.enabled:
+            EVENTS.record(
+                "compile",
+                key,
+                dur_s=fn.last_compile_s,
+                t_start=start,
+                path="warmup",
+                fresh=fresh,
+                donated=fn.donate_state,
+                tenants=self.num_tenants,
+                state_bundles=len(state),
+                signature=arg_signature(ids, *sample_batch, **kwargs),
+            )
+        from metrics_tpu.observability.cost import executable_cost
+
+        return {
+            "metric": "MultiTenantCollection",
+            "tenants": self.num_tenants,
+            "members": len(self._collection),
+            "state_bundles": len(state),
+            "compiled_this_call": fresh,
+            "compile_seconds": round(fn.last_compile_s, 6),
+            "donated": fn.donate_state,
+            "executables_cached": fn._cache_size(),
+            "dispatch_cache": fn.cache_info(),
+            "update": executable_cost(compiled),
+            "state_memory": {
+                owner: km.state_memory_report() for owner, km in self._keyed.items()
+            },
+        }
+
+    # ------------------------------------------------------------------
+    # compute fan-out + rollups
+    # ------------------------------------------------------------------
+
+    def compute(self) -> Dict[str, Any]:
+        """``{member name: per-tenant values}`` — each compute-group bundle
+        syncs once (eager cross-process gather of the stacked leaves) and
+        fans out to every member's own compute, vmapped over the tenant
+        axis."""
+        out: Dict[str, Any] = {}
+        keyed = self._require_built()
+        for owner, names in self._layout:
+            km = keyed[owner]
+            with km.sync_context(dist_sync_fn=km.dist_sync_fn):
+                state = km._get_states()
+                for n in names:
+                    member = self._collection[n]
+                    out[self._collection._set_name(n)] = vmap_compute(
+                        member, axis_name=None
+                    )(state)
+        return out
+
+    def _member_series(self, metric: Optional[str], key: Optional[str]) -> Array:
+        keyed = self._require_built()
+        if metric is None:
+            if len(self._collection) == 1:
+                metric = next(iter(self._collection.keys(keep_base=True)))
+            else:
+                raise ValueError(
+                    "pass metric=<member name> to pick the rollup series; members:"
+                    f" {list(self._collection.keys(keep_base=True))}"
+                )
+        if metric not in self._collection:
+            raise KeyError(
+                f"no member {metric!r}; members:"
+                f" {list(self._collection.keys(keep_base=True))}"
+            )
+        owner = next(o for o, ns in self._layout if metric in ns)
+        km = keyed[owner]
+        member = self._collection[metric]
+        with km.sync_context(dist_sync_fn=km.dist_sync_fn):
+            vals = vmap_compute(member, axis_name=None)(km._get_states())
+        if isinstance(vals, dict):
+            if key is None:
+                raise ValueError(
+                    f"{metric!r} computes a dict; pass key=<one of {sorted(vals)}>."
+                )
+            vals = vals[key]
+        vals = jnp.asarray(vals)
+        if vals.ndim != 1:
+            raise ValueError(
+                f"rollups need one scalar per tenant; {metric!r} computes"
+                f" per-tenant values of shape {vals.shape[1:]}"
+            )
+        return vals
+
+    def compute_topk(
+        self,
+        k: int,
+        *,
+        metric: Optional[str] = None,
+        largest: bool = True,
+        key: Optional[str] = None,
+    ) -> Tuple[Array, Array]:
+        """``(values, tenant_ids)`` of the ``k`` extreme tenants by one
+        member's computed value (see :meth:`KeyedMetric.compute_topk`)."""
+        if not 1 <= int(k) <= self.num_tenants:
+            raise ValueError(f"k must be in [1, {self.num_tenants}], got {k}")
+        vals = self._member_series(metric, key)
+        scores = vals if largest else -vals
+        top_vals, top_ids = jax.lax.top_k(scores, int(k))
+        return (top_vals if largest else -top_vals), top_ids
+
+    def compute_percentiles(
+        self, q: Any, *, metric: Optional[str] = None, key: Optional[str] = None
+    ) -> Array:
+        """NaN-skipping percentile(s) of one member's per-tenant values (see
+        :meth:`KeyedMetric.compute_percentiles`)."""
+        return jnp.nanpercentile(self._member_series(metric, key), jnp.asarray(q))
+
+    def reset(self, tenant_ids: Optional[Any] = None) -> None:
+        """Reset every bundle — all tenants, or only ``tenant_ids``."""
+        if self._keyed is None:
+            return
+        for km in self._keyed.values():
+            km.reset(tenant_ids)
+
+    # ------------------------------------------------------------------
+    # container / misc protocol
+    # ------------------------------------------------------------------
+
+    def keys(self, keep_base: bool = False) -> Any:
+        return self._collection.keys(keep_base=keep_base)
+
+    def __getitem__(self, key: str) -> Metric:
+        return self._collection[key]
+
+    def __len__(self) -> int:
+        return len(self._collection)
+
+    def __getstate__(self) -> dict:
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if k
+            not in (
+                "_update_fn",
+                "_update_copy_fn",
+                "_update_many_fn",
+                "_update_many_copy_fn",
+                "_telemetry_key",
+                "_jit_cache_seen",
+                "_donation_warned",
+            )
+        }
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self._update_fn = None
+        self._update_copy_fn = None
+        self._update_many_fn = None
+        self._update_many_copy_fn = None
+        self._donation_warned = False
+
+    def __repr__(self) -> str:
+        return (
+            f"MultiTenantCollection({self._collection!r}, num_tenants={self.num_tenants})"
+        )

@@ -888,6 +888,95 @@ def bench_collection_compute_groups():
 bench_collection_compute_groups._force_cpu = True
 
 
+# ------------------------------------------------ multi-tenant keyed state
+#: tenant-axis sizes the keyed config amortizes over (the middle entry is
+#: the headline N the acceptance multiplier reads)
+MULTITENANT_NS = (100, 1000, 10000)
+#: mixed event rows routed per keyed dispatch
+MULTITENANT_ROWS = 4096
+#: eager-loop steps per measurement (the dispatch itself is the signal)
+MULTITENANT_STEPS = 50
+
+
+def bench_multitenant_update():
+    """Vectorized multi-tenant update: ONE donated segment-scatter dispatch
+    routes a 4096-row mixed event batch to N tenants' stacked states
+    (``MultiTenantCollection`` of Accuracy + macro P/R/F1 — the P/R/F1 trio
+    shares one compute-group bundle, so the dispatch threads 2 bundles for 4
+    members). ``value`` is the amortized cost per tenant at the headline
+    N=1000; ``amortized_us_per_tenant`` carries all of N ∈ {100, 1000,
+    10000}. The baseline is our own single-collection fused compiled step
+    (the PR-4/5 hot path, same members, same batch, update-only), so
+    ``vs_baseline`` IS the per-tenant amortization multiplier — the
+    acceptance pin reads it ≥ 50×. CPU-pinned like the other stateful
+    configs (per-step host dispatch through the tunnel would measure the
+    link)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import F1, Accuracy, MetricCollection, MultiTenantCollection, Precision, Recall
+
+    def members(**extra):
+        kw = dict(average="macro", num_classes=NUM_CLASSES, **extra)
+        return [
+            Accuracy(**extra),
+            Precision(**kw),
+            Recall(**kw),
+            F1(**kw),
+        ]
+
+    rng = np.random.RandomState(0)
+    rows = MULTITENANT_ROWS
+    logits = rng.rand(rows, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, rows))
+
+    amortized = {}
+    bundles = None
+    for n in MULTITENANT_NS:
+        ids = jnp.asarray(rng.randint(0, n, rows))
+        mtc = MultiTenantCollection(members(), n)
+        mtc.warmup(ids, preds, target)
+        owner = next(iter(mtc._keyed.values()))
+        leaf = next(iter(owner._child._defaults))
+
+        def step(mtc=mtc, ids=ids, owner=owner, leaf=leaf):
+            mtc.update(ids, preds, target)
+            jax.block_until_ready(getattr(owner, leaf))
+
+        t = _time_eager_loop(step, steps=MULTITENANT_STEPS)
+        amortized[str(n)] = round(t / n * 1e6, 6)
+        bundles = mtc.state_bundles
+
+    headline = MULTITENANT_NS[len(MULTITENANT_NS) // 2]
+    ours = amortized[str(headline)] / 1e6  # seconds per tenant
+
+    def ref(torchmetrics, torch):
+        # our own fused single-collection compiled step is the baseline: the
+        # ratio is then exactly "one stream's step cost / one tenant's
+        # amortized cost" on identical members and batch
+        single = MetricCollection(members(compute_on_step=False)).jit_forward()
+        single.warmup(preds, target)
+
+        def step():
+            single(preds, target)
+            jax.block_until_ready(single["Accuracy"].correct)
+
+        return _time_eager_loop(step, steps=MULTITENANT_STEPS)
+
+    extra = {
+        "tenants_per_dispatch": int(headline),
+        "amortized_us_per_tenant": amortized,
+        "rows_per_dispatch": int(rows),
+        "dispatches_per_update": 1.0,
+        "state_bundles": int(bundles),
+    }
+    return "multitenant_update_step", ours, ref, "us/tenant", extra
+
+
+bench_multitenant_update._force_cpu = True
+
+
 # ------------------------------------------------ packed collective sync
 #: scan length for the in-graph sync config (tiny per-step states -> the
 #: sync program itself is the signal; shorter than STEPS is plenty)
@@ -1206,6 +1295,7 @@ CONFIG_META = {
     "bench_stateful_forward_donated": ("stateful_forward_donated_step", "us/step"),
     "bench_forward_scan_microbatch": ("forward_scan_microbatch", "us/step"),
     "bench_collection_compute_groups": ("collection_update_compute_groups", "us/step"),
+    "bench_multitenant_update": ("multitenant_update_step", "us/tenant"),
     "bench_collection_sync_in_graph": ("collection_sync_in_graph_step", "us/step"),
     "bench_collection_sync_eager": ("collection_sync_eager_epoch", "us/epoch"),
 }
@@ -1224,6 +1314,7 @@ CONFIGS = [
     bench_stateful_forward_donated,
     bench_forward_scan_microbatch,
     bench_collection_compute_groups,
+    bench_multitenant_update,
     bench_collection_sync_in_graph,
     bench_collection_sync_eager,
     bench_collection,

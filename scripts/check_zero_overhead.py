@@ -261,6 +261,39 @@ def donation_aliasing() -> Dict[str, Dict[str, int]]:
     out["metric_update_many_donated"] = {
         "state_leaves": leaves(ustate), "aliased": txt.count("tf.aliasing_output")
     }
+
+    # the multi-tenant stacked state: the keyed segment-scatter dispatch must
+    # alias every (N, ...) stacked leaf — an un-aliased leaf means XLA copies
+    # ALL tenants' state every step, the exact copy the tenant axis amortizes
+    from metrics_tpu import F1, Precision, Recall, Specificity, StatScores
+    from metrics_tpu.wrappers import KeyedMetric, MultiTenantCollection
+
+    ids = jnp.zeros((8,), jnp.int32)
+    km = KeyedMetric(Accuracy(), 16)
+    kstate = km._get_states()
+    txt = km._keyed_dispatch(True).lower_text(kstate, ids, preds, target)
+    out["keyed_update_donated"] = {
+        "state_leaves": leaves(kstate), "aliased": txt.count("tf.aliasing_output")
+    }
+
+    # the grouped collection form: the stat-scores quintet over the tenant
+    # axis still collapses to ONE stacked bundle, fully aliased
+    nc = 5
+    kw = dict(average="macro", num_classes=nc)
+    mtc = MultiTenantCollection(
+        [Precision(**kw), Recall(**kw), F1(**kw), Specificity(**kw),
+         StatScores(reduce="macro", num_classes=nc)],
+        16,
+    )
+    qpreds = jnp.zeros((8, nc), jnp.float32)
+    mtc.build(qpreds, target)
+    cstate = mtc._collect_state()
+    txt = mtc._dispatch(True).lower_text(cstate, ids, qpreds, target)
+    out["multitenant_quintet_donated"] = {
+        "state_bundles": len(cstate),
+        "state_leaves": leaves(cstate),
+        "aliased": txt.count("tf.aliasing_output"),
+    }
     return out
 
 

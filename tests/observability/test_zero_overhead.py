@@ -112,10 +112,15 @@ def test_donated_lowerings_alias_every_state_buffer():
         "capacity_jit_forward_donated",
         "collection_jit_forward_donated",
         "metric_update_many_donated",
+        "keyed_update_donated",
+        "multitenant_quintet_donated",
     }
     for name, rec in donation.items():
         assert rec["state_leaves"] > 0, name
         assert rec["aliased"] == rec["state_leaves"], (name, rec)
+    # the tenant axis must not break the group collapse: the keyed quintet
+    # still dispatches ONE stacked bundle
+    assert donation["multitenant_quintet_donated"]["state_bundles"] == 1
 
 
 def test_donation_aliasing_is_pinned_in_baseline():
@@ -129,6 +134,8 @@ def test_donation_aliasing_is_pinned_in_baseline():
         "capacity_jit_forward_donated",
         "collection_jit_forward_donated",
         "metric_update_many_donated",
+        "keyed_update_donated",
+        "multitenant_quintet_donated",
     }
     for rec in pinned.values():
         assert rec["aliased"] == rec["state_leaves"] > 0

@@ -197,6 +197,32 @@ def test_donation_microbatch_bench_records_round_trip(monkeypatch):
     assert "bench_forward_scan_microbatch" in bench_suite.CONFIG_META
 
 
+def test_multitenant_bench_record_round_trips(monkeypatch):
+    """The multi-tenant config's record must survive json round-trips and
+    carry the amortization evidence: ``tenants_per_dispatch`` (the headline
+    N), ``amortized_us_per_tenant`` at every configured N, one dispatch per
+    update, and the group-collapsed bundle count (Accuracy + the P/R/F1
+    compute group = 2 bundles for 4 members)."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "MULTITENANT_NS", (4, 8))
+    monkeypatch.setattr(bench_suite, "MULTITENANT_ROWS", 64)
+    monkeypatch.setattr(bench_suite, "MULTITENANT_STEPS", 2)
+
+    line = bench_suite.run_config(bench_suite.bench_multitenant_update, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "multitenant_update_step" and line["unit"] == "us/tenant"
+    assert line["tenants_per_dispatch"] == 8
+    assert set(line["amortized_us_per_tenant"]) == {"4", "8"}
+    assert all(v > 0 for v in line["amortized_us_per_tenant"].values())
+    assert line["dispatches_per_update"] == 1.0
+    assert line["rows_per_dispatch"] == 64
+    assert line["state_bundles"] == 2
+    assert "telemetry" in line
+    assert "bench_multitenant_update" in bench_suite.CONFIG_META
+
+
 def test_compute_group_bench_record_round_trips(monkeypatch):
     """The compute-group config's record must survive json round-trips and
     carry the dedup evidence: exactly one group over the stat-scores quintet

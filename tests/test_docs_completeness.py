@@ -60,6 +60,31 @@ def test_compute_groups_documented_and_cross_linked():
     assert "performance.md#compute-groups" in obs
 
 
+def test_multitenant_documented_and_cross_linked():
+    """The multi-tenant keyed state's user contract lives in two places: the
+    performance guide (amortized-cost model, sharding spec, rollups, id
+    safety) and the observability guide (its counters + events),
+    cross-linked."""
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "### Multi-tenant state" in perf
+    for phrase in (
+        "tenant_ids",
+        "tenant_axis_sharding",
+        "compute_topk",
+        "compute_percentiles",
+        "validate_ids",
+        "invalid_tenant_ids",
+    ):
+        assert phrase in perf, phrase
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    for counter in ("keyed_update_rows", "keyed_update_dispatches", "invalid_tenant_ids"):
+        assert counter in obs, counter
+    assert "keyed_scatter" in obs and "keyed_build" in obs
+    assert "performance.md#multi-tenant-state" in obs
+
+
 def test_observability_page_cross_linked():
     """The page must be reachable from the performance guide and the README
     (the two places a user hunting for runtime numbers starts from)."""

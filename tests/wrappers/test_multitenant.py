@@ -1,0 +1,495 @@
+"""Multi-tenant keyed state: parity, id safety, lifecycle, and dispatch.
+
+Parity oracle: N independent metric instances, each fed exactly its tenant's
+event rows. Integer add-reduced leaves must match BIT-identically (the
+acceptance pin — segment_sum over int leaves is exact); float leaves match
+within a tight documented tolerance (an instance's batch ``jnp.sum`` and the
+router's ``segment_sum`` may order float additions differently).
+"""
+import pickle
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    BootStrapper,
+    F1,
+    KeyedMetric,
+    MeanSquaredError,
+    MetricCollection,
+    MultiTenantCollection,
+    Precision,
+    Recall,
+    RetrievalPrecision,
+    Specificity,
+    StatScores,
+    observability,
+)
+from metrics_tpu.utilities.distributed import tenant_axis_sharding
+
+NC = 4
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    yield
+    observability.reset()
+    observability.enable()
+
+
+def _assert_state_parity(keyed, insts):
+    """Stacked row t must equal instance t's state: int leaves bit-identical,
+    float leaves within the documented tolerance."""
+    for name in keyed._child._defaults:
+        stacked = np.asarray(getattr(keyed, name))
+        for t, inst in enumerate(insts):
+            want = np.asarray(getattr(inst, name))
+            if np.issubdtype(stacked.dtype, np.integer):
+                np.testing.assert_array_equal(stacked[t], want, err_msg=f"{name}[{t}]")
+            else:
+                np.testing.assert_allclose(
+                    stacked[t], want, rtol=1e-6, atol=1e-8, err_msg=f"{name}[{t}]"
+                )
+
+
+def _values_parity(keyed_vals, insts, updated):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for t, inst in enumerate(insts):
+            if not updated[t]:
+                continue
+            got = np.asarray(jax.tree.map(lambda v: v[t], keyed_vals))
+            np.testing.assert_allclose(got, np.asarray(inst.compute()), rtol=1e-5, atol=1e-7)
+
+
+def _fuzz(keyed, inst_factory, make_batch, steps=4, seed=0, reset_at=None):
+    """Drive keyed vs independent instances over random routed batches;
+    returns (instances, ever-updated mask)."""
+    n = keyed.num_tenants
+    rng = np.random.RandomState(seed)
+    insts = [inst_factory() for _ in range(n)]
+    updated = [False] * n
+    for step in range(steps):
+        rows, batch = make_batch(rng)
+        ids = rng.randint(0, n, rows)
+        keyed.update(jnp.asarray(ids), *[jnp.asarray(b) for b in batch])
+        for t in range(n):
+            sel = ids == t
+            if sel.any():
+                insts[t].update(*[jnp.asarray(b[sel]) for b in batch])
+                updated[t] = True
+        if reset_at is not None and step in reset_at:
+            victims = rng.choice(n, size=2, replace=False)
+            keyed.reset(tenant_ids=jnp.asarray(victims))
+            for t in victims:
+                insts[int(t)].reset()
+                updated[int(t)] = False
+    return insts, updated
+
+
+# ---------------------------------------------------------------- parity fuzz
+
+
+def test_parity_fuzz_classification_binary_bit_identical():
+    keyed = KeyedMetric(Accuracy(), 6)
+    insts, updated = _fuzz(
+        keyed,
+        Accuracy,
+        lambda rng: (48, (rng.rand(48).astype(np.float32), rng.randint(0, 2, 48))),
+    )
+    _assert_state_parity(keyed, insts)  # all-integer states: exact
+    _values_parity(keyed.compute(), insts, updated)
+
+
+def test_parity_fuzz_classification_multiclass():
+    keyed = KeyedMetric(Precision(average="macro", num_classes=NC), 5)
+
+    def batch(rng):
+        logits = rng.rand(40, NC).astype(np.float32)
+        return 40, (logits / logits.sum(-1, keepdims=True), rng.randint(0, NC, 40))
+
+    insts, updated = _fuzz(
+        keyed, lambda: Precision(average="macro", num_classes=NC), batch
+    )
+    _assert_state_parity(keyed, insts)
+    _values_parity(keyed.compute(), insts, updated)
+
+
+def test_parity_fuzz_regression_with_interleaved_resets():
+    keyed = KeyedMetric(MeanSquaredError(), 5)
+    insts, updated = _fuzz(
+        keyed,
+        MeanSquaredError,
+        lambda rng: (32, (rng.randn(32), rng.randn(32))),
+        steps=6,
+        reset_at={1, 3},
+    )
+    _assert_state_parity(keyed, insts)
+    _values_parity(keyed.compute(), insts, updated)
+
+
+def test_parity_fuzz_retrieval_padded():
+    """Tenant axis = query-row axis of the padded retrieval layout."""
+    keyed = KeyedMetric(RetrievalPrecision(padded=True, k=3), 4)
+
+    def batch(rng):
+        return 24, (rng.rand(24, 6).astype(np.float32), rng.randint(0, 2, (24, 6)))
+
+    insts, updated = _fuzz(keyed, lambda: RetrievalPrecision(padded=True, k=3), batch)
+    _assert_state_parity(keyed, insts)
+    _values_parity(keyed.compute(), insts, updated)
+
+
+def test_mixed_dtypes_and_empty_segments():
+    """Leaf dtypes survive stacking; tenants that never receive a row keep
+    their default state exactly."""
+    child = MeanSquaredError()
+    keyed = KeyedMetric(child, 4)
+    for name, default in child._defaults.items():
+        assert getattr(keyed, name).dtype == jnp.asarray(default).dtype
+        assert getattr(keyed, name).shape == (4,) + jnp.shape(default)
+    # rows only for tenants 0 and 2
+    keyed.update(jnp.array([0, 2, 0]), jnp.array([1.0, 2.0, 3.0]), jnp.array([1.5, 2.5, 2.0]))
+    for name, default in child._defaults.items():
+        stacked = np.asarray(getattr(keyed, name))
+        for empty in (1, 3):
+            np.testing.assert_array_equal(stacked[empty], np.asarray(default))
+    assert float(keyed.total[0]) == 2 and float(keyed.total[2]) == 1
+
+
+# ---------------------------------------------------------------- id safety
+
+
+def test_eager_validation_raises_descriptive():
+    keyed = KeyedMetric(Accuracy(), 3)
+    p, t = jnp.array([0.9, 0.1]), jnp.array([1, 0])
+    with pytest.raises(ValueError, match=r"outside the valid range \[0, 3\)"):
+        keyed.update(jnp.array([0, 3]), p, t)
+    with pytest.raises(ValueError, match="outside the valid range"):
+        keyed.update(jnp.array([-1, 0]), p, t)
+    with pytest.raises(ValueError, match="integer array"):
+        keyed.update(jnp.array([0.5, 1.0]), p, t)
+    with pytest.raises(ValueError, match="rank-1"):
+        keyed.update(jnp.array([[0], [1]]), p, t)
+    # nothing was scattered by the failed calls
+    assert int(jnp.sum(keyed.tp) + jnp.sum(keyed.fp) + jnp.sum(keyed.tn) + jnp.sum(keyed.fn)) == 0
+
+
+def test_compiled_clip_drop_counts_invalid_ids():
+    """validate_ids=False: invalid rows are dropped (valid rows land exactly)
+    and the `invalid_tenant_ids` counter carries the drop count."""
+    keyed = KeyedMetric(Accuracy(), 3, validate_ids=False)
+    reference = KeyedMetric(Accuracy(), 3)
+    keyed.update(
+        jnp.array([0, 99, -7, 2]),
+        jnp.array([0.9, 0.5, 0.5, 0.2]),
+        jnp.array([1, 0, 1, 0]),
+    )
+    jax.effects_barrier()  # flush the debug.callback feeding the counter
+    reference.update(jnp.array([0, 2]), jnp.array([0.9, 0.2]), jnp.array([1, 0]))
+    for name in keyed._child._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(keyed, name)), np.asarray(getattr(reference, name))
+        )
+    snap = observability.snapshot(include_timers=False)
+    counters = {
+        k: e["counters"].get("invalid_tenant_ids", 0) for k, e in snap["metrics"].items()
+    }
+    assert sum(counters.values()) == 2
+
+
+def test_pure_apply_update_clips_under_jit():
+    """The pure path cannot raise from a compiled program: invalid ids must
+    clip-and-drop, bit-identically to the valid-rows-only update."""
+    observability.disable()  # no debug.callback: the traced program is pure
+    keyed = KeyedMetric(Accuracy(), 3)
+    step = jax.jit(keyed.apply_update)
+    state = step(
+        keyed.init_state(),
+        jnp.array([1, 77, -2]),
+        jnp.array([0.8, 0.1, 0.3]),
+        jnp.array([1, 1, 0]),
+    )
+    want = keyed.apply_update(
+        keyed.init_state(), jnp.array([1]), jnp.array([0.8]), jnp.array([1])
+    )
+    for name in state:
+        np.testing.assert_array_equal(np.asarray(state[name]), np.asarray(want[name]))
+
+
+# ---------------------------------------------------------------- lifecycle
+
+
+def test_partial_reset_validates_and_preserves_others():
+    keyed = KeyedMetric(Accuracy(), 4)
+    keyed.update(jnp.array([0, 1, 2, 3]), jnp.array([0.9, 0.9, 0.9, 0.9]), jnp.array([1, 1, 1, 1]))
+    before = np.asarray(keyed.tp).copy()
+    keyed.reset(tenant_ids=jnp.array([1, 3]))
+    after = np.asarray(keyed.tp)
+    np.testing.assert_array_equal(after[[0, 2]], before[[0, 2]])
+    np.testing.assert_array_equal(after[[1, 3]], 0)
+    with pytest.raises(ValueError, match="outside the valid range"):
+        keyed.reset(tenant_ids=jnp.array([9]))
+    keyed.reset()  # full reset restores every default
+    assert int(jnp.sum(keyed.tp)) == 0
+
+
+def test_update_many_composes_with_keyed_state():
+    keyed = KeyedMetric(Accuracy(), 4)
+    seq = KeyedMetric(Accuracy(), 4)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 4, (5, 16))
+    preds = rng.rand(5, 16).astype(np.float32)
+    target = rng.randint(0, 2, (5, 16))
+    keyed.update_many(jnp.asarray(ids), jnp.asarray(preds), jnp.asarray(target))
+    for k in range(5):
+        seq.update(jnp.asarray(ids[k]), jnp.asarray(preds[k]), jnp.asarray(target[k]))
+    _assert_state_parity(keyed, [_Row(seq, t) for t in range(4)])
+    with pytest.raises(ValueError, match="outside the valid range"):
+        keyed.update_many(jnp.asarray(ids + 100), jnp.asarray(preds), jnp.asarray(target))
+
+
+class _Row:
+    """Adapter presenting row t of a keyed metric as a per-tenant 'instance'."""
+
+    def __init__(self, keyed, t):
+        for name in keyed._child._defaults:
+            setattr(self, name, getattr(keyed, name)[t])
+
+
+def test_warmup_aot_compiles_then_every_dispatch_hits():
+    keyed = KeyedMetric(Accuracy(), 8)
+    ids = jnp.zeros((16,), jnp.int32)
+    p, t = jnp.linspace(0, 1, 16), jnp.ones((16,), jnp.int32)
+    report = keyed.warmup(ids, p, t)
+    assert report["compiled_this_call"] is True
+    assert report["tenants"] == 8 and report["executables_cached"] == 1
+    assert keyed.warmup(ids, p, t)["compiled_this_call"] is False
+    keyed.update(ids, p, t)
+    fn = keyed._keyed_dispatch(True)
+    assert fn.last_compiled is False  # the real step hit the warmed executable
+    info = fn.cache_info()
+    assert info["entries"] == 1 and info["misses"] == 1 and info["hits"] >= 2
+
+
+def test_donated_and_copying_updates_agree_and_reset_survives():
+    donated = KeyedMetric(Accuracy(), 3)
+    copying = KeyedMetric(Accuracy(), 3, donate=False)
+    for _ in range(3):
+        ids = jnp.array([0, 1, 2, 0])
+        p, t = jnp.array([0.9, 0.2, 0.7, 0.1]), jnp.array([1, 0, 1, 1])
+        donated.update(ids, p, t)
+        copying.update(ids, p, t)
+    for name in donated._child._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(donated, name)), np.asarray(getattr(copying, name))
+        )
+    donated.reset()  # registered defaults were defensively copied, never donated
+    assert int(jnp.sum(donated.tp)) == 0
+    donated.update(jnp.array([1]), jnp.array([0.9]), jnp.array([1]))
+    assert int(donated.tp[1]) == 1
+
+
+def test_pickle_roundtrip_preserves_state_and_rebuilds_dispatch():
+    keyed = KeyedMetric(Accuracy(), 3)
+    keyed.update(jnp.array([0, 2]), jnp.array([0.9, 0.1]), jnp.array([1, 1]))
+    clone = pickle.loads(pickle.dumps(keyed))
+    for name in keyed._child._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(clone, name)), np.asarray(getattr(keyed, name))
+        )
+    assert clone._keyed_update_fn is None  # executables never serialize
+    clone.update(jnp.array([1]), jnp.array([0.9]), jnp.array([1]))
+    assert int(clone.fn[2]) == int(keyed.fn[2])
+
+
+# ---------------------------------------------------------------- eligibility
+
+
+def test_keyed_gate_rejects_ineligible_metrics():
+    with pytest.raises(ValueError, match="unbounded list states"):
+        KeyedMetric(AUROC(), 4)
+    with pytest.raises(ValueError, match="registers no states"):
+        KeyedMetric(BootStrapper(Accuracy()), 4)
+    with pytest.raises(ValueError, match="dist_sync_on_step"):
+        KeyedMetric(Accuracy(dist_sync_on_step=True), 4)
+    with pytest.raises(ValueError, match="num_tenants"):
+        KeyedMetric(Accuracy(), 0)
+    with pytest.raises(ValueError, match="metrics_tpu.Metric"):
+        KeyedMetric("Accuracy", 4)
+
+
+def test_keyed_hooks_on_metric_and_collection():
+    assert isinstance(Accuracy().keyed(4), KeyedMetric)
+    mtc = MetricCollection([Accuracy()]).keyed(4)
+    assert isinstance(mtc, MultiTenantCollection)
+    assert mtc.num_tenants == 4
+
+
+# ---------------------------------------------------------------- collection
+
+
+def _quintet():
+    kw = dict(average="macro", num_classes=NC)
+    return [
+        Precision(**kw),
+        Recall(**kw),
+        F1(**kw),
+        Specificity(**kw),
+        StatScores(reduce="macro", num_classes=NC),
+    ]
+
+
+def _probs(rng, rows):
+    logits = rng.rand(rows, NC).astype(np.float32)
+    return logits / logits.sum(-1, keepdims=True)
+
+
+def test_collection_quintet_collapses_to_one_bundle():
+    """The PR-5 group machinery survives the tenant axis: the stat-scores
+    quintet over N tenants is ONE stacked state bundle and ONE update."""
+    mtc = MultiTenantCollection(_quintet(), 10)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 10, 32)
+    mtc.update(jnp.asarray(ids), jnp.asarray(_probs(rng, 32)), jnp.asarray(rng.randint(0, NC, 32)))
+    assert mtc.state_bundles == 1 and len(mtc) == 5
+    snap = observability.snapshot(include_timers=False)
+    dedup = sum(
+        e["counters"].get("update_dedup_skipped", 0) for e in snap["metrics"].values()
+    )
+    assert dedup == 4  # five members, one shared update
+    ungrouped = MultiTenantCollection([Accuracy(), Precision(average="macro", num_classes=NC)], 10)
+    ungrouped.update(
+        jnp.asarray(ids), jnp.asarray(_probs(rng, 32)), jnp.asarray(rng.randint(0, NC, 32))
+    )
+    assert ungrouped.state_bundles == 2
+
+
+def test_collection_parity_fuzz_vs_independent_collections():
+    n = 6
+    mtc = MultiTenantCollection(_quintet(), n)
+    rng = np.random.RandomState(1)
+    refs = [MetricCollection(_quintet()) for _ in range(n)]
+    updated = [False] * n
+    for _ in range(3):
+        ids = rng.randint(0, n, 64)
+        preds = _probs(rng, 64)
+        target = rng.randint(0, NC, 64)
+        mtc.update(jnp.asarray(ids), jnp.asarray(preds), jnp.asarray(target))
+        for t in range(n):
+            sel = ids == t
+            if sel.any():
+                refs[t].update(jnp.asarray(preds[sel]), jnp.asarray(target[sel]))
+                updated[t] = True
+    vals = mtc.compute()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ref_vals = [r.compute() for r in refs]
+    assert set(vals) == set(ref_vals[0])
+    for name in ("Precision", "Recall", "F1", "Specificity"):
+        for t in range(n):
+            if updated[t]:
+                np.testing.assert_allclose(
+                    np.asarray(vals[name][t]), np.asarray(ref_vals[t][name]), rtol=1e-5
+                )
+
+
+def test_collection_rollups_and_member_selection():
+    mtc = MultiTenantCollection(_quintet(), 5)
+    rng = np.random.RandomState(2)
+    ids = np.arange(40) % 5  # every tenant sees rows: the rollup series is NaN-free
+    mtc.update(jnp.asarray(ids), jnp.asarray(_probs(rng, 40)), jnp.asarray(rng.randint(0, NC, 40)))
+    vals, tenants = mtc.compute_topk(2, metric="F1")
+    assert vals.shape == (2,) and tenants.shape == (2,)
+    series = np.asarray(mtc.compute()["F1"])
+    np.testing.assert_allclose(np.asarray(vals), np.sort(series)[::-1][:2], rtol=1e-6)
+    pct = mtc.compute_percentiles(50.0, metric="Precision")
+    assert np.isfinite(float(pct))
+    with pytest.raises(ValueError, match="pass metric="):
+        mtc.compute_topk(2)
+    with pytest.raises(KeyError, match="no member"):
+        mtc.compute_topk(2, metric="Nope")
+    with pytest.raises(ValueError, match=r"k must be in \[1, 5\]"):
+        mtc.compute_topk(6, metric="F1")
+    with pytest.raises(ValueError, match="one scalar per tenant"):
+        mtc.compute_topk(2, metric="StatScores")
+
+
+def test_collection_update_many_matches_sequential():
+    rng = np.random.RandomState(4)
+    ids = rng.randint(0, 4, (3, 24))
+    preds = np.stack([_probs(rng, 24) for _ in range(3)])
+    target = rng.randint(0, NC, (3, 24))
+    many = MultiTenantCollection(_quintet(), 4)
+    seq = MultiTenantCollection(_quintet(), 4)
+    many.update_many(jnp.asarray(ids), jnp.asarray(preds), jnp.asarray(target))
+    for k in range(3):
+        seq.update(jnp.asarray(ids[k]), jnp.asarray(preds[k]), jnp.asarray(target[k]))
+    for owner, km in many._keyed.items():
+        for name in km._child._defaults:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(km, name)), np.asarray(getattr(seq._keyed[owner], name))
+            )
+
+
+def test_collection_requires_build_for_pure_api():
+    mtc = MultiTenantCollection(_quintet(), 4)
+    with pytest.raises(RuntimeError, match="no state bundles yet"):
+        mtc.init_state()
+    rng = np.random.RandomState(5)
+    groups = mtc.build(jnp.asarray(_probs(rng, 16)), jnp.asarray(rng.randint(0, NC, 16)))
+    assert sum(len(v) for v in groups.values()) == 5  # the quintet groups fully
+    state = mtc.init_state()
+    ids = jnp.asarray(rng.randint(0, 4, 16))
+    state = jax.jit(mtc.apply_update)(
+        state, ids, jnp.asarray(_probs(rng, 16)), jnp.asarray(rng.randint(0, NC, 16))
+    )
+    vals = mtc.apply_compute(state, axis_name=None)
+    assert np.asarray(vals["F1"]).shape == (4,)
+
+
+# ---------------------------------------------------------------- sharding/sync
+
+
+def test_tenant_axis_sharding_spec():
+    devices = jax.devices()[:2]
+    mesh = jax.sharding.Mesh(np.array(devices), ("tenants",))
+    spec = tenant_axis_sharding(mesh, "tenants")
+    keyed = KeyedMetric(Accuracy(), 4, tenant_sharding=spec)
+    assert keyed.tp.sharding.is_equivalent_to(spec, keyed.tp.ndim)
+    keyed.update(jnp.array([0, 3]), jnp.array([0.9, 0.2]), jnp.array([1, 0]))
+    assert int(keyed.tp[0]) == 1
+
+
+def test_sync_collectives_independent_of_tenant_count():
+    """The stacked leaves ride the packed buckets: the in-graph sync lowers
+    to the SAME collective count at N=3 and N=300 — one psum per bucket
+    regardless of tenant count."""
+    import os
+    import sys
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    from check_zero_overhead import _count_collectives, _shard_map
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    counts = {}
+    for n in (3, 300):
+        keyed = KeyedMetric(Accuracy(), n, process_group="data")
+        state = keyed.init_state()
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        jaxpr = jax.make_jaxpr(
+            _shard_map(lambda s, m=keyed: m.sync_state(s, "data"), mesh, (P(),), P())
+        )(state)
+        counts[n] = _count_collectives(jaxpr.jaxpr)
+    assert counts[3] == counts[300]
+    assert sum(counts[3].values()) <= 2  # one psum bucket + one pmax bucket
