@@ -1,8 +1,8 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead
+.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress
 
-ci: test doctest doctest-docs dryrun examples zero-overhead
+ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress
 
 # Full suite on the virtual 8-device CPU mesh (tests/conftest.py), including
 # the real 2-process jax.distributed sync test (tests/bases/test_multiprocess.py).
@@ -41,6 +41,12 @@ examples:
 # Also runs inside the suite as tests/observability/test_zero_overhead.py.
 zero-overhead:
 	python scripts/check_zero_overhead.py
+
+# Perf-regression gate (scripts/bench_regress.py): the latest committed
+# BENCH_r*.json capture must stay within tolerance of the per-config
+# baselines fitted from the prior rounds (degraded/rerun records excluded).
+bench-regress:
+	python scripts/bench_regress.py --check
 
 # Full benchmark suite on the default backend (the real TPU chip under axon).
 bench:

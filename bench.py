@@ -153,6 +153,19 @@ def _measure(name: str, meta) -> dict:
     return {"metric": metric, "value": None, "unit": unit, "vs_baseline": None}
 
 
+def _final_block(lines):
+    """The end-of-run re-emission, tagged ``"rerun": true`` per record.
+
+    The final uninterrupted block repeats every already-printed line, so a
+    consumer of the full output (``scripts/bench_regress.py``, trajectory
+    tooling over the driver's recorded tail) would otherwise double-count
+    each config — the flagship collection line showed up twice in the
+    BENCH_r05 capture. The tag marks the copies; first-pass lines never
+    carry it.
+    """
+    return [dict(line, rerun=True) for line in lines]
+
+
 def main() -> None:
     import bench_suite
 
@@ -168,7 +181,7 @@ def main() -> None:
     # the driver records a bounded tail of this output, and interleaved
     # library warnings once pushed the first config's line out of it
     sys.stderr.flush()
-    for line in lines:
+    for line in _final_block(lines):
         print(json.dumps(line), flush=True)
 
 

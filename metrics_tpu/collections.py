@@ -39,6 +39,7 @@ from metrics_tpu.metric import (
 )
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.health import HEALTH, guard_state
+from metrics_tpu.observability.histogram import observe_dispatch
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import arg_signature
 from metrics_tpu.utilities.aot import CompiledDispatch, trace_fingerprint
@@ -647,20 +648,24 @@ class MetricCollection:
             state, donatable = self._donation_safe_state(state)
             if not donatable:
                 fn = self._forward_copy_dispatch()
-        start = time.perf_counter() if EVENTS.enabled else None
+        start = time.perf_counter() if (EVENTS.enabled or TELEMETRY.enabled) else None
         new_state, values = fn(state, *args, **kwargs)
         if start is not None:
-            EVENTS.record(
-                "forward",
-                self.telemetry_key,
-                dur_s=time.perf_counter() - start,
-                t_start=start,
-                path="compiled",
-                members=len(self._metrics),
-                state_bundles=len(state),
-                compiled_this_call=bool(fn.last_compiled),
-                donated=fn.donate_state,
-            )
+            dur = time.perf_counter() - start
+            if TELEMETRY.enabled:
+                observe_dispatch(dur, "compiled")
+            if EVENTS.enabled:
+                EVENTS.record(
+                    "forward",
+                    self.telemetry_key,
+                    dur_s=dur,
+                    t_start=start,
+                    path="compiled",
+                    members=len(self._metrics),
+                    state_bundles=len(state),
+                    compiled_this_call=bool(fn.last_compiled),
+                    donated=fn.donate_state,
+                )
         record = TELEMETRY.enabled
         if record:
             # one compiled program serves every member: the collection key
@@ -790,6 +795,7 @@ class MetricCollection:
             if TELEMETRY.enabled:
                 TELEMETRY.inc(key, "update_many_calls")
                 TELEMETRY.inc(key, "update_many_batches", k)
+                observe_dispatch(dur, "update_many")
                 _note_compiled_dispatch(
                     self, fn, stacked, stacked_kwargs, counter="update_many_dispatches"
                 )

@@ -54,6 +54,7 @@ from metrics_tpu.utilities.data import (
 )
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.health import HEALTH, MetricHealthError, guard_state  # noqa: F401
+from metrics_tpu.observability.histogram import observe_dispatch
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import MONITOR, arg_signature, is_tracing
 from metrics_tpu.utilities.aot import CompiledDispatch
@@ -872,20 +873,24 @@ class Metric(ABC):
             state, donatable = self._donation_safe_state(state)
             if not donatable:
                 fn = self._forward_copy_dispatch()
-        start = time.perf_counter() if EVENTS.enabled else None
+        start = time.perf_counter() if (EVENTS.enabled or TELEMETRY.enabled) else None
         out = fn(state, *args, **kwargs)
         if start is not None:
             # wall time of the (async) dispatch, not the device step — the
             # device cost lives in the profiler trace this timeline rides next to
-            EVENTS.record(
-                "forward",
-                self.telemetry_key,
-                dur_s=time.perf_counter() - start,
-                t_start=start,
-                path="compiled",
-                compiled_this_call=bool(fn.last_compiled),
-                donated=fn.donate_state,
-            )
+            dur = time.perf_counter() - start
+            if TELEMETRY.enabled:
+                observe_dispatch(dur, "compiled")
+            if EVENTS.enabled:
+                EVENTS.record(
+                    "forward",
+                    self.telemetry_key,
+                    dur_s=dur,
+                    t_start=start,
+                    path="compiled",
+                    compiled_this_call=bool(fn.last_compiled),
+                    donated=fn.donate_state,
+                )
         if TELEMETRY.enabled:
             _note_compiled_dispatch(self, fn, args, kwargs)
         new_state, value = out if self.compute_on_step else (out, None)
@@ -1022,6 +1027,7 @@ class Metric(ABC):
             if TELEMETRY.enabled:
                 TELEMETRY.inc(key, "update_many_calls")
                 TELEMETRY.inc(key, "update_many_batches", k)
+                observe_dispatch(dur, "update_many")
                 _note_compiled_dispatch(
                     self, fn, stacked, stacked_kwargs, counter="update_many_dispatches"
                 )

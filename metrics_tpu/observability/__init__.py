@@ -1,6 +1,6 @@
 """Runtime telemetry for the metric lifecycle (see ``docs/observability.md``).
 
-Seven pieces, one snapshot:
+Nine pieces, one snapshot:
 
 * :mod:`~metrics_tpu.observability.registry` — thread-safe per-metric
   counters (update/forward/compute/reset/sync, eager vs. compiled path) and
@@ -19,8 +19,17 @@ Seven pieces, one snapshot:
 * :mod:`~metrics_tpu.observability.health` — on-device NaN/Inf/zero-weight
   monitoring: ``Metric.check_health()`` plus the opt-in per-update guard
   (:func:`set_health_policy`).
+* :mod:`~metrics_tpu.observability.histogram` — fixed-bucket log2 latency/size
+  histograms for the fast path (:data:`HISTOGRAMS`: dispatch wall time, sync
+  round-trips, gather payload sizes; no allocation, no lock on ``observe``).
+* :mod:`~metrics_tpu.observability.aggregate` — mergeable snapshots:
+  declared per-leaf reductions (counters sum, gauges max, histogram buckets
+  sum), the :func:`snapshot_pytree` canonical form that rides
+  ``sync_state_packed``, and :func:`aggregate_snapshots` — ONE fleet-wide
+  snapshot (with per-process breakdown) shipped over ``gather_all_pytrees``.
 * :mod:`~metrics_tpu.observability.export` — :func:`snapshot` (JSON dict) and
-  :func:`render_prometheus` (text exposition format).
+  :func:`render_prometheus` (text exposition format; ``aggregated=True``
+  renders the fleet view with ``process`` labels).
 
 Everything is recorded host-side; the compiled hot paths carry zero extra
 traced ops unless the (opt-in) health guard is armed — and
@@ -33,7 +42,18 @@ byte-identical to the uninstrumented baseline. Typical scrape::
     observability.timeline.export("/tmp/metrics-timeline.json")
 """
 from metrics_tpu.observability import timeline  # noqa: F401
+from metrics_tpu.observability.aggregate import (  # noqa: F401
+    aggregate_snapshots,
+    apply_pytree,
+    merge_snapshots,
+    snapshot_pytree,
+)
 from metrics_tpu.observability.cost import program_cost, pytree_nbytes  # noqa: F401
+from metrics_tpu.observability.histogram import (  # noqa: F401
+    HISTOGRAMS,
+    HistogramRegistry,
+    Log2Histogram,
+)
 from metrics_tpu.observability.events import (  # noqa: F401
     EVENTS,
     Event,
@@ -76,11 +96,13 @@ def disable() -> None:
 
 def reset() -> None:
     """Clear all recorded counters, timers, sync stats, retrace ledgers,
-    events, and health records (enablement, policy, step tag survive)."""
+    events, histograms, and health records (enablement, policy, step tag
+    survive)."""
     TELEMETRY.reset()
     MONITOR.reset()
     EVENTS.clear()
     HEALTH.reset()
+    HISTOGRAMS.reset()
 
 
 __all__ = [
@@ -88,12 +110,17 @@ __all__ = [
     "Event",
     "EventLog",
     "HEALTH",
+    "HISTOGRAMS",
     "HealthMonitor",
+    "HistogramRegistry",
+    "Log2Histogram",
     "MONITOR",
     "MetricHealthError",
     "RetraceMonitor",
     "TELEMETRY",
     "TelemetryRegistry",
+    "aggregate_snapshots",
+    "apply_pytree",
     "arg_signature",
     "disable",
     "dumps",
@@ -101,6 +128,7 @@ __all__ = [
     "get_health_policy",
     "get_retrace_threshold",
     "get_step",
+    "merge_snapshots",
     "program_cost",
     "pytree_nbytes",
     "render_prometheus",
@@ -109,6 +137,7 @@ __all__ = [
     "set_retrace_threshold",
     "set_step",
     "snapshot",
+    "snapshot_pytree",
     "step_context",
     "timeline",
 ]

@@ -33,8 +33,13 @@ from typing import Any, Dict, Iterator, List, NamedTuple, Optional
 
 #: the closed set of event kinds the timeline knows how to render;
 #: ``compile`` marks a deliberate AOT lower+compile (``Metric.warmup``) so a
-#: first-dispatch trace+compile slice is distinguishable from steady state
-EVENT_KINDS = ("update", "forward", "compute", "sync", "retrace", "health", "compile")
+#: first-dispatch trace+compile slice is distinguishable from steady state;
+#: ``tenant_report`` marks a multi-tenant drill-down rollup (occupancy,
+#: traffic, staleness) landing on the timeline
+EVENT_KINDS = (
+    "update", "forward", "compute", "sync", "retrace", "health", "compile",
+    "tenant_report",
+)
 
 #: default bound on retained events; ~100 bytes each, so the default log
 #: tops out near half a megabyte of host memory

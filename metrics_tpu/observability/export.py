@@ -1,17 +1,22 @@
 """Snapshot assembly and export renderers.
 
 :func:`snapshot` merges the telemetry registry (counters, timers, state
-memory, sync stats) with the retrace monitor's ledger into one
-JSON-serializable dict — the structure a serving loop scrapes, the bench
-harness attaches to its records, and the tests pin. :func:`render_prometheus`
-renders the same data in the Prometheus text exposition format so a scrape
-endpoint can serve it directly.
+memory, sync stats), the fast-path histograms, and the retrace monitor's
+ledger into one JSON-serializable dict — the structure a serving loop
+scrapes, the bench harness attaches to its records, and the tests pin.
+:func:`render_prometheus` renders the same data in the Prometheus text
+exposition format so a scrape endpoint can serve it directly: every series
+carries ``# HELP`` / ``# TYPE`` metadata, histograms render in the proper
+``_bucket``/``_sum``/``_count`` form, and ``aggregated=True`` renders a
+fleet-wide :func:`~metrics_tpu.observability.aggregate.aggregate_snapshots`
+view with ``process`` labels.
 """
 import json
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.health import HEALTH
+from metrics_tpu.observability.histogram import HISTOGRAMS
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import MONITOR
 
@@ -19,6 +24,32 @@ from metrics_tpu.observability.retrace import MONITOR
 SCHEMA_VERSION = 1
 
 _PROM_PREFIX = "metrics_tpu"
+
+#: HELP strings per (unprefixed) series name — the exposition format wants
+#: one HELP + TYPE per metric family; unlisted names degrade to a generated
+#: one-liner, never to a missing header
+_HELP: Dict[str, str] = {
+    "calls_total": "Instrumented calls per metric instance and operation.",
+    "eager_seconds": "Eager update/forward/compute wall time per metric.",
+    "state_bytes": "Live metric state footprint (shape x itemsize).",
+    "compute_groups": "Multi-member compute groups formed in a collection.",
+    "compute_group_members": "Members served by one compute group's shared state.",
+    "retrace_compiles_total": "Fresh XLA compiles forced by jitted dispatches.",
+    "retrace_traces_total": "Pure-API traces recorded per metric.",
+    "events_recorded_total": "Events appended to the structured event log.",
+    "events_dropped_total": "Events evicted from the bounded event log.",
+    "events_high_water": "Peak retained event count.",
+    "events_by_kind_total": "Events recorded per kind.",
+    "health_checks_total": "Health checks run per metric.",
+    "processes": "Processes aggregated into this scrape.",
+    "tenants": "Tenant-axis size of a multi-tenant wrapper.",
+    "tenants_active": "Tenants that received at least one event row.",
+    "tenant_rows_routed_total": "Event rows routed to tenant states.",
+    "tenant_invalid_rate": "Fraction of routed rows with out-of-range tenant ids.",
+    "dispatch_seconds": "Compiled dispatch host wall time (fast-path log2 histogram).",
+    "sync_round_trip_seconds": "Eager sync transport round-trip wall time.",
+    "gather_payload_bytes": "Eager gather transport payload volume.",
+}
 
 
 def snapshot(include_timers: bool = True) -> Dict[str, Any]:
@@ -42,15 +73,21 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
                      "metrics": {key: {"checks": int, "unhealthy": int,
                                         "nan": int, "inf": int,
                                         "zero_weight": int}}},
+          "histograms": {"dispatch_seconds{path=compiled}": {"unit": "s",
+                          "count": int, "sum": float, "buckets": {...},
+                          "p50": float, "p95": float, "p99": float}, ...},
         }
 
-    Always JSON-serializable (``json.dumps(snapshot())`` round-trips).
+    Always JSON-serializable (``json.dumps(snapshot())`` round-trips), and
+    mergeable across processes by the declared reductions — see
+    :func:`~metrics_tpu.observability.aggregate.aggregate_snapshots`.
     """
     snap = TELEMETRY.snapshot(include_timers=include_timers)
     snap["schema"] = SCHEMA_VERSION
     snap["retrace"] = MONITOR.snapshot()
     snap["events"] = EVENTS.summary()
     snap["health"] = HEALTH.summary()
+    snap["histograms"] = HISTOGRAMS.snapshot()
     return snap
 
 
@@ -60,62 +97,105 @@ def _prom_label(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
-    """Render a snapshot in the Prometheus text exposition format (0.0.4)."""
-    if snap is None:
-        snap = snapshot()
-    lines = []
+def _prom_le(bound_key: str) -> str:
+    """``le_...`` bucket-table key -> exposition ``le`` label value."""
+    le = bound_key[len("le_"):]
+    if le.endswith("s"):
+        le = le[:-1]
+    return "+Inf" if le == "inf" else le
 
-    def emit(name: str, labels: Dict[str, str], value: Any, type_: Optional[str] = None) -> None:
-        full = f"{_PROM_PREFIX}_{name}"
-        if type_ is not None:
-            lines.append(f"# TYPE {full} {type_}")
+
+class _Renderer:
+    """Line emitter tracking per-family ``# HELP`` / ``# TYPE`` metadata so
+    every series declares itself exactly once per scrape."""
+
+    def __init__(self) -> None:
+        self.lines: List[str] = []
+        self._seen: set = set()
+
+    def _meta(self, full: str, type_: str, name: str) -> None:
+        if full in self._seen:
+            return
+        self._seen.add(full)
+        help_ = _HELP.get(name, name.replace("_", " "))
+        self.lines.append(f"# HELP {full} {help_}")
+        self.lines.append(f"# TYPE {full} {type_}")
+
+    def _sample(self, full: str, labels: Dict[str, str], value: Any) -> None:
         label_str = ",".join(f'{k}="{_prom_label(str(v))}"' for k, v in labels.items())
-        lines.append(f"{full}{{{label_str}}} {value}" if label_str else f"{full} {value}")
+        self.lines.append(f"{full}{{{label_str}}} {value}" if label_str else f"{full} {value}")
 
-    first_counter = True
-    first_hist = True
+    def emit(self, name: str, labels: Dict[str, str], value: Any, type_: str = "gauge") -> None:
+        full = f"{_PROM_PREFIX}_{name}"
+        self._meta(full, type_, name)
+        self._sample(full, labels, value)
+
+    def emit_histogram(
+        self, name: str, labels: Dict[str, str], buckets: Dict[str, int],
+        sum_: float, count: int,
+    ) -> None:
+        """One histogram family: cumulative ``_bucket{le=...}`` samples (the
+        ``buckets`` table is per-bucket), then ``_sum`` and ``_count`` —
+        TYPE/HELP declared on the base name, per the exposition format."""
+        full = f"{_PROM_PREFIX}_{name}"
+        self._meta(full, "histogram", name)
+        cumulative = 0
+        for bound_key, n in buckets.items():
+            cumulative += n
+            self._sample(f"{full}_bucket", {**labels, "le": _prom_le(bound_key)}, cumulative)
+        self._sample(f"{full}_sum", labels, sum_)
+        self._sample(f"{full}_count", labels, count)
+
+
+def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer) -> None:
+    """Render one process's snapshot; ``base`` labels (e.g. ``process``) ride
+    every sample."""
     for key, entry in sorted(snap.get("metrics", {}).items()):
         for counter, value in sorted(entry.get("counters", {}).items()):
-            emit(
-                "calls_total",
-                {"metric": key, "op": counter},
-                value,
-                type_="counter" if first_counter else None,
-            )
-            first_counter = False
+            out.emit("calls_total", {**base, "metric": key, "op": counter}, value, "counter")
         for phase, hist in sorted(entry.get("timers", {}).items()):
-            labels = {"metric": key, "phase": phase}
-            if first_hist:
-                lines.append(f"# TYPE {_PROM_PREFIX}_eager_seconds histogram")
-                first_hist = False
-            cumulative = 0
-            for bound, count in hist["buckets"].items():
-                cumulative += count
-                le = bound[len("le_"):].rstrip("s").replace("inf", "+Inf")
-                emit("eager_seconds_bucket", {**labels, "le": le}, cumulative)
-            emit("eager_seconds_sum", labels, hist["sum_s"])
-            emit("eager_seconds_count", labels, hist["count"])
+            out.emit_histogram(
+                "eager_seconds",
+                {**base, "metric": key, "phase": phase},
+                hist["buckets"],
+                hist["sum_s"],
+                hist["count"],
+            )
         mem = entry.get("state_memory")
         if mem is not None:
-            emit("state_bytes", {"metric": key}, mem.get("total_bytes", 0), type_="gauge")
+            out.emit("state_bytes", {**base, "metric": key}, mem.get("total_bytes", 0))
         cg = entry.get("info", {}).get("compute_groups")
         if cg is not None:
             # group composition as gauges: group count, plus members served
             # per group (labeled by the group owner's member name)
-            emit("compute_groups", {"metric": key}, len(cg.get("groups", {})), type_="gauge")
+            out.emit("compute_groups", {**base, "metric": key}, len(cg.get("groups", {})))
             for owner, members in sorted(cg.get("groups", {}).items()):
-                emit(
+                out.emit(
                     "compute_group_members",
-                    {"metric": key, "group": owner},
+                    {**base, "metric": key, "group": owner},
                     len(members),
-                    type_="gauge",
                 )
+        tr = entry.get("info", {}).get("tenant_report")
+        if tr is not None:
+            # multi-tenant drill-down rollup: axis size, occupancy, traffic,
+            # invalid-id pressure (the full report is in the snapshot blob)
+            out.emit("tenants", {**base, "metric": key}, tr.get("tenants", 0))
+            out.emit(
+                "tenants_active", {**base, "metric": key},
+                tr.get("occupancy", {}).get("active", 0),
+            )
+            out.emit(
+                "tenant_rows_routed_total", {**base, "metric": key},
+                tr.get("rows_routed", 0), "counter",
+            )
+            out.emit(
+                "tenant_invalid_rate", {**base, "metric": key}, tr.get("invalid_rate", 0.0)
+            )
 
     retrace = snap.get("retrace", {})
     for key, rec in sorted(retrace.get("metrics", {}).items()):
-        emit("retrace_compiles_total", {"metric": key}, rec["compiles"], type_="counter")
-        emit("retrace_traces_total", {"metric": key}, rec["traces"])
+        out.emit("retrace_compiles_total", {**base, "metric": key}, rec["compiles"], "counter")
+        out.emit("retrace_traces_total", {**base, "metric": key}, rec["traces"], "counter")
 
     sync = snap.get("sync", {})
     for field in (
@@ -129,44 +209,70 @@ def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
         "payload_rounds",
     ):
         if field in sync:
-            emit(f"sync_{field}_total", {}, sync[field], type_="counter")
+            out.emit(f"sync_{field}_total", base, sync[field], "counter")
     in_graph = sync.get("in_graph", {})
     for kind, n in sorted(in_graph.get("collectives", {}).items()):
-        emit("sync_in_graph_collectives_total", {"kind": kind}, n)
+        out.emit("sync_in_graph_collectives_total", {**base, "kind": kind}, n, "counter")
     for bucket, n in sorted(in_graph.get("buckets", {}).items()):
-        emit("sync_in_graph_bucket_states_total", {"bucket": bucket}, n)
+        out.emit("sync_in_graph_bucket_states_total", {**base, "bucket": bucket}, n, "counter")
     for field in ("collectives_before", "collectives_after", "dedup_groups", "dedup_members"):
         if field in in_graph:
-            emit(f"sync_in_graph_{field}_total", {}, in_graph[field], type_="counter")
+            out.emit(f"sync_in_graph_{field}_total", base, in_graph[field], "counter")
 
     events = snap.get("events", {})
     if events:
-        emit("events_recorded_total", {}, events.get("recorded_total", 0), type_="counter")
-        emit("events_dropped_total", {}, events.get("dropped", 0), type_="counter")
-        emit("events_high_water", {}, events.get("high_water", 0), type_="gauge")
-        first_kind = True
+        out.emit("events_recorded_total", base, events.get("recorded_total", 0), "counter")
+        out.emit("events_dropped_total", base, events.get("dropped", 0), "counter")
+        out.emit("events_high_water", base, events.get("high_water", 0))
         for kind, n in sorted(events.get("by_kind", {}).items()):
-            emit(
-                "events_by_kind_total",
-                {"kind": kind},
-                n,
-                type_="counter" if first_kind else None,
-            )
-            first_kind = False
+            out.emit("events_by_kind_total", {**base, "kind": kind}, n, "counter")
 
     health = snap.get("health", {})
-    first_check = True
     for key, rec in sorted(health.get("metrics", {}).items()):
-        emit(
-            "health_checks_total",
-            {"metric": key},
-            rec["checks"],
-            type_="counter" if first_check else None,
-        )
-        first_check = False
+        out.emit("health_checks_total", {**base, "metric": key}, rec.get("checks", 0), "counter")
         for kind in ("unhealthy", "nan", "inf", "zero_weight"):
-            emit(f"health_{kind}_total", {"metric": key}, rec[kind])
-    return "\n".join(lines) + "\n"
+            out.emit(f"health_{kind}_total", {**base, "metric": key}, rec.get(kind, 0), "counter")
+
+    for series in sorted(snap.get("histograms", {})):
+        entry = snap["histograms"][series]
+        name = entry.get("name", series)
+        labels = {**base, **entry.get("labels", {})}
+        out.emit_histogram(name, labels, entry["buckets"], entry["sum"], entry["count"])
+
+
+def render_prometheus(
+    snap: Optional[Dict[str, Any]] = None, *, aggregated: bool = False
+) -> str:
+    """Render a snapshot in the Prometheus text exposition format (0.0.4).
+
+    Every series carries ``# HELP``/``# TYPE`` metadata; timers and the
+    fast-path log2 histograms render as proper histogram families
+    (cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``).
+
+    ``aggregated=True`` (or passing an
+    :func:`~metrics_tpu.observability.aggregate.aggregate_snapshots` result
+    as ``snap``) renders the FLEET view: every process's series with a
+    ``process="<index>"`` label — the per-process drill-down a scraper sums
+    for fleet totals — plus a ``metrics_tpu_processes`` gauge. When
+    ``aggregated=True`` and ``snap`` is omitted, the local process gathers
+    the fleet's snapshots first (a collective: all processes must call
+    together).
+    """
+    if snap is None:
+        if aggregated:
+            from metrics_tpu.observability.aggregate import aggregate_snapshots
+
+            snap = aggregate_snapshots()
+        else:
+            snap = snapshot()
+    out = _Renderer()
+    if snap.get("aggregated"):
+        out.emit("processes", {}, snap.get("process_count", 0))
+        for proc in sorted(snap.get("per_process", {}), key=lambda p: (len(p), p)):
+            _render_snapshot(snap["per_process"][proc], {"process": proc}, out)
+    else:
+        _render_snapshot(snap, {}, out)
+    return "\n".join(out.lines) + "\n"
 
 
 def dumps(include_timers: bool = True, **json_kwargs: Any) -> str:

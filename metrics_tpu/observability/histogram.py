@@ -1,0 +1,248 @@
+"""Fixed-bucket log2 histograms for the host-side hot path.
+
+The registry's eager timers (:class:`~metrics_tpu.observability.registry._Histogram`)
+answer "how long do eager calls take" at 6 coarse decades; this module is the
+**fast-path** instrument: dispatch wall-times, sync round-trips, and gather
+payload sizes recorded at every compiled dispatch / transport completion.
+Design constraints, in order:
+
+* **Zero traced ops.** Observations happen strictly host-side, inside the
+  already-instrumented dispatch/transport call sites, gated on the same
+  lock-free ``TELEMETRY.enabled`` read — the compiled programs are
+  byte-identical with histograms on or off (``scripts/check_zero_overhead.py``
+  pins it).
+* **No allocation, no lock contention on the fast path.**
+  :meth:`Log2Histogram.observe` is one ``math.frexp`` (the value's binary
+  exponent IS the bucket index) plus three in-place writes into preallocated
+  numpy buffers. There is no lock: under concurrent writers counts may
+  under-tally by the races lost (never corrupt, never raise) — the documented
+  trade for a contention-free step path. Series *creation* takes a lock once;
+  call sites hit a plain dict read afterwards.
+* **Mergeable.** Bucket layouts are fixed per unit (``"s"`` / ``"bytes"``), so
+  fleet aggregation (:mod:`~metrics_tpu.observability.aggregate`) is an
+  elementwise bucket sum — histograms are the third reduction kind (after
+  counter→sum and gauge→max) the mergeable-snapshot contract declares.
+
+Exported views: :meth:`Log2Histogram.to_dict` carries the bucket table plus
+``p50``/``p95``/``p99`` estimates into ``observability.snapshot()`` (under the
+``histograms`` key); the Prometheus renderer emits each series in the proper
+histogram exposition form (cumulative ``_bucket{le=...}`` + ``_sum`` +
+``_count``).
+"""
+import math
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+#: binary-exponent range of the latency buckets: upper bounds 2^-20 s (~1 µs)
+#: .. 2^2 s (4 s), +inf implicit — 23 finite buckets spanning µs-dispatches to
+#: multi-second stragglers at a fixed 2x resolution
+LATENCY_EXP_RANGE = (-20, 2)
+#: binary-exponent range of the size buckets: upper bounds 2^6 (64 B) ..
+#: 2^30 (1 GiB), +inf implicit
+SIZE_EXP_RANGE = (6, 30)
+
+#: bucket layout per unit — every histogram of one unit shares a layout, so
+#: cross-process aggregation is an elementwise bucket sum
+UNIT_EXP_RANGES = {"s": LATENCY_EXP_RANGE, "bytes": SIZE_EXP_RANGE}
+
+
+class Log2Histogram:
+    """Preallocated fixed-bucket histogram with power-of-two bounds.
+
+    Bucket ``i`` counts observations in ``(2^(min_exp+i-1), 2^(min_exp+i)]``
+    (Prometheus ``le`` semantics on the upper bound); the first bucket
+    additionally absorbs everything at or below its bound, the last
+    (``+inf``) everything above ``2^max_exp``. ``observe`` never allocates
+    and never locks.
+    """
+
+    __slots__ = ("unit", "_min_exp", "_counts", "_totals")
+
+    def __init__(self, unit: str = "s") -> None:
+        if unit not in UNIT_EXP_RANGES:
+            raise ValueError(f"unknown histogram unit {unit!r}; known: {sorted(UNIT_EXP_RANGES)}")
+        self.unit = unit
+        min_exp, max_exp = UNIT_EXP_RANGES[unit]
+        self._min_exp = min_exp
+        # finite buckets + the +inf bucket, preallocated once
+        self._counts = np.zeros(max_exp - min_exp + 2, dtype=np.int64)
+        # [count, sum] — kept in one buffer so observe touches two arrays total
+        self._totals = np.zeros(2, dtype=np.float64)
+
+    # -- recording (the fast path) ------------------------------------------
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        if value > 0.0:
+            # frexp: value = m * 2^e with m in [0.5, 1) -> the smallest upper
+            # bound holding value is 2^e, except an exact power of two
+            # (m == 0.5) belongs to its own bound 2^(e-1) ("le" semantics)
+            m, e = math.frexp(value)
+            if m == 0.5:
+                e -= 1
+            idx = e - self._min_exp
+            if idx < 0:
+                idx = 0
+            elif idx >= self._counts.shape[0]:
+                idx = self._counts.shape[0] - 1
+        else:
+            idx = 0
+        self._counts[idx] += 1
+        self._totals[0] += 1.0
+        self._totals[1] += value
+
+    # -- reading -------------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return int(self._totals[0])
+
+    @property
+    def sum(self) -> float:
+        return float(self._totals[1])
+
+    def bounds(self) -> Tuple[float, ...]:
+        """Finite bucket upper bounds (the +inf bucket is implicit last)."""
+        return tuple(
+            2.0 ** (self._min_exp + i) for i in range(self._counts.shape[0] - 1)
+        )
+
+    def percentile(self, q: float) -> float:
+        """Estimate the ``q``-th percentile (``q`` in [0, 100]) from the
+        buckets: linear interpolation inside the covering bucket, its upper
+        bound when the rank lands in ``+inf``. 0.0 when empty."""
+        total = int(self._totals[0])
+        if total == 0:
+            return 0.0
+        rank = q / 100.0 * total
+        cum = 0
+        for i in range(self._counts.shape[0]):
+            prev = cum
+            cum += int(self._counts[i])
+            if cum >= rank and cum > 0:
+                hi = 2.0 ** (self._min_exp + i)
+                if i == self._counts.shape[0] - 1:  # +inf bucket: clamp
+                    return 2.0 ** (self._min_exp + i - 1)
+                lo = 2.0 ** (self._min_exp + i - 1) if i > 0 else 0.0
+                inside = self._counts[i]
+                frac = (rank - prev) / inside if inside else 1.0
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        return 2.0 ** (self._min_exp + self._counts.shape[0] - 2)  # pragma: no cover
+
+    def bucket_counts(self) -> np.ndarray:
+        """The raw per-bucket counts (finite buckets then +inf) — the
+        sum-reducible leaf the aggregation pytree ships."""
+        return self._counts.copy()
+
+    def merge_counts(self, counts: Any, count: float, sum_: float) -> None:
+        """Fold another histogram's raw buckets/totals into this one (the
+        aggregation path; layouts are fixed per unit so this is elementwise)."""
+        counts = np.asarray(counts, dtype=np.int64)
+        if counts.shape != self._counts.shape:
+            raise ValueError(
+                f"bucket layout mismatch: {counts.shape} vs {self._counts.shape}"
+            )
+        self._counts += counts
+        self._totals[0] += float(count)
+        self._totals[1] += float(sum_)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON view: bucket table (``le_<bound>`` -> count), totals, and the
+        p50/p95/p99 estimates."""
+        buckets = {}
+        for i in range(self._counts.shape[0] - 1):
+            bound = 2.0 ** (self._min_exp + i)
+            buckets[f"le_{bound:.9g}"] = int(self._counts[i])
+        buckets["le_inf"] = int(self._counts[-1])
+        return {
+            "unit": self.unit,
+            "count": self.count,
+            "sum": round(self.sum, 9),
+            "buckets": buckets,
+            "p50": round(self.percentile(50.0), 9),
+            "p95": round(self.percentile(95.0), 9),
+            "p99": round(self.percentile(99.0), 9),
+        }
+
+
+def _series_key(name: str, labels: Dict[str, str]) -> str:
+    if not labels:
+        return name
+    return name + "{" + ",".join(f"{k}={labels[k]}" for k in sorted(labels)) + "}"
+
+
+class HistogramRegistry:
+    """Named fast-path histograms (one process-global instance,
+    :data:`HISTOGRAMS`).
+
+    Series are keyed ``name{label=value,...}``; creation is locked once per
+    series, after which :meth:`observe` is a dict read plus the lock-free
+    :meth:`Log2Histogram.observe`. Call sites gate on ``TELEMETRY.enabled``
+    (the registry carries no enablement of its own), so a disabled telemetry
+    stack skips these entirely.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._series: Dict[str, Tuple[Log2Histogram, Dict[str, str], str]] = {}
+
+    def get(self, name: str, unit: str = "s", **labels: str) -> Log2Histogram:
+        """The series' histogram, created (under the lock) on first use."""
+        key = _series_key(name, labels)
+        entry = self._series.get(key)
+        if entry is None:
+            with self._lock:
+                entry = self._series.get(key)
+                if entry is None:
+                    entry = (Log2Histogram(unit), dict(labels), name)
+                    self._series[key] = entry
+        return entry[0]
+
+    def observe(self, name: str, value: float, unit: str = "s", **labels: str) -> None:
+        self.get(name, unit=unit, **labels).observe(float(value))
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON view keyed by series: bucket tables, totals, percentiles,
+        and the series' name/labels split back out (for renderers)."""
+        out: Dict[str, Any] = {}
+        # snapshot iterates a live dict: take a consistent key list first
+        with self._lock:
+            items = list(self._series.items())
+        for key, (hist, labels, name) in items:
+            entry = hist.to_dict()
+            entry["name"] = name
+            if labels:
+                entry["labels"] = dict(labels)
+            out[key] = entry
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+#: the process-global fast-path histogram registry
+HISTOGRAMS = HistogramRegistry()
+
+#: canonical series names the library records (call sites + docs + tests)
+DISPATCH_SECONDS = "dispatch_seconds"
+SYNC_ROUND_TRIP_SECONDS = "sync_round_trip_seconds"
+GATHER_PAYLOAD_BYTES = "gather_payload_bytes"
+
+
+def observe_dispatch(seconds: float, path: str) -> None:
+    """One compiled dispatch's host wall time (``path``: ``compiled`` /
+    ``keyed_scatter`` / ``update_many``)."""
+    HISTOGRAMS.observe(DISPATCH_SECONDS, seconds, unit="s", path=path)
+
+
+def observe_sync_round_trip(seconds: float, transport: str = "gather") -> None:
+    """One eager sync transport's full round-trip wall time."""
+    HISTOGRAMS.observe(SYNC_ROUND_TRIP_SECONDS, seconds, unit="s", transport=transport)
+
+
+def observe_gather_payload(nbytes: int) -> None:
+    """One eager gather transport's total payload volume."""
+    HISTOGRAMS.observe(GATHER_PAYLOAD_BYTES, nbytes, unit="bytes")

@@ -242,6 +242,16 @@ class TelemetryRegistry:
 
     # -- reading -------------------------------------------------------------
 
+    def counter(self, key: str, name: str, default: int = 0) -> int:
+        """One counter's current value (``default`` when never recorded) —
+        the cheap point read report builders use instead of a full
+        :meth:`snapshot`."""
+        with self._lock:
+            entry = self._metrics.get(key)
+            if entry is None:
+                return default
+            return entry["counters"].get(name, default)
+
     def _state_memory(self, key: str) -> Optional[Dict[str, Any]]:
         ref = self._instances.get(key)
         obj = ref() if ref is not None else None

@@ -134,7 +134,16 @@ def export(
     path: str, events: Optional[Sequence[Event]] = None, log: Optional[EventLog] = None
 ) -> str:
     """Write the Chrome-trace JSON to ``path`` and return ``path``. The file
-    loads directly in ``chrome://tracing`` and https://ui.perfetto.dev."""
+    loads directly in ``chrome://tracing`` and https://ui.perfetto.dev.
+
+    Missing parent directories are created (the usual call site is an
+    end-of-run hook writing into a per-run artifact dir that may not exist
+    yet), and a never-written/empty event log exports a VALID empty trace —
+    the process-name metadata plus an empty-summary ``otherData`` block —
+    so an early-exit run's artifact still loads in the viewers."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     trace = to_chrome_trace(events, log=log)
     with open(path, "w") as fh:
         json.dump(trace, fh)

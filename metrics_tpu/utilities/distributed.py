@@ -460,11 +460,19 @@ def _record_gather_telemetry(
     raises."""
     try:
         from metrics_tpu.observability.events import EVENTS
+        from metrics_tpu.observability.histogram import (
+            observe_gather_payload,
+            observe_sync_round_trip,
+        )
         from metrics_tpu.observability.registry import TELEMETRY
 
         payload_rounds = 1 if max_bytes else 0
         transport_bytes = nprocs * desc_bytes + payload_rounds * nprocs * max_bytes
         if TELEMETRY.enabled:
+            # fast-path log2 histograms: the transport's full round-trip wall
+            # time and its payload volume (host-side; the gather is complete)
+            observe_sync_round_trip(dur_s, transport="gather")
+            observe_gather_payload(transport_bytes)
             TELEMETRY.record_gather(
                 bytes_out=int(bytes_out),
                 bytes_in=int(bytes_in),

@@ -68,6 +68,7 @@ from metrics_tpu.metric import (
 )
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.health import HEALTH, guard_state
+from metrics_tpu.observability.histogram import observe_dispatch
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import arg_signature, is_tracing
 from metrics_tpu.utilities.aot import CompiledDispatch
@@ -130,6 +131,114 @@ def _keyed_gate(metric: Metric, what: str = "base_metric") -> None:
             " cannot run inside the keyed compiled dispatch; sync at compute()"
             " instead (stacked leaves ride the packed collectives)."
         )
+
+
+class _TenantTraffic:
+    """Host-side per-tenant traffic/staleness ledger behind
+    ``tenant_report()``.
+
+    Tracks, per tenant, the event rows routed and the wall-clock instant of
+    the last routed row — plain numpy on the host, fed from the stateful
+    ``update``/``update_many`` call sites (never from inside a traced
+    program: zero traced ops, and the pure ``apply_update`` path is
+    untouched). Buffers allocate lazily on the first observed batch while
+    telemetry is enabled (~16 bytes/tenant), so a disabled stack pays one
+    ``enabled`` read. Invalid ids are dropped here exactly as the scatter's
+    discard bucket drops them.
+    """
+
+    __slots__ = ("n", "rows", "last_seen")
+
+    def __init__(self, n: int) -> None:
+        self.n = int(n)
+        self.rows: Optional[np.ndarray] = None
+        self.last_seen: Optional[np.ndarray] = None
+
+    def note(self, ids: Any) -> None:
+        concrete = np.asarray(ids).reshape(-1)
+        valid = concrete[(concrete >= 0) & (concrete < self.n)]
+        if valid.size == 0:
+            return
+        if self.rows is None:
+            self.rows = np.zeros(self.n, dtype=np.int64)
+            self.last_seen = np.full(self.n, np.nan)
+        self.rows += np.bincount(valid, minlength=self.n)
+        self.last_seen[np.unique(valid)] = time.time()
+
+    def clear(self, ids: Optional[Any] = None) -> None:
+        if self.rows is None:
+            return
+        if ids is None:
+            self.rows = None
+            self.last_seen = None
+            return
+        idx = np.asarray(ids).reshape(-1)
+        self.rows[idx] = 0
+        self.last_seen[idx] = np.nan
+
+    def report(self, top_k: int, invalid: int) -> Dict[str, Any]:
+        """The drill-down dict (see ``KeyedMetric.tenant_report``)."""
+        now = time.time()
+        n = self.n
+        rows = self.rows if self.rows is not None else np.zeros(n, dtype=np.int64)
+        active_mask = rows > 0
+        active = int(active_mask.sum())
+        rows_total = int(rows.sum())
+        k = max(0, min(int(top_k), n))
+        top: List[Dict[str, Any]] = []
+        if rows_total and k:
+            order = np.argsort(rows)[::-1][:k]
+            top = [
+                {"tenant": int(i), "rows": int(rows[i])} for i in order if rows[i] > 0
+            ]
+        staleness: Dict[str, Any] = {"p50": None, "p95": None, "max": None}
+        stalest: List[Dict[str, Any]] = []
+        if active and self.last_seen is not None:
+            ages = now - self.last_seen[active_mask]
+            staleness = {
+                "p50": round(float(np.percentile(ages, 50)), 6),
+                "p95": round(float(np.percentile(ages, 95)), 6),
+                "max": round(float(ages.max()), 6),
+            }
+            active_ids = np.nonzero(active_mask)[0]
+            order = np.argsort(ages)[::-1][: min(k, active)]
+            stalest = [
+                {"tenant": int(active_ids[i]), "age_s": round(float(ages[i]), 6)}
+                for i in order
+            ]
+        routed_plus_invalid = rows_total + int(invalid)
+        return {
+            "tenants": n,
+            "tracking": self.rows is not None,
+            "rows_routed": rows_total,
+            "occupancy": {
+                "active": active,
+                "fraction": round(active / n, 6) if n else 0.0,
+            },
+            "top_traffic": top,
+            "invalid_tenant_ids": int(invalid),
+            "invalid_rate": (
+                round(int(invalid) / routed_plus_invalid, 6) if routed_plus_invalid else 0.0
+            ),
+            "staleness_s": staleness,
+            "stalest": stalest,
+            "generated_unix_s": round(now, 3),
+        }
+
+
+def _publish_tenant_report(key: str, report: Dict[str, Any]) -> None:
+    """Land a tenant report on the snapshot (compact ``info`` blob — the
+    Prometheus renderer reads it) and the event timeline."""
+    compact = {
+        "tenants": report["tenants"],
+        "rows_routed": report["rows_routed"],
+        "occupancy": report["occupancy"],
+        "invalid_rate": report["invalid_rate"],
+    }
+    if TELEMETRY.enabled:
+        TELEMETRY.set_info(key, "tenant_report", compact)
+    if EVENTS.enabled:
+        EVENTS.record("tenant_report", key, **compact)
 
 
 def _note_invalid_ids(key: str, count: Any) -> None:
@@ -232,6 +341,14 @@ class KeyedMetric(Metric):
             )
         self._keyed_update_fn: Optional[CompiledDispatch] = None
         self._keyed_update_copy_fn: Optional[CompiledDispatch] = None
+        self._traffic = _TenantTraffic(self.num_tenants)
+
+    def _note_tenant_traffic(self, ids: Any) -> None:
+        """Host-side drill-down ledger feed (rows + staleness per tenant)."""
+        try:
+            self._traffic.note(ids)
+        except Exception:  # pragma: no cover - telemetry must not break updates
+            pass
 
     # ------------------------------------------------------------------
     # tenant-id canonicalization / validation
@@ -376,6 +493,8 @@ class KeyedMetric(Metric):
             key = self.telemetry_key
             if TELEMETRY.enabled:
                 TELEMETRY.inc(key, "keyed_update_rows", int(ids.shape[0]))
+                observe_dispatch(dur, "keyed_scatter")
+                self._note_tenant_traffic(ids)
                 _note_compiled_dispatch(
                     self, fn, (ids,) + args, kwargs, counter="keyed_update_dispatches"
                 )
@@ -401,6 +520,8 @@ class KeyedMetric(Metric):
         ids = jnp.asarray(tenant_ids)
         if self.validate_ids:
             self._validate_ids_eager(ids.reshape(-1))
+        if TELEMETRY.enabled:
+            self._note_tenant_traffic(ids)
         super().update_many(ids, *stacked, **stacked_kwargs)
 
     def warmup(self, tenant_ids: Any, *sample_batch: Any, **kwargs: Any) -> Dict[str, Any]:
@@ -492,6 +613,27 @@ class KeyedMetric(Metric):
         distribution."""
         return jnp.nanpercentile(self._scalar_values(key), jnp.asarray(q))
 
+    def tenant_report(self, top_k: int = 10) -> Dict[str, Any]:
+        """Per-tenant drill-down from the host-side traffic ledger.
+
+        Returns occupancy (tenants that received >=1 row, count + fraction),
+        the ``top_k`` update-traffic tenants (``{"tenant", "rows"}``), the
+        ``invalid_tenant_ids`` counter with its rate over all routed rows,
+        and last-update staleness — p50/p95/max age in seconds over active
+        tenants plus the ``top_k`` stalest of them. Purely host-side (numpy
+        over the ledger the stateful ``update``/``update_many`` call sites
+        feed while telemetry is enabled; ``tracking`` is ``False`` when no
+        traffic was recorded). Publishing side effects: the compact rollup
+        lands on the snapshot as a ``tenant_report`` info blob (rendered as
+        ``metrics_tpu_tenants*`` gauges) and on the event timeline as a
+        ``tenant_report`` event.
+        """
+        invalid = TELEMETRY.counter(self.telemetry_key, "invalid_tenant_ids")
+        report = self._traffic.report(top_k, invalid)
+        report["metric"] = f"KeyedMetric({type(self._child).__name__})"
+        _publish_tenant_report(self.telemetry_key, report)
+        return report
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
@@ -503,9 +645,11 @@ class KeyedMetric(Metric):
         every stacked leaf, leaving all other tenants' accumulation intact
         (ids always validate here: reset is host-side administration)."""
         if tenant_ids is None:
+            self._traffic.clear()
             return super().reset()
         ids = self._canonical_ids(tenant_ids)
         self._validate_ids_eager(ids)
+        self._traffic.clear(np.asarray(ids))
         new: StateDict = {}
         for name, default in self._child._defaults.items():
             new[name] = getattr(self, name).at[ids].set(jnp.asarray(default))
@@ -577,6 +721,14 @@ class MultiTenantCollection:
         self._update_many_fn: Optional[CompiledDispatch] = None
         self._update_many_copy_fn: Optional[CompiledDispatch] = None
         self._donation_warned = False
+        self._traffic = _TenantTraffic(self.num_tenants)
+
+    def _note_tenant_traffic(self, ids: Any) -> None:
+        """Host-side drill-down ledger feed (rows + staleness per tenant)."""
+        try:
+            self._traffic.note(ids)
+        except Exception:  # pragma: no cover - telemetry must not break updates
+            pass
 
     @property
     def telemetry_key(self) -> str:
@@ -804,6 +956,8 @@ class MultiTenantCollection:
             if TELEMETRY.enabled:
                 TELEMETRY.inc(key, "update_calls")
                 TELEMETRY.inc(key, "keyed_update_rows", int(ids.shape[0]))
+                observe_dispatch(dur, "keyed_scatter")
+                self._note_tenant_traffic(ids)
                 skipped = sum(len(ns) - 1 for _, ns in self._layout)
                 if skipped:
                     TELEMETRY.inc(key, "update_dedup_skipped", skipped)
@@ -880,6 +1034,7 @@ class MultiTenantCollection:
             key = self.telemetry_key
             TELEMETRY.inc(key, "update_many_calls")
             TELEMETRY.inc(key, "update_many_batches", k)
+            self._note_tenant_traffic(ids)
             _note_compiled_dispatch(
                 self, fn, (ids,) + stacked, stacked_kwargs, counter="update_many_dispatches"
             )
@@ -1017,6 +1172,22 @@ class MultiTenantCollection:
             return
         for km in self._keyed.values():
             km.reset(tenant_ids)
+        self._traffic.clear(None if tenant_ids is None else np.asarray(tenant_ids))
+
+    def tenant_report(self, top_k: int = 10) -> Dict[str, Any]:
+        """Per-tenant drill-down for the whole collection (one ledger — every
+        member sees the same routed rows): occupancy, top-``top_k``
+        update-traffic tenants, the ``invalid_tenant_ids`` rate, and
+        last-update staleness (see :meth:`KeyedMetric.tenant_report`). Also
+        lands on the snapshot (``tenant_report`` info blob / Prometheus
+        ``metrics_tpu_tenants*`` gauges) and the event timeline."""
+        invalid = TELEMETRY.counter(self.telemetry_key, "invalid_tenant_ids")
+        report = self._traffic.report(top_k, invalid)
+        report["metric"] = "MultiTenantCollection"
+        report["members"] = len(self._collection)
+        report["state_bundles"] = len(self._keyed) if self._keyed is not None else 0
+        _publish_tenant_report(self.telemetry_key, report)
+        return report
 
     # ------------------------------------------------------------------
     # container / misc protocol

@@ -1,0 +1,242 @@
+"""Continuous perf-regression gate over the committed bench trajectory.
+
+The repo ships one ``BENCH_r<NN>.json`` capture per driver round — the
+self-defending records ``bench.py`` emits (value + unit + ``vs_baseline`` +
+endpoint-health probes + ``degraded`` flag per config). This script turns
+that trajectory into an automated gate: it fits a per-config baseline from
+the PRIOR rounds and fails, with a readable delta table, when the latest
+round regresses past a configurable tolerance.
+
+Decision rules (each unit-tested in ``tests/test_bench_regress.py``):
+
+* **Degraded records never vote.** A record probed on a sick endpoint
+  (``"degraded": true`` — the round-3 failure mode) is excluded from the
+  baseline, and a degraded LATEST record is reported as skipped rather than
+  judged: a sick chip is not a code regression.
+* **Re-emitted records never double-count.** ``bench.py`` repeats every line
+  in its final output block tagged ``"rerun": true``; those copies (and the
+  literal duplicates in pre-tag captures) are deduplicated per round.
+* **The baseline is the median of prior healthy rounds** (at least
+  ``--min-history`` of them; configs with less history are reported, not
+  judged — a brand-new config cannot fail the gate on its first capture).
+* **Lower is better** for every recorded unit (``us/step``, ``us/tenant``,
+  ``us/epoch``, ``pct``): the latest value regresses when
+  ``latest > baseline * (1 + tolerance)``.
+
+Run: ``python scripts/bench_regress.py --check`` (CI via ``make
+bench-regress`` / ``make ci``); exit 1 iff a config regressed. ``--list``
+prints the parsed trajectory instead of judging it.
+"""
+import argparse
+import glob as globlib
+import json
+import os
+import re
+import sys
+from statistics import median
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: default regression tolerance: fail past baseline x (1 + TOLERANCE). Bench
+#: noise between healthy rounds is single-digit percent (BENCH_r01-r05);
+#: 0.5 separates that from a real 2x regression with wide margin both ways.
+DEFAULT_TOLERANCE = 0.5
+#: prior healthy rounds required before a config is judged
+DEFAULT_MIN_HISTORY = 2
+
+#: record statuses the delta table reports
+OK, REGRESSED, SKIPPED_DEGRADED, SKIPPED_NO_VALUE, SKIPPED_NO_HISTORY = (
+    "ok", "REGRESSED", "skipped (degraded)", "skipped (no value)",
+    "skipped (insufficient history)",
+)
+
+
+def _iter_json_lines(text: str) -> List[Dict[str, Any]]:
+    """Every parseable one-line JSON object in ``text`` (a driver tail may
+    open with a truncated line — unparseable lines are dropped)."""
+    out = []
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            rec = json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            out.append(rec)
+    return out
+
+
+def load_round(path: str) -> Tuple[int, Dict[str, Dict[str, Any]]]:
+    """One capture file -> ``(round_number, {metric: record})``.
+
+    Accepts the driver capture format (``{"n": .., "tail": "<jsonl>",
+    "parsed": {..}}``), a plain JSON list of records, or raw JSONL.
+    Records tagged ``"rerun": true`` are dropped; remaining duplicates of a
+    metric keep the LAST occurrence (the final re-emitted block of pre-tag
+    captures repeats the first-pass values verbatim, so last-wins is
+    value-identical and keeps the most complete line).
+    """
+    with open(path) as fh:
+        text = fh.read()
+    records: List[Dict[str, Any]] = []
+    number: Optional[int] = None
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        number = doc.get("n")
+        records = _iter_json_lines(doc.get("tail", ""))
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            records.append(parsed)
+    elif isinstance(doc, list):
+        records = [r for r in doc if isinstance(r, dict) and "metric" in r]
+    else:
+        records = _iter_json_lines(text)
+    if number is None:
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        number = int(m.group(1)) if m else 0
+    by_metric: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        if rec.get("rerun"):
+            continue
+        by_metric[rec["metric"]] = rec
+    return int(number), by_metric
+
+
+def load_trajectory(paths: List[str]) -> List[Tuple[int, Dict[str, Dict[str, Any]]]]:
+    """All capture files as ``[(round, {metric: record})]``, round-ascending."""
+    rounds = [load_round(p) for p in sorted(paths)]
+    rounds.sort(key=lambda item: item[0])
+    return rounds
+
+
+def _healthy_value(rec: Optional[Dict[str, Any]]) -> Optional[float]:
+    if not rec or rec.get("degraded") or rec.get("value") is None:
+        return None
+    return float(rec["value"])
+
+
+def check_trajectory(
+    rounds: List[Tuple[int, Dict[str, Dict[str, Any]]]],
+    tolerance: float = DEFAULT_TOLERANCE,
+    min_history: int = DEFAULT_MIN_HISTORY,
+) -> List[Dict[str, Any]]:
+    """Judge the LATEST round against per-config baselines from the prior
+    ones. Returns one row per config in the latest round:
+    ``{"metric", "unit", "baseline", "latest", "delta_pct", "status",
+    "history"}`` — ``status`` is ``REGRESSED`` only for a healthy latest
+    value past ``baseline * (1 + tolerance)``.
+    """
+    if not rounds:
+        return []
+    latest_n, latest = rounds[-1]
+    prior = rounds[:-1]
+    rows: List[Dict[str, Any]] = []
+    for metric in sorted(latest):
+        rec = latest[metric]
+        history = [
+            v for v in (_healthy_value(by_metric.get(metric)) for _, by_metric in prior)
+            if v is not None
+        ]
+        row: Dict[str, Any] = {
+            "metric": metric,
+            "unit": rec.get("unit"),
+            "round": latest_n,
+            "history": len(history),
+            "baseline": round(median(history), 3) if history else None,
+            "latest": rec.get("value"),
+            "delta_pct": None,
+        }
+        if rec.get("degraded"):
+            row["status"] = SKIPPED_DEGRADED
+        elif rec.get("value") is None:
+            row["status"] = SKIPPED_NO_VALUE
+        elif len(history) < min_history:
+            row["status"] = SKIPPED_NO_HISTORY
+        else:
+            baseline = median(history)
+            value = float(rec["value"])
+            row["delta_pct"] = round((value / baseline - 1.0) * 100.0, 1)
+            row["status"] = REGRESSED if value > baseline * (1.0 + tolerance) else OK
+        rows.append(row)
+    return rows
+
+
+def render_table(rows: List[Dict[str, Any]], tolerance: float) -> str:
+    """The human-readable delta table the gate prints."""
+    headers = ("config", "unit", "baseline", "latest", "delta", "status")
+    table = [headers]
+    for row in rows:
+        table.append(
+            (
+                row["metric"],
+                str(row["unit"] or "-"),
+                "-" if row["baseline"] is None else f"{row['baseline']:g}",
+                "-" if row["latest"] is None else f"{row['latest']:g}",
+                "-" if row["delta_pct"] is None else f"{row['delta_pct']:+.1f}%",
+                row["status"],
+            )
+        )
+    widths = [max(len(r[i]) for r in table) for i in range(len(headers))]
+    lines = ["  ".join(cell.ljust(w) for cell, w in zip(r, widths)).rstrip() for r in table]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    regressed = sum(1 for row in rows if row["status"] == REGRESSED)
+    lines.append("")
+    lines.append(
+        f"{len(rows)} configs, {regressed} regressed"
+        f" (tolerance: +{tolerance * 100:.0f}% over the prior-round median)"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "paths", nargs="*",
+        help="capture files (default: BENCH_r*.json at the repo root)",
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="CI mode: exit 1 when a config regressed (the exit code reflects"
+        " regressions either way; the flag documents intent in make targets)",
+    )
+    parser.add_argument(
+        "--tolerance", type=float, default=DEFAULT_TOLERANCE,
+        help="allowed fractional slowdown over the baseline (default"
+        f" {DEFAULT_TOLERANCE}: fail past baseline x {1 + DEFAULT_TOLERANCE})",
+    )
+    parser.add_argument(
+        "--min-history", type=int, default=DEFAULT_MIN_HISTORY,
+        help="prior healthy rounds required before a config is judged"
+        f" (default {DEFAULT_MIN_HISTORY})",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="print the parsed trajectory and exit"
+    )
+    args = parser.parse_args(argv)
+
+    paths = args.paths or sorted(globlib.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+    if not paths:
+        print("bench_regress: no capture files found", file=sys.stderr)
+        return 2
+    rounds = load_trajectory(paths)
+    if args.list:
+        for n, by_metric in rounds:
+            for metric, rec in sorted(by_metric.items()):
+                print(
+                    f"r{n:02d} {metric}: {rec.get('value')} {rec.get('unit')}"
+                    f" (degraded={bool(rec.get('degraded'))})"
+                )
+        return 0
+    rows = check_trajectory(rounds, tolerance=args.tolerance, min_history=args.min_history)
+    print(render_table(rows, args.tolerance))
+    return 1 if any(row["status"] == REGRESSED for row in rows) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

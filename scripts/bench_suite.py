@@ -133,6 +133,18 @@ def probe_endpoint() -> dict:
         t0 = time.perf_counter()
         float(ident(jnp.zeros(())))
         rtts.append(time.perf_counter() - t0)
+    try:
+        # the probe's link round-trips feed the fast-path sync histogram, so
+        # the record's telemetry snapshot carries the RTT distribution
+        # (p50/p95/p99) next to the point estimate below
+        from metrics_tpu.observability.histogram import observe_sync_round_trip
+        from metrics_tpu.observability.registry import TELEMETRY
+
+        if TELEMETRY.enabled:
+            for rtt in rtts:
+                observe_sync_round_trip(rtt, transport="probe")
+    except Exception:  # pragma: no cover - telemetry must not break the probe
+        pass
 
     e_short, e_long = _probe_epoch(_PROBE_SHORT), _probe_epoch(_PROBE_LONG)
     a = jax.random.normal(jax.random.PRNGKey(0), (_PROBE_DIM, _PROBE_DIM), jnp.float32)

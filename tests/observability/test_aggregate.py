@@ -1,0 +1,410 @@
+"""Mergeable telemetry snapshots: declared-reduction merge semantics
+(property-style, every reduction kind), the canonical pytree form riding the
+packed in-graph sync, and the fleet aggregation round-trip through
+``gather_all_pytrees`` over simulated processes."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu.utilities.distributed as dist_mod
+from metrics_tpu import Accuracy, observability
+from metrics_tpu.observability.aggregate import (
+    aggregate_snapshots,
+    apply_pytree,
+    leaf_reduction,
+    merge_snapshots,
+    snapshot_pytree,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    yield
+    observability.reset()
+    observability.enable()
+
+
+def _synthetic_snapshot(scale=1, *, dead=False, step=7):
+    """A snapshot exercising EVERY declared reduction kind with hand-checkable
+    values: counters/histograms (sum), gauges (max), booleans (any/or),
+    signature lists (union), annotations (last)."""
+    snap = {
+        "schema": 1,
+        "enabled": scale % 2 == 1,
+        "metrics": {
+            "Accuracy#0": {
+                "counters": {"update_calls": 10 * scale, "sync_calls": scale},
+                "timers": {
+                    "forward": {
+                        "count": 4 * scale,
+                        "sum_s": 0.25 * scale,
+                        "buckets": {"le_0.001s": 3 * scale, "le_inf": 1 * scale},
+                    }
+                },
+                "state_memory": {"total_bytes": 128 * scale, "per_state": {"correct": {}}},
+            },
+            "Gone#0": {"counters": {"update_calls": scale}, "dead": dead},
+        },
+        "retrace": {
+            "threshold": 3 * scale,
+            "metrics": {
+                "Accuracy#0": {
+                    "compiles": 2 * scale,
+                    "traces": 3 * scale,
+                    "warned": scale > 1,
+                    "signatures": [f"(f32[{scale}])", "(f32[8])"],
+                }
+            },
+        },
+        "sync": {
+            "gathers": 5 * scale,
+            "payload_bytes_out": 100 * scale,
+            "groups": {"0,1": {"gathers": 5 * scale, "world": 2 * scale}},
+            "in_graph": {"syncs": scale, "collectives": {"psum": 2 * scale}},
+        },
+        "events": {
+            "enabled": True,
+            "capacity": 4096,
+            "size": 10 * scale,
+            "high_water": 20 * scale,
+            "recorded_total": 30 * scale,
+            "dropped": scale - 1,
+            "step": step * scale,
+            "by_kind": {"update": 9 * scale},
+        },
+        "health": {
+            "policy": "off" if scale == 1 else "record",
+            "unhealthy_total": scale - 1,
+            "metrics": {"Accuracy#0": {"checks": scale, "nan": 0}},
+        },
+        "histograms": {
+            "dispatch_seconds{path=compiled}": {
+                "unit": "s",
+                "name": "dispatch_seconds",
+                "labels": {"path": "compiled"},
+                "count": 8 * scale,
+                "sum": 0.5 * scale,
+                "buckets": {"le_0.001": 6 * scale, "le_inf": 2 * scale},
+                "p50": 0.0005,
+                "p95": 0.001,
+                "p99": 0.001,
+            }
+        },
+    }
+    return snap
+
+
+def test_merge_matches_hand_merged_for_every_reduction_kind():
+    """Satellite: ``aggregate_snapshots([a, b])`` equals the hand-merged
+    result for every declared reduction — sum (counters, histogram buckets,
+    timer totals), max (thresholds, high-water, step), any/or (warned,
+    dead), union (signatures), last (policy, annotations)."""
+    a, b = _synthetic_snapshot(1, dead=True), _synthetic_snapshot(3)
+    merged = aggregate_snapshots([a, b])["merged"]
+
+    # counters -> sum
+    assert merged["metrics"]["Accuracy#0"]["counters"] == {
+        "update_calls": 40, "sync_calls": 4
+    }
+    # dead-weakref entries merge too: counters sum, the flag ORs
+    assert merged["metrics"]["Gone#0"] == {"counters": {"update_calls": 4}, "dead": True}
+    # timers -> histogram merge (count/sum_s/buckets all sum)
+    timer = merged["metrics"]["Accuracy#0"]["timers"]["forward"]
+    assert timer == {"count": 16, "sum_s": 1.0, "buckets": {"le_0.001s": 12, "le_inf": 4}}
+    # state memory: fleet bytes sum, per-state detail last-wins
+    assert merged["metrics"]["Accuracy#0"]["state_memory"]["total_bytes"] == 512
+    # retrace: gauge threshold max, counters sum, warned ORs, signatures union
+    assert merged["retrace"]["threshold"] == 9
+    rt = merged["retrace"]["metrics"]["Accuracy#0"]
+    assert rt["compiles"] == 8 and rt["traces"] == 12 and rt["warned"] is True
+    assert rt["signatures"] == ["(f32[1])", "(f32[8])", "(f32[3])"]
+    # sync: totals sum, group world is a gauge (max)
+    assert merged["sync"]["gathers"] == 20
+    assert merged["sync"]["groups"]["0,1"] == {"gathers": 20, "world": 6}
+    assert merged["sync"]["in_graph"] == {"syncs": 4, "collectives": {"psum": 8}}
+    # events: capacity/high_water/step max, volumes sum, enabled ORs
+    ev = merged["events"]
+    assert ev["capacity"] == 4096 and ev["high_water"] == 60 and ev["step"] == 21
+    assert ev["size"] == 40 and ev["recorded_total"] == 120 and ev["dropped"] == 2
+    assert ev["by_kind"] == {"update": 36}
+    # health: policy last-wins, ledgers sum
+    assert merged["health"]["policy"] == "record"
+    assert merged["health"]["metrics"]["Accuracy#0"] == {"checks": 4, "nan": 0}
+    # histograms: buckets/count/sum sum; percentiles recomputed, not summed
+    hist = merged["histograms"]["dispatch_seconds{path=compiled}"]
+    assert hist["count"] == 32 and hist["sum"] == 2.0
+    assert hist["buckets"] == {"le_0.001": 24, "le_inf": 8}
+    assert 0 < hist["p50"] <= 0.001  # interpolated from merged buckets
+    assert hist["labels"] == {"path": "compiled"}
+    # enabled ORs; the merged result stays JSON-serializable
+    assert merged["enabled"] is True
+    assert json.loads(json.dumps(merged)) == merged
+
+
+def test_merge_is_associative_and_empty_is_identity():
+    a, b, c = (_synthetic_snapshot(s) for s in (1, 2, 3))
+    left = merge_snapshots([merge_snapshots([a, b]), c])
+    right = merge_snapshots([a, merge_snapshots([b, c])])
+    flat = merge_snapshots([a, b, c])
+    # percentile recomputation is idempotent, so nesting == flat
+    assert left == right == flat
+    # empty snapshots are identities (a process that recorded nothing)
+    assert merge_snapshots([a, {}]) == merge_snapshots([{}, a]) == merge_snapshots([a])
+    assert merge_snapshots([]) == {}
+    assert merge_snapshots([{}, {}]) == {}
+
+
+def test_merge_of_real_snapshots_doubles_counters():
+    rng = np.random.RandomState(0)
+    probs = jnp.asarray(rng.rand(8, 3).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 3, 8))
+    m = Accuracy()
+    m(probs, target)
+    m.compute()
+    snap = observability.snapshot()
+    merged = merge_snapshots([snap, snap])
+    key = m.telemetry_key
+    for counter, value in snap["metrics"][key]["counters"].items():
+        assert merged["metrics"][key]["counters"][counter] == 2 * value
+    for series, entry in snap["histograms"].items():
+        assert merged["histograms"][series]["count"] == 2 * entry["count"]
+
+
+def test_leaf_reduction_declarations():
+    assert leaf_reduction(("metrics", "A#0", "counters", "update_calls")) == "sum"
+    assert leaf_reduction(("events", "high_water")) == "max"
+    assert leaf_reduction(("retrace", "metrics", "A#0", "warned")) == "any"
+    assert leaf_reduction(("retrace", "metrics", "A#0", "signatures")) == "union"
+    assert leaf_reduction(("health", "policy")) == "last"
+    assert leaf_reduction(("histograms", "x", "buckets", "le_1")) == "sum"
+    assert leaf_reduction(("unknown", "leaf")) == "last"  # never drop, never invent
+
+
+# ---------------------------------------------------------------------------
+# canonical pytree form: dogfooding the packed in-graph sync
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_pytree_declares_only_collective_reductions():
+    snap = _synthetic_snapshot(2)
+    state, reductions = snapshot_pytree(snap)
+    assert set(state) == set(reductions)
+    assert set(reductions.values()) <= {"sum", "max"}
+    # counters ride as sums, gauges as max, histogram buckets as ONE vector
+    assert reductions["metrics/Accuracy#0/counters/update_calls"] == "sum"
+    assert reductions["events/high_water"] == "max"
+    bucket_key = "histograms/dispatch_seconds{path=compiled}/buckets"
+    assert reductions[bucket_key] == "sum"
+    assert state[bucket_key].shape == (2,) and state[bucket_key].dtype == np.int64
+    # strings/bools/annotations never enter the pytree
+    assert "health/policy" not in state
+    assert "enabled" not in state
+
+
+def test_snapshot_pytree_round_trips_through_packed_in_graph_sync():
+    """The in-graph dogfood: the snapshot's pytree form rides
+    ``sync_state_packed`` over a mesh axis on the virtual device mesh —
+    counters come back world-summed, gauges world-maxed, histogram buckets
+    bucket-summed — and ``apply_pytree`` folds the reduced leaves back into
+    a full snapshot with recomputed percentiles."""
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.utilities.distributed import sync_state_packed
+
+    snap = _synthetic_snapshot(1)
+    state, reductions = snapshot_pytree(snap)
+    world = min(4, jax.device_count())
+    mesh = Mesh(np.array(jax.devices()[:world]), ("fleet",))
+
+    def shard_map(fn):
+        if hasattr(jax, "shard_map"):
+            return jax.shard_map(fn, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False)
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=(P(),), out_specs=P(), check_rep=False)
+
+    jstate = {k: jnp.asarray(v) for k, v in state.items()}
+    synced = shard_map(lambda s: sync_state_packed(s, reductions, "fleet"))(jstate)
+    synced = {k: np.asarray(v) for k, v in synced.items()}
+
+    # sums scale by world size, maxes don't (every shard held the same value)
+    assert synced["metrics/Accuracy#0/counters/update_calls"] == 10 * world
+    assert synced["events/high_water"] == 20
+    bucket_key = "histograms/dispatch_seconds{path=compiled}/buckets"
+    np.testing.assert_array_equal(synced[bucket_key], np.array([6, 2]) * world)
+
+    fleet = apply_pytree(snap, synced)
+    assert fleet["metrics"]["Accuracy#0"]["counters"]["update_calls"] == 10 * world
+    assert fleet["events"]["high_water"] == 20
+    hist = fleet["histograms"]["dispatch_seconds{path=compiled}"]
+    assert hist["count"] == 8 * world
+    assert hist["buckets"] == {"le_0.001": 6 * world, "le_inf": 2 * world}
+    assert 0 < hist["p50"] <= 0.001
+    assert json.loads(json.dumps(fleet)) == fleet
+
+
+# ---------------------------------------------------------------------------
+# eager aggregation over the real gather transport (simulated processes)
+# ---------------------------------------------------------------------------
+
+
+def _run_ranks(fns):
+    """Run one callable per simulated rank over a barrier-backed fake
+    ``_process_allgather`` (the tests/bases/test_packed_gather.py harness)."""
+    nprocs = len(fns)
+    barrier = threading.Barrier(nprocs)
+    exchange = {}
+    lock = threading.Lock()
+    rank_of_thread = {}
+
+    def fake_allgather(x):
+        rank = rank_of_thread[threading.get_ident()]
+        with lock:
+            exchange[rank] = np.asarray(x)
+        barrier.wait()
+        stacked = np.stack([exchange[r] for r in range(nprocs)])
+        barrier.wait()
+        return stacked
+
+    results, errors = [None] * nprocs, [None] * nprocs
+
+    def worker(rank):
+        rank_of_thread[threading.get_ident()] = rank
+        try:
+            results[rank] = fns[rank]()
+        except Exception as err:  # pragma: no cover - surfaced below
+            errors[rank] = err
+            time.sleep(0.1)
+            barrier.abort()
+
+    orig = (
+        dist_mod._process_allgather,
+        dist_mod.distributed_available,
+        dist_mod.world_size,
+        dist_mod.jax.process_index,
+    )
+    dist_mod._process_allgather = fake_allgather
+    dist_mod.distributed_available = lambda: True
+    dist_mod.world_size = lambda: nprocs
+    dist_mod.jax.process_index = lambda: rank_of_thread[threading.get_ident()]
+    try:
+        threads = [threading.Thread(target=worker, args=(r,)) for r in range(nprocs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        (
+            dist_mod._process_allgather,
+            dist_mod.distributed_available,
+            dist_mod.world_size,
+            dist_mod.jax.process_index,
+        ) = orig
+    assert errors == [None] * nprocs, errors
+    return results
+
+
+def test_aggregate_snapshots_round_trips_gather_over_two_processes():
+    """Acceptance: ``aggregate_snapshots()`` over >= 2 simulated processes
+    round-trips each rank's DIFFERENT local snapshot through the real
+    ``gather_all_pytrees`` ragged byte protocol, and the merged result
+    carries the correct sum/max/bucket merges on every rank."""
+    locals_ = {0: _synthetic_snapshot(1), 1: _synthetic_snapshot(3)}
+
+    def rank_fn(rank):
+        def run():
+            # hand the rank's own local snapshot through the real packed
+            # ragged byte transport, then merge the decoded fleet
+            payload = np.frombuffer(
+                json.dumps(locals_[rank]).encode(), dtype=np.uint8
+            )
+            gathered = dist_mod.gather_all_pytrees([payload])[0]
+            snaps = [
+                json.loads(np.asarray(b, dtype=np.uint8).tobytes().decode())
+                for b in gathered
+            ]
+            return aggregate_snapshots(snaps)
+
+        return run
+
+    results = _run_ranks([rank_fn(0), rank_fn(1)])
+    for agg in results:
+        assert agg["process_count"] == 2
+        assert agg["per_process"]["0"] == locals_[0]
+        assert agg["per_process"]["1"] == locals_[1]
+        merged = agg["merged"]
+        assert merged["metrics"]["Accuracy#0"]["counters"]["update_calls"] == 40
+        assert merged["events"]["high_water"] == 60  # max(20, 60)
+        assert merged["histograms"]["dispatch_seconds{path=compiled}"]["buckets"] == {
+            "le_0.001": 24, "le_inf": 8
+        }
+    assert results[0] == results[1]  # every rank sees the same fleet view
+
+
+def test_aggregate_snapshots_gathers_real_local_snapshots_per_rank():
+    """End-to-end default path: ``aggregate_snapshots()`` with no arguments
+    snapshots locally on every rank and gathers the fleet itself (the two
+    simulated ranks share this process's registry, so the merged counters
+    come back exactly doubled)."""
+    rng = np.random.RandomState(0)
+    probs = jnp.asarray(rng.rand(8, 3).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 3, 8))
+    m = Accuracy()
+    m(probs, target)  # both simulated ranks share this process's registry
+
+    def run():
+        return aggregate_snapshots()
+
+    results = _run_ranks([run, run])
+    key = m.telemetry_key
+    local = observability.snapshot()
+    for agg in results:
+        assert agg["process_count"] == 2
+        # two identical process views -> merged counters exactly double
+        assert (
+            agg["merged"]["metrics"][key]["counters"]["forward_fused_calls"]
+            == 2 * local["metrics"][key]["counters"]["forward_fused_calls"]
+        )
+
+
+def test_aggregate_single_process_degrades_gracefully():
+    m = Accuracy()
+    m(jnp.zeros((4, 3)), jnp.zeros((4,), jnp.int32))
+    agg = aggregate_snapshots()
+    assert agg["process_count"] == 1
+    assert agg["merged"]["metrics"][m.telemetry_key]["counters"]["forward_fused_calls"] == 1
+    assert agg["per_process"]["0"]["metrics"][m.telemetry_key]["counters"]
+
+
+def test_render_prometheus_aggregated_carries_process_labels():
+    a, b = _synthetic_snapshot(1), _synthetic_snapshot(2)
+    agg = aggregate_snapshots([a, b])
+    text = observability.render_prometheus(agg)
+    assert "metrics_tpu_processes 2" in text
+    assert (
+        'metrics_tpu_calls_total{process="0",metric="Accuracy#0",op="update_calls"} 10'
+        in text
+    )
+    assert (
+        'metrics_tpu_calls_total{process="1",metric="Accuracy#0",op="update_calls"} 20'
+        in text
+    )
+    # histogram families render per process too, in proper exposition form
+    assert 'metrics_tpu_dispatch_seconds_bucket{process="0",path="compiled",le="0.001"} 6' in text
+    from tests.observability.test_registry import _check_exposition_format
+
+    _check_exposition_format(text)
+
+
+def test_aggregated_snapshot_is_json_round_trippable():
+    agg = aggregate_snapshots([_synthetic_snapshot(1), _synthetic_snapshot(2)])
+    assert json.loads(json.dumps(agg)) == agg

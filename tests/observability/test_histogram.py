@@ -1,0 +1,193 @@
+"""Fast-path log2 histograms: bucket math, percentile estimation, the
+registry contract, the dispatch/sync/gather recording sites, and the
+zero-traced-ops guarantee."""
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, observability
+from metrics_tpu.observability.histogram import (
+    HISTOGRAMS,
+    HistogramRegistry,
+    LATENCY_EXP_RANGE,
+    Log2Histogram,
+)
+
+NC = 3
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    yield
+    observability.reset()
+    observability.enable()
+
+
+def test_bucket_assignment_is_exact_log2():
+    h = Log2Histogram("s")
+    bounds = h.bounds()
+    assert bounds[0] == 2.0 ** LATENCY_EXP_RANGE[0]
+    assert bounds[-1] == 2.0 ** LATENCY_EXP_RANGE[1]
+    # a value lands in the FIRST bucket whose upper bound holds it: exactly
+    # at a bound stays in that bucket (le semantics), epsilon above moves up
+    for i, bound in enumerate(bounds[:-1]):
+        h2 = Log2Histogram("s")
+        h2.observe(bound)
+        assert int(h2.bucket_counts()[i]) == 1, f"bound {bound} not in bucket {i}"
+        h3 = Log2Histogram("s")
+        h3.observe(bound * 1.0000001)
+        assert int(h3.bucket_counts()[i + 1]) == 1
+    # below range -> first bucket; above range -> +inf bucket; zero/negative
+    # (a clock that didn't advance) -> first bucket, never a crash
+    edge = Log2Histogram("s")
+    for v in (1e-12, 1e9, 0.0, -1.0):
+        edge.observe(v)
+    counts = edge.bucket_counts()
+    assert counts[0] == 3 and counts[-1] == 1
+    assert edge.count == 4
+
+
+def test_observe_never_allocates_bucket_storage():
+    h = Log2Histogram("s")
+    buf = h._counts
+    for v in np.random.RandomState(0).rand(1000):
+        h.observe(float(v))
+    assert h._counts is buf  # same preallocated buffer throughout
+    assert h.count == 1000 and int(h.bucket_counts().sum()) == 1000
+
+
+def test_percentiles_bracket_the_true_quantiles():
+    h = Log2Histogram("s")
+    rng = np.random.RandomState(0)
+    values = 10.0 ** rng.uniform(-5, -1, 5000)  # log-uniform over the range
+    for v in values:
+        h.observe(float(v))
+    for q in (50.0, 95.0, 99.0):
+        true = np.percentile(values, q)
+        est = h.percentile(q)
+        # a log2 histogram's quantile estimate is within one bucket (2x)
+        assert true / 2 <= est <= true * 2, (q, true, est)
+    assert h.percentile(50) <= h.percentile(95) <= h.percentile(99)
+    assert Log2Histogram("s").percentile(50) == 0.0  # empty -> 0, no crash
+
+
+def test_to_dict_is_json_and_prometheus_consistent():
+    h = Log2Histogram("bytes")
+    for v in (1, 100, 10_000, 2**40):
+        h.observe(v)
+    d = json.loads(json.dumps(h.to_dict()))
+    assert d["unit"] == "bytes" and d["count"] == 4
+    assert sum(d["buckets"].values()) == 4
+    assert d["buckets"]["le_inf"] == 1  # the 2**40 observation
+    assert d["sum"] == pytest.approx(1 + 100 + 10_000 + 2**40)
+    assert {"p50", "p95", "p99"} <= set(d)
+
+
+def test_registry_series_are_label_keyed_and_reusable():
+    reg = HistogramRegistry()
+    a = reg.get("dispatch_seconds", path="compiled")
+    b = reg.get("dispatch_seconds", path="keyed_scatter")
+    assert a is not b
+    assert reg.get("dispatch_seconds", path="compiled") is a  # stable handle
+    reg.observe("dispatch_seconds", 1e-4, path="compiled")
+    snap = reg.snapshot()
+    key = "dispatch_seconds{path=compiled}"
+    assert snap[key]["count"] == 1
+    assert snap[key]["name"] == "dispatch_seconds"
+    assert snap[key]["labels"] == {"path": "compiled"}
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+def test_registry_concurrent_observe_never_raises():
+    reg = HistogramRegistry()
+    errors = []
+
+    def work(i):
+        try:
+            for _ in range(2000):
+                reg.observe("s", 1e-4, path=f"p{i % 2}")
+        except Exception as err:  # pragma: no cover - the assertion target
+            errors.append(err)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    # lock-free by design: totals stay bounded by the issued observations
+    # (drops under contention are allowed, corruption is not)
+    total = sum(e["count"] for e in reg.snapshot().values())
+    assert 0 < total <= 12000
+
+
+def test_compiled_dispatch_feeds_dispatch_histogram():
+    rng = np.random.RandomState(0)
+    probs = jnp.asarray(rng.rand(8, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, (8,)))
+    m = Accuracy().jit_forward()
+    for _ in range(3):
+        m(probs, target)
+    m2 = Accuracy()
+    m2.update_many(jnp.stack([probs] * 2), jnp.stack([target] * 2))
+    snap = observability.snapshot()
+    hists = snap["histograms"]
+    assert hists["dispatch_seconds{path=compiled}"]["count"] == 3
+    assert hists["dispatch_seconds{path=update_many}"]["count"] == 1
+    # the snapshot stays JSON-round-trippable with histograms aboard
+    assert json.loads(json.dumps(snap))["histograms"] == hists
+
+
+def test_gather_transport_feeds_rtt_and_payload_histograms():
+    import metrics_tpu.utilities.distributed as dist_mod
+
+    orig = (dist_mod._process_allgather, dist_mod.distributed_available, dist_mod.world_size)
+    dist_mod._process_allgather = lambda x: np.stack([np.asarray(x), np.asarray(x)])
+    dist_mod.distributed_available = lambda: True
+    dist_mod.world_size = lambda: 2
+    try:
+        dist_mod.gather_all_pytrees([{"a": jnp.arange(8.0), "b": jnp.zeros((2, 2))}])
+    finally:
+        (dist_mod._process_allgather, dist_mod.distributed_available,
+         dist_mod.world_size) = orig
+    hists = observability.snapshot()["histograms"]
+    rtt = hists["sync_round_trip_seconds{transport=gather}"]
+    payload = hists["gather_payload_bytes"]
+    assert rtt["count"] >= 1 and rtt["unit"] == "s"
+    assert payload["count"] >= 1 and payload["unit"] == "bytes"
+    assert payload["sum"] > 0
+
+
+def test_histograms_disabled_with_telemetry():
+    observability.disable()
+    rng = np.random.RandomState(0)
+    probs = jnp.asarray(rng.rand(8, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, (8,)))
+    m = Accuracy().jit_forward()
+    m(probs, target)
+    observability.enable()
+    assert observability.snapshot()["histograms"] == {}
+
+
+def test_histograms_add_zero_traced_ops():
+    """The hard guarantee: recording rides the host dispatch sites only —
+    the traced programs are identical with histograms recording or not."""
+    import jax
+
+    rng = np.random.RandomState(0)
+    probs = jnp.asarray(rng.rand(8, NC).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NC, (8,)))
+    m = Accuracy()
+    observability.enable()
+    on = str(jax.make_jaxpr(m.apply_update)(m.init_state(), probs, target))
+    observability.disable()
+    off = str(jax.make_jaxpr(m.apply_update)(m.init_state(), probs, target))
+    assert on == off

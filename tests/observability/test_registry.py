@@ -254,7 +254,10 @@ def test_prometheus_escapes_newlines_in_label_values():
     newline must not split the sample line and corrupt the scrape."""
     snap = {"metrics": {"Bad\nName#0": {"counters": {"update_calls": 1}}}}
     text = observability.render_prometheus(snap)
-    sample = [ln for ln in text.splitlines() if "calls_total" in ln and "TYPE" not in ln]
+    sample = [
+        ln for ln in text.splitlines()
+        if "calls_total" in ln and not ln.startswith("#")
+    ]
     assert sample == ['metrics_tpu_calls_total{metric="Bad\\nName#0",op="update_calls"} 1']
     # backslash and quote escaping still composes with the newline escape
     snap = {"metrics": {'a"b\\c\nd': {"counters": {"x": 2}}}}
@@ -263,6 +266,119 @@ def test_prometheus_escapes_newlines_in_label_values():
         if "calls_total{" in ln
     ]
     assert 'metric="a\\"b\\\\c\\nd"' in line
+
+
+def _check_exposition_format(text):
+    """Minimal Prometheus text exposition (0.0.4) checker.
+
+    Every sample line must parse (name, optional well-formed label set,
+    float-parseable value), and every series must be preceded by its
+    ``# HELP`` and ``# TYPE`` metadata — histogram ``_bucket``/``_sum``/
+    ``_count`` children are covered by their base family's declaration, and
+    each ``_bucket`` run must be cumulative and end at ``le="+Inf"``.
+    Returns the parsed samples as ``(name, labels, value)`` triples.
+    """
+    import re
+
+    name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    sample_re = re.compile(rf"^({name_re})(?:\{{(.*)\}})? (\S+)$")
+    helps, types, samples = {}, {}, []
+    buckets = {}  # (name, non-le labels) -> last cumulative count
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert re.fullmatch(name_re, name), line
+            assert help_text.strip(), f"empty HELP: {line}"
+            helps[name] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, type_ = rest.partition(" ")
+            assert type_ in ("counter", "gauge", "histogram", "summary", "untyped"), line
+            assert name in helps, f"TYPE before HELP for {name}"
+            types[name] = type_
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line}"
+        m = sample_re.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, raw_labels, raw_value = m.groups()
+        labels = {}
+        if raw_labels:
+            consumed = ",".join(f'{k}="{v}"' for k, v in label_re.findall(raw_labels))
+            assert consumed == raw_labels, f"malformed labels in: {line!r}"
+            labels = dict(label_re.findall(raw_labels))
+        value = float(raw_value.replace("+Inf", "inf"))
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            stripped = name[: -len(suffix)] if name.endswith(suffix) else None
+            if stripped and types.get(stripped) == "histogram":
+                base = stripped
+                break
+        assert base in types and base in helps, f"series {name} lacks HELP/TYPE metadata"
+        if name.endswith("_bucket") and types.get(base) == "histogram":
+            assert "le" in labels, f"histogram bucket without le label: {line!r}"
+            key = (base, tuple(sorted((k, v) for k, v in labels.items() if k != "le")))
+            prev = buckets.get(key, -1.0)
+            assert value >= prev, f"non-cumulative bucket series: {line!r}"
+            buckets[key] = value if labels["le"] != "+Inf" else -1.0
+            if labels["le"] == "+Inf":
+                buckets.pop(key)
+        samples.append((name, labels, value))
+    assert not buckets, f"histogram bucket runs missing le=+Inf: {sorted(buckets)}"
+    return samples
+
+
+def test_exposition_help_and_type_for_every_series(stream):
+    """Satellite: every rendered series — counters, gauges, histograms (eager
+    timers AND the fast-path log2 histograms) — carries # HELP / # TYPE and
+    parses under the minimal exposition checker."""
+    probs, target = stream
+    world = lambda x, group=None: [x, x]  # exercise the gather histograms too
+    m = Accuracy(dist_sync_fn=world)
+    for i in range(NB):
+        m(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    m.compute()
+    jitted = Accuracy().jit_forward()
+    jitted(jnp.asarray(probs[0]), jnp.asarray(target[0]))  # dispatch histogram
+
+    text = observability.render_prometheus()
+    samples = _check_exposition_format(text)
+    names = {s[0] for s in samples}
+    # the three major families all present and declared
+    assert "metrics_tpu_calls_total" in names
+    assert "metrics_tpu_eager_seconds_bucket" in names
+    assert "metrics_tpu_dispatch_seconds_bucket" in names
+    assert "metrics_tpu_dispatch_seconds_sum" in names
+    assert "metrics_tpu_state_bytes" in names
+
+
+def test_exposition_checker_rejects_missing_metadata_and_bad_lines():
+    """The checker itself must have teeth: a sample without TYPE/HELP, a
+    malformed label set, and a non-cumulative bucket run all fail."""
+    _check = _check_exposition_format
+    with pytest.raises(AssertionError):
+        _check("metrics_tpu_orphan_total 1\n")
+    with pytest.raises(AssertionError):
+        _check(
+            "# HELP metrics_tpu_x x\n# TYPE metrics_tpu_x gauge\n"
+            'metrics_tpu_x{bad-label="1"} 1\n'
+        )
+    with pytest.raises(AssertionError):
+        _check(
+            "# HELP metrics_tpu_h h\n# TYPE metrics_tpu_h histogram\n"
+            'metrics_tpu_h_bucket{le="1"} 5\n'
+            'metrics_tpu_h_bucket{le="2"} 3\n'  # cumulative count went DOWN
+            'metrics_tpu_h_bucket{le="+Inf"} 5\n'
+        )
+    with pytest.raises(AssertionError):
+        _check(
+            "# HELP metrics_tpu_h h\n# TYPE metrics_tpu_h histogram\n"
+            'metrics_tpu_h_bucket{le="1"} 5\n'  # bucket run never reaches +Inf
+        )
 
 
 def test_snapshot_evicts_dead_instances():

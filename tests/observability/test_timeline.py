@@ -139,6 +139,62 @@ def test_events_are_time_ordered():
     assert ts == sorted(ts)
 
 
+def test_export_empty_log_is_valid_chrome_trace(tmp_path):
+    """Satellite: a never-written event log exports a VALID empty trace —
+    the process metadata plus an empty summary — that json-loads and shows
+    zero non-metadata events (an early-exit run's artifact must still open
+    in Perfetto)."""
+    log = EventLog()
+    path = timeline.export(str(tmp_path / "empty.json"), log=log)
+    trace = json.load(open(path))
+    assert isinstance(trace["traceEvents"], list)
+    non_meta = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+    assert non_meta == []
+    assert trace["otherData"]["events_summary"]["recorded_total"] == 0
+    assert trace["displayTimeUnit"] == "ms"
+    # a cleared log (events recorded then dropped) exports the same shape
+    log.record("update", "M#0", dur_s=0.001)
+    log.clear()
+    trace = json.load(open(timeline.export(str(tmp_path / "cleared.json"), log=log)))
+    assert [e for e in trace["traceEvents"] if e["ph"] != "M"] == []
+
+
+def test_export_creates_parent_directories(tmp_path):
+    """Satellite: export into a not-yet-existing artifact directory creates
+    the parents instead of raising FileNotFoundError."""
+    log = EventLog()
+    log.record("update", "M#0", dur_s=0.001)
+    nested = tmp_path / "run-42" / "artifacts" / "trace.json"
+    assert not nested.parent.exists()
+    path = timeline.export(str(nested), log=log)
+    trace = json.load(open(path))
+    assert any(e["ph"] == "X" for e in trace["traceEvents"])
+    # a bare filename (no directory component) still works from the cwd
+    import os
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        json.load(open(timeline.export("bare.json", log=log)))
+    finally:
+        os.chdir(cwd)
+
+
+def test_tenant_report_events_render_on_the_timeline():
+    """The multi-tenant drill-down rollup lands as a timeline instant."""
+    log = EventLog()
+    log.record(
+        "tenant_report", "MultiTenantCollection#0",
+        tenants=100, rows_routed=5000, occupancy={"active": 80, "fraction": 0.8},
+        invalid_rate=0.0,
+    )
+    trace = timeline.to_chrome_trace(log=log)
+    (ev,) = [e for e in trace["traceEvents"] if e.get("cat") == "tenant_report"]
+    assert ev["ph"] == "i"
+    assert ev["args"]["occupancy"]["active"] == 80
+    json.dumps(trace)
+
+
 def test_export_summary_metadata(tmp_path):
     log = EventLog()
     log.record("update", "M#0", dur_s=0.001)

@@ -1,0 +1,175 @@
+"""The perf-regression gate's decision paths: regression / no-regression /
+degraded-excluded / rerun-deduped / insufficient-history, the capture-format
+parsing, and the committed BENCH_r* trajectory staying green."""
+import json
+import os
+import sys
+
+import pytest
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts")
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+import bench_regress  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _record(metric="m", value=10.0, unit="us/step", degraded=False, rerun=False, **extra):
+    rec = {
+        "metric": metric, "value": value, "unit": unit, "vs_baseline": 5.0,
+        "degraded": degraded,
+    }
+    if rerun:
+        rec["rerun"] = True
+    rec.update(extra)
+    return rec
+
+
+def _capture(tmp_path, n, records, tail_prefix=""):
+    """One driver-format capture file: records as the recorded output tail."""
+    tail = tail_prefix + "\n".join(json.dumps(r) for r in records)
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps({"n": n, "cmd": "python bench.py", "rc": 0, "tail": tail}))
+    return str(path)
+
+
+def _rounds(tmp_path, values, degraded_flags=None, metric="m"):
+    degraded_flags = degraded_flags or [False] * len(values)
+    return [
+        _capture(tmp_path, i + 1, [_record(metric, v, degraded=d)])
+        for i, (v, d) in enumerate(zip(values, degraded_flags))
+    ]
+
+
+def test_no_regression_passes(tmp_path):
+    paths = _rounds(tmp_path, [10.0, 11.0, 9.5, 10.5])
+    rows = bench_regress.check_trajectory(bench_regress.load_trajectory(paths))
+    (row,) = rows
+    assert row["status"] == bench_regress.OK
+    assert row["baseline"] == 10.0  # median of 10, 11, 9.5
+    assert bench_regress.main(paths + ["--check"]) == 0
+
+
+def test_two_x_regression_fails(tmp_path):
+    """Acceptance: a synthetic 2x regression record demonstrably fails."""
+    paths = _rounds(tmp_path, [10.0, 11.0, 9.5, 20.0])
+    rows = bench_regress.check_trajectory(bench_regress.load_trajectory(paths))
+    (row,) = rows
+    assert row["status"] == bench_regress.REGRESSED
+    assert row["delta_pct"] == pytest.approx(100.0)
+    assert bench_regress.main(paths + ["--check"]) == 1
+    # the failure prints a readable delta table naming the config
+    table = bench_regress.render_table(rows, bench_regress.DEFAULT_TOLERANCE)
+    assert "REGRESSED" in table and "m" in table and "+100.0%" in table
+
+
+def test_tolerance_is_configurable(tmp_path):
+    paths = _rounds(tmp_path, [10.0, 10.0, 13.0])
+    rows = bench_regress.check_trajectory(
+        bench_regress.load_trajectory(paths), tolerance=0.5
+    )
+    assert rows[0]["status"] == bench_regress.OK  # +30% < +50%
+    rows = bench_regress.check_trajectory(
+        bench_regress.load_trajectory(paths), tolerance=0.2
+    )
+    assert rows[0]["status"] == bench_regress.REGRESSED  # +30% > +20%
+
+
+def test_degraded_records_are_excluded_from_the_baseline(tmp_path):
+    """A sick-endpoint round (10-20x slow, flagged) must not poison the
+    baseline: with it excluded the clean latest round passes, and a 2x true
+    regression still fails."""
+    paths = _rounds(
+        tmp_path, [10.0, 150.0, 10.5, 10.2], degraded_flags=[False, True, False, False]
+    )
+    (row,) = bench_regress.check_trajectory(bench_regress.load_trajectory(paths))
+    assert row["baseline"] == pytest.approx(10.25)  # median(10, 10.5) — not 150
+    assert row["status"] == bench_regress.OK
+
+
+def test_degraded_latest_round_is_skipped_not_judged(tmp_path):
+    paths = _rounds(
+        tmp_path, [10.0, 10.5, 150.0], degraded_flags=[False, False, True]
+    )
+    (row,) = bench_regress.check_trajectory(bench_regress.load_trajectory(paths))
+    assert row["status"] == bench_regress.SKIPPED_DEGRADED
+    assert bench_regress.main(paths + ["--check"]) == 0  # a sick chip is not a code bug
+
+
+def test_null_value_latest_is_skipped(tmp_path):
+    paths = _rounds(tmp_path, [10.0, 10.5]) + [
+        _capture(tmp_path, 3, [_record(value=None)])
+    ]
+    (row,) = bench_regress.check_trajectory(bench_regress.load_trajectory(paths))
+    assert row["status"] == bench_regress.SKIPPED_NO_VALUE
+
+
+def test_insufficient_history_is_reported_not_judged(tmp_path):
+    paths = _rounds(tmp_path, [10.0, 20.0])  # one prior round < min_history=2
+    (row,) = bench_regress.check_trajectory(bench_regress.load_trajectory(paths))
+    assert row["status"] == bench_regress.SKIPPED_NO_HISTORY
+    assert bench_regress.main(paths + ["--check"]) == 0
+
+
+def test_rerun_records_do_not_double_count(tmp_path):
+    """The end-of-suite re-emission (tagged ``rerun``) and the pre-tag
+    literal duplicates both collapse to one record per config per round."""
+    records = [
+        _record("m", 10.0),
+        _record("other", 5.0),
+        # the final re-emitted block: tagged copies
+        _record("m", 10.0, rerun=True),
+        _record("other", 5.0, rerun=True),
+    ]
+    path = _capture(tmp_path, 1, records)
+    n, by_metric = bench_regress.load_round(path)
+    assert n == 1 and set(by_metric) == {"m", "other"}
+    assert "rerun" not in by_metric["m"]
+    # pre-tag captures: identical duplicate lines keep the last occurrence
+    legacy = _capture(tmp_path, 2, [_record("m", 10.0), _record("m", 10.0)])
+    _, by_metric = bench_regress.load_round(legacy)
+    assert by_metric["m"]["value"] == 10.0
+
+
+def test_truncated_tail_lines_are_dropped(tmp_path):
+    # the driver records a bounded tail: the first line is typically cut
+    path = _capture(
+        tmp_path, 1, [_record("m", 10.0)],
+        tail_prefix='p_fused", "value": 3.878, "unit": "us/step"}\n',
+    )
+    _, by_metric = bench_regress.load_round(path)
+    assert set(by_metric) == {"m"}
+
+
+def test_jsonl_and_list_formats_also_load(tmp_path):
+    jsonl = tmp_path / "BENCH_r07.json"
+    jsonl.write_text("\n".join(json.dumps(_record("m", v)) for v in (1.0, 2.0)))
+    n, by_metric = bench_regress.load_round(str(jsonl))
+    assert n == 7 and by_metric["m"]["value"] == 2.0  # last wins
+    aslist = tmp_path / "BENCH_r08.json"
+    aslist.write_text(json.dumps([_record("m", 3.0), _record("k", 4.0)]))
+    n, by_metric = bench_regress.load_round(str(aslist))
+    assert n == 8 and by_metric["m"]["value"] == 3.0 and by_metric["k"]["value"] == 4.0
+
+
+def test_new_config_in_latest_round_cannot_fail(tmp_path):
+    paths = _rounds(tmp_path, [10.0, 10.0, 10.0])
+    extra = _capture(tmp_path, 4, [_record("m", 10.0), _record("brand_new", 99.0)])
+    rows = bench_regress.check_trajectory(bench_regress.load_trajectory(paths + [extra]))
+    by_metric = {r["metric"]: r for r in rows}
+    assert by_metric["m"]["status"] == bench_regress.OK
+    assert by_metric["brand_new"]["status"] == bench_regress.SKIPPED_NO_HISTORY
+
+
+def test_committed_trajectory_passes():
+    """Acceptance: ``bench_regress --check`` stays green on the repo's own
+    BENCH_r01..r05 history."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+    assert len(paths) >= 5
+    assert bench_regress.main(paths + ["--check"]) == 0
+    rows = bench_regress.check_trajectory(bench_regress.load_trajectory(paths))
+    assert any(r["metric"] == "metric_collection_update_step_fused" for r in rows)
+    assert all(r["status"] != bench_regress.REGRESSED for r in rows)

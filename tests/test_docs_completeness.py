@@ -85,6 +85,40 @@ def test_multitenant_documented_and_cross_linked():
     assert "performance.md#multi-tenant-state" in obs
 
 
+def test_telemetry_plane_documented_and_cross_linked():
+    """The cluster telemetry plane's user contract: the observability guide
+    must document the fast-path histograms, fleet aggregation (mergeable
+    snapshots), tenant reports, and the perf-regression gate — and the
+    performance guide must link to the histogram/aggregation sections."""
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    for section in (
+        "## Fast-path latency histograms",
+        "## Fleet aggregation (mergeable snapshots)",
+        "## Tenant reports",
+        "## The perf-regression gate",
+    ):
+        assert section in obs, section
+    for phrase in (
+        "dispatch_seconds",
+        "sync_round_trip_seconds",
+        "gather_payload_bytes",
+        "aggregate_snapshots",
+        "merge_snapshots",
+        "snapshot_pytree",
+        "render_prometheus(aggregated=True)",
+        "tenant_report",
+        "bench_regress.py",
+        "make bench-regress",
+    ):
+        assert phrase in obs, phrase
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "observability.md#fast-path-latency-histograms" in perf
+    assert "observability.md#fleet-aggregation-mergeable-snapshots" in perf
+    assert "bench-regress" in perf
+
+
 def test_observability_page_cross_linked():
     """The page must be reachable from the performance guide and the README
     (the two places a user hunting for runtime numbers starts from)."""

@@ -1,0 +1,184 @@
+"""Per-tenant drill-downs: ``tenant_report()`` on ``KeyedMetric`` and
+``MultiTenantCollection`` — occupancy, top-k traffic, invalid-id rate,
+staleness — plus the snapshot/Prometheus/timeline surfacing and the
+zero-traced-ops / telemetry-off contracts."""
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, F1, Precision, Recall, observability
+from metrics_tpu.wrappers import KeyedMetric, MultiTenantCollection
+
+NC = 3
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    yield
+    observability.reset()
+    observability.enable()
+
+
+def _batch(rows, n_tenants, rng=None, ids=None):
+    rng = rng or np.random.RandomState(0)
+    if ids is None:
+        ids = rng.randint(0, n_tenants, rows)
+    probs = rng.rand(rows, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    return jnp.asarray(ids), jnp.asarray(probs), jnp.asarray(rng.randint(0, NC, rows))
+
+
+def test_keyed_report_occupancy_and_topk_traffic():
+    km = KeyedMetric(Accuracy(), 10)
+    # tenant 3 gets 4 rows, tenant 7 gets 2, tenant 0 gets 1
+    ids, probs, target = _batch(7, 10, ids=np.array([3, 3, 3, 3, 7, 7, 0]))
+    km.update(ids, probs, target)
+    rep = km.tenant_report(top_k=2)
+    assert rep["tenants"] == 10 and rep["rows_routed"] == 7
+    assert rep["occupancy"] == {"active": 3, "fraction": 0.3}
+    assert rep["top_traffic"] == [
+        {"tenant": 3, "rows": 4}, {"tenant": 7, "rows": 2}
+    ]
+    assert rep["invalid_tenant_ids"] == 0 and rep["invalid_rate"] == 0.0
+    assert rep["tracking"] is True
+    json.dumps(rep)  # the report is a JSON-clean artifact
+
+
+def test_keyed_report_accumulates_across_updates_and_update_many():
+    km = KeyedMetric(Accuracy(), 4)
+    ids, probs, target = _batch(8, 4)
+    km.update(ids, probs, target)
+    km.update_many(jnp.stack([ids, ids]), jnp.stack([probs, probs]), jnp.stack([target, target]))
+    rep = km.tenant_report()
+    assert rep["rows_routed"] == 24  # 8 + 2x8
+    counts = {t["tenant"]: t["rows"] for t in rep["top_traffic"]}
+    expected = np.bincount(np.asarray(ids), minlength=4) * 3
+    assert counts == {i: int(c) for i, c in enumerate(expected) if c}
+
+
+def test_keyed_report_staleness_orders_tenants():
+    import time
+
+    km = KeyedMetric(Accuracy(), 5)
+    early_ids, probs, target = _batch(2, 5, ids=np.array([0, 1]))
+    km.update(early_ids, probs, target)
+    time.sleep(0.05)
+    late_ids, probs2, target2 = _batch(2, 5, ids=np.array([2, 2]))
+    km.update(late_ids, probs2, target2)
+    rep = km.tenant_report(top_k=5)
+    st = rep["staleness_s"]
+    assert st["max"] >= st["p95"] >= st["p50"] >= 0
+    assert st["max"] >= 0.05  # tenants 0/1 are at least the sleep old
+    # the stalest list leads with the early tenants, never the fresh one
+    assert {t["tenant"] for t in rep["stalest"][:2]} == {0, 1}
+    assert rep["stalest"][-1]["tenant"] == 2
+
+
+def test_keyed_report_counts_invalid_rate_in_clip_mode():
+    km = KeyedMetric(Accuracy(), 4, validate_ids=False)
+    ids, probs, target = _batch(8, 4, ids=np.array([0, 1, 2, 3, -1, 7, 9, 2]))
+    km.update(ids, probs, target)
+    rep = km.tenant_report()
+    assert rep["rows_routed"] == 5  # the 3 invalid rows never count as traffic
+    if rep["invalid_tenant_ids"]:  # backend can run the debug callback
+        assert rep["invalid_tenant_ids"] == 3
+        assert rep["invalid_rate"] == pytest.approx(3 / 8)
+
+
+def test_keyed_reset_clears_the_ledger():
+    km = KeyedMetric(Accuracy(), 4)
+    ids, probs, target = _batch(8, 4)
+    km.update(ids, probs, target)
+    km.reset(jnp.asarray([0]))  # partial: only tenant 0's history drops
+    rep = km.tenant_report()
+    assert all(t["tenant"] != 0 for t in rep["top_traffic"])
+    km.reset()
+    rep = km.tenant_report()
+    assert rep["rows_routed"] == 0 and rep["occupancy"]["active"] == 0
+    assert rep["tracking"] is False and rep["top_traffic"] == []
+    assert rep["staleness_s"] == {"p50": None, "p95": None, "max": None}
+
+
+def test_collection_report_covers_members_and_bundles():
+    members = [
+        Accuracy(),
+        Precision(average="macro", num_classes=NC),
+        Recall(average="macro", num_classes=NC),
+        F1(average="macro", num_classes=NC),
+    ]
+    mtc = MultiTenantCollection(members, 6)
+    ids, probs, target = _batch(12, 6)
+    mtc.update(ids, probs, target)
+    mtc.update_many(jnp.stack([ids]), jnp.stack([probs]), jnp.stack([target]))
+    rep = mtc.tenant_report(top_k=3)
+    assert rep["metric"] == "MultiTenantCollection"
+    assert rep["members"] == 4
+    assert rep["state_bundles"] == mtc.state_bundles  # P/R/F1 share a bundle
+    assert rep["rows_routed"] == 24
+    assert len(rep["top_traffic"]) <= 3
+    json.dumps(rep)
+
+
+def test_report_lands_on_snapshot_prometheus_and_timeline():
+    km = KeyedMetric(Accuracy(), 8)
+    ids, probs, target = _batch(16, 8)
+    km.update(ids, probs, target)
+    km.tenant_report()
+    key = km.telemetry_key
+    snap = observability.snapshot()
+    blob = snap["metrics"][key]["info"]["tenant_report"]
+    assert blob["tenants"] == 8 and blob["rows_routed"] == 16
+    assert set(blob) == {"tenants", "rows_routed", "occupancy", "invalid_rate"}
+    text = observability.render_prometheus(snap)
+    assert f'metrics_tpu_tenants{{metric="{key}"}} 8' in text
+    assert f'metrics_tpu_tenant_rows_routed_total{{metric="{key}"}} 16' in text
+    assert "metrics_tpu_tenants_active" in text and "metrics_tpu_tenant_invalid_rate" in text
+    kinds = {e.kind for e in observability.EVENTS.events()}
+    assert "tenant_report" in kinds
+    # and the aggregated fleet render keeps the gauges, process-labeled
+    agg = observability.aggregate_snapshots([snap, snap])
+    assert 'process="1"' in observability.render_prometheus(agg)
+
+
+def test_telemetry_off_records_no_traffic_and_report_stays_cheap():
+    observability.disable()
+    km = KeyedMetric(Accuracy(), 4)
+    ids, probs, target = _batch(8, 4)
+    km.update(ids, probs, target)
+    assert km._traffic.rows is None  # no ledger allocation while disabled
+    rep = km.tenant_report()
+    assert rep["tracking"] is False and rep["rows_routed"] == 0
+    observability.enable()
+
+
+def test_tenant_tracking_adds_zero_traced_ops():
+    """The ledger feeds from the stateful host path only: the pure keyed
+    update program is byte-identical with telemetry on and off."""
+    import jax
+
+    km = KeyedMetric(Accuracy(), 4)
+    ids, probs, target = _batch(8, 4)
+    state = km.init_state()
+    observability.enable()
+    on = str(jax.make_jaxpr(lambda s, i, p, t: km._segment_scatter(s, i, (p, t), {}))(
+        state, ids, probs, target))
+    observability.disable()
+    off = str(jax.make_jaxpr(lambda s, i, p, t: km._segment_scatter(s, i, (p, t), {}))(
+        state, ids, probs, target))
+    assert on == off
+
+
+def test_report_pickles_with_the_wrapper():
+    import pickle
+
+    km = KeyedMetric(Accuracy(), 4)
+    ids, probs, target = _batch(8, 4)
+    km.update(ids, probs, target)
+    clone = pickle.loads(pickle.dumps(km))
+    rep = clone.tenant_report()
+    assert rep["rows_routed"] == 8  # the ledger travels with the wrapper
