@@ -1,8 +1,8 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress
+.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check
 
-ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress
+ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check
 
 # Full suite on the virtual 8-device CPU mesh (tests/conftest.py), including
 # the real 2-process jax.distributed sync test (tests/bases/test_multiprocess.py).
@@ -41,6 +41,14 @@ examples:
 # Also runs inside the suite as tests/observability/test_zero_overhead.py.
 zero-overhead:
 	python scripts/check_zero_overhead.py
+
+# Chrome-trace validity gate (scripts/check_trace.py): timeline.export and
+# timeline.export_fleet must emit traces the Perfetto/chrome://tracing
+# viewers load — required keys per phase, monotonic timestamps per track,
+# paired flow events. Also runs inside the suite as
+# tests/observability/test_trace_check.py.
+trace-check:
+	python scripts/check_trace.py --selftest
 
 # Perf-regression gate (scripts/bench_regress.py): the latest committed
 # BENCH_r*.json capture must stay within tolerance of the per-config

@@ -42,6 +42,7 @@ from metrics_tpu.observability.health import HEALTH, guard_state
 from metrics_tpu.observability.histogram import observe_dispatch
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import arg_signature
+from metrics_tpu.observability.tracing import TRACER
 from metrics_tpu.utilities.aot import CompiledDispatch, trace_fingerprint
 from metrics_tpu.utilities.prints import rank_zero_warn
 from metrics_tpu.utilities.profiling import compiled_scope, eager_span
@@ -987,7 +988,20 @@ class MetricCollection:
         for group, names in bundles.values():
             pre = [self._metrics[n]._pre_sync_states() for n in names]
             sync_start = time.perf_counter() if EVENTS.enabled else None
+            # collective span around the whole collection bundle: one
+            # deterministic id per epoch sync, shared by every participating
+            # process (the fleet-timeline correlation key)
+            tr_span = (
+                TRACER.begin("sync", group=repr(group), bucket="collection")
+                if TRACER.enabled
+                else None
+            )
             gathered = _dist.gather_all_pytrees([states for states, _ in pre], group=group)
+            span_id = (
+                TRACER.end(tr_span, collection=self.telemetry_key, members=list(names))
+                if tr_span
+                else None
+            )
             if sync_start is not None:
                 # compute_groups: how many members each gathered bundle
                 # serves (owner -> group size) — the transport-dedup evidence
@@ -998,6 +1012,7 @@ class MetricCollection:
                     t_start=sync_start,
                     members=list(names),
                     packed=True,
+                    span_id=span_id,
                     compute_groups={n: cg_sizes[n] for n in names if n in cg_sizes},
                 )
             for n, (states, list_dtypes), g in zip(names, pre, gathered):

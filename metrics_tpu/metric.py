@@ -57,6 +57,7 @@ from metrics_tpu.observability.health import HEALTH, MetricHealthError, guard_st
 from metrics_tpu.observability.histogram import observe_dispatch
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import MONITOR, arg_signature, is_tracing
+from metrics_tpu.observability.tracing import TRACER
 from metrics_tpu.utilities.aot import CompiledDispatch
 from metrics_tpu.utilities.distributed import (
     distributed_available,
@@ -1236,6 +1237,10 @@ class Metric(ABC):
 
         sync_start = time.perf_counter() if EVENTS.enabled else None
         group = process_group or self.process_group
+        # collective span around the epoch sync: a deterministic id shared by
+        # every participating process, correlating this metric's gather on the
+        # merged fleet timeline (observability/tracing.py)
+        tr_span = TRACER.begin("sync", group=repr(group), bucket="metric") if TRACER.enabled else None
         if dist_sync_fn is gather_all_arrays:
             # the default transport: pack EVERY leaf of this metric into one
             # descriptor round + one payload round instead of two transport
@@ -1244,6 +1249,7 @@ class Metric(ABC):
         else:
             # injected custom gathers keep the documented per-leaf contract
             gathered = apply_to_collection(states, ArrayTypes, dist_sync_fn, group=group)
+        span_id = TRACER.end(tr_span, metric=self.telemetry_key) if tr_span else None
         if sync_start is not None:
             EVENTS.record(
                 "sync",
@@ -1251,6 +1257,7 @@ class Metric(ABC):
                 dur_s=time.perf_counter() - sync_start,
                 t_start=sync_start,
                 payload_bytes=payload_bytes,
+                span_id=span_id,
             )
 
         self._apply_gathered_states(gathered, list_dtypes)

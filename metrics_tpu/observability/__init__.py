@@ -1,6 +1,6 @@
 """Runtime telemetry for the metric lifecycle (see ``docs/observability.md``).
 
-Nine pieces, one snapshot:
+Ten pieces, one snapshot:
 
 * :mod:`~metrics_tpu.observability.registry` — thread-safe per-metric
   counters (update/forward/compute/reset/sync, eager vs. compiled path) and
@@ -27,6 +27,13 @@ Nine pieces, one snapshot:
   sum), the :func:`snapshot_pytree` canonical form that rides
   ``sync_state_packed``, and :func:`aggregate_snapshots` — ONE fleet-wide
   snapshot (with per-process breakdown) shipped over ``gather_all_pytrees``.
+* :mod:`~metrics_tpu.observability.tracing` — fleet-wide distributed
+  tracing: deterministic collective span ids on every sync round
+  (:data:`TRACER`), the clock-offset gather handshake
+  (:func:`estimate_clock_offsets`), and straggler/skew diagnostics
+  (:func:`straggler_report` / :func:`degraded_processes`);
+  ``timeline.export_fleet(path)`` merges every process's timeline into ONE
+  clock-aligned Perfetto trace with cross-process flow arrows.
 * :mod:`~metrics_tpu.observability.export` — :func:`snapshot` (JSON dict) and
   :func:`render_prometheus` (text exposition format; ``aggregated=True``
   renders the fleet view with ``process`` labels).
@@ -71,6 +78,15 @@ from metrics_tpu.observability.health import (  # noqa: F401
     set_health_policy,
 )
 from metrics_tpu.observability.registry import TELEMETRY, TelemetryRegistry  # noqa: F401
+from metrics_tpu.observability import tracing  # noqa: F401
+from metrics_tpu.observability.tracing import (  # noqa: F401
+    TRACER,
+    CollectiveSpan,
+    SpanTracker,
+    degraded_processes,
+    estimate_clock_offsets,
+    straggler_report,
+)
 from metrics_tpu.observability.retrace import (  # noqa: F401
     MONITOR,
     RetraceMonitor,
@@ -81,31 +97,36 @@ from metrics_tpu.observability.retrace import (  # noqa: F401
 
 
 def enable(on: bool = True) -> None:
-    """Turn telemetry AND event recording on (the default) or off
-    process-wide. The health guard is governed separately by
+    """Turn telemetry, event recording AND collective-span tracing on (the
+    default) or off process-wide. The health guard is governed separately by
     :func:`set_health_policy` (default ``"off"``)."""
     TELEMETRY.enable(on)
     EVENTS.enable(on)
+    TRACER.enable(on)
 
 
 def disable() -> None:
     """Stop recording; instrumented call sites reduce to attribute reads."""
     TELEMETRY.disable()
     EVENTS.disable()
+    TRACER.disable()
 
 
 def reset() -> None:
     """Clear all recorded counters, timers, sync stats, retrace ledgers,
-    events, histograms, and health records (enablement, policy, step tag
-    survive)."""
+    events, histograms, collective spans, and health records (enablement,
+    policy, step tag survive). Span-id sequence counters reset too — like
+    any collective, reset on every process together or on none."""
     TELEMETRY.reset()
     MONITOR.reset()
     EVENTS.clear()
     HEALTH.reset()
     HISTOGRAMS.reset()
+    TRACER.clear()
 
 
 __all__ = [
+    "CollectiveSpan",
     "EVENTS",
     "Event",
     "EventLog",
@@ -117,14 +138,18 @@ __all__ = [
     "MONITOR",
     "MetricHealthError",
     "RetraceMonitor",
+    "SpanTracker",
     "TELEMETRY",
+    "TRACER",
     "TelemetryRegistry",
     "aggregate_snapshots",
     "apply_pytree",
     "arg_signature",
+    "degraded_processes",
     "disable",
     "dumps",
     "enable",
+    "estimate_clock_offsets",
     "get_health_policy",
     "get_retrace_threshold",
     "get_step",
@@ -139,5 +164,7 @@ __all__ = [
     "snapshot",
     "snapshot_pytree",
     "step_context",
+    "straggler_report",
     "timeline",
+    "tracing",
 ]

@@ -71,6 +71,15 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     # health ledger
     ("health.policy", "last"),
     ("health.*", "sum"),
+    # collective-span tracker + straggler diagnostics: span volumes sum; the
+    # straggler report is already fleet-wide, so the last publisher wins
+    ("tracing.enabled", "any"),
+    ("tracing.capacity", "max"),
+    ("tracing.size", "sum"),
+    ("tracing.recorded_total", "sum"),
+    ("tracing.dropped", "sum"),
+    ("tracing.by_kind.*", "sum"),
+    ("tracing.*", "last"),
     # fast-path histograms (percentiles recomputed after the bucket merge)
     ("histograms.*.buckets.*", "sum"),
     ("histograms.*.count", "sum"),
@@ -307,7 +316,12 @@ def aggregate_snapshots(
             transport = gather_all_pytrees
         local = _snapshot(include_timers=include_timers)
         payload = np.frombuffer(json.dumps(local).encode("utf-8"), dtype=np.uint8)
-        gathered = transport([payload])[0]
+        # collective span around the snapshot shipment: the aggregation round
+        # correlates across processes on the merged fleet timeline
+        from metrics_tpu.observability.tracing import TRACER
+
+        with TRACER.collective_span("aggregate", bucket="snapshot", bytes=int(payload.size)):
+            gathered = transport([payload])[0]
         snaps = [
             json.loads(np.asarray(buf, dtype=np.uint8).tobytes().decode("utf-8"))
             for buf in gathered

@@ -35,10 +35,12 @@ from typing import Any, Dict, Iterator, List, NamedTuple, Optional
 #: ``compile`` marks a deliberate AOT lower+compile (``Metric.warmup``) so a
 #: first-dispatch trace+compile slice is distinguishable from steady state;
 #: ``tenant_report`` marks a multi-tenant drill-down rollup (occupancy,
-#: traffic, staleness) landing on the timeline
+#: traffic, staleness) landing on the timeline; ``straggler`` marks a fleet
+#: straggler report flagging a persistently-slow process
+#: (:mod:`~metrics_tpu.observability.tracing`)
 EVENT_KINDS = (
     "update", "forward", "compute", "sync", "retrace", "health", "compile",
-    "tenant_report",
+    "tenant_report", "straggler",
 )
 
 #: default bound on retained events; ~100 bytes each, so the default log
@@ -179,6 +181,12 @@ class EventLog:
     def epoch_unix(self) -> float:
         """Wall-clock (``time.time()``) instant of the log's ``ts_s=0``."""
         return self._epoch_unix
+
+    def now(self) -> float:
+        """The current instant on the log's clock (seconds since its epoch)
+        — the shared timebase event ``ts_s`` and collective-span timestamps
+        (:mod:`~metrics_tpu.observability.tracing`) are recorded on."""
+        return time.perf_counter() - self._epoch
 
     def summary(self) -> Dict[str, Any]:
         """Compact JSON view for ``snapshot()`` / bench records: totals per

@@ -19,6 +19,7 @@ from metrics_tpu.observability.health import HEALTH
 from metrics_tpu.observability.histogram import HISTOGRAMS
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import MONITOR
+from metrics_tpu.observability.tracing import TRACER
 
 #: bumped when the snapshot layout changes incompatibly
 SCHEMA_VERSION = 1
@@ -49,6 +50,16 @@ _HELP: Dict[str, str] = {
     "dispatch_seconds": "Compiled dispatch host wall time (fast-path log2 histogram).",
     "sync_round_trip_seconds": "Eager sync transport round-trip wall time.",
     "gather_payload_bytes": "Eager gather transport payload volume.",
+    "sync_descriptor_seconds_total": "Cumulative descriptor-round wall time of eager gathers.",
+    "sync_payload_seconds_total": "Cumulative payload-round wall time of eager gathers.",
+    "tracing_spans_total": "Collective spans recorded by the fleet tracer.",
+    "tracing_spans_dropped_total": "Collective spans evicted from the bounded span ledger.",
+    "straggler_collectives": "Cross-process collectives the latest straggler report analyzed.",
+    "straggler_fraction": "Fraction of analyzed collectives a process entered last.",
+    "straggler_lag_seconds": "Arrival lag behind the earliest peer (clock-aligned quantiles).",
+    "straggler_wait_seconds_total": "Time a process spent waiting for its slowest peer.",
+    "straggler_transfer_seconds_total": "Post-barrier transfer time attributed to a process.",
+    "straggler_flagged": "1 when the latest report flags the process as persistently slow.",
 }
 
 
@@ -76,6 +87,9 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
           "histograms": {"dispatch_seconds{path=compiled}": {"unit": "s",
                           "count": int, "sum": float, "buckets": {...},
                           "p50": float, "p95": float, "p99": float}, ...},
+          "tracing": {"enabled": bool, "capacity": int, "size": int,
+                      "recorded_total": int, "dropped": int,
+                      "by_kind": {...}, "straggler": <fleet report or null>},
         }
 
     Always JSON-serializable (``json.dumps(snapshot())`` round-trips), and
@@ -88,6 +102,7 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
     snap["events"] = EVENTS.summary()
     snap["health"] = HEALTH.summary()
     snap["histograms"] = HISTOGRAMS.snapshot()
+    snap["tracing"] = TRACER.summary()
     return snap
 
 
@@ -207,6 +222,8 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
         "transport_bytes",
         "descriptor_rounds",
         "payload_rounds",
+        "descriptor_seconds",
+        "payload_seconds",
     ):
         if field in sync:
             out.emit(f"sync_{field}_total", base, sync[field], "counter")
@@ -238,6 +255,38 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
         name = entry.get("name", series)
         labels = {**base, **entry.get("labels", {})}
         out.emit_histogram(name, labels, entry["buckets"], entry["sum"], entry["count"])
+
+    tracing = snap.get("tracing", {})
+    if tracing:
+        out.emit("tracing_spans_total", base, tracing.get("recorded_total", 0), "counter")
+        out.emit("tracing_spans_dropped_total", base, tracing.get("dropped", 0), "counter")
+        report = tracing.get("straggler") or {}
+        if report:
+            # the metrics_tpu_straggler* family: per-process skew/lag from the
+            # latest published fleet report (label "peer" — "process" is the
+            # aggregated renderer's label for the SCRAPING process)
+            out.emit("straggler_collectives", base, report.get("collectives", 0))
+            flagged = {int(p) for p in report.get("flagged", [])}
+            for peer in sorted(report.get("processes", {}), key=lambda p: (len(p), p)):
+                entry = report["processes"][peer]
+                labels = {**base, "peer": peer}
+                out.emit("straggler_fraction", labels, entry.get("straggler_fraction", 0.0))
+                for q in ("p50", "p95"):
+                    out.emit(
+                        "straggler_lag_seconds",
+                        {**labels, "quantile": q},
+                        entry.get(f"lag_{q}_s", 0.0),
+                    )
+                out.emit(
+                    "straggler_wait_seconds_total", labels, entry.get("wait_s", 0.0), "counter"
+                )
+                out.emit(
+                    "straggler_transfer_seconds_total",
+                    labels,
+                    entry.get("transfer_s", 0.0),
+                    "counter",
+                )
+                out.emit("straggler_flagged", labels, 1 if int(peer) in flagged else 0)
 
 
 def render_prometheus(

@@ -66,6 +66,12 @@ def _fresh_sync_stats() -> Dict[str, Any]:
         "transport_bytes": 0,
         "descriptor_rounds": 0,
         "payload_rounds": 0,
+        # cumulative wall time split per collective round: the descriptor
+        # exchange vs the padded payload exchange (seconds); with the round
+        # counts above these give per-round averages, and the span
+        # decomposition (observability/tracing.py) gives per-collective detail
+        "descriptor_seconds": 0.0,
+        "payload_seconds": 0.0,
         "groups": {},
         # in-graph (trace-time) collective composition — sync_in_graph /
         # sync_state_packed. "collectives" counts STATES per collective kind;
@@ -180,11 +186,15 @@ class TelemetryRegistry:
         members: Any,
         error: bool = False,
         leaves: int = 1,
+        descriptor_s: float = 0.0,
+        payload_s: float = 0.0,
     ) -> None:
         """One completed ``gather_all_arrays``/``gather_all_pytrees``
         transport (host sync path). ``leaves`` is how many state arrays the
         packed descriptor/payload rounds carried — the bundling win is
-        ``gather_leaves / gathers`` leaves per transport."""
+        ``gather_leaves / gathers`` leaves per transport.
+        ``descriptor_s``/``payload_s`` split the transport's wall time into
+        its two collective rounds."""
         if not self._enabled:
             return
         group_label = ",".join(str(m) for m in members)
@@ -199,6 +209,8 @@ class TelemetryRegistry:
             s["transport_bytes"] += int(transport_bytes)
             s["descriptor_rounds"] += int(descriptor_rounds)
             s["payload_rounds"] += int(payload_rounds)
+            s["descriptor_seconds"] = round(s["descriptor_seconds"] + float(descriptor_s), 9)
+            s["payload_seconds"] = round(s["payload_seconds"] + float(payload_s), 9)
             g = s["groups"].setdefault(group_label, {"gathers": 0, "world": int(world)})
             g["gathers"] += 1
             g["world"] = int(world)

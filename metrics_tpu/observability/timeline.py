@@ -17,6 +17,13 @@ user's step counter additionally renders as a ``"C"`` counter track so slices
 line up against step boundaries. Timestamps are microseconds on the event
 log's shared monotonic clock.
 
+:func:`export_fleet` is the multi-process form: every process's event log
+and collective-span ledger (:mod:`~metrics_tpu.observability.tracing`) merge
+into ONE trace — one Perfetto *process* track per JAX process, timestamps
+clock-aligned by the gather handshake, the same collective's spans connected
+across processes by flow arrows, and the straggler report embedded in
+``otherData``.
+
 .. _Trace Event Format:
    https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU
 """
@@ -28,6 +35,9 @@ from metrics_tpu.observability.events import EVENTS, Event, EventLog
 
 #: track name for events not owned by a single metric (gather transports)
 GLOBAL_TRACK = "<global>"
+
+#: track name collective spans render on (per process in the fleet view)
+COLLECTIVES_TRACK = "<collectives>"
 
 
 def _json_safe(value: Any) -> Any:
@@ -48,29 +58,12 @@ def _json_safe(value: Any) -> Any:
     return repr(value)
 
 
-def to_chrome_trace(
-    events: Optional[Sequence[Event]] = None, log: Optional[EventLog] = None
-) -> Dict[str, Any]:
-    """Build the Chrome-trace dict (``{"traceEvents": [...], ...}``) from
-    ``events`` (default: the global log's retained events)."""
-    log = EVENTS if log is None else log
-    if events is None:
-        events = log.events()
-    pid = os.getpid()
-
-    trace: List[Dict[str, Any]] = [
-        {
-            "ph": "M",
-            "name": "process_name",
-            "pid": pid,
-            "tid": 0,
-            "args": {"name": "metrics_tpu"},
-        }
-    ]
+def _track_allocator(trace: List[Dict[str, Any]], pid: int) -> Any:
+    """A per-process thread-track allocator: hands out stable tids and emits
+    the ``thread_name`` metadata exactly once per track."""
     tids: Dict[str, int] = {}
 
-    def tid_for(metric: Optional[str]) -> int:
-        track = metric if metric is not None else GLOBAL_TRACK
+    def tid_for(track: str) -> int:
         tid = tids.get(track)
         if tid is None:
             tid = tids[track] = len(tids) + 1
@@ -85,9 +78,17 @@ def to_chrome_trace(
             )
         return tid
 
+    return tid_for
+
+
+def _append_events(
+    trace: List[Dict[str, Any]], pid: int, events: Sequence[Event], tid_for: Any
+) -> None:
+    """Emit one process's events: per-metric slices/instants plus the step
+    counter track (the single-process and fleet exporters share this)."""
     last_step: Optional[int] = None
     for ev in sorted(events, key=lambda e: (e.ts_s, e.seq)):
-        tid = tid_for(ev.metric)
+        tid = tid_for(ev.metric if ev.metric is not None else GLOBAL_TRACK)
         if ev.step is not None and ev.step != last_step:
             last_step = ev.step
             trace.append(
@@ -119,6 +120,28 @@ def to_chrome_trace(
             record["s"] = "t"
         trace.append(record)
 
+
+def to_chrome_trace(
+    events: Optional[Sequence[Event]] = None, log: Optional[EventLog] = None
+) -> Dict[str, Any]:
+    """Build the Chrome-trace dict (``{"traceEvents": [...], ...}``) from
+    ``events`` (default: the global log's retained events)."""
+    log = EVENTS if log is None else log
+    if events is None:
+        events = log.events()
+    pid = os.getpid()
+
+    trace: List[Dict[str, Any]] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": "metrics_tpu"},
+        }
+    ]
+    _append_events(trace, pid, events, _track_allocator(trace, pid))
+
     return {
         "traceEvents": trace,
         "displayTimeUnit": "ms",
@@ -147,4 +170,170 @@ def export(
     trace = to_chrome_trace(events, log=log)
     with open(path, "w") as fh:
         json.dump(trace, fh)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# fleet export: one merged, clock-aligned trace for every process
+# ---------------------------------------------------------------------------
+
+
+def _event_from_dict(d: Dict[str, Any]) -> Event:
+    return Event(
+        int(d.get("seq", 0)),
+        str(d.get("kind", "update")),
+        d.get("metric"),
+        d.get("step"),
+        float(d.get("ts_s", 0.0)),
+        float(d.get("dur_s", 0.0)),
+        dict(d.get("payload") or {}),
+    )
+
+
+def to_fleet_chrome_trace(
+    fleet: Dict[str, Any], report: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
+    """Build the merged Chrome-trace dict from a
+    :func:`~metrics_tpu.observability.tracing.gather_fleet` result.
+
+    Each JAX process becomes one Perfetto process track (``pid`` = process
+    index) holding its per-metric event tracks plus a ``<collectives>``
+    track of span slices; the same collective's spans — identified by their
+    deterministic span id — are connected across processes by flow events
+    (``ph: s/t/f`` with a shared ``id``), and ``otherData`` carries the
+    clock-alignment evidence and the straggler ``report``.
+    """
+    trace: List[Dict[str, Any]] = []
+    flow_tids: Dict[int, int] = {}
+    spans_by_id: Dict[str, List[Dict[str, Any]]] = {}
+
+    for entry in fleet.get("processes", []):
+        pid = int(entry["process"])
+        trace.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"metrics_tpu process {pid}"},
+            }
+        )
+        trace.append(
+            {
+                "ph": "M",
+                "name": "process_sort_index",
+                "pid": pid,
+                "tid": 0,
+                "args": {"sort_index": pid},
+            }
+        )
+        tid_for = _track_allocator(trace, pid)
+        _append_events(trace, pid, [_event_from_dict(e) for e in entry.get("events", [])], tid_for)
+
+        span_tid = tid_for(COLLECTIVES_TRACK)
+        flow_tids[pid] = span_tid
+        for s in sorted(entry.get("spans", []), key=lambda s: (s["enter_s"], s.get("seq", 0))):
+            dur_s = float(s["exit_s"]) - float(s["enter_s"])
+            args = {str(k): _json_safe(v) for k, v in (s.get("payload") or {}).items()}
+            args.update(
+                span_id=s["span_id"], group=s.get("group"), bucket=s.get("bucket"),
+                seq=s.get("seq"),
+            )
+            if s.get("step") is not None:
+                args["step"] = s["step"]
+            record: Dict[str, Any] = {
+                "name": f"{s['kind']}[{s.get('bucket', '-')}]",
+                "cat": "collective",
+                "pid": pid,
+                "tid": span_tid,
+                "ts": round(float(s["enter_s"]) * 1e6, 3),
+                "args": args,
+            }
+            if dur_s > 0:
+                record["ph"] = "X"
+                record["dur"] = round(dur_s * 1e6, 3)
+            else:
+                record["ph"] = "i"
+                record["s"] = "t"
+            trace.append(record)
+            spans_by_id.setdefault(s["span_id"], []).append({**s, "pid": pid})
+
+    # flow arrows: the same collective across processes. Emitted after the
+    # slices (flow events bind by id, not by array order); start on the
+    # earliest-entering process, finish on the latest, steps in between.
+    flow_id = 0
+    for span_id in sorted(spans_by_id):
+        members = spans_by_id[span_id]
+        if len(members) < 2:
+            continue
+        flow_id += 1
+        members = sorted(members, key=lambda s: (float(s["enter_s"]), s["pid"]))
+        for i, s in enumerate(members):
+            record = {
+                "name": s["kind"],
+                "cat": "collective_flow",
+                "id": flow_id,
+                "pid": s["pid"],
+                "tid": flow_tids[s["pid"]],
+                "ts": round(float(s["enter_s"]) * 1e6, 3),
+                "args": {"span_id": span_id},
+            }
+            if i == 0:
+                record["ph"] = "s"
+            elif i == len(members) - 1:
+                record["ph"] = "f"
+                record["bp"] = "e"
+            else:
+                record["ph"] = "t"
+            trace.append(record)
+
+    other: Dict[str, Any] = {
+        "producer": "metrics_tpu.observability.timeline.export_fleet",
+        "processes": len(fleet.get("processes", [])),
+        "clock": _json_safe(fleet.get("clock", {})),
+    }
+    if report is not None:
+        other["straggler_report"] = _json_safe(report)
+    return {"traceEvents": trace, "displayTimeUnit": "ms", "otherData": other}
+
+
+def export_fleet(
+    path: str,
+    *,
+    handshake_rounds: int = 3,
+    log: Optional[EventLog] = None,
+    tracker: Optional[Any] = None,
+    straggler_kwargs: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Gather, clock-align, and merge EVERY process's timeline into one
+    Perfetto trace at ``path`` (returns ``path``).
+
+    A collective — every participating process must call together, like any
+    gather (each writes its own ``path``; single-process runs degrade to a
+    one-track fleet). The pipeline: a clock handshake estimates per-process
+    offsets (±RTT/2), one packed ``gather_all_pytrees`` round-trip ships
+    every process's event log + collective-span ledger, timestamps shift
+    onto the local clock, and the merged trace gets per-process tracks with
+    flow arrows connecting each collective's spans
+    (:func:`to_fleet_chrome_trace`). The straggler report is computed from
+    the aligned spans, **published** (``snapshot()["tracing"]["straggler"]``,
+    the ``metrics_tpu_straggler*`` Prometheus family, one ``straggler``
+    event per flagged process), and embedded in the trace's ``otherData``;
+    ``straggler_kwargs`` forwards thresholds to
+    :func:`~metrics_tpu.observability.tracing.straggler_report`.
+    """
+    from metrics_tpu.observability import tracing
+
+    fleet = tracing.gather_fleet(
+        handshake_rounds=handshake_rounds, log=log, tracker=tracker
+    )
+    report = tracing.straggler_report(
+        fleet, publish=True, tracker=tracker, **(straggler_kwargs or {})
+    )
+    doc = to_fleet_chrome_trace(fleet, report)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
     return path

@@ -137,6 +137,17 @@ _GATHER_DTYPES = (
 )
 
 
+def _tracer():
+    """The enabled global span tracker, or ``None`` (lazy import: tracing
+    lives in observability, which must stay optional for this module)."""
+    try:
+        from metrics_tpu.observability.tracing import TRACER
+
+        return TRACER if TRACER.enabled else None
+    except Exception:  # pragma: no cover - tracing must never break a sync
+        return None
+
+
 def _resolve_group(group: Optional[Any], nprocs: int) -> List[int]:
     """Resolve a ``process_group`` argument to the member process indices.
 
@@ -281,6 +292,13 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
         arg_error = err
         members = list(range(nprocs))
 
+    # collective spans: one deterministic id per transport (and per round)
+    # shared by every participating process — the fleet-timeline correlation
+    # key (observability/tracing.py). Host-side bookkeeping only.
+    tracer = _tracer()
+    group_label = ",".join(str(m) for m in members)
+    t_span = tracer.begin("gather", group=group_label, bucket="transport") if tracer else None
+
     num_leaves = len(leaves)
     desc = np.zeros((num_leaves, _MAX_GATHER_NDIM + 2), dtype=np.int64)
     local_error: Optional[str] = None
@@ -292,7 +310,12 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
             local_error = local_error or err  # empty contribution rides the rounds
         else:
             local_parts.append(np.ascontiguousarray(np.asarray(arr)).tobytes())
+    d_span = tracer.begin("gather", group=group_label, bucket="descriptor") if tracer else None
+    desc_start = time.perf_counter()
     all_desc = _process_allgather(desc)  # (nprocs, num_leaves, 10)
+    desc_dur = time.perf_counter() - desc_start
+    if tracer:
+        tracer.end(d_span, leaves=num_leaves, bytes=int(desc.nbytes))
 
     aligned = [_align_leaf(all_desc[:, j, :], members) for j in range(num_leaves)]
     group_error = next((a[3] for a in aligned if a[3] is not None), None)
@@ -315,14 +338,25 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
     # group decodes only its own members), padded to the global max byte
     # length; skipped entirely — on EVERY rank, keeping the collective count
     # aligned — when all contributions are empty
+    payload_dur = 0.0
     if max_bytes == 0:
         gathered = None
     else:
         buf = np.zeros(max_bytes, dtype=np.uint8)
         local_bytes = np.frombuffer(b"".join(local_parts), np.uint8)
         buf[: local_bytes.size] = local_bytes
+        p_span = tracer.begin("gather", group=group_label, bucket="payload") if tracer else None
+        payload_start = time.perf_counter()
         gathered = _process_allgather(buf)  # (nprocs, max_bytes)
+        payload_dur = time.perf_counter() - payload_start
+        if tracer:
+            tracer.end(p_span, leaves=num_leaves, bytes=nprocs * max_bytes)
 
+    span_id = (
+        tracer.end(t_span, leaves=num_leaves, members=[int(m) for m in members])
+        if tracer
+        else None
+    )
     _record_gather_telemetry(
         bytes_out=int(totals[jax.process_index()]) if nprocs > 1 else int(totals[0]),
         bytes_in=int(sum(int(leaf_nbytes[i, j]) for i in members for j in range(num_leaves))),
@@ -334,6 +368,9 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
         error=arg_error is not None or local_error is not None or group_error is not None,
         dur_s=time.perf_counter() - transport_start,
         t_start=transport_start,
+        descriptor_s=desc_dur,
+        payload_s=payload_dur,
+        span_id=span_id,
     )
 
     if arg_error is not None:
@@ -454,10 +491,15 @@ def _record_gather_telemetry(
     error: bool,
     dur_s: float = 0.0,
     t_start: Optional[float] = None,
+    descriptor_s: float = 0.0,
+    payload_s: float = 0.0,
+    span_id: Optional[str] = None,
 ) -> None:
     """Record one gather transport into the telemetry registry and the event
-    timeline (host-side; the gather itself is already complete). Never
-    raises."""
+    timeline (host-side; the gather itself is already complete).
+    ``descriptor_s``/``payload_s`` split the round-trip into its descriptor
+    vs payload collective rounds (the span decomposition's raw material);
+    ``span_id`` is the transport's collective span id. Never raises."""
     try:
         from metrics_tpu.observability.events import EVENTS
         from metrics_tpu.observability.histogram import (
@@ -470,8 +512,12 @@ def _record_gather_telemetry(
         transport_bytes = nprocs * desc_bytes + payload_rounds * nprocs * max_bytes
         if TELEMETRY.enabled:
             # fast-path log2 histograms: the transport's full round-trip wall
-            # time and its payload volume (host-side; the gather is complete)
+            # time, its per-round split, and its payload volume (host-side;
+            # the gather is complete)
             observe_sync_round_trip(dur_s, transport="gather")
+            observe_sync_round_trip(descriptor_s, transport="gather_descriptor")
+            if payload_rounds:
+                observe_sync_round_trip(payload_s, transport="gather_payload")
             observe_gather_payload(transport_bytes)
             TELEMETRY.record_gather(
                 bytes_out=int(bytes_out),
@@ -483,11 +529,15 @@ def _record_gather_telemetry(
                 members=members,
                 error=error,
                 leaves=leaves,
+                descriptor_s=descriptor_s,
+                payload_s=payload_s,
             )
         if EVENTS.enabled:
             # the gather rounds on the global timeline: one interval per
-            # transport, with the descriptor/payload round composition and
-            # how many state leaves the packed rounds carried
+            # transport, with the descriptor/payload round composition (and
+            # per-round durations), how many state leaves the packed rounds
+            # carried, the collective span id, and the recording process (the
+            # fleet export's correlation keys)
             EVENTS.record(
                 "sync",
                 None,
@@ -500,6 +550,10 @@ def _record_gather_telemetry(
                 transport_bytes=transport_bytes,
                 descriptor_rounds=1,
                 payload_rounds=payload_rounds,
+                descriptor_s=round(float(descriptor_s), 9),
+                payload_s=round(float(payload_s), 9),
+                span_id=span_id,
+                process=int(jax.process_index()) if nprocs > 1 else 0,
                 world=nprocs,
                 members=[int(m) for m in members],
                 error=bool(error),
@@ -602,6 +656,7 @@ def _record_in_graph_telemetry(
     collectives_before: int = 0,
     collectives_after: int = 0,
     groups: Optional[Dict[str, int]] = None,
+    span_ids: Optional[Dict[str, str]] = None,
 ) -> None:
     """Trace-time record of one in-graph sync lowering (registry + event
     timeline). ``kinds`` counts STATES per collective kind; ``buckets`` maps
@@ -609,7 +664,8 @@ def _record_in_graph_telemetry(
     before/after are the per-leaf vs actually-issued collective counts;
     ``groups`` maps each deduped bundle (a compute group or shared-update
     class) to the member count it serves — the leaf-set the transport did
-    NOT have to carry. Never raises."""
+    NOT have to carry; ``span_ids`` maps each packed bucket to its collective
+    span id (observability/tracing.py). Never raises."""
     try:
         from metrics_tpu.observability.events import EVENTS
         from metrics_tpu.observability.registry import TELEMETRY
@@ -639,6 +695,8 @@ def _record_in_graph_telemetry(
                 payload["buckets"] = dict(buckets)
             if groups:
                 payload["compute_groups"] = dict(groups)
+            if span_ids:
+                payload["span_ids"] = dict(span_ids)
             EVENTS.record("sync", None, **payload)
     except Exception:  # pragma: no cover - telemetry must never break a sync
         pass
@@ -752,8 +810,21 @@ def sync_state_packed(
         buckets.setdefault((kind, value.dtype), []).append((name, jnp.reshape(value, (-1,)), spec))
 
     bucket_compo: Dict[str, int] = {}
+    bucket_spans: Dict[str, str] = {}
+    tracer = _tracer()
     for (kind, dtype), entries in buckets.items():
-        bucket_compo[f"{kind}/{np.dtype(dtype).name}"] = len(entries)
+        label = f"{kind}/{np.dtype(dtype).name}"
+        bucket_compo[label] = len(entries)
+        if tracer:
+            # trace-time instant span: one deterministic id per issued packed
+            # collective, keyed by (kind, axis, bucket) — the in-graph analogue
+            # of the eager transport's correlation key (this runs once per
+            # compile; the lowered program itself carries no tracing ops)
+            sid = tracer.instant(
+                "in_graph", group=repr(axis_name), bucket=label, leaves=len(entries)
+            )
+            if sid is not None:
+                bucket_spans[label] = sid
         buffer = jnp.concatenate([flat for _, flat, _ in entries]) if len(entries) > 1 else entries[0][1]
         out = _packed_collective(kind, buffer, axis_name)
         offset = 0
@@ -783,6 +854,7 @@ def sync_state_packed(
             collectives_before=per_leaf_collectives,
             collectives_after=len(buckets) + callable_leaves,
             groups=group_composition,
+            span_ids=bucket_spans or None,
         )
     return synced
 

@@ -22,6 +22,13 @@ Decision rules (each unit-tested in ``tests/test_bench_regress.py``):
 * **Lower is better** for every recorded unit (``us/step``, ``us/tenant``,
   ``us/epoch``, ``pct``): the latest value regresses when
   ``latest > baseline * (1 + tolerance)``.
+* **Per-config tolerance overrides.** A config that is legitimately noisy
+  (sub-microsecond medians, host-scheduler-bound epochs) should not force
+  the GLOBAL band wider: ``--tolerance-config NAME=PCT`` (repeatable;
+  ``PCT`` is a fraction like ``0.5`` or a percent like ``80%``) or a JSON
+  sidecar ``--tolerance-file overrides.json`` (``{"config": 0.8, ...}``)
+  overrides the band for the named configs only; everything else keeps
+  ``--tolerance``.
 
 Run: ``python scripts/bench_regress.py --check`` (CI via ``make
 bench-regress`` / ``make ci``); exit 1 iff a config regressed. ``--list``
@@ -121,19 +128,61 @@ def _healthy_value(rec: Optional[Dict[str, Any]]) -> Optional[float]:
     return float(rec["value"])
 
 
+def parse_tolerance(text: str) -> float:
+    """One tolerance value: a fraction (``0.5``) or a percent (``50%``)."""
+    text = text.strip()
+    if text.endswith("%"):
+        value = float(text[:-1]) / 100.0
+    else:
+        value = float(text)
+    if value < 0:
+        raise ValueError(f"tolerance must be >= 0, got {text!r}")
+    return value
+
+
+def parse_tolerance_overrides(
+    pairs: List[str], sidecar_path: Optional[str] = None
+) -> Dict[str, float]:
+    """Merge ``NAME=PCT`` flags over a JSON sidecar (flags win: the command
+    line is the more deliberate of the two)."""
+    overrides: Dict[str, float] = {}
+    if sidecar_path:
+        with open(sidecar_path) as fh:
+            doc = json.load(fh)
+        if not isinstance(doc, dict):
+            raise ValueError(
+                f"tolerance sidecar {sidecar_path} must be a JSON object of"
+                " config -> tolerance"
+            )
+        for name, value in doc.items():
+            overrides[str(name)] = parse_tolerance(str(value))
+    for pair in pairs:
+        name, sep, value = pair.partition("=")
+        if not sep or not name:
+            raise ValueError(
+                f"--tolerance-config expects NAME=PCT (e.g. noisy_cfg=0.8), got {pair!r}"
+            )
+        overrides[name] = parse_tolerance(value)
+    return overrides
+
+
 def check_trajectory(
     rounds: List[Tuple[int, Dict[str, Dict[str, Any]]]],
     tolerance: float = DEFAULT_TOLERANCE,
     min_history: int = DEFAULT_MIN_HISTORY,
+    tolerance_overrides: Optional[Dict[str, float]] = None,
 ) -> List[Dict[str, Any]]:
     """Judge the LATEST round against per-config baselines from the prior
     ones. Returns one row per config in the latest round:
-    ``{"metric", "unit", "baseline", "latest", "delta_pct", "status",
-    "history"}`` — ``status`` is ``REGRESSED`` only for a healthy latest
-    value past ``baseline * (1 + tolerance)``.
+    ``{"metric", "unit", "baseline", "latest", "delta_pct", "tolerance",
+    "status", "history"}`` — ``status`` is ``REGRESSED`` only for a healthy
+    latest value past ``baseline * (1 + tolerance)``, where a config named
+    in ``tolerance_overrides`` is judged against its own band instead of the
+    global one.
     """
     if not rounds:
         return []
+    overrides = tolerance_overrides or {}
     latest_n, latest = rounds[-1]
     prior = rounds[:-1]
     rows: List[Dict[str, Any]] = []
@@ -143,6 +192,7 @@ def check_trajectory(
             v for v in (_healthy_value(by_metric.get(metric)) for _, by_metric in prior)
             if v is not None
         ]
+        config_tolerance = overrides.get(metric, tolerance)
         row: Dict[str, Any] = {
             "metric": metric,
             "unit": rec.get("unit"),
@@ -151,6 +201,7 @@ def check_trajectory(
             "baseline": round(median(history), 3) if history else None,
             "latest": rec.get("value"),
             "delta_pct": None,
+            "tolerance": config_tolerance,
         }
         if rec.get("degraded"):
             row["status"] = SKIPPED_DEGRADED
@@ -162,14 +213,16 @@ def check_trajectory(
             baseline = median(history)
             value = float(rec["value"])
             row["delta_pct"] = round((value / baseline - 1.0) * 100.0, 1)
-            row["status"] = REGRESSED if value > baseline * (1.0 + tolerance) else OK
+            row["status"] = REGRESSED if value > baseline * (1.0 + config_tolerance) else OK
         rows.append(row)
     return rows
 
 
 def render_table(rows: List[Dict[str, Any]], tolerance: float) -> str:
-    """The human-readable delta table the gate prints."""
-    headers = ("config", "unit", "baseline", "latest", "delta", "status")
+    """The human-readable delta table the gate prints (the ``band`` column
+    is each config's own tolerance, so overrides are visible in the
+    output)."""
+    headers = ("config", "unit", "baseline", "latest", "delta", "band", "status")
     table = [headers]
     for row in rows:
         table.append(
@@ -179,6 +232,7 @@ def render_table(rows: List[Dict[str, Any]], tolerance: float) -> str:
                 "-" if row["baseline"] is None else f"{row['baseline']:g}",
                 "-" if row["latest"] is None else f"{row['latest']:g}",
                 "-" if row["delta_pct"] is None else f"{row['delta_pct']:+.1f}%",
+                f"+{row.get('tolerance', tolerance) * 100:.0f}%",
                 row["status"],
             )
         )
@@ -186,11 +240,15 @@ def render_table(rows: List[Dict[str, Any]], tolerance: float) -> str:
     lines = ["  ".join(cell.ljust(w) for cell, w in zip(r, widths)).rstrip() for r in table]
     lines.insert(1, "  ".join("-" * w for w in widths))
     regressed = sum(1 for row in rows if row["status"] == REGRESSED)
+    overridden = sum(1 for row in rows if row.get("tolerance", tolerance) != tolerance)
     lines.append("")
-    lines.append(
+    note = (
         f"{len(rows)} configs, {regressed} regressed"
-        f" (tolerance: +{tolerance * 100:.0f}% over the prior-round median)"
+        f" (tolerance: +{tolerance * 100:.0f}% over the prior-round median"
     )
+    if overridden:
+        note += f"; {overridden} per-config override{'s' if overridden != 1 else ''}"
+    lines.append(note + ")")
     return "\n".join(lines)
 
 
@@ -209,6 +267,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--tolerance", type=float, default=DEFAULT_TOLERANCE,
         help="allowed fractional slowdown over the baseline (default"
         f" {DEFAULT_TOLERANCE}: fail past baseline x {1 + DEFAULT_TOLERANCE})",
+    )
+    parser.add_argument(
+        "--tolerance-config", action="append", default=[], metavar="NAME=PCT",
+        help="per-config tolerance override (repeatable; PCT is a fraction"
+        " like 0.8 or a percent like 80%%) — a noisy config widens its own"
+        " band without loosening the global gate",
+    )
+    parser.add_argument(
+        "--tolerance-file", default=None, metavar="FILE",
+        help="JSON sidecar of per-config tolerance overrides"
+        ' ({"config": 0.8, ...}); --tolerance-config entries win over it',
     )
     parser.add_argument(
         "--min-history", type=int, default=DEFAULT_MIN_HISTORY,
@@ -233,7 +302,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     f" (degraded={bool(rec.get('degraded'))})"
                 )
         return 0
-    rows = check_trajectory(rounds, tolerance=args.tolerance, min_history=args.min_history)
+    try:
+        overrides = parse_tolerance_overrides(args.tolerance_config, args.tolerance_file)
+    except (ValueError, OSError, json.JSONDecodeError) as err:
+        print(f"bench_regress: {err}", file=sys.stderr)
+        return 2
+    rows = check_trajectory(
+        rounds,
+        tolerance=args.tolerance,
+        min_history=args.min_history,
+        tolerance_overrides=overrides,
+    )
     print(render_table(rows, args.tolerance))
     return 1 if any(row["status"] == REGRESSED for row in rows) else 0
 

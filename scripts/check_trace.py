@@ -1,0 +1,205 @@
+"""Chrome-trace validity gate for the timeline exporters.
+
+``timeline.export`` and ``timeline.export_fleet`` emit Trace Event Format
+JSON that must load in ``chrome://tracing`` / Perfetto; the viewers fail
+*silently* (dropped events, broken flow arrows) rather than loudly, so CI
+needs its own checker. :func:`validate_chrome_trace` enforces the invariants
+the exporters promise:
+
+* **Document shape**: a dict with a ``traceEvents`` list; every event is a
+  dict with a valid ``ph`` and the fields that phase requires (``name``,
+  ``pid``, ``tid``; ``ts`` for timed phases; ``dur >= 0`` for ``X``; an
+  ``id`` for flow events; metadata events carry ``args``).
+* **Monotonic timestamps per track**: within one ``(pid, tid)`` track,
+  slice/instant/counter events must appear in non-decreasing ``ts`` order —
+  the exporters sort before emitting, and a regression there scrambles the
+  rendered timeline. Flow events bind by ``id``, not array order, and are
+  exempt.
+* **Flow-event pairing**: every flow ``(cat, id)`` chain has exactly one
+  start (``ph: "s"``), at least one finish (``ph: "f"``), no step/finish
+  without a start, and no finish earlier on the clock than its start —
+  unpaired flows are the precise failure mode that silently loses the
+  cross-process arrows ``export_fleet`` exists to draw.
+
+Run modes: ``python scripts/check_trace.py FILE...`` validates existing
+trace files (exit 1 on any violation); ``--selftest`` exports fresh traces —
+a never-written log, an exercised single-process timeline, and a
+(single-process) fleet export — and validates those, which is what ``make
+trace-check`` (wired into ``make ci``) runs. The test suite imports
+:func:`validate_chrome_trace` directly over both exporters' output.
+"""
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: phases the exporters may emit; anything else is a checker violation
+KNOWN_PHASES = ("M", "X", "i", "C", "s", "t", "f", "b", "e", "B", "E")
+
+#: phases that occupy a (pid, tid) track and must keep ts order there
+TRACK_PHASES = ("X", "i", "C", "B", "E")
+
+#: flow phases binding by (cat, id) instead of track order
+FLOW_PHASES = ("s", "t", "f")
+
+
+def validate_chrome_trace(doc: Any) -> List[str]:
+    """Every violation in ``doc`` (a parsed trace), empty when valid."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"trace document must be a JSON object, got {type(doc).__name__}"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["trace document is missing the required 'traceEvents' list"]
+
+    last_ts: Dict[Tuple[Any, Any], float] = {}
+    flows: Dict[Tuple[Any, Any], Dict[str, List[float]]] = {}
+
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: event must be an object, got {type(ev).__name__}")
+            continue
+        ph = ev.get("ph")
+        if ph not in KNOWN_PHASES:
+            errors.append(f"{where}: unknown or missing phase {ph!r}")
+            continue
+        for field in ("name", "pid", "tid"):
+            if field not in ev:
+                errors.append(f"{where}: phase {ph!r} is missing required key {field!r}")
+        if ph == "M":
+            if not isinstance(ev.get("args"), dict):
+                errors.append(f"{where}: metadata event must carry an 'args' object")
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            errors.append(f"{where}: phase {ph!r} requires a numeric 'ts', got {ts!r}")
+            continue
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where}: complete event requires 'dur' >= 0, got {dur!r}")
+        if ph in TRACK_PHASES:
+            track = (ev.get("pid"), ev.get("tid"))
+            prev = last_ts.get(track)
+            if prev is not None and ts < prev:
+                errors.append(
+                    f"{where}: ts {ts} goes backwards on track pid={track[0]}"
+                    f" tid={track[1]} (previous {prev}) — track order must be"
+                    " non-decreasing"
+                )
+            last_ts[track] = max(float(ts), prev if prev is not None else float(ts))
+        if ph in FLOW_PHASES:
+            if "id" not in ev:
+                errors.append(f"{where}: flow event requires an 'id'")
+                continue
+            chain = flows.setdefault((ev.get("cat"), ev["id"]), {"s": [], "t": [], "f": []})
+            chain[ph].append(float(ts))
+
+    for (cat, fid), chain in sorted(flows.items(), key=lambda kv: str(kv[0])):
+        label = f"flow cat={cat!r} id={fid!r}"
+        if len(chain["s"]) != 1:
+            errors.append(
+                f"{label}: expected exactly one start ('s') event, got {len(chain['s'])}"
+            )
+        if not chain["f"]:
+            errors.append(f"{label}: has no finish ('f') event — the arrow is dangling")
+        if chain["s"]:
+            start = chain["s"][0]
+            for ts in chain["t"] + chain["f"]:
+                if ts < start:
+                    errors.append(
+                        f"{label}: step/finish at ts {ts} precedes its start at {start}"
+                    )
+    return errors
+
+
+def validate_file(path: str) -> List[str]:
+    """Parse ``path`` and validate; unreadable/unparseable files are a
+    violation, not a crash."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError) as err:
+        return [f"{path}: not readable as JSON ({err})"]
+    return [f"{path}: {e}" for e in validate_chrome_trace(doc)]
+
+
+def selftest(workdir: str) -> List[str]:
+    """Export fresh traces through both exporters and validate them: the
+    empty-log contract, an exercised single-process timeline (every event
+    kind the instrumentation emits), and a fleet export (degrades to one
+    process track outside a multi-process runtime)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, observability
+    from metrics_tpu.observability import timeline
+    from metrics_tpu.observability.events import EventLog
+
+    errors: List[str] = []
+
+    # 1. a never-written log must still export a valid (empty) trace
+    empty = os.path.join(workdir, "empty.json")
+    timeline.export(empty, log=EventLog())
+    errors += validate_file(empty)
+
+    # 2. an exercised timeline: updates/forwards/computes + a local fan-out
+    # sync so span + sync events land on the log
+    observability.reset()
+    observability.enable()
+    m = Accuracy(dist_sync_fn=lambda x, group=None: [x, x])
+    probs = jnp.zeros((8, 3), jnp.float32)
+    target = jnp.zeros((8,), jnp.int32)
+    with observability.step_context(0):
+        m(probs, target)
+    m.compute()
+    local = os.path.join(workdir, "local.json")
+    timeline.export(local)
+    errors += validate_file(local)
+
+    # 3. the fleet export (collective; single-process degrades to one track)
+    fleet = os.path.join(workdir, "fleet.json")
+    timeline.export_fleet(fleet)
+    errors += validate_file(fleet)
+
+    observability.reset()
+    return errors
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("paths", nargs="*", help="trace files to validate")
+    parser.add_argument(
+        "--selftest",
+        action="store_true",
+        help="export fresh traces via timeline.export / export_fleet and validate them",
+    )
+    args = parser.parse_args(argv)
+    if not args.paths and not args.selftest:
+        parser.error("pass trace files to validate, or --selftest")
+
+    errors: List[str] = []
+    for path in args.paths:
+        errors += validate_file(path)
+    if args.selftest:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as workdir:
+            errors += selftest(workdir)
+
+    if errors:
+        for e in errors:
+            print(f"VIOLATION: {e}")
+        return 1
+    n = len(args.paths) + (3 if args.selftest else 0)
+    print(f"trace-check: OK ({n} trace{'s' if n != 1 else ''} valid)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,12 @@ self-consistency (aliased == state leaves, version-independent) and pinned
 against the baseline (``donation_aliasing``) so a lowering change that
 silently reintroduces copies fails the gate.
 
+The identity sweep also toggles the **fleet tracing** span tracker
+(``observability/tracing.py``) on its own: collective spans are host-side
+bookkeeping, so the disabled-state AND enabled-state hot-path jaxprs must
+stay byte-identical to the pinned baseline — the same discipline the health
+monitor established.
+
 Fourth pin: **compute-group fusion**. The canonical stat-scores collection
 (``Precision/Recall/F1/Specificity/StatScores``, same config) must
 trace-fingerprint into ONE compute group, so its compiled step runs exactly
@@ -403,6 +409,25 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
         observability.set_health_policy(prev_policy)
         observability.TELEMETRY.enable(prev_enabled)
         observability.EVENTS.enable(prev_enabled)
+        observability.TRACER.enable(prev_enabled)
+
+    # fleet tracing must be host-side only: toggling the collective span
+    # tracker ALONE (telemetry/events untouched) must leave every hot-path
+    # jaxpr byte-identical — a tracing call site that leaks a traced op
+    # (clock read, debug callback) into a compiled program fails here
+    prev_tracing = observability.TRACER.enabled
+    try:
+        for name, thunk in programs.items():
+            observability.TRACER.enable()
+            tracing_on = thunk()
+            observability.TRACER.disable()
+            if tracing_on != thunk():
+                violations.append(
+                    f"{name}: jaxpr differs between tracing enabled and disabled —"
+                    " a collective-span call site leaked traced ops into the hot path"
+                )
+    finally:
+        observability.TRACER.enable(prev_tracing)
 
     # the donated lowering must be zero-copy regardless of any baseline: every
     # donated state leaf aliases an output buffer, or XLA copies it per step

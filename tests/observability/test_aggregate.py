@@ -408,3 +408,44 @@ def test_render_prometheus_aggregated_carries_process_labels():
 def test_aggregated_snapshot_is_json_round_trippable():
     agg = aggregate_snapshots([_synthetic_snapshot(1), _synthetic_snapshot(2)])
     assert json.loads(json.dumps(agg)) == agg
+
+
+def test_merged_histogram_percentiles_equal_summed_bucket_percentiles():
+    """Satellite: a merged histogram's p50/p95/p99 must equal the
+    percentiles computed FROM THE SUMMED BUCKETS — never any average of the
+    per-process percentiles. Two processes with very different latency
+    distributions (a fast one and a slow one) make the two answers diverge
+    by orders of magnitude, so the assertion cannot pass by accident."""
+    from metrics_tpu.observability.histogram import Log2Histogram
+    from metrics_tpu.observability.aggregate import merge_snapshots
+
+    fast, slow = Log2Histogram("s"), Log2Histogram("s")
+    for _ in range(90):
+        fast.observe(2e-6)  # 90 fast observations ~2 µs
+    for _ in range(10):
+        slow.observe(0.5)  # 10 slow observations ~500 ms
+
+    def snap_of(hist):
+        entry = hist.to_dict()
+        entry["name"] = "dispatch_seconds"
+        return {"histograms": {"dispatch_seconds": entry}}
+
+    merged = merge_snapshots([snap_of(fast), snap_of(slow)])
+    entry = merged["histograms"]["dispatch_seconds"]
+
+    # ground truth: one histogram holding BOTH processes' observations
+    ref = Log2Histogram("s")
+    ref.merge_counts(fast.bucket_counts(), fast.count, fast.sum)
+    ref.merge_counts(slow.bucket_counts(), slow.count, slow.sum)
+    assert entry["count"] == 100 and entry["count"] == ref.count
+    for q, key in ((50.0, "p50"), (95.0, "p95"), (99.0, "p99")):
+        # snapshot values are rounded to 9 decimals; match that exactly
+        assert entry[key] == round(ref.percentile(q), 9), key
+
+    # and explicitly NOT the mean of the per-process percentiles: the fleet
+    # p50 stays in the fast band (90/100 observations), while the average
+    # of per-process p50s would sit near 0.25 s — off by ~5 orders
+    for key in ("p50", "p95", "p99"):
+        averaged = (fast.to_dict()[key] + slow.to_dict()[key]) / 2.0
+        assert entry[key] != pytest.approx(averaged, rel=0.3), key
+    assert entry["p50"] < 1e-4 < 0.1 < entry["p95"]

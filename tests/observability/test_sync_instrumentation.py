@@ -48,6 +48,54 @@ def test_gather_payload_accounting_simulated_two_ranks():
     assert sync["groups"] == {"0,1": {"gathers": 2, "world": 2}}
 
 
+def test_gather_round_durations_split_descriptor_vs_payload():
+    """Satellite: the transport's single ``dur_s`` is decomposed into the
+    descriptor round vs the payload round — cumulative totals in the sync
+    stats, per-round series in the fast-path histograms, and per-transport
+    values (plus the collective span id) on the sync event."""
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    b = np.arange(6, dtype=np.float32).reshape(2, 3)
+    _, errors = run_ranks([a, b])
+    assert errors == [None, None]
+    snap = observability.snapshot()
+    sync = snap["sync"]
+    assert sync["descriptor_seconds"] > 0.0
+    assert sync["payload_seconds"] > 0.0
+    hists = snap["histograms"]
+    # one histogram observation per rank per round
+    assert hists["sync_round_trip_seconds{transport=gather_descriptor}"]["count"] == 2
+    assert hists["sync_round_trip_seconds{transport=gather_payload}"]["count"] == 2
+    assert hists["sync_round_trip_seconds{transport=gather}"]["count"] == 2
+    events = [
+        e for e in observability.EVENTS.events() if e.payload.get("transport") == "gather"
+    ]
+    assert len(events) == 2
+    for ev in events:
+        assert ev.payload["descriptor_s"] >= 0.0
+        assert ev.payload["payload_s"] >= 0.0
+        # the split cannot exceed the whole transport
+        assert ev.payload["descriptor_s"] + ev.payload["payload_s"] <= ev.dur_s + 1e-6
+        assert ev.payload["span_id"] == "gather|0,1|transport|0"
+    # each rank's event is stamped with its recording process
+    assert sorted(ev.payload["process"] for ev in events) == [0, 1]
+    text = observability.render_prometheus()
+    assert "metrics_tpu_sync_descriptor_seconds_total" in text
+    assert "metrics_tpu_sync_payload_seconds_total" in text
+
+
+def test_all_empty_gather_skips_payload_round_duration():
+    """An all-empty bundle skips the payload collective on every rank: the
+    payload split stays zero and no gather_payload histogram lands."""
+    empty = np.zeros((0,), dtype=np.float32)
+    _, errors = run_ranks([empty, empty])
+    assert errors == [None, None]
+    snap = observability.snapshot()
+    assert snap["sync"]["payload_rounds"] == 0
+    assert snap["sync"]["descriptor_seconds"] > 0.0
+    assert snap["sync"]["payload_seconds"] == 0.0
+    assert "sync_round_trip_seconds{transport=gather_payload}" not in snap["histograms"]
+
+
 def test_gather_group_topology_recorded_per_group():
     locals_ = [np.ones(2, np.float32) * r for r in range(4)]
     _, errors = run_ranks(locals_, groups=[[0, 1], [0, 1], [2, 3], [2, 3]])

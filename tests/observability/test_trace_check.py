@@ -1,0 +1,163 @@
+"""The Chrome-trace validity checker (``scripts/check_trace.py``): its
+violation taxonomy on hand-built traces, and the standing contract that both
+timeline exporters' real output passes it."""
+import json
+import os
+import sys
+
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, observability
+from metrics_tpu.observability import timeline
+from metrics_tpu.observability.events import EventLog
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "scripts"
+)
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+import check_trace  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    yield
+    observability.reset()
+    observability.enable()
+
+
+def _trace(events):
+    return {"traceEvents": events}
+
+
+def _slice(pid=0, tid=1, ts=1.0, dur=1.0, name="x"):
+    return {"ph": "X", "name": name, "pid": pid, "tid": tid, "ts": ts, "dur": dur}
+
+
+def test_minimal_valid_trace_passes():
+    doc = _trace([
+        {"ph": "M", "name": "process_name", "pid": 0, "tid": 0, "args": {"name": "p"}},
+        _slice(ts=1.0),
+        _slice(ts=2.0),
+        {"ph": "i", "name": "inst", "pid": 0, "tid": 1, "ts": 3.0, "s": "t"},
+    ])
+    assert check_trace.validate_chrome_trace(doc) == []
+
+
+def test_document_shape_violations():
+    assert check_trace.validate_chrome_trace([]) != []
+    assert check_trace.validate_chrome_trace({}) != []
+    assert check_trace.validate_chrome_trace({"traceEvents": "nope"}) != []
+    errs = check_trace.validate_chrome_trace(_trace([{"ph": "Z", "name": "x"}]))
+    assert any("unknown or missing phase" in e for e in errs)
+    errs = check_trace.validate_chrome_trace(_trace([{"ph": "X", "ts": 1.0, "dur": 1.0}]))
+    assert any("missing required key" in e for e in errs)
+
+
+def test_required_fields_per_phase():
+    # X without dur; timed phase without ts; metadata without args
+    errs = check_trace.validate_chrome_trace(
+        _trace([{"ph": "X", "name": "x", "pid": 0, "tid": 1, "ts": 1.0}])
+    )
+    assert any("'dur'" in e for e in errs)
+    errs = check_trace.validate_chrome_trace(
+        _trace([{"ph": "i", "name": "x", "pid": 0, "tid": 1}])
+    )
+    assert any("numeric 'ts'" in e for e in errs)
+    errs = check_trace.validate_chrome_trace(
+        _trace([{"ph": "M", "name": "process_name", "pid": 0, "tid": 0}])
+    )
+    assert any("'args'" in e for e in errs)
+
+
+def test_backwards_ts_on_one_track_is_a_violation():
+    doc = _trace([_slice(ts=5.0), _slice(ts=1.0)])
+    errs = check_trace.validate_chrome_trace(doc)
+    assert any("goes backwards" in e for e in errs)
+    # separate tracks keep independent clocks — no violation
+    doc = _trace([_slice(ts=5.0, tid=1), _slice(ts=1.0, tid=2)])
+    assert check_trace.validate_chrome_trace(doc) == []
+
+
+def _flow(ph, fid=1, ts=1.0, pid=0):
+    ev = {"ph": ph, "name": "f", "cat": "flow", "id": fid, "pid": pid, "tid": 1, "ts": ts}
+    if ph == "f":
+        ev["bp"] = "e"
+    return ev
+
+
+def test_flow_pairing_violations():
+    # dangling start (no finish)
+    errs = check_trace.validate_chrome_trace(_trace([_flow("s")]))
+    assert any("no finish" in e for e in errs)
+    # finish without start
+    errs = check_trace.validate_chrome_trace(_trace([_flow("f")]))
+    assert any("exactly one start" in e for e in errs)
+    # duplicate starts
+    errs = check_trace.validate_chrome_trace(_trace([_flow("s"), _flow("s"), _flow("f", ts=2.0)]))
+    assert any("exactly one start" in e for e in errs)
+    # finish before its start on the clock
+    errs = check_trace.validate_chrome_trace(_trace([_flow("s", ts=5.0), _flow("f", ts=1.0)]))
+    assert any("precedes its start" in e for e in errs)
+    # a well-paired chain (start -> step -> finish) passes, and flow events
+    # are exempt from per-track monotonicity (they bind by id)
+    doc = _trace([_slice(ts=9.0), _flow("s", ts=1.0), _flow("t", ts=2.0, pid=1), _flow("f", ts=3.0)])
+    assert check_trace.validate_chrome_trace(doc) == []
+
+
+def test_missing_flow_id_is_a_violation():
+    ev = {"ph": "s", "name": "f", "cat": "flow", "pid": 0, "tid": 1, "ts": 1.0}
+    errs = check_trace.validate_chrome_trace(_trace([ev]))
+    assert any("requires an 'id'" in e for e in errs)
+
+
+def test_validate_file_handles_unreadable_input(tmp_path):
+    missing = str(tmp_path / "nope.json")
+    assert any("not readable" in e for e in check_trace.validate_file(missing))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert any("not readable" in e for e in check_trace.validate_file(str(bad)))
+
+
+# ---------------------------------------------------------------------------
+# the standing contract: real exporter output passes the checker
+# ---------------------------------------------------------------------------
+
+
+def test_export_output_is_checker_valid(tmp_path):
+    m = Accuracy(dist_sync_fn=lambda x, group=None: [x, x])
+    with observability.step_context(0):
+        m(jnp.zeros((8, 3)), jnp.zeros((8,), jnp.int32))
+    m.compute()
+    path = timeline.export(str(tmp_path / "local.json"))
+    assert check_trace.validate_file(path) == []
+
+
+def test_empty_log_export_is_checker_valid(tmp_path):
+    path = timeline.export(str(tmp_path / "empty.json"), log=EventLog())
+    assert check_trace.validate_file(path) == []
+
+
+def test_export_fleet_output_is_checker_valid(tmp_path):
+    m = Accuracy(dist_sync_fn=lambda x, group=None: [x, x])
+    m(jnp.zeros((8, 3)), jnp.zeros((8,), jnp.int32))
+    m.compute()
+    path = timeline.export_fleet(str(tmp_path / "fleet.json"))
+    assert check_trace.validate_file(path) == []
+
+
+def test_selftest_passes(tmp_path):
+    assert check_trace.selftest(str(tmp_path)) == []
+
+
+def test_cli_exit_codes(tmp_path):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_trace([_slice()])))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_trace([_slice(ts=5.0), _slice(ts=1.0)])))
+    assert check_trace.main([str(good)]) == 0
+    assert check_trace.main([str(bad)]) == 1

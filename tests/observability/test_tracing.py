@@ -1,0 +1,407 @@
+"""Fleet tracing: deterministic collective span ids, the clock-offset
+handshake, the straggler/skew decomposition, and the acceptance contract —
+``export_fleet`` over simulated multi-process ranks produces ONE valid
+Perfetto trace with the same collective's clock-aligned spans on every
+process track connected by flow events, and the straggler report identifies
+a synthetically-delayed process."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.utilities.distributed as dist_mod
+from metrics_tpu import Accuracy, observability
+from metrics_tpu.observability import timeline, tracing
+from metrics_tpu.observability.events import EventLog
+from metrics_tpu.observability.tracing import (
+    SpanTracker,
+    TRACER,
+    degraded_processes,
+    estimate_clock_offsets,
+    straggler_report,
+)
+from tests.observability.test_aggregate import _run_ranks
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "scripts"
+)
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+import check_trace  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    yield
+    observability.reset()
+    observability.enable()
+
+
+# ---------------------------------------------------------------------------
+# span ids
+# ---------------------------------------------------------------------------
+
+
+def test_span_ids_are_deterministic_per_kind_group_bucket():
+    tracker = SpanTracker(log=EventLog())
+    a = tracker.begin("gather", group="0,1", bucket="transport")
+    b = tracker.begin("gather", group="0,1", bucket="descriptor")
+    c = tracker.begin("gather", group="0,1", bucket="transport")
+    d = tracker.begin("sync", group="0,1", bucket="transport")
+    # each (kind, group, bucket) counts its own monotonic sequence
+    assert a.span_id == "gather|0,1|transport|0"
+    assert b.span_id == "gather|0,1|descriptor|0"
+    assert c.span_id == "gather|0,1|transport|1"
+    assert d.span_id == "sync|0,1|transport|0"
+    for s in (a, b, c, d):
+        tracker.end(s)
+    assert [r.span_id for r in tracker.records()] == [
+        s.span_id for s in (a, b, c, d)
+    ]
+
+
+def test_span_records_carry_clock_step_and_payload():
+    log = EventLog()
+    tracker = SpanTracker(log=log)
+    log.set_step(7)
+    with tracker.collective_span("gather", group="all", bucket="transport", leaves=3) as span:
+        time.sleep(0.002)
+    (rec,) = tracker.records()
+    assert rec.span_id == span.span_id
+    assert rec.exit_s > rec.enter_s
+    assert rec.step == 7
+    assert rec.payload == {"leaves": 3}
+    summary = tracker.summary()
+    assert summary["recorded_total"] == 1 and summary["by_kind"] == {"gather": 1}
+
+
+def test_disabled_tracker_records_nothing_and_costs_one_read():
+    tracker = SpanTracker(log=EventLog(), enabled=False)
+    assert tracker.begin("gather") is None
+    tracker.end(None)  # a no-op, never raises
+    assert tracker.instant("in_graph") is None
+    assert tracker.records() == []
+
+
+def test_tracker_is_bounded_and_counts_drops():
+    tracker = SpanTracker(capacity=2, log=EventLog())
+    for _ in range(5):
+        tracker.end(tracker.begin("gather"))
+    assert len(tracker.records()) == 2
+    assert tracker.summary()["dropped"] == 3
+    # the newest spans are the ones retained
+    assert [r.seq for r in tracker.records()] == [3, 4]
+
+
+def test_clear_resets_sequences_and_report():
+    tracker = SpanTracker(log=EventLog())
+    tracker.end(tracker.begin("gather"))
+    tracker.set_fleet_report({"flagged": [1]})
+    tracker.clear()
+    assert tracker.records() == [] and tracker.last_fleet_report is None
+    assert tracker.begin("gather").span_id.endswith("|0")  # sequence restarted
+
+
+def test_observability_toggles_cover_the_tracer():
+    observability.disable()
+    assert not TRACER.enabled
+    observability.enable()
+    assert TRACER.enabled
+
+
+# ---------------------------------------------------------------------------
+# instrumented call sites
+# ---------------------------------------------------------------------------
+
+
+def test_metric_sync_records_span_and_event_span_id():
+    m = Accuracy(dist_sync_fn=lambda x, group=None: [x, x])
+    m(jnp.zeros((4, 3)), jnp.zeros((4,), jnp.int32))
+    m.compute()
+    spans = [r for r in TRACER.records() if r.kind == "sync" and r.bucket == "metric"]
+    assert len(spans) == 1
+    assert spans[0].payload["metric"] == m.telemetry_key
+    sync_events = [e for e in observability.EVENTS.events() if e.kind == "sync" and e.metric]
+    assert sync_events and sync_events[-1].payload["span_id"] == spans[0].span_id
+
+
+def test_packed_in_graph_sync_records_bucket_span_ids():
+    import jax
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    state = {"a": jnp.ones((3,), jnp.float32), "b": jnp.ones((2,), jnp.float32)}
+    reductions = {"a": "sum", "b": "sum"}
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+
+    def shard_map(fn):
+        if hasattr(jax, "shard_map"):
+            return jax.shard_map(fn, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False)
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=(P(),), out_specs=P(), check_rep=False)
+
+    jax.make_jaxpr(shard_map(lambda s: dist_mod.sync_state_packed(s, reductions, "data")))(state)
+    spans = [r for r in TRACER.records() if r.kind == "in_graph"]
+    assert len(spans) == 1  # one bucket: psum/float32
+    assert spans[0].bucket == "psum/float32"
+    assert spans[0].enter_s == spans[0].exit_s  # trace-time instant
+    sync_events = [
+        e for e in observability.EVENTS.events() if e.payload.get("in_graph")
+    ]
+    assert sync_events[-1].payload["span_ids"] == {"psum/float32": spans[0].span_id}
+
+
+def test_gather_transport_records_round_spans_and_duration_split():
+    """Each simulated-rank gather records transport + descriptor + payload
+    spans with matching ids across ranks, and the telemetry split lands."""
+    from tests.bases.test_gather_protocol import run_ranks
+
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    b = np.arange(6, dtype=np.float32).reshape(2, 3)
+    _, errors = run_ranks([a, b])
+    assert errors == [None, None]
+    spans = TRACER.records()
+    by_rank = {p: [r for r in spans if r.process == p] for p in (0, 1)}
+    for p in (0, 1):
+        assert [r.bucket for r in by_rank[p]] == ["descriptor", "payload", "transport"]
+        # both ranks produced the SAME deterministic ids — the correlation key
+        assert [r.span_id for r in by_rank[p]] == [
+            "gather|0,1|descriptor|0",
+            "gather|0,1|payload|0",
+            "gather|0,1|transport|0",
+        ]
+    sync = observability.snapshot()["sync"]
+    assert sync["descriptor_seconds"] > 0.0
+    assert sync["payload_seconds"] > 0.0
+    hists = observability.snapshot()["histograms"]
+    assert "sync_round_trip_seconds{transport=gather_descriptor}" in hists
+    assert "sync_round_trip_seconds{transport=gather_payload}" in hists
+    ev = [e for e in observability.EVENTS.events() if e.payload.get("transport") == "gather"]
+    assert ev[-1].payload["descriptor_s"] >= 0.0
+    assert ev[-1].payload["payload_s"] >= 0.0
+    assert ev[-1].payload["span_id"] == "gather|0,1|transport|0"
+
+
+# ---------------------------------------------------------------------------
+# clock-offset handshake
+# ---------------------------------------------------------------------------
+
+
+def test_estimate_clock_offsets_single_process_is_identity():
+    est = estimate_clock_offsets()
+    assert est["offsets"] == [0.0] and est["rtt_s"] == 0.0
+
+
+def test_estimate_clock_offsets_recovers_synthetic_skew():
+    """Two simulated ranks with clocks shifted 10 s apart: each rank's
+    estimate of the other's offset lands within the RTT bound."""
+    base = time.perf_counter()
+    shift = {0: 0.0, 1: 10.0}
+
+    def rank_fn(rank):
+        def run():
+            return estimate_clock_offsets(
+                rounds=3, now_fn=lambda: time.perf_counter() - base + shift[rank]
+            )
+
+        return run
+
+    results = _run_ranks([rank_fn(0), rank_fn(1)])
+    r0, r1 = results
+    assert r0["process"] == 0 and r1["process"] == 1
+    assert r0["offsets"][0] == 0.0 and r1["offsets"][1] == 0.0
+    tol = max(0.05, r0["rtt_s"], r1["rtt_s"])
+    assert abs(r0["offsets"][1] - 10.0) < tol  # peer 1 runs 10 s ahead
+    assert abs(r1["offsets"][0] + 10.0) < tol  # and sees peer 0 10 s behind
+    assert r0["uncertainty_s"] == pytest.approx(r0["rtt_s"] / 2, abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# straggler report (pure decomposition on synthetic spans)
+# ---------------------------------------------------------------------------
+
+
+def _span(span_id, process, enter, exit_, kind="gather", bucket="transport"):
+    return {
+        "span_id": span_id, "kind": kind, "group": "0,1", "bucket": bucket,
+        "seq": int(span_id.rsplit("|", 1)[1]), "process": process,
+        "enter_s": enter, "exit_s": exit_, "step": None, "payload": {},
+    }
+
+
+def _fleet(spans_by_process):
+    return {
+        "processes": [
+            {"process": p, "epoch_unix": 0.0, "events": [], "spans": spans}
+            for p, spans in sorted(spans_by_process.items())
+        ],
+        "clock": {"offsets": [0.0] * len(spans_by_process), "uncertainty_s": 0.001},
+    }
+
+
+def test_straggler_report_decomposes_wait_vs_transfer():
+    # two collectives; process 1 arrives 0.10 late both times
+    fleet = _fleet({
+        0: [_span("gather|0,1|transport|0", 0, 1.0, 1.25),
+            _span("gather|0,1|transport|1", 0, 2.0, 2.30)],
+        1: [_span("gather|0,1|transport|0", 1, 1.1, 1.25),
+            _span("gather|0,1|transport|1", 1, 2.1, 2.30)],
+    })
+    report = straggler_report(fleet)
+    assert report["collectives"] == 2
+    p0, p1 = report["processes"]["0"], report["processes"]["1"]
+    # the early arriver waits for the slowest peer; the straggler never waits
+    assert p0["wait_s"] == pytest.approx(0.2)
+    assert p1["wait_s"] == pytest.approx(0.0)
+    # transfer = exit - last_enter, attributed to both
+    assert p0["transfer_s"] == pytest.approx(0.15 + 0.20)
+    assert p1["transfer_s"] == pytest.approx(0.15 + 0.20)
+    assert p0["lag_p50_s"] == pytest.approx(0.0)
+    assert p1["lag_p50_s"] == pytest.approx(0.1)
+    assert report["skew_p50_s"] == pytest.approx(0.1)
+    assert p1["straggler_fraction"] == 1.0
+    assert report["flagged"] == [1]
+    assert report["clock_uncertainty_s"] == 0.001
+
+
+def test_straggler_report_respects_thresholds_and_min_spans():
+    fleet = _fleet({
+        0: [_span("gather|0,1|transport|0", 0, 1.0, 1.2)],
+        1: [_span("gather|0,1|transport|0", 1, 1.1, 1.2)],
+    })
+    # one collective < min_spans=2: nobody can be flagged yet
+    assert straggler_report(fleet)["flagged"] == []
+    assert straggler_report(fleet, min_spans=1)["flagged"] == [1]
+    # a min_lag floor above the observed skew suppresses the flag
+    assert straggler_report(fleet, min_spans=1, min_lag_s=0.5)["flagged"] == []
+
+
+def test_straggler_report_ignores_sub_round_and_single_process_spans():
+    fleet = _fleet({
+        0: [_span("gather|0,1|descriptor|0", 0, 1.0, 1.1, bucket="descriptor"),
+            _span("gather|0,1|transport|5", 0, 1.0, 1.1)],
+        1: [_span("gather|0,1|descriptor|0", 1, 1.0, 1.1, bucket="descriptor")],
+    })
+    report = straggler_report(fleet)
+    assert report["collectives"] == 0
+    assert report["flagged"] == []
+
+
+def test_publish_feeds_snapshot_prometheus_and_straggler_event():
+    fleet = _fleet({
+        0: [_span("gather|0,1|transport|0", 0, 1.0, 1.2),
+            _span("gather|0,1|transport|1", 0, 2.0, 2.2)],
+        1: [_span("gather|0,1|transport|0", 1, 1.1, 1.2),
+            _span("gather|0,1|transport|1", 1, 2.1, 2.2)],
+    })
+    report = straggler_report(fleet, publish=True)
+    assert degraded_processes() == [1]
+    assert degraded_processes(report) == [1]
+    snap = observability.snapshot()
+    assert snap["tracing"]["straggler"]["flagged"] == [1]
+    assert json.loads(json.dumps(snap)) == snap
+    text = observability.render_prometheus()
+    assert 'metrics_tpu_straggler_fraction{peer="1"} 1.0' in text
+    assert 'metrics_tpu_straggler_flagged{peer="1"} 1' in text
+    assert 'metrics_tpu_straggler_flagged{peer="0"} 0' in text
+    assert 'metrics_tpu_straggler_lag_seconds{peer="1",quantile="p50"}' in text
+    from tests.observability.test_registry import _check_exposition_format
+
+    _check_exposition_format(text)
+    # the flagged process landed on the event timeline as a straggler event
+    kinds = [e.kind for e in observability.EVENTS.events()]
+    assert "straggler" in kinds
+
+
+def test_degraded_processes_empty_without_a_report():
+    assert degraded_processes() == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance: export_fleet over simulated ranks with an injected delay
+# ---------------------------------------------------------------------------
+
+
+def test_export_fleet_acceptance_with_synthetic_straggler(tmp_path):
+    """ISSUE 8 acceptance: on the simulated multi-process mesh,
+    ``export_fleet`` produces a single VALID Perfetto trace where one sync
+    collective appears as clock-aligned spans on every participating process
+    track connected by flow events, and the straggler report identifies the
+    process whose transport path carries an injected sleep."""
+    delay_s = 0.05
+    paths = {}
+
+    def rank_fn(rank):
+        def run():
+            for _ in range(3):
+                if rank == 1:
+                    time.sleep(delay_s)  # the synthetic straggler
+                dist_mod.gather_all_pytrees([{"x": np.arange(4, dtype=np.float32)}])
+            paths[rank] = timeline.export_fleet(str(tmp_path / f"fleet_{rank}.json"))
+            return paths[rank]
+
+        return run
+
+    _run_ranks([rank_fn(0), rank_fn(1)])
+
+    with open(paths[0]) as fh:
+        doc = json.load(fh)
+    # a single valid Perfetto/Chrome trace (the CI checker's contract)
+    assert check_trace.validate_chrome_trace(doc) == []
+
+    events = doc["traceEvents"]
+    pids = {e["pid"] for e in events if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert pids == {0, 1}
+
+    # the same collective's span appears on BOTH process tracks...
+    slices = [e for e in events if e.get("cat") == "collective" and e.get("ph") == "X"]
+    sid = "gather|0,1|transport|0"
+    per_pid = {p: [e for e in slices if e["pid"] == p and e["args"]["span_id"] == sid] for p in (0, 1)}
+    assert len(per_pid[0]) == 1 and len(per_pid[1]) == 1
+    # ...clock-aligned: the delayed rank entered ~delay_s after rank 0
+    skew_us = per_pid[1][0]["ts"] - per_pid[0][0]["ts"]
+    assert skew_us > 0.5 * delay_s * 1e6
+    # ...and connected by flow events (one start + one finish per chain)
+    flows = [e for e in events if e.get("cat") == "collective_flow"]
+    flow_for_sid = [e for e in flows if e["args"]["span_id"] == sid]
+    assert {e["ph"] for e in flow_for_sid} == {"s", "f"}
+    assert {e["pid"] for e in flow_for_sid} == {0, 1}
+    # the start rides the earliest-entering process (rank 0)
+    assert next(e for e in flow_for_sid if e["ph"] == "s")["pid"] == 0
+
+    # the straggler report correctly identifies the delayed process, in the
+    # trace, the published query, and the snapshot
+    report = doc["otherData"]["straggler_report"]
+    assert report["flagged"] == [1]
+    assert report["processes"]["1"]["straggler_fraction"] == 1.0
+    assert report["processes"]["1"]["lag_p50_s"] > 0.5 * delay_s
+    assert degraded_processes() == [1]
+    assert observability.snapshot()["tracing"]["straggler"]["flagged"] == [1]
+    # every rank exported the same fleet (same spans, same report)
+    with open(paths[1]) as fh:
+        doc1 = json.load(fh)
+    assert check_trace.validate_chrome_trace(doc1) == []
+    assert doc1["otherData"]["straggler_report"]["flagged"] == [1]
+
+
+def test_export_fleet_single_process_degrades_to_one_track(tmp_path):
+    m = Accuracy(dist_sync_fn=lambda x, group=None: [x, x])
+    m(jnp.zeros((4, 3)), jnp.zeros((4,), jnp.int32))
+    m.compute()
+    path = timeline.export_fleet(str(tmp_path / "artifacts" / "fleet.json"))
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert check_trace.validate_chrome_trace(doc) == []
+    assert doc["otherData"]["processes"] == 1
+    assert doc["otherData"]["straggler_report"]["collectives"] == 0
+    # per-metric event tracks render under the single process pid
+    names = {e["args"]["name"] for e in doc["traceEvents"] if e["name"] == "thread_name"}
+    assert any(name.startswith("Accuracy#") for name in names)

@@ -162,6 +162,76 @@ def test_new_config_in_latest_round_cannot_fail(tmp_path):
     assert by_metric["brand_new"]["status"] == bench_regress.SKIPPED_NO_HISTORY
 
 
+def test_per_config_tolerance_override_widens_only_the_named_band(tmp_path):
+    """Satellite: a noisy config's own +100% band lets its 1.8x latest pass
+    while a second config at the same delta still fails the global +50%."""
+    paths = [
+        _capture(tmp_path, i + 1, [_record("noisy", 10.0), _record("steady", 10.0)])
+        for i in range(3)
+    ]
+    paths.append(_capture(tmp_path, 4, [_record("noisy", 18.0), _record("steady", 18.0)]))
+    trajectory = bench_regress.load_trajectory(paths)
+
+    # no override: both 1.8x deltas regress at the +50% default
+    rows = {r["metric"]: r for r in bench_regress.check_trajectory(trajectory)}
+    assert rows["noisy"]["status"] == bench_regress.REGRESSED
+    assert rows["steady"]["status"] == bench_regress.REGRESSED
+
+    rows = {
+        r["metric"]: r
+        for r in bench_regress.check_trajectory(
+            trajectory, tolerance_overrides={"noisy": 1.0}
+        )
+    }
+    assert rows["noisy"]["status"] == bench_regress.OK
+    assert rows["noisy"]["tolerance"] == 1.0
+    assert rows["steady"]["status"] == bench_regress.REGRESSED
+    assert rows["steady"]["tolerance"] == bench_regress.DEFAULT_TOLERANCE
+
+    # CLI: the override flips the exit code once it also covers "steady",
+    # and the rendered table shows the per-config band
+    assert bench_regress.main(paths + ["--check", "--tolerance-config", "noisy=1.0"]) == 1
+    assert (
+        bench_regress.main(
+            paths + ["--check", "--tolerance-config", "noisy=1.0",
+                     "--tolerance-config", "steady=100%"]
+        )
+        == 0
+    )
+    table = bench_regress.render_table(
+        bench_regress.check_trajectory(trajectory, tolerance_overrides={"noisy": 1.0}),
+        bench_regress.DEFAULT_TOLERANCE,
+    )
+    assert "+100%" in table and "+50%" in table and "1 per-config override" in table
+
+
+def test_tolerance_sidecar_file_and_flag_precedence(tmp_path):
+    sidecar = tmp_path / "tolerances.json"
+    sidecar.write_text(json.dumps({"noisy": 0.8, "other": "25%"}))
+    overrides = bench_regress.parse_tolerance_overrides([], str(sidecar))
+    assert overrides == {"noisy": 0.8, "other": 0.25}
+    # explicit flags win over the sidecar
+    overrides = bench_regress.parse_tolerance_overrides(["noisy=2.0"], str(sidecar))
+    assert overrides["noisy"] == 2.0 and overrides["other"] == 0.25
+    paths = _rounds(tmp_path, [10.0, 10.0, 10.0, 18.0], metric="noisy")
+    assert bench_regress.main(paths + ["--check", "--tolerance-file", str(sidecar)]) == 0
+
+
+def test_tolerance_parse_errors_are_descriptive(tmp_path):
+    with pytest.raises(ValueError, match="NAME=PCT"):
+        bench_regress.parse_tolerance_overrides(["missing-equals"])
+    with pytest.raises(ValueError, match=">= 0"):
+        bench_regress.parse_tolerance_overrides(["m=-0.5"])
+    assert bench_regress.parse_tolerance("80%") == pytest.approx(0.8)
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    with pytest.raises(ValueError, match="JSON object"):
+        bench_regress.parse_tolerance_overrides([], str(bad))
+    # CLI surfaces parse failures as exit 2, not a traceback
+    paths = _rounds(tmp_path, [10.0, 10.0, 10.0])
+    assert bench_regress.main(paths + ["--tolerance-config", "bogus"]) == 2
+
+
 def test_committed_trajectory_passes():
     """Acceptance: ``bench_regress --check`` stays green on the repo's own
     BENCH_r01..r05 history."""

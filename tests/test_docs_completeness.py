@@ -119,6 +119,35 @@ def test_telemetry_plane_documented_and_cross_linked():
     assert "bench-regress" in perf
 
 
+def test_fleet_tracing_documented_and_cross_linked():
+    """The fleet-tracing contract lives in the observability guide (span
+    ids + their collective-discipline caveat, clock-alignment uncertainty,
+    export_fleet, the straggler report and its Prometheus family, the
+    trace-check gate) and is cross-linked from the performance guide's sync
+    section."""
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "## Fleet tracing & straggler diagnostics" in obs
+    for phrase in (
+        "span id",
+        "estimate_clock_offsets",
+        "RTT/2",
+        "export_fleet",
+        "straggler_report",
+        "degraded_processes",
+        "metrics_tpu_straggler",
+        "flow arrows",
+        "make trace-check",
+        "check_trace.py",
+        "transport=\"handshake\"",
+    ):
+        assert phrase in obs, phrase
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "observability.md#fleet-tracing--straggler-diagnostics" in perf
+    assert "export_fleet" in perf and "degraded_processes" in perf
+
+
 def test_observability_page_cross_linked():
     """The page must be reachable from the performance guide and the README
     (the two places a user hunting for runtime numbers starts from)."""
