@@ -52,7 +52,9 @@ trace-check:
 
 # Perf-regression gate (scripts/bench_regress.py): the latest committed
 # BENCH_r*.json capture must stay within tolerance of the per-config
-# baselines fitted from the prior rounds (degraded/rerun records excluded).
+# baselines fitted from the prior rounds (degraded/rerun records excluded),
+# and the committed MULTICHIP_r*.json dryrun trajectory must stay healthy
+# (latest rc judged against the prior healthy rounds) — one table, one gate.
 bench-regress:
 	python scripts/bench_regress.py --check
 

@@ -913,6 +913,45 @@ class MetricCollection:
                     m._set_states(cache)
                 m._to_sync = prev_to_sync
 
+    def compute_async(
+        self,
+        *,
+        on_degraded: str = "retry",
+        round_timeout_s: Optional[float] = None,
+        max_retries: Optional[int] = None,
+        backoff_s: Optional[float] = None,
+    ) -> Any:
+        """Epoch-end :meth:`compute` with the packed gather OFF the step path.
+
+        Snapshots the whole collection into a detached shadow clone (compute
+        groups, class aliases and the packed ONE-descriptor+ONE-payload
+        transport all apply inside the shadow exactly as in :meth:`compute`)
+        and runs the transport rounds on the background sync engine,
+        overlapped with subsequent ``update()``/``forward()`` steps on the
+        live collection. Returns a
+        :class:`~metrics_tpu.utilities.async_sync.SyncFuture` resolving to
+        the same ``{name: value}`` dict a synchronous :meth:`compute` at the
+        snapshot moment would return. ``on_degraded`` /
+        ``round_timeout_s`` select the degraded-link policy exactly as in
+        :meth:`Metric.compute_async`; the same cross-process collective
+        discipline applies. ``compute()`` stays the synchronous path.
+        """
+        from metrics_tpu.utilities.async_sync import get_engine
+
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "compute_async_calls")
+        shadow = self.clone()
+        # per-attempt clone: an orphaned (timed-out) transport attempt must
+        # not race a retry on shared shadow state — see Metric.compute_async
+        return get_engine().submit(
+            self.telemetry_key,
+            lambda: shadow.clone().compute(),
+            on_degraded=on_degraded,
+            round_timeout_s=round_timeout_s,
+            max_retries=max_retries,
+            backoff_s=backoff_s,
+        )
+
     def _adopt_packed_synced_states(self, adopted: list) -> None:
         """Sync every packable member's states in ONE packed transport per
         gather group and point the members at the synced values; appends

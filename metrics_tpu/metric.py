@@ -526,7 +526,7 @@ class Metric(ABC):
             with self._bound_state(state):
                 return self._unwrapped_compute()
 
-    def sync_state(self, state: StateDict, axis_name: Any) -> StateDict:
+    def sync_state(self, state: StateDict, axis_name: Any, levels: Any = None) -> StateDict:
         """In-graph sync of a state pytree over ``axis_name`` (no compute);
         ``None`` returns the state untouched. Exposed so a caller holding
         several metrics with IDENTICAL states (a shared-update equivalence
@@ -536,12 +536,17 @@ class Metric(ABC):
         Lowers through the bucketed engine
         (:func:`~metrics_tpu.utilities.distributed.sync_state_packed`): one
         collective per (kind, dtype) bucket instead of one per state leaf;
-        callable custom reductions keep the per-leaf gather."""
+        callable custom reductions keep the per-leaf gather. A hierarchical
+        spec — ``levels=[("ici", intra_axis), ("dcn", inter_axis)]``, or a
+        :class:`~metrics_tpu.utilities.distributed.Hierarchy` passed as
+        ``axis_name`` (e.g. the metric's ``process_group``) — lowers each
+        bucket two-level instead: reduce within-host over ICI first, then
+        across hosts over DCN, one collective per (level, kind, dtype)."""
         if axis_name is None:
             return state
         with compiled_scope(f"{self.__class__.__name__}.sync"):
             try:
-                return sync_state_packed(state, self._reductions, axis_name)
+                return sync_state_packed(state, self._reductions, axis_name, levels=levels)
             except NameError as err:  # unbound collective axis
                 raise NameError(
                     f"{err}. This metric declares process_group={self.process_group!r}, which is"
@@ -1300,6 +1305,55 @@ class Metric(ABC):
         yield
         if cache and restore_cache:
             self._set_states(cache)
+
+    def compute_async(
+        self,
+        *,
+        on_degraded: str = "retry",
+        round_timeout_s: Optional[float] = None,
+        max_retries: Optional[int] = None,
+        backoff_s: Optional[float] = None,
+    ) -> "Any":
+        """Epoch-end compute with the cross-process gather OFF the step path.
+
+        Snapshots the current states into a detached shadow copy (one state
+        copy — the same once-per-epoch cost the donation discipline already
+        pays at ``reset()``; the live metric is never touched again) and
+        hands the descriptor+payload gather rounds to the background sync
+        engine (:mod:`metrics_tpu.utilities.async_sync`), overlapped with
+        whatever ``update()``/``forward()`` steps follow. Returns a
+        :class:`~metrics_tpu.utilities.async_sync.SyncFuture` whose
+        ``result()`` is exactly what a synchronous :meth:`compute` at the
+        snapshot moment would have returned; ``compute()`` itself is
+        untouched and stays the synchronous path.
+
+        ``on_degraded`` picks the degraded-link policy the engine applies
+        when :func:`~metrics_tpu.observability.tracing.degraded_processes`
+        flags peers or a transport round times out (``round_timeout_s``):
+        ``"retry"`` (bounded backoff), ``"stale"`` (serve the last completed
+        generation, ``future.stale=True``), or ``"quorum"`` (reduce over the
+        healthy subgroup via the existing group plumbing). **Collective
+        discipline applies across processes**: every process must submit the
+        same ``compute_async`` calls in the same order, exactly as for
+        ``compute()`` — the engine's FIFO worker preserves that order.
+        """
+        from metrics_tpu.utilities.async_sync import get_engine
+
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "compute_async_calls")
+        shadow = self.clone()
+        # each policy ATTEMPT computes on its own clone of the snapshot: a
+        # timed-out transport round cannot be cancelled, only orphaned, and
+        # the orphan must not race the retry on shared state (the per-attempt
+        # clone runs on the worker, off the hot path)
+        return get_engine().submit(
+            self.telemetry_key,
+            lambda: shadow.clone().compute(),
+            on_degraded=on_degraded,
+            round_timeout_s=round_timeout_s,
+            max_retries=max_retries,
+            backoff_s=backoff_s,
+        )
 
     # ------------------------------------------------------------------
     # lifecycle

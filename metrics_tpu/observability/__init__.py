@@ -114,15 +114,20 @@ def disable() -> None:
 
 def reset() -> None:
     """Clear all recorded counters, timers, sync stats, retrace ledgers,
-    events, histograms, collective spans, and health records (enablement,
-    policy, step tag survive). Span-id sequence counters reset too — like
-    any collective, reset on every process together or on none."""
+    events, histograms, collective spans, async-sync engine counters, and
+    health records (enablement, policy, step tag survive). Span-id sequence
+    counters and async generations reset too — like any collective, reset
+    on every process together or on none."""
     TELEMETRY.reset()
     MONITOR.reset()
     EVENTS.clear()
     HEALTH.reset()
     HISTOGRAMS.reset()
     TRACER.clear()
+    from metrics_tpu.utilities import async_sync as _async_sync
+
+    if _async_sync._ENGINE is not None:
+        _async_sync._ENGINE.reset()
 
 
 __all__ = [

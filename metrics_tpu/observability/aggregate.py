@@ -80,6 +80,11 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     ("tracing.dropped", "sum"),
     ("tracing.by_kind.*", "sum"),
     ("tracing.*", "last"),
+    # background sync engine: outcome counters sum; generations are per-key
+    # monotonic watermarks (max), the live flag ORs
+    ("async_sync.engine_alive", "any"),
+    ("async_sync.generations.*", "max"),
+    ("async_sync.*", "sum"),
     # fast-path histograms (percentiles recomputed after the bucket merge)
     ("histograms.*.buckets.*", "sum"),
     ("histograms.*.count", "sum"),

@@ -60,6 +60,17 @@ _HELP: Dict[str, str] = {
     "straggler_wait_seconds_total": "Time a process spent waiting for its slowest peer.",
     "straggler_transfer_seconds_total": "Post-barrier transfer time attributed to a process.",
     "straggler_flagged": "1 when the latest report flags the process as persistently slow.",
+    "sync_transport_gathers_total": "Eager gather transports per level label (gather=inline, dcn=async engine).",
+    "sync_in_graph_level_syncs_total": "Hierarchical in-graph sync lowerings per level label (ici/dcn).",
+    "async_sync_submitted_total": "Background syncs submitted to the async engine.",
+    "async_sync_completed_total": "Background syncs resolved (fresh or stale).",
+    "async_sync_failed_total": "Background syncs that exhausted their degraded-link policy.",
+    "async_sync_retries_total": "Transport attempts the retry policy re-issued.",
+    "async_sync_timeouts_total": "Transport rounds that exceeded their round timeout.",
+    "async_sync_stale_serves_total": "Futures served from the last completed generation (stale policy).",
+    "async_sync_quorum_syncs_total": "Background syncs reduced over the healthy subgroup (quorum policy).",
+    "async_sync_degraded_rounds_total": "Transport rounds started with flagged degraded peers.",
+    "async_sync_in_flight": "Background syncs queued or running right now.",
 }
 
 
@@ -90,10 +101,17 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
           "tracing": {"enabled": bool, "capacity": int, "size": int,
                       "recorded_total": int, "dropped": int,
                       "by_kind": {...}, "straggler": <fleet report or null>},
+          "async_sync": {"engine_alive": bool, "in_flight": int,
+                         "submitted": int, "completed": int, "failed": int,
+                         "retries": int, "timeouts": int, "stale_serves": int,
+                         "quorum_syncs": int, "degraded_rounds": int,
+                         "generations": {key: int}},
         }
 
-    Always JSON-serializable (``json.dumps(snapshot())`` round-trips), and
-    mergeable across processes by the declared reductions — see
+    ``async_sync`` is ``{}`` until the first ``compute_async`` constructs
+    the background engine. Always JSON-serializable
+    (``json.dumps(snapshot())`` round-trips), and mergeable across processes
+    by the declared reductions — see
     :func:`~metrics_tpu.observability.aggregate.aggregate_snapshots`.
     """
     snap = TELEMETRY.snapshot(include_timers=include_timers)
@@ -103,6 +121,9 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
     snap["health"] = HEALTH.summary()
     snap["histograms"] = HISTOGRAMS.snapshot()
     snap["tracing"] = TRACER.summary()
+    from metrics_tpu.utilities import async_sync as _async_sync
+
+    snap["async_sync"] = _async_sync.summary()
     return snap
 
 
@@ -227,14 +248,38 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
     ):
         if field in sync:
             out.emit(f"sync_{field}_total", base, sync[field], "counter")
+    for transport, n in sorted(sync.get("transports", {}).items()):
+        out.emit(
+            "sync_transport_gathers_total", {**base, "transport": transport}, n, "counter"
+        )
     in_graph = sync.get("in_graph", {})
     for kind, n in sorted(in_graph.get("collectives", {}).items()):
         out.emit("sync_in_graph_collectives_total", {**base, "kind": kind}, n, "counter")
     for bucket, n in sorted(in_graph.get("buckets", {}).items()):
         out.emit("sync_in_graph_bucket_states_total", {**base, "bucket": bucket}, n, "counter")
+    for level, n in sorted(in_graph.get("levels", {}).items()):
+        out.emit("sync_in_graph_level_syncs_total", {**base, "level": level}, n, "counter")
     for field in ("collectives_before", "collectives_after", "dedup_groups", "dedup_members"):
         if field in in_graph:
             out.emit(f"sync_in_graph_{field}_total", base, in_graph[field], "counter")
+
+    async_sync = snap.get("async_sync", {})
+    if async_sync:
+        # the background sync engine's family: policy outcomes are counters,
+        # the queue depth a gauge (per-key generations stay in the JSON blob)
+        for field in (
+            "submitted",
+            "completed",
+            "failed",
+            "retries",
+            "timeouts",
+            "stale_serves",
+            "quorum_syncs",
+            "degraded_rounds",
+        ):
+            if field in async_sync:
+                out.emit(f"async_sync_{field}_total", base, async_sync[field], "counter")
+        out.emit("async_sync_in_flight", base, async_sync.get("in_flight", 0))
 
     events = snap.get("events", {})
     if events:

@@ -23,7 +23,7 @@ Design constraints, in order:
 """
 import threading
 import weakref
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 #: histogram bucket upper bounds (seconds) for eager wall-time observations;
 #: log-spaced from 10 µs to 1 s, with +inf implicit
@@ -72,6 +72,10 @@ def _fresh_sync_stats() -> Dict[str, Any]:
         # decomposition (observability/tracing.py) gives per-collective detail
         "descriptor_seconds": 0.0,
         "payload_seconds": 0.0,
+        # gathers per transport label ("gather" inline; "dcn" for the async
+        # engine's cross-host legs — utilities/distributed.py
+        # transport_overrides), so the sync volume splits by level
+        "transports": {},
         "groups": {},
         # in-graph (trace-time) collective composition — sync_in_graph /
         # sync_state_packed. "collectives" counts STATES per collective kind;
@@ -92,6 +96,10 @@ def _fresh_sync_stats() -> Dict[str, Any]:
             # classes), and how many member states they served in total
             "dedup_groups": 0,
             "dedup_members": 0,
+            # hierarchical lowerings: syncs per level label ("ici"/"dcn"),
+            # so the two-level bucket composition is visible at a glance
+            # (the per-level bucket detail lives under "buckets")
+            "levels": {},
         },
     }
 
@@ -188,19 +196,23 @@ class TelemetryRegistry:
         leaves: int = 1,
         descriptor_s: float = 0.0,
         payload_s: float = 0.0,
+        transport: str = "gather",
     ) -> None:
         """One completed ``gather_all_arrays``/``gather_all_pytrees``
         transport (host sync path). ``leaves`` is how many state arrays the
         packed descriptor/payload rounds carried — the bundling win is
         ``gather_leaves / gathers`` leaves per transport.
         ``descriptor_s``/``payload_s`` split the transport's wall time into
-        its two collective rounds."""
+        its two collective rounds; ``transport`` is the level label
+        (``"gather"`` inline, ``"dcn"`` for the async engine's cross-host
+        legs)."""
         if not self._enabled:
             return
         group_label = ",".join(str(m) for m in members)
         with self._lock:
             s = self._sync
             s["gathers"] += 1
+            s["transports"][transport] = s["transports"].get(transport, 0) + 1
             if error:
                 s["gather_errors"] += 1
             s["gather_leaves"] += int(leaves)
@@ -225,14 +237,17 @@ class TelemetryRegistry:
         collectives_before: int = 0,
         collectives_after: int = 0,
         groups: Optional[Dict[str, int]] = None,
+        levels: Optional[List[str]] = None,
     ) -> None:
         """Trace-time record of one ``sync_in_graph``/``sync_state_packed``
         lowering: which XLA collectives the state bundle compiles to, the
         (pre-collective) payload size, the packed bucket composition
-        (``"<kind>/<dtype>" -> state count``), the per-leaf vs issued
-        collective counts, and the deduped-bundle composition (``groups``:
-        bundle label -> member count it serves — compute groups and
-        shared-update classes). Runs once per trace, never per step."""
+        (``"<kind>/<dtype>" -> state count``; ``"<level>/<kind>/<dtype>"``
+        when hierarchical), the per-leaf vs issued collective counts, the
+        deduped-bundle composition (``groups``: bundle label -> member count
+        it serves — compute groups and shared-update classes), and the
+        hierarchy's level labels when the lowering was two-level. Runs once
+        per trace, never per step."""
         if not self._enabled:
             return
         with self._lock:
@@ -242,6 +257,8 @@ class TelemetryRegistry:
             ig["bytes_traced"] += int(bytes_traced)
             ig["collectives_before"] += int(collectives_before)
             ig["collectives_after"] += int(collectives_after)
+            for lvl in levels or ():
+                ig["levels"][lvl] = ig["levels"].get(lvl, 0) + 1
             for n in (groups or {}).values():
                 ig["dedup_groups"] += 1
                 ig["dedup_members"] += int(n)
@@ -307,6 +324,7 @@ class TelemetryRegistry:
                 for k, v in self._sync.items()
             }
             sync["groups"] = {k: dict(v) for k, v in self._sync["groups"].items()}
+            sync["transports"] = dict(self._sync["transports"])
             ig = self._sync["in_graph"]
             sync["in_graph"] = {
                 "syncs": ig["syncs"],
@@ -319,6 +337,7 @@ class TelemetryRegistry:
                 "collectives_after": ig["collectives_after"],
                 "dedup_groups": ig["dedup_groups"],
                 "dedup_members": ig["dedup_members"],
+                "levels": dict(ig["levels"]),
             }
         # state memory reads live objects outside the lock (it may touch
         # arbitrary metric code)

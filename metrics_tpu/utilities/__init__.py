@@ -1,5 +1,11 @@
 from metrics_tpu.utilities.data import apply_to_collection  # noqa: F401
-from metrics_tpu.utilities.distributed import class_reduce, reduce  # noqa: F401
+from metrics_tpu.utilities.distributed import (  # noqa: F401
+    Hierarchy,
+    class_reduce,
+    hierarchical_axis,
+    reduce,
+    transport_overrides,
+)
 from metrics_tpu.utilities.prints import (  # noqa: F401
     rank_zero_debug,
     rank_zero_info,

@@ -1,0 +1,477 @@
+"""Background async sync engine: epoch-end gathers off the step critical path.
+
+The eager epoch sync (``Metric.compute()`` / ``MetricCollection.compute()``)
+is a blocking descriptor+payload transport round-trip — ~100 µs of link RTT
+per round on the benched TPU tunnel, and unboundedly worse on a degraded
+link. This module moves it onto a worker thread:
+
+* :meth:`Metric.compute_async` / :meth:`MetricCollection.compute_async`
+  snapshot the live state into a detached shadow copy on the caller thread
+  (jax arrays are immutable, so the snapshot is one state copy — the same
+  once-per-epoch cost the donation discipline already pays at ``reset()``;
+  the live metric keeps updating, donation intact) and submit the shadow's
+  ``compute()`` to the engine. The returned :class:`SyncFuture` resolves to
+  exactly what the synchronous ``compute()`` would have returned at the
+  snapshot moment, while subsequent ``update()``/``forward()`` steps overlap
+  the transfer. ``compute()`` itself is untouched.
+
+* **Degraded-link policies** (``on_degraded=``): before each transport
+  attempt the engine consults
+  :func:`~metrics_tpu.observability.tracing.degraded_processes` — the PR-8
+  straggler trigger — and applies per-round timeouts
+  (``round_timeout_s``). On a degraded peer or a timed-out round:
+
+  - ``"retry"`` — bounded exponential backoff (``max_retries``,
+    ``backoff_s``), for transient link wobbles;
+  - ``"stale"`` — serve the last **completed generation**'s value
+    immediately, flagged ``future.stale=True`` and counted
+    (``stale_serves``): a dashboard metric a few seconds old beats a step
+    loop stalled on a sick link;
+  - ``"quorum"`` — reduce over the healthy subgroup through the existing
+    group plumbing
+    (:func:`~metrics_tpu.utilities.distributed.transport_overrides`
+    ``quorum=``): the flagged peers' contributions are excluded exactly as
+    an explicit ``group=`` argument would exclude them.
+
+* **Generation counter.** Every submission under one telemetry key gets a
+  monotonically increasing generation; the engine retains the latest
+  completed ``(generation, value)`` per key. That is what the stale policy
+  serves, what guards a late-arriving superseded round from overwriting a
+  newer result, and what ``future.generation`` reports.
+
+**Collective discipline applies across processes**: transport rounds are
+global collectives, so every process must submit the same ``compute_async``
+calls in the same order (exactly the rule synchronous ``compute()`` already
+imposes), and inline gathers must not interleave differently between
+processes while a job is in flight. The engine's single FIFO worker
+preserves submission order; the per-round timeout exists precisely because a
+desynced or dead peer otherwise hangs the round forever.
+
+Everything here is host-side: the engine adds zero traced ops
+(``scripts/check_zero_overhead.py`` pins the hot-path jaxprs byte-identical
+with the engine constructed and running), and its counters surface in
+``observability.snapshot()["async_sync"]`` and the
+``metrics_tpu_async_sync_*`` Prometheus family.
+"""
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+#: default bounded-backoff parameters for the "retry" policy
+DEFAULT_MAX_RETRIES = 2
+DEFAULT_BACKOFF_S = 0.05
+
+#: the selectable degraded-link policies
+POLICIES = ("retry", "stale", "quorum")
+
+
+class AsyncSyncError(RuntimeError):
+    """A background sync exhausted its policy (retries spent, no stale
+    generation to serve, quorum round failed)."""
+
+
+class SyncTimeout(AsyncSyncError):
+    """A transport round exceeded its ``round_timeout_s``."""
+
+
+class SyncFuture:
+    """Handle to one in-flight background sync.
+
+    ``result(timeout=None)`` blocks until the engine resolves the job and
+    returns the computed value (or raises the job's terminal error);
+    ``done()`` polls without blocking. ``stale`` is True when the degraded
+    -link policy served the previous completed generation instead of a fresh
+    sync; ``generation`` is the submission's per-key generation;
+    ``attempts`` counts transport attempts the policy spent.
+    """
+
+    def __init__(self, key: str, generation: int, policy: str) -> None:
+        self.key = key
+        self.generation = generation
+        self.policy = policy
+        self.stale = False
+        self.attempts = 0
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"background sync of {self.key} (generation {self.generation}) still"
+                f" in flight after {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def exception(self, timeout: Optional[float] = None) -> Optional[BaseException]:
+        """The job's terminal error (None on success); blocks like
+        :meth:`result`."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"background sync of {self.key} (generation {self.generation}) still"
+                f" in flight after {timeout}s"
+            )
+        return self._error
+
+    def _resolve(self, value: Any, *, stale: bool = False) -> None:
+        self._value = value
+        self.stale = stale
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def __repr__(self) -> str:
+        state = "done" if self.done() else "pending"
+        return (
+            f"SyncFuture({self.key}, generation={self.generation},"
+            f" policy={self.policy!r}, {state})"
+        )
+
+
+class _Job:
+    __slots__ = (
+        "future", "thunk", "on_degraded", "round_timeout_s", "max_retries", "backoff_s"
+    )
+
+    def __init__(self, future, thunk, on_degraded, round_timeout_s, max_retries, backoff_s):
+        self.future = future
+        self.thunk = thunk
+        self.on_degraded = on_degraded
+        self.round_timeout_s = round_timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+
+
+def _degraded() -> List[int]:
+    """The PR-8 straggler trigger, guarded (tracing is optional here)."""
+    try:
+        from metrics_tpu.observability.tracing import degraded_processes
+
+        return degraded_processes()
+    except Exception:  # pragma: no cover - diagnostics must not break a sync
+        return []
+
+
+class AsyncSyncEngine:
+    """Single-worker FIFO engine running background sync jobs.
+
+    One process-global instance (:func:`get_engine`) backs
+    ``compute_async``; private instances are supported for tests. The worker
+    thread starts lazily on the first submission and is a daemon — an idle
+    engine holds no thread at import, and process exit never blocks on it.
+    FIFO matters: it is what keeps engine-issued collectives in the same
+    order on every process (the collective-discipline invariant).
+    """
+
+    def __init__(
+        self,
+        *,
+        max_retries: int = DEFAULT_MAX_RETRIES,
+        backoff_s: float = DEFAULT_BACKOFF_S,
+        round_timeout_s: Optional[float] = None,
+    ) -> None:
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.round_timeout_s = round_timeout_s
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[_Job] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._in_flight = 0
+        self._generations: Dict[str, int] = {}
+        self._last: Dict[str, Any] = {}  # key -> (generation, value)
+        self._counters: Dict[str, int] = {
+            "submitted": 0,
+            "completed": 0,
+            "failed": 0,
+            "retries": 0,
+            "timeouts": 0,
+            "stale_serves": 0,
+            "quorum_syncs": 0,
+            "degraded_rounds": 0,
+        }
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(
+        self,
+        key: str,
+        thunk: Callable[[], Any],
+        *,
+        on_degraded: str = "retry",
+        round_timeout_s: Optional[float] = None,
+        max_retries: Optional[int] = None,
+        backoff_s: Optional[float] = None,
+    ) -> SyncFuture:
+        """Queue ``thunk`` (a self-contained sync+compute over a detached
+        state snapshot) and return its :class:`SyncFuture`. Per-job
+        ``round_timeout_s``/``max_retries``/``backoff_s`` override the engine
+        defaults."""
+        if on_degraded not in POLICIES:
+            raise ValueError(
+                f"on_degraded must be one of {POLICIES}, got {on_degraded!r}"
+            )
+        with self._lock:
+            generation = self._generations.get(key, 0) + 1
+            self._generations[key] = generation
+            future = SyncFuture(key, generation, on_degraded)
+            self._queue.append(
+                _Job(
+                    future,
+                    thunk,
+                    on_degraded,
+                    self.round_timeout_s if round_timeout_s is None else round_timeout_s,
+                    self.max_retries if max_retries is None else int(max_retries),
+                    self.backoff_s if backoff_s is None else float(backoff_s),
+                )
+            )
+            self._counters["submitted"] += 1
+            self._in_flight += 1
+            self._ensure_worker()
+            self._cv.notify()
+        return future
+
+    def _ensure_worker(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stopping = False
+            self._thread = threading.Thread(
+                target=self._worker, name="metrics-tpu-async-sync", daemon=True
+            )
+            self._thread.start()
+
+    # -- the worker ---------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopping:
+                    self._cv.wait()
+                if self._stopping and not self._queue:
+                    return
+                job = self._queue.pop(0)
+            try:
+                self._run_job(job)
+            finally:
+                with self._lock:
+                    self._in_flight -= 1
+
+    def _attempt(self, thunk: Callable[[], Any], timeout: Optional[float]) -> Any:
+        """One transport attempt under the per-round timeout.
+
+        The timeout runs the thunk on a helper thread and abandons it on
+        expiry — a hung collective cannot be cancelled, only orphaned; the
+        orphan operates on the job's detached shadow state, so a late
+        completion mutates nothing the caller can observe and its result is
+        discarded."""
+        if timeout is None:
+            return thunk()
+        box: Dict[str, Any] = {}
+
+        def run() -> None:
+            try:
+                box["value"] = thunk()
+            except BaseException as err:  # noqa: BLE001 - relayed to the policy
+                box["error"] = err
+
+        helper = threading.Thread(target=run, daemon=True)
+        helper.start()
+        helper.join(timeout)
+        if helper.is_alive():
+            with self._lock:
+                self._counters["timeouts"] += 1
+            raise SyncTimeout(f"transport round exceeded round_timeout_s={timeout}")
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    def _serve_stale(self, job: _Job, reason: str) -> bool:
+        """Resolve the job from the last completed generation (the "stale"
+        policy); False when no generation has ever completed for the key."""
+        with self._lock:
+            last = self._last.get(job.future.key)
+            if last is None:
+                return False
+            self._counters["stale_serves"] += 1
+            self._counters["completed"] += 1
+        generation, value = last
+        job.future._resolve(value, stale=True)
+        self._record_event(
+            job, outcome="stale", reason=reason, served_generation=generation
+        )
+        return True
+
+    def _run_job(self, job: _Job) -> None:
+        future = job.future
+        attempt = 0
+        while True:
+            degraded = _degraded()
+            quorum: Optional[List[int]] = None
+            if degraded:
+                with self._lock:
+                    self._counters["degraded_rounds"] += 1
+                if job.on_degraded == "stale" and self._serve_stale(
+                    job, reason=f"degraded peers {degraded}"
+                ):
+                    return
+                if job.on_degraded == "quorum":
+                    quorum = self._healthy_subgroup(degraded)
+            try:
+                future.attempts = attempt + 1
+                from metrics_tpu.utilities.distributed import transport_overrides
+
+                if quorum is not None:
+                    with self._lock:
+                        self._counters["quorum_syncs"] += 1
+                    with transport_overrides(quorum=quorum, transport_label="dcn"):
+                        value = self._attempt(job.thunk, job.round_timeout_s)
+                else:
+                    with transport_overrides(transport_label="dcn"):
+                        value = self._attempt(job.thunk, job.round_timeout_s)
+            except BaseException as err:  # noqa: BLE001 - the policy decides
+                if job.on_degraded == "stale" and self._serve_stale(
+                    job, reason=f"{type(err).__name__}: {err}"
+                ):
+                    return
+                if job.on_degraded in ("retry", "quorum") and attempt < job.max_retries:
+                    attempt += 1
+                    with self._lock:
+                        self._counters["retries"] += 1
+                    time.sleep(job.backoff_s * (2 ** (attempt - 1)))
+                    continue
+                with self._lock:
+                    self._counters["failed"] += 1
+                if isinstance(err, AsyncSyncError):
+                    future._fail(err)
+                else:
+                    future._fail(
+                        AsyncSyncError(
+                            f"background sync of {future.key} failed after"
+                            f" {attempt + 1} attempt(s): {type(err).__name__}: {err}"
+                        )
+                    )
+                self._record_event(job, outcome="failed", reason=f"{type(err).__name__}: {err}")
+                return
+            with self._lock:
+                self._counters["completed"] += 1
+                prev = self._last.get(future.key)
+                # a late round never overwrites a newer completed generation
+                if prev is None or prev[0] < future.generation:
+                    self._last[future.key] = (future.generation, value)
+            future._resolve(value)
+            self._record_event(
+                job,
+                outcome="quorum" if quorum is not None else "completed",
+                quorum=quorum,
+            )
+            return
+
+    @staticmethod
+    def _healthy_subgroup(degraded: List[int]) -> List[int]:
+        from metrics_tpu.utilities.distributed import world_size
+
+        sick = {int(p) for p in degraded}
+        healthy = [p for p in range(world_size()) if p not in sick]
+        return healthy or list(range(world_size()))  # never an empty quorum
+
+    def _record_event(self, job: _Job, *, outcome: str, **payload: Any) -> None:
+        """One ``sync`` event per finished background job (host-side; never
+        raises)."""
+        try:
+            from metrics_tpu.observability.events import EVENTS
+
+            if EVENTS.enabled:
+                EVENTS.record(
+                    "sync",
+                    job.future.key,
+                    path="async",
+                    policy=job.on_degraded,
+                    outcome=outcome,
+                    generation=job.future.generation,
+                    attempts=job.future.attempts,
+                    stale=job.future.stale,
+                    **{k: v for k, v in payload.items() if v is not None},
+                )
+        except Exception:  # pragma: no cover - telemetry must not break a sync
+            pass
+
+    # -- reading / lifecycle ------------------------------------------------
+
+    def last_generation(self, key: str) -> int:
+        """The latest completed generation for ``key`` (0 when none)."""
+        with self._lock:
+            last = self._last.get(key)
+            return last[0] if last else 0
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact JSON view for ``snapshot()["async_sync"]``."""
+        with self._lock:
+            return {
+                "engine_alive": bool(self._thread is not None and self._thread.is_alive()),
+                "in_flight": self._in_flight,
+                "generations": {k: g for k, g in self._generations.items()},
+                **dict(self._counters),
+            }
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued job has finished; False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._in_flight == 0:
+                    return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.001)
+
+    def reset(self) -> None:
+        """Clear counters, generations and retained values (queued jobs keep
+        running). Like the span tracker's clear: generations are part of the
+        cross-process contract — reset on every process together or on
+        none."""
+        with self._lock:
+            self._generations.clear()
+            self._last.clear()
+            for k in self._counters:
+                self._counters[k] = 0
+
+    def shutdown(self, timeout: Optional[float] = 1.0) -> None:
+        """Stop the worker after the queue drains (mainly for tests)."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout)
+
+
+#: the process-global engine, constructed lazily (import must stay cheap and
+#: thread-free for the zero-overhead discipline)
+_ENGINE: Optional[AsyncSyncEngine] = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def get_engine() -> AsyncSyncEngine:
+    """The process-global background sync engine (created on first use)."""
+    global _ENGINE
+    if _ENGINE is None:
+        with _ENGINE_LOCK:
+            if _ENGINE is None:
+                _ENGINE = AsyncSyncEngine()
+    return _ENGINE
+
+
+def summary() -> Dict[str, Any]:
+    """The global engine's compact view — ``{}`` when nothing ever submitted
+    (the snapshot stays clean for processes that never used
+    ``compute_async``)."""
+    if _ENGINE is None:
+        return {}
+    return _ENGINE.summary()

@@ -33,9 +33,22 @@ fusion, applied to metric state:
   rounds per leaf per metric, while preserving the deadlock-safety invariants
   (fixed collective count per rank, 0-length placeholder alignment, deferred
   group-error raising).
+
+The in-graph packed engine additionally ships a **hierarchical** mode
+(:class:`Hierarchy` / the ``levels=`` argument of :func:`sync_state_packed`):
+at pod scale a single flat collective pushes every byte over the slowest
+link, so each packed bucket instead lowers to one collective per *level* —
+reduce within-host over ICI first, then across hosts over DCN — the metric
+-state analogue of Horovod's hierarchical allreduce / NCCL tree reductions.
+One collective per **(level, kind, dtype)** bucket, results identical to the
+flat sync (bit-identical for integer/extremal reductions and gathers, which
+is what metric states overwhelmingly are; rounding float sums agree up to
+reassociation of the level partials, ≤1 ulp).
 """
+import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -120,6 +133,134 @@ def _process_allgather(x: Array) -> Array:
     return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
 
 
+class Hierarchy:
+    """Multi-level mesh-axis spec for hierarchical (two-level) bucketed sync.
+
+    ``Hierarchy(("ici", "intra"), ("dcn", "inter"))`` names the levels a
+    packed sync reduces over, **innermost first**: level 0 is the within-host
+    ICI axis (reduced/gathered first), the last level the cross-host DCN axis.
+    Each level's axis may itself be a tuple of mesh axes. Usable anywhere an
+    ``axis_name`` is accepted — ``Metric(process_group=...)``,
+    ``apply_compute(axis_name=...)``, :meth:`Metric.sync_state`, the
+    collection presync — and :func:`sync_state_packed` lowers each packed
+    bucket to one collective per level instead of one flat collective.
+
+    :attr:`flat` is the equivalent flat axis tuple (**outermost first**):
+    hierarchical results are ordered identically to a flat sync over
+    ``hierarchy.flat`` (gathers stack outer-major, exactly as
+    ``lax.all_gather`` over the tuple does). Per-leaf paths
+    (:func:`sync_in_graph`, callable custom reductions) lower over
+    :attr:`flat` directly — hierarchy is a packed-engine optimization, never
+    a semantic change.
+    """
+
+    __slots__ = ("levels",)
+
+    def __init__(self, *levels: Tuple[str, Any]) -> None:
+        if len(levels) == 1 and isinstance(levels[0], (list, tuple)) and levels[0] \
+                and isinstance(levels[0][0], (list, tuple)):
+            levels = tuple(levels[0])  # Hierarchy([("ici", a), ("dcn", b)])
+        norm: List[Tuple[str, Any]] = []
+        for entry in levels:
+            try:
+                label, axis = entry
+            except (TypeError, ValueError):
+                raise TypeError(
+                    f"each hierarchy level must be a (label, axis) pair, got {entry!r}"
+                )
+            norm.append((str(label), tuple(axis) if isinstance(axis, (list, tuple)) else axis))
+        if len(norm) < 2:
+            raise ValueError(
+                f"a Hierarchy needs at least 2 levels (got {len(norm)}); use the plain"
+                " axis name for single-level sync"
+            )
+        labels = [label for label, _ in norm]
+        if len(set(labels)) != len(labels):
+            raise ValueError(f"hierarchy level labels must be unique, got {labels}")
+        object.__setattr__(self, "levels", tuple(norm))
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        raise AttributeError("Hierarchy is immutable")
+
+    @property
+    def flat(self) -> Tuple[str, ...]:
+        """The equivalent flat axis tuple, outermost level first."""
+        axes: List[str] = []
+        for _, axis in reversed(self.levels):
+            axes.extend(axis if isinstance(axis, tuple) else (axis,))
+        return tuple(axes)
+
+    @classmethod
+    def from_mesh(cls, mesh: Any, intra: str, inter: str) -> "Hierarchy":
+        """The canonical two-level spec from a mesh's axis names: ``intra``
+        is the within-host (ICI) axis, ``inter`` the cross-host (DCN) axis.
+        Validates both axes exist on ``mesh``."""
+        names = tuple(getattr(mesh, "axis_names", ()))
+        for axis in (intra, inter):
+            if axis not in names:
+                raise ValueError(f"mesh {names} has no axis {axis!r}")
+        return cls(("ici", intra), ("dcn", inter))
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{label}={axis!r}" for label, axis in self.levels)
+        return f"Hierarchy({inner})"
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Hierarchy) and self.levels == other.levels
+
+    def __hash__(self) -> int:
+        return hash(self.levels)
+
+    def __reduce__(self):
+        return (Hierarchy, tuple(self.levels))
+
+
+def hierarchical_axis(intra: Any, inter: Any) -> Hierarchy:
+    """The canonical two-level spec: ``intra`` (within-host ICI axis, reduced
+    first) then ``inter`` (cross-host DCN axis) — shorthand for
+    ``Hierarchy(("ici", intra), ("dcn", inter))``."""
+    return Hierarchy(("ici", intra), ("dcn", inter))
+
+
+#: thread-scoped overrides for the eager gather transport (the async sync
+#: engine's hooks; see :func:`transport_overrides`)
+_EAGER_OVERRIDES = threading.local()
+
+
+@contextmanager
+def transport_overrides(
+    *, quorum: Optional[Sequence[int]] = None, transport_label: Optional[str] = None
+):
+    """Thread-scoped overrides for the eager gather transport.
+
+    ``quorum`` restricts the decode/reduce membership of every gather issued
+    on this thread to the given process indices — the degraded-link
+    ``on_degraded="quorum"`` policy's hook: the underlying transport round
+    still spans all processes (it is a global collective), but only the
+    healthy subgroup's contributions enter the result, exactly as an explicit
+    ``group=`` argument would select (the existing group plumbing). A quorum
+    never widens a group: it intersects with whatever group each gather
+    names. ``transport_label`` relabels the round-trip telemetry (histogram
+    ``transport=`` label, sync events) so the async engine's cross-host DCN
+    legs are distinguishable from inline gathers.
+
+    Overrides nest; each ``with`` block restores the previous values. They
+    are deliberately **thread-local**: the background sync engine's worker
+    applies its policy without perturbing inline syncs on other threads.
+    """
+    prev_quorum = getattr(_EAGER_OVERRIDES, "quorum", None)
+    prev_label = getattr(_EAGER_OVERRIDES, "transport_label", None)
+    if quorum is not None:
+        _EAGER_OVERRIDES.quorum = sorted({int(i) for i in quorum})
+    if transport_label is not None:
+        _EAGER_OVERRIDES.transport_label = str(transport_label)
+    try:
+        yield
+    finally:
+        _EAGER_OVERRIDES.quorum = prev_quorum
+        _EAGER_OVERRIDES.transport_label = prev_label
+
+
 #: descriptor layout for the ragged gather: [ndim, d0..d7, dtype_code]
 _MAX_GATHER_NDIM = 8
 #: dtypes the ragged gather can align across ranks (code = list index);
@@ -153,10 +294,11 @@ def _resolve_group(group: Optional[Any], nprocs: int) -> List[int]:
 
     ``None`` -> all processes. A collection of ints -> that subgroup (the
     eager analogue of the reference's ``torch.distributed`` group handle,
-    ``utilities/distributed.py:113-135``). Mesh-axis names (a str, or a
-    collection of strs) are the IN-GRAPH sub-group mechanism; on the eager
-    path they cannot name a process subset, so they gather everything —
-    the documented fallback for metrics whose ``process_group`` is an axis.
+    ``utilities/distributed.py:113-135``). Mesh-axis names (a str, a
+    :class:`Hierarchy`, or a collection of strs) are the IN-GRAPH sub-group
+    mechanism; on the eager path they cannot name a process subset, so they
+    gather everything — the documented fallback for metrics whose
+    ``process_group`` is an axis.
     A collection MIXING axis names and indices (e.g. ``("data", 0)``) is
     ambiguous and raises ``TypeError``.
 
@@ -164,7 +306,7 @@ def _resolve_group(group: Optional[Any], nprocs: int) -> List[int]:
     these raises until after its collective rounds so a bad argument on one
     rank cannot hang peers mid-collective.
     """
-    if group is None or isinstance(group, str):
+    if group is None or isinstance(group, (str, Hierarchy)):
         return list(range(nprocs))
     try:
         items = list(group)
@@ -291,6 +433,15 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
     except (TypeError, ValueError) as err:
         arg_error = err
         members = list(range(nprocs))
+    # a thread-scoped quorum (the degraded-link policy hook) narrows the
+    # decoded membership to the healthy subgroup — the transport round still
+    # spans all processes, so collective discipline is untouched
+    quorum = getattr(_EAGER_OVERRIDES, "quorum", None)
+    if quorum is not None:
+        narrowed = [m for m in members if m in quorum]
+        if narrowed:
+            members = narrowed
+    transport_label = getattr(_EAGER_OVERRIDES, "transport_label", None) or "gather"
 
     # collective spans: one deterministic id per transport (and per round)
     # shared by every participating process — the fleet-timeline correlation
@@ -371,6 +522,7 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
         descriptor_s=desc_dur,
         payload_s=payload_dur,
         span_id=span_id,
+        transport=transport_label,
     )
 
     if arg_error is not None:
@@ -494,12 +646,16 @@ def _record_gather_telemetry(
     descriptor_s: float = 0.0,
     payload_s: float = 0.0,
     span_id: Optional[str] = None,
+    transport: str = "gather",
 ) -> None:
     """Record one gather transport into the telemetry registry and the event
     timeline (host-side; the gather itself is already complete).
     ``descriptor_s``/``payload_s`` split the round-trip into its descriptor
     vs payload collective rounds (the span decomposition's raw material);
-    ``span_id`` is the transport's collective span id. Never raises."""
+    ``span_id`` is the transport's collective span id; ``transport`` is the
+    histogram/event label (``"gather"`` inline, ``"dcn"`` for the async
+    engine's cross-host legs — see :func:`transport_overrides`). Never
+    raises."""
     try:
         from metrics_tpu.observability.events import EVENTS
         from metrics_tpu.observability.histogram import (
@@ -514,10 +670,10 @@ def _record_gather_telemetry(
             # fast-path log2 histograms: the transport's full round-trip wall
             # time, its per-round split, and its payload volume (host-side;
             # the gather is complete)
-            observe_sync_round_trip(dur_s, transport="gather")
-            observe_sync_round_trip(descriptor_s, transport="gather_descriptor")
+            observe_sync_round_trip(dur_s, transport=transport)
+            observe_sync_round_trip(descriptor_s, transport=f"{transport}_descriptor")
             if payload_rounds:
-                observe_sync_round_trip(payload_s, transport="gather_payload")
+                observe_sync_round_trip(payload_s, transport=f"{transport}_payload")
             observe_gather_payload(transport_bytes)
             TELEMETRY.record_gather(
                 bytes_out=int(bytes_out),
@@ -531,6 +687,7 @@ def _record_gather_telemetry(
                 leaves=leaves,
                 descriptor_s=descriptor_s,
                 payload_s=payload_s,
+                transport=transport,
             )
         if EVENTS.enabled:
             # the gather rounds on the global timeline: one interval per
@@ -543,7 +700,7 @@ def _record_gather_telemetry(
                 None,
                 dur_s=dur_s,
                 t_start=t_start,
-                transport="gather",
+                transport=transport,
                 leaves=int(leaves),
                 bytes_out=int(bytes_out),
                 bytes_in=int(bytes_in),
@@ -582,7 +739,12 @@ def sync_value_in_graph(value: Array, reduce_fx: ReduceFx, axis_name: AxisName) 
     result is the cross-shard concatenation. ``None`` gathers with a leading
     participant axis. A custom callable receives the stacked ``(world, ...)``
     gather, mirroring the reference's custom ``dist_reduce_fx`` contract.
+    A :class:`Hierarchy` axis lowers over its flat equivalent — per-leaf
+    collectives gain nothing from level splitting; the hierarchical mode
+    lives in the packed engine (:func:`sync_state_packed`).
     """
+    if isinstance(axis_name, Hierarchy):
+        axis_name = axis_name.flat
     if reduce_fx == "sum":
         return lax.psum(value, axis_name)
     if reduce_fx == "mean":
@@ -657,15 +819,19 @@ def _record_in_graph_telemetry(
     collectives_after: int = 0,
     groups: Optional[Dict[str, int]] = None,
     span_ids: Optional[Dict[str, str]] = None,
+    levels: Optional[List[str]] = None,
 ) -> None:
     """Trace-time record of one in-graph sync lowering (registry + event
     timeline). ``kinds`` counts STATES per collective kind; ``buckets`` maps
-    ``"<kind>/<dtype>"`` labels to the leaf count each packed bucket carries;
+    ``"<kind>/<dtype>"`` labels (``"<level>/<kind>/<dtype>"`` for a
+    hierarchical lowering) to the leaf count each packed bucket carries;
     before/after are the per-leaf vs actually-issued collective counts;
     ``groups`` maps each deduped bundle (a compute group or shared-update
     class) to the member count it serves — the leaf-set the transport did
     NOT have to carry; ``span_ids`` maps each packed bucket to its collective
-    span id (observability/tracing.py). Never raises."""
+    span id (observability/tracing.py); ``levels`` names the hierarchy's
+    level labels (e.g. ``["ici", "dcn"]``) when the lowering was two-level.
+    Never raises."""
     try:
         from metrics_tpu.observability.events import EVENTS
         from metrics_tpu.observability.registry import TELEMETRY
@@ -678,6 +844,7 @@ def _record_in_graph_telemetry(
             collectives_before=collectives_before,
             collectives_after=collectives_after,
             groups=groups,
+            levels=levels,
         )
         if EVENTS.enabled:
             # instant event at TRACE time (once per compile, never per
@@ -693,6 +860,8 @@ def _record_in_graph_telemetry(
             }
             if buckets is not None:
                 payload["buckets"] = dict(buckets)
+            if levels:
+                payload["levels"] = list(levels)
             if groups:
                 payload["compute_groups"] = dict(groups)
             if span_ids:
@@ -721,11 +890,52 @@ def _packed_collective(kind: str, buffer: Array, axis_name: AxisName) -> Array:
     return lax.all_gather(buffer, axis_name, axis=0, tiled=False)
 
 
+def _packed_collective_levels(kind: str, buffer: Array, levels: Tuple[Tuple[str, Any], ...]) -> Array:
+    """Hierarchical lowering of one packed bucket: one collective per LEVEL,
+    innermost (ICI) first, result identical to the flat collective over the
+    levels' combined axis tuple.
+
+    * psum/pmax/pmin chain exactly (the level partials re-associate the same
+      values; integer and extremal reductions are bit-identical, rounding
+      float sums agree to ≤1 ulp of reassociation);
+    * pmean runs the psum chain and divides ONCE by the total participant
+      count (``lax.psum`` of a literal folds to the static axis size — no
+      extra collective), matching the flat ``pmean``'s single division;
+    * the gather bucket gathers level by level — each outer level stacks the
+      previous level's block — and one reshape flattens the
+      (outer, ..., inner) grid into the flat participant axis, which is
+      exactly the outer-major order ``lax.all_gather`` over the flat tuple
+      produces (bit-identical, pinned in tests).
+    """
+    if kind in ("psum", "pmean"):
+        out = buffer
+        for _, axis in levels:
+            out = lax.psum(out, axis)
+        if kind == "pmean":
+            size = 1
+            for _, axis in levels:
+                size = size * lax.psum(1, axis)  # folds to the static axis size
+            out = out / size
+        return out
+    if kind in ("pmax", "pmin"):
+        op = lax.pmax if kind == "pmax" else lax.pmin
+        out = buffer
+        for _, axis in levels:
+            out = op(out, axis)
+        return out
+    out = lax.all_gather(buffer, levels[0][1], axis=0, tiled=False)
+    for _, axis in levels[1:]:
+        out = lax.all_gather(out, axis, axis=0, tiled=False)
+        out = jnp.reshape(out, (out.shape[0] * out.shape[1],) + out.shape[2:])
+    return out
+
+
 def sync_state_packed(
     state: Dict[str, Union[Array, List[Array]]],
     reductions: Dict[str, ReduceFx],
-    axis_name: AxisName,
+    axis_name: Any,
     *,
+    levels: Optional[Sequence[Tuple[str, Any]]] = None,
     group_composition: Optional[Dict[str, int]] = None,
 ) -> Dict[str, Union[Array, List[Array]]]:
     """Bucketed in-graph sync: ONE collective per (collective kind, dtype).
@@ -752,8 +962,22 @@ def sync_state_packed(
     analogue of DDP gradient bucketing / Horovod tensor fusion. List states
     are pre-concatenated exactly as in :func:`sync_in_graph`.
 
+    **Hierarchical mode** (``levels=[("ici", intra_axis), ("dcn",
+    inter_axis)]``, or a :class:`Hierarchy` passed as ``axis_name``): each
+    packed bucket lowers to one collective per **(level, kind, dtype)** —
+    reduce within-host over ICI first, then across hosts over DCN — so the
+    cross-host leg carries one already-reduced buffer per bucket instead of
+    every device's contribution (the Horovod-hierarchical-allreduce shape).
+    Results are identical to the flat sync over the levels' combined axis
+    (bit-identical for integer/extremal reductions and gathers; rounding
+    float sums agree to ≤1 ulp of level-partial reassociation — see
+    :func:`_packed_collective_levels`). Callable custom reductions keep the
+    per-leaf gather over the flat axis (their stacked contract admits no
+    level split).
+
     Telemetry (trace-time, once per compile): bucket composition
-    (``"<kind>/<dtype>" -> leaf count``) and the before/after collective
+    (``"<kind>/<dtype>" -> leaf count``; hierarchical buckets are keyed
+    ``"<level>/<kind>/<dtype>"`` per level) and the before/after collective
     counts land in ``snapshot()["sync"]["in_graph"]`` and the sync event.
     ``group_composition`` (``bundle label -> members served``) annotates
     bundles a caller already deduplicated — a ``MetricCollection``'s compute
@@ -762,6 +986,14 @@ def sync_state_packed(
     composition alongside the bucket packing.
     """
     from metrics_tpu.utilities.data import dim_zero_cat
+
+    if levels is None and isinstance(axis_name, Hierarchy):
+        levels = axis_name.levels
+    hier: Optional[Tuple[Tuple[str, Any], ...]] = None
+    if levels is not None:
+        hier = Hierarchy(*levels).levels  # normalize + validate
+        # per-leaf fallbacks (callables) and telemetry label the flat axis
+        axis_name = Hierarchy(*hier).flat
 
     synced: Dict[str, Union[Array, List[Array]]] = {}
     kinds: Dict[str, int] = {}
@@ -813,20 +1045,30 @@ def sync_state_packed(
     bucket_spans: Dict[str, str] = {}
     tracer = _tracer()
     for (kind, dtype), entries in buckets.items():
-        label = f"{kind}/{np.dtype(dtype).name}"
-        bucket_compo[label] = len(entries)
-        if tracer:
-            # trace-time instant span: one deterministic id per issued packed
-            # collective, keyed by (kind, axis, bucket) — the in-graph analogue
-            # of the eager transport's correlation key (this runs once per
-            # compile; the lowered program itself carries no tracing ops)
-            sid = tracer.instant(
-                "in_graph", group=repr(axis_name), bucket=label, leaves=len(entries)
-            )
-            if sid is not None:
-                bucket_spans[label] = sid
+        base_label = f"{kind}/{np.dtype(dtype).name}"
+        # hierarchical: one issued collective — and one composition entry and
+        # one span — per (level, kind, dtype); flat: per (kind, dtype)
+        labels = (
+            [f"{lvl}/{base_label}" for lvl, _ in hier] if hier else [base_label]
+        )
+        for label in labels:
+            bucket_compo[label] = len(entries)
+            if tracer:
+                # trace-time instant span: one deterministic id per issued
+                # packed collective, keyed by (kind, axis, bucket) — the
+                # in-graph analogue of the eager transport's correlation key
+                # (this runs once per compile; the lowered program itself
+                # carries no tracing ops)
+                sid = tracer.instant(
+                    "in_graph", group=repr(axis_name), bucket=label, leaves=len(entries)
+                )
+                if sid is not None:
+                    bucket_spans[label] = sid
         buffer = jnp.concatenate([flat for _, flat, _ in entries]) if len(entries) > 1 else entries[0][1]
-        out = _packed_collective(kind, buffer, axis_name)
+        if hier:
+            out = _packed_collective_levels(kind, buffer, hier)
+        else:
+            out = _packed_collective(kind, buffer, axis_name)
         offset = 0
         for name, flat, (mode, shape, wrap_list) in entries:
             n = int(flat.shape[0])
@@ -852,9 +1094,10 @@ def sync_state_packed(
             bytes_traced,
             buckets=bucket_compo,
             collectives_before=per_leaf_collectives,
-            collectives_after=len(buckets) + callable_leaves,
+            collectives_after=len(buckets) * (len(hier) if hier else 1) + callable_leaves,
             groups=group_composition,
             span_ids=bucket_spans or None,
+            levels=[lvl for lvl, _ in hier] if hier else None,
         )
     return synced
 

@@ -30,9 +30,20 @@ Decision rules (each unit-tested in ``tests/test_bench_regress.py``):
   overrides the band for the named configs only; everything else keeps
   ``--tolerance``.
 
+* **The multichip trajectory is gated too.** The repo also commits one
+  ``MULTICHIP_r<NN>.json`` capture per round — the driver's 8-device dryrun
+  health probe (``{"n_devices", "rc", "ok", "skipped", "tail"}``), not a
+  bench line. Each capture is adapted into the bench-record shape
+  (``value`` = return code, 0 healthy; ``degraded`` = skipped) and judged by
+  the same healthy-median machinery: with a baseline of prior rc=0 rounds, a
+  latest capture whose dryrun failed (rc>0) regresses the gate. A zero
+  baseline judges by sign (any positive latest fails), since a ratio over
+  zero is undefined.
+
 Run: ``python scripts/bench_regress.py --check`` (CI via ``make
-bench-regress`` / ``make ci``); exit 1 iff a config regressed. ``--list``
-prints the parsed trajectory instead of judging it.
+bench-regress`` / ``make ci``); exit 1 iff a config regressed — both the
+``BENCH_r*`` and ``MULTICHIP_r*`` trajectories are judged in one table.
+``--list`` prints the parsed trajectories instead of judging them.
 """
 import argparse
 import glob as globlib
@@ -118,6 +129,48 @@ def load_round(path: str) -> Tuple[int, Dict[str, Dict[str, Any]]]:
 def load_trajectory(paths: List[str]) -> List[Tuple[int, Dict[str, Dict[str, Any]]]]:
     """All capture files as ``[(round, {metric: record})]``, round-ascending."""
     rounds = [load_round(p) for p in sorted(paths)]
+    rounds.sort(key=lambda item: item[0])
+    return rounds
+
+
+def load_multichip_round(path: str) -> Tuple[int, Dict[str, Dict[str, Any]]]:
+    """One ``MULTICHIP_r<NN>.json`` dryrun capture adapted to the bench-record
+    shape the healthy-median machinery judges.
+
+    The capture is the driver's multichip health probe, not a bench line:
+    ``value`` becomes the dryrun's return code (0 = healthy, lower is
+    better exactly like every bench unit), ``unit`` is ``"rc"``, and a
+    ``skipped`` capture is ``degraded`` (no chips to probe is not a code
+    regression). An unparseable capture degrades to rc=1, so a corrupted
+    capture cannot silently pass."""
+    number = 0
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    if m:
+        number = int(m.group(1))
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        doc = {}
+    if not isinstance(doc, dict):
+        doc = {}
+    metric = f"multichip_dryrun_{int(doc.get('n_devices', 0))}dev"
+    rc = doc.get("rc")
+    if rc is None:
+        rc = 0 if doc.get("ok") else 1
+    record = {
+        "metric": metric,
+        "value": float(rc),
+        "unit": "rc",
+        "degraded": bool(doc.get("skipped")),
+    }
+    return number, {metric: record}
+
+
+def load_multichip_trajectory(paths: List[str]) -> List[Tuple[int, Dict[str, Dict[str, Any]]]]:
+    """All multichip captures as ``[(round, {metric: record})]``,
+    round-ascending."""
+    rounds = [load_multichip_round(p) for p in sorted(paths)]
     rounds.sort(key=lambda item: item[0])
     return rounds
 
@@ -212,7 +265,10 @@ def check_trajectory(
         else:
             baseline = median(history)
             value = float(rec["value"])
-            row["delta_pct"] = round((value / baseline - 1.0) * 100.0, 1)
+            # a zero baseline (the multichip rc trajectory's healthy state)
+            # admits no ratio: judge by sign — any positive latest regresses
+            if baseline:
+                row["delta_pct"] = round((value / baseline - 1.0) * 100.0, 1)
             row["status"] = REGRESSED if value > baseline * (1.0 + config_tolerance) else OK
         rows.append(row)
     return rows
@@ -259,6 +315,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="capture files (default: BENCH_r*.json at the repo root)",
     )
     parser.add_argument(
+        "--multichip", nargs="*", default=None, metavar="FILE",
+        help="multichip dryrun captures to gate alongside the bench"
+        " trajectory (default: MULTICHIP_r*.json at the repo root; pass"
+        " nothing after the flag to disable)",
+    )
+    parser.add_argument(
         "--check", action="store_true",
         help="CI mode: exit 1 when a config regressed (the exit code reflects"
         " regressions either way; the flag documents intent in make targets)",
@@ -293,9 +355,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not paths:
         print("bench_regress: no capture files found", file=sys.stderr)
         return 2
+    if args.multichip is not None:
+        multichip_paths = list(args.multichip)
+    elif args.paths:
+        multichip_paths = []  # explicit captures named: gate only those
+    else:
+        multichip_paths = sorted(globlib.glob(os.path.join(REPO_ROOT, "MULTICHIP_r*.json")))
     rounds = load_trajectory(paths)
+    multichip_rounds = load_multichip_trajectory(multichip_paths) if multichip_paths else []
     if args.list:
-        for n, by_metric in rounds:
+        for n, by_metric in rounds + multichip_rounds:
             for metric, rec in sorted(by_metric.items()):
                 print(
                     f"r{n:02d} {metric}: {rec.get('value')} {rec.get('unit')}"
@@ -313,6 +382,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         min_history=args.min_history,
         tolerance_overrides=overrides,
     )
+    # the multichip dryrun trajectory is a SEPARATE round sequence (its own
+    # baselines); its rows join the same table and the same exit code
+    if multichip_rounds:
+        rows.extend(
+            check_trajectory(
+                multichip_rounds,
+                tolerance=args.tolerance,
+                min_history=args.min_history,
+                tolerance_overrides=overrides,
+            )
+        )
     print(render_table(rows, args.tolerance))
     return 1 if any(row["status"] == REGRESSED for row in rows) else 0
 

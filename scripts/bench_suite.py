@@ -1200,6 +1200,208 @@ def bench_collection_sync_eager():
 bench_collection_sync_eager._force_cpu = True
 
 
+def bench_collection_sync_hierarchical():
+    """Hierarchical (two-level) in-graph sync of the 10-metric classification
+    collection, per scanned step: each packed bucket reduces within-host over
+    the ICI axis first, then across hosts over DCN — one collective per
+    (level, kind, dtype) bucket — against our own FLAT packed sync over the
+    combined axis as the baseline (same backend, same bucket fusion). On the
+    bench host both levels ride the same fabric, so the time mostly prices
+    the extra collective launches; on a real pod the DCN leg carries one
+    already-reduced buffer per bucket instead of every device's bytes. The
+    record carries the per-level collective counts (from the trace-time
+    bucket telemetry) so the (level, kind, dtype) composition is pinned in
+    the capture."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from check_zero_overhead import _count_collectives, _shard_map
+    from metrics_tpu import hierarchical_axis, observability
+    from metrics_tpu.utilities.distributed import sync_state_packed
+
+    nc = 5
+    coll = _ten_metric_classification_collection(nc)
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(256, nc).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, nc, 256))
+    state = coll.apply_update(coll.init_state(), preds, target)
+    flat_state = {
+        f"{n}.{k}": v for n, m in coll.items(keep_base=True) for k, v in state[n].items()
+    }
+    flat_reductions = {
+        f"{n}.{k}": m._reductions[k]
+        for n, m in coll.items(keep_base=True)
+        for k in state[n]
+    }
+
+    # two-level mesh over whatever devices the backend offers: (inter, intra)
+    # — axis SIZES change the data movement, never the collective counts
+    n_dev = len(jax.devices())
+    inter = 2 if n_dev >= 2 and n_dev % 2 == 0 else 1
+    mesh = Mesh(np.array(jax.devices()).reshape(inter, n_dev // inter), ("inter", "intra"))
+    hier = hierarchical_axis("intra", "inter")
+    xs = jnp.arange(SYNC_STEPS, dtype=jnp.int32)
+
+    def make_update(axis):
+        body = _shard_map(
+            lambda s: sync_state_packed(s, flat_reductions, axis), mesh, (P(),), P()
+        )
+
+        def update(acc, x):
+            s = {k: v + x.astype(v.dtype) for k, v in flat_state.items()}
+            synced = body(s)
+            folded = sum(
+                jnp.sum(leaf).astype(jnp.float32) for leaf in jax.tree.leaves(synced)
+            )
+            return acc + folded
+
+        return update
+
+    hier_update = make_update(hier)
+    flat_update = make_update(("inter", "intra"))
+
+    # per-level composition from the trace-time bucket telemetry: one traced
+    # lowering against a clean registry, buckets keyed "<level>/<kind>/<dtype>"
+    observability.TELEMETRY.reset()
+    hier_jaxpr = jax.make_jaxpr(lambda a, x: hier_update(a, x))(jnp.zeros(()), xs[0])
+    buckets = observability.snapshot()["sync"]["in_graph"]["buckets"]
+    per_level: dict = {}
+    for label in buckets:
+        parts = label.split("/")
+        if len(parts) == 3:  # "<level>/<kind>/<dtype>"
+            per_level[parts[0]] = per_level.get(parts[0], 0) + 1
+
+    flat_counts = _count_collectives(
+        jax.make_jaxpr(lambda a, x: flat_update(a, x))(jnp.zeros(()), xs[0]).jaxpr
+    )
+    hier_counts = _count_collectives(hier_jaxpr.jaxpr)
+
+    zero = lambda: jnp.zeros(())  # noqa: E731
+    ours = _time_scan_epoch((xs,), zero, hier_update)
+
+    def ref(torchmetrics, torch):  # our own flat packed sync is the baseline
+        return _time_scan_epoch((xs,), zero, flat_update)
+
+    extra = {
+        "collectives_per_level": {k: int(v) for k, v in sorted(per_level.items())},
+        "collectives_flat": int(sum(flat_counts.values())),
+        "collectives_hierarchical": int(sum(hier_counts.values())),
+        "levels": ["ici", "dcn"],
+        "mesh_shape": [int(inter), int(n_dev // inter)],
+    }
+    return "collection_sync_hierarchical_step", ours, ref, "us/step", extra
+
+
+#: async-overlap harness parameters: the simulated 2-host link's per-round
+#: sleep (the DCN RTT stand-in) and the step budget while the sync is in
+#: flight
+ASYNC_ROUND_SLEEP_S = 0.04
+ASYNC_MAX_STEPS = 200
+
+
+def bench_compute_async_overlap():
+    """``compute_async`` takes the epoch-end gather off the step critical
+    path: on a simulated 2-host transport (loopback world-2 with an injected
+    per-round sleep standing in for the DCN RTT), the collection submits its
+    epoch sync to the background engine and keeps stepping while the
+    transfer is in flight. ``value`` is the submit latency (the only hot
+    -path cost async leaves behind: one state snapshot); the baseline is the
+    SYNCHRONOUS epoch sync on the same link, so ``vs_baseline`` is the
+    blocking time taken off the critical path. The record carries the
+    acceptance evidence: ``overlap_fraction`` (> 0.5 required — the fraction
+    of the sync's flight time the main thread spent inside real update
+    steps), ``steps_during_flight``, and ``values_match`` (the future
+    resolved bit-identical to a synchronous ``compute()`` of the same
+    snapshot)."""
+    import jax.numpy as jnp
+
+    import metrics_tpu.utilities.distributed as dist_mod
+
+    nc = 5
+    coll = _ten_metric_classification_collection(nc)
+    rng = np.random.RandomState(0)
+    probs = rng.rand(256, nc).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    preds = jnp.asarray(probs)
+    target = jnp.asarray(rng.randint(0, nc, 256))
+    coll.update(preds, target)
+
+    def loopback_allgather(x):
+        time.sleep(ASYNC_ROUND_SLEEP_S)  # the simulated cross-host RTT
+        return np.stack([np.asarray(x), np.asarray(x)])
+
+    orig = (
+        dist_mod._process_allgather,
+        dist_mod.distributed_available,
+        dist_mod.world_size,
+        dist_mod.jax.process_index,
+    )
+    dist_mod._process_allgather = loopback_allgather
+    dist_mod.distributed_available = lambda: True
+    dist_mod.world_size = lambda: 2
+    dist_mod.jax.process_index = lambda: 0
+    try:
+        # the synchronous baseline: a blocking epoch sync of the same
+        # snapshot on the same link (also the equivalence oracle)
+        oracle = coll.clone()
+        t0 = time.perf_counter()
+        sync_values = oracle.compute()
+        sync_epoch_s = time.perf_counter() - t0
+
+        t_submit = time.perf_counter()
+        future = coll.compute_async()
+        submit_s = time.perf_counter() - t_submit
+
+        # steps proceed during the in-flight sync: keep updating the LIVE
+        # collection until the future resolves
+        steps = 0
+        busy_s = 0.0
+        while not future.done() and steps < ASYNC_MAX_STEPS:
+            t = time.perf_counter()
+            coll.update(preds, target)
+            busy_s += time.perf_counter() - t
+            steps += 1
+        async_values = future.result(timeout=30.0)
+        flight_s = time.perf_counter() - t_submit
+        overlap = min(1.0, busy_s / flight_s) if flight_s > 0 else 0.0
+        values_match = all(
+            np.array_equal(np.asarray(async_values[k]), np.asarray(sync_values[k]))
+            for k in sync_values
+        )
+    finally:
+        (
+            dist_mod._process_allgather,
+            dist_mod.distributed_available,
+            dist_mod.world_size,
+            dist_mod.jax.process_index,
+        ) = orig
+
+    extra = {
+        "overlap_fraction": round(float(overlap), 4),
+        "steps_during_flight": int(steps),
+        "flight_ms": round(flight_s * 1e3, 3),
+        "sync_epoch_ms": round(sync_epoch_s * 1e3, 3),
+        "values_match": bool(values_match),
+        "transport_rounds": {"descriptor": 1, "payload": 1},
+        "simulated_hosts": 2,
+        "round_sleep_ms": round(ASYNC_ROUND_SLEEP_S * 1e3, 3),
+    }
+    # our own blocking epoch sync is the baseline; torch args are unused
+    return (
+        "compute_async_overlap",
+        submit_s,
+        lambda torchmetrics, torch: sync_epoch_s,
+        "us/submit",
+        extra,
+    )
+
+
+#: host-bound loopback harness (see bench_collection_sync_eager)
+bench_compute_async_overlap._force_cpu = True
+
+
 def run_config(cfg, probe: bool = True, _repinned: bool = False) -> dict:
     """Run one bench config and shape the driver JSON line (NaN-safe).
 
@@ -1310,6 +1512,8 @@ CONFIG_META = {
     "bench_multitenant_update": ("multitenant_update_step", "us/tenant"),
     "bench_collection_sync_in_graph": ("collection_sync_in_graph_step", "us/step"),
     "bench_collection_sync_eager": ("collection_sync_eager_epoch", "us/epoch"),
+    "bench_collection_sync_hierarchical": ("collection_sync_hierarchical_step", "us/step"),
+    "bench_compute_async_overlap": ("compute_async_overlap", "us/submit"),
 }
 
 #: driver order — the flagship collection config LAST (the driver's headline)
@@ -1329,6 +1533,8 @@ CONFIGS = [
     bench_multitenant_update,
     bench_collection_sync_in_graph,
     bench_collection_sync_eager,
+    bench_collection_sync_hierarchical,
+    bench_compute_async_overlap,
     bench_collection,
 ]
 

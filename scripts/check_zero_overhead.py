@@ -50,6 +50,16 @@ bookkeeping, so the disabled-state AND enabled-state hot-path jaxprs must
 stay byte-identical to the pinned baseline — the same discipline the health
 monitor established.
 
+The packed-sync pin extends to the **hierarchical (two-level) lowering**:
+the same canonical programs over a ``Hierarchy(("ici", ...), ("dcn", ...))``
+axis must issue exactly one collective per (level, kind, dtype) bucket —
+checked self-consistently (every flat count doubled, nothing more) AND
+pinned against the baseline (``hierarchical_sync_collectives``). And the
+identity sweep covers the **background sync engine**: with the engine
+constructed, its worker running, and a job completed, the hot-path jaxprs
+must stay byte-identical — ``compute_async`` takes work off the step path,
+it must never add to it.
+
 Fourth pin: **compute-group fusion**. The canonical stat-scores collection
 (``Precision/Recall/F1/Specificity/StatScores``, same config) must
 trace-fingerprint into ONE compute group, so its compiled step runs exactly
@@ -206,6 +216,76 @@ def sync_collective_counts() -> Dict[str, Dict[str, int]]:
     return {
         "collection_sync_packed": _count_collectives(coll_jaxpr.jaxpr),
         "metric_sync_packed": _count_collectives(metric_jaxpr.jaxpr),
+    }
+
+
+def hierarchical_sync_collectives() -> Dict[str, Dict[str, int]]:
+    """Collective counts for the pinned HIERARCHICAL packed-sync programs.
+
+    Same canonical programs as :func:`sync_collective_counts`, lowered over a
+    two-level ``Hierarchy`` on a 2-axis ``("inter", "intra")`` mesh (1x1 —
+    collective counts are device-count-independent). The hierarchical engine
+    must issue exactly one collective per **(level, kind, dtype)** bucket:
+    every flat count doubled, nothing more — a level that silently falls
+    back to flat (or issues per-leaf collectives) changes these counts and
+    fails the gate.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu import (
+        Accuracy,
+        CohenKappa,
+        ConfusionMatrix,
+        F1,
+        HammingDistance,
+        IoU,
+        MatthewsCorrcoef,
+        MetricCollection,
+        Precision,
+        Recall,
+        Specificity,
+        hierarchical_axis,
+    )
+
+    jax.config.update("jax_enable_x64", True)
+    nc = 5
+    coll = MetricCollection(
+        [
+            Accuracy(),
+            Precision(average="macro", num_classes=nc),
+            Recall(average="macro", num_classes=nc),
+            F1(average="macro", num_classes=nc),
+            Specificity(average="macro", num_classes=nc),
+            HammingDistance(),
+            ConfusionMatrix(num_classes=nc),
+            CohenKappa(num_classes=nc),
+            MatthewsCorrcoef(num_classes=nc),
+            IoU(num_classes=nc),
+        ]
+    )
+    preds = jnp.zeros((8, nc), jnp.float32)
+    target = jnp.zeros((8,), jnp.int32)
+    state = coll.apply_update(coll.init_state(), preds, target)
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("inter", "intra"))
+    hier = hierarchical_axis("intra", "inter")
+
+    coll_jaxpr = jax.make_jaxpr(
+        _shard_map(lambda s: coll.apply_compute(s, axis_name=hier), mesh, (P(),), P())
+    )(state)
+
+    acc = Accuracy()
+    acc_state = acc.apply_update(acc.init_state(), preds, target)
+    metric_jaxpr = jax.make_jaxpr(
+        _shard_map(lambda s: acc.sync_state(s, hier), mesh, (P(),), P())
+    )(acc_state)
+
+    return {
+        "collection_sync_hierarchical": _count_collectives(coll_jaxpr.jaxpr),
+        "metric_sync_hierarchical": _count_collectives(metric_jaxpr.jaxpr),
     }
 
 
@@ -429,6 +509,40 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
     finally:
         observability.TRACER.enable(prev_tracing)
 
+    # the background sync engine must be host-side only: with the engine
+    # constructed, its worker thread running, and one job completed, every
+    # hot-path jaxpr must still be byte-identical to the engine-off state —
+    # compute_async takes work OFF the step path, it must never add to it
+    from metrics_tpu.utilities.async_sync import get_engine
+
+    engine = get_engine()
+    engine.submit("zero_overhead_probe", lambda: None)
+    engine.drain(timeout=5.0)
+    for name, thunk in programs.items():
+        if thunk() != texts[name]:
+            violations.append(
+                f"{name}: jaxpr differs with the async sync engine running —"
+                " the background engine leaked traced ops into the hot path"
+            )
+
+    # hierarchical fusion self-consistency (baseline-independent): each
+    # two-level lowering issues exactly one collective per (level, kind,
+    # dtype) bucket — every flat count doubled, nothing more
+    hierarchical = hierarchical_sync_collectives()
+    flat_counts = sync_collective_counts()
+    for flat_name, hier_name in (
+        ("collection_sync_packed", "collection_sync_hierarchical"),
+        ("metric_sync_packed", "metric_sync_hierarchical"),
+    ):
+        want = {k: 2 * v for k, v in flat_counts[flat_name].items()}
+        if hierarchical[hier_name] != want:
+            violations.append(
+                f"{hier_name}: two-level sync lowers to {hierarchical[hier_name]},"
+                f" expected exactly one collective per (level, kind, dtype) bucket"
+                f" ({want} — the flat {flat_name} counts doubled); a level is"
+                " falling back to flat or regressing toward per-leaf collectives"
+            )
+
     # the donated lowering must be zero-copy regardless of any baseline: every
     # donated state leaf aliases an output buffer, or XLA copies it per step
     donation = donation_aliasing()
@@ -483,8 +597,7 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
         if pinned_sync is None:
             violations.append("sync_collectives missing from baseline (run --update)")
         else:
-            current = sync_collective_counts()
-            for name, counts in current.items():
+            for name, counts in flat_counts.items():
                 want = pinned_sync.get(name)
                 if want is None:
                     violations.append(f"{name}: sync program missing from baseline (run --update)")
@@ -493,6 +606,24 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                         f"{name}: in-graph sync lowers to {counts}, baseline pins {want} —"
                         " the packed (bucketed) sync regressed toward per-leaf collectives"
                         " (or the bucket layout changed). If intentional, regenerate with"
+                        " `python scripts/check_zero_overhead.py --update`."
+                    )
+        # the hierarchical counts are pinned per (level, kind) too: the
+        # self-consistency check above proves "2x flat"; the baseline pin
+        # makes any change to EITHER side a conscious regeneration
+        pinned_hier = baseline.get("hierarchical_sync_collectives")
+        if pinned_hier is None:
+            violations.append("hierarchical_sync_collectives missing from baseline (run --update)")
+        else:
+            for name, counts in hierarchical.items():
+                want = pinned_hier.get(name)
+                if want is None:
+                    violations.append(f"{name}: hierarchical sync program missing from baseline (run --update)")
+                elif want != counts:
+                    violations.append(
+                        f"{name}: hierarchical sync lowers to {counts}, baseline pins"
+                        f" {want} — the per-(level, kind, dtype) bucket layout changed."
+                        " If intentional, regenerate with"
                         " `python scripts/check_zero_overhead.py --update`."
                     )
         # compute-group fusion counts are version-independent too: pin them
@@ -556,6 +687,9 @@ def update_baseline(baseline_path: str = BASELINE_PATH) -> str:
         # packed in-graph sync lowering: collective count per kind; a
         # regression back to per-leaf collectives inflates these and fails
         "sync_collectives": sync_collective_counts(),
+        # hierarchical (two-level) lowering: exactly one collective per
+        # (level, kind, dtype) bucket — the flat counts doubled
+        "hierarchical_sync_collectives": hierarchical_sync_collectives(),
         # donated stateful lowering: every state leaf must alias an output
         # buffer (zero-copy in-place updates); fewer means per-step copies
         "donation_aliasing": donation_aliasing(),

@@ -1,0 +1,354 @@
+"""Background async sync engine + degraded-link policies under fault
+injection.
+
+``compute_async`` snapshots state into a detached shadow and runs the
+descriptor+payload gather rounds on a worker thread; these tests drive the
+engine through a fault-injected world-2 transport (patched
+``_process_allgather`` — the same loopback harness the eager sync bench
+uses; the collection sync path reads the distributed state dynamically, so
+the simulated world applies) and pin:
+
+* the future resolves to EXACTLY what synchronous ``compute()`` returns —
+  single-process, simulated 2-process, fresh and in-flight;
+* updates on the live collection during an in-flight sync neither corrupt
+  the future nor are lost (the snapshot-vs-mutation generation guard);
+* each degraded-link policy under its fault: **retry** (flaky peer →
+  bounded backoff, then success or ``AsyncSyncError``), **stale** (dead
+  peer / flagged-degraded link → last completed generation served with
+  ``stale=True`` and a staleness counter; failure when no generation ever
+  completed), **quorum** (flagged peer excluded → result equals the
+  healthy-subgroup flat sync, garbage from the sick rank never decoded);
+* per-round timeouts orphan a hung transport without wedging the engine;
+* observability: ``snapshot()["async_sync"]`` counters, the ``dcn``
+  transport label on gather telemetry/histograms, and the
+  ``metrics_tpu_async_sync_*`` Prometheus family.
+"""
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.utilities.distributed as dist_mod
+from metrics_tpu import Accuracy, ConfusionMatrix, MetricCollection, Precision, observability
+from metrics_tpu.observability.tracing import TRACER
+from metrics_tpu.utilities.async_sync import (
+    AsyncSyncEngine,
+    AsyncSyncError,
+    SyncTimeout,
+    get_engine,
+)
+
+
+@pytest.fixture
+def two_proc(monkeypatch):
+    """Simulated 2-process world: install a transport and restore after.
+    Yields a setter so a test can swap transports mid-test."""
+    monkeypatch.setattr(dist_mod, "distributed_available", lambda: True)
+    monkeypatch.setattr(dist_mod, "world_size", lambda: 2)
+    monkeypatch.setattr(dist_mod.jax, "process_index", lambda: 0)
+
+    def set_transport(fn):
+        monkeypatch.setattr(dist_mod, "_process_allgather", fn)
+
+    yield set_transport
+    get_engine().drain(timeout=10.0)  # no job may outlive the patch
+
+
+def loopback(x):
+    """Both simulated ranks contribute identical data."""
+    a = np.asarray(x)
+    return np.stack([a, a])
+
+
+def skewed(x):
+    """Rank 1's payload bytes are garbage (descriptor round untouched, so
+    alignment succeeds) — only a quorum excluding rank 1 decodes cleanly."""
+    a = np.asarray(x)
+    if a.dtype == np.uint8 and a.ndim == 1:  # the payload round
+        return np.stack([a, (a + 1).astype(np.uint8)])
+    return np.stack([a, a.copy()])
+
+
+def _confmat_coll():
+    coll = MetricCollection([ConfusionMatrix(num_classes=2)])
+    coll.update(jnp.asarray([0.1, 0.9, 0.8, 0.2]), jnp.asarray([0, 1, 1, 1]))
+    return coll
+
+
+def _value(result):
+    return np.asarray(result["ConfusionMatrix"])
+
+
+def test_future_matches_sync_compute_single_process():
+    acc = Accuracy()
+    acc.update(jnp.asarray([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]]), jnp.asarray([0, 1, 1]))
+    fut = acc.compute_async()
+    value = fut.result(timeout=10.0)
+    assert fut.done() and not fut.stale
+    np.testing.assert_array_equal(np.asarray(value), np.asarray(acc.compute()))
+
+
+def test_future_matches_sync_compute_two_process_collection(two_proc):
+    two_proc(loopback)
+    coll = MetricCollection([Accuracy(), Precision(average="macro", num_classes=3)])
+    rng = np.random.RandomState(0)
+    coll.update(jnp.asarray(rng.rand(16, 3).astype(np.float32)), jnp.asarray(rng.randint(0, 3, 16)))
+    expected = {k: np.asarray(v) for k, v in coll.clone().compute().items()}
+    fut = coll.compute_async()
+    got = fut.result(timeout=10.0)
+    assert set(got) == set(expected)
+    for k in expected:
+        np.testing.assert_array_equal(np.asarray(got[k]), expected[k])
+
+
+def test_live_updates_during_flight_do_not_corrupt_future(two_proc):
+    """The generation guard: state mutated after submission never leaks into
+    the in-flight snapshot, and the live accumulation is never lost."""
+    two_proc(loopback)
+    coll = _confmat_coll()
+    snapshot_value = _value(coll.clone().compute())  # oracle BEFORE mutation
+
+    release = threading.Event()
+
+    def slow_loopback(x):
+        release.wait(10.0)
+        return loopback(x)
+
+    two_proc(slow_loopback)
+    fut = coll.compute_async()
+    coll.update(jnp.asarray([0.9, 0.9]), jnp.asarray([0, 0]))  # mutate mid-flight
+    assert not fut.done()
+    release.set()
+    got = _value(fut.result(timeout=10.0))
+    np.testing.assert_array_equal(got, snapshot_value)
+    # the live collection kept its mid-flight update (4 + 2 samples)
+    assert int(np.asarray(coll["ConfusionMatrix"].confmat).sum()) == 6
+
+
+def test_retry_policy_recovers_from_flaky_transport(two_proc):
+    two_proc(loopback)
+    observability.reset()
+    coll = _confmat_coll()
+    expected = _value(coll.clone().compute())  # the healthy 2-rank sync
+    calls = {"n": 0}
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] <= 2:  # the first two attempts' descriptor rounds fail
+            raise OSError("link reset")
+        return loopback(x)
+
+    two_proc(flaky)
+    fut = coll.compute_async(on_degraded="retry", max_retries=2, backoff_s=0.001)
+    got = _value(fut.result(timeout=10.0))
+    np.testing.assert_array_equal(got, expected)
+    snap = observability.snapshot()["async_sync"]
+    assert snap["retries"] >= 1 and snap["completed"] >= 1 and snap["failed"] == 0
+
+
+def test_retry_policy_exhausts_to_error(two_proc):
+    def dead(x):
+        raise OSError("peer unreachable")
+
+    two_proc(dead)
+    coll = _confmat_coll()
+    fut = coll.compute_async(on_degraded="retry", max_retries=1, backoff_s=0.001)
+    with pytest.raises(AsyncSyncError, match="peer unreachable"):
+        fut.result(timeout=10.0)
+    assert fut.attempts == 2  # the original attempt + one retry
+
+
+def test_round_timeout_orphans_hung_transport(two_proc):
+    """A hung round trips ``round_timeout_s``; the retry then succeeds on a
+    healthy transport while the orphaned attempt is discarded."""
+    two_proc(loopback)
+    observability.reset()
+    coll = _confmat_coll()
+    expected = _value(coll.clone().compute())
+    release = threading.Event()
+    calls = {"n": 0}
+
+    def hung_then_healthy(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            release.wait(10.0)  # attempt 1 hangs well past the timeout
+        return loopback(x)
+
+    two_proc(hung_then_healthy)
+    fut = coll.compute_async(
+        on_degraded="retry", round_timeout_s=0.1, max_retries=1, backoff_s=0.001
+    )
+    try:
+        got = _value(fut.result(timeout=10.0))
+    finally:
+        release.set()  # let the orphan finish inside the patch scope
+    np.testing.assert_array_equal(got, expected)
+    snap = observability.snapshot()["async_sync"]
+    assert snap["timeouts"] >= 1 and snap["retries"] >= 1
+    get_engine().drain(timeout=10.0)
+    time.sleep(0.05)  # the orphan thread drains its discarded gather
+
+
+def test_stale_policy_serves_last_completed_generation(two_proc):
+    two_proc(loopback)
+    observability.reset()
+    coll = _confmat_coll()
+    first = coll.compute_async()
+    fresh_value = _value(first.result(timeout=10.0))
+    assert not first.stale
+
+    def dead(x):
+        raise OSError("link down")
+
+    two_proc(dead)
+    coll.update(jnp.asarray([0.9, 0.9]), jnp.asarray([0, 0]))  # diverge the live state
+    fut = coll.compute_async(on_degraded="stale")
+    got = _value(fut.result(timeout=10.0))
+    assert fut.stale is True
+    np.testing.assert_array_equal(got, fresh_value)  # generation 1's value
+    snap = observability.snapshot()["async_sync"]
+    assert snap["stale_serves"] == 1
+    # the stale-read flag is visible on the sync event too
+    stale_events = [
+        e for e in observability.EVENTS.events()
+        if e.kind == "sync" and e.payload.get("path") == "async"
+        and e.payload.get("outcome") == "stale"
+    ]
+    assert stale_events and stale_events[-1].payload["stale"] is True
+
+
+def test_stale_policy_without_history_fails(two_proc):
+    def dead(x):
+        raise OSError("link down")
+
+    two_proc(dead)
+    observability.reset()  # no completed generation to serve
+    coll = _confmat_coll()
+    fut = coll.compute_async(on_degraded="stale")
+    with pytest.raises(AsyncSyncError):
+        fut.result(timeout=10.0)
+
+
+def test_stale_policy_skips_transport_when_peers_flagged(two_proc):
+    """With degraded peers already flagged (the PR-8 trigger), the stale
+    policy serves immediately instead of stalling on the sick link."""
+    two_proc(loopback)
+    observability.reset()
+    coll = _confmat_coll()
+    fresh = _value(coll.compute_async().result(timeout=10.0))
+
+    blocked = {"called": False}
+
+    def must_not_be_called(x):
+        blocked["called"] = True
+        return loopback(x)
+
+    two_proc(must_not_be_called)
+    TRACER.set_fleet_report({"flagged": [1]})
+    try:
+        fut = coll.compute_async(on_degraded="stale")
+        got = _value(fut.result(timeout=10.0))
+    finally:
+        TRACER.set_fleet_report(None)
+    assert fut.stale and not blocked["called"]
+    np.testing.assert_array_equal(got, fresh)
+    snap = observability.snapshot()["async_sync"]
+    assert snap["degraded_rounds"] >= 1 and snap["stale_serves"] == 1
+
+
+def test_quorum_policy_matches_healthy_subgroup_flat_sync(two_proc):
+    """With rank 1 flagged degraded and its payload garbage, the quorum
+    reduce equals the healthy-subgroup ([0]) flat sync — the sick rank's
+    bytes never enter the result."""
+    two_proc(skewed)
+    observability.reset()
+    coll = _confmat_coll()
+    # healthy-subgroup oracle: the same states flat-synced with an explicit
+    # group=[0] (the existing group plumbing quorum reuses)
+    oracle = coll.clone()
+    oracle["ConfusionMatrix"].process_group = [0]
+    expected = _value(oracle.compute())
+
+    TRACER.set_fleet_report({"flagged": [1]})
+    try:
+        fut = coll.compute_async(on_degraded="quorum")
+        got = _value(fut.result(timeout=10.0))
+    finally:
+        TRACER.set_fleet_report(None)
+    np.testing.assert_array_equal(got, expected)
+    snap = observability.snapshot()["async_sync"]
+    assert snap["quorum_syncs"] == 1 and snap["degraded_rounds"] >= 1
+    # without the quorum the garbage rank corrupts the sum: prove the fault
+    # injection has teeth
+    full = _value(coll.clone().compute())
+    assert not np.array_equal(full, expected)
+
+
+def test_quorum_without_flagged_peers_is_a_plain_sync(two_proc):
+    two_proc(loopback)
+    observability.reset()
+    coll = _confmat_coll()
+    expected = _value(coll.clone().compute())
+    fut = coll.compute_async(on_degraded="quorum")
+    np.testing.assert_array_equal(_value(fut.result(timeout=10.0)), expected)
+    assert observability.snapshot()["async_sync"]["quorum_syncs"] == 0
+
+
+def test_async_transport_rides_dcn_label(two_proc):
+    two_proc(loopback)
+    observability.reset()
+    coll = _confmat_coll()
+    coll.compute_async().result(timeout=10.0)
+    snap = observability.snapshot()
+    assert snap["sync"]["transports"].get("dcn", 0) >= 1
+    assert any("transport=dcn" in k for k in snap["histograms"])
+    text = observability.render_prometheus()
+    assert 'metrics_tpu_sync_transport_gathers_total{transport="dcn"}' in text
+    assert "# TYPE metrics_tpu_async_sync_submitted_total counter" in text
+
+
+def test_engine_generations_and_policy_validation():
+    engine = AsyncSyncEngine()
+    f1 = engine.submit("k", lambda: 1)
+    f2 = engine.submit("k", lambda: 2)
+    assert (f1.generation, f2.generation) == (1, 2)
+    assert f1.result(5.0) == 1 and f2.result(5.0) == 2
+    assert engine.last_generation("k") == 2
+    with pytest.raises(ValueError, match="on_degraded"):
+        engine.submit("k", lambda: 3, on_degraded="panic")
+    summary = engine.summary()
+    assert summary["submitted"] == 2 and summary["completed"] == 2
+    engine.shutdown()
+
+
+def test_engine_fifo_order_preserved():
+    engine = AsyncSyncEngine()
+    order = []
+    futures = [engine.submit("k", lambda i=i: order.append(i) or i) for i in range(5)]
+    for i, fut in enumerate(futures):
+        assert fut.result(5.0) == i
+    assert order == list(range(5))
+    engine.shutdown()
+
+
+def test_timeout_error_type_is_async_sync_error():
+    engine = AsyncSyncEngine()
+    fut = engine.submit("k", lambda: time.sleep(5.0), round_timeout_s=0.05, max_retries=0)
+    err = fut.exception(timeout=10.0)
+    assert isinstance(err, SyncTimeout) and isinstance(err, AsyncSyncError)
+    engine.shutdown()
+
+
+def test_compute_sync_path_untouched_and_counter_recorded(two_proc):
+    """``compute()`` stays the synchronous path (no future, no engine), and
+    ``compute_async`` counts per-collection ``compute_async_calls``."""
+    two_proc(loopback)
+    observability.reset()
+    coll = _confmat_coll()
+    value = coll.compute()  # plain blocking dict, not a future
+    assert isinstance(value, dict) and not hasattr(value, "result")
+    coll.compute_async().result(timeout=10.0)
+    counters = observability.snapshot()["metrics"][coll.telemetry_key]["counters"]
+    assert counters["compute_async_calls"] == 1

@@ -1,0 +1,454 @@
+"""Hierarchical (two-level) bucketed sync: equivalence with the flat packed
+path and the per-(level, kind, dtype) collective guarantees.
+
+``sync_state_packed(..., levels=[("ici", intra), ("dcn", inter)])`` (or a
+:class:`Hierarchy` passed as the axis) lowers each packed bucket to a
+within-host reduce over ICI followed by a cross-host reduce over DCN — the
+metric-state analogue of Horovod's hierarchical allreduce. These tests pin:
+
+* **bit-identical results vs the flat packed sync** over the combined axis
+  tuple for every exact reduction — integer sums, integer-valued float sums
+  (metric states are overwhelmingly counts), pmax/pmin, cat/stacked gathers,
+  list states — plus tight reassociation bounds for rounding float sums;
+* the collective-count guarantee: exactly ONE collective per
+  (level, kind, dtype) bucket in the compiled HLO — the flat counts doubled,
+  nothing more;
+* the wiring: ``Metric.sync_state`` / ``process_group=Hierarchy`` /
+  ``MetricCollection.apply_compute`` all lower hierarchically, compute
+  groups still contribute one bundle, and the trace-time telemetry carries
+  the per-level bucket composition;
+* the :class:`Hierarchy` spec itself (validation, flat equivalent, mesh
+  constructor, equality, pickling).
+
+Runs on the virtual 8-device CPU mesh reshaped (2, 4) as
+``("inter", "intra")`` — 2 simulated hosts of 4 devices.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from metrics_tpu import (
+    Accuracy,
+    F1,
+    Hierarchy,
+    MetricCollection,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+    hierarchical_axis,
+    observability,
+)
+from metrics_tpu.utilities.distributed import sync_state_packed
+
+WORLD = 8
+INTER, INTRA = 2, 4
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    if hasattr(jax, "shard_map"):  # pragma: no cover - newer jax
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:WORLD]).reshape(INTER, INTRA), ("inter", "intra"))
+
+
+def _hier():
+    return hierarchical_axis("intra", "inter")
+
+
+#: the flat axis a two-level ("intra" then "inter") sync must match
+FLAT_AXIS = ("inter", "intra")
+
+
+def _run_sync(per_rank_states, reductions, axis, **kwargs):
+    """Run ``sync_state_packed`` over the (2, 4) virtual mesh, one rank per
+    device, and return the (replicated) synced pytree."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *per_rank_states)
+
+    def body(state):
+        state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
+        return sync_state_packed(state, reductions, axis, **kwargs)
+
+    fn = jax.jit(_shard_map(body, _mesh(), (P(("inter", "intra")),), P()))
+    return fn(stacked)
+
+
+def _assert_tree_identical(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype and x.shape == y.shape
+        np.testing.assert_array_equal(x, y)
+
+
+def _count_collectives(jaxpr, counts=None):
+    counts = {} if counts is None else counts
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("psum", "pmax", "pmin", "all_gather", "all_to_all"):
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+        for v in eqn.params.values():
+            if hasattr(v, "eqns"):
+                _count_collectives(v, counts)
+            elif hasattr(v, "jaxpr"):
+                _count_collectives(v.jaxpr, counts)
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# the Hierarchy spec
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchy_spec_and_flat_equivalent():
+    h = Hierarchy(("ici", "intra"), ("dcn", "inter"))
+    assert h.levels == (("ici", "intra"), ("dcn", "inter"))
+    assert h.flat == ("inter", "intra")  # outermost level first
+    assert hierarchical_axis("intra", "inter") == h
+    assert hash(hierarchical_axis("intra", "inter")) == hash(h)
+    assert h != Hierarchy(("ici", "inter"), ("dcn", "intra"))
+    # tuple-of-axes levels flatten in level order
+    deep = Hierarchy(("ici", ("a", "b")), ("dcn", "c"))
+    assert deep.flat == ("c", "a", "b")
+    # repr is stable (it keys collection presync bundles)
+    assert repr(h) == repr(hierarchical_axis("intra", "inter"))
+
+
+def test_hierarchy_validation():
+    with pytest.raises(ValueError, match="at least 2 levels"):
+        Hierarchy(("ici", "intra"))
+    with pytest.raises(ValueError, match="unique"):
+        Hierarchy(("ici", "a"), ("ici", "b"))
+    with pytest.raises(TypeError, match="pair"):
+        Hierarchy("intra", "inter")
+    with pytest.raises(AttributeError):
+        hierarchical_axis("intra", "inter").levels = ()
+
+
+def test_hierarchy_from_mesh_validates_axes():
+    with _mesh() as mesh:
+        h = Hierarchy.from_mesh(mesh, intra="intra", inter="inter")
+        assert h == _hier()
+        with pytest.raises(ValueError, match="no axis"):
+            Hierarchy.from_mesh(mesh, intra="intra", inter="nope")
+
+
+def test_hierarchy_pickles():
+    h = _hier()
+    assert pickle.loads(pickle.dumps(h)) == h
+
+
+# ---------------------------------------------------------------------------
+# bit-identity with the flat packed sync
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchical_matches_flat_exact_reductions():
+    """Integer sums, integer-valued float sums, extrema and gathers are
+    bit-identical between the two-level and flat lowerings."""
+    rng = np.random.RandomState(0)
+    per_rank = [
+        {
+            "isum": jnp.asarray(rng.randint(0, 1000, (3, 2)), jnp.int64),
+            "fsum": jnp.asarray(rng.randint(0, 1000, (5,)).astype(np.float64)),
+            "fmax": jnp.asarray(rng.randn(4).astype(np.float32)),
+            "fmin": jnp.asarray(rng.randn(4).astype(np.float32)),
+            "cat": jnp.asarray(rng.randn(2, 3)),
+            "stack": jnp.asarray(rng.randn(3).astype(np.float32)),
+        }
+        for _ in range(WORLD)
+    ]
+    reds = {"isum": "sum", "fsum": "sum", "fmax": "max", "fmin": "min", "cat": "cat", "stack": None}
+    flat = _run_sync(per_rank, reds, FLAT_AXIS)
+    hier = _run_sync(per_rank, reds, _hier())
+    _assert_tree_identical(flat, hier)
+    # explicit levels= spec is the same lowering as the Hierarchy axis
+    explicit = _run_sync(per_rank, reds, FLAT_AXIS, levels=[("ici", "intra"), ("dcn", "inter")])
+    _assert_tree_identical(flat, explicit)
+
+
+def test_hierarchical_mean_matches_flat_on_exact_sums():
+    rng = np.random.RandomState(1)
+    per_rank = [{"m": jnp.asarray(rng.randint(0, 64, (6,)).astype(np.float64))} for _ in range(WORLD)]
+    flat = _run_sync(per_rank, {"m": "mean"}, FLAT_AXIS)
+    hier = _run_sync(per_rank, {"m": "mean"}, _hier())
+    _assert_tree_identical(flat, hier)
+
+
+def test_hierarchical_float_sums_agree_to_reassociation():
+    """Rounding float sums re-associate across the level split: equal to a
+    tight tolerance (a few ulp), never exactly pinned."""
+    rng = np.random.RandomState(2)
+    per_rank = [{"s": jnp.asarray(rng.randn(64))} for _ in range(WORLD)]
+    flat = _run_sync(per_rank, {"s": "sum"}, FLAT_AXIS)
+    hier = _run_sync(per_rank, {"s": "sum"}, _hier())
+    np.testing.assert_allclose(np.asarray(flat["s"]), np.asarray(hier["s"]), rtol=1e-14)
+
+
+def test_hierarchical_list_states_and_empty_lists():
+    rng = np.random.RandomState(3)
+    per_rank = [
+        {"lst": [jnp.asarray(rng.randn(2, 3)), jnp.asarray(rng.randn(1, 3))], "empty": []}
+        for _ in range(WORLD)
+    ]
+    reds = {"lst": "cat", "empty": "cat"}
+    flat = _run_sync(per_rank, reds, FLAT_AXIS)
+    hier = _run_sync(per_rank, reds, _hier())
+    _assert_tree_identical(flat, hier)
+    assert isinstance(hier["empty"], list) and len(hier["empty"]) == 0
+
+
+def test_callable_reduction_bypasses_levels_with_flat_gather():
+    """A custom callable's contract is the stacked per-leaf gather; the
+    hierarchical engine hands it the FLAT gather (same stacked order), so
+    results match the flat path exactly."""
+    rng = np.random.RandomState(4)
+    custom = lambda stacked: jnp.sum(stacked, axis=0) * 2  # noqa: E731
+    per_rank = [
+        {"c": jnp.asarray(rng.randint(0, 9, (3,)).astype(np.float64)),
+         "s": jnp.asarray(rng.randint(0, 9, (2,)), jnp.int64)}
+        for _ in range(WORLD)
+    ]
+    reds = {"c": custom, "s": "sum"}
+    flat = _run_sync(per_rank, reds, FLAT_AXIS)
+    hier = _run_sync(per_rank, reds, _hier())
+    _assert_tree_identical(flat, hier)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_hierarchical_equals_flat_bit_identically(seed):
+    """The acceptance fuzz pin: random mixed-dtype bundles — int32/int64
+    sums, integer-valued f32/f64 sums (exact regardless of association),
+    extrema, cat/stacked gathers, list states — sync bit-identically through
+    the two-level and flat engines."""
+    rng = np.random.RandomState(100 + seed)
+    dtypes = [(jnp.int32, 100), (jnp.int64, 1000), (jnp.float32, 256), (jnp.float64, 4096)]
+    per_rank = []
+    n_leaves = rng.randint(3, 8)
+    specs = []
+    for j in range(n_leaves):
+        dtype, hi = dtypes[rng.randint(len(dtypes))]
+        red = ["sum", "max", "min", "cat", None][rng.randint(5)]
+        shape = tuple(rng.randint(1, 5, size=rng.randint(1, 3)))
+        specs.append((f"leaf{j}", dtype, hi, red, shape))
+    for _ in range(WORLD):
+        state = {}
+        for name, dtype, hi, red, shape in specs:
+            state[name] = jnp.asarray(rng.randint(0, hi, shape), dtype)
+        per_rank.append(state)
+    reds = {name: red for name, _, _, red, _ in specs}
+    flat = _run_sync(per_rank, reds, FLAT_AXIS)
+    hier = _run_sync(per_rank, reds, _hier())
+    _assert_tree_identical(flat, hier)
+
+
+# ---------------------------------------------------------------------------
+# the collective-count guarantee (compiled HLO)
+# ---------------------------------------------------------------------------
+
+
+def test_one_collective_per_level_kind_dtype_bucket():
+    """Mixed (kind, dtype) bundle: flat issues one collective per (kind,
+    dtype); two-level issues EXACTLY one per (level, kind, dtype) — double,
+    nothing more."""
+    state = {
+        "a": jnp.zeros((3,), jnp.float64),
+        "b": jnp.zeros((2,), jnp.float64),
+        "c": jnp.zeros((4,), jnp.int64),
+        "d": jnp.zeros((2,), jnp.float64),
+        "e": jnp.zeros((5,), jnp.float32),
+    }
+    reds = {"a": "sum", "b": "sum", "c": "sum", "d": "max", "e": None}
+
+    def counts(axis):
+        def body(s):
+            return sync_state_packed(s, reds, axis)
+
+        jaxpr = jax.make_jaxpr(_shard_map(body, _mesh(), (P(),), P()))(state)
+        return _count_collectives(jaxpr.jaxpr)
+
+    flat = counts(FLAT_AXIS)
+    hier = counts(_hier())
+    # flat buckets: psum/f64 (a+b), psum/i64 (c), pmax/f64 (d), gather/f32 (e)
+    assert flat == {"psum": 2, "pmax": 1, "all_gather": 1}
+    assert hier == {k: 2 * v for k, v in flat.items()}
+
+
+def test_ten_metric_collection_hierarchical_collective_counts():
+    """The canonical 10-metric classification collection's two-level epoch
+    sync issues exactly twice the flat packed counts — the per-(level, kind,
+    dtype) acceptance pin on the real collection program."""
+    from metrics_tpu import (
+        CohenKappa,
+        ConfusionMatrix,
+        HammingDistance,
+        IoU,
+        MatthewsCorrcoef,
+    )
+
+    nc = 5
+    coll = MetricCollection(
+        [
+            Accuracy(),
+            Precision(average="macro", num_classes=nc),
+            Recall(average="macro", num_classes=nc),
+            F1(average="macro", num_classes=nc),
+            Specificity(average="macro", num_classes=nc),
+            HammingDistance(),
+            ConfusionMatrix(num_classes=nc),
+            CohenKappa(num_classes=nc),
+            MatthewsCorrcoef(num_classes=nc),
+            IoU(num_classes=nc),
+        ]
+    )
+    preds = jnp.asarray(np.random.RandomState(0).rand(16, nc).astype(np.float32))
+    target = jnp.asarray(np.random.RandomState(1).randint(0, nc, 16))
+    state = coll.apply_update(coll.init_state(), preds, target)
+
+    def counts(axis):
+        jaxpr = jax.make_jaxpr(
+            _shard_map(lambda s: coll.apply_compute(s, axis_name=axis), _mesh(), (P(),), P())
+        )(state)
+        return _count_collectives(jaxpr.jaxpr)
+
+    flat = counts(FLAT_AXIS)
+    hier = counts(_hier())
+    assert hier == {k: 2 * v for k, v in flat.items()}
+    assert sum(hier.values()) <= 8  # two levels of the <=4-collective pin
+
+
+def test_collection_hierarchical_values_match_flat():
+    nc = 3
+    coll = MetricCollection(
+        [Accuracy(), Precision(average="macro", num_classes=nc), Recall(average="macro", num_classes=nc)]
+    )
+    rng = np.random.RandomState(5)
+    per_rank = [
+        coll.apply_update(
+            coll.init_state(),
+            jnp.asarray(rng.rand(8, nc).astype(np.float32)),
+            jnp.asarray(rng.randint(0, nc, 8)),
+        )
+        for _ in range(WORLD)
+    ]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(list(xs)), *per_rank)
+
+    def run(axis):
+        def body(state):
+            state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
+            return coll.apply_compute(state, axis_name=axis)
+
+        fn = jax.jit(_shard_map(body, _mesh(), (P(("inter", "intra")),), P()))
+        return jax.tree.map(np.asarray, fn(stacked))
+
+    flat_vals = run(FLAT_AXIS)
+    hier_vals = run(_hier())
+    for k in flat_vals:
+        np.testing.assert_array_equal(flat_vals[k], hier_vals[k]), k
+
+
+def test_metric_process_group_hierarchy_is_default_axis():
+    """A metric declaring ``process_group=Hierarchy(...)`` syncs two-level
+    from ``apply_compute`` with no axis argument — the constructor spec is
+    the default axis, exactly as for a plain mesh-axis name."""
+    acc = Accuracy(process_group=_hier())
+    rng = np.random.RandomState(6)
+    per_rank = [
+        acc.apply_update(
+            acc.init_state(),
+            jnp.asarray(rng.rand(8, 3).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 3, 8)),
+        )
+        for _ in range(WORLD)
+    ]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(list(xs)), *per_rank)
+
+    def body(state):
+        state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
+        return acc.apply_compute(state)  # axis defaults to the process_group
+
+    value = np.asarray(
+        jax.jit(_shard_map(body, _mesh(), (P(("inter", "intra")),), P()))(stacked)
+    )
+
+    flat = Accuracy(process_group=FLAT_AXIS)
+    def body_flat(state):
+        state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
+        return flat.apply_compute(state)
+
+    expected = np.asarray(
+        jax.jit(_shard_map(body_flat, _mesh(), (P(("inter", "intra")),), P()))(stacked)
+    )
+    np.testing.assert_array_equal(value, expected)
+
+
+def test_compute_groups_sync_one_bundle_per_group_hierarchically():
+    """The stat-scores quintet collapses to ONE bundle; its two-level sync
+    issues one collective per (level, kind, dtype) of that single bundle."""
+    nc = 5
+    kw = dict(average="macro", num_classes=nc)
+    coll = MetricCollection(
+        [Precision(**kw), Recall(**kw), F1(**kw), Specificity(**kw),
+         StatScores(reduce="macro", num_classes=nc)]
+    )
+    preds = jnp.asarray(np.random.RandomState(7).rand(8, nc).astype(np.float32))
+    target = jnp.asarray(np.random.RandomState(8).randint(0, nc, 8))
+    coll.build_compute_groups(preds, target)
+    state = coll.apply_update(coll.init_state(), preds, target)
+
+    def counts(axis):
+        jaxpr = jax.make_jaxpr(
+            _shard_map(lambda s: coll.apply_compute(s, axis_name=axis), _mesh(), (P(),), P())
+        )(state)
+        return _count_collectives(jaxpr.jaxpr)
+
+    flat = counts(FLAT_AXIS)
+    hier = counts(_hier())
+    assert sum(flat.values()) == 1  # one grouped bundle, one i64 psum
+    assert hier == {k: 2 * v for k, v in flat.items()}
+
+
+# ---------------------------------------------------------------------------
+# trace-time telemetry: per-level bucket composition
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchical_telemetry_per_level_buckets_and_counts():
+    observability.reset()
+    state = {"a": jnp.zeros((3,), jnp.float64), "b": jnp.zeros((2,), jnp.int64)}
+    reds = {"a": "sum", "b": "max"}
+    jax.make_jaxpr(
+        _shard_map(lambda s: sync_state_packed(s, reds, _hier()), _mesh(), (P(),), P())
+    )(state)
+    ig = observability.snapshot()["sync"]["in_graph"]
+    # bucket composition keyed per (level, kind, dtype)
+    assert ig["buckets"] == {
+        "ici/psum/float64": 1, "dcn/psum/float64": 1,
+        "ici/pmax/int64": 1, "dcn/pmax/int64": 1,
+    }
+    # 2 per-leaf collectives fuse into 2 buckets x 2 levels = 4 issued
+    assert ig["collectives_before"] == 2
+    assert ig["collectives_after"] == 4
+    assert ig["levels"] == {"ici": 1, "dcn": 1}
+    # the sync event carries the level labels and the per-level buckets
+    events = [
+        e for e in observability.EVENTS.events()
+        if e.kind == "sync" and e.payload.get("in_graph")
+    ]
+    assert events and events[-1].payload["levels"] == ["ici", "dcn"]
+    assert "ici/psum/float64" in events[-1].payload["buckets"]
+    # ... and the Prometheus renderer emits the per-level families
+    text = observability.render_prometheus()
+    assert 'metrics_tpu_sync_in_graph_level_syncs_total{level="ici"} 1' in text
+    assert 'metrics_tpu_sync_in_graph_bucket_states_total{bucket="dcn/pmax/int64"} 1' in text

@@ -512,3 +512,47 @@ def test_no_traced_ops_added_to_compiled_update(stream):
     got = float(m.apply_compute(state, axis_name=None))
     want = float(np.mean(probs.argmax(-1) == target))
     np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_exposition_covers_async_and_per_level_families():
+    """Satellite: the hierarchical/async families — ``async_sync_*``
+    counters, the ``transport=dcn`` round-trip histogram, the per-transport
+    gather counter and the per-level in-graph counter — render with
+    HELP/TYPE and pass the exposition checker."""
+    from metrics_tpu.observability.histogram import observe_sync_round_trip
+
+    observability.reset()
+    # per-level gather telemetry (the async engine's cross-host label)
+    observability.TELEMETRY.record_gather(
+        bytes_out=8, bytes_in=8, transport_bytes=16, descriptor_rounds=1,
+        payload_rounds=1, world=2, members=[0, 1], leaves=1, transport="dcn",
+    )
+    observe_sync_round_trip(0.002, transport="dcn")
+    # hierarchical in-graph lowering: per-level buckets + level labels
+    observability.TELEMETRY.record_in_graph_sync(
+        "('inter', 'intra')", {"psum": 2}, 64,
+        buckets={"ici/psum/float64": 2, "dcn/psum/float64": 2},
+        collectives_before=2, collectives_after=4, levels=["ici", "dcn"],
+    )
+    # the background engine's counters ride the snapshot
+    from metrics_tpu.utilities.async_sync import get_engine
+
+    get_engine().submit("exposition_probe", lambda: 1).result(5.0)
+
+    text = observability.render_prometheus()
+    samples = _check_exposition_format(text)
+    names = {s[0] for s in samples}
+    assert "metrics_tpu_sync_transport_gathers_total" in names
+    assert "metrics_tpu_sync_in_graph_level_syncs_total" in names
+    assert "metrics_tpu_async_sync_submitted_total" in names
+    assert "metrics_tpu_async_sync_in_flight" in names
+    assert 'metrics_tpu_sync_round_trip_seconds_bucket' in names
+    by_name = {}
+    for name, labels, _ in samples:
+        by_name.setdefault(name, []).append(labels)
+    assert {"transport": "dcn"} in by_name["metrics_tpu_sync_transport_gathers_total"]
+    assert {"level": "ici"} in by_name["metrics_tpu_sync_in_graph_level_syncs_total"]
+    assert any(
+        lbls.get("bucket") == "dcn/psum/float64"
+        for lbls in by_name["metrics_tpu_sync_in_graph_bucket_states_total"]
+    )

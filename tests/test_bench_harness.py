@@ -258,3 +258,45 @@ def test_compute_group_bench_record_round_trips(monkeypatch):
     assert line["sync_leaves_before"] == 20 and line["sync_leaves_after"] == 4
     assert "telemetry" in line
     assert "bench_collection_compute_groups" in bench_suite.CONFIG_META
+
+
+def test_hierarchical_sync_bench_record_round_trips(monkeypatch):
+    """The hierarchical-sync config's record must survive json round-trips
+    and carry the per-level evidence: one collective per (level, kind,
+    dtype) — the flat counts doubled across the two levels — with the level
+    labels and mesh shape pinned in the record."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "SYNC_STEPS", 8)
+    line = bench_suite.run_config(bench_suite.bench_collection_sync_hierarchical, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "collection_sync_hierarchical_step"
+    assert line["levels"] == ["ici", "dcn"]
+    per_level = line["collectives_per_level"]
+    assert set(per_level) == {"ici", "dcn"}
+    assert per_level["ici"] == per_level["dcn"]  # one collective per level per bucket
+    assert line["collectives_hierarchical"] == 2 * line["collectives_flat"]
+    assert sum(per_level.values()) == line["collectives_hierarchical"]
+    assert "telemetry" in line
+    assert "bench_collection_sync_hierarchical" in bench_suite.CONFIG_META
+
+
+def test_compute_async_overlap_bench_record_round_trips(monkeypatch):
+    """The async-overlap config's record must survive json round-trips and
+    carry the acceptance evidence: overlap fraction > 0.5 on the simulated
+    2-host harness, steps proceeding during the in-flight sync, and a future
+    bit-identical to the synchronous compute of the same snapshot."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "ASYNC_ROUND_SLEEP_S", 0.02)
+    line = bench_suite.run_config(bench_suite.bench_compute_async_overlap, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "compute_async_overlap" and line["unit"] == "us/submit"
+    assert line["overlap_fraction"] > 0.5  # the acceptance pin
+    assert line["steps_during_flight"] >= 1
+    assert line["values_match"] is True
+    assert line["simulated_hosts"] == 2
+    assert line["transport_rounds"] == {"descriptor": 1, "payload": 1}
+    assert "bench_compute_async_overlap" in bench_suite.CONFIG_META

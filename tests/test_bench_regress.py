@@ -243,3 +243,106 @@ def test_committed_trajectory_passes():
     rows = bench_regress.check_trajectory(bench_regress.load_trajectory(paths))
     assert any(r["metric"] == "metric_collection_update_step_fused" for r in rows)
     assert all(r["status"] != bench_regress.REGRESSED for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# the MULTICHIP_r* dryrun trajectory (satellite: gate both trajectories)
+# ---------------------------------------------------------------------------
+
+
+def _multichip_capture(tmp_path, n, rc=0, ok=None, skipped=False, n_devices=8):
+    doc = {
+        "n_devices": n_devices,
+        "rc": rc,
+        "ok": (rc == 0) if ok is None else ok,
+        "skipped": skipped,
+        "tail": "dryrun tail",
+    }
+    path = tmp_path / f"MULTICHIP_r{n:02d}.json"
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_multichip_capture_adapts_to_record_shape(tmp_path):
+    n, by_metric = bench_regress.load_multichip_round(
+        _multichip_capture(tmp_path, 3, rc=0)
+    )
+    assert n == 3
+    (rec,) = by_metric.values()
+    assert rec["metric"] == "multichip_dryrun_8dev"
+    assert rec["value"] == 0.0 and rec["unit"] == "rc" and rec["degraded"] is False
+
+
+def test_multichip_skipped_capture_is_degraded(tmp_path):
+    _, by_metric = bench_regress.load_multichip_round(
+        _multichip_capture(tmp_path, 2, rc=0, skipped=True)
+    )
+    (rec,) = by_metric.values()
+    assert rec["degraded"] is True
+    # a degraded latest is skipped, not judged — same rule as bench records
+    paths = [
+        _multichip_capture(tmp_path, i, rc=0) for i in (3, 4, 5)
+    ] + [_multichip_capture(tmp_path, 6, rc=0, skipped=True)]
+    rows = bench_regress.check_trajectory(bench_regress.load_multichip_trajectory(paths))
+    (row,) = rows
+    assert row["status"] == bench_regress.SKIPPED_DEGRADED
+
+
+def test_multichip_corrupt_capture_degrades_to_failure(tmp_path):
+    path = tmp_path / "MULTICHIP_r07.json"
+    path.write_text("not json at all")
+    _, by_metric = bench_regress.load_multichip_round(str(path))
+    (rec,) = by_metric.values()
+    assert rec["value"] == 1.0  # unparseable capture cannot silently pass
+
+
+def test_multichip_failed_latest_dryrun_regresses(tmp_path):
+    """With a healthy rc=0 baseline, a latest rc=1 dryrun fails the gate —
+    the zero baseline judges by sign (any positive latest regresses)."""
+    paths = [_multichip_capture(tmp_path, i, rc=0) for i in (1, 2, 3)]
+    paths.append(_multichip_capture(tmp_path, 4, rc=1))
+    rows = bench_regress.check_trajectory(bench_regress.load_multichip_trajectory(paths))
+    (row,) = rows
+    assert row["status"] == bench_regress.REGRESSED
+    assert row["baseline"] == 0.0 and row["delta_pct"] is None
+
+
+def test_multichip_healthy_latest_passes_and_early_failure_does_not_poison(tmp_path):
+    """An rc=1 round in the HISTORY (the committed r01 shape) does not move
+    the median-of-healthy baseline; a healthy latest stays OK."""
+    paths = [_multichip_capture(tmp_path, 1, rc=1)]
+    paths += [_multichip_capture(tmp_path, i, rc=0) for i in (2, 3, 4, 5)]
+    rows = bench_regress.check_trajectory(bench_regress.load_multichip_trajectory(paths))
+    (row,) = rows
+    assert row["status"] == bench_regress.OK and row["baseline"] == 0.0
+
+
+def test_main_gates_both_trajectories_in_one_table(tmp_path, capsys):
+    bench_paths = _rounds(tmp_path, [10.0, 11.0, 9.5, 10.5])
+    mc_paths = [_multichip_capture(tmp_path, i, rc=0) for i in (1, 2, 3)]
+    mc_paths.append(_multichip_capture(tmp_path, 4, rc=1))
+    rc = bench_regress.main(bench_paths + ["--check", "--multichip"] + mc_paths)
+    out = capsys.readouterr().out
+    assert rc == 1  # the failed dryrun fails the combined gate
+    assert "multichip_dryrun_8dev" in out and "m " in out
+
+
+def test_main_explicit_bench_paths_skip_multichip_by_default(tmp_path):
+    # hermetic unit runs: naming bench captures does not drag the committed
+    # repo MULTICHIP trajectory into the table
+    paths = _rounds(tmp_path, [10.0, 11.0, 9.5, 10.5])
+    assert bench_regress.main(paths + ["--check"]) == 0
+
+
+def test_committed_multichip_trajectory_passes():
+    """Acceptance: the repo's own MULTICHIP_r01..r05 history stays green
+    (r01's failed dryrun is history, not the latest round)."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(REPO_ROOT, "MULTICHIP_r*.json")))
+    assert len(paths) >= 5
+    rows = bench_regress.check_trajectory(bench_regress.load_multichip_trajectory(paths))
+    assert rows and all(r["status"] != bench_regress.REGRESSED for r in rows)
+    # ... and the default no-args gate (make bench-regress) judges BOTH
+    # committed trajectories green
+    assert bench_regress.main(["--check"]) == 0

@@ -155,3 +155,44 @@ def test_observability_page_cross_linked():
         assert "observability.md" in fh.read()
     with open(os.path.join(os.path.dirname(DOCS_DIR), "README.md")) as fh:
         assert "docs/observability.md" in fh.read()
+
+
+def test_hierarchical_async_sync_documented_and_cross_linked():
+    """The hierarchical/async sync user contract lives in two places: the
+    performance guide (the Hierarchy spec, compute_async, the degraded-link
+    policies) and the observability guide (per-level buckets/labels, the
+    async engine's counters/events), cross-linked both ways."""
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "## Hierarchical & async sync" in perf
+    for phrase in (
+        "hierarchical_axis",
+        "Hierarchy",
+        "compute_async",
+        "on_degraded",
+        "round_timeout_s",
+        '"retry"',
+        '"stale"',
+        '"quorum"',
+        "degraded_processes",
+    ):
+        assert phrase in perf, phrase
+    assert "observability.md#hierarchical--async-sync-telemetry" in perf
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "## Hierarchical & async sync telemetry" in obs
+    for phrase in (
+        "ici/psum/float64",
+        'transport="dcn"',
+        "async_sync",
+        "stale_serves",
+        "quorum_syncs",
+        "degraded_rounds",
+        "compute_async_calls",
+        "generations",
+        "metrics_tpu_sync_in_graph_level_syncs_total",
+        "metrics_tpu_sync_transport_gathers_total",
+        "metrics_tpu_async_sync_",
+    ):
+        assert phrase in obs, phrase
+    assert "performance.md#hierarchical--async-sync" in obs
