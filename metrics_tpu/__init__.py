@@ -47,6 +47,7 @@ from metrics_tpu.classification import (  # noqa: F401 E402
 from metrics_tpu.collections import MetricCollection  # noqa: F401 E402
 from metrics_tpu.image import FID, IS, KID, PSNR, SSIM  # noqa: F401 E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401 E402
+from metrics_tpu.utilities.capped_buffer import BufferOverflowError  # noqa: F401 E402
 from metrics_tpu.utilities.distributed import Hierarchy, hierarchical_axis  # noqa: F401 E402
 from metrics_tpu.regression import (  # noqa: F401 E402
     CosineSimilarity,
@@ -80,6 +81,7 @@ __all__ = [
     "BinnedPrecisionRecallCurve",
     "BinnedRecallAtFixedPrecision",
     "BootStrapper",
+    "BufferOverflowError",
     "CohenKappa",
     "CompositionalMetric",
     "ConfusionMatrix",

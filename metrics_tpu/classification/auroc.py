@@ -13,18 +13,20 @@ per-step retracing, SURVEY hard part #1). Binary by default; multiclass via
 ``multilabel=True``. Samples past the capacity are dropped (tracked by the
 counter; a warning is raised at eager compute).
 """
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.capped_buffer import CappedBufferMixin
+from metrics_tpu.utilities.sketching import HistogramSketchMixin
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.functional.classification.masked_curves import masked_binary_auroc
+from metrics_tpu.kernels.sketches import hist_auroc
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array, dim_zero_cat
 
 
-class AUROC(CappedBufferMixin, Metric):
+class AUROC(HistogramSketchMixin, CappedBufferMixin, Metric):
     """Area under the ROC curve over all batches.
 
     Args:
@@ -45,9 +47,30 @@ class AUROC(CappedBufferMixin, Metric):
             one-vs-rest macro/weighted average. Samples past the capacity
             are dropped with a warning (see ``docs/overview.md``).
             Incompatible with ``max_fpr``.
-        multilabel: capacity-mode hint that the ``(N, C)`` inputs are
-            per-label binaries rather than class probabilities (the list
-            mode infers this from data; a preallocated buffer cannot).
+        multilabel: capacity/sketched-mode hint that the ``(N, C)`` inputs
+            are per-label binaries rather than class probabilities (the list
+            mode infers this from data; a preallocated state cannot).
+        sketched: bounded-memory streaming mode — accumulate per-bin score
+            histograms split by label instead of the O(samples) lists or the
+            O(capacity) buffer. State is two fixed ``(C, num_bins)`` count
+            tensors synced by ONE ``psum`` regardless of sample count, fully
+            eligible for ``jit_forward``/donation/``update_many``/compute
+            groups/``keyed``. The value matches the exact computation to
+            within the documented tolerance (each histogram bin acts as one
+            prediction tie group; see
+            ``docs/performance.md#bounded-memory-sketched-states``).
+            Incompatible with ``capacity`` and ``max_fpr``; exact mode (the
+            default) remains bit-faithful to the reference.
+        num_bins: sketched-mode histogram resolution (default 2048; 16 KB of
+            state in binary mode). More bins tighten the approximation.
+        score_range: sketched-mode score grid bounds (default ``(0, 1)``,
+            matching probability scores); out-of-range scores clip into the
+            edge bins and are counted in ``sketch_clipped``. Pass the logit
+            range explicitly when feeding raw logits.
+        overflow: capacity-mode policy past the buffer — ``"warn"`` (drop +
+            warn, the default) or ``"error"`` (raise
+            :class:`~metrics_tpu.utilities.capped_buffer.BufferOverflowError`
+            at the next eager compute).
         compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
             the common lifecycle quartet — see :class:`~metrics_tpu.Metric`.
 
@@ -63,6 +86,11 @@ class AUROC(CappedBufferMixin, Metric):
 
     is_differentiable = False
     _fusable = False
+    _sketch_hint = (
+        "Alternatively, AUROC(sketched=True) keeps fixed-size binned-histogram"
+        " states (bounded memory, one psum at sync regardless of sample count;"
+        " see docs/performance.md#bounded-memory-sketched-states)."
+    )
 
     def __init__(
         self,
@@ -72,6 +100,10 @@ class AUROC(CappedBufferMixin, Metric):
         max_fpr: Optional[float] = None,
         capacity: Optional[int] = None,
         multilabel: bool = False,
+        sketched: bool = False,
+        num_bins: int = 2048,
+        score_range: Tuple[float, float] = (0.0, 1.0),
+        overflow: str = "warn",
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -88,6 +120,7 @@ class AUROC(CappedBufferMixin, Metric):
         self.average = average
         self.max_fpr = max_fpr
         self.capacity = capacity
+        self.sketched = sketched
         self.mode = None
 
         allowed_average = (None, "macro", "weighted", "micro")
@@ -99,20 +132,34 @@ class AUROC(CappedBufferMixin, Metric):
         if max_fpr is not None and (not isinstance(max_fpr, float) or not 0 < max_fpr <= 1):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
-        if capacity is not None:
+        if sketched:
+            if capacity is not None:
+                raise ValueError("`sketched` and `capacity` modes are mutually exclusive")
+            if max_fpr is not None:
+                raise ValueError("`sketched` mode does not support `max_fpr`")
+            if num_classes is not None and num_classes > 1 and average not in (None, "macro", "weighted"):
+                raise ValueError("multi-class `sketched` mode supports average None, 'macro' or 'weighted'")
+            # histogram states are plain "sum" arrays: the fused single-update
+            # forward (and with it compute groups) applies
+            self._fusable = True
+            self._init_hist_states(num_bins, score_range, num_classes, pos_label, multilabel=multilabel)
+        elif capacity is not None:
             if max_fpr is not None:
                 raise ValueError("`capacity` mode does not support `max_fpr`")
             if num_classes is not None and num_classes > 1 and average not in ("macro", "weighted"):
                 raise ValueError("multi-column `capacity` mode supports average 'macro' or 'weighted'")
-            self._init_capacity_states(capacity, num_classes, pos_label, multilabel=multilabel)
+            self._init_capacity_states(capacity, num_classes, pos_label, multilabel=multilabel, overflow=overflow)
         else:
             if multilabel:
-                raise ValueError("`multilabel` is a `capacity`-mode hint; list mode infers it from data")
+                raise ValueError("`multilabel` is a `capacity`/`sketched`-mode hint; list mode infers it from data")
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Append the batch scores/targets to the state."""
+        if self.sketched:
+            self._hist_update(preds, target)
+            return
         if self.capacity is not None:
             self._buffer_update(preds, target)
             return
@@ -130,6 +177,19 @@ class AUROC(CappedBufferMixin, Metric):
 
     def compute(self) -> Array:
         """AUROC over everything seen so far."""
+        if self.sketched:
+            supports = self._hist_check_degenerate()
+            per_class = hist_auroc(self.pos_hist, self.neg_hist)
+            self._publish_hist_info()
+            if self._sketch_multiclass or self._sketch_multilabel:
+                if self.average == "weighted":
+                    support = supports if supports is not None else jnp.sum(self.pos_hist, axis=-1)
+                    return jnp.sum(per_class * support / jnp.maximum(jnp.sum(support), 1.0))
+                if self.average is None:
+                    return per_class
+                return jnp.mean(per_class)
+            return per_class[0]
+
         if self.capacity is not None:
             preds, target, valid = self._buffer_flatten()
             supports = self._check_degenerate_classes(target, valid)

@@ -6,19 +6,21 @@ average_precision.py:28-132``, plus the TPU ``capacity`` extension (see
 step-invariant, so the metric runs inside ``jit``/``shard_map`` without
 retracing.
 """
-from typing import Any, Callable, List, Optional, Union
+from typing import Any, Callable, List, Optional, Tuple, Union
 
 from metrics_tpu.utilities.capped_buffer import CappedBufferMixin
+from metrics_tpu.utilities.sketching import HistogramSketchMixin
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
 from metrics_tpu.functional.classification.masked_curves import masked_binary_average_precision
+from metrics_tpu.kernels.sketches import hist_average_precision
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array, dim_zero_cat
 
 
-class AveragePrecision(CappedBufferMixin, Metric):
+class AveragePrecision(HistogramSketchMixin, CappedBufferMixin, Metric):
     """Average precision over all batches.
 
     Args:
@@ -27,9 +29,19 @@ class AveragePrecision(CappedBufferMixin, Metric):
             without per-step retracing. Binary by default; with
             ``num_classes > 1`` compute returns the per-class one-vs-rest
             APs as a ``(C,)`` array.
-        multilabel: capacity-mode hint that the ``(N, C)`` inputs are
-            per-label binaries rather than class probabilities (the list
-            mode infers this from data; a preallocated buffer cannot).
+        multilabel: capacity/sketched-mode hint that the ``(N, C)`` inputs
+            are per-label binaries rather than class probabilities (the list
+            mode infers this from data; a preallocated state cannot).
+        sketched: bounded-memory streaming mode — fixed ``(C, num_bins)``
+            label-histogram states synced by one ``psum`` regardless of
+            sample count, eligible for the whole compiled hot path; matches
+            the exact AP within the documented tolerance (see
+            ``docs/performance.md#bounded-memory-sketched-states``).
+        num_bins / score_range: sketched-mode grid (see
+            :class:`~metrics_tpu.AUROC`).
+        overflow: capacity-mode policy past the buffer — ``"warn"`` (drop +
+            warn) or ``"error"`` (raise ``BufferOverflowError`` at the next
+            eager compute).
 
     Example:
         >>> import jax.numpy as jnp
@@ -43,6 +55,11 @@ class AveragePrecision(CappedBufferMixin, Metric):
 
     is_differentiable = False
     _fusable = False
+    _sketch_hint = (
+        "Alternatively, AveragePrecision(sketched=True) keeps fixed-size"
+        " binned-histogram states (bounded memory, one psum at sync; see"
+        " docs/performance.md#bounded-memory-sketched-states)."
+    )
 
     def __init__(
         self,
@@ -50,6 +67,10 @@ class AveragePrecision(CappedBufferMixin, Metric):
         pos_label: Optional[int] = None,
         capacity: Optional[int] = None,
         multilabel: bool = False,
+        sketched: bool = False,
+        num_bins: int = 2048,
+        score_range: Tuple[float, float] = (0.0, 1.0),
+        overflow: str = "warn",
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -64,17 +85,26 @@ class AveragePrecision(CappedBufferMixin, Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
         self.capacity = capacity
+        self.sketched = sketched
 
-        if capacity is not None:
-            self._init_capacity_states(capacity, num_classes, pos_label, multilabel=multilabel)
+        if sketched:
+            if capacity is not None:
+                raise ValueError("`sketched` and `capacity` modes are mutually exclusive")
+            self._fusable = True
+            self._init_hist_states(num_bins, score_range, num_classes, pos_label, multilabel=multilabel)
+        elif capacity is not None:
+            self._init_capacity_states(capacity, num_classes, pos_label, multilabel=multilabel, overflow=overflow)
         else:
             if multilabel:
-                raise ValueError("`multilabel` is a `capacity`-mode hint; list mode infers it from data")
+                raise ValueError("`multilabel` is a `capacity`/`sketched`-mode hint; list mode infers it from data")
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Append the canonicalized batch to the state."""
+        if self.sketched:
+            self._hist_update(preds, target)
+            return
         if self.capacity is not None:
             self._buffer_update(preds, target)
             return
@@ -89,6 +119,15 @@ class AveragePrecision(CappedBufferMixin, Metric):
 
     def compute(self) -> Union[List[Array], Array]:
         """Average precision over everything seen so far."""
+        if self.sketched:
+            # per-class/label APs as a (C,) array (binary: the scalar) — the
+            # reference *returns* NaN for degenerate streams, so no raise
+            per_class = hist_average_precision(self.pos_hist, self.neg_hist)
+            self._publish_hist_info()
+            if self._sketch_multiclass or self._sketch_multilabel:
+                return per_class
+            return per_class[0]
+
         if self.capacity is not None:
             preds, target, valid = self._buffer_flatten()
             if self._capacity_multiclass or self._capacity_multilabel:

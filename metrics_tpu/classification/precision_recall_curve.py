@@ -10,11 +10,13 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
 )
+from metrics_tpu.kernels.sketches import hist_precision_recall_curve
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array, dim_zero_cat
+from metrics_tpu.utilities.sketching import HistogramSketchMixin
 
 
-class PrecisionRecallCurve(Metric):
+class PrecisionRecallCurve(HistogramSketchMixin, Metric):
     """Precision/recall pairs at every distinct threshold, over all batches.
 
     Args:
@@ -24,7 +26,15 @@ class PrecisionRecallCurve(Metric):
 
     Output shapes depend on the data (one point per distinct threshold), so
     compute is an epoch-end operation; inside a compiled step use the
-    fixed-shape :class:`~metrics_tpu.BinnedPrecisionRecallCurve`.
+    fixed-shape :class:`~metrics_tpu.BinnedPrecisionRecallCurve` — or
+    ``sketched=True``, which accumulates fixed ``(C, num_bins)`` label
+    histograms (one bucketing pass per update instead of the binned mode's
+    O(N·T) compare, one ``psum`` at sync regardless of sample count) and
+    returns the curve at the ascending bin-edge grid in the
+    :class:`~metrics_tpu.BinnedPrecisionRecallCurve` output convention.
+    ``num_bins``/``score_range``/``multilabel`` as on
+    :class:`~metrics_tpu.AUROC`; see
+    ``docs/performance.md#bounded-memory-sketched-states``.
 
     Example (binary):
         >>> import jax.numpy as jnp
@@ -39,11 +49,21 @@ class PrecisionRecallCurve(Metric):
 
     is_differentiable = False
     _fusable = False  # curve forward values are tuples/lists, not mergeable arrays
+    _sketch_hint = (
+        "Alternatively, PrecisionRecallCurve(sketched=True) keeps fixed-size"
+        " binned-histogram states and returns the curve at the fixed bin-edge"
+        " grid (bounded memory, one psum at sync; see"
+        " docs/performance.md#bounded-memory-sketched-states)."
+    )
 
     def __init__(
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        sketched: bool = False,
+        num_bins: int = 2048,
+        score_range: Tuple[float, float] = (0.0, 1.0),
+        multilabel: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -57,12 +77,22 @@ class PrecisionRecallCurve(Metric):
         )
         self.num_classes = num_classes
         self.pos_label = pos_label
+        self.sketched = sketched
 
+        if sketched:
+            self._fusable = True
+            self._init_hist_states(num_bins, score_range, num_classes, pos_label, multilabel=multilabel)
+            return
+        if multilabel:
+            raise ValueError("`multilabel` is a `sketched`-mode hint; list mode infers it from data")
         self.add_state("preds", default=[], dist_reduce_fx="cat")
         self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Append the canonicalized batch to the curve state."""
+        if self.sketched:
+            self._hist_update(preds, target)
+            return
         preds, target, num_classes, pos_label = _precision_recall_curve_update(
             preds, target, self.num_classes, self.pos_label
         )
@@ -73,6 +103,13 @@ class PrecisionRecallCurve(Metric):
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         """(precision, recall, thresholds) over everything seen so far."""
+        if self.sketched:
+            lo, hi = self._sketch_range
+            precision, recall, thresholds = hist_precision_recall_curve(self.pos_hist, self.neg_hist, lo, hi)
+            self._publish_hist_info()
+            if self._sketch_multiclass or self._sketch_multilabel:
+                return list(precision), list(recall), [thresholds for _ in range(self.num_classes)]
+            return precision[0], recall[0], thresholds
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

@@ -6,11 +6,13 @@ roc.py:24-172``.
 from typing import Any, Callable, List, Optional, Tuple, Union
 
 from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
+from metrics_tpu.kernels.sketches import hist_roc
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array, dim_zero_cat
+from metrics_tpu.utilities.sketching import HistogramSketchMixin
 
 
-class ROC(Metric):
+class ROC(HistogramSketchMixin, Metric):
     """ROC curve (fpr, tpr, thresholds) over all batches.
 
     Args:
@@ -20,7 +22,13 @@ class ROC(Metric):
 
     Like :class:`~metrics_tpu.PrecisionRecallCurve`, output shapes are
     data-dependent — an epoch-end metric; use :class:`~metrics_tpu.AUROC`
-    with ``capacity=`` for the jit-native scalar.
+    with ``capacity=`` for the jit-native scalar — **unless** ``sketched=True``:
+    the sketched mode accumulates fixed ``(C, num_bins)`` label histograms
+    (bounded memory, one ``psum`` at sync regardless of sample count) and
+    returns the curve at the ``num_bins + 1`` grid points, a fixed shape
+    that lives inside compiled programs. ``num_bins``/``score_range``/
+    ``multilabel`` as on :class:`~metrics_tpu.AUROC`; see
+    ``docs/performance.md#bounded-memory-sketched-states``.
 
     Example (binary):
         >>> import jax.numpy as jnp
@@ -35,11 +43,21 @@ class ROC(Metric):
 
     is_differentiable = False
     _fusable = False
+    _sketch_hint = (
+        "Alternatively, ROC(sketched=True) keeps fixed-size binned-histogram"
+        " states and returns the curve at the fixed bin-edge grid (bounded"
+        " memory, one psum at sync; see"
+        " docs/performance.md#bounded-memory-sketched-states)."
+    )
 
     def __init__(
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        sketched: bool = False,
+        num_bins: int = 2048,
+        score_range: Tuple[float, float] = (0.0, 1.0),
+        multilabel: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -53,12 +71,22 @@ class ROC(Metric):
         )
         self.num_classes = num_classes
         self.pos_label = pos_label
+        self.sketched = sketched
 
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if sketched:
+            self._fusable = True
+            self._init_hist_states(num_bins, score_range, num_classes, pos_label, multilabel=multilabel)
+        else:
+            if multilabel:
+                raise ValueError("`multilabel` is a `sketched`-mode hint; list mode infers it from data")
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Append the canonicalized batch to the curve state."""
+        if self.sketched:
+            self._hist_update(preds, target)
+            return
         preds, target, num_classes, pos_label = _roc_update(preds, target, self.num_classes, self.pos_label)
         self.preds.append(preds)
         self.target.append(target)
@@ -67,6 +95,13 @@ class ROC(Metric):
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         """(fpr, tpr, thresholds) over everything seen so far."""
+        if self.sketched:
+            lo, hi = self._sketch_range
+            fpr, tpr, thresholds = hist_roc(self.pos_hist, self.neg_hist, lo, hi)
+            self._publish_hist_info()
+            if self._sketch_multiclass or self._sketch_multilabel:
+                return list(fpr), list(tpr), [thresholds for _ in range(self.num_classes)]
+            return fpr[0], tpr[0], thresholds
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _roc_compute(preds, target, self.num_classes, self.pos_label)

@@ -21,4 +21,18 @@ from metrics_tpu.kernels.confusion_matrix import (  # noqa: F401
 from metrics_tpu.kernels.binned_counts import (  # noqa: F401
     binned_tp_fp_fn,
     binned_tp_fp_fn_xla,
+    label_score_histograms,
+)
+from metrics_tpu.kernels.sketches import (  # noqa: F401
+    bounded_priority_keep,
+    cdf_sketch_cdf,
+    cdf_sketch_quantile,
+    hist_auroc,
+    hist_average_precision,
+    hist_precision_recall_curve,
+    hist_roc,
+    joint_grid_update,
+    spearman_from_grid,
+    uniform_hash,
+    weighted_priority,
 )

@@ -20,6 +20,45 @@ import jax
 import jax.numpy as jnp
 
 
+def label_score_histograms(
+    preds: jax.Array,
+    target: jax.Array,
+    num_bins: int,
+    lo: float = 0.0,
+    hi: float = 1.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-bin score counts split by label: two ``(C, B)`` float32 histograms.
+
+    The bounded-memory dual of :func:`binned_tp_fp_fn`: instead of comparing
+    every score against every threshold (O(N·C·T) per update), bucket each
+    score once (O(N·C)) and recover the per-threshold counts at compute time
+    by a cumulative sum over the fixed grid — the update cost no longer
+    scales with the threshold resolution, so sketches can afford thousands
+    of bins. Backs the ``sketched=True`` modes via
+    :mod:`metrics_tpu.kernels.sketches`.
+
+    ``preds`` is ``(N, C)`` scores on an ascending ``num_bins`` grid over
+    ``[lo, hi]`` (out-of-range scores clip into the edge bins and are
+    counted in the returned scalar); ``target`` is ``(N, C)`` binary
+    {0, 1}. Returns ``(pos_hist, neg_hist, clipped)``. Counts are float32 —
+    exact integers far below 2**24, and psum/merge-reducible by ``+``.
+    """
+    span = hi - lo
+    x = preds.astype(jnp.float32)
+    idx = jnp.clip(
+        jnp.floor((x - lo) / span * num_bins), 0, num_bins - 1
+    ).astype(jnp.int32)
+    pos = (target == 1).astype(jnp.float32)
+    clipped = jnp.sum((x < lo) | (x > hi)).astype(jnp.float32)
+
+    def one_column(ix: jax.Array, p: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        zeros = jnp.zeros((num_bins,), jnp.float32)
+        return zeros.at[ix].add(p), zeros.at[ix].add(1.0 - p)
+
+    pos_hist, neg_hist = jax.vmap(one_column, in_axes=(1, 1), out_axes=0)(idx, pos)
+    return pos_hist, neg_hist, clipped
+
+
 def binned_tp_fp_fn(
     preds: jax.Array,
     target: jax.Array,

@@ -1,0 +1,291 @@
+"""Mergeable fixed-size sketch summaries for the O(samples) metrics.
+
+The reference (TorchMetrics v0.4.0) carries ``dist_reduce_fx="cat"`` list
+states for every rank/threshold metric — AUROC, ROC, PrecisionRecallCurve,
+AveragePrecision, Spearman, the retrieval family — so state size, memory,
+and sync payloads all grow O(samples) with traffic. This module provides the
+three bounded-memory summaries behind their ``sketched=True`` modes, each a
+plain fixed-shape array state that merges by a cheap elementwise reduction
+(``psum``-able across the mesh, ``+``-mergeable across batches):
+
+1. **binned label histograms** — per-bin score counts split by label
+   (:func:`~metrics_tpu.kernels.binned_counts.label_score_histograms`); the
+   curve functions here (:func:`hist_auroc`, :func:`hist_roc`,
+   :func:`hist_precision_recall_curve`, :func:`hist_average_precision`)
+   reconstruct threshold metrics from the counts, treating each bin as one
+   prediction tie group — exactly the tie handling of the masked curve
+   kernels, so the result equals the exact computation whenever no two
+   samples share a bin and degrades smoothly (O(1/num_bins)) otherwise.
+
+2. **fixed-grid CDF sketch** — a value histogram over a static grid,
+   supporting interpolated :func:`cdf_sketch_quantile` / :func:`cdf_sketch_cdf`
+   queries, and its 2-D form :func:`joint_grid_update` /
+   :func:`spearman_from_grid` computing Spearman's rho from joint bin counts
+   with midrank tie correction (equal to the exact rho of the discretized
+   stream).
+
+3. **weighted reservoir sampling** — Efraimidis–Spirakis priorities
+   (:func:`weighted_priority`) over deterministic per-id uniforms
+   (:func:`uniform_hash`): keeping the ``capacity`` smallest keys draws a
+   weighted sample without replacement, and because the key is a pure
+   function of the id, independently-built reservoirs merge exactly
+   (:func:`bounded_priority_keep`) — the generic fallback for metrics (the
+   retrieval family) whose value is not a function of any fixed summary.
+
+All functions are pure jnp (jit/vmap/scan-safe, zero host ops); counts are
+float32 — exact integers far below 2**24, and directly ``psum``-reducible in
+the packed (kind, dtype) sync buckets.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from metrics_tpu.utilities.data import METRIC_EPS, Array
+
+__all__ = [
+    "bounded_priority_keep",
+    "cdf_sketch_cdf",
+    "cdf_sketch_quantile",
+    "grid_index",
+    "hist_auroc",
+    "hist_average_precision",
+    "hist_precision_recall_curve",
+    "hist_roc",
+    "joint_grid_update",
+    "spearman_from_grid",
+    "uniform_hash",
+    "weighted_priority",
+]
+
+
+# ---------------------------------------------------------------------------
+# binned label histograms -> threshold metrics
+# ---------------------------------------------------------------------------
+#
+# Convention shared by all hist_* functions: ``pos_hist``/``neg_hist`` hold
+# per-bin counts over the LAST axis (leading axes = classes/labels), bin b
+# covering scores in [edge_b, edge_{b+1}) over an ascending grid.
+
+
+def _rev_cumsum(x: Array) -> Array:
+    """Inclusive cumulative sum from the top bin down, along the last axis."""
+    return jnp.cumsum(x[..., ::-1], axis=-1)[..., ::-1]
+
+
+def hist_auroc(pos_hist: Array, neg_hist: Array) -> Array:
+    """AUROC from label histograms: the Mann-Whitney U with half credit for
+    within-bin ties (== the trapezoid over the per-bin ROC segments).
+
+    Degenerate single-label streams divide 0/0 -> NaN, matching the masked
+    curve kernels and the reference's arithmetic.
+    """
+    pos = pos_hist.astype(jnp.float32)
+    neg = neg_hist.astype(jnp.float32)
+    p_total = jnp.sum(pos, axis=-1)
+    n_total = jnp.sum(neg, axis=-1)
+    pos_above = _rev_cumsum(pos) - pos  # positives in strictly higher bins
+    u = jnp.sum(neg * (pos_above + 0.5 * pos), axis=-1)
+    return u / (p_total * n_total)
+
+
+def _desc_counts(pos_hist: Array, neg_hist: Array) -> Tuple[Array, Array]:
+    """(tps, fps) cumulative counts walking thresholds DOWN the bin grid:
+    position k holds the counts at threshold = lower edge of the k-th bin
+    from the top (every sample in that bin and above)."""
+    tps = jnp.cumsum(pos_hist[..., ::-1].astype(jnp.float32), axis=-1)
+    fps = jnp.cumsum(neg_hist[..., ::-1].astype(jnp.float32), axis=-1)
+    return tps, fps
+
+
+def _bin_edges(num_bins: int, lo: float, hi: float) -> Array:
+    """Ascending lower bin edges (``num_bins`` values in [lo, hi))."""
+    return lo + (hi - lo) * jnp.arange(num_bins, dtype=jnp.float32) / num_bins
+
+
+def hist_roc(pos_hist: Array, neg_hist: Array, lo: float = 0.0, hi: float = 1.0):
+    """(fpr, tpr, thresholds) from label histograms — ``num_bins + 1`` curve
+    points at descending thresholds (the exact ROC's orientation), starting
+    from the (0, 0) point at threshold ``hi``."""
+    num_bins = pos_hist.shape[-1]
+    tps, fps = _desc_counts(pos_hist, neg_hist)
+    p_total = tps[..., -1:]
+    n_total = fps[..., -1:]
+    zero = jnp.zeros(tps.shape[:-1] + (1,), jnp.float32)
+    tpr = jnp.concatenate([zero, tps / p_total], axis=-1)
+    fpr = jnp.concatenate([zero, fps / n_total], axis=-1)
+    edges = _bin_edges(num_bins, lo, hi)
+    thresholds = jnp.concatenate([jnp.asarray([hi], jnp.float32), edges[::-1]])
+    return fpr, tpr, thresholds
+
+
+def hist_precision_recall_curve(
+    pos_hist: Array, neg_hist: Array, lo: float = 0.0, hi: float = 1.0
+):
+    """(precision, recall, thresholds) at the ascending bin edges, with the
+    (1, 0) endpoint appended — the :class:`BinnedPrecisionRecallCurve` output
+    convention (``num_bins + 1`` curve values over ``num_bins`` thresholds).
+    """
+    tps_desc, fps_desc = _desc_counts(pos_hist, neg_hist)
+    tps = tps_desc[..., ::-1]  # ascending thresholds
+    fps = fps_desc[..., ::-1]
+    p_total = tps_desc[..., -1:]
+    precision = (tps + METRIC_EPS) / (tps + fps + METRIC_EPS)
+    recall = tps / jnp.maximum(p_total, METRIC_EPS)
+    one = jnp.ones(precision.shape[:-1] + (1,), precision.dtype)
+    zero = jnp.zeros(recall.shape[:-1] + (1,), recall.dtype)
+    precision = jnp.concatenate([precision, one], axis=-1)
+    recall = jnp.concatenate([recall, zero], axis=-1)
+    return precision, recall, _bin_edges(pos_hist.shape[-1], lo, hi)
+
+
+def hist_average_precision(pos_hist: Array, neg_hist: Array) -> Array:
+    """AP = Σ Δrecall · precision over descending thresholds, each bin one
+    tie group (the masked kernel's group-end tie handling). No-positive
+    streams divide 0/0 -> NaN like the reference's recall."""
+    tps, fps = _desc_counts(pos_hist, neg_hist)
+    p_total = tps[..., -1:]
+    precision = tps / jnp.maximum(tps + fps, METRIC_EPS)
+    recall = tps / p_total
+    recall_prev = jnp.concatenate(
+        [jnp.zeros(recall.shape[:-1] + (1,), recall.dtype), recall[..., :-1]], axis=-1
+    )
+    return jnp.sum((recall - recall_prev) * precision, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# fixed-grid CDF sketch (quantiles / rank statistics)
+# ---------------------------------------------------------------------------
+
+
+def grid_index(x: Array, num_bins: int, lo: float, hi: float) -> Array:
+    """Bin index of each value on the static ascending grid; out-of-range
+    values clip into the edge bins (count them via :func:`clipped_count`)."""
+    span = hi - lo
+    raw = jnp.floor((x.astype(jnp.float32) - lo) / span * num_bins)
+    return jnp.clip(raw, 0, num_bins - 1).astype(jnp.int32)
+
+
+def clipped_count(x: Array, lo: float, hi: float) -> Array:
+    """How many values fell outside [lo, hi] (clipped into an edge bin)."""
+    out = (x < lo) | (x > hi)
+    return jnp.sum(out).astype(jnp.float32)
+
+
+def cdf_sketch_update(counts: Array, x: Array, lo: float, hi: float) -> Array:
+    """Accumulate a batch into a ``(num_bins,)`` CDF sketch (merge = ``+``)."""
+    idx = grid_index(jnp.ravel(x), counts.shape[-1], lo, hi)
+    return counts.at[idx].add(1.0)
+
+
+def cdf_sketch_cdf(counts: Array, v: Array, lo: float, hi: float) -> Array:
+    """P(X <= v) under the sketch (bin mass attributed to the bin midpoint)."""
+    num_bins = counts.shape[-1]
+    total = jnp.maximum(jnp.sum(counts), 1.0)
+    idx = grid_index(v, num_bins, lo, hi)
+    cum = jnp.cumsum(counts)
+    below = jnp.where(idx > 0, cum[jnp.maximum(idx - 1, 0)], 0.0)
+    return (below + counts[idx] * 0.5) / total
+
+
+def cdf_sketch_quantile(counts: Array, q: Array, lo: float, hi: float) -> Array:
+    """Interpolated quantile(s): walk the cumulative mass to the target rank
+    and interpolate linearly inside the crossing bin."""
+    num_bins = counts.shape[-1]
+    total = jnp.maximum(jnp.sum(counts), 1.0)
+    cum = jnp.cumsum(counts)
+    rank = jnp.asarray(q, jnp.float32) * total
+    idx = jnp.clip(jnp.searchsorted(cum, rank, side="left"), 0, num_bins - 1)
+    prev = jnp.where(idx > 0, cum[jnp.maximum(idx - 1, 0)], 0.0)
+    in_bin = jnp.maximum(counts[idx], METRIC_EPS)
+    frac = jnp.clip((rank - prev) / in_bin, 0.0, 1.0)
+    width = (hi - lo) / num_bins
+    return lo + (idx.astype(jnp.float32) + frac) * width
+
+
+def joint_grid_update(
+    grid: Array,
+    x: Array,
+    y: Array,
+    x_range: Tuple[float, float],
+    y_range: Tuple[float, float],
+) -> Tuple[Array, Array]:
+    """Accumulate (x, y) pairs into a ``(Bx, By)`` joint grid; returns the
+    advanced grid and this batch's out-of-range (clipped) pair count."""
+    bx, by = grid.shape
+    x = jnp.ravel(x)
+    y = jnp.ravel(y)
+    ix = grid_index(x, bx, *x_range)
+    iy = grid_index(y, by, *y_range)
+    clipped = jnp.sum(
+        (x < x_range[0]) | (x > x_range[1]) | (y < y_range[0]) | (y > y_range[1])
+    ).astype(jnp.float32)
+    return grid.at[ix, iy].add(1.0), clipped
+
+
+def spearman_from_grid(grid: Array) -> Array:
+    """Spearman's rho from joint bin counts with midrank tie correction —
+    exactly the rho of the stream discretized onto the grid (error -> 0 as
+    the grid refines for continuous in-range data). Empty grids divide
+    0/0 -> NaN like the exact formula on an empty stream."""
+    g = grid.astype(jnp.float32)
+    nx = jnp.sum(g, axis=1)
+    ny = jnp.sum(g, axis=0)
+    n = jnp.sum(nx)
+    # midrank of every bin: ranks 1..n, ties averaged within a bin
+    rx = jnp.cumsum(nx) - nx + (nx + 1.0) / 2.0
+    ry = jnp.cumsum(ny) - ny + (ny + 1.0) / 2.0
+    rbar = (n + 1.0) / 2.0
+    dx = rx - rbar
+    dy = ry - rbar
+    cov = dx @ (g @ dy)
+    var_x = jnp.sum(nx * dx * dx)
+    var_y = jnp.sum(ny * dy * dy)
+    return cov / jnp.sqrt(var_x * var_y)
+
+
+# ---------------------------------------------------------------------------
+# weighted reservoir sampling (bounded-priority sample)
+# ---------------------------------------------------------------------------
+
+
+def uniform_hash(ids: Array) -> Array:
+    """Deterministic uniform in [0, 1) per integer id (murmur3 finalizer).
+
+    The same id hashes identically on every process and at every step, so
+    independently-built reservoirs agree on priorities and merge exactly —
+    no coordination, no PRNG state.
+    """
+    x = jnp.asarray(ids).astype(jnp.uint32) + jnp.uint32(0x9E3779B9)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x.astype(jnp.float32) / jnp.float32(4294967296.0)
+
+
+def weighted_priority(uniform: Array, weight: Array = 1.0) -> Array:
+    """Efraimidis–Spirakis priority: an Exp(weight) variate from a uniform.
+
+    Keeping the ``capacity`` SMALLEST priorities draws a weighted sample
+    without replacement (an item of weight w survives with probability
+    proportional to w); ``weight=1`` degrades to uniform sampling.
+    """
+    u = jnp.clip(jnp.asarray(uniform, jnp.float32), 1e-12, 1.0)
+    return -jnp.log(u) / jnp.asarray(weight, jnp.float32)
+
+
+def bounded_priority_keep(
+    keys: Array, tiebreak: Array, values: Tuple[Array, ...], capacity: int
+) -> Tuple[Array, Array, Tuple[Array, ...]]:
+    """Keep the ``capacity`` rows with the smallest ``(key, tiebreak)``.
+
+    The two-key stable variadic sort carries the payload columns through the
+    sort (no argsort+gather) and canonicalizes the row order, so repeated
+    pushes and merges of the same row population produce identical buffers —
+    the property the merge-associativity suite pins. Empty slots use
+    ``key = +inf`` and naturally sort (and fall) off the end.
+    """
+    out = lax.sort((keys, tiebreak) + tuple(values), num_keys=2, is_stable=True)
+    return out[0][:capacity], out[1][:capacity], tuple(v[:capacity] for v in out[2:])

@@ -265,6 +265,10 @@ class Metric(ABC):
     higher_is_better: Optional[bool] = None
     #: set False on subclasses whose forward must use the double-update protocol
     _fusable: bool = True
+    #: set on subclasses that offer a bounded-memory ``sketched=True`` mode —
+    #: appended to the compiled-state / keyed eligibility-gate errors so the
+    #: remediation for an O(samples) `cat`-state refusal is actionable
+    _sketch_hint: Optional[str] = None
 
     def __init__(
         self,
@@ -777,11 +781,12 @@ class Metric(ABC):
         :meth:`update_many`; side-effect free, so callers (MetricCollection)
         can validate members without touching their own enablement."""
         if any(isinstance(v, list) for v in self._defaults.values()):
+            hint = f" {self._sketch_hint}" if self._sketch_hint else ""
             raise ValueError(
                 f"{self.__class__.__name__} holds unbounded list states, whose pytree grows"
                 " every step under jit (a retrace per call); use the fixed-shape"
                 " `capacity=`/`streaming=` mode of this metric with jit_forward, or keep the"
-                " eager forward."
+                f" eager forward.{hint}"
             )
         if set(self.init_state()) != set(self._defaults):
             # wrappers like BootStrapper own a custom pure-state layout the

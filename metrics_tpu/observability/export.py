@@ -211,6 +211,19 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
                     {**base, "metric": key, "group": owner},
                     len(members),
                 )
+        sk = entry.get("info", {}).get("sketch")
+        if sk is not None:
+            # bounded-memory sketched state: size knobs as gauges, overflow
+            # (clipped/dropped samples) and merge activity as counters
+            labels = {**base, "metric": key, "kind": str(sk.get("kind", ""))}
+            out.emit("sketch_bins", labels, sk.get("bins", sk.get("capacity", 0)))
+            out.emit("sketch_overflow_total", labels, sk.get("overflow", 0), "counter")
+            out.emit(
+                "sketch_merges_total",
+                labels,
+                entry.get("counters", {}).get("sketch_merges", 0),
+                "counter",
+            )
         tr = entry.get("info", {}).get("tenant_report")
         if tr is not None:
             # multi-tenant drill-down rollup: axis size, occupancy, traffic,

@@ -5,8 +5,20 @@ TPU extension — ``capacity``: a preallocated ``(capacity,)`` sample buffer
 stream to moments) whose state structure is step-invariant: updates write in
 place under ``jit``, sync is a tiled ``all_gather`` + counter gather, and
 compute is the masked searchsorted rank formula over the valid entries.
+
+TPU extension — ``sketched``: TRUE bounded-memory streaming. The joint
+(pred, target) distribution is accumulated into a fixed ``(num_bins,
+num_bins)`` rank grid (:func:`~metrics_tpu.kernels.sketches.joint_grid_update`)
+and rho is computed from the bin counts with midrank tie correction — exactly
+the Spearman of the stream discretized onto the grid, so the error is
+O(1/num_bins) for continuous in-range data and the state/sync cost is
+O(num_bins²) regardless of traffic (one ``psum`` per sync). Requires an
+explicit ``value_range`` (the grid must be static to stay mergeable across
+processes); out-of-range values clip into the edge bins and are counted.
 """
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax.numpy as jnp
 
 from metrics_tpu.utilities.capped_buffer import CappedBufferMixin
 from metrics_tpu.functional.regression.spearman import (
@@ -14,19 +26,40 @@ from metrics_tpu.functional.regression.spearman import (
     _spearman_corrcoef_update,
     masked_spearman_corrcoef,
 )
+from metrics_tpu.kernels.sketches import joint_grid_update, spearman_from_grid
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import Array, dim_zero_cat
 from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.utilities.sketching import SketchTelemetryMixin, _check_num_bins, _check_range
 
 
-class SpearmanCorrcoef(CappedBufferMixin, Metric):
+class SpearmanCorrcoef(SketchTelemetryMixin, CappedBufferMixin, Metric):
     """Spearman rank correlation over all seen (preds, target) pairs.
 
     Args:
         capacity: when set, accumulate into a fixed-size ``(capacity,)``
             buffer instead of unbounded lists — usable inside compiled
             programs without per-step retracing; samples past the capacity
-            are dropped (warned about at eager compute).
+            are dropped (warned about at eager compute, or raised with
+            ``overflow="error"``).
+        sketched: bounded-memory streaming mode — accumulate a fixed
+            ``(num_bins, num_bins)`` joint rank grid instead of samples.
+            Unlike ``capacity`` the state never saturates: every sample
+            lands in the grid, memory and sync stay O(num_bins²) forever,
+            and the whole lifecycle (update, ``psum`` sync, compute) is
+            jit/donation/``update_many``/``keyed``-eligible. Accuracy is
+            the exact rho of the grid-discretized stream (documented
+            tolerance in ``docs/performance.md#bounded-memory-sketched-states``).
+        num_bins: sketched-mode grid resolution per axis (default 512 —
+            1 MB of state).
+        value_range: REQUIRED with ``sketched=True``: the static grid
+            bounds, either one ``(low, high)`` pair for both axes or
+            ``((pred_low, pred_high), (target_low, target_high))``.
+            Out-of-range values clip into the edge bins (rank clamping —
+            counted in ``sketch_clipped``, reported in the telemetry
+            snapshot).
+        overflow: capacity-mode policy past the buffer — ``"warn"`` or
+            ``"error"``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -39,10 +72,20 @@ class SpearmanCorrcoef(CappedBufferMixin, Metric):
     """
 
     is_differentiable = False
+    _sketch_hint = (
+        "Alternatively, SpearmanCorrcoef(sketched=True,"
+        " value_range=(low, high)) keeps a fixed-size joint rank grid"
+        " (bounded memory, one psum at sync; see"
+        " docs/performance.md#bounded-memory-sketched-states)."
+    )
 
     def __init__(
         self,
         capacity: Optional[int] = None,
+        sketched: bool = False,
+        num_bins: int = 512,
+        value_range: Optional[Union[Tuple[float, float], Tuple[Tuple[float, float], ...]]] = None,
+        overflow: str = "warn",
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -55,10 +98,35 @@ class SpearmanCorrcoef(CappedBufferMixin, Metric):
             dist_sync_fn=dist_sync_fn,
         )
         self.capacity = capacity
+        self.sketched = sketched
         self.num_classes = None  # raw-value buffer; no class semantics
 
-        if capacity is not None:
-            self._init_raw_buffer_states(capacity)
+        if sketched:
+            if capacity is not None:
+                raise ValueError("`sketched` and `capacity` modes are mutually exclusive")
+            _check_num_bins(num_bins)
+            if value_range is None:
+                raise ValueError(
+                    "SpearmanCorrcoef(sketched=True) needs an explicit `value_range`"
+                    " — the rank grid must be static (the same on every process and"
+                    " every step) to stay mergeable. Pass (low, high) covering your"
+                    " preds/target values, or ((pred_low, pred_high), (target_low,"
+                    " target_high)); out-of-range values clip into the edge bins."
+                )
+            if (
+                isinstance(value_range, (tuple, list))
+                and len(value_range) == 2
+                and isinstance(value_range[0], (tuple, list))
+            ):
+                self._sketch_range_x = _check_range("value_range[0]", value_range[0])
+                self._sketch_range_y = _check_range("value_range[1]", value_range[1])
+            else:
+                self._sketch_range_x = self._sketch_range_y = _check_range("value_range", value_range)
+            self._sketch_bins = num_bins
+            self.add_state("joint_grid", jnp.zeros((num_bins, num_bins), jnp.float32), dist_reduce_fx="sum")
+            self.add_state("sketch_clipped", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        elif capacity is not None:
+            self._init_raw_buffer_states(capacity, overflow=overflow)
         else:
             rank_zero_warn(
                 "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
@@ -68,8 +136,16 @@ class SpearmanCorrcoef(CappedBufferMixin, Metric):
             self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
-        """Append the batch pairs (buffered in place under ``capacity``)."""
+        """Append the batch pairs (buffered/bucketed in place under
+        ``capacity``/``sketched``)."""
         preds, target = _spearman_corrcoef_update(preds, target)
+        if self.sketched:
+            grid, clipped = joint_grid_update(
+                self.joint_grid, preds, target, self._sketch_range_x, self._sketch_range_y
+            )
+            self.joint_grid = grid
+            self.sketch_clipped = self.sketch_clipped + clipped
+            return
         if self.capacity is not None:
             self._raw_buffer_update(preds, target)
             return
@@ -78,6 +154,15 @@ class SpearmanCorrcoef(CappedBufferMixin, Metric):
 
     def compute(self) -> Array:
         """Spearman correlation over everything seen so far."""
+        if self.sketched:
+            rho = spearman_from_grid(self.joint_grid)
+            self._publish_sketch_info(
+                kind="joint_grid",
+                bins=self._sketch_bins,
+                range=[list(self._sketch_range_x), list(self._sketch_range_y)],
+                overflow=self.sketch_clipped,
+            )
+            return rho
         if self.capacity is not None:
             preds, target, valid = self._buffer_flatten()
             return masked_spearman_corrcoef(preds, target, valid)

@@ -26,12 +26,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.functional.retrieval.precision import _check_k
+from metrics_tpu.kernels.sketches import bounded_priority_keep, uniform_hash
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
-from metrics_tpu.utilities.data import Array, dim_zero_cat
+from metrics_tpu.utilities.data import Array, _is_traced, dim_zero_cat
+from metrics_tpu.utilities.sketching import SketchTelemetryMixin
 
 
-class RetrievalMetric(Metric, ABC):
+class RetrievalMetric(SketchTelemetryMixin, Metric, ABC):
     """Base for information-retrieval metrics over ``(preds, target, indexes)``.
 
     ``indexes`` maps each prediction to its query; scores are grouped by
@@ -47,6 +49,19 @@ class RetrievalMetric(Metric, ABC):
         dist_sync_fn: override for the eager state gather.
         k: score only each query's top ``k`` predictions (``None``: all);
             only subclasses with ``_uses_k`` accept it.
+        sketched: bounded-memory fallback for the flat ``indexes`` mode —
+            keep a fixed ``sketch_capacity``-row weighted reservoir of
+            QUERIES instead of the O(samples) lists. Each row's priority is
+            a deterministic hash of its query id
+            (:func:`~metrics_tpu.kernels.sketches.uniform_hash`), so a
+            query's rows survive or fall together, every process agrees on
+            priorities without coordination, and independently-built
+            reservoirs merge exactly at sync (fixed-size gather payload).
+            ``compute()`` scores the sampled queries — an unbiased estimate
+            of the all-queries mean with O(1/√kept_queries) noise (documented
+            tolerance in ``docs/performance.md#bounded-memory-sketched-states``).
+        sketch_capacity: reservoir size in rows (default 8192 — ~128 KB of
+            state; at 10 candidates/query that samples ~800 queries).
     """
 
     #: compute() groups queries on the host (epoch boundary) and cannot trace
@@ -58,10 +73,18 @@ class RetrievalMetric(Metric, ABC):
     #: whether this metric has @k semantics (MAP/MRR do not)
     _uses_k: bool = False
 
+    _sketch_hint = (
+        "Alternatively, the sketched=True mode keeps a fixed-size query"
+        " reservoir (bounded memory, fixed-size sync payloads; see"
+        " docs/performance.md#bounded-memory-sketched-states)."
+    )
+
     def __init__(
         self,
         empty_target_action: str = "neg",
         padded: bool = False,
+        sketched: bool = False,
+        sketch_capacity: int = 8192,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -79,11 +102,18 @@ class RetrievalMetric(Metric, ABC):
             raise ValueError(f"`empty_target_action` received a wrong value `{empty_target_action}`.")
         self.empty_target_action = empty_target_action
         self.padded = padded
+        self.sketched = sketched
 
         if k is not None and not self._uses_k:
             raise TypeError(f"{self.__class__.__name__} does not accept `k`")
         _check_k(k)
         self.k = k
+
+        if sketched and padded:
+            raise ValueError(
+                "`sketched` applies to the flat `indexes` mode; `padded=True` already"
+                " has O(1) streaming state and needs no reservoir"
+            )
 
         if padded:
             if empty_target_action == "error":
@@ -97,6 +127,23 @@ class RetrievalMetric(Metric, ABC):
             dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
             self.add_state("value_sum", default=jnp.zeros((), dtype), dist_reduce_fx="sum")
             self.add_state("query_total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        elif sketched:
+            if not (isinstance(sketch_capacity, int) and sketch_capacity > 0):
+                raise ValueError(
+                    f"`sketch_capacity` should be a positive integer, got: {sketch_capacity}"
+                )
+            self.sketch_capacity = sketch_capacity
+            # fixed-shape reservoir columns: priority key (+inf = empty slot),
+            # query id, score, relevance; "cat" ships one fixed-size gather
+            # leg per column, "sum" for the row counter
+            self.add_state("res_key", jnp.full((sketch_capacity,), jnp.inf, jnp.float32), dist_reduce_fx="cat")
+            self.add_state("res_qid", jnp.zeros((sketch_capacity,), jnp.int32), dist_reduce_fx="cat")
+            self.add_state("res_pred", jnp.zeros((sketch_capacity,), jnp.float32), dist_reduce_fx="cat")
+            self.add_state("res_target", jnp.zeros((sketch_capacity,), jnp.float32), dist_reduce_fx="cat")
+            self.add_state("res_seen", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+            # (1,)-shaped so the "cat" gather yields one flag per shard,
+            # aligned with the per-shard buffer slices
+            self.add_state("res_overflow", jnp.zeros((1,), jnp.float32), dist_reduce_fx="cat")
         else:
             self.add_state("indexes", default=[], dist_reduce_fx=None)
             self.add_state("preds", default=[], dist_reduce_fx=None)
@@ -125,9 +172,89 @@ class RetrievalMetric(Metric, ABC):
             jnp.asarray(indexes), jnp.asarray(preds), jnp.asarray(target),
             allow_non_binary_target=self.allow_non_binary_target,
         )
+        if self.sketched:
+            self._reservoir_update(indexes, preds, target)
+            return
         self.indexes.append(indexes)
         self.preds.append(preds)
         self.target.append(target)
+
+    def _reservoir_update(self, indexes: Array, preds: Array, target: Array) -> None:
+        """Push one flat batch into the fixed-size query reservoir.
+
+        The priority of every row is ``uniform_hash(query_id)`` — the same
+        wherever and whenever the row arrives — and the buffer keeps the
+        ``sketch_capacity`` smallest-priority rows, so eviction removes
+        whole queries from the top of the priority order. Pure jnp
+        (jit/scan-safe); the row counter keeps the true total so compute
+        can tell whether sampling occurred."""
+        qid = indexes.astype(jnp.int32)
+        keys = jnp.concatenate([self.res_key, uniform_hash(qid)])
+        qids = jnp.concatenate([self.res_qid, qid])
+        spreds = jnp.concatenate([self.res_pred, preds.astype(jnp.float32)])
+        stargets = jnp.concatenate([self.res_target, target.astype(jnp.float32)])
+        overflowed = jnp.sum(~jnp.isinf(keys)) > self.sketch_capacity
+        self.res_key, self.res_qid, (self.res_pred, self.res_target) = bounded_priority_keep(
+            keys, qids, (spreds, stargets), self.sketch_capacity
+        )
+        self.res_seen = self.res_seen + indexes.shape[0]
+        self.res_overflow = jnp.maximum(self.res_overflow, overflowed.astype(jnp.float32))
+
+    def _reservoir_rows(self):
+        """The merged, COMPLETE-query view of the (possibly multi-shard)
+        reservoir: numpy ``(indexes, preds, target)`` plus drop accounting.
+
+        Eviction removes the largest priorities first, so on any shard that
+        ever overflowed, every query with priority strictly below that
+        shard's largest kept priority is fully present. The global cutoff is
+        the minimum of the per-shard cutoffs (never-full shards contribute
+        +inf): rows at or above it are dropped as potentially-partial
+        queries. Host-side — the valid-row count is data-dependent, exactly
+        like the flat mode's epoch-end grouping pass."""
+        cap = self.sketch_capacity
+        key = dim_zero_cat(self.res_key) if isinstance(self.res_key, list) else self.res_key
+        qid = dim_zero_cat(self.res_qid) if isinstance(self.res_qid, list) else self.res_qid
+        pred = dim_zero_cat(self.res_pred) if isinstance(self.res_pred, list) else self.res_pred
+        targ = dim_zero_cat(self.res_target) if isinstance(self.res_target, list) else self.res_target
+        if _is_traced(key, qid, pred, targ):
+            raise NotImplementedError(
+                f"{self.__class__.__name__}: `sketched` mode computes on concrete"
+                " (non-traced) state — the kept-query set is data-dependent. Call"
+                " compute()/apply_compute outside jit (the fixed-shape part is the"
+                " update path)."
+            )
+        flags = dim_zero_cat(self.res_overflow) if isinstance(self.res_overflow, list) else self.res_overflow
+        keys = np.asarray(key).reshape(-1, cap)
+        # a shard that ever evicted keeps a clean priority prefix: only its
+        # boundary (largest-kept-priority) query may be partial. Shards that
+        # never evicted are complete outright.
+        full = np.asarray(flags).reshape(-1) > 0
+        cutoff = np.where(full, keys.max(axis=1, initial=-np.inf), np.inf).min()
+        keep = np.asarray(key) < cutoff
+        shards = keys.shape[0]
+        kept_qids = np.asarray(qid)[keep]
+        dropped_rows = int((~keep & ~np.isinf(np.asarray(key))).sum())
+        if dropped_rows > 0 or bool(full.any()):
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"{self.__class__.__name__}(sketched=True, sketch_capacity={cap})"
+                f" sampled the query stream: scoring {int(np.unique(kept_qids).size)}"
+                f" complete queries out of {int(np.asarray(self.res_seen))} seen rows"
+                " (the value is an unbiased estimate over a uniform query sample;"
+                " raise `sketch_capacity` to tighten it).",
+                UserWarning,
+            )
+        self._count_sketch_merges(shards - 1)
+        self._publish_sketch_info(
+            kind="reservoir",
+            capacity=cap,
+            rows_seen=self.res_seen,
+            rows_kept=int(keep.sum()),
+            queries_kept=int(np.unique(kept_qids).size),
+            overflow=dropped_rows,
+        )
+        return kept_qids, np.asarray(pred)[keep], np.asarray(targ)[keep]
 
     def _update_padded(self, preds: Array, target: Array, mask: Optional[Array]) -> None:
         """Score one ``(Q, D)`` batch of fully-contained queries in-graph."""
@@ -191,11 +318,19 @@ class RetrievalMetric(Metric, ABC):
 
     def _group_into_rows(self) -> Tuple[Array, Array]:
         """Flat accumulated stream -> ``(num_queries, max_len)`` rows sorted by
-        descending score, plus per-query lengths. Host-side (concrete epoch data)."""
-        indexes = np.asarray(dim_zero_cat(self.indexes))
-        preds = np.asarray(dim_zero_cat(self.preds))
-        target = np.asarray(dim_zero_cat(self.target))
+        descending score, plus per-query lengths. Host-side (concrete epoch
+        data). ``sketched`` mode feeds the reservoir's complete-query rows
+        through the identical pass."""
+        if self.sketched:
+            indexes, preds, target = self._reservoir_rows()
+        else:
+            indexes = np.asarray(dim_zero_cat(self.indexes))
+            preds = np.asarray(dim_zero_cat(self.preds))
+            target = np.asarray(dim_zero_cat(self.target))
+        return self._group_arrays_into_rows(indexes, preds, target)
 
+    @staticmethod
+    def _group_arrays_into_rows(indexes, preds, target) -> Tuple[Array, Array]:
         _, inverse = np.unique(indexes, return_inverse=True)
         order = np.lexsort((-preds, inverse))  # query-major, score-descending
         counts = np.bincount(inverse)

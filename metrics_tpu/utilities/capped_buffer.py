@@ -38,10 +38,32 @@ from metrics_tpu.utilities.prints import rank_zero_warn
 #: chunk size for oversized batches
 BUF_SLACK_ROWS = 4096
 
+#: what a capacity-mode metric does when the stream exceeds the buffer
+OVERFLOW_POLICIES = ("warn", "error")
+
+
+class BufferOverflowError(RuntimeError):
+    """An exact-mode ``capacity=`` buffer received more samples than it can
+    hold and the metric was built with ``overflow="error"``.
+
+    Raised at the first host boundary where the fill counters are concrete
+    (eager ``compute()``, including after compiled ``jit_forward`` /
+    ``update_many`` steps — inside a compiled program the counter is traced
+    and cannot raise, so the overflow surfaces at the next eager read
+    instead of silently truncating the stream)."""
+
 
 def _check_capacity(capacity: int) -> None:
     if not (isinstance(capacity, int) and capacity > 0):
         raise ValueError(f"`capacity` should be a positive integer, got: {capacity}")
+
+
+def _check_overflow_policy(overflow: str) -> str:
+    if overflow not in OVERFLOW_POLICIES:
+        raise ValueError(
+            f"`overflow` should be one of {OVERFLOW_POLICIES}, got: {overflow!r}"
+        )
+    return overflow
 
 
 def init_feature_buffer(capacity: int, dim: int, dtype=jnp.float32) -> Tuple[Array, int]:
@@ -146,9 +168,18 @@ class CappedBufferMixin:
     _capacity_multilabel = False
     #: classification modes cast the label columns back to int32 at flatten
     _capacity_int_target = True
+    #: overflow policy: "warn" drops past-capacity samples with a warning
+    #: (the historical behavior), "error" raises BufferOverflowError at the
+    #: first concrete read of an overflowed counter
+    _buf_overflow_policy = "warn"
 
     def _init_capacity_states(
-        self, capacity: int, num_classes: Optional[int], pos_label: Optional[int], multilabel: bool = False
+        self,
+        capacity: int,
+        num_classes: Optional[int],
+        pos_label: Optional[int],
+        multilabel: bool = False,
+        overflow: str = "warn",
     ) -> None:
         """Validate the capacity-mode configuration and register the buffer state.
 
@@ -169,6 +200,7 @@ class CappedBufferMixin:
             raise ValueError("`pos_label` does not apply to multi-column `capacity` mode")
         self._capacity_multilabel = multilabel
         self._capacity_int_target = True
+        self._buf_overflow_policy = _check_overflow_policy(overflow)
         if multi:
             width = 2 * num_classes if multilabel else num_classes + 1
         else:
@@ -191,9 +223,10 @@ class CappedBufferMixin:
             return self.num_classes
         return 1
 
-    def _init_raw_buffer_states(self, capacity: int, dtype=jnp.float32) -> None:
+    def _init_raw_buffer_states(self, capacity: int, dtype=jnp.float32, overflow: str = "warn") -> None:
         """Raw-value variant: preds/target kept verbatim (no canonicalization)."""
         _check_capacity(capacity)
+        self._buf_overflow_policy = _check_overflow_policy(overflow)
         self._capacity_int_target = False
         self._buf_width = 2
         self._buf_slack = min(capacity, BUF_SLACK_ROWS)
@@ -268,6 +301,16 @@ class CappedBufferMixin:
 
             overflow = np.asarray(jnp.maximum(counts - self.capacity, 0)).sum()
             if overflow > 0:
+                if self._buf_overflow_policy == "error":
+                    raise BufferOverflowError(
+                        f"{self.__class__.__name__}(capacity={self.capacity}) overflowed:"
+                        f" {int(overflow)} sample(s) past the buffer capacity"
+                        f" ({int(np.asarray(counts).sum())} received in total). This metric"
+                        ' was built with overflow="error", so the truncated stream is an'
+                        " error instead of a silently approximate value. Raise `capacity`,"
+                        " reset() more often, or switch to the bounded-memory"
+                        " `sketched=True` mode if the metric offers one."
+                    )
                 rank_zero_warn(
                     f"{self.__class__.__name__}(capacity={self.capacity}) dropped {int(overflow)}"
                     " samples past the buffer capacity; the computed value covers the first"

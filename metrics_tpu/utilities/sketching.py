@@ -1,0 +1,191 @@
+"""Sketched-state wiring shared by the ``sketched=True`` metric modes.
+
+:mod:`metrics_tpu.kernels.sketches` holds the pure summaries; this module
+holds the *metric-class* plumbing around them:
+
+* :class:`SketchTelemetryMixin` — the observability contract every sketched
+  metric honors: a ``sketch_merges`` counter (eager state merges of sketch
+  summaries, plus cross-shard merges at compute) and an ``info.sketch`` blob
+  in ``observability.snapshot()`` (kind, bins/capacity, overflow counters)
+  rendered as the ``metrics_tpu_sketch_*`` Prometheus families.
+
+* :class:`HistogramSketchMixin` — state registration + canonicalized update
+  for the binned-label-histogram sketch backing
+  AUROC/ROC/PrecisionRecallCurve/AveragePrecision ``sketched=True``: fixed
+  ``(C, num_bins)`` ``pos_hist``/``neg_hist`` float32 sum states (plus a
+  scalar clipped-score counter), mirroring the capacity mode's binary /
+  multiclass one-vs-rest / multilabel input handling.
+
+Because every sketch state is a fixed-shape ``"sum"`` array, sketched
+metrics clear the PR-4 compiled-state gate (jit_forward / warmup /
+update_many / donation), the PR-5 compute-group tracer, AND the PR-6 keyed
+gate — the whole hot-path machinery the ``cat``-list states were excluded
+from — and their sync rides the packed (kind, dtype) buckets as one psum
+regardless of sample count.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.kernels.binned_counts import label_score_histograms
+from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.observability.retrace import is_tracing
+from metrics_tpu.utilities.data import Array, _is_traced
+from metrics_tpu.utilities.enums import DataType
+
+__all__ = ["HistogramSketchMixin", "SketchTelemetryMixin"]
+
+
+def _check_num_bins(num_bins: int) -> None:
+    if not (isinstance(num_bins, int) and num_bins > 1):
+        raise ValueError(f"`num_bins` should be an integer > 1, got: {num_bins}")
+
+
+def _check_range(name: str, rng: Tuple[float, float]) -> Tuple[float, float]:
+    try:
+        lo, hi = float(rng[0]), float(rng[1])
+    except (TypeError, ValueError, IndexError):
+        raise ValueError(f"`{name}` should be a (low, high) pair of floats, got: {rng!r}")
+    if not lo < hi:
+        raise ValueError(f"`{name}` needs low < high, got: {rng!r}")
+    return lo, hi
+
+
+class SketchTelemetryMixin:
+    """Observability hooks shared by every ``sketched=True`` metric mode."""
+
+    #: set by the concrete metric's sketched-state init
+    sketched: bool = False
+
+    def merge_states(self, a, b):  # type: ignore[override]
+        merged = super().merge_states(a, b)
+        # host-side accounting only: under tracing this body runs once per
+        # compile, and counting there would both miscount and (worse) tempt a
+        # traced op — sketched states must stay zero-overhead like the rest
+        # of the telemetry plane
+        if self.sketched and TELEMETRY.enabled and not is_tracing(a, b):
+            TELEMETRY.inc(self.telemetry_key, "sketch_merges")
+        return merged
+
+    def _count_sketch_merges(self, n: int) -> None:
+        """Cross-shard sketch merges performed at compute (eager sync)."""
+        if n > 0 and TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "sketch_merges", n)
+
+    def _publish_sketch_info(self, **info) -> None:
+        """Publish the ``info.sketch`` snapshot blob (eager compute only —
+        traced values cannot be read and the publish is skipped)."""
+        if not TELEMETRY.enabled:
+            return
+        concrete = {}
+        for k, v in info.items():
+            if _is_traced(v):
+                return
+            concrete[k] = float(v) if hasattr(v, "dtype") else v
+        TELEMETRY.set_info(self.telemetry_key, "sketch", concrete)
+
+
+class HistogramSketchMixin(SketchTelemetryMixin):
+    """Binned-label-histogram states + canonicalized update for the
+    threshold-curve metrics' ``sketched=True`` mode."""
+
+    _sketch_multilabel = False
+
+    def _init_hist_states(
+        self,
+        num_bins: int,
+        score_range: Tuple[float, float],
+        num_classes: Optional[int],
+        pos_label: Optional[int],
+        multilabel: bool = False,
+    ) -> None:
+        """Validate the sketched configuration and register the histogram
+        states: ``pos_hist``/``neg_hist`` of shape ``(C, num_bins)`` (C = 1
+        for binary) plus the scalar out-of-range counter, all ``"sum"``."""
+        _check_num_bins(num_bins)
+        lo, hi = _check_range("score_range", score_range)
+        multi = num_classes is not None and num_classes > 1
+        if multilabel and not multi:
+            raise ValueError(
+                f"multilabel `sketched` mode needs `num_classes` > 1 (the label count), got {num_classes}"
+            )
+        if not multi and pos_label not in (None, 0, 1):
+            raise ValueError(f"`sketched` mode expects `pos_label` in (0, 1), got: {pos_label}")
+        if multi and pos_label is not None:
+            raise ValueError("`pos_label` does not apply to multi-class `sketched` mode")
+        self._sketch_multilabel = multilabel
+        self._sketch_bins = num_bins
+        self._sketch_range = (lo, hi)
+        width = num_classes if multi else 1
+        for name in ("pos_hist", "neg_hist"):
+            self.add_state(name, jnp.zeros((width, num_bins), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sketch_clipped", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    @property
+    def _sketch_multiclass(self) -> bool:
+        num_classes = getattr(self, "num_classes", None)
+        return num_classes is not None and num_classes > 1 and not self._sketch_multilabel
+
+    def _hist_update(self, preds: Array, target: Array) -> None:
+        """Accumulate one batch into the label histograms — the capacity
+        buffer's canonicalization (binary / multiclass one-vs-rest /
+        multilabel) over the fixed score grid instead of a sample buffer."""
+        from metrics_tpu.functional.classification.auroc import _auroc_update
+        from metrics_tpu.utilities.data import to_onehot
+
+        preds, target, mode = _auroc_update(preds, target)
+        if self._sketch_multilabel:
+            if mode != DataType.MULTILABEL or preds.ndim != 2 or preds.shape[1] != self.num_classes:
+                raise ValueError(
+                    f"multilabel `sketched` mode with num_classes={self.num_classes} expects"
+                    f" (N, C) scores and (N, C) binary labels, got mode {mode} with preds shape {preds.shape}"
+                )
+            target = (target == 1).astype(jnp.int32)
+        elif self._sketch_multiclass:
+            if mode != DataType.MULTICLASS or preds.ndim != 2 or preds.shape[1] != self.num_classes:
+                raise ValueError(
+                    f"`sketched` mode with num_classes={self.num_classes} expects (N, C) class scores"
+                    f" and (N,) labels, got mode {mode} with preds shape {preds.shape}"
+                )
+            target = to_onehot(target.astype(jnp.int32), num_classes=self.num_classes).astype(jnp.int32)
+        else:
+            if mode != DataType.BINARY:
+                raise ValueError(f"`sketched` mode supports binary inputs only, got mode {mode}")
+            pos_label = 1 if getattr(self, "pos_label", None) is None else self.pos_label
+            preds = preds.reshape(-1, 1)
+            target = (target == pos_label).astype(jnp.int32).reshape(-1, 1)
+        lo, hi = self._sketch_range
+        pos, neg, clipped = label_score_histograms(preds, target, self._sketch_bins, lo, hi)
+        self.pos_hist = self.pos_hist + pos
+        self.neg_hist = self.neg_hist + neg
+        self.sketch_clipped = self.sketch_clipped + clipped
+
+    def _hist_check_degenerate(self) -> Optional[Array]:
+        """Eager raise on degenerate (single-label) histograms, mirroring the
+        capacity mode's :meth:`_check_degenerate_classes`; returns the
+        per-class positive supports for weighted averaging. Inside compiled
+        programs raising is impossible — the hist kernels return the same
+        0/0 NaN the reference's arithmetic would."""
+        if _is_traced(self.pos_hist, self.neg_hist):
+            return None
+        import numpy as np
+
+        pos = np.asarray(jnp.sum(self.pos_hist, axis=-1))
+        neg = np.asarray(jnp.sum(self.neg_hist, axis=-1))
+        if (pos + neg).sum() == 0:  # empty stream: compute-before-update already warned
+            return None
+        for p, n in zip(pos, neg):
+            if p > 0 and n == 0:
+                raise ValueError("No negative samples in targets, false positive value should be meaningless")
+            if n > 0 and p == 0:
+                raise ValueError("No positive samples in targets, true positive value should be meaningless")
+        return jnp.sum(self.pos_hist, axis=-1)
+
+    def _publish_hist_info(self) -> None:
+        self._publish_sketch_info(
+            kind="binned_histogram",
+            bins=self._sketch_bins,
+            range=list(self._sketch_range),
+            classes=int(self.pos_hist.shape[0]),
+            overflow=self.sketch_clipped,
+        )

@@ -100,11 +100,13 @@ def _keyed_gate(metric: Metric, what: str = "base_metric") -> None:
             f"{what} {name} registers no states, so there is nothing to key per"
             " tenant (compositions key their children instead)."
         )
+    hint = getattr(metric, "_sketch_hint", None)
+    hint = f" {hint}" if hint else ""
     if any(isinstance(v, list) for v in metric._defaults.values()):
         raise ValueError(
             f"{what} {name} holds unbounded list states, whose pytree grows every"
             " step under jit; keyed state must be fixed-shape — use the metric's"
-            " `capacity=`/`streaming=` mode, or keep per-tenant instances."
+            f" `capacity=`/`streaming=` mode, or keep per-tenant instances.{hint}"
         )
     bad = {
         k: fx
@@ -117,7 +119,7 @@ def _keyed_gate(metric: Metric, what: str = "base_metric") -> None:
             f" exactly: {bad}. Keyed updates support"
             f" {list(_SEGMENT_REDUCTIONS)} leaves ('sum' via segment_sum,"
             " 'max'/'min' via masked segment extremes); 'cat'/'mean'/callable"
-            " reductions stay single-stream."
+            f" reductions stay single-stream.{hint}"
         )
     if set(metric.init_state()) != set(metric._defaults):
         raise ValueError(

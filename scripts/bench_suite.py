@@ -1028,6 +1028,96 @@ def _ten_metric_classification_collection(nc=5):
     )
 
 
+#: sample counts for the sketched-vs-exact sync payload sweep (the bench
+#: acceptance: sketched payload bytes CONSTANT across this axis while the
+#: exact `cat` payload grows linearly); monkeypatched smaller in tests
+SKETCH_SYNC_SAMPLES = (10_000, 100_000, 1_000_000)
+#: histogram resolution of the sketched side (the class default)
+SKETCH_BINS = 2048
+
+
+def bench_sketched_state_sync():
+    """Bounded-memory sketched states: the O(samples) -> O(sketch) trade
+    measured. For every n in ``SKETCH_SYNC_SAMPLES`` an exact (list-state)
+    AUROC and a sketched AUROC ingest the same n-sample stream; the record
+    carries each side's epoch sync payload (``pytree_nbytes`` of the
+    gather-ready states — what the eager transport ships and the in-graph
+    path traces) and the sketched-vs-exact value delta at the largest n (the
+    documented-tolerance acceptance pin). The timed quantity is the sketched
+    donated compiled update step; the baseline is the exact list-state eager
+    update at the same batch size — the hot-path cost a production scorer
+    actually pays on each side. CPU-pinned (per-step host dispatch through
+    the tunnel would measure the link)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import AUROC
+    from metrics_tpu.observability.cost import pytree_nbytes
+
+    rng = np.random.RandomState(0)
+    chunk = 10_000
+    payload = {"sketched": {}, "exact": {}}
+    parity = {}
+    n_max = max(SKETCH_SYNC_SAMPLES)
+
+    sketched = AUROC(sketched=True, num_bins=SKETCH_BINS, compute_on_step=False)
+    exact = AUROC(compute_on_step=False)
+    seen = 0
+    for n in sorted(SKETCH_SYNC_SAMPLES):
+        while seen < n:
+            m = min(chunk, n - seen)
+            scores = rng.rand(m).astype(np.float32)
+            labels = (rng.rand(m) < scores).astype(np.int32)
+            p, t = jnp.asarray(scores), jnp.asarray(labels)
+            sketched.update(p, t)
+            exact.update(p, t)
+            seen += m
+        payload["sketched"][str(n)] = int(pytree_nbytes(sketched._pre_sync_states()[0]))
+        payload["exact"][str(n)] = int(pytree_nbytes(exact._pre_sync_states()[0]))
+        if n == n_max:
+            parity["exact_auroc"] = float(exact.compute())
+            parity["sketched_auroc"] = float(sketched.compute())
+            parity["abs_delta"] = abs(parity["exact_auroc"] - parity["sketched_auroc"])
+
+    # timed side: the donated compiled sketched update vs the eager exact
+    # list append, both at BATCH samples/step
+    p = jnp.asarray(rng.rand(BATCH).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, BATCH))
+    hot = AUROC(sketched=True, num_bins=SKETCH_BINS, compute_on_step=False).jit_forward()
+    hot.warmup(p, t)
+
+    def sketched_step():
+        hot(p, t)
+        jax.block_until_ready(hot.pos_hist)
+
+    ours = _time_eager_loop(sketched_step)
+
+    def ref(torchmetrics, torch):  # our own exact list-state update is the baseline
+        cold = AUROC(compute_on_step=False)
+
+        def exact_step():
+            cold(p, t)
+            jax.block_until_ready(cold.preds[-1])
+
+        return _time_eager_loop(exact_step)
+
+    ns = sorted(payload["sketched"])
+    extra = {
+        "samples": sorted(SKETCH_SYNC_SAMPLES),
+        "num_bins": SKETCH_BINS,
+        "payload_bytes": payload,
+        "payload_constant": len(set(payload["sketched"][n] for n in ns)) == 1,
+        "payload_ratio_at_max": round(
+            payload["exact"][str(n_max)] / max(payload["sketched"][str(n_max)], 1), 3
+        ),
+        "parity": parity,
+    }
+    return "sketched_state_sync_step", ours, ref, "us/step", extra
+
+
+bench_sketched_state_sync._force_cpu = True
+
+
 def bench_collection_sync_in_graph():
     """In-graph metric-state sync of the 10-metric classification collection,
     per scanned step: the packed (bucketed) engine — one collective per
@@ -1510,6 +1600,7 @@ CONFIG_META = {
     "bench_forward_scan_microbatch": ("forward_scan_microbatch", "us/step"),
     "bench_collection_compute_groups": ("collection_update_compute_groups", "us/step"),
     "bench_multitenant_update": ("multitenant_update_step", "us/tenant"),
+    "bench_sketched_state_sync": ("sketched_state_sync_step", "us/step"),
     "bench_collection_sync_in_graph": ("collection_sync_in_graph_step", "us/step"),
     "bench_collection_sync_eager": ("collection_sync_eager_epoch", "us/epoch"),
     "bench_collection_sync_hierarchical": ("collection_sync_hierarchical_step", "us/step"),
@@ -1531,6 +1622,7 @@ CONFIGS = [
     bench_forward_scan_microbatch,
     bench_collection_compute_groups,
     bench_multitenant_update,
+    bench_sketched_state_sync,
     bench_collection_sync_in_graph,
     bench_collection_sync_eager,
     bench_collection_sync_hierarchical,

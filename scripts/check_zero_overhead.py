@@ -119,11 +119,21 @@ def _programs() -> Dict[str, Callable[[], str]]:
         fn = functools.partial(coll.apply_forward, axis_name=None)
         return str(jax.make_jaxpr(fn)(coll.init_state(), preds, target))
 
+    def sketched_auroc_jit_forward() -> str:
+        from metrics_tpu import AUROC
+
+        m = AUROC(sketched=True, num_bins=256)
+        fn = functools.partial(m.apply_forward, axis_name=None)
+        bp = jnp.zeros((8,), jnp.float32)
+        bt = jnp.zeros((8,), jnp.int32)
+        return str(jax.make_jaxpr(fn)(m.init_state(), bp, bt))
+
     return {
         "metric_update": metric_update,
         "metric_jit_forward": metric_jit_forward,
         "collection_update": collection_update,
         "collection_jit_forward": collection_jit_forward,
+        "sketched_auroc_jit_forward": sketched_auroc_jit_forward,
     }
 
 
@@ -213,9 +223,24 @@ def sync_collective_counts() -> Dict[str, Dict[str, int]]:
         _shard_map(lambda s: acc.sync_state(s, "data"), mesh, (P(),), P())
     )(acc_state)
 
+    # the sketched-state acceptance pin: every AUROC(sketched=True) leaf is a
+    # float32 "sum" array, so the whole sync — histograms AND overflow
+    # counter — must ride ONE packed psum regardless of sample count (the
+    # exact `cat` path this mode replaces pays an O(samples) all_gather)
+    from metrics_tpu import AUROC
+
+    sk = AUROC(sketched=True, num_bins=256)
+    sk_state = sk.apply_update(
+        sk.init_state(), jnp.zeros((8,), jnp.float32), jnp.zeros((8,), jnp.int32)
+    )
+    sk_jaxpr = jax.make_jaxpr(
+        _shard_map(lambda s: sk.sync_state(s, "data"), mesh, (P(),), P())
+    )(sk_state)
+
     return {
         "collection_sync_packed": _count_collectives(coll_jaxpr.jaxpr),
         "metric_sync_packed": _count_collectives(metric_jaxpr.jaxpr),
+        "sketched_auroc_sync_packed": _count_collectives(sk_jaxpr.jaxpr),
     }
 
 
@@ -329,6 +354,18 @@ def donation_aliasing() -> Dict[str, Dict[str, int]]:
     )
     out["capacity_jit_forward_donated"] = {
         "state_leaves": leaves(astate), "aliased": txt.count("tf.aliasing_output")
+    }
+
+    # the sketched-state acceptance pin: the bounded-memory histogram states
+    # must donate like any other fixed-shape state — every leaf aliased, so
+    # the compiled step updates the histograms in place
+    sk = AUROC(sketched=True, num_bins=256, compute_on_step=False).jit_forward()
+    sk_state = sk._get_states()
+    txt = sk._forward_dispatch().lower_text(
+        sk_state, jnp.zeros((8,), jnp.float32), jnp.zeros((8,), jnp.int32)
+    )
+    out["sketched_auroc_donated"] = {
+        "state_leaves": leaves(sk_state), "aliased": txt.count("tf.aliasing_output")
     }
 
     coll = MetricCollection([Accuracy(), Precision(average="macro", num_classes=3)]).jit_forward()

@@ -149,3 +149,67 @@ def test_feature_buffer_write_chunked_oversized_batch():
     with pytest.warns(UserWarning, match="dropped 7"):
         got = feature_buffer_read(buf, count, capacity, slack, "T")
     np.testing.assert_array_equal(np.asarray(got), np.asarray(rows[:capacity]))
+
+
+class TestOverflowErrorPolicy:
+    """``overflow="error"``: a descriptive BufferOverflowError (metric name +
+    capacity + overflow count) instead of the warn-and-truncate default —
+    on both the eager and the compiled update path."""
+
+    def test_eager_overflow_raises_with_details(self):
+        from metrics_tpu import AUROC, BufferOverflowError
+
+        m = AUROC(capacity=8, overflow="error")
+        m.update(jnp.linspace(0, 1, 20), jnp.arange(20) % 2)
+        with pytest.raises(BufferOverflowError) as err:
+            m.compute()
+        msg = str(err.value)
+        assert "AUROC" in msg and "capacity=8" in msg and "12 sample(s)" in msg
+
+    def test_compiled_overflow_raises_at_next_eager_compute(self):
+        """jit_forward steps cannot raise in-graph (the counter is traced);
+        the overflow must still surface — at the next eager compute."""
+        from metrics_tpu import BufferOverflowError, SpearmanCorrcoef
+
+        m = SpearmanCorrcoef(capacity=8, overflow="error", compute_on_step=False).jit_forward()
+        x = jnp.linspace(0.0, 1.0, 6)
+        for _ in range(3):  # 18 samples through the compiled donated step
+            m(x, x)
+        with pytest.raises(BufferOverflowError, match=r"capacity=8.*10 sample"):
+            m.compute()
+
+    def test_update_many_overflow_raises_at_compute(self):
+        from metrics_tpu import AveragePrecision, BufferOverflowError
+
+        m = AveragePrecision(capacity=4, overflow="error")
+        p = jnp.stack([jnp.linspace(0, 1, 4)] * 3)
+        t = jnp.stack([jnp.asarray([0, 1, 0, 1])] * 3)
+        m.update_many(p, t)
+        with pytest.raises(BufferOverflowError, match="AveragePrecision"):
+            m.compute()
+
+    def test_within_capacity_never_raises(self):
+        from metrics_tpu import AUROC
+
+        m = AUROC(capacity=32, overflow="error")
+        m.update(jnp.linspace(0, 1, 16), jnp.arange(16) % 2)
+        assert np.isfinite(float(m.compute()))
+
+    def test_default_policy_still_warns_and_truncates(self):
+        from metrics_tpu import AUROC
+
+        m = AUROC(capacity=8)
+        m.update(jnp.linspace(0, 1, 20), jnp.arange(20) % 2)
+        with pytest.warns(UserWarning, match="dropped 12"):
+            float(m.compute())
+
+    def test_bad_policy_rejected(self):
+        from metrics_tpu import AUROC
+
+        with pytest.raises(ValueError, match="overflow"):
+            AUROC(capacity=8, overflow="explode")
+
+    def test_error_is_importable_and_catchable_as_runtime_error(self):
+        from metrics_tpu import BufferOverflowError
+
+        assert issubclass(BufferOverflowError, RuntimeError)

@@ -1,0 +1,606 @@
+"""Parity fuzz + merge-property suite for the bounded-memory sketched states.
+
+Three layers:
+
+1. **Sketch algebra** — merge commutativity/associativity and the identity
+   element for each of the three summaries (histograms merge by ``+``, the
+   reservoir by re-keeping the smallest priorities), plus the quantile
+   sketch's query functions.
+2. **Sketched-vs-exact parity** — fuzz across distributions and
+   bin/capacity sizes with the tolerance pins documented in
+   ``docs/performance.md#bounded-memory-sketched-states``.
+3. **The hot-path acceptance gates** — sketched AUROC through jit_forward /
+   donation / update_many / compute groups / keyed, eligibility-gate error
+   messages pointing at ``sketched=True``, and a 2-simulated-process
+   ``sync_state_packed`` round-trip on the virtual mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    AUROC,
+    AveragePrecision,
+    MetricCollection,
+    PrecisionRecallCurve,
+    ROC,
+    RetrievalMAP,
+    SpearmanCorrcoef,
+)
+from metrics_tpu.kernels.binned_counts import label_score_histograms
+from metrics_tpu.kernels.sketches import (
+    bounded_priority_keep,
+    cdf_sketch_cdf,
+    cdf_sketch_quantile,
+    cdf_sketch_update,
+    hist_auroc,
+    joint_grid_update,
+    spearman_from_grid,
+    uniform_hash,
+    weighted_priority,
+)
+
+
+def _scored_stream(rng, n):
+    """Uniform scores with Bernoulli(score) labels — a calibrated scorer."""
+    scores = rng.rand(n).astype(np.float32)
+    labels = (rng.rand(n) < scores).astype(np.int32)
+    return jnp.asarray(scores), jnp.asarray(labels)
+
+
+# ---------------------------------------------------------------------------
+# sketch algebra: merge properties + identity
+# ---------------------------------------------------------------------------
+
+
+class TestMergeProperties:
+    def test_histogram_merge_commutes_and_associates_exactly(self):
+        rng = np.random.RandomState(0)
+        parts = []
+        for _ in range(3):
+            p, t = _scored_stream(rng, 257)
+            pos, neg, _ = label_score_histograms(p[:, None], t[:, None], 64)
+            parts.append((pos, neg))
+        a, b, c = parts
+        # counts are exact f32 integers: + is exactly commutative/associative
+        assert jnp.array_equal(a[0] + b[0], b[0] + a[0])
+        assert jnp.array_equal((a[0] + b[0]) + c[0], a[0] + (b[0] + c[0]))
+        # identity element: the zero histogram (a fresh init_state)
+        zero = jnp.zeros_like(a[0])
+        assert jnp.array_equal(a[0] + zero, a[0])
+
+    def test_joint_grid_merge_commutes_with_identity(self):
+        rng = np.random.RandomState(1)
+        grids = []
+        for _ in range(2):
+            x = jnp.asarray(rng.randn(300).astype(np.float32))
+            y = jnp.asarray(rng.randn(300).astype(np.float32))
+            g, _ = joint_grid_update(jnp.zeros((32, 32), jnp.float32), x, y, (-4, 4), (-4, 4))
+            grids.append(g)
+        a, b = grids
+        assert jnp.array_equal(a + b, b + a)
+        assert jnp.array_equal(a + jnp.zeros_like(a), a)
+
+    def test_reservoir_merge_order_independent(self):
+        """Two independently-built reservoirs keep the same row population
+        merged in either order (deterministic per-id priorities)."""
+        cap = 32
+        rng = np.random.RandomState(2)
+
+        def build(ids):
+            keys = jnp.full((cap,), jnp.inf, jnp.float32)
+            qids = jnp.zeros((cap,), jnp.int32)
+            vals = jnp.zeros((cap,), jnp.float32)
+            new_ids = jnp.asarray(ids, jnp.int32)
+            k, q, (v,) = bounded_priority_keep(
+                jnp.concatenate([keys, uniform_hash(new_ids)]),
+                jnp.concatenate([qids, new_ids]),
+                (jnp.concatenate([vals, new_ids.astype(jnp.float32)]),),
+                cap,
+            )
+            return k, q, v
+
+        a = build(rng.randint(0, 1000, 40))
+        b = build(rng.randint(1000, 2000, 40))
+
+        def merge(x, y):
+            return bounded_priority_keep(
+                jnp.concatenate([x[0], y[0]]),
+                jnp.concatenate([x[1], y[1]]),
+                (jnp.concatenate([x[2], y[2]]),),
+                cap,
+            )
+
+        kab, qab, (vab,) = merge(a, b)
+        kba, qba, (vba,) = merge(b, a)
+        assert jnp.array_equal(kab, kba)
+        assert jnp.array_equal(qab, qba)
+        assert jnp.array_equal(vab, vba)
+        # identity element: merging with an all-empty reservoir is a no-op
+        empty = (
+            jnp.full((cap,), jnp.inf, jnp.float32),
+            jnp.zeros((cap,), jnp.int32),
+            jnp.zeros((cap,), jnp.float32),
+        )
+        kid, qid_, (vid,) = merge(a, empty)
+        assert jnp.array_equal(kid, a[0]) and jnp.array_equal(qid_, a[1]) and jnp.array_equal(vid, a[2])
+
+    def test_uniform_hash_is_deterministic_and_spread(self):
+        ids = jnp.arange(10_000)
+        u = uniform_hash(ids)
+        assert jnp.array_equal(u, uniform_hash(ids))  # pure function of the id
+        u = np.asarray(u)
+        assert 0.0 <= u.min() and u.max() < 1.0
+        assert abs(u.mean() - 0.5) < 0.02  # roughly uniform
+
+    def test_weighted_priority_prefers_heavy_items(self):
+        """Doubling an item's weight halves its expected priority: across
+        many hashed draws, heavy items win the keep far more often."""
+        u = np.asarray(uniform_hash(jnp.arange(20_000)))
+        light = np.asarray(weighted_priority(jnp.asarray(u[:10_000]), 1.0))
+        heavy = np.asarray(weighted_priority(jnp.asarray(u[10_000:]), 4.0))
+        assert (heavy < light).mean() > 0.7
+
+
+class TestQuantileSketch:
+    def test_quantiles_and_cdf_match_numpy_within_grid_step(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(50_000).astype(np.float32)
+        counts = cdf_sketch_update(jnp.zeros((512,), jnp.float32), jnp.asarray(x), -5.0, 5.0)
+        for q in (0.1, 0.5, 0.9, 0.99):
+            est = float(cdf_sketch_quantile(counts, q, -5.0, 5.0))
+            ref = float(np.quantile(x, q))
+            assert abs(est - ref) < 3 * (10.0 / 512), (q, est, ref)
+        for v in (-1.0, 0.0, 2.0):
+            est = float(cdf_sketch_cdf(counts, jnp.asarray(v), -5.0, 5.0))
+            ref = float((x <= v).mean())
+            assert abs(est - ref) < 0.01
+
+    def test_merge_then_query_equals_single_pass(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(4000).astype(np.float32)
+        whole = cdf_sketch_update(jnp.zeros((128,), jnp.float32), jnp.asarray(x), -4.0, 4.0)
+        halves = sum(
+            cdf_sketch_update(jnp.zeros((128,), jnp.float32), jnp.asarray(part), -4.0, 4.0)
+            for part in (x[:1000], x[1000:])
+        )
+        assert jnp.array_equal(whole, halves)
+
+
+# ---------------------------------------------------------------------------
+# sketched-vs-exact parity fuzz (the documented tolerance pins)
+# ---------------------------------------------------------------------------
+
+
+class TestParityFuzz:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("num_bins", [512, 2048])
+    def test_auroc_binary_tolerance(self, seed, num_bins):
+        rng = np.random.RandomState(seed)
+        p, t = _scored_stream(rng, 20_000)
+        sk = AUROC(sketched=True, num_bins=num_bins)
+        ex = AUROC()
+        for lo in range(0, 20_000, 5000):  # multi-batch accumulation
+            sk.update(p[lo : lo + 5000], t[lo : lo + 5000])
+            ex.update(p[lo : lo + 5000], t[lo : lo + 5000])
+        assert abs(float(sk.compute()) - float(ex.compute())) < 5e-3
+
+    @pytest.mark.parametrize("dist", ["uniform", "beta", "logit_normal"])
+    def test_auroc_across_score_distributions(self, dist):
+        rng = np.random.RandomState(7)
+        n = 20_000
+        if dist == "uniform":
+            scores = rng.rand(n)
+        elif dist == "beta":
+            scores = rng.beta(0.5, 0.5, n)  # mass piled at the grid edges
+        else:
+            scores = 1.0 / (1.0 + np.exp(-rng.randn(n)))
+        scores = scores.astype(np.float32)
+        labels = (rng.rand(n) < scores).astype(np.int32)
+        sk = AUROC(sketched=True)
+        ex = AUROC()
+        sk.update(jnp.asarray(scores), jnp.asarray(labels))
+        ex.update(jnp.asarray(scores), jnp.asarray(labels))
+        assert abs(float(sk.compute()) - float(ex.compute())) < 5e-3
+
+    def test_average_precision_tolerance(self):
+        rng = np.random.RandomState(8)
+        p, t = _scored_stream(rng, 20_000)
+        sk = AveragePrecision(sketched=True)
+        ex = AveragePrecision()
+        sk.update(p, t)
+        ex.update(p, t)
+        assert abs(float(sk.compute()) - float(ex.compute())) < 5e-3
+
+    def test_auroc_multiclass_macro_and_weighted(self):
+        rng = np.random.RandomState(9)
+        n, c = 4000, 4
+        logits = rng.randn(n, c).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+        labels = np.array([rng.choice(c, p=probs[i]) for i in range(n)], np.int32)
+        for average in ("macro", "weighted"):
+            sk = AUROC(sketched=True, num_classes=c, average=average)
+            ex = AUROC(num_classes=c, average=average)
+            sk.update(jnp.asarray(probs), jnp.asarray(labels))
+            ex.update(jnp.asarray(probs), jnp.asarray(labels))
+            assert abs(float(sk.compute()) - float(ex.compute())) < 1e-2, average
+
+    def test_roc_and_pr_curve_points_lie_on_exact_curves(self):
+        """The sketched curves sample the exact curves at the bin-edge grid:
+        every sketched (fpr, tpr) point must match the exact ROC evaluated
+        at that threshold (counts are exact per grid threshold)."""
+        rng = np.random.RandomState(10)
+        p, t = _scored_stream(rng, 3000)
+        sk = ROC(sketched=True, num_bins=64)
+        sk.update(p, t)
+        fpr, tpr, thresholds = sk.compute()
+        pn, tn = np.asarray(p), np.asarray(t)
+        pos, neg = (tn == 1).sum(), (tn == 0).sum()
+        for k in range(1, len(thresholds)):  # skip the synthetic (0,0) point
+            thr = float(thresholds[k])
+            np.testing.assert_allclose(float(tpr[k]), ((pn >= thr) & (tn == 1)).sum() / pos, rtol=1e-6)
+            np.testing.assert_allclose(float(fpr[k]), ((pn >= thr) & (tn == 0)).sum() / neg, rtol=1e-6)
+
+        prc = PrecisionRecallCurve(sketched=True, num_bins=64)
+        prc.update(p, t)
+        precision, recall, thr = prc.compute()
+        for k in (0, 13, 63):
+            sel = pn >= float(thr[k])
+            tp = (sel & (tn == 1)).sum()
+            np.testing.assert_allclose(float(recall[k]), tp / pos, rtol=1e-5)
+            np.testing.assert_allclose(float(precision[k]), tp / max(sel.sum(), 1), rtol=1e-4)
+
+    @pytest.mark.parametrize("num_bins", [256, 512])
+    @pytest.mark.parametrize("dist", ["normal", "uniform", "heavy_tail"])
+    def test_spearman_tolerance(self, num_bins, dist):
+        rng = np.random.RandomState(11)
+        n = 10_000
+        if dist == "normal":
+            x = rng.randn(n)
+        elif dist == "uniform":
+            x = rng.rand(n) * 8 - 4
+        else:
+            x = np.clip(rng.standard_t(2, n), -6, 6)
+        y = x + rng.randn(n) * 1.2
+        x, y = x.astype(np.float32), y.astype(np.float32)
+        sk = SpearmanCorrcoef(sketched=True, num_bins=num_bins, value_range=(-8.0, 8.0))
+        ex = SpearmanCorrcoef()
+        sk.update(jnp.asarray(x), jnp.asarray(y))
+        ex.update(jnp.asarray(x), jnp.asarray(y))
+        assert abs(float(sk.compute()) - float(ex.compute())) < 1e-2
+
+    def test_spearman_exact_on_distinct_bins(self):
+        """With every sample in its own bin the grid preserves the full
+        ranking: rho is exact to float tolerance."""
+        x = np.linspace(-0.9, 0.9, 50).astype(np.float32)
+        rng = np.random.RandomState(12)
+        y = np.asarray(sorted(rng.rand(50)), np.float32)[np.argsort(np.argsort(x))]
+        sk = SpearmanCorrcoef(sketched=True, num_bins=4096, value_range=(-1.0, 1.0))
+        ex = SpearmanCorrcoef()
+        sk.update(jnp.asarray(x), jnp.asarray(y))
+        ex.update(jnp.asarray(x), jnp.asarray(y))
+        np.testing.assert_allclose(float(sk.compute()), float(ex.compute()), atol=1e-5)
+
+    def test_retrieval_exact_below_capacity_and_sampled_above(self):
+        rng = np.random.RandomState(13)
+        queries = rng.randint(0, 200, 3000)
+        preds = rng.rand(3000).astype(np.float32)
+        target = rng.randint(0, 2, 3000)
+        args = (jnp.asarray(preds), jnp.asarray(target))
+        kw = dict(indexes=jnp.asarray(queries))
+
+        exact = RetrievalMAP()
+        exact.update(*args, **kw)
+        ref = float(exact.compute())
+
+        # never overflowed -> bit-identical to the exact flat mode
+        big = RetrievalMAP(sketched=True, sketch_capacity=4096)
+        big.update(*args, **kw)
+        assert float(big.compute()) == ref
+
+        # overflowed -> unbiased sample of complete queries, warned about
+        small = RetrievalMAP(sketched=True, sketch_capacity=512)
+        small.update(*args, **kw)
+        with pytest.warns(UserWarning, match="sampled the query stream"):
+            est = float(small.compute())
+        assert abs(est - ref) < 0.15  # ~30 sampled queries
+
+    def test_retrieval_sampled_estimate_converges_with_capacity(self):
+        rng = np.random.RandomState(14)
+        queries = rng.randint(0, 500, 10_000)
+        preds = rng.rand(10_000).astype(np.float32)
+        target = rng.randint(0, 2, 10_000)
+        exact = RetrievalMAP()
+        exact.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(queries))
+        ref = float(exact.compute())
+        errs = []
+        for cap in (512, 4096):
+            m = RetrievalMAP(sketched=True, sketch_capacity=cap)
+            m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(queries))
+            with pytest.warns(UserWarning, match="sampled"):
+                errs.append(abs(float(m.compute()) - ref))
+        assert errs[1] < max(errs[0], 0.05) + 1e-9  # more capacity, no worse
+
+    def test_reservoir_query_integrity_across_batches(self):
+        """A kept query's rows all survive even when they arrived in
+        different batches around eviction events."""
+        rng = np.random.RandomState(15)
+        m = RetrievalMAP(sketched=True, sketch_capacity=256)
+        all_q, all_p, all_t = [], [], []
+        for step in range(6):
+            q = rng.randint(0, 120, 300)
+            p = rng.rand(300).astype(np.float32)
+            t = rng.randint(0, 2, 300)
+            m.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(q))
+            all_q.append(q), all_p.append(p), all_t.append(t)
+        with pytest.warns(UserWarning, match="sampled"):
+            idx, preds, targ = m._reservoir_rows()
+        q_all = np.concatenate(all_q)
+        for qid in np.unique(idx):
+            assert (idx == qid).sum() == (q_all == qid).sum(), f"query {qid} truncated"
+
+
+# ---------------------------------------------------------------------------
+# hot-path acceptance gates
+# ---------------------------------------------------------------------------
+
+
+class TestCompiledGates:
+    def _stream(self, n=256, seed=0):
+        rng = np.random.RandomState(seed)
+        return _scored_stream(rng, n)
+
+    def test_sketched_auroc_jit_forward_warmup_donation(self):
+        p, t = self._stream()
+        m = AUROC(sketched=True, num_bins=128).jit_forward()
+        report = m.warmup(p, t)
+        assert report["donated"] is True
+        eager = AUROC(sketched=True, num_bins=128)
+        for _ in range(3):
+            compiled_value = m(p, t)
+            eager_value = eager(p, t)
+        np.testing.assert_allclose(np.asarray(compiled_value), np.asarray(eager_value), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(eager.compute()), rtol=1e-6)
+
+    def test_sketched_auroc_update_many(self):
+        p, t = self._stream()
+        k = 4
+        m = AUROC(sketched=True, num_bins=128)
+        m.update_many(jnp.stack([p] * k), jnp.stack([t] * k))
+        ref = AUROC(sketched=True, num_bins=128)
+        for _ in range(k):
+            ref.update(p, t)
+        np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(ref.compute()), rtol=1e-6)
+
+    def test_sketched_auroc_compute_group(self):
+        """Two identical sketched AUROCs in a collection share ONE state."""
+        p, t = self._stream()
+        coll = MetricCollection({"a": AUROC(sketched=True, num_bins=64), "b": AUROC(sketched=True, num_bins=64)})
+        coll.jit_forward()
+        coll(p, t)
+        report = coll.compute_group_report()
+        assert report["built"] and report["groups"] == {"a": ["a", "b"]}
+        vals = coll.compute()
+        assert float(vals["a"]) == float(vals["b"])
+
+    def test_sketched_auroc_keyed_matches_independent_instances(self):
+        rng = np.random.RandomState(3)
+        p, t = self._stream(512, seed=3)
+        n_tenants = 5
+        ids = jnp.asarray(rng.randint(0, n_tenants, 512))
+        km = AUROC(sketched=True, num_bins=64).keyed(n_tenants)
+        km.update(ids, p, t)
+        keyed_vals = np.asarray(km.compute())
+        for i in range(n_tenants):
+            sel = np.where(np.asarray(ids) == i)[0]
+            ref = AUROC(sketched=True, num_bins=64)
+            ref.update(p[sel], t[sel])
+            np.testing.assert_array_equal(keyed_vals[i], np.asarray(ref.compute()))
+
+    def test_sketched_spearman_jit_forward(self):
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(256).astype(np.float32))
+        y = jnp.asarray(rng.randn(256).astype(np.float32))
+        m = SpearmanCorrcoef(sketched=True, num_bins=64, value_range=(-4.0, 4.0)).jit_forward()
+        eager = SpearmanCorrcoef(sketched=True, num_bins=64, value_range=(-4.0, 4.0))
+        m(x, y)
+        eager(x, y)
+        np.testing.assert_allclose(float(m.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_sketched_retrieval_update_is_jittable(self):
+        """The reservoir update is pure jnp: accumulate-only jit_forward
+        (compute stays an eager epoch-end pass, like the flat mode)."""
+        rng = np.random.RandomState(5)
+        m = RetrievalMAP(sketched=True, sketch_capacity=128, compute_on_step=False).jit_forward()
+        eager = RetrievalMAP(sketched=True, sketch_capacity=128)
+        for step in range(3):
+            q = jnp.asarray(rng.randint(0, 40, 100))
+            p = jnp.asarray(rng.rand(100).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, 2, 100))
+            m(p, t, indexes=q)
+            eager.update(p, t, indexes=q)
+        assert float(m.compute()) == float(eager.compute())
+
+
+class TestGateMessagesPointAtSketched:
+    def test_jit_forward_refusal_names_sketched_alternative(self):
+        with pytest.raises(ValueError, match="sketched=True"):
+            AUROC().jit_forward()
+        with pytest.raises(ValueError, match="sketched=True"):
+            SpearmanCorrcoef().jit_forward()
+        with pytest.raises(ValueError, match="sketched=True"):
+            RetrievalMAP().jit_forward()
+
+    def test_update_many_refusal_names_sketched_alternative(self):
+        p = jnp.zeros((2, 8), jnp.float32)
+        t = jnp.zeros((2, 8), jnp.int32)
+        with pytest.raises(ValueError, match="sketched=True"):
+            PrecisionRecallCurve().update_many(p, t)
+
+    def test_keyed_gate_names_sketched_alternative_for_lists_and_cat(self):
+        # list states (the flat exact mode)
+        with pytest.raises(ValueError, match="sketched=True"):
+            AUROC().keyed(4)
+        # fixed-shape but cat-reduced states (the capacity mode)
+        with pytest.raises(ValueError, match="sketched=True"):
+            AUROC(capacity=64).keyed(4)
+
+    def test_non_sketchable_metrics_keep_the_plain_message(self):
+        from metrics_tpu import PearsonCorrcoef
+
+        with pytest.raises(ValueError) as err:
+            PearsonCorrcoef().keyed(4)
+        assert "sketched=True" not in str(err.value)
+
+
+class TestPackedSyncRoundTrip:
+    def test_two_simulated_processes_one_psum(self):
+        """2-shard ``sync_state_packed`` round-trip on the virtual mesh: each
+        simulated process holds half the stream, the packed in-graph sync
+        reduces the histogram states, and BOTH shards compute the
+        all-samples AUROC — equal to a single-process run over the
+        concatenated stream."""
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        rng = np.random.RandomState(6)
+        p, t = _scored_stream(rng, 512)
+        world = 2
+        m = AUROC(sketched=True, num_bins=64)
+
+        halves = [
+            m.apply_update(m.init_state(), p[i * 256 : (i + 1) * 256], t[i * 256 : (i + 1) * 256])
+            for i in range(world)
+        ]
+        stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *halves)
+        mesh = Mesh(np.array(jax.devices()[:world]), ("proc",))
+
+        def body(state):
+            state = jax.tree.map(lambda leaf: leaf[0], state)  # this shard's state
+            return m.apply_compute(state, axis_name="proc")[None]
+
+        if hasattr(jax, "shard_map"):
+            fn = jax.shard_map(body, mesh=mesh, in_specs=(P("proc"),), out_specs=P("proc"), check_vma=False)
+        else:
+            from jax.experimental.shard_map import shard_map
+
+            fn = shard_map(body, mesh=mesh, in_specs=(P("proc"),), out_specs=P("proc"))
+        per_shard = np.asarray(fn(stacked))
+
+        single = AUROC(sketched=True, num_bins=64)
+        single.update(p, t)
+        expected = float(single.compute())
+        np.testing.assert_allclose(per_shard, expected, rtol=1e-6)
+
+        # the collective-count pin: ONE psum for the whole sketched state
+        jaxpr = str(jax.make_jaxpr(fn)(stacked))
+        assert jaxpr.count("psum") == 1
+        assert "all_gather" not in jaxpr
+
+    def test_reservoir_gather_merge_matches_single_process(self):
+        """The eager path's shard merge: two reservoirs built on disjoint
+        halves, cat-gathered (as _apply_gathered_states produces), compute
+        the same sampled value a single never-overflowed reservoir gives."""
+        rng = np.random.RandomState(16)
+        q = rng.randint(0, 60, 800)
+        p = rng.rand(800).astype(np.float32)
+        t = rng.randint(0, 2, 800)
+
+        shards = []
+        for i in range(2):
+            m = RetrievalMAP(sketched=True, sketch_capacity=1024)
+            sl = slice(i * 400, (i + 1) * 400)
+            m.update(jnp.asarray(p[sl]), jnp.asarray(t[sl]), indexes=jnp.asarray(q[sl]))
+            shards.append(m)
+
+        merged = RetrievalMAP(sketched=True, sketch_capacity=1024)
+        merged._update_called = True
+        for name in ("res_key", "res_qid", "res_pred", "res_target", "res_overflow"):
+            setattr(merged, name, jnp.concatenate([getattr(s, name) for s in shards]))
+        merged.res_seen = shards[0].res_seen + shards[1].res_seen
+
+        single = RetrievalMAP(sketched=True, sketch_capacity=4096)
+        single.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(q))
+        assert float(merged.compute()) == float(single.compute())
+
+
+class TestSketchTelemetry:
+    def test_snapshot_carries_sketch_info_and_merge_counter(self):
+        from metrics_tpu import observability
+
+        observability.reset()
+        rng = np.random.RandomState(17)
+        p, t = _scored_stream(rng, 64)
+        m = AUROC(sketched=True, num_bins=32)
+        m(p, t)  # fused forward: one eager batch->accumulator sketch merge
+        m(p, t)
+        m.compute()
+        snap = observability.snapshot()
+        entry = snap["metrics"][m.telemetry_key]
+        assert entry["counters"]["sketch_merges"] >= 2
+        info = entry["info"]["sketch"]
+        assert info["kind"] == "binned_histogram"
+        assert info["bins"] == 32
+        assert info["overflow"] == 0.0
+
+    def test_out_of_range_scores_counted_as_overflow(self):
+        from metrics_tpu import observability
+
+        observability.reset()
+        m = AUROC(sketched=True, num_bins=32, score_range=(0.0, 1.0))
+        m.update(jnp.asarray([0.5, 1.5, -0.5, 0.2]), jnp.asarray([1, 1, 0, 0]))
+        m.compute()
+        snap = observability.snapshot()
+        assert snap["metrics"][m.telemetry_key]["info"]["sketch"]["overflow"] == 2.0
+
+
+class TestSketchedModeValidation:
+    def test_sketched_and_capacity_are_exclusive(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            AUROC(sketched=True, capacity=100)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            SpearmanCorrcoef(sketched=True, capacity=100, value_range=(0, 1))
+
+    def test_sketched_spearman_requires_value_range(self):
+        with pytest.raises(ValueError, match="value_range"):
+            SpearmanCorrcoef(sketched=True)
+
+    def test_sketched_rejects_max_fpr_and_micro(self):
+        with pytest.raises(ValueError, match="max_fpr"):
+            AUROC(sketched=True, max_fpr=0.5)
+        with pytest.raises(ValueError, match="average"):
+            AUROC(sketched=True, num_classes=3, average="micro")
+
+    def test_sketched_retrieval_rejects_padded(self):
+        with pytest.raises(ValueError, match="padded"):
+            RetrievalMAP(sketched=True, padded=True)
+
+    def test_bad_grid_configuration(self):
+        with pytest.raises(ValueError, match="num_bins"):
+            AUROC(sketched=True, num_bins=1)
+        with pytest.raises(ValueError, match="score_range"):
+            AUROC(sketched=True, score_range=(1.0, 0.0))
+        with pytest.raises(ValueError, match="sketch_capacity"):
+            RetrievalMAP(sketched=True, sketch_capacity=0)
+
+    def test_state_dict_round_trip(self):
+        rng = np.random.RandomState(18)
+        p, t = _scored_stream(rng, 128)
+        m = AUROC(sketched=True, num_bins=64)
+        m.update(p, t)
+        m.persistent(True)
+        sd = m.state_dict()
+        m2 = AUROC(sketched=True, num_bins=64)
+        m2.load_state_dict(sd)
+        m2._update_called = True
+        np.testing.assert_allclose(float(m2.compute()), float(m.compute()), rtol=1e-7)
+
+    def test_reset_restores_fresh_sketch(self):
+        rng = np.random.RandomState(19)
+        p, t = _scored_stream(rng, 128)
+        m = SpearmanCorrcoef(sketched=True, num_bins=32, value_range=(0.0, 1.0))
+        m.update(p, t.astype(jnp.float32))
+        m.reset()
+        assert float(jnp.sum(m.joint_grid)) == 0.0

@@ -556,3 +556,37 @@ def test_exposition_covers_async_and_per_level_families():
         lbls.get("bucket") == "dcn/psum/float64"
         for lbls in by_name["metrics_tpu_sync_in_graph_bucket_states_total"]
     )
+
+
+def test_sketch_families_render_with_metadata(stream):
+    """Satellite: the sketched-state families — sketch_bins /
+    sketch_overflow_total / sketch_merges_total — render with # HELP / # TYPE
+    and parse under the exposition checker, carrying the sketch kind label."""
+    from metrics_tpu import AUROC
+
+    m = AUROC(sketched=True, num_bins=32)
+    preds = jnp.asarray([0.1, 0.7, 1.4, 0.3])  # one out-of-range score
+    target = jnp.asarray([0, 1, 1, 0])
+    m(preds, target)
+    m(preds, target)  # fused forward merges the sketch accumulator
+    m.compute()
+
+    text = observability.render_prometheus()
+    samples = _check_exposition_format(text)
+    names = {s[0] for s in samples}
+    assert "metrics_tpu_sketch_bins" in names
+    assert "metrics_tpu_sketch_overflow_total" in names
+    assert "metrics_tpu_sketch_merges_total" in names
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    bins = [s for s in by_name["metrics_tpu_sketch_bins"] if s[0].get("metric") == m.telemetry_key]
+    assert bins and bins[0][0]["kind"] == "binned_histogram" and bins[0][1] == 32.0
+    overflow = [
+        s for s in by_name["metrics_tpu_sketch_overflow_total"] if s[0].get("metric") == m.telemetry_key
+    ]
+    assert overflow and overflow[0][1] == 2.0  # two updates x one clipped score
+    merges = [
+        s for s in by_name["metrics_tpu_sketch_merges_total"] if s[0].get("metric") == m.telemetry_key
+    ]
+    assert merges and merges[0][1] >= 2.0

@@ -39,6 +39,7 @@ def test_baseline_file_is_pinned():
         "metric_jit_forward",
         "collection_update",
         "collection_jit_forward",
+        "sketched_auroc_jit_forward",
     }
     for rec in baseline["programs"].values():
         assert rec["sha256"] and rec["jaxpr"]
@@ -46,6 +47,7 @@ def test_baseline_file_is_pinned():
     assert set(baseline["sync_collectives"]) == {
         "collection_sync_packed",
         "metric_sync_packed",
+        "sketched_auroc_sync_packed",
     }
     for counts in baseline["sync_collectives"].values():
         assert counts and all(isinstance(n, int) for n in counts.values())
@@ -110,6 +112,7 @@ def test_donated_lowerings_alias_every_state_buffer():
     assert set(donation) == {
         "metric_jit_forward_donated",
         "capacity_jit_forward_donated",
+        "sketched_auroc_donated",
         "collection_jit_forward_donated",
         "metric_update_many_donated",
         "keyed_update_donated",
@@ -132,6 +135,7 @@ def test_donation_aliasing_is_pinned_in_baseline():
     assert set(pinned) == {
         "metric_jit_forward_donated",
         "capacity_jit_forward_donated",
+        "sketched_auroc_donated",
         "collection_jit_forward_donated",
         "metric_update_many_donated",
         "keyed_update_donated",

@@ -300,3 +300,28 @@ def test_compute_async_overlap_bench_record_round_trips(monkeypatch):
     assert line["simulated_hosts"] == 2
     assert line["transport_rounds"] == {"descriptor": 1, "payload": 1}
     assert "bench_compute_async_overlap" in bench_suite.CONFIG_META
+
+
+def test_sketched_state_sync_bench_record_round_trips(monkeypatch):
+    """The sketched-state config's record must survive json round-trips and
+    carry the acceptance evidence: sync payload bytes CONSTANT across the
+    sample-count axis for the sketched side (O(sketch)) while the exact
+    `cat` payload grows, and sketched-vs-exact parity within the documented
+    tolerance at the largest n."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "SKETCH_SYNC_SAMPLES", (1_000, 4_000))
+    monkeypatch.setattr(bench_suite, "SKETCH_BINS", 256)
+    monkeypatch.setattr(bench_suite, "REF_STEPS", 5)
+    line = bench_suite.run_config(bench_suite.bench_sketched_state_sync, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "sketched_state_sync_step" and line["unit"] == "us/step"
+    payload = line["payload_bytes"]
+    assert line["payload_constant"] is True
+    assert payload["sketched"]["1000"] == payload["sketched"]["4000"]  # O(sketch)
+    assert payload["exact"]["4000"] == 4 * payload["exact"]["1000"]  # O(samples)
+    assert line["payload_ratio_at_max"] > 1.0
+    assert line["parity"]["abs_delta"] < 5e-3  # the documented tolerance
+    assert "telemetry" in line
+    assert "bench_sketched_state_sync" in bench_suite.CONFIG_META

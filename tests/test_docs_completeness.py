@@ -196,3 +196,40 @@ def test_hierarchical_async_sync_documented_and_cross_linked():
     ):
         assert phrase in obs, phrase
     assert "performance.md#hierarchical--async-sync" in obs
+
+
+def test_sketched_states_documented_and_cross_linked():
+    """The bounded-memory sketched-state contract lives in two places: the
+    performance guide (the three sketch kinds, the tolerance table, when to
+    opt out, the overflow="error" policy) and the observability guide (the
+    sketch_merges counter, the sketch info blob, the Prometheus families),
+    cross-linked both ways."""
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "## Bounded-memory sketched states" in perf
+    for phrase in (
+        "sketched=True",
+        "label_score_histograms",
+        "spearman_from_grid",
+        "uniform_hash",
+        "score_range",
+        "value_range",
+        "sketch_capacity",
+        'overflow="error"',
+        "BufferOverflowError",
+        "tolerance",
+    ):
+        assert phrase in perf, phrase
+    assert "observability.md#sketched-state-telemetry" in perf
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "## Sketched-state telemetry" in obs
+    for phrase in (
+        "sketch_merges",
+        "metrics_tpu_sketch_bins",
+        "metrics_tpu_sketch_overflow_total",
+        "metrics_tpu_sketch_merges_total",
+        "sketched_auroc_sync_packed",
+    ):
+        assert phrase in obs, phrase
+    assert "performance.md#bounded-memory-sketched-states" in obs
