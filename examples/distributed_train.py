@@ -25,10 +25,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if os.environ.get("METRICS_TPU_FORCE_CPU_MESH"):
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # must be set before jax initializes its backends (older jax has no
+    # jax_num_cpu_devices config option — the flag works everywhere)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        jax.config.update("jax_num_cpu_devices", 8)
 else:
     import jax
 
@@ -45,6 +51,7 @@ except ModuleNotFoundError:  # pragma: no cover
     sys.exit(1)
 
 from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 NUM_CLASSES = 5
 FEATURES = 32
@@ -131,7 +138,7 @@ def main() -> None:
         return params, opt_state, values, losses[-1]
 
     sharded_train_epoch = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             train_epoch,
             mesh=mesh,
             in_specs=(P(), P(), P(None, "data"), P(None, "data")),
@@ -161,7 +168,7 @@ def main() -> None:
         return metrics.apply_compute(state, axis_name="data")
 
     sharded_eval = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             eval_pass,
             mesh=mesh,
             in_specs=(P(), P("data"), P("data")),

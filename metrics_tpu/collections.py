@@ -998,6 +998,8 @@ class MetricCollection:
                 self._metrics[n]._reductions != rep._reductions
                 or self._metrics[n].process_group != rep.process_group
                 or self._metrics[n].dist_sync_fn is not rep.dist_sync_fn
+                or self._metrics[n].__dict__.get("_transport")
+                is not rep.__dict__.get("_transport")
                 for n in names[1:]
             ):
                 continue
@@ -1017,6 +1019,7 @@ class MetricCollection:
             if (
                 m.dist_sync_fn is not None
                 or type(m)._sync_dist is not Metric._sync_dist
+                or m.__dict__.get("_transport") is not None  # pinned backends self-sync
                 or not m._defaults
                 or not m._to_sync
             ):
@@ -1266,6 +1269,7 @@ class MetricCollection:
         return (
             type(m).apply_compute is Metric.apply_compute
             and type(m).sync_state is Metric.sync_state
+            and m.__dict__.get("_transport") is None  # pinned backends self-sync
             and bool(m._reductions)
             and set(member_state) == set(m._reductions)
         )

@@ -276,11 +276,15 @@ class Metric(ABC):
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        transport: Optional[Any] = None,
     ) -> None:
         self.compute_on_step = compute_on_step
         self.dist_sync_on_step = dist_sync_on_step
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn
+        self._transport = None
+        if transport is not None:
+            self.set_transport(transport)
 
         self._to_sync = True
         self._restore_cache = True
@@ -304,6 +308,33 @@ class Metric(ABC):
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+
+    def set_transport(self, transport: Optional[Any]) -> "Metric":
+        """Pin THIS metric to a collective transport backend
+        (``metrics_tpu.transport``); ``None`` restores the ambient
+        resolution (context manager -> process global -> auto). A pinned
+        metric syncs itself through its own backend and opts out of
+        collection-level bundle packing (the bundle rides the ambient
+        transport). Returns ``self`` for chaining."""
+        if transport is not None:
+            from metrics_tpu.transport import Transport
+
+            if not isinstance(transport, Transport):
+                raise TypeError(
+                    f"expected a metrics_tpu.transport.Transport, got {transport!r}"
+                )
+        self.__dict__["_transport"] = transport
+        return self
+
+    @property
+    def transport(self) -> Optional[Any]:
+        """This metric's pinned transport backend (``None`` = ambient)."""
+        return self.__dict__.get("_transport")
+
+    def _resolve_transport(self) -> Any:
+        from metrics_tpu.transport import resolve_transport
+
+        return resolve_transport(self)
 
     @property
     def telemetry_key(self) -> str:
@@ -550,7 +581,9 @@ class Metric(ABC):
             return state
         with compiled_scope(f"{self.__class__.__name__}.sync"):
             try:
-                return sync_state_packed(state, self._reductions, axis_name, levels=levels)
+                return self._resolve_transport().sync_state_packed(
+                    state, self._reductions, axis_name, levels=levels
+                )
             except NameError as err:  # unbound collective axis
                 raise NameError(
                     f"{err}. This metric declares process_group={self.process_group!r}, which is"
@@ -1201,11 +1234,22 @@ class Metric(ABC):
                     states[name] = [jnp.zeros((0,), jnp.float32)]
         return states, list_dtypes
 
-    def _apply_gathered_states(self, gathered: StateDict, list_dtypes: Dict[str, Any]) -> None:
+    def _apply_gathered_states(
+        self,
+        gathered: StateDict,
+        list_dtypes: Dict[str, Any],
+        presynced: Optional[StateDict] = None,
+    ) -> None:
         """Reduce the per-member gather results into the live states
         (stack + reduction for tensor states, flatten + cat for list states,
-        empty-shard dropping, all-empty dtype restore)."""
+        empty-shard dropping, all-empty dtype restore). ``presynced`` holds
+        leaves the transport ALREADY reduced in place (the sharded backend's
+        elementwise states) — set directly, never stacked, so a
+        device-sharded giant leaf is not copied through the host protocol."""
         for name, fx in self._reductions.items():
+            if presynced is not None and name in presynced:
+                setattr(self, name, presynced[name])
+                continue
             value = gathered[name]
             if isinstance(value[0], ArrayTypes):
                 value = jnp.stack([jnp.asarray(v) for v in value])
@@ -1251,11 +1295,24 @@ class Metric(ABC):
         # every participating process, correlating this metric's gather on the
         # merged fleet timeline (observability/tracing.py)
         tr_span = TRACER.begin("sync", group=repr(group), bucket="metric") if TRACER.enabled else None
+        presynced: Optional[StateDict] = None
         if dist_sync_fn is gather_all_arrays:
-            # the default transport: pack EVERY leaf of this metric into one
-            # descriptor round + one payload round instead of two transport
-            # rounds per state (see gather_all_pytrees)
-            gathered = gather_all_pytrees([states], group=group)[0]
+            # the default path dispatches through the ACTIVE transport
+            # (metrics_tpu.transport): device-resident backends reduce the
+            # elementwise leaves in place (sharding-preserving — a giant
+            # sharded state never materializes on one host), and whatever
+            # remains packs into one descriptor round + one payload round
+            # (see gather_all_pytrees)
+            transport = self._resolve_transport()
+            presynced = transport.reduce_states(states, self._reductions, group=group)
+            if presynced:
+                rest = {k: v for k, v in states.items() if k not in presynced}
+                gathered = (
+                    transport.gather_pytrees([rest], group=group)[0] if rest else {}
+                )
+            else:
+                presynced = None
+                gathered = transport.gather_pytrees([states], group=group)[0]
         else:
             # injected custom gathers keep the documented per-leaf contract
             gathered = apply_to_collection(states, ArrayTypes, dist_sync_fn, group=group)
@@ -1270,7 +1327,7 @@ class Metric(ABC):
                 span_id=span_id,
             )
 
-        self._apply_gathered_states(gathered, list_dtypes)
+        self._apply_gathered_states(gathered, list_dtypes, presynced=presynced)
 
     def sync(
         self,
@@ -1546,7 +1603,7 @@ class Metric(ABC):
             if k not in ("update", "compute", "_update_signature", "_jit_forward_fn",
                          "_jit_forward_copy_fn", "_update_many_fn", "_update_many_copy_fn",
                          "_telemetry_key", "_jit_cache_seen", "_donation_warned",
-                         "_compute_group", "_group_bound")
+                         "_compute_group", "_group_bound", "_transport")
         }
         if self.__dict__.get("_compute_group") is not None:
             # a grouped member's dict may hold no state attributes at all
@@ -1571,6 +1628,9 @@ class Metric(ABC):
         # alone with materialized states, and 0.6.0-and-earlier pickles
         # predate the attribute entirely
         self.__dict__.setdefault("_compute_group", None)
+        # transport pins never serialize (a backend may hold a device mesh);
+        # the unpickled copy resolves the ambient transport until re-pinned
+        self.__dict__.setdefault("_transport", None)
         self._donation_warned = False
         self._drop_compiled_dispatch()
         self._update_signature = inspect.signature(self.update)

@@ -61,6 +61,7 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     # sync transport stats
     ("sync.groups.*.world", "max"),
     ("sync.groups.*.*", "sum"),
+    ("sync.participants.*", "last"),
     ("sync.*", "sum"),
     # event-log summary
     ("events.enabled", "any"),

@@ -60,7 +60,8 @@ _HELP: Dict[str, str] = {
     "straggler_wait_seconds_total": "Time a process spent waiting for its slowest peer.",
     "straggler_transfer_seconds_total": "Post-barrier transfer time attributed to a process.",
     "straggler_flagged": "1 when the latest report flags the process as persistently slow.",
-    "sync_transport_gathers_total": "Eager gather transports per level label (gather=inline, dcn=async engine).",
+    "sync_transport_gathers_total": "Eager gather transports per backend label (gather=inline, dcn=async engine, loopback/sharded=strategy backends).",
+    "sync_subgroup_rounds_total": "Transport rounds whose exchanges spanned a proper subgroup of the processes (true subgroup formation).",
     "sync_in_graph_level_syncs_total": "Hierarchical in-graph sync lowerings per level label (ici/dcn).",
     "async_sync_submitted_total": "Background syncs submitted to the async engine.",
     "async_sync_completed_total": "Background syncs resolved (fresh or stale).",
@@ -258,6 +259,7 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
         "payload_rounds",
         "descriptor_seconds",
         "payload_seconds",
+        "subgroup_rounds",
     ):
         if field in sync:
             out.emit(f"sync_{field}_total", base, sync[field], "counter")

@@ -73,9 +73,17 @@ def _fresh_sync_stats() -> Dict[str, Any]:
         "descriptor_seconds": 0.0,
         "payload_seconds": 0.0,
         # gathers per transport label ("gather" inline; "dcn" for the async
-        # engine's cross-host legs — utilities/distributed.py
-        # transport_overrides), so the sync volume splits by level
+        # engine's cross-host legs; "loopback"/"sharded"/... for strategy
+        # backends — utilities/distributed.py transport_overrides and
+        # metrics_tpu/transport), so the sync volume splits by backend
         "transports": {},
+        # rounds whose exchanges spanned a PROPER SUBSET of the processes
+        # (true subgroup formation — metrics_tpu/transport/gather.py); the
+        # quorum/degraded policies' touch-only-healthy-peers evidence
+        "subgroup_rounds": 0,
+        # last participant set per transport label (gauge-like; what the
+        # round physically touched)
+        "participants": {},
         "groups": {},
         # in-graph (trace-time) collective composition — sync_in_graph /
         # sync_state_packed. "collectives" counts STATES per collective kind;
@@ -197,15 +205,18 @@ class TelemetryRegistry:
         descriptor_s: float = 0.0,
         payload_s: float = 0.0,
         transport: str = "gather",
+        participants: Optional[List[int]] = None,
     ) -> None:
         """One completed ``gather_all_arrays``/``gather_all_pytrees``
         transport (host sync path). ``leaves`` is how many state arrays the
         packed descriptor/payload rounds carried — the bundling win is
         ``gather_leaves / gathers`` leaves per transport.
         ``descriptor_s``/``payload_s`` split the transport's wall time into
-        its two collective rounds; ``transport`` is the level label
+        its two collective rounds; ``transport`` is the backend/level label
         (``"gather"`` inline, ``"dcn"`` for the async engine's cross-host
-        legs)."""
+        legs, ``"loopback"``/``"sharded"`` for strategy backends);
+        ``participants`` is the peer set the round physically touched — a
+        proper subset of the world counts as a subgroup round."""
         if not self._enabled:
             return
         group_label = ",".join(str(m) for m in members)
@@ -213,6 +224,10 @@ class TelemetryRegistry:
             s = self._sync
             s["gathers"] += 1
             s["transports"][transport] = s["transports"].get(transport, 0) + 1
+            if participants is not None:
+                s["participants"][transport] = [int(p) for p in participants]
+                if world > 1 and len(participants) < world:
+                    s["subgroup_rounds"] += 1
             if error:
                 s["gather_errors"] += 1
             s["gather_leaves"] += int(leaves)

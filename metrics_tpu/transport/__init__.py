@@ -1,0 +1,62 @@
+"""Pluggable collective transport (L0 strategy layer).
+
+The library's two hardwired sync paths — the in-graph ``jax.lax`` packed
+collectives and the eager descriptor+payload byte gather — become
+first-class, swappable **strategy objects** behind one interface:
+
+* :class:`~metrics_tpu.transport.base.Transport` — the strategy interface:
+  an in-graph packed lowering (:meth:`~Transport.sync_state_packed`), an
+  eager bundle gather (:meth:`~Transport.gather_pytrees`), an eager
+  in-place reduction hook for device-resident states
+  (:meth:`~Transport.reduce_states`), and subgroup formation
+  (:meth:`~Transport.subgroup`).
+* :class:`InGraphTransport` — the ``jax.lax`` packed-bucket collectives
+  (hierarchical levels included); the TPU-native default for traced
+  programs.
+* :class:`GatherTransport` — the eager descriptor+payload byte rounds,
+  extended with **true subgroup formation**: a transport bound to a
+  participant subset runs its rounds over those processes only (via the
+  registered subgroup channel), so quorum/degraded syncs never touch a dead
+  peer.
+* :class:`LoopbackTransport` — the zero-copy single-process identity
+  backend; the default eager transport when ``jax.process_count() == 1``.
+* :class:`ShardedTransport` — a ``shard_map``/pjit path for states too
+  large for one device: state leaves live sharded across mesh devices, and
+  sync lowers to in-place sharded reductions plus a final subgroup combine
+  for the non-elementwise leaves.
+
+The **active transport** is settable globally (:func:`set_transport`),
+per-metric (``Metric(transport=...)`` / :meth:`Metric.set_transport`), and
+via context manager (:func:`use_transport`); resolution is
+per-metric -> context -> global -> auto default. ``Metric.sync_state``,
+``sync_state_packed``, ``Metric._sync_dist``, ``gather_all_pytrees``, the
+background async engine and ``aggregate_snapshots`` all dispatch through it.
+Dispatch happens host-side at trace/call time: with the default
+:class:`InGraphTransport`/:class:`GatherTransport` pair active, every
+compiled hot-path jaxpr is byte-identical to the direct-call engine
+(pinned by ``scripts/check_zero_overhead.py``).
+"""
+from metrics_tpu.transport.base import (  # noqa: F401
+    AutoTransport,
+    Transport,
+    active_transport_name,
+    get_transport,
+    resolve_transport,
+    set_transport,
+    use_transport,
+)
+from metrics_tpu.transport.in_graph import InGraphTransport  # noqa: F401
+from metrics_tpu.transport.gather import (  # noqa: F401
+    GatherTransport,
+    kvstore_subgroup_allgather,
+    set_subgroup_allgather,
+    subgroup_allgather,
+)
+from metrics_tpu.transport.loopback import LoopbackTransport  # noqa: F401
+from metrics_tpu.transport.sharded import ShardedTransport  # noqa: F401
+
+from metrics_tpu.transport.base import _register_singletons as __register
+
+__register(GatherTransport(), LoopbackTransport())
+del __register
+

@@ -1,0 +1,267 @@
+"""Transport strategy interface + active-transport resolution.
+
+A :class:`Transport` packages the three ways metric state crosses device or
+process boundaries:
+
+* **in-graph** (:meth:`Transport.sync_state_packed`) — called inside a
+  traced program (``shard_map``/``pmap``/``pjit``); must lower to XLA
+  collectives (or to nothing, for the loopback backend);
+* **eager gather** (:meth:`Transport.gather_pytrees` /
+  :meth:`Transport.gather_array`) — the epoch-boundary path; returns each
+  group member's contribution so the caller applies the declared
+  reductions host-side;
+* **eager in-place reduction** (:meth:`Transport.reduce_states`) — an
+  optional fast path for device-resident (possibly sharded) states: the
+  transport reduces elementwise states across processes *without* handing
+  full per-member copies to the host. ``None`` (the default) means "use the
+  gather protocol".
+
+Resolution order for the **active** transport: per-metric override ->
+innermost :func:`use_transport` context (thread-local) -> process-global
+:func:`set_transport` -> the :class:`AutoTransport` default (in-graph
+packed collectives for traced code; loopback when
+``jax.process_count() == 1``, the byte gather otherwise).
+
+Everything here is host-side bookkeeping: resolving a transport never adds
+a traced op, and with the default backends active the lowered programs are
+byte-identical to the pre-seam engine (``scripts/check_zero_overhead.py``
+pins this).
+"""
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class Transport:
+    """Strategy object for metric-state collectives (the L0 seam).
+
+    Subclasses override the paths they implement natively; the base class
+    routes everything to the default engines so a backend only has to
+    express what it changes. Transports are cheap, immutable-ish value
+    objects — :meth:`subgroup` returns a NEW transport bound to a
+    participant subset rather than mutating the receiver.
+    """
+
+    #: telemetry label (histogram ``transport=`` label values, sync events,
+    #: per-backend round counters)
+    name: str = "base"
+
+    # -- in-graph (traced) path -------------------------------------------
+
+    def sync_state_packed(
+        self,
+        state: Dict[str, Any],
+        reductions: Dict[str, Any],
+        axis_name: Any,
+        *,
+        levels: Optional[Sequence] = None,
+        group_composition: Optional[Dict[str, int]] = None,
+    ) -> Dict[str, Any]:
+        """Packed in-graph sync of a state dict over ``axis_name`` — one
+        collective per (kind, dtype) bucket. Default: the ``jax.lax``
+        packed-bucket engine (hierarchical levels included)."""
+        from metrics_tpu.utilities.distributed import _sync_state_packed_impl
+
+        return _sync_state_packed_impl(
+            state, reductions, axis_name, levels=levels, group_composition=group_composition
+        )
+
+    # -- eager (epoch-boundary) path --------------------------------------
+
+    def gather_pytrees(self, trees: List[Any], group: Optional[Any] = None) -> List[Any]:
+        """Gather every array leaf of ``trees`` across the transport's
+        participants; each leaf becomes the list of group members' arrays in
+        ascending process order. Default: the packed descriptor+payload byte
+        rounds (loopback identity when not distributed)."""
+        from metrics_tpu.utilities.distributed import _gather_pytrees_impl
+
+        return _gather_pytrees_impl(
+            trees, group, participants=self.participants, label=self.name
+        )
+
+    def gather_array(self, result: Any, group: Optional[Any] = None) -> List[Any]:
+        """Per-array form of :meth:`gather_pytrees` (the
+        ``gather_all_arrays`` contract)."""
+        return self.gather_pytrees([result], group=group)[0]
+
+    def reduce_states(
+        self,
+        states: Dict[str, Any],
+        reductions: Dict[str, Any],
+        group: Optional[Any] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Eagerly reduce the elementwise-reducible subset of ``states``
+        across processes IN PLACE (device-resident, sharding-preserving) and
+        return ``{name: synced_leaf}`` for the leaves handled — or ``None``
+        to route everything through the gather protocol (the default).
+
+        Backends for device-sharded giant states override this so a
+        100k-class confusion matrix syncs without one host ever holding the
+        full array; the caller gathers only the leaves this method did not
+        handle."""
+        return None
+
+    # -- capability / topology --------------------------------------------
+
+    @property
+    def participants(self) -> Optional[List[int]]:
+        """The process indices this transport's rounds span (``None`` = all
+        processes)."""
+        return None
+
+    def subgroup(self, members: Sequence[int]) -> "Transport":
+        """A transport whose rounds span only ``members`` — the degraded
+        -link quorum hook. Backends without true subgroup formation return
+        ``self`` (callers then narrow decode membership via
+        ``transport_overrides(quorum=...)``, the legacy behavior)."""
+        return self
+
+    def distributed(self) -> bool:
+        """Whether this transport spans more than one participant."""
+        from metrics_tpu.utilities.distributed import distributed_available
+
+        return distributed_available()
+
+    def __repr__(self) -> str:
+        extra = ""
+        if self.participants is not None:
+            extra = f", participants={self.participants}"
+        return f"{type(self).__name__}(name={self.name!r}{extra})"
+
+
+class AutoTransport(Transport):
+    """The default pair: in-graph packed collectives for traced code, and —
+    eagerly — :class:`~metrics_tpu.transport.loopback.LoopbackTransport`
+    when ``jax.process_count() == 1``, the descriptor+payload byte gather
+    otherwise. Byte-identical to the pre-seam direct engine calls."""
+
+    name = "auto"
+
+    def gather_pytrees(self, trees: List[Any], group: Optional[Any] = None) -> List[Any]:
+        return self._eager().gather_pytrees(trees, group=group)
+
+    def gather_array(self, result: Any, group: Optional[Any] = None) -> List[Any]:
+        return self._eager().gather_array(result, group=group)
+
+    def subgroup(self, members: Sequence[int]) -> Transport:
+        return self._eager().subgroup(members)
+
+    def _eager(self) -> Transport:
+        # hot path (every dispatched eager gather): the module reference is
+        # resolved once and cached — a per-call import would dominate the
+        # loopback backend's zero-copy cost. The attribute lookup stays
+        # per-call so test harnesses (and a late-initialized
+        # jax.distributed) that swap ``distributed_available`` are honored.
+        global _DIST_MODULE
+        if _DIST_MODULE is None:
+            from metrics_tpu.utilities import distributed
+
+            _DIST_MODULE = distributed
+        if _DIST_MODULE.distributed_available():
+            if _GATHER_SINGLETON is not None:
+                return _GATHER_SINGLETON
+            from metrics_tpu.transport.gather import GatherTransport
+
+            return GatherTransport()
+        if _LOOPBACK_SINGLETON is not None:
+            return _LOOPBACK_SINGLETON
+        from metrics_tpu.transport.loopback import LoopbackTransport
+
+        return LoopbackTransport()
+
+
+#: lazily-filled default instances (avoid an import cycle at module load)
+_GATHER_SINGLETON: Optional[Transport] = None
+_LOOPBACK_SINGLETON: Optional[Transport] = None
+#: cached reference to the distributed engine module (resolved on first
+#: dispatch; the availability ATTRIBUTE is looked up per call)
+_DIST_MODULE = None
+
+#: the auto default — what ``get_transport()`` returns when nothing is set
+_AUTO = AutoTransport()
+
+#: process-global active transport (``None`` = auto)
+_GLOBAL: Optional[Transport] = None
+_GLOBAL_LOCK = threading.Lock()
+
+#: thread-local context-manager stack (innermost wins)
+_CONTEXT = threading.local()
+
+
+def _register_singletons(gather: Transport, loopback: Transport) -> None:
+    """Called by the backend modules at import so :class:`AutoTransport`
+    reuses one instance per default backend (stable telemetry identity)."""
+    global _GATHER_SINGLETON, _LOOPBACK_SINGLETON
+    if _GATHER_SINGLETON is None:
+        _GATHER_SINGLETON = gather
+    if _LOOPBACK_SINGLETON is None:
+        _LOOPBACK_SINGLETON = loopback
+
+
+def _check(transport: Any) -> Transport:
+    if not isinstance(transport, Transport):
+        raise TypeError(
+            f"expected a metrics_tpu.transport.Transport instance, got {transport!r}"
+        )
+    return transport
+
+
+def set_transport(transport: Optional[Transport]) -> Optional[Transport]:
+    """Install ``transport`` as the process-global active transport
+    (``None`` restores the auto default). Returns the previous global so a
+    caller can restore it. **Collective discipline**: like any sync
+    configuration, install the same transport on every participating
+    process."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        previous = _GLOBAL
+        _GLOBAL = _check(transport) if transport is not None else None
+    return previous
+
+
+def get_transport() -> Transport:
+    """The active transport for this thread: innermost
+    :func:`use_transport` context, else the process global, else the auto
+    default."""
+    stack = getattr(_CONTEXT, "stack", None)
+    if stack:
+        return stack[-1]
+    return _GLOBAL if _GLOBAL is not None else _AUTO
+
+
+def resolve_transport(metric: Any = None) -> Transport:
+    """Resolution used by every dispatch site: the metric's own override
+    (when one is set) wins over the ambient :func:`get_transport`."""
+    if metric is not None:
+        override = getattr(metric, "_transport", None)
+        if override is not None:
+            return override
+    return get_transport()
+
+
+def active_transport_name() -> str:
+    """Telemetry helper: the active transport's label."""
+    return get_transport().name
+
+
+@contextmanager
+def use_transport(transport: Transport):
+    """Scope ``transport`` as the active transport for this thread.
+
+    Reentrant and exception-safe: contexts nest (innermost wins) and every
+    exit — normal or raising — restores the previous state, so a transport
+    round failing mid-sync can never leave a stale backend installed."""
+    _check(transport)
+    stack = getattr(_CONTEXT, "stack", None)
+    if stack is None:
+        stack = _CONTEXT.stack = []
+    stack.append(transport)
+    try:
+        yield transport
+    finally:
+        # pop OUR entry specifically: a mis-nested exit (generator closed
+        # out of order) must not strip someone else's context
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is transport:
+                del stack[i]
+                break

@@ -1,0 +1,158 @@
+"""GatherTransport: eager descriptor+payload byte rounds with TRUE subgroups.
+
+The eager gather engine (``utilities/distributed.py::_gather_all_leaves``)
+historically had exactly one transport primitive — the global
+``process_allgather`` — so every round spanned ALL processes even when the
+caller only wanted a subset: PR-9's quorum policy could *narrow the decode*
+(drop sick peers' contributions) but still paid a full all-process round per
+attempt, and a genuinely dead peer hung the round until its timeout.
+
+This backend adds **real subgroup formation**: a transport bound to a
+participant subset (:meth:`GatherTransport.subgroup`) runs its descriptor
+and payload rounds over those processes only, through a registered
+*subgroup channel* — a primitive that exchanges equal-length byte buffers
+among an explicit peer set without involving anyone else:
+
+* :func:`set_subgroup_allgather` installs a channel (the test harness
+  installs a barrier-based in-process one; deployments with a JAX
+  coordination service get :func:`kvstore_subgroup_allgather` — the
+  distributed KV store is point-readable, so healthy members exchange
+  payloads without the dead peer ever being contacted);
+* with no channel registered, a subgrouped round falls back to the legacy
+  behavior — one global round, subgroup members decoded — and the round
+  telemetry records the participant set that was actually touched, so the
+  degradation is observable rather than silent.
+
+Round telemetry (``sync`` events, ``snapshot()["sync"]``) now carries
+``participants`` — the peer set the transport round physically touched —
+which is what the acceptance tests assert for quorum syncs.
+"""
+import base64
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from metrics_tpu.transport.base import Transport
+
+#: the registered subgroup channel: ``fn(buf: np.ndarray, participants) ->
+#: (len(participants), ...) stacked array``, executed by every participant
+#: with identical arguments; non-participants never call it.
+_SUBGROUP_ALLGATHER: Optional[Callable[[np.ndarray, List[int]], np.ndarray]] = None
+_CHANNEL_LOCK = threading.Lock()
+
+
+def set_subgroup_allgather(
+    fn: Optional[Callable[[np.ndarray, List[int]], np.ndarray]],
+) -> Optional[Callable]:
+    """Register (or clear, with ``None``) the subgroup exchange channel.
+    Returns the previously registered channel."""
+    global _SUBGROUP_ALLGATHER
+    with _CHANNEL_LOCK:
+        previous = _SUBGROUP_ALLGATHER
+        _SUBGROUP_ALLGATHER = fn
+    return previous
+
+
+def subgroup_allgather() -> Optional[Callable]:
+    """The registered subgroup channel, or ``None``."""
+    return _SUBGROUP_ALLGATHER
+
+
+#: per-participant-set monotonic round counters for the KV-store channel —
+#: the same determinism rule as collective span ids: every participant
+#: issues subgroup rounds in the same order, so the N-th round over one
+#: peer set names the same exchange on every member.
+_KV_ROUNDS: Dict[Any, int] = {}
+_KV_LOCK = threading.Lock()
+
+
+def kvstore_subgroup_allgather(
+    buf: np.ndarray, participants: List[int], *, timeout_ms: int = 60_000
+) -> np.ndarray:
+    """Subgroup byte exchange over the JAX coordination-service KV store.
+
+    Each participant publishes its buffer under a deterministic
+    ``(round, rank)`` key and point-reads only its co-participants' keys —
+    a dead non-participant is never contacted, which is exactly the
+    property the global ``process_allgather`` cannot offer. Requires an
+    initialized ``jax.distributed`` runtime; raises ``RuntimeError``
+    otherwise (callers treat that as "no channel").
+    """
+    from jax._src import distributed as _jax_distributed
+
+    client = getattr(_jax_distributed.global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "kvstore_subgroup_allgather needs an initialized jax.distributed runtime"
+        )
+    import jax
+
+    rank = jax.process_index()
+    key_set = tuple(sorted(int(p) for p in participants))
+    with _KV_LOCK:
+        seq = _KV_ROUNDS.get(key_set, 0)
+        _KV_ROUNDS[key_set] = seq + 1
+    prefix = f"mtpu_subgroup/{'-'.join(map(str, key_set))}/{seq}"
+    flat = np.ascontiguousarray(np.asarray(buf, dtype=np.uint8)).reshape(-1)
+    client.key_value_set(f"{prefix}/{rank}", base64.b64encode(flat.tobytes()).decode())
+    rows = []
+    for peer in key_set:
+        raw = client.blocking_key_value_get(f"{prefix}/{peer}", timeout_ms)
+        rows.append(np.frombuffer(base64.b64decode(raw), dtype=np.uint8))
+    width = max((r.size for r in rows), default=0)
+    stacked = np.zeros((len(rows), width), dtype=np.uint8)
+    for i, r in enumerate(rows):
+        stacked[i, : r.size] = r
+    try:  # best-effort cleanup; absent on older runtimes
+        client.key_value_delete(f"{prefix}/{rank}")
+    except Exception:  # pragma: no cover - cleanup is optional
+        pass
+    return stacked
+
+
+class GatherTransport(Transport):
+    """The eager byte-transport backend (descriptor+payload packed rounds).
+
+    ``participants=None`` spans all processes — byte-for-byte the engine
+    the default path always ran. A participant-bound instance (from
+    :meth:`subgroup`) runs true subgroup rounds when a subgroup channel is
+    registered and falls back to global-round + narrowed decode otherwise.
+    ``label`` overrides the telemetry ``transport=`` label (the async
+    engine labels its legs ``"dcn"``).
+    """
+
+    name = "gather"
+
+    def __init__(
+        self,
+        *,
+        participants: Optional[Sequence[int]] = None,
+        label: Optional[str] = None,
+    ) -> None:
+        self._participants = (
+            sorted({int(p) for p in participants}) if participants is not None else None
+        )
+        if self._participants is not None and not self._participants:
+            raise ValueError("participants must name at least one process index")
+        if label is not None:
+            self.name = str(label)
+
+    @property
+    def participants(self) -> Optional[List[int]]:
+        return list(self._participants) if self._participants is not None else None
+
+    def subgroup(self, members: Sequence[int]) -> Transport:
+        members = sorted({int(m) for m in members})
+        if self._participants is not None:
+            members = [m for m in members if m in self._participants]
+        if members == (self._participants or members) and self._participants is not None:
+            return self
+        return GatherTransport(
+            participants=members or self._participants,
+            label=self.name if self.name != "gather" else None,
+        )
+
+    # gather_pytrees / gather_array: inherited — the base class routes to
+    # ``_gather_pytrees_impl`` with this transport's participants + label,
+    # which is the native engine for this backend.

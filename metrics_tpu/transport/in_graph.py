@@ -1,0 +1,54 @@
+"""InGraphTransport: the ``jax.lax`` packed-bucket collective backend."""
+from typing import Any, Dict, List, Optional, Sequence
+
+from metrics_tpu.transport.base import Transport
+
+
+class InGraphTransport(Transport):
+    """The TPU-native in-graph backend: packed (bucketed) ``jax.lax``
+    collectives, one per (kind, dtype) bucket — hierarchical
+    (``Hierarchy``/two-level) lowering included.
+
+    This IS the engine every traced sync already lowers through; installing
+    it explicitly changes nothing about the compiled programs (pinned
+    byte-identical by ``scripts/check_zero_overhead.py``) — it exists so the
+    in-graph path is nameable, testable and composable like every other
+    backend. Epoch-boundary eager gathers delegate to ``eager`` (default:
+    the auto loopback/byte-gather pair), since an in-graph collective cannot
+    run outside a traced program.
+    """
+
+    name = "in_graph"
+
+    def __init__(self, eager: Optional[Transport] = None) -> None:
+        if eager is not None and not isinstance(eager, Transport):
+            raise TypeError(f"eager must be a Transport, got {eager!r}")
+        self._eager_override = eager
+
+    # sync_state_packed: inherited — the base class already routes to the
+    # packed jax.lax engine, which is this backend's native path.
+
+    def gather_pytrees(self, trees: List[Any], group: Optional[Any] = None) -> List[Any]:
+        return self._eager().gather_pytrees(trees, group=group)
+
+    def gather_array(self, result: Any, group: Optional[Any] = None) -> List[Any]:
+        return self._eager().gather_array(result, group=group)
+
+    def reduce_states(
+        self,
+        states: Dict[str, Any],
+        reductions: Dict[str, Any],
+        group: Optional[Any] = None,
+    ) -> Optional[Dict[str, Any]]:
+        return self._eager().reduce_states(states, reductions, group=group)
+
+    def subgroup(self, members: Sequence[int]) -> Transport:
+        sub = self._eager().subgroup(members)
+        return InGraphTransport(eager=sub) if sub is not self._eager() else self
+
+    def _eager(self) -> Transport:
+        if self._eager_override is not None:
+            return self._eager_override
+        from metrics_tpu.transport.base import _AUTO
+
+        return _AUTO._eager()

@@ -1,0 +1,170 @@
+"""LoopbackTransport: the zero-copy single-process identity backend.
+
+Single-process runs (every ``jax.process_count() == 1`` deployment, and —
+in this repo's CI — the whole CPU test environment, where jax 0.4.37 has no
+multiprocess collectives) previously exercised the multiprocess code paths
+only as an incidental degenerate case. The loopback backend makes the
+single-participant world a first-class, testable transport:
+
+* the eager gather is the exact world-1 protocol result — every leaf
+  becomes a one-member list holding the local array, **zero-copy** (the
+  same ``jax.Array`` object rides through; no descriptor/payload rounds,
+  no padding, no byte marshalling);
+* the in-graph lowering issues **zero collectives** and returns what the
+  packed engine produces over a size-1 axis: elementwise reductions are the
+  identity, ``cat`` states pre-concatenate, gather-only states gain the
+  ``(1, ...)`` participant axis, callable reductions see the stacked
+  world-1 gather.
+
+It is the default eager backend whenever ``jax.process_count() == 1``
+(via :class:`~metrics_tpu.transport.base.AutoTransport`), which turns the
+multiprocess-assuming test surface into runnable single-process signal.
+"""
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.transport.base import Transport
+
+
+class LoopbackTransport(Transport):
+    """Identity transport for a world of one participant."""
+
+    name = "loopback"
+
+    # -- eager path --------------------------------------------------------
+
+    def gather_pytrees(self, trees: List[Any], group: Optional[Any] = None) -> List[Any]:
+        from metrics_tpu.utilities import distributed as _dist
+
+        # validate the group argument eagerly: with no peers to desync there
+        # is nothing to defer for
+        if group is not None:
+            _dist._resolve_group(group, max(_dist.world_size(), 1))
+        record = TELEMETRY.enabled or EVENTS.enabled
+        t_start = time.perf_counter() if record else 0.0
+        flat = [jax.tree_util.tree_flatten(t) for t in trees]
+        out = []
+        leaves_total = 0
+        for leaves, treedef in flat:
+            leaves_total += len(leaves)
+            out.append(
+                jax.tree_util.tree_unflatten(
+                    treedef, [[jnp.asarray(leaf)] for leaf in leaves]
+                )
+            )
+        if record:
+            _dist._record_gather_telemetry(
+                bytes_out=0,
+                bytes_in=0,
+                members=[0],
+                nprocs=1,
+                leaves=leaves_total,
+                desc_bytes=0,
+                max_bytes=0,
+                error=False,
+                dur_s=time.perf_counter() - t_start,
+                t_start=t_start,
+                span_id=None,
+                transport=self.name,
+                participants=[0],
+            )
+        return out
+
+    def gather_array(self, result: Any, group: Optional[Any] = None) -> List[Any]:
+        return self.gather_pytrees([result], group=group)[0]
+
+    def reduce_states(
+        self,
+        states: Dict[str, Any],
+        reductions: Dict[str, Any],
+        group: Optional[Any] = None,
+    ) -> Optional[Dict[str, Any]]:
+        # every non-list elementwise-reduced leaf is already its own synced
+        # value in a world of one: hand the SAME buffers back (zero-copy) and
+        # let the caller gather the rest (list/cat/None/callable leaves,
+        # which have protocol shape changes even at world 1)
+        handled = {
+            name: value
+            for name, value in states.items()
+            if not isinstance(value, (list, tuple))
+            and reductions.get(name) in ("sum", "mean", "max", "min")
+        }
+        return handled or None
+
+    # -- in-graph path -----------------------------------------------------
+
+    def sync_state_packed(
+        self,
+        state: Dict[str, Any],
+        reductions: Dict[str, Any],
+        axis_name: Any,
+        *,
+        levels: Optional[Sequence] = None,
+        group_composition: Optional[Dict[str, int]] = None,
+    ) -> Dict[str, Any]:
+        """The packed engine's world-1 semantics with ZERO collectives.
+
+        Valid only when the named axis has a single participant (the
+        loopback contract); results are then bit-identical to
+        ``sync_state_packed`` over that axis — pinned by the transport
+        -equivalence suite.
+        """
+        from metrics_tpu.utilities.data import dim_zero_cat
+        from metrics_tpu.utilities.distributed import _record_in_graph_telemetry
+
+        synced: Dict[str, Any] = {}
+        kinds: Dict[str, int] = {}
+        n_states = 0
+        for name, value in state.items():
+            fx = reductions.get(name)
+            wrap_list = False
+            if isinstance(value, (list, tuple)):
+                if len(value) == 0:
+                    synced[name] = value
+                    continue
+                value = dim_zero_cat(list(value))
+                fx = "cat" if fx in ("cat", None) else fx
+                wrap_list = fx == "cat"
+            n_states += 1
+            if callable(fx):
+                synced[name] = fx(value[None])
+                kinds["loopback"] = kinds.get("loopback", 0) + 1
+            elif fx in ("sum", "mean", "max", "min"):
+                synced[name] = [value] if wrap_list else value
+                kinds["loopback"] = kinds.get("loopback", 0) + 1
+            elif fx == "cat":
+                value = jnp.atleast_1d(value)
+                synced[name] = [value] if wrap_list else value
+                kinds["loopback"] = kinds.get("loopback", 0) + 1
+            elif fx is None:
+                synced[name] = value[None]
+                kinds["loopback"] = kinds.get("loopback", 0) + 1
+            else:
+                raise ValueError(f"Unknown dist_reduce_fx: {fx!r}")
+        if kinds:
+            _record_in_graph_telemetry(
+                axis_name,
+                kinds,
+                0,
+                collectives_before=n_states,
+                collectives_after=0,
+                groups=group_composition,
+            )
+        return synced
+
+    # -- topology ----------------------------------------------------------
+
+    @property
+    def participants(self) -> Optional[List[int]]:
+        return [0]
+
+    def subgroup(self, members: Sequence[int]) -> Transport:
+        return self
+
+    def distributed(self) -> bool:
+        return False

@@ -1,9 +1,12 @@
 from metrics_tpu.utilities.data import apply_to_collection  # noqa: F401
 from metrics_tpu.utilities.distributed import (  # noqa: F401
     Hierarchy,
+    applied_transport_overrides,
     class_reduce,
+    current_transport_overrides,
     hierarchical_axis,
     reduce,
+    shard_map_compat,
     transport_overrides,
 )
 from metrics_tpu.utilities.prints import (  # noqa: F401

@@ -27,11 +27,16 @@ link. This module moves it onto a worker thread:
     immediately, flagged ``future.stale=True`` and counted
     (``stale_serves``): a dashboard metric a few seconds old beats a step
     loop stalled on a sick link;
-  - ``"quorum"`` — reduce over the healthy subgroup through the existing
-    group plumbing
+  - ``"quorum"`` — reduce over the healthy subgroup. The engine forms a
+    TRUE transport subgroup when the active backend supports it
+    (``metrics_tpu.transport`` — ``resolve_transport().subgroup(healthy)``
+    plus a registered subgroup channel): the gather rounds then span only
+    the healthy peers, and a dead process is never contacted. Without a
+    subgroup channel the legacy narrowing applies
     (:func:`~metrics_tpu.utilities.distributed.transport_overrides`
-    ``quorum=``): the flagged peers' contributions are excluded exactly as
-    an explicit ``group=`` argument would exclude them.
+    ``quorum=``): the round still spans all processes, but the flagged
+    peers' contributions are excluded exactly as an explicit ``group=``
+    argument would exclude them.
 
 * **Generation counter.** Every submission under one telemetry key gets a
   monotonically increasing generation; the engine retains the latest
@@ -270,14 +275,26 @@ class AsyncSyncEngine:
         expiry — a hung collective cannot be cancelled, only orphaned; the
         orphan operates on the job's detached shadow state, so a late
         completion mutates nothing the caller can observe and its result is
-        discarded."""
+        discarded. The helper INHERITS the worker thread's transport context
+        and eager overrides (both are thread-local) — without the snapshot a
+        quorum/label set on the worker would silently not apply to the
+        gather it governs."""
         if timeout is None:
             return thunk()
         box: Dict[str, Any] = {}
+        from metrics_tpu.transport import get_transport, use_transport
+        from metrics_tpu.utilities.distributed import (
+            applied_transport_overrides,
+            current_transport_overrides,
+        )
+
+        overrides = current_transport_overrides()
+        transport = get_transport()
 
         def run() -> None:
             try:
-                box["value"] = thunk()
+                with use_transport(transport), applied_transport_overrides(overrides):
+                    box["value"] = thunk()
             except BaseException as err:  # noqa: BLE001 - relayed to the policy
                 box["error"] = err
 
@@ -325,12 +342,22 @@ class AsyncSyncEngine:
                     quorum = self._healthy_subgroup(degraded)
             try:
                 future.attempts = attempt + 1
+                from metrics_tpu.transport import resolve_transport, use_transport
                 from metrics_tpu.utilities.distributed import transport_overrides
 
                 if quorum is not None:
                     with self._lock:
                         self._counters["quorum_syncs"] += 1
-                    with transport_overrides(quorum=quorum, transport_label="dcn"):
+                    # TRUE subgroup formation when the active transport (and
+                    # its channel) supports it: the gather rounds span only
+                    # the healthy peers — a dead peer is never contacted.
+                    # The decode-narrowing override stays installed either
+                    # way (it is the fallback when no subgroup channel is
+                    # registered, and it is harmless when one is).
+                    subgroup = resolve_transport().subgroup(quorum)
+                    with use_transport(subgroup), transport_overrides(
+                        quorum=quorum, transport_label="dcn"
+                    ):
                         value = self._attempt(job.thunk, job.round_timeout_s)
                 else:
                     with transport_overrides(transport_label="dcn"):

@@ -44,6 +44,15 @@ One collective per **(level, kind, dtype)** bucket, results identical to the
 flat sync (bit-identical for integer/extremal reductions and gathers, which
 is what metric states overwhelmingly are; rounding float sums agree up to
 reassociation of the level partials, ≤1 ulp).
+
+Since 0.13.0 both engines sit behind the **pluggable transport seam**
+(``metrics_tpu.transport``): the public :func:`sync_state_packed`,
+:func:`gather_all_arrays` and :func:`gather_all_pytrees` dispatch through
+the ACTIVE strategy backend (in-graph packed / byte gather / loopback /
+device-sharded), while ``_sync_state_packed_impl`` and
+``_gather_pytrees_impl`` remain the default engines those backends run.
+The dispatch is host-side only — with the default backends the traced
+programs are byte-identical to direct engine calls.
 """
 import threading
 import time
@@ -131,6 +140,24 @@ def _process_allgather(x: Array) -> Array:
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check_vma=False, **kwargs):
+    """``jax.shard_map`` across jax versions: the top-level API (with
+    ``check_vma``) when present, else ``jax.experimental.shard_map`` (with
+    the equivalent ``check_rep``). Replication checking is disabled either
+    way — ``lax.all_gather`` outputs are semantically replicated but the
+    static checker cannot prove it. Drop-in for the ``jax.shard_map`` call
+    shape the test/bench/dryrun harnesses use."""
+    if hasattr(jax, "shard_map"):  # pragma: no cover - newer jax
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False, **kwargs
+    )
 
 
 class Hierarchy:
@@ -227,38 +254,77 @@ def hierarchical_axis(intra: Any, inter: Any) -> Hierarchy:
 _EAGER_OVERRIDES = threading.local()
 
 
-@contextmanager
-def transport_overrides(
-    *, quorum: Optional[Sequence[int]] = None, transport_label: Optional[str] = None
-):
-    """Thread-scoped overrides for the eager gather transport.
+class transport_overrides:
+    """Thread-scoped overrides for the eager gather transport (a REENTRANT
+    context manager).
 
     ``quorum`` restricts the decode/reduce membership of every gather issued
     on this thread to the given process indices — the degraded-link
-    ``on_degraded="quorum"`` policy's hook: the underlying transport round
-    still spans all processes (it is a global collective), but only the
-    healthy subgroup's contributions enter the result, exactly as an explicit
-    ``group=`` argument would select (the existing group plumbing). A quorum
-    never widens a group: it intersects with whatever group each gather
-    names. ``transport_label`` relabels the round-trip telemetry (histogram
+    ``on_degraded="quorum"`` policy's hook: when the active transport has no
+    true-subgroup channel the underlying round still spans all processes,
+    but only the healthy subgroup's contributions enter the result, exactly
+    as an explicit ``group=`` argument would select. A quorum never widens a
+    group: it intersects with whatever group each gather names.
+    ``transport_label`` relabels the round-trip telemetry (histogram
     ``transport=`` label, sync events) so the async engine's cross-host DCN
     legs are distinguishable from inline gathers.
 
-    Overrides nest; each ``with`` block restores the previous values. They
-    are deliberately **thread-local**: the background sync engine's worker
-    applies its policy without perturbing inline syncs on other threads.
+    Overrides nest and the SAME instance may be re-entered (each
+    ``__enter__`` pushes the previous values, each ``__exit__`` pops and
+    restores under ``try``/``finally`` semantics) — a gather raising
+    mid-attempt can never leave a stale quorum installed to poison the next
+    flat sync. Arguments are validated at CONSTRUCTION, before anything is
+    installed. Deliberately **thread-local**: the background sync engine's
+    worker applies its policy without perturbing inline syncs on other
+    threads; :func:`current_transport_overrides` /
+    :func:`applied_transport_overrides` propagate a snapshot onto helper
+    threads (the engine's per-round-timeout runner).
     """
-    prev_quorum = getattr(_EAGER_OVERRIDES, "quorum", None)
-    prev_label = getattr(_EAGER_OVERRIDES, "transport_label", None)
-    if quorum is not None:
-        _EAGER_OVERRIDES.quorum = sorted({int(i) for i in quorum})
-    if transport_label is not None:
-        _EAGER_OVERRIDES.transport_label = str(transport_label)
+
+    def __init__(
+        self, *, quorum: Optional[Sequence[int]] = None, transport_label: Optional[str] = None
+    ) -> None:
+        self._quorum = sorted({int(i) for i in quorum}) if quorum is not None else None
+        self._label = str(transport_label) if transport_label is not None else None
+        self._saved: List[Tuple[Optional[List[int]], Optional[str]]] = []
+
+    def __enter__(self) -> "transport_overrides":
+        self._saved.append(current_transport_overrides())
+        if self._quorum is not None:
+            _EAGER_OVERRIDES.quorum = self._quorum
+        if self._label is not None:
+            _EAGER_OVERRIDES.transport_label = self._label
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        prev_quorum, prev_label = self._saved.pop()
+        _EAGER_OVERRIDES.quorum = prev_quorum
+        _EAGER_OVERRIDES.transport_label = prev_label
+        return False
+
+
+def current_transport_overrides() -> Tuple[Optional[List[int]], Optional[str]]:
+    """This thread's ``(quorum, transport_label)`` override snapshot."""
+    return (
+        getattr(_EAGER_OVERRIDES, "quorum", None),
+        getattr(_EAGER_OVERRIDES, "transport_label", None),
+    )
+
+
+@contextmanager
+def applied_transport_overrides(snapshot: Tuple[Optional[List[int]], Optional[str]]):
+    """Install an override snapshot (from
+    :func:`current_transport_overrides`) on THIS thread for the duration of
+    the block — how the async engine's timeout helper threads inherit the
+    worker's quorum/label. Exception-safe: always restores."""
+    quorum, label = snapshot
+    prev = current_transport_overrides()
+    _EAGER_OVERRIDES.quorum = quorum
+    _EAGER_OVERRIDES.transport_label = label
     try:
         yield
     finally:
-        _EAGER_OVERRIDES.quorum = prev_quorum
-        _EAGER_OVERRIDES.transport_label = prev_label
+        _EAGER_OVERRIDES.quorum, _EAGER_OVERRIDES.transport_label = prev
 
 
 #: descriptor layout for the ragged gather: [ndim, d0..d7, dtype_code]
@@ -413,7 +479,13 @@ def _align_leaf(
     return shapes, counts, target_dtype, group_error
 
 
-def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[Array]]:
+def _gather_all_leaves(
+    leaves: List[Array],
+    group: Optional[Any],
+    *,
+    participants: Optional[Sequence[int]] = None,
+    label: Optional[str] = None,
+) -> List[List[Array]]:
     """Packed transport core: gather EVERY leaf across processes in ONE
     descriptor round plus (at most) one payload round.
 
@@ -422,6 +494,16 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
     an intra-group shape/dtype mismatch — is deferred until after the last
     collective so no rank can desync the fixed per-call round count its peers
     are committed to.
+
+    ``participants`` (a transport-level subgroup, from
+    ``GatherTransport.subgroup``) restricts the processes the rounds
+    physically touch: with a registered subgroup channel
+    (``metrics_tpu.transport.gather.set_subgroup_allgather``) the
+    descriptor/payload exchanges run among exactly those peers — a dead
+    non-participant is never contacted; without one, the rounds fall back to
+    the global collective and only the decode narrows (the legacy quorum
+    behavior). ``label`` names the backend for the round telemetry; a
+    thread-scoped ``transport_overrides(transport_label=...)`` wins.
     """
     transport_start = time.perf_counter()
     nprocs = world_size()
@@ -434,14 +516,43 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
         arg_error = err
         members = list(range(nprocs))
     # a thread-scoped quorum (the degraded-link policy hook) narrows the
-    # decoded membership to the healthy subgroup — the transport round still
-    # spans all processes, so collective discipline is untouched
+    # decoded membership to the healthy subgroup
     quorum = getattr(_EAGER_OVERRIDES, "quorum", None)
     if quorum is not None:
         narrowed = [m for m in members if m in quorum]
         if narrowed:
             members = narrowed
-    transport_label = getattr(_EAGER_OVERRIDES, "transport_label", None) or "gather"
+    transport_label = (
+        getattr(_EAGER_OVERRIDES, "transport_label", None) or label or "gather"
+    )
+
+    # -- transport-level subgroup formation ---------------------------------
+    # ranks = the processes this round's exchanges span; slot = a rank's row
+    # index in the exchanged arrays. Default: all processes, global rounds.
+    ranks = list(range(nprocs))
+    exchange = _process_allgather
+    local_rank = int(jax.process_index()) if nprocs > 1 else 0
+    if participants is not None:
+        want = sorted({int(p) for p in participants if 0 <= int(p) < nprocs})
+        if want and want != ranks:
+            channel = _subgroup_channel()
+            if channel is not None:
+                # true subgroup: rounds touch ONLY these peers (callers
+                # outside the set publish-and-read without contributing)
+                ranks = want
+
+                def exchange(x, _channel=channel, _want=tuple(want)):
+                    return np.asarray(_channel(np.asarray(x), list(_want)))
+
+            # either way the decoded membership narrows to the subgroup
+            narrowed = [m for m in members if m in want]
+            if narrowed:
+                members = narrowed
+    slot_of = {r: i for i, r in enumerate(ranks)}
+    nslots = len(ranks)
+    members = [m for m in members if m in slot_of] or list(ranks)
+    member_slots = [slot_of[m] for m in members]
+    local_slot = slot_of.get(local_rank)
 
     # collective spans: one deterministic id per transport (and per round)
     # shared by every participating process — the fleet-timeline correlation
@@ -463,32 +574,32 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
             local_parts.append(np.ascontiguousarray(np.asarray(arr)).tobytes())
     d_span = tracer.begin("gather", group=group_label, bucket="descriptor") if tracer else None
     desc_start = time.perf_counter()
-    all_desc = _process_allgather(desc)  # (nprocs, num_leaves, 10)
+    all_desc = np.asarray(exchange(desc))  # (nslots, num_leaves, 10)
     desc_dur = time.perf_counter() - desc_start
     if tracer:
         tracer.end(d_span, leaves=num_leaves, bytes=int(desc.nbytes))
 
-    aligned = [_align_leaf(all_desc[:, j, :], members) for j in range(num_leaves)]
+    aligned = [_align_leaf(all_desc[:, j, :], member_slots) for j in range(num_leaves)]
     group_error = next((a[3] for a in aligned if a[3] is not None), None)
 
     # per-rank byte layout: each rank's payload is the concatenation of its
     # leaves' raw bytes in leaf order (offsets recomputed per rank from that
     # rank's own descriptors, so ragged per-rank shapes need no padding
     # between leaves)
-    dtype_codes = all_desc[:, :, -1].astype(int)  # (nprocs, num_leaves)
-    leaf_nbytes = np.zeros((nprocs, num_leaves), dtype=np.int64)
+    dtype_codes = all_desc[:, :, -1].astype(int)  # (nslots, num_leaves)
+    leaf_nbytes = np.zeros((nslots, num_leaves), dtype=np.int64)
     for j in range(num_leaves):
         counts_j = aligned[j][1]
-        for i in range(nprocs):
+        for i in range(nslots):
             leaf_nbytes[i, j] = int(counts_j[i]) * _GATHER_DTYPES[int(dtype_codes[i, j])].itemsize
-    offsets = np.concatenate([np.zeros((nprocs, 1), np.int64), np.cumsum(leaf_nbytes, axis=1)], axis=1)
+    offsets = np.concatenate([np.zeros((nslots, 1), np.int64), np.cumsum(leaf_nbytes, axis=1)], axis=1)
     totals = offsets[:, -1]
     max_bytes = int(totals.max())
 
-    # ONE global payload round carries every process's whole bundle (each
-    # group decodes only its own members), padded to the global max byte
-    # length; skipped entirely — on EVERY rank, keeping the collective count
-    # aligned — when all contributions are empty
+    # ONE payload round carries every participant's whole bundle (each
+    # group decodes only its own members), padded to the round's max byte
+    # length; skipped entirely — on EVERY participant, keeping the
+    # collective count aligned — when all contributions are empty
     payload_dur = 0.0
     if max_bytes == 0:
         gathered = None
@@ -498,10 +609,10 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
         buf[: local_bytes.size] = local_bytes
         p_span = tracer.begin("gather", group=group_label, bucket="payload") if tracer else None
         payload_start = time.perf_counter()
-        gathered = _process_allgather(buf)  # (nprocs, max_bytes)
+        gathered = np.asarray(exchange(buf))  # (nslots, max_bytes)
         payload_dur = time.perf_counter() - payload_start
         if tracer:
-            tracer.end(p_span, leaves=num_leaves, bytes=nprocs * max_bytes)
+            tracer.end(p_span, leaves=num_leaves, bytes=nslots * max_bytes)
 
     span_id = (
         tracer.end(t_span, leaves=num_leaves, members=[int(m) for m in members])
@@ -509,8 +620,8 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
         else None
     )
     _record_gather_telemetry(
-        bytes_out=int(totals[jax.process_index()]) if nprocs > 1 else int(totals[0]),
-        bytes_in=int(sum(int(leaf_nbytes[i, j]) for i in members for j in range(num_leaves))),
+        bytes_out=int(totals[local_slot]) if local_slot is not None else 0,
+        bytes_in=int(sum(int(leaf_nbytes[s, j]) for s in member_slots for j in range(num_leaves))),
         members=members,
         nprocs=nprocs,
         leaves=num_leaves,
@@ -523,6 +634,7 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
         payload_s=payload_dur,
         span_id=span_id,
         transport=transport_label,
+        participants=list(ranks),
     )
 
     if arg_error is not None:
@@ -536,20 +648,31 @@ def _gather_all_leaves(leaves: List[Array], group: Optional[Any]) -> List[List[A
     for j in range(num_leaves):
         shapes, counts, target_dtype, _ = aligned[j]
         per_member: List[Array] = []
-        for i in members:
-            shape = tuple(int(d) for d in shapes[i])
-            if counts[i] == 0:
+        for s in member_slots:
+            shape = tuple(int(d) for d in shapes[s])
+            if counts[s] == 0:
                 per_member.append(jnp.zeros(shape, target_dtype))
                 continue
             raw = np.frombuffer(
-                gathered[i].tobytes(),
+                gathered[s].tobytes(),
                 dtype=target_dtype,
-                count=int(counts[i]),
-                offset=int(offsets[i, j]),
+                count=int(counts[s]),
+                offset=int(offsets[s, j]),
             )
             per_member.append(jnp.asarray(raw.reshape(shape)))
         out.append(per_member)
     return out
+
+
+def _subgroup_channel():
+    """The registered transport-subgroup exchange channel, or ``None`` (lazy
+    import: the strategy layer must stay optional for this module)."""
+    try:
+        from metrics_tpu.transport.gather import subgroup_allgather
+
+        return subgroup_allgather()
+    except Exception:  # pragma: no cover - the seam must never break a sync
+        return None
 
 
 def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]:
@@ -582,11 +705,16 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
     To gather many arrays at once, :func:`gather_all_pytrees` packs a whole
     state bundle into the same two transport rounds this function spends on
     a single array.
+
+    Dispatches through the ACTIVE transport
+    (:func:`metrics_tpu.transport.resolve_transport`): the default
+    loopback/byte-gather pair reproduces the historical behavior exactly;
+    an installed backend (subgrouped gather, sharded, custom) owns the
+    round instead.
     """
-    result = jnp.asarray(result)
-    if not distributed_available():
-        return [result]
-    return _gather_all_leaves([result], group)[0]
+    from metrics_tpu.transport import resolve_transport
+
+    return resolve_transport().gather_array(jnp.asarray(result), group=group)
 
 
 def gather_all_pytrees(trees: List[Any], group: Optional[Any] = None) -> List[Any]:
@@ -617,13 +745,32 @@ def gather_all_pytrees(trees: List[Any], group: Optional[Any] = None) -> List[An
     ``gather_all_arrays`` calls on every rank; packed, they mean one N-leaf
     bundle on every rank). Per-leaf shapes, ndims and dtypes may still
     differ arbitrarily across groups.
+
+    Dispatches through the ACTIVE transport
+    (:func:`metrics_tpu.transport.resolve_transport`); the default
+    loopback/byte-gather pair reproduces the historical behavior exactly.
     """
+    from metrics_tpu.transport import resolve_transport
+
+    return resolve_transport().gather_pytrees(trees, group=group)
+
+
+def _gather_pytrees_impl(
+    trees: List[Any],
+    group: Optional[Any] = None,
+    *,
+    participants: Optional[Sequence[int]] = None,
+    label: Optional[str] = None,
+) -> List[Any]:
+    """The byte-transport engine behind :func:`gather_all_pytrees` (what the
+    default gather backend runs): descriptor+payload rounds when
+    distributed, the world-1 identity otherwise."""
     flat = [jax.tree_util.tree_flatten(t) for t in trees]
     all_leaves = [jnp.asarray(leaf) for leaves, _ in flat for leaf in leaves]
     if not distributed_available():
         gathered: List[List[Array]] = [[leaf] for leaf in all_leaves]
     else:
-        gathered = _gather_all_leaves(all_leaves, group)
+        gathered = _gather_all_leaves(all_leaves, group, participants=participants, label=label)
     out, pos = [], 0
     for leaves, treedef in flat:
         out.append(jax.tree_util.tree_unflatten(treedef, gathered[pos : pos + len(leaves)]))
@@ -647,6 +794,7 @@ def _record_gather_telemetry(
     payload_s: float = 0.0,
     span_id: Optional[str] = None,
     transport: str = "gather",
+    participants: Optional[List[int]] = None,
 ) -> None:
     """Record one gather transport into the telemetry registry and the event
     timeline (host-side; the gather itself is already complete).
@@ -654,8 +802,11 @@ def _record_gather_telemetry(
     vs payload collective rounds (the span decomposition's raw material);
     ``span_id`` is the transport's collective span id; ``transport`` is the
     histogram/event label (``"gather"`` inline, ``"dcn"`` for the async
-    engine's cross-host legs — see :func:`transport_overrides`). Never
-    raises."""
+    engine's cross-host legs, the backend name for strategy transports —
+    see :func:`transport_overrides` and ``metrics_tpu.transport``);
+    ``participants`` is the peer set the round PHYSICALLY touched (all
+    processes for a global collective, the subgroup for a true subgroup
+    round) — what the quorum acceptance tests assert. Never raises."""
     try:
         from metrics_tpu.observability.events import EVENTS
         from metrics_tpu.observability.histogram import (
@@ -688,6 +839,7 @@ def _record_gather_telemetry(
                 descriptor_s=descriptor_s,
                 payload_s=payload_s,
                 transport=transport,
+                participants=participants,
             )
         if EVENTS.enabled:
             # the gather rounds on the global timeline: one interval per
@@ -714,6 +866,11 @@ def _record_gather_telemetry(
                 world=nprocs,
                 members=[int(m) for m in members],
                 error=bool(error),
+                **(
+                    {"participants": [int(p) for p in participants]}
+                    if participants is not None
+                    else {}
+                ),
             )
     except Exception:  # pragma: no cover - telemetry must never break a sync
         pass
@@ -984,7 +1141,30 @@ def sync_state_packed(
     groups or shared-update classes syncing ONE leaf-set for several
     members — so the sync event and ``in_graph`` stats carry the group
     composition alongside the bucket packing.
+
+    Dispatches through the ACTIVE transport
+    (:func:`metrics_tpu.transport.resolve_transport`); the default
+    :class:`~metrics_tpu.transport.in_graph.InGraphTransport` lowering is
+    this module's packed engine itself (``_sync_state_packed_impl``), so the
+    traced program is byte-identical to a direct engine call.
     """
+    from metrics_tpu.transport import resolve_transport
+
+    return resolve_transport().sync_state_packed(
+        state, reductions, axis_name, levels=levels, group_composition=group_composition
+    )
+
+
+def _sync_state_packed_impl(
+    state: Dict[str, Union[Array, List[Array]]],
+    reductions: Dict[str, ReduceFx],
+    axis_name: Any,
+    *,
+    levels: Optional[Sequence[Tuple[str, Any]]] = None,
+    group_composition: Optional[Dict[str, int]] = None,
+) -> Dict[str, Union[Array, List[Array]]]:
+    """The packed in-graph engine behind :func:`sync_state_packed` (what the
+    default in-graph backend lowers through)."""
     from metrics_tpu.utilities.data import dim_zero_cat
 
     if levels is None and isinstance(axis_name, Hierarchy):

@@ -225,21 +225,29 @@ def check_trajectory(
     min_history: int = DEFAULT_MIN_HISTORY,
     tolerance_overrides: Optional[Dict[str, float]] = None,
 ) -> List[Dict[str, Any]]:
-    """Judge the LATEST round against per-config baselines from the prior
-    ones. Returns one row per config in the latest round:
+    """Judge each config's LATEST record against its per-config baseline
+    from the prior rounds. Returns one row per config:
     ``{"metric", "unit", "baseline", "latest", "delta_pct", "tolerance",
     "status", "history"}`` — ``status`` is ``REGRESSED`` only for a healthy
     latest value past ``baseline * (1 + tolerance)``, where a config named
     in ``tolerance_overrides`` is judged against its own band instead of the
     global one.
+
+    A config ABSENT from the newest round (a partial capture — e.g. a round
+    that re-measured only the new configs) is still judged: its newest
+    record anywhere in the trajectory is compared against the rounds before
+    it, so a partial round can never silently shrink the judged set.
     """
     if not rounds:
         return []
     overrides = tolerance_overrides or {}
-    latest_n, latest = rounds[-1]
-    prior = rounds[:-1]
+    all_metrics = sorted({m for _, by_metric in rounds for m in by_metric})
     rows: List[Dict[str, Any]] = []
-    for metric in sorted(latest):
+    for metric in all_metrics:
+        # the config's newest record, and the rounds strictly before it
+        rec_idx = max(i for i, (_, by_metric) in enumerate(rounds) if metric in by_metric)
+        latest_n, latest = rounds[rec_idx]
+        prior = rounds[:rec_idx]
         rec = latest[metric]
         history = [
             v for v in (_healthy_value(by_metric.get(metric)) for _, by_metric in prior)

@@ -55,6 +55,13 @@ if REPO_ROOT not in sys.path:
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO_ROOT, ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
+# virtual 8-device CPU mesh for the CPU-pinned mesh configs (sharded-state
+# sync); only affects the CPU platform, so the TPU-backed configs are
+# untouched. Must be set before jax initializes its backends.
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (_xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
 NUM_CLASSES = 10
 BATCH = 1024
 # scan length for our side: the slope's signal (marginal device time between
@@ -1585,6 +1592,268 @@ def run_config(cfg, probe: bool = True, _repinned: bool = False) -> dict:
 
 #: metric name + unit per config, so a crashed config can still report under
 #: its real identity (bench.py's fallback line)
+#: classes for the giant device-sharded confusion matrix (the acceptance
+#: target is >=100k; the CI smoke step overrides this down via env)
+SHARDED_CLASSES = int(os.environ.get("METRICS_TPU_BENCH_SHARDED_CLASSES", "100000"))
+#: classes for the sharded-vs-replicated timing comparison (both sides must
+#: actually fit replicated per-device, so this stays modest)
+SHARDED_SMALL_CLASSES = int(os.environ.get("METRICS_TPU_BENCH_SHARDED_SMALL", "4096"))
+
+
+def _mem_available_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:  # pragma: no cover - non-linux
+        pass
+    return 0
+
+
+def _time_steps(step_fn, warmup=2, steps=8):
+    """Wall time per step of an eager-dispatch jitted step (median-free
+    simple mean after warmup; the sharded configs' steps are long enough
+    that dispatch noise is negligible)."""
+    for _ in range(warmup):
+        step_fn()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step_fn()
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_transport_dispatch_overhead():
+    """The strategy seam's cost: dispatching every sync through the active
+    transport must be free. Two pins:
+
+    * **eager loopback**: per-call cost of ``gather_all_pytrees`` through
+      the dispatcher (auto -> LoopbackTransport) vs the direct world-1
+      engine call (``_gather_pytrees_impl``) — the baseline the driver's
+      ``vs_baseline`` reports;
+    * **in-graph**: the packed sync SCAN step with ``InGraphTransport``
+      installed vs the direct ``_sync_state_packed_impl`` — identical
+      compiled programs (dispatch happens at trace time), so the slope must
+      be within noise; both values ride the record.
+
+    Acceptance: loopback and in-graph within noise of the direct path.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu import observability
+    from metrics_tpu.transport import InGraphTransport, use_transport
+    from metrics_tpu.utilities import distributed as dist_mod
+    from metrics_tpu.utilities.distributed import (
+        _sync_state_packed_impl,
+        gather_all_pytrees,
+        shard_map_compat,
+        sync_state_packed,
+    )
+
+    observability.disable()
+    try:
+        # -- eager: loopback dispatch vs direct impl (per-call, world 1)
+        tree = {
+            "tp": jnp.zeros((64,), jnp.float32),
+            "fp": jnp.zeros((64,), jnp.float32),
+            "rows": [jnp.zeros((128,), jnp.float32)],
+        }
+        n_calls = 2000
+
+        def timed(fn):
+            fn()  # warm
+            t0 = time.perf_counter()
+            for _ in range(n_calls):
+                fn()
+            return (time.perf_counter() - t0) / n_calls
+
+        loopback_us = timed(lambda: gather_all_pytrees([tree])) * 1e6
+        direct_us = timed(lambda: dist_mod._gather_pytrees_impl([tree])) * 1e6
+
+        # -- in-graph: seamed vs direct packed sync scan step
+        nc = 8
+        state = {
+            "confmat": jnp.ones((nc, nc), jnp.float32),
+            "total": jnp.ones((), jnp.float32),
+        }
+        reductions = {"confmat": "sum", "total": "sum"}
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        xs = jnp.arange(SYNC_STEPS, dtype=jnp.int32)
+
+        def make_update(sync_fn):
+            body = shard_map_compat(
+                lambda s: sync_fn(s, reductions, "data"), mesh=mesh, in_specs=(P(),), out_specs=P()
+            )
+
+            def update(acc, x):
+                s = {k: v + x.astype(v.dtype) for k, v in state.items()}
+                synced = body(s)
+                return acc + sum(jnp.sum(v) for v in synced.values())
+
+            return update
+
+        zero = lambda: jnp.zeros(())  # noqa: E731
+        with use_transport(InGraphTransport()):
+            seamed_step = _time_scan_epoch((xs,), zero, make_update(sync_state_packed))
+        direct_step = _time_scan_epoch((xs,), zero, make_update(_sync_state_packed_impl))
+    finally:
+        observability.enable()
+
+    def ref(torchmetrics, torch):  # the direct engine call is the baseline
+        return direct_us * 1e-6
+
+    extra = {
+        "loopback_dispatch_us": round(loopback_us, 4),
+        "direct_engine_us": round(direct_us, 4),
+        "eager_overhead_us": round(loopback_us - direct_us, 4),
+        "in_graph_seamed_us_step": round(seamed_step * 1e6, 4),
+        "in_graph_direct_us_step": round(direct_step * 1e6, 4),
+        # the acceptance pins: the seam adds at most a resolve + singleton
+        # lookup eagerly (a few µs against a ~60 µs call), and NOTHING on
+        # the in-graph step (dispatch is trace-time-only — the two scans
+        # are the same executable)
+        "eager_within_noise": bool(loopback_us <= direct_us * 1.25 + 5.0),
+        "in_graph_within_noise": bool(
+            seamed_step <= direct_step * 1.5 + 5e-6 and direct_step <= seamed_step * 1.5 + 5e-6
+        ),
+    }
+    return "transport_dispatch_overhead", loopback_us * 1e-6, ref, "us/call", extra
+
+
+bench_transport_dispatch_overhead._force_cpu = True
+
+
+def bench_sharded_state_sync():
+    """Device-sharded giant states: a >=100k-class confusion matrix synced
+    without ever materializing the full count grid on one device.
+
+    Two measurements ride one record:
+
+    * **timing comparison** at ``SHARDED_SMALL_CLASSES`` (both sides fit):
+      donated update+sync step with the state SHARDED over the 8-device
+      mesh (``ShardedTransport``: scatter-add into the owning shard, sync =
+      in-place reduction) vs the REPLICATED layout (every device accumulates
+      a private (C, C) partial, sync = packed psum over the mesh axis) —
+      ``vs_baseline`` is replicated/sharded;
+    * **the giant case** at ``SHARDED_CLASSES`` (sharded only; the
+      replicated layout would need devices x C^2 x 4 bytes): per-step cost,
+      per-device bytes, ``max_shard_fraction`` == 1/8 (the acceptance
+      evidence), and the sync payload a replicated psum WOULD have moved vs
+      the sharded path's zero inter-replica bytes. Guarded by MemAvailable;
+      a skipped giant case is recorded with its reason, never silently.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.transport import ShardedTransport
+    from metrics_tpu.utilities.distributed import _sync_state_packed_impl, shard_map_compat
+
+    ndev = min(len(jax.devices()), 8)
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("shard",))
+    transport = ShardedTransport(mesh, "shard")
+    rng = np.random.RandomState(0)
+    B = 8192
+
+    def sharded_step_fn(C):
+        sharding = NamedSharding(mesh, P("shard"))
+        state = jax.jit(
+            lambda: jnp.zeros((C, C), jnp.int32), out_shardings=sharding
+        )()
+
+        @functools.partial(jax.jit, donate_argnums=(0,), out_shardings=sharding)
+        def update(s, t, p):
+            return s.at[t, p].add(1)
+
+        t_idx = jnp.asarray(rng.randint(0, C, B))
+        p_idx = jnp.asarray(rng.randint(0, C, B))
+        box = {"state": state}
+
+        def step():
+            box["state"] = update(box["state"], t_idx, p_idx)
+            # sync: the in-place sharded reduction (identity for a global
+            # sharded array — the state IS already fleet-wide)
+            box["state"] = transport.reduce_states(
+                {"confmat": box["state"]}, {"confmat": "sum"}
+            )["confmat"]
+            jax.block_until_ready(box["state"])
+
+        return step, box
+
+    def replicated_step_fn(C):
+        # every device accumulates a PRIVATE (C, C) partial from its batch
+        # shard; epoch sync = one packed psum over the mesh axis
+        state = jnp.zeros((C, C), jnp.int32)
+        t_idx = jnp.asarray(rng.randint(0, C, B))
+        p_idx = jnp.asarray(rng.randint(0, C, B))
+
+        body = shard_map_compat(
+            lambda s, t, p: _sync_state_packed_impl(
+                {"confmat": s.at[t, p].add(1)}, {"confmat": "sum"}, "shard"
+            )["confmat"],
+            mesh=mesh,
+            in_specs=(P(), P("shard"), P("shard")),
+            out_specs=P(),
+        )
+        fn = jax.jit(body, donate_argnums=(0,))
+        box = {"state": state}
+
+        def step():
+            box["state"] = fn(box["state"], t_idx, p_idx)
+            jax.block_until_ready(box["state"])
+
+        return step, box
+
+    # -- timing comparison at the small size
+    C_small = SHARDED_SMALL_CLASSES
+    sharded_step, sharded_box = sharded_step_fn(C_small)
+    ours = _time_steps(sharded_step)
+    small_frac = transport.max_shard_fraction(sharded_box["state"])
+
+    def ref(torchmetrics, torch):  # the replicated layout is the baseline
+        rep_step, _ = replicated_step_fn(C_small)
+        return _time_steps(rep_step)
+
+    # -- the giant case (sharded only)
+    C = SHARDED_CLASSES
+    state_bytes = 4 * C * C
+    giant: dict = {"classes": C, "state_bytes": state_bytes}
+    avail = _mem_available_bytes()
+    if avail and avail < 2.2 * state_bytes:
+        giant["skipped"] = (
+            f"MemAvailable {avail} B < 2.2x state ({state_bytes} B); rerun with more"
+            " RAM or METRICS_TPU_BENCH_SHARDED_CLASSES"
+        )
+    else:
+        g_step, g_box = sharded_step_fn(C)
+        giant["us_step"] = round(_time_steps(g_step, warmup=1, steps=3) * 1e6, 3)
+        frac = transport.max_shard_fraction(g_box["state"])
+        giant["max_shard_fraction"] = round(frac, 6)
+        giant["per_device_bytes"] = int(state_bytes * frac)
+        giant["full_state_on_one_device"] = bool(frac > 1.0 / ndev + 1e-9)
+        # what a replicated epoch sync would MOVE per psum vs the sharded
+        # path (nothing crosses replicas: the state is one global array)
+        giant["replicated_sync_payload_bytes"] = state_bytes
+        giant["sharded_sync_payload_bytes"] = 0
+        del g_box
+
+    extra = {
+        "devices": ndev,
+        "batch": B,
+        "small_classes": C_small,
+        "small_max_shard_fraction": round(small_frac, 6),
+        "giant": giant,
+    }
+    return "sharded_state_sync_step", ours, ref, "us/step", extra
+
+
+bench_sharded_state_sync._force_cpu = True
+
+
 CONFIG_META = {
     "bench_accuracy": ("accuracy_update_step", "us/step"),
     "bench_collection": ("metric_collection_update_step_fused", "us/step"),
@@ -1605,6 +1874,8 @@ CONFIG_META = {
     "bench_collection_sync_eager": ("collection_sync_eager_epoch", "us/epoch"),
     "bench_collection_sync_hierarchical": ("collection_sync_hierarchical_step", "us/step"),
     "bench_compute_async_overlap": ("compute_async_overlap", "us/submit"),
+    "bench_transport_dispatch_overhead": ("transport_dispatch_overhead", "us/call"),
+    "bench_sharded_state_sync": ("sharded_state_sync_step", "us/step"),
 }
 
 #: driver order — the flagship collection config LAST (the driver's headline)
@@ -1627,6 +1898,8 @@ CONFIGS = [
     bench_collection_sync_eager,
     bench_collection_sync_hierarchical,
     bench_compute_async_overlap,
+    bench_transport_dispatch_overhead,
+    bench_sharded_state_sync,
     bench_collection,
 ]
 

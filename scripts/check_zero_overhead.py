@@ -314,6 +314,47 @@ def hierarchical_sync_collectives() -> Dict[str, Dict[str, int]]:
     }
 
 
+def sharded_confusion_sync() -> Dict[str, Dict[str, int]]:
+    """Collective counts for the SHARDED transport's in-place replica
+    reduction (``metrics_tpu/transport/sharded.py``) over a confusion-matrix
+    state — the device-sharded giant-state backend's sync program.
+
+    The reduction lowers through the packed engine inside ``shard_map``, so
+    a single-dtype confusion matrix must issue exactly ONE ``psum`` (one
+    bucket), and a mixed bundle one collective per (kind, dtype) bucket —
+    never per leaf. Traced on a 1x1 ``("replica", "shard")`` mesh
+    (collective counts are device-count-independent).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from metrics_tpu.transport import ShardedTransport
+
+    jax.config.update("jax_enable_x64", True)
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("replica", "shard"))
+    t = ShardedTransport(mesh, "shard", replica_axis="replica")
+
+    confmat = {"confmat": jnp.zeros((16, 16), jnp.float32)}
+    program = t._reduce_program(confmat, {"confmat": "sum"})
+    single = _count_collectives(jax.make_jaxpr(program)(confmat).jaxpr)
+
+    multi = {
+        "confmat": jnp.zeros((16, 16), jnp.float32),
+        "row_counts": jnp.zeros((16,), jnp.int64),
+        "seen_max": jnp.zeros((), jnp.float32),
+    }
+    program2 = t._reduce_program(
+        multi, {"confmat": "sum", "row_counts": "sum", "seen_max": "max"}
+    )
+    mixed = _count_collectives(jax.make_jaxpr(program2)(multi).jaxpr)
+    return {
+        "sharded_confusion_sync": single,
+        "sharded_confusion_sync_multi_dtype": mixed,
+    }
+
+
 def donation_aliasing() -> Dict[str, Dict[str, int]]:
     """Buffer-donation aliasing audit of the donated stateful hot paths.
 
@@ -562,6 +603,41 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                 " the background engine leaked traced ops into the hot path"
             )
 
+    # the TRANSPORT SEAM must be free: with the in-graph / gather strategy
+    # backends explicitly installed as the process-global transport (the
+    # dispatch every sync now routes through), every hot-path jaxpr must be
+    # byte-identical to the direct-engine state — the strategy layer is
+    # host-side dispatch, never traced ops
+    from metrics_tpu.transport import (
+        GatherTransport,
+        InGraphTransport,
+        set_transport,
+    )
+
+    for backend in (InGraphTransport(), GatherTransport()):
+        prev_transport = set_transport(backend)
+        try:
+            for name, thunk in programs.items():
+                if thunk() != texts[name]:
+                    violations.append(
+                        f"{name}: jaxpr differs with {type(backend).__name__} installed"
+                        " as the active transport — the strategy seam leaked traced"
+                        " ops into the hot path"
+                    )
+        finally:
+            set_transport(prev_transport)
+
+    # sharded-backend self-consistency (baseline-independent): the in-place
+    # replica reduction packs into buckets — one psum for the single-dtype
+    # confusion matrix, one collective per (kind, dtype) for a mixed bundle
+    sharded = sharded_confusion_sync()
+    if sharded["sharded_confusion_sync"] != {"psum": 1}:
+        violations.append(
+            f"sharded_confusion_sync: lowers to {sharded['sharded_confusion_sync']},"
+            " expected exactly one packed psum — the sharded backend is regressing"
+            " toward per-leaf collectives"
+        )
+
     # hierarchical fusion self-consistency (baseline-independent): each
     # two-level lowering issues exactly one collective per (level, kind,
     # dtype) bucket — every flat count doubled, nothing more
@@ -643,6 +719,24 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                         f"{name}: in-graph sync lowers to {counts}, baseline pins {want} —"
                         " the packed (bucketed) sync regressed toward per-leaf collectives"
                         " (or the bucket layout changed). If intentional, regenerate with"
+                        " `python scripts/check_zero_overhead.py --update`."
+                    )
+        # the sharded backend's reduction counts are pinned the same way:
+        # self-consistency above proves "one psum"; the baseline makes any
+        # bucket-layout change a conscious regeneration
+        pinned_sharded = baseline.get("sharded_confusion_sync")
+        if pinned_sharded is None:
+            violations.append("sharded_confusion_sync missing from baseline (run --update)")
+        else:
+            for name, counts in sharded.items():
+                want = pinned_sharded.get(name)
+                if want is None:
+                    violations.append(f"{name}: sharded sync program missing from baseline (run --update)")
+                elif want != counts:
+                    violations.append(
+                        f"{name}: sharded in-place reduction lowers to {counts}, baseline"
+                        f" pins {want} — the sharded backend's bucket layout changed. If"
+                        " intentional, regenerate with"
                         " `python scripts/check_zero_overhead.py --update`."
                     )
         # the hierarchical counts are pinned per (level, kind) too: the
@@ -727,6 +821,9 @@ def update_baseline(baseline_path: str = BASELINE_PATH) -> str:
         # hierarchical (two-level) lowering: exactly one collective per
         # (level, kind, dtype) bucket — the flat counts doubled
         "hierarchical_sync_collectives": hierarchical_sync_collectives(),
+        # sharded backend's in-place replica reduction: one packed collective
+        # per (kind, dtype) bucket for the canonical confusion-matrix states
+        "sharded_confusion_sync": sharded_confusion_sync(),
         # donated stateful lowering: every state leaf must alias an output
         # buffer (zero-copy in-place updates); fewer means per-step copies
         "donation_aliasing": donation_aliasing(),

@@ -22,6 +22,7 @@ pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 2, reason="collective staging needs a multi-device mesh"
 )
 
+from metrics_tpu.utilities.distributed import shard_map_compat
 from metrics_tpu import (
     AUROC,
     Accuracy,
@@ -90,7 +91,7 @@ def test_ten_metric_sync_is_one_allreduce():
 
     mesh = _mesh()
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             lambda s: coll.apply_compute(s, axis_name="data"),
             mesh=mesh,
             in_specs=(P(),),
@@ -126,7 +127,7 @@ def test_sync_values_match_sequential_after_combining():
         return coll.apply_compute(state, axis_name="data")
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             sharded, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False
         )
     )
@@ -157,7 +158,7 @@ def test_forward_on_step_sync_aliases_class_bundle():
         return values
 
     fn = jax.jit(
-        jax.shard_map(fwd, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        shard_map_compat(fwd, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
     )
     compiled = fn.lower(preds, target).compile().as_text()
     operands = _allreduce_operand_count(compiled)
@@ -180,7 +181,7 @@ def test_capacity_auroc_sync_is_bounded():
     state = auroc.apply_update(auroc.init_state(), preds, target)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             lambda s: auroc.apply_compute(s, axis_name="data"),
             mesh=_mesh(),
             in_specs=(P(),),

@@ -9,6 +9,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from metrics_tpu import Accuracy, MetricCollection, Precision
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 DATA, MODEL = 4, 2
 
@@ -35,7 +36,7 @@ def test_metric_reduces_over_data_axis_only():
         return metric.apply_compute(state, axis_name="data").reshape(1)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             step,
             mesh=mesh,
             in_specs=(P("data"), P("data")),
@@ -69,7 +70,7 @@ def test_collection_on_2d_mesh():
         return metrics.apply_compute(state, axis_name="data")
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        shard_map_compat(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
     )
     values = jax.tree.map(
         np.asarray,
@@ -107,7 +108,7 @@ def test_process_group_is_default_axis_name():
         return defaulted.reshape(1), local.reshape(1), fwd_value.reshape(1)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             step,
             mesh=mesh,
             in_specs=(P("data"), P("data")),
@@ -152,7 +153,7 @@ def test_forward_syncs_batch_value_over_defaulted_axis():
         return value.reshape(1)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("model"), check_vma=False
         )
     )
@@ -183,7 +184,7 @@ def test_tuple_axis_names_reduce_over_both():
 
     # shard the batch over BOTH axes: 8 shards of 8 samples
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             step,
             mesh=mesh,
             in_specs=(P(("data", "model")), P(("data", "model"))),

@@ -97,6 +97,54 @@ _WORKER = textwrap.dedent(
 )
 
 
+#: one-shot probe result: can this jax runtime actually run multi-process
+#: collectives on the current backend? (jax 0.4.x CPU cannot — the workers
+#: die with "Multiprocess computations aren't implemented on the CPU
+#: backend".) Cached per session; None = not probed yet.
+_MULTIPROC_SUPPORT = {}
+
+_PROBE_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank, port = int(sys.argv[1]), sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+    )
+    import numpy as np
+    from jax.experimental import multihost_utils
+    out = multihost_utils.process_allgather(np.asarray([rank], np.int32))
+    assert sorted(np.asarray(out).reshape(-1).tolist()) == [0, 1], out
+    print(f"PARITY_OK rank={rank}", flush=True)
+    """
+)
+
+
+def _require_multiprocess_collectives(tmp_path):
+    """Skip (not fail) when the runtime genuinely cannot run cross-process
+    collectives — the documented environmental residue (ROADMAP.md): these
+    tests are then covered in-process by the loopback/simulated-transport
+    variants below, and run for real wherever the backend supports
+    multi-process (TPU, newer jax CPU)."""
+    import pytest
+
+    if "supported" not in _MULTIPROC_SUPPORT:
+        try:
+            _run_process_workers(tmp_path, _PROBE_WORKER, nprocs=2, timeout=120)
+            _MULTIPROC_SUPPORT["supported"] = True
+        except Exception as err:  # noqa: BLE001 - any failure = unsupported
+            _MULTIPROC_SUPPORT["supported"] = False
+            _MULTIPROC_SUPPORT["reason"] = str(err)[-300:]
+    if not _MULTIPROC_SUPPORT["supported"]:
+        pytest.skip(
+            "multi-process collectives unsupported on this jax backend"
+            " (see ROADMAP.md residue note); covered in-process by the"
+            " transport-parametrized variants"
+        )
+
+
 def _run_process_workers(tmp_path, script, nprocs=2, extra_env=None, timeout=220):
     with socket.socket() as s:  # reserve a free coordinator port
         s.bind(("127.0.0.1", 0))
@@ -134,6 +182,7 @@ def _run_two_process_worker(tmp_path, script, extra_env=None, timeout=220):
 
 
 def test_two_process_sync_matches_sequential(tmp_path):
+    _require_multiprocess_collectives(tmp_path)
     _run_two_process_worker(tmp_path, _WORKER)
 
 
@@ -153,6 +202,7 @@ _SPMD_WORKER = textwrap.dedent(
     from sklearn.metrics import accuracy_score, precision_score
 
     from metrics_tpu import Accuracy, MetricCollection, Precision
+    from metrics_tpu.utilities.distributed import shard_map_compat
 
     # 2 processes x 4 local devices = one GLOBAL 8-device mesh: the in-graph
     # psum crosses the process boundary (the DCN analogue), not just ICI
@@ -179,7 +229,7 @@ _SPMD_WORKER = textwrap.dedent(
         return metrics.apply_compute(state, axis_name="data")
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        shard_map_compat(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
     )
     values = jax.tree.map(lambda x: float(np.asarray(x)), fn(gp, gt))
 
@@ -198,6 +248,7 @@ def test_two_process_global_mesh_in_graph_sync(tmp_path):
     each); the metric's in-graph psum crosses the process boundary — the
     jit-path analogue of the reference's NCCL all_gather, complementing the
     eager-gather test above."""
+    _require_multiprocess_collectives(tmp_path)
     # keep any operator-set XLA flags; only the device-count flag is replaced
     kept = [
         f
@@ -274,6 +325,7 @@ def test_four_process_uneven_and_empty_rank_sync(tmp_path):
     cat-state gather with uneven per-rank sample counts AND one rank holding
     an empty (0-length) curve state — the reference's uneven-shape gather
     case (``tests/bases/test_ddp.py:63-81``) at twice the world size."""
+    _require_multiprocess_collectives(tmp_path)
     _run_process_workers(tmp_path, _FOUR_PROC_WORKER, nprocs=4)
 
 
@@ -293,6 +345,7 @@ _SPMD_2D_WORKER = textwrap.dedent(
     from sklearn.metrics import accuracy_score, precision_score
 
     from metrics_tpu import Accuracy, MetricCollection, Precision
+    from metrics_tpu.utilities.distributed import shard_map_compat
 
     # 2 processes x 4 local devices = 8 global devices arranged as a 2-D
     # (data=4, model=2) mesh. Device order puts process 0 on devices 0-3,
@@ -324,7 +377,7 @@ _SPMD_2D_WORKER = textwrap.dedent(
         state = metrics.apply_update(metrics.init_state(), p, t)
         return metrics.apply_compute(state, axis_name="data")
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map_compat(
         step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False
     ))
     values = jax.tree.map(lambda x: float(np.asarray(x)), fn(gp, gt))
@@ -345,6 +398,7 @@ def test_two_process_2d_mesh_data_axis_scoped_sync(tmp_path):
     scoped to the data axis only (the ``process_group`` -> mesh-axis
     generalization) — previously exercised only single-process on the
     virtual mesh (``tests/bases/test_mesh_axes.py``)."""
+    _require_multiprocess_collectives(tmp_path)
     kept = [
         f
         for f in os.environ.get("XLA_FLAGS", "").split()
@@ -472,4 +526,132 @@ def test_four_process_disjoint_group_sync(tmp_path):
     (``torchmetrics/utilities/distributed.py:113-135``). Also pins the
     byte-transport properties: per-round heterogeneous ndim/dtype across
     groups, an empty member inside one group, and non-member masking."""
+    _require_multiprocess_collectives(tmp_path)
     _run_process_workers(tmp_path, _DISJOINT_GROUPS_WORKER, nprocs=4)
+
+
+# ---------------------------------------------------------------------------
+# In-process transport variants (the loopback satellite): the same semantic
+# scenarios the real-process tests above cover, runnable on ANY backend —
+# parametrized over the strategy transports (metrics_tpu/transport). These
+# are the runnable signal the environmental residue above converts into.
+# ---------------------------------------------------------------------------
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from metrics_tpu import AUROC, Accuracy  # noqa: E402
+from metrics_tpu.transport import (  # noqa: E402
+    GatherTransport,
+    LoopbackTransport,
+    use_transport,
+)
+from tests.helpers.transports import run_rank_fns  # noqa: E402
+
+
+@pytest.fixture(params=["loopback", "auto"])
+def single_process_transport(request):
+    """The satellite's parametrized fixture: world-1 sync must behave
+    identically through the explicit loopback backend and the auto default
+    (which selects loopback at ``process_count() == 1``)."""
+    if request.param == "loopback":
+        with use_transport(LoopbackTransport()):
+            yield "loopback"
+    else:
+        yield "auto"
+
+
+def test_single_process_sync_matches_sequential(single_process_transport):
+    """The _WORKER scenario at world 1: scalar sum states and ragged cat
+    states compute the same values as the sequential oracle through the
+    active single-process transport (no jax.distributed runtime needed)."""
+    from sklearn.metrics import accuracy_score, roc_auc_score
+
+    NB, B, NC = 7, 16, 4
+    rng = np.random.RandomState(7)
+    probs = rng.rand(NB, B, NC).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.randint(0, NC, (NB, B))
+    bin_probs = rng.rand(NB, B).astype(np.float32)
+    bin_target = rng.randint(0, 2, (NB, B))
+
+    acc = Accuracy()
+    auroc = AUROC()
+    for i in range(NB):
+        acc.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        auroc.update(jnp.asarray(bin_probs[i]), jnp.asarray(bin_target[i]))
+
+    # force the sync path even at world 1 (sync() normally short-circuits)
+    with acc.sync_context(distributed_available=lambda: True):
+        got_acc = float(acc.compute())
+    with auroc.sync_context(distributed_available=lambda: True):
+        got_auroc = float(auroc.compute())
+
+    np.testing.assert_allclose(
+        got_acc, accuracy_score(target.reshape(-1), probs.argmax(-1).reshape(-1)), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        got_auroc, roc_auc_score(bin_target.reshape(-1), bin_probs.reshape(-1)), atol=1e-6
+    )
+
+
+def test_simulated_four_rank_uneven_and_empty_rank_sync():
+    """The _FOUR_PROC_WORKER scenario on the in-process simulated gather
+    transport: 4 ranks with uneven sample counts (one never updated) sync
+    to the sequential oracle — runnable signal for the eager multi-process
+    path on a backend with no multi-process collectives."""
+    from sklearn.metrics import roc_auc_score
+
+    NB, B = 6, 8
+    rng = np.random.RandomState(3)
+    scores = rng.rand(NB, B).astype(np.float32)
+    labels = rng.randint(0, 2, (NB, B))
+
+    def make_rank(rank):
+        def run():
+            m = AUROC()
+            # rank 3 never updates: its contribution is the 0-length
+            # placeholder, aligned by the protocol
+            for i in range(rank, NB, 4):
+                if rank < 3:
+                    m.update(jnp.asarray(scores[i]), jnp.asarray(labels[i]))
+            # distributed_available is injected: the threaded fake patches
+            # the module attr, not the default metric.py captured
+            with m.sync_context(distributed_available=lambda: True):
+                return float(m.compute())
+
+        return run
+
+    results, errors, calls = run_rank_fns([make_rank(r) for r in range(4)])
+    assert errors == [None] * 4, errors
+    # ranks 0-2 contributed batches 0..5 striped by 4 -> exactly batches
+    # {0,1,2,4,5} (batch 3 belongs to the silent rank 3)
+    used = [i for i in range(NB) if i % 4 != 3]
+    want = roc_auc_score(labels[used].reshape(-1), scores[used].reshape(-1))
+    for got in results:
+        np.testing.assert_allclose(got, want, atol=1e-6)
+    assert calls[0] == calls[1] == calls[2] == calls[3], calls
+
+
+def test_simulated_disjoint_groups_through_gather_transport():
+    """The _DISJOINT_GROUPS_WORKER core on the simulated transport, driven
+    through an explicitly installed GatherTransport: two disjoint groups
+    decode only their members from shared rounds."""
+    from metrics_tpu.utilities.distributed import gather_all_arrays
+
+    def make_rank(rank):
+        group = [0, 1] if rank < 2 else [2, 3]
+
+        def run():
+            with use_transport(GatherTransport()):
+                out = gather_all_arrays(jnp.asarray([float(rank)]), group=group)
+            return [float(np.asarray(v)[0]) for v in out]
+
+        return run
+
+    results, errors, _ = run_rank_fns([make_rank(r) for r in range(4)])
+    assert errors == [None] * 4, errors
+    assert results[0] == results[1] == [0.0, 1.0]
+    assert results[2] == results[3] == [2.0, 3.0]

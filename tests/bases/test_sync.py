@@ -14,6 +14,7 @@ from jax.sharding import PartitionSpec as P
 
 from metrics_tpu.metric import Metric
 from tests.helpers.testers import DummyListMetric, DummyMetricSum, sharded_compute
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 
 class SumAndCatMetric(Metric):
@@ -78,7 +79,7 @@ def test_apply_forward_dist_sync_on_step():
         return metric.apply_forward(state, x, axis_name="procs")
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             step, mesh=mesh, in_specs=(P("procs"), P("procs")), out_specs=(P("procs"), P()), check_vma=False
         )
     )

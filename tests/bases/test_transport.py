@@ -1,0 +1,536 @@
+"""The pluggable transport seam (metrics_tpu/transport).
+
+Covers the strategy-object API (resolution precedence, context nesting,
+per-metric pins), the loopback backend's zero-copy identity semantics, TRUE
+subgroup formation through the gather backend (dead peer never touched;
+round telemetry asserts the peer set — the acceptance criterion), the
+reentrant ``transport_overrides`` regression (a failed quorum attempt must
+not poison the next flat sync), and the async engine's subgroup quorum.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.utilities.distributed as dist_mod
+from metrics_tpu import Accuracy, observability
+from metrics_tpu.transport import (
+    AutoTransport,
+    GatherTransport,
+    InGraphTransport,
+    LoopbackTransport,
+    Transport,
+    get_transport,
+    resolve_transport,
+    set_transport,
+    use_transport,
+)
+from metrics_tpu.utilities.distributed import (
+    applied_transport_overrides,
+    current_transport_overrides,
+    gather_all_arrays,
+    gather_all_pytrees,
+    transport_overrides,
+)
+from tests.helpers.transports import SimSubgroupChannel, run_rank_fns
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_transport():
+    prev = set_transport(None)
+    yield
+    set_transport(prev)
+
+
+# ---------------------------------------------------------------------------
+# resolution: global / context / per-metric
+# ---------------------------------------------------------------------------
+
+
+def test_default_is_auto():
+    assert isinstance(get_transport(), AutoTransport)
+    assert get_transport().name == "auto"
+
+
+def test_set_transport_global_and_restore():
+    t = LoopbackTransport()
+    prev = set_transport(t)
+    try:
+        assert get_transport() is t
+    finally:
+        set_transport(prev)
+    assert isinstance(get_transport(), AutoTransport)
+
+
+def test_set_transport_rejects_non_transport():
+    with pytest.raises(TypeError, match="Transport"):
+        set_transport(object())
+
+
+def test_use_transport_nests_and_restores_on_raise():
+    outer, inner = LoopbackTransport(), GatherTransport()
+    with use_transport(outer):
+        assert get_transport() is outer
+        with pytest.raises(RuntimeError):
+            with use_transport(inner):
+                assert get_transport() is inner
+                raise RuntimeError("mid-sync failure")
+        # the raise must not leave the inner transport installed
+        assert get_transport() is outer
+    assert isinstance(get_transport(), AutoTransport)
+
+
+def test_use_transport_is_thread_local():
+    seen = {}
+
+    def other_thread():
+        seen["other"] = get_transport()
+
+    with use_transport(LoopbackTransport()):
+        th = threading.Thread(target=other_thread)
+        th.start()
+        th.join()
+    assert isinstance(seen["other"], AutoTransport)
+
+
+def test_per_metric_pin_wins_over_context_and_global():
+    pin = LoopbackTransport()
+    m = Accuracy().set_transport(pin)
+    assert m.transport is pin
+    with use_transport(GatherTransport()):
+        assert resolve_transport(m) is pin
+    m.set_transport(None)
+    assert m.transport is None
+    with use_transport(pin):
+        assert resolve_transport(m) is pin
+
+
+def test_per_metric_pin_rejects_non_transport():
+    from metrics_tpu import Metric
+
+    with pytest.raises(TypeError, match="Transport"):
+        Accuracy().set_transport("gather")
+
+    class Custom(Metric):  # the Metric base accepts transport= directly
+        def update(self):  # pragma: no cover - constructor test only
+            pass
+
+        def compute(self):  # pragma: no cover
+            return 0
+
+    with pytest.raises(TypeError, match="Transport"):
+        Custom(transport="gather")
+    assert Custom(transport=LoopbackTransport()).transport is not None
+
+
+def test_transport_pin_does_not_pickle():
+    import pickle
+
+    m = Accuracy().set_transport(LoopbackTransport())
+    m.update(jnp.asarray([0, 1, 1]), jnp.asarray([0, 1, 0]))
+    clone = pickle.loads(pickle.dumps(m))
+    assert clone.transport is None
+    np.testing.assert_allclose(float(clone.compute()), float(m.compute()))
+
+
+def test_subgroup_of_auto_and_in_graph_compose():
+    sub = AutoTransport().subgroup([0, 2])
+    # single-process: loopback has no subgroups — returns itself
+    assert isinstance(sub, LoopbackTransport)
+    ig = InGraphTransport()
+    assert ig.subgroup([0]) is not None
+    g = GatherTransport().subgroup([2, 0, 2])
+    assert g.participants == [0, 2]
+    assert g.subgroup([0]).participants == [0]
+
+
+# ---------------------------------------------------------------------------
+# loopback semantics
+# ---------------------------------------------------------------------------
+
+
+def test_loopback_gather_is_zero_copy_identity():
+    lb = LoopbackTransport()
+    leaf = jnp.asarray([1.0, 2.0])
+    out = lb.gather_pytrees([{"a": leaf, "b": [jnp.asarray([3])]}])
+    assert out[0]["a"][0] is leaf  # the SAME buffer rides through
+    assert np.asarray(out[0]["b"][0][0]).tolist() == [3]
+    arr_out = lb.gather_array(leaf)
+    assert len(arr_out) == 1 and arr_out[0] is leaf
+
+
+def test_loopback_matches_world1_protocol_shapes():
+    """Loopback must return exactly what the byte protocol returns at
+    world 1 — the dispatcher equivalence the auto default relies on."""
+    lb = LoopbackTransport()
+    trees = [{"x": jnp.arange(6, dtype=jnp.float32).reshape(2, 3), "s": jnp.asarray(2)}]
+    via_loopback = lb.gather_pytrees(trees)
+    via_protocol = dist_mod._gather_pytrees_impl(trees)  # world-1 branch
+    for k in ("x", "s"):
+        got, want = via_loopback[0][k], via_protocol[0][k]
+        assert len(got) == len(want) == 1
+        np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+
+
+def test_loopback_in_graph_zero_collectives_matches_packed_engine():
+    """Loopback's in-graph lowering = the packed engine over a 1-member
+    axis, with ZERO collectives in the traced program."""
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.utilities.distributed import (
+        _sync_state_packed_impl,
+        shard_map_compat,
+    )
+
+    state = {
+        "total": jnp.asarray(5.0),
+        "rows": [jnp.asarray([1.0, 2.0])],
+        "best": jnp.asarray(7, jnp.int32),
+        "stackme": jnp.asarray([1.0, 4.0]),
+    }
+    reductions = {"total": "sum", "rows": "cat", "best": "max", "stackme": None}
+
+    lb = LoopbackTransport()
+    got = lb.sync_state_packed(state, reductions, "procs")
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("procs",))
+    body = shard_map_compat(
+        lambda s: _sync_state_packed_impl(s, reductions, "procs"),
+        mesh=mesh, in_specs=(P(),), out_specs=P(),
+    )
+    want = body(state)
+    for k in state:
+        g = got[k][0] if isinstance(got[k], list) else got[k]
+        w = want[k][0] if isinstance(want[k], list) else want[k]
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w)), k
+
+    # zero collectives in the loopback lowering
+    import sys
+
+    sys.path.insert(0, "scripts")
+    from check_zero_overhead import _count_collectives
+
+    jaxpr = jax.make_jaxpr(lambda s: lb.sync_state_packed(s, reductions, "procs"))(state)
+    assert _count_collectives(jaxpr.jaxpr) == {}
+
+
+def test_loopback_reduce_states_hands_back_same_buffers():
+    lb = LoopbackTransport()
+    states = {"tp": jnp.asarray(3.0), "rows": [jnp.asarray([1.0])]}
+    handled = lb.reduce_states(states, {"tp": "sum", "rows": "cat"})
+    assert set(handled) == {"tp"}
+    assert handled["tp"] is states["tp"]
+
+
+# ---------------------------------------------------------------------------
+# true subgroup formation (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_subgroup_rounds_touch_only_healthy_peers_with_dead_rank():
+    """4 simulated ranks, rank 3 DEAD (its thread never starts). A
+    subgrouped GatherTransport over the healthy [0, 1, 2] completes its
+    rounds through the subgroup channel — the dead peer is never contacted
+    — and the round telemetry records exactly the healthy peer set."""
+    channel = SimSubgroupChannel()
+    healthy = [0, 1, 2]
+    observability.reset()
+
+    def make_rank(rank):
+        def run():
+            sub = GatherTransport().subgroup(healthy)
+            out = sub.gather_pytrees([{"v": jnp.asarray([float(rank)])}])
+            return sorted(float(np.asarray(x)[0]) for x in out[0]["v"])
+
+        return run
+
+    results, errors, calls = run_rank_fns(
+        [make_rank(r) for r in range(4)], subgroup_channel=channel, dead=[3]
+    )
+    assert errors[:3] == [None] * 3, errors
+    for r in healthy:
+        assert results[r] == [0.0, 1.0, 2.0]
+    # the global primitive was NEVER used; both rounds went subgroup-only
+    assert calls == [0, 0, 0, 0], calls
+    assert channel.rounds and all(
+        want == (0, 1, 2) and touched == (0, 1, 2) for want, touched in channel.rounds
+    ), channel.rounds
+    # telemetry asserts the peer set (the acceptance pin)
+    snap = observability.snapshot()
+    assert snap["sync"]["participants"]["gather"] == healthy
+    assert snap["sync"]["subgroup_rounds"] >= 1
+
+
+def test_subgroup_without_channel_falls_back_to_global_round():
+    """No subgroup channel registered: the rounds span all processes (the
+    legacy behavior) and only the decode narrows — telemetry shows the full
+    participant set, so the degradation is observable."""
+    observability.reset()
+
+    def make_rank(rank):
+        def run():
+            sub = GatherTransport().subgroup([0, 1])
+            out = sub.gather_pytrees([{"v": jnp.asarray([float(rank)])}])
+            return sorted(float(np.asarray(x)[0]) for x in out[0]["v"])
+
+        return run
+
+    results, errors, calls = run_rank_fns([make_rank(r) for r in range(3)])
+    assert errors == [None] * 3, errors
+    for r in range(3):
+        assert results[r] == [0.0, 1.0]  # decode narrowed to the subgroup
+    assert calls == [2, 2, 2], calls  # global rounds still spanned everyone
+    snap = observability.snapshot()
+    assert snap["sync"]["participants"]["gather"] == [0, 1, 2]
+
+
+def test_subgroup_respects_group_intersection():
+    """An explicit group= narrows WITHIN the subgroup's participants."""
+    channel = SimSubgroupChannel()
+
+    def make_rank(rank):
+        def run():
+            sub = GatherTransport().subgroup([0, 1, 2])
+            out = sub.gather_pytrees([{"v": jnp.asarray([float(rank)])}], group=[1, 2, 3])
+            return sorted(float(np.asarray(x)[0]) for x in out[0]["v"])
+
+        return run
+
+    results, errors, _ = run_rank_fns(
+        [make_rank(r) for r in range(4)], subgroup_channel=channel, dead=[3]
+    )
+    assert errors[:3] == [None] * 3, errors
+    for r in range(3):
+        assert results[r] == [1.0, 2.0]  # group ∩ participants
+
+
+# ---------------------------------------------------------------------------
+# transport_overrides: reentrancy + the poisoned-quorum regression
+# ---------------------------------------------------------------------------
+
+
+def test_transport_overrides_restores_after_midattempt_raise():
+    """A gather raising INSIDE the override block must not leave the quorum
+    installed: the next flat sync sees no narrowing (the PR-9 regression)."""
+    assert current_transport_overrides() == (None, None)
+    with pytest.raises(ValueError):
+        with transport_overrides(quorum=[0], transport_label="dcn"):
+            raise ValueError("transport round failed mid-attempt")
+    assert current_transport_overrides() == (None, None)
+
+    # the next flat sync decodes ALL members again
+    def make_rank(rank):
+        def run():
+            out = gather_all_arrays(jnp.asarray([float(rank)]))
+            return len(out)
+
+        return run
+
+    results, errors, _ = run_rank_fns([make_rank(r) for r in range(2)])
+    assert errors == [None, None]
+    assert results == [2, 2]
+
+
+def test_transport_overrides_is_reentrant_and_nests():
+    cm = transport_overrides(quorum=[0, 1])
+    with cm:
+        assert current_transport_overrides()[0] == [0, 1]
+        with cm:  # re-entering the SAME instance
+            assert current_transport_overrides()[0] == [0, 1]
+            with transport_overrides(transport_label="dcn"):
+                assert current_transport_overrides() == ([0, 1], "dcn")
+            assert current_transport_overrides() == ([0, 1], None)
+        assert current_transport_overrides()[0] == [0, 1]
+    assert current_transport_overrides() == (None, None)
+
+
+def test_transport_overrides_validates_eagerly():
+    with pytest.raises((TypeError, ValueError)):
+        transport_overrides(quorum=["zero", object()])
+    # nothing installed by the failed construction
+    assert current_transport_overrides() == (None, None)
+
+
+def test_applied_transport_overrides_propagates_to_helper_thread():
+    seen = {}
+    with transport_overrides(quorum=[1, 2], transport_label="dcn"):
+        snap = current_transport_overrides()
+
+        def helper():
+            seen["before"] = current_transport_overrides()
+            with applied_transport_overrides(snap):
+                seen["inside"] = current_transport_overrides()
+            seen["after"] = current_transport_overrides()
+
+        th = threading.Thread(target=helper)
+        th.start()
+        th.join()
+    assert seen["before"] == (None, None)
+    assert seen["inside"] == ([1, 2], "dcn")
+    assert seen["after"] == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# async engine: quorum forms a true subgroup
+# ---------------------------------------------------------------------------
+
+
+def test_async_quorum_runs_through_subgroup_transport(monkeypatch):
+    """With degraded peers flagged and a subgroup channel registered, the
+    quorum policy's gather rounds span only the healthy peers."""
+    from metrics_tpu.utilities.async_sync import AsyncSyncEngine
+
+    channel = SimSubgroupChannel()
+    engine_holder = {}
+
+    def make_rank(rank):
+        def run():
+            if rank == 0:
+                import metrics_tpu.utilities.async_sync as async_mod
+                from tests.helpers import transports as sim
+
+                monkeypatch.setattr(async_mod, "_degraded", lambda: [3])
+                engine = AsyncSyncEngine()
+                engine_holder["engine"] = engine
+
+                def thunk():
+                    # the engine's WORKER thread issues the gather: give it
+                    # rank 0's identity in the simulated world
+                    sim._RANK_OF_THREAD[threading.get_ident()] = 0
+                    return sorted(
+                        float(np.asarray(x)[0])
+                        for x in gather_all_arrays(jnp.asarray([0.0]))
+                    )
+
+                fut = engine.submit("k", thunk, on_degraded="quorum")
+                return fut.result(timeout=30)
+            # healthy peers join the engine-issued subgroup round directly
+            sub = GatherTransport().subgroup([0, 1, 2])
+            out = sub.gather_pytrees([{"v": jnp.asarray([float(rank)])}])
+            return sorted(float(np.asarray(x)[0]) for x in out[0]["v"])
+
+        return run
+
+    results, errors, calls = run_rank_fns(
+        [make_rank(r) for r in range(4)], subgroup_channel=channel, dead=[3]
+    )
+    assert errors[:3] == [None] * 3, errors
+    assert results[0] == [0.0, 1.0, 2.0]
+    assert calls == [0, 0, 0, 0], calls  # no global round anywhere
+    engine_holder["engine"].shutdown()
+
+
+# ---------------------------------------------------------------------------
+# dispatcher zero-behavior-change guarantees
+# ---------------------------------------------------------------------------
+
+
+def test_in_graph_transport_lowering_is_byte_identical():
+    """sync_state_packed through an installed InGraphTransport traces the
+    SAME jaxpr as a direct engine call — the zero-overhead seam contract."""
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.utilities.distributed import (
+        _sync_state_packed_impl,
+        shard_map_compat,
+        sync_state_packed,
+    )
+
+    state = {"a": jnp.asarray([1.0, 2.0]), "n": jnp.asarray(3, jnp.int32)}
+    reductions = {"a": "sum", "n": "max"}
+    mesh = Mesh(np.array(jax.devices()[:1]), ("procs",))
+
+    def trace(fn):
+        body = shard_map_compat(
+            lambda s: fn(s, reductions, "procs"), mesh=mesh, in_specs=(P(),), out_specs=P()
+        )
+        return str(jax.make_jaxpr(body)(state))
+
+    direct = trace(_sync_state_packed_impl)
+    with use_transport(InGraphTransport()):
+        seamed = trace(sync_state_packed)
+    assert direct == seamed
+
+
+def test_gather_transport_default_equals_module_function():
+    def make_rank(rank):
+        def run():
+            tree = {"v": jnp.asarray([float(rank)] * (rank + 1))}
+            with use_transport(GatherTransport()):
+                a = gather_all_pytrees([tree])
+            b = dist_mod._gather_pytrees_impl([tree])
+            return a, b
+
+        return run
+
+    results, errors, _ = run_rank_fns([make_rank(r) for r in range(2)])
+    assert errors == [None, None]
+    for a, b in results:
+        for x, y in zip(a[0]["v"], b[0]["v"]):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_base_transport_interface_defaults():
+    t = Transport()
+    assert t.participants is None
+    assert t.subgroup([0]) is t
+    assert t.reduce_states({}, {}) is None
+    assert "Transport" in repr(GatherTransport(participants=[1]))
+
+
+# ---------------------------------------------------------------------------
+# KV-store subgroup channel (coordination-service runtimes)
+# ---------------------------------------------------------------------------
+
+
+def test_kvstore_subgroup_allgather_with_fake_client(monkeypatch):
+    """The KV-store channel publishes under deterministic (peer-set, round,
+    rank) keys and point-reads only its co-participants — exercised against
+    a fake coordination-service client."""
+    from jax._src import distributed as jax_distributed
+
+    from metrics_tpu.transport.gather import kvstore_subgroup_allgather
+
+    store = {}
+
+    class FakeClient:
+        def key_value_set(self, key, value):
+            store[key] = value
+
+        def blocking_key_value_get(self, key, timeout_ms):
+            assert key in store, f"would block forever on {key}"
+            return store[key]
+
+        def key_value_delete(self, key):
+            store.pop(key, None)
+
+    monkeypatch.setattr(jax_distributed.global_state, "client", FakeClient(), raising=False)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+
+    # peers 0 and 2 already published their buffers for this round
+    me = np.arange(4, dtype=np.uint8)
+    import base64
+
+    for rank, payload in ((0, b"\x10\x11\x12\x13"), (2, b"\x20\x21\x22\x23")):
+        store[f"mtpu_subgroup/0-1-2/0/{rank}"] = base64.b64encode(payload).decode()
+    out = kvstore_subgroup_allgather(me, [2, 0, 1])
+    assert out.shape == (3, 4)
+    np.testing.assert_array_equal(out[1], me)
+    np.testing.assert_array_equal(out[0], np.frombuffer(b"\x10\x11\x12\x13", np.uint8))
+    np.testing.assert_array_equal(out[2], np.frombuffer(b"\x20\x21\x22\x23", np.uint8))
+    # a rank outside the peer set (a dead process) was never read
+    assert not any(k.endswith("/3") for k in store)
+
+
+def test_kvstore_subgroup_allgather_requires_runtime(monkeypatch):
+    from jax._src import distributed as jax_distributed
+
+    from metrics_tpu.transport.gather import kvstore_subgroup_allgather
+
+    monkeypatch.setattr(jax_distributed.global_state, "client", None, raising=False)
+    with pytest.raises(RuntimeError, match="jax.distributed"):
+        kvstore_subgroup_allgather(np.zeros(2, np.uint8), [0, 1])

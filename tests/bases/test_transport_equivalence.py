@@ -1,0 +1,246 @@
+"""Transport-equivalence fuzz suite (the acceptance criterion).
+
+The same random state bundle synced through every backend must agree:
+
+* **in-graph** (packed ``jax.lax`` collectives over a mesh axis),
+* **gather** (eager descriptor+payload byte rounds over simulated ranks),
+* **sharded** (in-place ``shard_map`` reduction across a replica axis),
+* **loopback** (the world-1 identity backend),
+
+bit-identical for integer and extremal (max/min) reductions and for
+gathers/cat, and within 1 ulp for rounding float sums (reassociation across
+backends). Runs on the virtual 8-device mesh; the gather backend runs on
+the N-thread simulated transport.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from metrics_tpu.transport import GatherTransport, LoopbackTransport, ShardedTransport
+from metrics_tpu.utilities.distributed import (
+    _sync_state_packed_impl,
+    shard_map_compat,
+)
+from tests.helpers.transports import run_rank_fns
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh"
+)
+
+N_BUNDLES = int(os.environ.get("METRICS_TPU_FUZZ_SEEDS", "40")) // 4 or 1
+
+#: (reduction, dtype) space the fuzzer draws leaves from
+_LEAF_SPACE = [
+    ("sum", np.int32),
+    ("sum", np.int64),
+    ("sum", np.float32),
+    ("sum", np.float64),
+    ("max", np.int32),
+    ("max", np.float32),
+    ("min", np.int64),
+    ("min", np.float64),
+    ("cat", np.float32),
+    ("cat", np.int32),
+    (None, np.float32),
+]
+
+
+def _random_bundle(rng, world):
+    """Per-rank states + reductions: a dict of leaves with random shapes,
+    every rank holding the same layout (the in-graph/sharded contract)."""
+    reductions, per_rank = {}, [dict() for _ in range(world)]
+    n_leaves = rng.randint(2, 6)
+    picks = [  # at least one int sum and one float sum per bundle
+        _LEAF_SPACE[rng.randint(len(_LEAF_SPACE))] for _ in range(n_leaves)
+    ] + [("sum", np.int64), ("sum", np.float32)]
+    for j, (fx, dtype) in enumerate(picks):
+        name = f"leaf{j}_{fx}_{np.dtype(dtype).name}"
+        reductions[name] = fx
+        shape = tuple(rng.randint(1, 5) for _ in range(rng.randint(0, 3)))
+        for r in range(world):
+            if np.issubdtype(dtype, np.integer):
+                value = rng.randint(-1000, 1000, size=shape).astype(dtype)
+            else:
+                # exactly-representable dyadic rationals: float sums are then
+                # order-independent, so every backend must agree BIT for bit
+                # (the <=1-ulp rounding claim gets its own dedicated test)
+                value = (rng.randint(-8000, 8000, size=shape) / 8.0).astype(dtype)
+            per_rank[r][name] = value
+    return reductions, per_rank
+
+
+def _sync_in_graph(per_rank, reductions, world):
+    """The reference lowering: packed collectives over a ``world``-device
+    mesh axis."""
+    stacked = {
+        k: jnp.stack([jnp.asarray(per_rank[r][k]) for r in range(world)])
+        for k in per_rank[0]
+    }
+    mesh = Mesh(np.array(jax.devices()[:world]), ("procs",))
+
+    def body(state):
+        state = {k: jnp.squeeze(v, 0) for k, v in state.items()}
+        return _sync_state_packed_impl(state, reductions, "procs")
+
+    fn = jax.jit(shard_map_compat(body, mesh=mesh, in_specs=(P("procs"),), out_specs=P()))
+    return {k: np.asarray(v) for k, v in fn(stacked).items()}
+
+
+def _sync_gather(per_rank, reductions, world):
+    """The eager byte transport over ``world`` simulated ranks, host-reduced
+    exactly as ``Metric._apply_gathered_states`` reduces tensor states."""
+
+    def make_rank(rank):
+        def run():
+            tree = {k: jnp.asarray(v) for k, v in per_rank[rank].items()}
+            gathered = GatherTransport().gather_pytrees([tree])[0]
+            out = {}
+            for name, fx in reductions.items():
+                members = np.stack([np.asarray(m) for m in gathered[name]])
+                if fx == "sum":
+                    out[name] = members.sum(axis=0, dtype=members.dtype)
+                elif fx == "max":
+                    out[name] = members.max(axis=0)
+                elif fx == "min":
+                    out[name] = members.min(axis=0)
+                elif fx == "cat":
+                    out[name] = np.concatenate(
+                        [np.atleast_1d(m) for m in members], axis=0
+                    )
+                else:  # None: the stacked (world, ...) gather
+                    out[name] = members
+            return out
+
+        return run
+
+    results, errors, _ = run_rank_fns([make_rank(r) for r in range(world)])
+    assert errors == [None] * world, errors
+    return results
+
+
+def _sync_sharded(per_rank, reductions, world):
+    """Per-rank partials reduced IN PLACE by the real sharded backend on a
+    ``(replica=world, shard)`` mesh: device ``(i, j)`` holds replica i's
+    partial (its shard-j slice when the leading dim divides), and
+    ``ShardedTransport.reduce_states`` folds the replicas — elementwise
+    reductions only, the backend's native domain."""
+    shard = 8 // world
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(world, shard), ("replica", "shard"))
+    t = ShardedTransport(mesh, "shard", replica_axis="replica")
+    elem = {k: fx for k, fx in reductions.items() if fx in ("sum", "max", "min")}
+
+    coords = {}  # device -> its (replica, shard) mesh coordinates
+    for i in range(world):
+        for j in range(shard):
+            coords[mesh.devices[i, j]] = (i, j)
+
+    state = {}
+    for name in elem:
+        shape = per_rank[0][name].shape
+        sharding = t.sharding_for(per_rank[0][name])
+        index_map = sharding.addressable_devices_indices_map(shape)
+        pieces = [
+            jax.device_put(jnp.asarray(per_rank[coords[d][0]][name][idx]), d)
+            for d, idx in index_map.items()
+        ]
+        state[name] = jax.make_array_from_single_device_arrays(shape, sharding, pieces)
+
+    out = t.reduce_states(state, elem)
+    assert set(out) == set(elem)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _assert_close(name, got, want):
+    got, want = np.asarray(got), np.asarray(want)
+    assert got.shape == want.shape, (name, got.shape, want.shape)
+    assert got.dtype == want.dtype, (name, got.dtype, want.dtype)
+    if np.issubdtype(got.dtype, np.integer):
+        np.testing.assert_array_equal(got, want, err_msg=name)
+    else:
+        np.testing.assert_array_max_ulp(got, want, maxulp=1)
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("seed", range(N_BUNDLES))
+def test_gather_and_sharded_match_in_graph(world, seed):
+    """Fuzz: the eager gather backend and the sharded replica reduction
+    agree with the in-graph packed lowering — bit-identical for
+    integer/extremal reductions, <=1 ulp for rounding float sums."""
+    rng = np.random.RandomState(1000 * world + seed)
+    reductions, per_rank = _random_bundle(rng, world)
+
+    want = _sync_in_graph(per_rank, reductions, world)
+    via_gather = _sync_gather(per_rank, reductions, world)
+    for rank in range(world):
+        for name in reductions:
+            _assert_close(f"gather[{rank}]:{name}", via_gather[rank][name], want[name])
+
+    via_sharded = _sync_sharded(per_rank, reductions, world)
+    for name in via_sharded:
+        _assert_close(f"sharded:{name}", via_sharded[name], want[name])
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_float_sum_reassociation_within_one_ulp(world):
+    """Rounding float sums: the gather backend's host reduction and the
+    sharded replica psum stay within 1 ulp of the in-graph lowering
+    (positive same-scale values — the documented reassociation bound)."""
+    rng = np.random.RandomState(world)
+    reductions = {"fsum32": "sum", "fsum64": "sum"}
+    per_rank = [
+        {
+            "fsum32": (rng.rand(16) + 0.5).astype(np.float32),
+            "fsum64": (rng.rand(16) + 0.5).astype(np.float64),
+        }
+        for _ in range(world)
+    ]
+    want = _sync_in_graph(per_rank, reductions, world)
+    via_gather = _sync_gather(per_rank, reductions, world)
+    for name in reductions:
+        np.testing.assert_array_max_ulp(via_gather[0][name], want[name], maxulp=1)
+    via_sharded = _sync_sharded(per_rank, reductions, world)
+    for name in reductions:
+        np.testing.assert_array_max_ulp(via_sharded[name], want[name], maxulp=1)
+
+
+@pytest.mark.parametrize("seed", range(N_BUNDLES))
+def test_loopback_matches_in_graph_world1(seed):
+    """Fuzz at world 1: the loopback identity backend is bit-identical to
+    the packed engine over a single-device axis AND to the world-1 eager
+    protocol, for every reduction kind including list states."""
+    rng = np.random.RandomState(seed)
+    reductions, per_rank = _random_bundle(rng, 1)
+    # add list states (incl. an empty one): loopback's cat semantics
+    reductions["rows_cat"] = "cat"
+    per_rank[0]["rows_cat"] = [
+        rng.randn(rng.randint(1, 4)).astype(np.float32) for _ in range(rng.randint(1, 3))
+    ]
+    reductions["rows_empty"] = "cat"
+    per_rank[0]["rows_empty"] = []
+
+    state = {
+        k: ([jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v))
+        for k, v in per_rank[0].items()
+    }
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("procs",))
+    body = shard_map_compat(
+        lambda s: _sync_state_packed_impl(s, reductions, "procs"),
+        mesh=mesh, in_specs=(P(),), out_specs=P(),
+    )
+    want = body(state)
+    got = LoopbackTransport().sync_state_packed(state, reductions, "procs")
+
+    for name in reductions:
+        g, w = got[name], want[name]
+        if isinstance(w, list):
+            assert isinstance(g, list) and len(g) == len(w), name
+            for gi, wi in zip(g, w):
+                _assert_close(name, gi, wi)
+        else:
+            _assert_close(name, g, w)

@@ -13,6 +13,7 @@ from metrics_tpu.functional.classification.masked_curves import (
     masked_binary_average_precision,
 )
 from tests.conftest import NUM_DEVICES
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 _rng = np.random.RandomState(17)
 
@@ -100,7 +101,7 @@ class TestCapacityMode:
             return metric.apply_compute(state, axis_name="data")
 
         fn = jax.jit(
-            jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+            shard_map_compat(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
         )
         value = float(
             fn(
@@ -273,7 +274,7 @@ class TestMulticlassCapacity:
             return metric.apply_compute(state, axis_name="data")
 
         fn = jax.jit(
-            jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+            shard_map_compat(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
         )
         value = float(fn(
             jax.device_put(jnp.asarray(probs), NamedSharding(mesh, P("data"))),
@@ -339,7 +340,7 @@ class TestMulticlassCapacity:
             return metric.apply_compute(state, axis_name="data")
 
         fn = jax.jit(
-            jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+            shard_map_compat(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
         )
         value = float(fn(
             jax.device_put(jnp.asarray(probs), NamedSharding(mesh, P("data"))),

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import apply_to_collection
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 NUM_PROCESSES = 2
 NUM_BATCHES = 10
@@ -82,7 +83,7 @@ def sharded_compute(metric: Metric, rank_metrics: Sequence[Metric]) -> Any:
 
         # check_vma=False: lax.all_gather outputs are semantically replicated but
         # the varying-manual-axes checker can't prove it statically
-        fn = jax.jit(jax.shard_map(_compute, mesh=mesh, in_specs=P("procs"), out_specs=P(), check_vma=False))
+        fn = jax.jit(shard_map_compat(_compute, mesh=mesh, in_specs=P("procs"), out_specs=P(), check_vma=False))
         return fn(stacked)
 
     # curve-style metrics (dynamic epoch-end math): collectives in-graph,
@@ -92,7 +93,7 @@ def sharded_compute(metric: Metric, rank_metrics: Sequence[Metric]) -> Any:
         state = jax.tree.map(lambda x: jnp.squeeze(x, 0), state)
         return metric.sync_state(state, "procs")
 
-    fn = jax.jit(jax.shard_map(_sync, mesh=mesh, in_specs=P("procs"), out_specs=P(), check_vma=False))
+    fn = jax.jit(shard_map_compat(_sync, mesh=mesh, in_specs=P("procs"), out_specs=P(), check_vma=False))
     synced = fn(stacked)
     return metric.apply_compute(synced)
 

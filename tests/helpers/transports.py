@@ -1,0 +1,143 @@
+"""Shared in-process transport simulation harness.
+
+``run_rank_fns`` (generalized from tests/bases/test_packed_gather.py) runs
+one callable per simulated rank over a barrier-backed fake
+``_process_allgather`` — the N-thread stand-in for N JAX processes that the
+packed-gather, async-sync and transport suites all use.
+
+``SimSubgroupChannel`` adds the missing piece for TRUE subgroup testing: a
+participant-set-scoped rendezvous (only the named ranks meet; a dead peer
+outside the set is never contacted, and the channel records exactly which
+ranks each round touched, so tests can assert the peer set).
+"""
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import metrics_tpu.utilities.distributed as dist_mod
+from metrics_tpu.transport.gather import set_subgroup_allgather
+
+
+class SimSubgroupChannel:
+    """In-process subgroup byte-exchange: ranks rendezvous per participant
+    set. ``rounds`` records ``(participants, touched_ranks)`` per exchange —
+    the acceptance evidence that a quorum round touched only healthy
+    peers."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._slots: Dict[Tuple, Dict[int, np.ndarray]] = {}
+        self._seq: Dict[Tuple, int] = {}
+        self.rounds: List[Tuple[Tuple[int, ...], Tuple[int, ...]]] = []
+
+    def __call__(self, buf: np.ndarray, participants: List[int]) -> np.ndarray:
+        rank = _rank_of_current_thread()
+        want = tuple(sorted(int(p) for p in participants))
+        assert rank in want, f"non-participant rank {rank} entered subgroup round {want}"
+        with self._cv:
+            seq = self._seq.get(want, 0)
+            key = (want, seq)
+            slot = self._slots.setdefault(key, {})
+            slot[rank] = np.asarray(buf).copy()
+            if len(slot) == len(want):
+                self._seq[want] = seq + 1
+                self.rounds.append((want, tuple(sorted(slot))))
+                self._cv.notify_all()
+            else:
+                deadline = time.monotonic() + 30.0
+                while len(self._slots.get(key, {})) < len(want):
+                    remaining = deadline - time.monotonic()
+                    assert remaining > 0, f"subgroup round {key} timed out waiting for peers"
+                    self._cv.wait(remaining)
+            stacked = np.stack([self._slots[key][r] for r in want])
+        return stacked
+
+
+_RANK_OF_THREAD: Dict[int, int] = {}
+
+
+def _rank_of_current_thread() -> int:
+    return _RANK_OF_THREAD[threading.get_ident()]
+
+
+def run_rank_fns(
+    fns: List[Callable],
+    *,
+    subgroup_channel: Optional[SimSubgroupChannel] = None,
+    dead: Optional[List[int]] = None,
+):
+    """Run one callable per simulated rank over a barrier-backed fake
+    ``_process_allgather``; returns ``(results, errors, transport_calls)``.
+
+    ``dead`` names ranks whose callables are never started — with a
+    ``subgroup_channel`` installed, subgroup rounds among the LIVE ranks
+    complete anyway (the acceptance property); any global round would hang
+    (and trip the barrier timeout), which is exactly what the legacy path
+    does on a dead peer.
+    """
+    nprocs = len(fns)
+    dead = sorted(set(dead or []))
+    live = [r for r in range(nprocs) if r not in dead]
+    barrier = threading.Barrier(nprocs - len(dead))
+    exchange: Dict[int, np.ndarray] = {}
+    lock = threading.Lock()
+    calls = [0] * nprocs
+
+    def fake_allgather(x):
+        rank = _rank_of_current_thread()
+        calls[rank] += 1
+        with lock:
+            exchange[rank] = np.asarray(x)
+        barrier.wait(timeout=30)
+        stacked = np.stack([exchange[r] for r in range(nprocs)])
+        barrier.wait(timeout=30)  # all read before the dict is reused
+        return stacked
+
+    results = [None] * nprocs
+    errors = [None] * nprocs
+
+    def worker(rank):
+        _RANK_OF_THREAD[threading.get_ident()] = rank
+        try:
+            results[rank] = fns[rank]()
+        except Exception as err:  # surfaced to the test
+            errors[rank] = err
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                if all(
+                    results[r] is not None or errors[r] is not None for r in live
+                ):
+                    return
+                time.sleep(0.01)
+            barrier.abort()
+
+    orig = (
+        dist_mod._process_allgather,
+        dist_mod.distributed_available,
+        dist_mod.world_size,
+        dist_mod.jax.process_index,
+    )
+    dist_mod._process_allgather = fake_allgather
+    dist_mod.distributed_available = lambda: True
+    dist_mod.world_size = lambda: nprocs
+    dist_mod.jax.process_index = lambda: _RANK_OF_THREAD[threading.get_ident()]
+    prev_channel = set_subgroup_allgather(subgroup_channel) if subgroup_channel else None
+    try:
+        threads = [threading.Thread(target=worker, args=(r,)) for r in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        if subgroup_channel:
+            set_subgroup_allgather(prev_channel)
+        (
+            dist_mod._process_allgather,
+            dist_mod.distributed_available,
+            dist_mod.world_size,
+            dist_mod.jax.process_index,
+        ) = orig
+    return results, errors, calls

@@ -20,6 +20,7 @@ from metrics_tpu.image.inception_net import (
     resolve_feature_extractor,
 )
 from metrics_tpu.image.kid import poly_mmd
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 _rng = np.random.RandomState(11)
 
@@ -254,7 +255,7 @@ class TestFIDStreaming:
             return metric.apply_compute(state, axis_name="data")
 
         fn = jax.jit(
-            jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+            shard_map_compat(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
         )
         value = float(fn(
             jax.device_put(real, NamedSharding(mesh, P("data"))),

@@ -16,6 +16,7 @@ import optax
 
 from metrics_tpu import Accuracy, AverageMeter, F1, Metric, MetricCollection, Precision, Recall
 from tests.conftest import NUM_DEVICES
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 NUM_CLASSES = 4
 BATCH = 32
@@ -156,7 +157,7 @@ def test_distributed_train_loop_matches_single_process():
         return metrics.apply_compute(state, axis_name="data")
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             shard_step,
             mesh=mesh,
             in_specs=(P("data"), P("data")),

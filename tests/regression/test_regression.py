@@ -37,6 +37,7 @@ from metrics_tpu.functional import (
 )
 from tests.helpers.testers import MetricTester
 from tests.regression.inputs import NUM_OUTPUTS, _multi_target_inputs, _single_target_inputs
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 
 def _sk_mape(preds, target):
@@ -309,7 +310,7 @@ def test_pearson_streaming_sharded():
         return metric.apply_compute(state, axis_name="data")
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        shard_map_compat(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
     )
     value = float(fn(
         jax.device_put(preds, NamedSharding(mesh, P("data"))),
@@ -485,7 +486,7 @@ def test_spearman_capacity_sharded():
         return metric.apply_compute(state, axis_name="data")
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        shard_map_compat(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
     )
     value = float(fn(
         jax.device_put(jnp.asarray(preds), NamedSharding(mesh, P("data"))),

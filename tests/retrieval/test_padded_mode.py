@@ -15,6 +15,7 @@ from metrics_tpu import (
     RetrievalRecall,
 )
 from tests.conftest import NUM_DEVICES
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 _rng = np.random.RandomState(23)
 ALL_CLASSES = [
@@ -138,7 +139,7 @@ def test_padded_sharded_compute():
         return metric.apply_compute(state, axis_name="data")
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        shard_map_compat(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
     )
     value = float(fn(
         jax.device_put(jnp.asarray(preds), NamedSharding(mesh, P("data"))),

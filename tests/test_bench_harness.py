@@ -325,3 +325,51 @@ def test_sketched_state_sync_bench_record_round_trips(monkeypatch):
     assert line["parity"]["abs_delta"] < 5e-3  # the documented tolerance
     assert "telemetry" in line
     assert "bench_sketched_state_sync" in bench_suite.CONFIG_META
+
+
+def test_transport_dispatch_overhead_bench_record_round_trips(monkeypatch):
+    """The transport-seam config's record must survive json round-trips and
+    carry the acceptance evidence: the loopback eager dispatch and the
+    seamed in-graph scan step within noise of the direct engine calls."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "SYNC_STEPS", 50)
+    line = bench_suite.run_config(bench_suite.bench_transport_dispatch_overhead, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "transport_dispatch_overhead" and line["unit"] == "us/call"
+    assert line["eager_within_noise"] is True  # the acceptance pin
+    assert line["in_graph_within_noise"] is True
+    assert line["loopback_dispatch_us"] > 0
+    assert line["direct_engine_us"] > 0
+    assert "telemetry" in line
+    assert "bench_transport_dispatch_overhead" in bench_suite.CONFIG_META
+
+
+def test_sharded_state_sync_bench_record_round_trips(monkeypatch):
+    """The sharded-state config's record must survive json round-trips and
+    carry the acceptance evidence: the confusion-matrix state sharded over
+    every mesh device (max shard fraction == 1/devices — the full state is
+    NEVER materialized on one device), and the giant case either measured
+    with the same property or skipped with an explicit recorded reason."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "SHARDED_CLASSES", 1024)
+    monkeypatch.setattr(bench_suite, "SHARDED_SMALL_CLASSES", 512)
+    line = bench_suite.run_config(bench_suite.bench_sharded_state_sync, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "sharded_state_sync_step" and line["unit"] == "us/step"
+    assert line["devices"] >= 1
+    assert line["small_max_shard_fraction"] <= 1.0 / line["devices"] + 1e-9
+    giant = line["giant"]
+    assert giant["classes"] == 1024
+    assert giant["state_bytes"] == 4 * 1024 * 1024
+    if "skipped" in giant:
+        assert isinstance(giant["skipped"], str) and giant["skipped"]
+    else:
+        assert giant["full_state_on_one_device"] is False  # the acceptance pin
+        assert giant["max_shard_fraction"] <= 1.0 / line["devices"] + 1e-9
+        assert giant["sharded_sync_payload_bytes"] == 0
+        assert giant["replicated_sync_payload_bytes"] == giant["state_bytes"]
+    assert "bench_sharded_state_sync" in bench_suite.CONFIG_META

@@ -346,3 +346,28 @@ def test_committed_multichip_trajectory_passes():
     # ... and the default no-args gate (make bench-regress) judges BOTH
     # committed trajectories green
     assert bench_regress.main(["--check"]) == 0
+
+
+def test_partial_latest_round_still_judges_absent_configs(tmp_path):
+    """A partial newest round (a capture that re-measured only new configs,
+    e.g. BENCH_r06's transport records) must not shrink the judged set: a
+    config absent from it is judged at its newest record anywhere in the
+    trajectory, against the rounds before that record."""
+    paths = [
+        _capture(tmp_path, 1, [_record("old", 10.0), _record("stale_reg", 10.0)]),
+        _capture(tmp_path, 2, [_record("old", 10.5), _record("stale_reg", 10.5)]),
+        _capture(tmp_path, 3, [_record("old", 11.0), _record("stale_reg", 25.0)]),
+        # the partial round: ONLY the new config
+        _capture(tmp_path, 4, [_record("new", 5.0)]),
+    ]
+    rows = bench_regress.check_trajectory(bench_regress.load_trajectory(paths))
+    by_metric = {r["metric"]: r for r in rows}
+    assert set(by_metric) == {"old", "stale_reg", "new"}
+    # "old": newest record is r3, judged against the r1/r2 median — OK
+    assert by_metric["old"]["status"] == bench_regress.OK
+    assert by_metric["old"]["round"] == 3
+    # "stale_reg": its r3 regression is still CAUGHT despite the partial r4
+    assert by_metric["stale_reg"]["status"] == bench_regress.REGRESSED
+    # "new": first appearance — reported, not judged
+    assert by_metric["new"]["status"] == bench_regress.SKIPPED_NO_HISTORY
+    assert bench_regress.main(paths + ["--check"]) == 1

@@ -233,3 +233,51 @@ def test_sketched_states_documented_and_cross_linked():
     ):
         assert phrase in obs, phrase
     assert "performance.md#bounded-memory-sketched-states" in obs
+
+
+def test_transport_layer_documented_and_cross_linked():
+    """The transport strategy seam's user contract lives in two places: the
+    performance guide (backend selection matrix, subgroup semantics,
+    sharded-state sizing guidance) and the observability guide (transport=
+    label values, per-backend round counters, the subgroup peer-set
+    evidence), cross-linked both ways."""
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "## Transport layer" in perf
+    for phrase in (
+        "InGraphTransport",
+        "GatherTransport",
+        "LoopbackTransport",
+        "ShardedTransport",
+        "set_transport",
+        "use_transport",
+        "metric.set_transport",
+        "subgroup",
+        "set_subgroup_allgather",
+        "kvstore_subgroup_allgather",
+        "Backend selection matrix",
+        "Subgroup semantics",
+        "Device-sharded giant states",
+        "Sizing guidance",
+        "shard_state",
+        "reduce_states",
+        "max_shard_fraction",
+        "transport_dispatch_overhead",
+        "sharded_state_sync_step",
+    ):
+        assert phrase in perf, phrase
+    assert "observability.md#transport-telemetry" in perf
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "## Transport telemetry" in obs
+    for phrase in (
+        "`loopback`",
+        "`sharded`",
+        "participants",
+        "subgroup_rounds",
+        "metrics_tpu_sync_subgroup_rounds_total",
+        "metrics_tpu_sync_transport_gathers_total",
+        'on_degraded="quorum"',
+    ):
+        assert phrase in obs, phrase
+    assert "performance.md#transport-layer" in obs

@@ -10,6 +10,7 @@ from sklearn.metrics import precision_score, recall_score
 from metrics_tpu import Precision, Recall
 from metrics_tpu.utilities.data import apply_to_collection
 from metrics_tpu.wrappers.bootstrapping import BootStrapper, _bootstrap_sampler
+from metrics_tpu.utilities.distributed import shard_map_compat
 
 _rng = np.random.RandomState(9)
 _preds = _rng.randint(0, 10, (10, 32))
@@ -216,7 +217,7 @@ class TestPureApi:
             return b.apply_compute(s, axis_name="data")["mean"]
 
         fn = jax.jit(
-            jax.shard_map(run, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P(), check_vma=False)
+            shard_map_compat(run, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P(), check_vma=False)
         )
         p = jnp.asarray(rng.rand(320, 4).astype(np.float32))
         t = jnp.asarray(rng.randint(0, 4, 320))
