@@ -75,9 +75,26 @@ def kvstore_subgroup_allgather(
     Each participant publishes its buffer under a deterministic
     ``(round, rank)`` key and point-reads only its co-participants' keys —
     a dead non-participant is never contacted, which is exactly the
-    property the global ``process_allgather`` cannot offer. Requires an
-    initialized ``jax.distributed`` runtime; raises ``RuntimeError``
-    otherwise (callers treat that as "no channel").
+    property the global ``process_allgather`` cannot offer.
+
+    The channel contract is shape- and dtype-preserving: the raw BYTES of
+    ``buf`` ride the store (a byte view, never a value cast — an int64
+    descriptor survives intact) and the result is the
+    ``(len(participants),) + buf.shape`` stack in ascending rank order
+    with ``buf``'s dtype. Every participant must present an
+    identically-shaped buffer, which the packed gather protocol
+    guarantees (descriptor rounds share one layout; payload rounds pad to
+    the round's max byte length); a peer violating it raises.
+
+    Cleanup is deferred one round: a peer publishes round ``N`` only
+    after its round-``N-1`` reads completed, so entering round ``N``
+    proves every co-participant is done with round ``N-1`` — each rank
+    therefore deletes its own round-``N-1`` key after finishing round
+    ``N``'s reads. (Deleting the round-``N`` key eagerly would race a
+    slower peer into a spurious ``blocking_key_value_get`` timeout.)
+
+    Requires an initialized ``jax.distributed`` runtime; raises
+    ``RuntimeError`` otherwise (callers treat that as "no channel").
     """
     from jax._src import distributed as _jax_distributed
 
@@ -93,22 +110,26 @@ def kvstore_subgroup_allgather(
     with _KV_LOCK:
         seq = _KV_ROUNDS.get(key_set, 0)
         _KV_ROUNDS[key_set] = seq + 1
-    prefix = f"mtpu_subgroup/{'-'.join(map(str, key_set))}/{seq}"
-    flat = np.ascontiguousarray(np.asarray(buf, dtype=np.uint8)).reshape(-1)
-    client.key_value_set(f"{prefix}/{rank}", base64.b64encode(flat.tobytes()).decode())
+    peers = "-".join(map(str, key_set))
+    prefix = f"mtpu_subgroup/{peers}/{seq}"
+    payload = np.ascontiguousarray(buf)
+    client.key_value_set(f"{prefix}/{rank}", base64.b64encode(payload.tobytes()).decode())
     rows = []
     for peer in key_set:
-        raw = client.blocking_key_value_get(f"{prefix}/{peer}", timeout_ms)
-        rows.append(np.frombuffer(base64.b64decode(raw), dtype=np.uint8))
-    width = max((r.size for r in rows), default=0)
-    stacked = np.zeros((len(rows), width), dtype=np.uint8)
-    for i, r in enumerate(rows):
-        stacked[i, : r.size] = r
-    try:  # best-effort cleanup; absent on older runtimes
-        client.key_value_delete(f"{prefix}/{rank}")
-    except Exception:  # pragma: no cover - cleanup is optional
-        pass
-    return stacked
+        raw = base64.b64decode(client.blocking_key_value_get(f"{prefix}/{peer}", timeout_ms))
+        if len(raw) != payload.nbytes:
+            raise RuntimeError(
+                f"kvstore_subgroup_allgather: peer {peer} published {len(raw)} bytes"
+                f" where this rank holds {payload.nbytes}; the subgroup channel"
+                " contract requires identically-shaped buffers per round"
+            )
+        rows.append(np.frombuffer(raw, dtype=payload.dtype).reshape(payload.shape))
+    if seq > 0:  # deferred cleanup (see docstring); absent on older runtimes
+        try:
+            client.key_value_delete(f"mtpu_subgroup/{peers}/{seq - 1}/{rank}")
+        except Exception:  # pragma: no cover - cleanup is optional
+            pass
+    return np.stack(rows)
 
 
 class GatherTransport(Transport):
@@ -143,13 +164,26 @@ class GatherTransport(Transport):
         return list(self._participants) if self._participants is not None else None
 
     def subgroup(self, members: Sequence[int]) -> Transport:
-        members = sorted({int(m) for m in members})
-        if self._participants is not None:
-            members = [m for m in members if m in self._participants]
-        if members == (self._participants or members) and self._participants is not None:
+        requested = sorted({int(m) for m in members})
+        narrowed = (
+            [m for m in requested if m in self._participants]
+            if self._participants is not None
+            else requested
+        )
+        if not narrowed:
+            # a subgroup NEVER widens: an empty request (or one disjoint
+            # from this transport's participants) must not silently fall
+            # back to the full parent set — a quorum round would then span
+            # more peers than the caller asked for
+            raise ValueError(
+                f"subgroup members {requested} do not intersect this transport's"
+                " participants"
+                f" {self._participants if self._participants is not None else '(all processes)'}"
+            )
+        if self._participants is not None and narrowed == self._participants:
             return self
         return GatherTransport(
-            participants=members or self._participants,
+            participants=narrowed,
             label=self.name if self.name != "gather" else None,
         )
 

@@ -191,24 +191,30 @@ class ShardedTransport(Transport):
     def _note_reduce(self, sub: Dict[str, Any], *, identity: bool) -> None:
         """Telemetry for one in-place sharded sync (host-side, never
         raises): a zero-byte transport round labeled ``sharded`` — nothing
-        crosses the process boundary on this path."""
+        crosses the process boundary on this path. The in-place reduction
+        covers the FULL replica dimension, so the round spans every
+        process: participants is the whole world, never a proper subset —
+        it must not count toward ``subgroup_rounds`` (the quorum-acceptance
+        telemetry)."""
         try:
             from metrics_tpu.utilities.distributed import (
                 _record_gather_telemetry,
                 world_size,
             )
 
+            nprocs = max(world_size(), 1)
+            everyone = list(range(nprocs))
             _record_gather_telemetry(
                 bytes_out=0,
                 bytes_in=0,
-                members=list(self.participants or [0]),
-                nprocs=max(world_size(), 1),
+                members=everyone,
+                nprocs=nprocs,
                 leaves=len(sub),
                 desc_bytes=0,
                 max_bytes=0,
                 error=False,
                 transport=self.name if identity else f"{self.name}_reduce",
-                participants=list(self.participants or [0]),
+                participants=everyone,
             )
         except Exception:  # pragma: no cover - telemetry must not break sync
             pass

@@ -276,7 +276,9 @@ class transport_overrides:
     flat sync. Arguments are validated at CONSTRUCTION, before anything is
     installed. Deliberately **thread-local**: the background sync engine's
     worker applies its policy without perturbing inline syncs on other
-    threads; :func:`current_transport_overrides` /
+    threads — the saved-snapshot stack is itself per-thread, so ONE
+    instance entered concurrently from several threads restores each
+    thread's own prior state; :func:`current_transport_overrides` /
     :func:`applied_transport_overrides` propagate a snapshot onto helper
     threads (the engine's per-round-timeout runner).
     """
@@ -286,10 +288,16 @@ class transport_overrides:
     ) -> None:
         self._quorum = sorted({int(i) for i in quorum}) if quorum is not None else None
         self._label = str(transport_label) if transport_label is not None else None
-        self._saved: List[Tuple[Optional[List[int]], Optional[str]]] = []
+        # per-THREAD snapshot stacks: the overrides being restored are
+        # thread-local, so a shared instance list would interleave pushes
+        # and pops across threads and restore the wrong thread's snapshot
+        self._saved = threading.local()
 
     def __enter__(self) -> "transport_overrides":
-        self._saved.append(current_transport_overrides())
+        stack = getattr(self._saved, "stack", None)
+        if stack is None:
+            stack = self._saved.stack = []
+        stack.append(current_transport_overrides())
         if self._quorum is not None:
             _EAGER_OVERRIDES.quorum = self._quorum
         if self._label is not None:
@@ -297,7 +305,7 @@ class transport_overrides:
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
-        prev_quorum, prev_label = self._saved.pop()
+        prev_quorum, prev_label = self._saved.stack.pop()
         _EAGER_OVERRIDES.quorum = prev_quorum
         _EAGER_OVERRIDES.transport_label = prev_label
         return False

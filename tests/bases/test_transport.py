@@ -146,6 +146,17 @@ def test_subgroup_of_auto_and_in_graph_compose():
     assert g.subgroup([0]).participants == [0]
 
 
+def test_gather_subgroup_never_widens_on_empty_intersection():
+    """A degenerate member set must raise, not silently fall back to the
+    wider parent set (a quorum subgroup must never span extra peers)."""
+    with pytest.raises(ValueError, match="do not intersect"):
+        GatherTransport().subgroup([])
+    with pytest.raises(ValueError, match="do not intersect"):
+        GatherTransport(participants=[0, 1]).subgroup([5])
+    # a genuine intersection still narrows
+    assert GatherTransport(participants=[0, 1, 2]).subgroup([1, 5]).participants == [1]
+
+
 # ---------------------------------------------------------------------------
 # loopback semantics
 # ---------------------------------------------------------------------------
@@ -373,6 +384,57 @@ def test_applied_transport_overrides_propagates_to_helper_thread():
     assert seen["after"] == (None, None)
 
 
+def test_transport_overrides_shared_instance_across_threads():
+    """ONE instance entered concurrently from two threads (with crossing
+    exits: A enters, B enters, A exits, B exits) must restore each thread's
+    OWN prior snapshot — a shared push/pop stack would hand A's snapshot to
+    B and vice versa."""
+    cm = transport_overrides(quorum=[7])
+    a_entered, b_entered, a_exited = (threading.Event() for _ in range(3))
+    seen = {}
+    failures = []
+
+    def thread_a():
+        try:
+            with transport_overrides(quorum=[0, 1]):  # A's prior state
+                with cm:
+                    a_entered.set()
+                    assert b_entered.wait(10)
+                    seen["a_inside"] = current_transport_overrides()
+                seen["a_after_cm"] = current_transport_overrides()
+                a_exited.set()
+            seen["a_after_outer"] = current_transport_overrides()
+        except BaseException as err:  # pragma: no cover - surfaced below
+            failures.append(err)
+            a_entered.set()
+            a_exited.set()
+
+    def thread_b():
+        try:
+            assert a_entered.wait(10)
+            with cm:
+                b_entered.set()
+                seen["b_inside"] = current_transport_overrides()
+                assert a_exited.wait(10)
+            seen["b_after"] = current_transport_overrides()
+        except BaseException as err:  # pragma: no cover - surfaced below
+            failures.append(err)
+            b_entered.set()
+
+    ta = threading.Thread(target=thread_a)
+    tb = threading.Thread(target=thread_b)
+    ta.start()
+    tb.start()
+    ta.join(timeout=10)
+    tb.join(timeout=10)
+    assert not failures, failures
+    assert seen["a_inside"][0] == [7]
+    assert seen["b_inside"][0] == [7]
+    assert seen["a_after_cm"][0] == [0, 1]  # A's snapshot, not B's
+    assert seen["a_after_outer"] == (None, None)
+    assert seen["b_after"] == (None, None)  # B's snapshot, not A's
+
+
 # ---------------------------------------------------------------------------
 # async engine: quorum forms a true subgroup
 # ---------------------------------------------------------------------------
@@ -487,28 +549,72 @@ def test_base_transport_interface_defaults():
 # ---------------------------------------------------------------------------
 
 
+class _FakeKVClient:
+    """Non-blocking coordination-service stand-in (single-thread tests)."""
+
+    def __init__(self, store=None):
+        self.store = store if store is not None else {}
+
+    def key_value_set(self, key, value):
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        assert key in self.store, f"would block forever on {key}"
+        return self.store[key]
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+
+class _BlockingKVClient(_FakeKVClient):
+    """Thread-safe stand-in whose gets genuinely block until the key is
+    published — what the multi-threaded integration round needs."""
+
+    def __init__(self):
+        super().__init__()
+        self._cv = threading.Condition()
+
+    def key_value_set(self, key, value):
+        with self._cv:
+            self.store[key] = value
+            self._cv.notify_all()
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        import time
+
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while key not in self.store:
+                remaining = deadline - time.monotonic()
+                assert remaining > 0, f"timed out waiting for {key}"
+                self._cv.wait(remaining)
+            return self.store[key]
+
+    def key_value_delete(self, key):
+        with self._cv:
+            self.store.pop(key, None)
+
+
+def _install_kv_client(monkeypatch, client):
+    """Patch the coordination-service client in and reset the module-global
+    round counter so each test sees a deterministic round 0."""
+    from jax._src import distributed as jax_distributed
+
+    from metrics_tpu.transport import gather as gather_mod
+
+    monkeypatch.setattr(jax_distributed.global_state, "client", client, raising=False)
+    monkeypatch.setattr(gather_mod, "_KV_ROUNDS", {})
+
+
 def test_kvstore_subgroup_allgather_with_fake_client(monkeypatch):
     """The KV-store channel publishes under deterministic (peer-set, round,
     rank) keys and point-reads only its co-participants — exercised against
     a fake coordination-service client."""
-    from jax._src import distributed as jax_distributed
-
     from metrics_tpu.transport.gather import kvstore_subgroup_allgather
 
-    store = {}
-
-    class FakeClient:
-        def key_value_set(self, key, value):
-            store[key] = value
-
-        def blocking_key_value_get(self, key, timeout_ms):
-            assert key in store, f"would block forever on {key}"
-            return store[key]
-
-        def key_value_delete(self, key):
-            store.pop(key, None)
-
-    monkeypatch.setattr(jax_distributed.global_state, "client", FakeClient(), raising=False)
+    client = _FakeKVClient()
+    store = client.store
+    _install_kv_client(monkeypatch, client)
     monkeypatch.setattr(jax, "process_index", lambda: 1)
 
     # peers 0 and 2 already published their buffers for this round
@@ -534,3 +640,110 @@ def test_kvstore_subgroup_allgather_requires_runtime(monkeypatch):
     monkeypatch.setattr(jax_distributed.global_state, "client", None, raising=False)
     with pytest.raises(RuntimeError, match="jax.distributed"):
         kvstore_subgroup_allgather(np.zeros(2, np.uint8), [0, 1])
+
+
+def test_kvstore_subgroup_allgather_preserves_dtype_and_shape(monkeypatch):
+    """The channel contract is shape/dtype-preserving: an int64 descriptor
+    array with dim sizes >= 256 must ride the store as raw bytes — a uint8
+    VALUE cast would silently corrupt it — and come back as the
+    ``(nslots,) + buf.shape`` stack with the original dtype."""
+    from metrics_tpu.transport.gather import kvstore_subgroup_allgather
+
+    client = _FakeKVClient()
+    _install_kv_client(monkeypatch, client)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+    mine = np.array([[1, 300, 100_000], [2, 70_000, -5]], dtype=np.int64)
+    theirs = np.array([[9, 512, 8], [7, 6, 1 << 40]], dtype=np.int64)
+    import base64
+
+    client.store["mtpu_subgroup/0-2/0/2"] = base64.b64encode(theirs.tobytes()).decode()
+    out = kvstore_subgroup_allgather(mine, [0, 2])
+    assert out.shape == (2,) + mine.shape and out.dtype == np.int64
+    np.testing.assert_array_equal(out[0], mine)
+    np.testing.assert_array_equal(out[1], theirs)
+
+
+def test_kvstore_subgroup_allgather_rejects_mismatched_peer_buffer(monkeypatch):
+    from metrics_tpu.transport.gather import kvstore_subgroup_allgather
+
+    client = _FakeKVClient()
+    _install_kv_client(monkeypatch, client)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    import base64
+
+    client.store["mtpu_subgroup/0-1/0/1"] = base64.b64encode(b"\x01\x02\x03").decode()
+    with pytest.raises(RuntimeError, match="identically-shaped"):
+        kvstore_subgroup_allgather(np.zeros(4, np.uint8), [0, 1])
+
+
+def test_kvstore_subgroup_allgather_defers_own_key_cleanup(monkeypatch):
+    """A rank must NOT delete its round-N key at the end of round N (a
+    slower peer may still need to read it); it deletes its round-(N-1) key
+    after round N's reads prove every peer finished round N-1."""
+    from metrics_tpu.transport.gather import kvstore_subgroup_allgather
+
+    client = _FakeKVClient()
+    _install_kv_client(monkeypatch, client)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+
+    kvstore_subgroup_allgather(np.arange(3, dtype=np.uint8), [1])
+    # the just-published key survives the round
+    assert sorted(client.store) == ["mtpu_subgroup/1/0/1"]
+    kvstore_subgroup_allgather(np.arange(3, dtype=np.uint8), [1])
+    # round 1 cleaned round 0's key; round 1's own key still readable
+    assert sorted(client.store) == ["mtpu_subgroup/1/1/1"]
+
+
+def test_kvstore_channel_runs_full_gather_round(monkeypatch):
+    """Integration: kvstore_subgroup_allgather registered as the subgroup
+    channel carries a complete descriptor+payload _gather_all_leaves round
+    among the healthy peers of a 4-rank world with rank 3 dead — including
+    leaves whose dim sizes exceed 255 (the uint8-cast corruption pin)."""
+    from metrics_tpu.transport import gather as gather_mod
+    from metrics_tpu.transport.gather import kvstore_subgroup_allgather
+
+    client = _BlockingKVClient()
+    _install_kv_client(monkeypatch, client)
+
+    class _PerThreadRounds(dict):
+        """In production each PROCESS owns its round counters; the threaded
+        rank simulation must not share them, so namespace by thread."""
+
+        def get(self, key, default=0):
+            return super().get((threading.get_ident(), key), default)
+
+        def __setitem__(self, key, value):
+            super().__setitem__((threading.get_ident(), key), value)
+
+    monkeypatch.setattr(gather_mod, "_KV_ROUNDS", _PerThreadRounds())
+    healthy = [0, 1, 2]
+
+    def make_rank(rank):
+        def run():
+            sub = GatherTransport().subgroup(healthy)
+            tree = {
+                "big": jnp.arange(300 + rank, dtype=jnp.float32) + rank,
+                "n": jnp.asarray(rank, jnp.int32),
+            }
+            out = sub.gather_pytrees([tree])
+            return out[0]
+
+        return run
+
+    results, errors, calls = run_rank_fns(
+        [make_rank(r) for r in range(4)],
+        subgroup_channel=kvstore_subgroup_allgather,
+        dead=[3],
+    )
+    assert errors[:3] == [None] * 3, errors
+    assert calls == [0, 0, 0, 0], calls  # the global primitive never ran
+    for r in healthy:
+        got = results[r]
+        assert [int(np.asarray(x)) for x in got["n"]] == healthy
+        for peer, big in zip(healthy, got["big"]):
+            want = np.arange(300 + peer, dtype=np.float32) + peer
+            np.testing.assert_array_equal(np.asarray(big), want)
+    # deferred cleanup: the payload round (seq 1) deleted the descriptor
+    # round's (seq 0) keys; the final round's keys remain readable
+    assert sorted(client.store) == [f"mtpu_subgroup/0-1-2/1/{r}" for r in healthy]

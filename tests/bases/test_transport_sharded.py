@@ -126,15 +126,22 @@ def test_metric_end_to_end_sharded_confusion_matrix():
     assert t.max_shard_fraction(sharded.confmat) == pytest.approx(1 / 8)
 
 
-def test_sharded_sync_records_transport_telemetry():
+def test_sharded_sync_records_transport_telemetry(monkeypatch):
     from metrics_tpu import observability
+    import metrics_tpu.utilities.distributed as dist_mod
 
     observability.reset()
+    # simulate a 4-process fleet: the in-place reduce spans the WHOLE world,
+    # so it must report the full participant set and never count as a
+    # subgroup round (it would otherwise pollute the quorum telemetry)
+    monkeypatch.setattr(dist_mod, "world_size", lambda: 4)
     t = ShardedTransport(_mesh_1d(), "shard")
     state = t.shard_state({"confmat": jnp.ones((64, 64), jnp.float32)})
     t.reduce_states(state, {"confmat": "sum"})
     snap = observability.snapshot()
     assert snap["sync"]["transports"].get("sharded", 0) >= 1
+    assert snap["sync"]["participants"]["sharded"] == [0, 1, 2, 3]
+    assert snap["sync"]["subgroup_rounds"] == 0
 
 
 def test_sharded_confusion_sync_collective_counts():
