@@ -1,6 +1,6 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check
+.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check soak
 
 ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check
 
@@ -61,6 +61,14 @@ bench-regress:
 # Full benchmark suite on the default backend (the real TPU chip under axon).
 bench:
 	python bench.py
+
+# Serving-layer soak (scripts/soak.py): sustained synthetic QPS over 10k
+# tenants for 60 s, p50/p99 ingest latency + the zero-lost-updates invariant
+# (rows submitted - rows shed == rows ingested into tenant state, exactly).
+# Exit 1 if the accounting invariant is violated. CPU-safe; the CI smoke leg
+# runs a short variant via bench_suite.py --config bench_serving_soak.
+soak:
+	JAX_PLATFORMS=cpu python scripts/soak.py --out SOAK.json
 
 # Convert a torchvision Inception3 checkpoint into the .npz the Flax
 # extractor loads: make export-weights CKPT=inception_v3.pth OUT=weights.npz

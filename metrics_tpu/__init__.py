@@ -70,11 +70,14 @@ from metrics_tpu.retrieval import (  # noqa: F401 E402
     RetrievalRecall,
 )
 from metrics_tpu.wrappers import BootStrapper, KeyedMetric, MultiTenantCollection  # noqa: F401 E402
+from metrics_tpu import serving  # noqa: F401 E402
+from metrics_tpu.serving import AdmissionQueue, SLOScheduler  # noqa: F401 E402
 
 __all__ = [
     "AUC",
     "AUROC",
     "Accuracy",
+    "AdmissionQueue",
     "AverageMeter",
     "AveragePrecision",
     "BinnedAveragePrecision",
@@ -122,6 +125,7 @@ __all__ = [
     "RetrievalRecall",
     "SI_SDR",
     "SI_SNR",
+    "SLOScheduler",
     "SNR",
     "SSIM",
     "Specificity",

@@ -114,10 +114,13 @@ def disable() -> None:
 
 def reset() -> None:
     """Clear all recorded counters, timers, sync stats, retrace ledgers,
-    events, histograms, collective spans, async-sync engine counters, and
-    health records (enablement, policy, step tag survive). Span-id sequence
-    counters and async generations reset too — like any collective, reset
-    on every process together or on none."""
+    events, histograms, collective spans, async-sync engine counters,
+    serving-plane counters, and health records (enablement, policy, step
+    tag survive). Span-id sequence counters and async generations reset
+    too — like any collective, reset on every process together or on
+    none."""
+    import sys as _sys
+
     TELEMETRY.reset()
     MONITOR.reset()
     EVENTS.clear()
@@ -128,6 +131,9 @@ def reset() -> None:
 
     if _async_sync._ENGINE is not None:
         _async_sync._ENGINE.reset()
+    serving_mod = _sys.modules.get("metrics_tpu.serving.telemetry")
+    if serving_mod is not None:
+        serving_mod.SERVING_STATS.reset()
 
 
 __all__ = [

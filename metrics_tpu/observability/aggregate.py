@@ -86,6 +86,11 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     ("async_sync.engine_alive", "any"),
     ("async_sync.generations.*", "max"),
     ("async_sync.*", "sum"),
+    # serving plane: admission/flush/read outcome counters sum (including
+    # the per-reason/per-trigger splits); occupancy gauges sum across
+    # processes (fleet-resident rows), the high-water mark maxes
+    ("serving.depth_high_water", "max"),
+    ("serving.*", "sum"),
     # fast-path histograms (percentiles recomputed after the bucket merge)
     ("histograms.*.buckets.*", "sum"),
     ("histograms.*.count", "sum"),

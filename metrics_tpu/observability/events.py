@@ -37,10 +37,12 @@ from typing import Any, Dict, Iterator, List, NamedTuple, Optional
 #: ``tenant_report`` marks a multi-tenant drill-down rollup (occupancy,
 #: traffic, staleness) landing on the timeline; ``straggler`` marks a fleet
 #: straggler report flagging a persistently-slow process
-#: (:mod:`~metrics_tpu.observability.tracing`)
+#: (:mod:`~metrics_tpu.observability.tracing`); ``serving`` marks the
+#: service plane's activity — admission-queue flushes/shed decisions and
+#: scheduler cache refreshes (:mod:`metrics_tpu.serving`)
 EVENT_KINDS = (
     "update", "forward", "compute", "sync", "retrace", "health", "compile",
-    "tenant_report", "straggler",
+    "tenant_report", "straggler", "serving",
 )
 
 #: default bound on retained events; ~100 bytes each, so the default log

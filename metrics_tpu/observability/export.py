@@ -72,6 +72,28 @@ _HELP: Dict[str, str] = {
     "async_sync_quorum_syncs_total": "Background syncs reduced over the healthy subgroup (quorum policy).",
     "async_sync_degraded_rounds_total": "Transport rounds started with flagged degraded peers.",
     "async_sync_in_flight": "Background syncs queued or running right now.",
+    "async_sync_coalesced_total": "Submissions served by an already-pending job for the same key (coalesce=True).",
+    "serving_queues": "Live admission queues in the serving plane.",
+    "serving_queue_depth_rows": "Rows resident across the serving plane's admission queues.",
+    "serving_queue_depth_high_water": "Peak resident rows observed at a flush.",
+    "serving_submitted_rows_total": "Event rows offered to the admission queues.",
+    "serving_admitted_rows_total": "Event rows admitted past the backpressure policy.",
+    "serving_shed_rows_total": "Event rows shed by the load-shedding policies (exactly accounted).",
+    "serving_shed_by_reason_total": "Shed rows split by policy reason.",
+    "serving_dispatched_rows_total": "Rows delivered to keyed update dispatches.",
+    "serving_flushes_total": "Coalesced dispatches (micro-batch flushes).",
+    "serving_flushes_by_trigger_total": "Flushes split by trigger (size/deadline/manual/close).",
+    "serving_dispatch_errors_total": "Flush dispatches that raised (their rows count as shed).",
+    "serving_reads_total": "SLO-governed per-tenant reads served.",
+    "serving_cache_hits_total": "Reads served from a fresh result cache.",
+    "serving_cache_misses_total": "Reads that had to wait for a fresh compute.",
+    "serving_stale_serves_total": "Reads served a stale-within-budget cached generation.",
+    "serving_refreshes_total": "Result-cache refreshes scheduled on the background engine.",
+    "serving_coalesced_refreshes_total": "Stale reads that joined an in-flight refresh.",
+    "serving_generation_bumps_total": "Write-generation bumps (one per dispatched flush).",
+    "serving_ingest_seconds": "Admission-to-dispatch-complete wall time per event row.",
+    "serving_flush_seconds": "One coalesced keyed dispatch's wall time.",
+    "serving_queue_depth": "Rows resident at flush time (log2 count histogram).",
 }
 
 
@@ -107,10 +129,16 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
                          "retries": int, "timeouts": int, "stale_serves": int,
                          "quorum_syncs": int, "degraded_rounds": int,
                          "generations": {key: int}},
+          "serving": {"queues": int, "depth": int, "admitted_rows": int,
+                      "shed_rows": int, "shed_by_reason": {...},
+                      "dispatched_rows": int, "flushes": int,
+                      "flushes_by_trigger": {...}, "reads": int,
+                      "cache_hits": int, "stale_serves": int, ...},
         }
 
     ``async_sync`` is ``{}`` until the first ``compute_async`` constructs
-    the background engine. Always JSON-serializable
+    the background engine; ``serving`` is ``{}`` until the first admission
+    queue is built (:mod:`metrics_tpu.serving`). Always JSON-serializable
     (``json.dumps(snapshot())`` round-trips), and mergeable across processes
     by the declared reductions — see
     :func:`~metrics_tpu.observability.aggregate.aggregate_snapshots`.
@@ -125,6 +153,13 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
     from metrics_tpu.utilities import async_sync as _async_sync
 
     snap["async_sync"] = _async_sync.summary()
+    import sys as _sys
+
+    # the serving section appears only when the service plane is actually
+    # imported AND touched — a process that never serves keeps both the
+    # snapshot and its import graph clean
+    serving_mod = _sys.modules.get("metrics_tpu.serving.telemetry")
+    snap["serving"] = serving_mod.summary() if serving_mod is not None else {}
     return snap
 
 
@@ -291,10 +326,51 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
             "stale_serves",
             "quorum_syncs",
             "degraded_rounds",
+            "coalesced",
         ):
             if field in async_sync:
                 out.emit(f"async_sync_{field}_total", base, async_sync[field], "counter")
         out.emit("async_sync_in_flight", base, async_sync.get("in_flight", 0))
+
+    serving = snap.get("serving", {})
+    if serving:
+        # the service plane's family: ingest/flush/shed/read outcomes are
+        # counters, queue occupancy gauges; the per-reason and per-trigger
+        # splits carry their own label (the ingest/flush/queue-depth
+        # latency histograms ride the regular histograms section)
+        out.emit("serving_queues", base, serving.get("queues", 0))
+        out.emit("serving_queue_depth_rows", base, serving.get("depth", 0))
+        out.emit(
+            "serving_queue_depth_high_water", base, serving.get("depth_high_water", 0)
+        )
+        for field in (
+            "submitted_rows",
+            "admitted_rows",
+            "shed_rows",
+            "dispatched_rows",
+            "flushes",
+            "dispatch_errors",
+            "reads",
+            "cache_hits",
+            "cache_misses",
+            "stale_serves",
+            "refreshes",
+            "coalesced_refreshes",
+            "generation_bumps",
+        ):
+            if field in serving:
+                out.emit(f"serving_{field}_total", base, serving[field], "counter")
+        for reason, n in sorted(serving.get("shed_by_reason", {}).items()):
+            out.emit(
+                "serving_shed_by_reason_total", {**base, "reason": reason}, n, "counter"
+            )
+        for trigger, n in sorted(serving.get("flushes_by_trigger", {}).items()):
+            out.emit(
+                "serving_flushes_by_trigger_total",
+                {**base, "trigger": trigger},
+                n,
+                "counter",
+            )
 
     events = snap.get("events", {})
     if events:

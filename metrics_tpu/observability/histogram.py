@@ -42,10 +42,17 @@ LATENCY_EXP_RANGE = (-20, 2)
 #: binary-exponent range of the size buckets: upper bounds 2^6 (64 B) ..
 #: 2^30 (1 GiB), +inf implicit
 SIZE_EXP_RANGE = (6, 30)
+#: binary-exponent range of the count buckets (queue depths, batch sizes):
+#: upper bounds 2^0 (1) .. 2^20 (~1M), +inf implicit
+COUNT_EXP_RANGE = (0, 20)
 
 #: bucket layout per unit — every histogram of one unit shares a layout, so
 #: cross-process aggregation is an elementwise bucket sum
-UNIT_EXP_RANGES = {"s": LATENCY_EXP_RANGE, "bytes": SIZE_EXP_RANGE}
+UNIT_EXP_RANGES = {
+    "s": LATENCY_EXP_RANGE,
+    "bytes": SIZE_EXP_RANGE,
+    "count": COUNT_EXP_RANGE,
+}
 
 
 class Log2Histogram:

@@ -1,0 +1,65 @@
+"""Online serving layer: metrics-as-a-service on top of the keyed machinery.
+
+Everything below this package is library-shaped — the caller owns the step
+loop. Production traffic is service-shaped: many threads submit per-tenant
+event rows continuously, and dashboards read per-tenant values against a
+latency/staleness SLO. This package is that service plane, built entirely
+host-side on the existing machinery (PR-6 keyed tenant scatter, PR-7 tenant
+reports, PR-9 ``compute_async`` background engine) with **zero traced
+ops** — every hot-path jaxpr digest stays byte-identical
+(``scripts/check_zero_overhead.py``).
+
+* :class:`~metrics_tpu.serving.queue.AdmissionQueue` — many-threaded
+  ingest coalesced into ONE keyed segment-scatter dispatch per flush, with
+  size- AND deadline-triggered micro-batching (``max_batch`` rows or
+  ``max_delay_ms``, whichever first).
+* :mod:`~metrics_tpu.serving.policy` — backpressure + load-shedding at
+  capacity (``block`` / ``shed_oldest`` / ``shed_tenant_over_quota``),
+  every shed row exactly accounted.
+* :class:`~metrics_tpu.serving.scheduler.SLOScheduler` — arbitration
+  between update dispatch and epoch reads: a hot per-tenant ``compute()``
+  result cache invalidated by write-generation counters, stale-serving
+  within a ``max_staleness_s`` budget, refreshes coalesced onto the PR-9
+  background engine.
+* :mod:`~metrics_tpu.serving.telemetry` — the ``serving.*`` family:
+  counters + queue-depth/flush-latency/ingest-latency log2 histograms in
+  ``observability.snapshot()["serving"]``, ``metrics_tpu_serving_*``
+  Prometheus series, and ``serving`` events on the Perfetto timeline —
+  mergeable across the fleet day one (declared ``MERGE_RULES``).
+
+Quickstart::
+
+    from metrics_tpu import Accuracy, KeyedMetric
+    from metrics_tpu.serving import SLOScheduler
+
+    svc = SLOScheduler(
+        KeyedMetric(Accuracy(), num_tenants=10_000),
+        max_batch=4096, max_delay_ms=5.0, policy="shed_oldest",
+        max_staleness_s=1.0,
+    )
+    svc.submit(tenant_id, preds_row, target_row)   # any thread, any rate
+    values = svc.read([tenant_id])                 # SLO-governed
+    svc.close()
+
+The soak harness (``scripts/soak.py``, bench config ``serving_soak_step``)
+drives this stack at sustained synthetic QPS over 10k+ tenants and pins the
+zero-lost-updates invariant: rows admitted − rows shed == rows ingested
+into tenant state (``tenant_report()["rows_routed"]``), with every shed row
+visible in the ``serving.*`` counters. See ``docs/serving.md``.
+"""
+from metrics_tpu.serving.policy import POLICIES, AdmissionPolicy, resolve_policy  # noqa: F401
+from metrics_tpu.serving.queue import AdmissionQueue, QueueClosedError  # noqa: F401
+from metrics_tpu.serving.scheduler import SLOScheduler  # noqa: F401
+from metrics_tpu.serving.telemetry import SERVING_STATS, ServingStats, summary  # noqa: F401
+
+__all__ = [
+    "POLICIES",
+    "AdmissionPolicy",
+    "AdmissionQueue",
+    "QueueClosedError",
+    "SERVING_STATS",
+    "SLOScheduler",
+    "ServingStats",
+    "resolve_policy",
+    "summary",
+]

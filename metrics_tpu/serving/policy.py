@@ -1,0 +1,92 @@
+"""Backpressure + load-shedding policies for the admission queue.
+
+A long-running evaluation service cannot assume the dispatch side keeps up
+with ingest forever: a recompile storm, a sick endpoint, or a traffic spike
+can push the admission queue to capacity. What happens next is a *policy*
+decision, and every outcome must be **exactly accounted** — a shed row that
+is not counted is indistinguishable from a lost update, which breaks the
+soak harness's zero-lost-updates invariant (rows admitted − rows shed ==
+rows ingested into tenant state).
+
+Three policies, selected by name (``AdmissionQueue(policy=...)``):
+
+* ``"block"`` — classic backpressure: the producer thread waits (bounded by
+  ``block_timeout_s``) until the flusher drains room. Nothing is ever shed;
+  ingest latency absorbs the pressure. Rows still unplaceable at the
+  timeout are rejected and counted (``shed_rows{reason="block_timeout"}``).
+* ``"shed_oldest"`` — bounded-latency ingest: the oldest *queued* rows are
+  dropped to admit the new ones (``reason="shed_oldest"``). The freshest
+  data wins — the right trade for dashboard-shaped metrics where a stale
+  sample is worth less than a current one.
+* ``"shed_tenant_over_quota"`` — noisy-neighbor isolation: an incoming row
+  whose tenant already holds ``tenant_quota_rows`` queued rows is rejected
+  (``reason="tenant_over_quota"``); tenants under quota are admitted even
+  at the same instant. A single hot tenant cannot evict everyone else's
+  rows. When the queue is full of *under-quota* rows the policy falls back
+  to shedding the incoming row (``reason="queue_full"``) rather than
+  blocking the producer.
+
+Every decision is host-side Python (zero traced ops) and is recorded in the
+``serving.*`` telemetry family (:mod:`metrics_tpu.serving.telemetry`).
+"""
+from typing import Optional
+
+__all__ = ["POLICIES", "resolve_policy", "AdmissionPolicy"]
+
+#: the selectable admission policies
+POLICIES = ("block", "shed_oldest", "shed_tenant_over_quota")
+
+#: shed-accounting reasons each policy can emit (docs + tests pin these)
+SHED_REASONS = ("block_timeout", "shed_oldest", "tenant_over_quota", "queue_full")
+
+
+class AdmissionPolicy:
+    """Value object naming one admission policy and its knobs.
+
+    The queue consults :attr:`name` at admission time; the policy itself
+    holds only configuration (it is shareable across queues and threads).
+    """
+
+    __slots__ = ("name", "block_timeout_s", "tenant_quota_rows")
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        block_timeout_s: Optional[float] = None,
+        tenant_quota_rows: Optional[int] = None,
+    ) -> None:
+        if name not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, got {name!r}")
+        if block_timeout_s is not None and block_timeout_s < 0:
+            raise ValueError(f"block_timeout_s must be >= 0, got {block_timeout_s}")
+        if tenant_quota_rows is not None and int(tenant_quota_rows) < 1:
+            raise ValueError(
+                f"tenant_quota_rows must be >= 1, got {tenant_quota_rows}"
+            )
+        self.name = name
+        self.block_timeout_s = block_timeout_s
+        self.tenant_quota_rows = (
+            int(tenant_quota_rows) if tenant_quota_rows is not None else None
+        )
+
+    def __repr__(self) -> str:
+        extra = ""
+        if self.block_timeout_s is not None:
+            extra += f", block_timeout_s={self.block_timeout_s}"
+        if self.tenant_quota_rows is not None:
+            extra += f", tenant_quota_rows={self.tenant_quota_rows}"
+        return f"AdmissionPolicy({self.name!r}{extra})"
+
+
+def resolve_policy(policy, **kwargs) -> AdmissionPolicy:
+    """``AdmissionPolicy`` from a name or a ready-made instance (the queue's
+    constructor seam). Keyword knobs apply only to the name form."""
+    if isinstance(policy, AdmissionPolicy):
+        if kwargs:
+            raise ValueError(
+                "pass policy knobs inside the AdmissionPolicy instance, not"
+                f" alongside it: {sorted(kwargs)}"
+            )
+        return policy
+    return AdmissionPolicy(str(policy), **kwargs)

@@ -1,0 +1,509 @@
+"""Admission queue: many-threaded event ingest coalesced into keyed dispatches.
+
+The library's hot path wants few, large, compiled dispatches (PR-4 donation,
+PR-6 segment scatter); a service's ingest side is many threads submitting
+single event rows. :class:`AdmissionQueue` is the seam between the two:
+
+* **submit side** — any number of producer threads call
+  :meth:`AdmissionQueue.submit` (one event row: a tenant id plus the
+  metric's positional update arguments for that row) or
+  :meth:`submit_many` (a pre-batched cohort). Admission is host-side
+  Python under one condition variable; the configured
+  :mod:`policy <metrics_tpu.serving.policy>` decides what happens at
+  capacity (block / shed oldest / shed over-quota tenants), and every shed
+  row is exactly accounted (``serving.*`` counters, per-reason split).
+* **dispatch side** — a single flusher thread coalesces pending rows into
+  ONE ``target(tenant_ids, *stacked_args)`` call — the
+  :meth:`KeyedMetric.update <metrics_tpu.wrappers.KeyedMetric.update>` /
+  :meth:`MultiTenantCollection.update` segment-scatter — with **size- AND
+  deadline-triggered micro-batching**: a flush fires at ``max_batch``
+  resident rows or ``max_delay_ms`` after the oldest resident row,
+  whichever comes first. Dispatches are serialized on one lock (metric
+  updates are a read-modify-write), so a manual :meth:`flush` or a
+  scheduler epoch read can never interleave with the flusher mid-dispatch.
+
+Exact accounting is load-bearing: the queue maintains
+``admitted − shed == dispatched (+ resident)`` as an internal invariant
+independent of telemetry enablement, which is what the soak harness's
+zero-lost-updates acceptance reads. Zero traced ops: everything here runs
+on the host; the compiled update programs are byte-identical with the queue
+running (``scripts/check_zero_overhead.py``).
+"""
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.serving.policy import AdmissionPolicy, resolve_policy
+from metrics_tpu.serving.telemetry import (
+    SERVING_STATS,
+    observe_flush,
+    observe_ingest,
+    observe_queue_depth,
+)
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+__all__ = ["AdmissionQueue", "QueueClosedError"]
+
+#: default micro-batch size (rows per coalesced dispatch)
+DEFAULT_MAX_BATCH = 4096
+#: default flush deadline: a row waits at most this long before dispatch
+DEFAULT_MAX_DELAY_MS = 5.0
+
+
+class QueueClosedError(RuntimeError):
+    """Submission against a closed queue."""
+
+
+class AdmissionQueue:
+    """Coalesce per-tenant event submissions into keyed update dispatches.
+
+    Args:
+        target: the dispatch callable — ``target(tenant_ids, *cols)`` with
+            ``tenant_ids`` a ``(rows,)`` int array and each ``cols[j]`` the
+            j-th positional update argument stacked on a leading row axis.
+            Typically ``KeyedMetric.update`` or
+            ``MultiTenantCollection.update`` (one segment-scatter dispatch
+            per flush).
+        max_batch: flush when this many rows are resident.
+        max_delay_ms: flush when the OLDEST resident row has waited this
+            long — the deadline trigger that bounds ingest latency at low
+            traffic.
+        capacity_rows: admission bound (default ``8 * max_batch``); the
+            policy governs what happens past it.
+        policy: ``"block"`` / ``"shed_oldest"`` / ``"shed_tenant_over_quota"``
+            or an :class:`~metrics_tpu.serving.policy.AdmissionPolicy`.
+        block_timeout_s: bound on a blocked producer's wait (``block``
+            policy; ``None`` waits until room or close).
+        tenant_quota_rows: resident-row quota per tenant
+            (``shed_tenant_over_quota``; default ``capacity_rows // 8``).
+        pad_to_bucket: pad every dispatched cohort to the next power-of-two
+            row count (capped at ``max_batch``) with discard rows —
+            tenant id ``-1``, zero-filled columns. Deadline flushes
+            otherwise dispatch arbitrary row counts, and each distinct
+            count is a fresh executable in the aval-keyed dispatch cache (a
+            recompile storm under bursty traffic); with padding at most
+            ``log2(max_batch)+1`` executables ever exist. The target must
+            clip-and-drop invalid ids — construct the
+            :class:`~metrics_tpu.wrappers.KeyedMetric` with
+            ``validate_ids=False`` (the discard-bucket path; dropped
+            padding rows are counted under ``invalid_tenant_ids``).
+        start: start the flusher thread immediately (tests pass ``False``
+            to drive flushes by hand).
+    """
+
+    def __init__(
+        self,
+        target: Callable[..., Any],
+        *,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        max_delay_ms: float = DEFAULT_MAX_DELAY_MS,
+        capacity_rows: Optional[int] = None,
+        policy: Any = "block",
+        block_timeout_s: Optional[float] = None,
+        tenant_quota_rows: Optional[int] = None,
+        pad_to_bucket: bool = False,
+        start: bool = True,
+    ) -> None:
+        if not callable(target):
+            raise TypeError(f"target must be callable, got {target!r}")
+        if int(max_batch) < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if float(max_delay_ms) <= 0:
+            raise ValueError(f"max_delay_ms must be > 0, got {max_delay_ms}")
+        self._target = target
+        self.pad_to_bucket = bool(pad_to_bucket)
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.capacity_rows = (
+            int(capacity_rows) if capacity_rows is not None else 8 * self.max_batch
+        )
+        if self.capacity_rows < self.max_batch:
+            raise ValueError(
+                f"capacity_rows ({self.capacity_rows}) must be >= max_batch"
+                f" ({self.max_batch}) or no size-triggered flush can ever fill"
+            )
+        if isinstance(policy, AdmissionPolicy):
+            self.policy = resolve_policy(policy)
+        else:
+            knobs: Dict[str, Any] = {}
+            if block_timeout_s is not None:
+                knobs["block_timeout_s"] = block_timeout_s
+            if tenant_quota_rows is not None:
+                knobs["tenant_quota_rows"] = tenant_quota_rows
+            self.policy = resolve_policy(policy, **knobs)
+        if (
+            self.policy.name == "shed_tenant_over_quota"
+            and self.policy.tenant_quota_rows is None
+        ):
+            self.policy = AdmissionPolicy(
+                "shed_tenant_over_quota",
+                tenant_quota_rows=max(1, self.capacity_rows // 8),
+            )
+
+        self._cv = threading.Condition()
+        #: resident rows, oldest first: (tenant, args, t_submit)
+        self._pending: List[Tuple[int, Tuple, float]] = []
+        self._per_tenant: Dict[int, int] = {}
+        self._closed = False
+        self._flush_now = False
+        self._flusher: Optional[threading.Thread] = None
+        #: serializes every target() call (metric updates are not reentrant)
+        self._dispatch_lock = threading.Lock()
+        self._in_dispatch = 0
+        self._last_error: Optional[BaseException] = None
+        self._error_warned = False
+        # exact accounting, independent of telemetry enablement — the
+        # zero-lost-updates invariant reads these
+        self._submitted = 0
+        self._admitted = 0
+        self._shed = 0
+        self._shed_by_reason: Dict[str, int] = {}
+        self._dispatched = 0
+        self._flushes = 0
+        self.telemetry_key = TELEMETRY.register(self)
+        SERVING_STATS.register_queue(self)
+        if start:
+            self._ensure_flusher()
+
+    # ------------------------------------------------------------------
+    # submit side
+    # ------------------------------------------------------------------
+
+    def submit(self, tenant_id: int, *args: Any) -> bool:
+        """Admit one event row; ``True`` when admitted, ``False`` when the
+        policy shed it. Thread-safe; raises :class:`QueueClosedError` after
+        :meth:`close`."""
+        return self.submit_many([tenant_id], *[[a] for a in args]) == 1
+
+    def submit_many(self, tenant_ids: Any, *cols: Any) -> int:
+        """Admit a cohort of rows (``tenant_ids`` plus one equal-length
+        column per update argument); returns how many rows were admitted.
+        Rows are admitted individually, oldest-policy semantics per row, so
+        a partial shed is possible (and exactly counted)."""
+        ids = np.asarray(tenant_ids).reshape(-1)
+        ncols = [np.asarray(c) for c in cols]
+        for c in ncols:
+            if c.shape[:1] != ids.shape:
+                raise ValueError(
+                    f"every column must carry one entry per row: ids {ids.shape}"
+                    f" vs column {c.shape}"
+                )
+        n = int(ids.shape[0])
+        if n == 0:
+            return 0
+        now = time.perf_counter()
+        admitted = 0
+        shed: Dict[str, int] = {}
+        with self._cv:
+            if self._closed:
+                raise QueueClosedError("AdmissionQueue is closed")
+            self._note_submitted(n)
+            for i in range(n):
+                tenant = int(ids[i])
+                row = (tenant, tuple(c[i] for c in ncols), now)
+                reason = self._admit_locked(row)
+                if reason is None:
+                    admitted += 1
+                else:
+                    shed[reason] = shed.get(reason, 0) + 1
+            self._cv.notify_all()
+        if shed:
+            self._account_shed(shed)
+        return admitted
+
+    def _note_submitted(self, n: int) -> None:
+        self._submitted += n  # caller holds the cv
+        SERVING_STATS.inc("submitted_rows", n)
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "submitted_rows", n)
+
+    def _admit_locked(self, row: Tuple[int, Tuple, float]) -> Optional[str]:
+        """Admit ``row`` under the lock, or return the shed reason."""
+        policy = self.policy
+        if policy.name == "shed_tenant_over_quota":
+            if self._per_tenant.get(row[0], 0) >= policy.tenant_quota_rows:
+                return "tenant_over_quota"
+            if len(self._pending) >= self.capacity_rows:
+                return "queue_full"
+        elif policy.name == "shed_oldest":
+            while len(self._pending) >= self.capacity_rows:
+                old = self._pending.pop(0)
+                self._per_tenant[old[0]] -= 1
+                # shed accounting happens in the caller's aggregate pass —
+                # but the eviction itself must be counted HERE, per row
+                self._shed += 1
+                self._shed_by_reason["shed_oldest"] = (
+                    self._shed_by_reason.get("shed_oldest", 0) + 1
+                )
+                SERVING_STATS.shed("shed_oldest", 1)
+        elif policy.name == "block":
+            deadline = (
+                None
+                if policy.block_timeout_s is None
+                else time.perf_counter() + policy.block_timeout_s
+            )
+            while len(self._pending) >= self.capacity_rows and not self._closed:
+                remaining = None if deadline is None else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    return "block_timeout"
+                self._cv.wait(remaining)
+            if self._closed:
+                return "block_timeout"
+        self._pending.append(row)
+        self._per_tenant[row[0]] = self._per_tenant.get(row[0], 0) + 1
+        self._admitted += 1
+        SERVING_STATS.inc("admitted_rows")
+        # wake the flusher the moment there is work to time (first resident
+        # row starts the deadline clock) or a full batch to dispatch — a
+        # producer that goes on to BLOCK for room in this same cohort would
+        # otherwise sleep holding an unnotified flusher (missed wakeup)
+        n_pending = len(self._pending)
+        if n_pending == 1 or n_pending >= self.max_batch:
+            self._cv.notify_all()
+        return None
+
+    def _account_shed(self, shed: Dict[str, int]) -> None:
+        with self._cv:
+            for reason, n in shed.items():
+                self._shed += n
+                self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + n
+        for reason, n in shed.items():
+            SERVING_STATS.shed(reason, n)
+            if TELEMETRY.enabled:
+                TELEMETRY.inc(self.telemetry_key, f"shed_{reason}", n)
+        if EVENTS.enabled:
+            EVENTS.record(
+                "serving", self.telemetry_key, path="shed", policy=self.policy.name,
+                **{f"shed_{r}": n for r, n in shed.items()},
+            )
+
+    # ------------------------------------------------------------------
+    # dispatch side
+    # ------------------------------------------------------------------
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None or not self._flusher.is_alive():
+            self._flusher = threading.Thread(
+                target=self._flusher_loop, name="metrics-tpu-serving-flusher", daemon=True
+            )
+            self._flusher.start()
+
+    def _flusher_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._pending:
+                    return
+                deadline = self._pending[0][2] + self.max_delay_s
+                while (
+                    len(self._pending) < self.max_batch
+                    and self._pending
+                    and not self._closed
+                    and not self._flush_now
+                ):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                if not self._pending:
+                    continue
+                trigger = (
+                    "size"
+                    if len(self._pending) >= self.max_batch
+                    else ("close" if self._closed else "deadline")
+                )
+            self._flush_once(trigger)
+
+    def _flush_once(self, trigger: str) -> int:
+        """Pop up to ``max_batch`` oldest rows and dispatch them as ONE
+        target call; returns rows dispatched (0 when nothing was resident)."""
+        with self._dispatch_lock:
+            with self._cv:
+                if not self._pending:
+                    return 0
+                depth_before = len(self._pending)
+                rows = self._pending[: self.max_batch]
+                del self._pending[: self.max_batch]
+                if not self._pending:
+                    self._flush_now = False
+                for tenant, _, _ in rows:
+                    left = self._per_tenant.get(tenant, 0) - 1
+                    if left > 0:
+                        self._per_tenant[tenant] = left
+                    else:
+                        self._per_tenant.pop(tenant, None)
+                self._in_dispatch += 1
+                self._cv.notify_all()  # room freed: wake blocked producers
+            try:
+                t0 = time.perf_counter()
+                ids = np.asarray([r[0] for r in rows], dtype=np.int32)
+                ncols = len(rows[0][1])
+                cols = [np.stack([r[1][j] for r in rows]) for j in range(ncols)]
+                if self.pad_to_bucket and len(rows) < self.max_batch:
+                    bucket = min(1 << max(0, len(rows) - 1).bit_length(), self.max_batch)
+                    pad = bucket - len(rows)
+                    if pad > 0:
+                        ids = np.concatenate([ids, np.full(pad, -1, ids.dtype)])
+                        cols = [
+                            np.concatenate(
+                                [c, np.zeros((pad,) + c.shape[1:], c.dtype)]
+                            )
+                            for c in cols
+                        ]
+                error: Optional[BaseException] = None
+                try:
+                    self._target(ids, *cols)
+                except Exception as err:  # noqa: BLE001 - accounted below
+                    error = err
+                dur = time.perf_counter() - t0
+                end = time.perf_counter()
+                self._note_flush(trigger, rows, depth_before, dur, end, error)
+            finally:
+                with self._cv:
+                    self._in_dispatch -= 1
+                    self._cv.notify_all()
+        return len(rows)
+
+    def _note_flush(
+        self,
+        trigger: str,
+        rows: List[Tuple[int, Tuple, float]],
+        depth_before: int,
+        dur: float,
+        end: float,
+        error: Optional[BaseException],
+    ) -> None:
+        n = len(rows)
+        with self._cv:
+            self._flushes += 1
+            if error is None:
+                self._dispatched += n
+            else:
+                # a failed dispatch never ingested: the rows are ACCOUNTED
+                # shed so the zero-lost invariant keeps holding exactly
+                self._shed += n
+                self._shed_by_reason["dispatch_error"] = (
+                    self._shed_by_reason.get("dispatch_error", 0) + n
+                )
+                self._last_error = error
+        if error is not None:
+            SERVING_STATS.inc("dispatch_errors")
+            SERVING_STATS.shed("dispatch_error", n)
+            if not self._error_warned:
+                self._error_warned = True
+                rank_zero_warn(
+                    f"AdmissionQueue dispatch failed ({type(error).__name__}:"
+                    f" {error}); the cohort's {n} rows are counted shed under"
+                    " reason 'dispatch_error'. Subsequent failures are counted"
+                    " silently — watch serving.dispatch_errors.",
+                    UserWarning,
+                )
+        SERVING_STATS.flush(trigger, n if error is None else 0, depth_before)
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "flushes")
+            if error is None:
+                TELEMETRY.inc(self.telemetry_key, "dispatched_rows", n)
+            observe_flush(dur, trigger)
+            observe_queue_depth(depth_before)
+            for _, _, t_submit in rows:
+                observe_ingest(end - t_submit, self.policy.name)
+        if EVENTS.enabled:
+            EVENTS.record(
+                "serving",
+                self.telemetry_key,
+                dur_s=dur,
+                t_start=end - dur,
+                path="flush",
+                trigger=trigger,
+                rows=n,
+                depth_before=depth_before,
+                policy=self.policy.name,
+                error=(f"{type(error).__name__}: {error}" if error else None),
+            )
+
+    # ------------------------------------------------------------------
+    # control plane
+    # ------------------------------------------------------------------
+
+    def flush(self) -> int:
+        """Dispatch everything resident NOW (caller thread, ``manual``
+        trigger); returns rows dispatched. Serialized against the flusher."""
+        total = 0
+        while True:
+            n = self._flush_once("manual")
+            if n == 0:
+                return total
+            total += n
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until no rows are resident and no dispatch is in flight;
+        ``False`` on timeout. With a live flusher the drain asks it to
+        flush immediately (no waiting out the deadline timer); without one
+        (``start=False``) the residue is dispatched on the caller thread.
+        The ``timeout`` bounds the WHOLE drain, in-flight dispatch
+        included."""
+        if self._flusher is None or not self._flusher.is_alive():
+            self.flush()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            self._flush_now = bool(self._pending)
+            self._cv.notify_all()
+            while self._pending or self._in_dispatch:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    def close(self, timeout: Optional[float] = 5.0) -> None:
+        """Stop admitting, flush the residue, and join the flusher."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self.flush()
+        thread = self._flusher
+        if thread is not None and thread.is_alive():
+            thread.join(timeout)
+
+    def depth(self) -> int:
+        """Rows currently resident (point-in-time)."""
+        with self._cv:
+            return len(self._pending)
+
+    def stats(self) -> Dict[str, Any]:
+        """The queue's exact ledger: submitted/admitted/shed (by reason)/
+        dispatched/flushes/resident.
+
+        Two conservation laws hold at every quiescent point — the
+        zero-lost-updates invariant's left-hand side:
+
+        * ``admitted == dispatched + resident + shed(shed_oldest) +
+          shed(dispatch_error)`` (rows shed AFTER admission);
+        * ``submitted − shed(total) == dispatched + resident`` — so at
+          drain, submitted − shed equals exactly what the keyed state
+          ingested (``tenant_report()["rows_routed"]``)."""
+        with self._cv:
+            return {
+                "policy": self.policy.name,
+                "max_batch": self.max_batch,
+                "max_delay_ms": round(self.max_delay_s * 1e3, 6),
+                "capacity_rows": self.capacity_rows,
+                "submitted": self._submitted,
+                "admitted": self._admitted,
+                "shed": self._shed,
+                "shed_by_reason": dict(self._shed_by_reason),
+                "dispatched": self._dispatched,
+                "flushes": self._flushes,
+                "resident": len(self._pending),
+                "closed": self._closed,
+                "last_error": (
+                    f"{type(self._last_error).__name__}: {self._last_error}"
+                    if self._last_error
+                    else None
+                ),
+            }

@@ -1,0 +1,318 @@
+"""SLO-aware scheduling between update dispatch and epoch reads.
+
+A serving loop has two competing consumers of the keyed metric state: the
+**write path** (admission-queue flushes — segment-scatter dispatches that
+must keep absorbing traffic) and the **read path** (per-tenant ``compute()``
+values for dashboards and rollups — an epoch-shaped fan-out that is orders
+of magnitude more expensive than one update). :class:`SLOScheduler` owns
+both and arbitrates by one explicit contract, the **staleness SLO**:
+
+* **updates always win the dispatch path.** Flushes run on the queue's
+  flusher thread; an epoch read never blocks them — the read snapshots the
+  state (one clone, the PR-9 ``compute_async`` discipline) and runs its
+  gather+compute on the background
+  :class:`~metrics_tpu.utilities.async_sync.AsyncSyncEngine`, overlapped
+  with whatever traffic follows.
+* **reads are served from a hot result cache** keyed by the scheduler's
+  **write generation** — a counter bumped once per dispatched flush (the
+  per-key generation discipline the async engine already applies to its
+  retained values). A cache entry is *fresh* when its generation matches
+  and nothing is resident in the queue; *servable* when younger than the
+  read's ``max_staleness_s`` budget (served immediately, counted
+  ``stale_serves``, with a background refresh scheduled); otherwise the
+  read flushes the queue (read-your-writes), submits a refresh, and blocks
+  on the future. ``max_staleness_s=0`` therefore guarantees a read NEVER
+  observes a value older than the latest generation — the
+  no-stale-cache-after-a-generation-bump invariant the concurrency tests
+  pin.
+* **refreshes coalesce.** Any number of concurrent stale reads share one
+  in-flight refresh per scheduler (counted ``coalesced_refreshes``); the
+  engine-level ``coalesce=`` submission option provides the same guarantee
+  for callers talking to the engine directly.
+
+Everything is host-side (zero traced ops); the counters surface under
+``snapshot()["serving"]`` next to the queue's, and each refresh rides the
+engine's existing ``async_sync.*`` family and ``sync`` events.
+"""
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.serving.queue import AdmissionQueue
+from metrics_tpu.serving.telemetry import SERVING_STATS
+
+__all__ = ["SLOScheduler"]
+
+#: default read staleness budget (seconds): a cached per-tenant value this
+#: young is served without touching the state
+DEFAULT_MAX_STALENESS_S = 1.0
+#: default bound on a blocking (cache-miss) read
+DEFAULT_READ_TIMEOUT_S = 30.0
+
+
+class SLOScheduler:
+    """Serve one keyed metric: queued updates in, SLO-governed reads out.
+
+    Args:
+        metric: a :class:`~metrics_tpu.wrappers.KeyedMetric` or
+            :class:`~metrics_tpu.wrappers.MultiTenantCollection` (anything
+            with ``update(tenant_ids, *cols)``, ``compute()`` and
+            ``clone()``).
+        max_staleness_s: default read budget (overridable per read).
+        read_timeout_s: bound on a blocking cache-miss read.
+        on_degraded: degraded-link policy for the refresh gathers
+            (``"retry"`` / ``"stale"`` / ``"quorum"`` — PR-9 semantics).
+        queue kwargs (``max_batch``, ``max_delay_ms``, ``capacity_rows``,
+            ``policy``, ``block_timeout_s``, ``tenant_quota_rows``,
+            ``start``) configure the owned
+            :class:`~metrics_tpu.serving.queue.AdmissionQueue`.
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        *,
+        max_staleness_s: float = DEFAULT_MAX_STALENESS_S,
+        read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+        on_degraded: str = "retry",
+        round_timeout_s: Optional[float] = None,
+        **queue_kwargs: Any,
+    ) -> None:
+        for attr in ("update", "compute"):
+            if not callable(getattr(metric, attr, None)):
+                raise TypeError(
+                    f"metric must provide {attr}(); got {type(metric).__name__}"
+                )
+        if max_staleness_s < 0:
+            raise ValueError(f"max_staleness_s must be >= 0, got {max_staleness_s}")
+        self._metric = metric
+        self.max_staleness_s = float(max_staleness_s)
+        self.read_timeout_s = float(read_timeout_s)
+        self.on_degraded = on_degraded
+        self.round_timeout_s = round_timeout_s
+        self._lock = threading.Lock()
+        self._generation = 0
+        #: {"generation", "values", "at"} — the hot per-tenant result cache
+        self._cache: Optional[Dict[str, Any]] = None
+        self._refresh_future: Optional[Any] = None
+        self._refresh_generation = -1
+        self.telemetry_key = TELEMETRY.register(self)
+        self.queue = AdmissionQueue(self._dispatch, **queue_kwargs)
+
+    # ------------------------------------------------------------------
+    # write path
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, tenant_ids: Any, *cols: Any) -> None:
+        """The queue's flush target: ONE keyed update dispatch, then a
+        generation bump — the cache-invalidation edge."""
+        self._metric.update(tenant_ids, *cols)
+        with self._lock:
+            self._generation += 1
+        SERVING_STATS.inc("generation_bumps")
+
+    def submit(self, tenant_id: int, *args: Any) -> bool:
+        """Admit one event row (see :meth:`AdmissionQueue.submit`)."""
+        return self.queue.submit(tenant_id, *args)
+
+    def submit_many(self, tenant_ids: Any, *cols: Any) -> int:
+        """Admit a row cohort (see :meth:`AdmissionQueue.submit_many`)."""
+        return self.queue.submit_many(tenant_ids, *cols)
+
+    @property
+    def generation(self) -> int:
+        """Write generation: dispatched flushes so far (cache entries are
+        stamped with the generation they computed at)."""
+        with self._lock:
+            return self._generation
+
+    # ------------------------------------------------------------------
+    # read path
+    # ------------------------------------------------------------------
+
+    def read(
+        self,
+        tenant_ids: Optional[Any] = None,
+        *,
+        max_staleness_s: Optional[float] = None,
+    ) -> Any:
+        """Per-tenant computed values under the staleness SLO.
+
+        ``tenant_ids=None`` returns the full per-tenant vector (or
+        ``{member: vector}`` for a collection); an index array selects
+        rows. ``max_staleness_s`` overrides the scheduler default for this
+        read; ``0`` forces read-your-writes freshness (flush + recompute
+        when anything changed)."""
+        SERVING_STATS.inc("reads")
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "reads")
+        budget = self.max_staleness_s if max_staleness_s is None else float(max_staleness_s)
+        now = time.monotonic()
+        with self._lock:
+            cache = self._cache
+            generation = self._generation
+        if (
+            cache is not None
+            and cache["generation"] == generation
+            and self.queue.depth() == 0
+        ):
+            SERVING_STATS.inc("cache_hits")
+            return _select(cache["values"], tenant_ids)
+        if cache is not None and (now - cache["at"]) <= budget:
+            # within the SLO: serve the stale generation immediately and
+            # refresh in the background — a dashboard value a moment old
+            # beats a read stalled behind an epoch fan-out (the PR-9
+            # stale-serving trade, applied to the result cache)
+            SERVING_STATS.inc("stale_serves")
+            self._ensure_refresh()
+            return _select(cache["values"], tenant_ids)
+        SERVING_STATS.inc("cache_misses")
+        future, target = self._ensure_refresh()
+        values = future.result(timeout=self.read_timeout_s)
+        self._install_cache(target, values)
+        return _select(values, tenant_ids)
+
+    def refresh(self, wait: bool = False) -> Any:
+        """Schedule (or join) a cache refresh; returns the refresh's
+        :class:`~metrics_tpu.utilities.async_sync.SyncFuture`. ``wait=True``
+        blocks until it resolves and installs the cache."""
+        future, target = self._ensure_refresh()
+        if wait:
+            self._install_cache(target, future.result(timeout=self.read_timeout_s))
+        return future
+
+    def _ensure_refresh(self):
+        """One in-flight refresh per scheduler: concurrent stale reads share
+        it (``coalesced_refreshes``); the refresh flushes resident rows
+        first so the snapshot covers everything admitted before the read."""
+        with self._lock:
+            future = self._refresh_future
+            if (
+                future is not None
+                and not future.done()
+                and self._refresh_generation >= self._generation
+                and self.queue.depth() == 0
+            ):
+                SERVING_STATS.inc("coalesced_refreshes")
+                return future, self._refresh_generation
+        # read-your-writes: everything admitted before this read reaches the
+        # state before the snapshot (serialized on the queue's dispatch lock)
+        self.queue.flush()
+        with self._lock:
+            future = self._refresh_future
+            if (
+                future is not None
+                and not future.done()
+                and self._refresh_generation >= self._generation
+            ):
+                SERVING_STATS.inc("coalesced_refreshes")
+                return future, self._refresh_generation
+            target = self._generation
+            shadow = _clone(self._metric)
+
+            def thunk(shadow=shadow, target=target):
+                # per-attempt clone: an orphaned timed-out attempt must not
+                # race a retry on shared state (Metric.compute_async's rule)
+                values = _clone(shadow).compute()
+                self._install_cache(target, values)
+                return values
+
+            from metrics_tpu.utilities.async_sync import get_engine
+
+            key = getattr(self._metric, "telemetry_key", None) or self.telemetry_key
+            future = get_engine().submit(
+                key,
+                thunk,
+                on_degraded=self.on_degraded,
+                round_timeout_s=self.round_timeout_s,
+            )
+            self._refresh_future = future
+            self._refresh_generation = target
+        SERVING_STATS.inc("refreshes")
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "refreshes")
+        if EVENTS.enabled:
+            EVENTS.record(
+                "serving",
+                self.telemetry_key,
+                path="refresh",
+                generation=target,
+                engine_generation=future.generation,
+            )
+        return future, target
+
+    def _install_cache(self, generation: int, values: Any) -> None:
+        with self._lock:
+            if self._cache is None or self._cache["generation"] <= generation:
+                self._cache = {
+                    "generation": generation,
+                    "values": values,
+                    "at": time.monotonic(),
+                }
+
+    # ------------------------------------------------------------------
+    # introspection / lifecycle
+    # ------------------------------------------------------------------
+
+    def report(self) -> Dict[str, Any]:
+        """Host-side drill-down: generation/cache state plus the queue's
+        exact ledger (and the metric's ``tenant_report`` when it has one)."""
+        with self._lock:
+            cache = self._cache
+            out: Dict[str, Any] = {
+                "generation": self._generation,
+                "cache_generation": cache["generation"] if cache else None,
+                "cache_age_s": (
+                    round(time.monotonic() - cache["at"], 6) if cache else None
+                ),
+                "cache_fresh": bool(cache and cache["generation"] == self._generation),
+                "max_staleness_s": self.max_staleness_s,
+                "on_degraded": self.on_degraded,
+            }
+        out["queue"] = self.queue.stats()
+        tenant_report = getattr(self._metric, "tenant_report", None)
+        if callable(tenant_report):
+            out["tenants"] = tenant_report()
+        return out
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Flush and wait out every resident row (see
+        :meth:`AdmissionQueue.drain`)."""
+        return self.queue.drain(timeout)
+
+    def close(self, timeout: Optional[float] = 5.0) -> None:
+        """Close the queue (flushes the residue first)."""
+        self.queue.close(timeout)
+
+    def __repr__(self) -> str:
+        return (
+            f"SLOScheduler({type(self._metric).__name__},"
+            f" policy={self.queue.policy.name!r},"
+            f" max_staleness_s={self.max_staleness_s})"
+        )
+
+
+def _clone(metric: Any) -> Any:
+    """Detached snapshot of ``metric``: its own ``clone()`` when it has one
+    (:class:`Metric` subclasses), ``deepcopy`` otherwise
+    (:class:`MultiTenantCollection` and metric-shaped doubles)."""
+    clone = getattr(metric, "clone", None)
+    if callable(clone):
+        return clone()
+    import copy
+
+    return copy.deepcopy(metric)
+
+
+def _select(values: Any, tenant_ids: Optional[Any]) -> Any:
+    """Index per-tenant values (array or {member: array}) by tenant ids."""
+    if tenant_ids is None:
+        return values
+    ids = np.asarray(tenant_ids).reshape(-1)
+    if isinstance(values, dict):
+        return {k: np.asarray(v)[ids] for k, v in values.items()}
+    return np.asarray(values)[ids]

@@ -1,0 +1,180 @@
+"""The ``serving.*`` telemetry family: exact accounting for the service plane.
+
+One process-global :class:`ServingStats` ledger records every admission
+outcome (admitted / shed, by reason), every flush (by trigger), every
+dispatched row, and every scheduler read outcome (cache hit / miss / stale
+serve / refresh). The ledger surfaces in three places, mirroring the
+async-sync engine's family:
+
+* ``observability.snapshot()["serving"]`` — the JSON view below, ``{}``
+  until the first queue is constructed (processes that never serve keep a
+  clean snapshot). Fleet aggregation works day one: the
+  :data:`~metrics_tpu.observability.aggregate.MERGE_RULES` table declares
+  counters sum, depth/queues sum, and high-water gauges max.
+* the ``metrics_tpu_serving_*`` Prometheus series
+  (:func:`~metrics_tpu.observability.export.render_prometheus`).
+* fast-path log2 histograms: ``serving_ingest_seconds`` (admission →
+  dispatch-complete wall time per row batch), ``serving_flush_seconds``
+  (one coalesced dispatch), and ``serving_queue_depth`` (rows resident at
+  flush time, unit ``count``) — mergeable bucket tables like every other
+  histogram family.
+
+Everything here is host-side bookkeeping behind the same lock-free
+``TELEMETRY.enabled`` gate the rest of the observability stack uses; the
+compiled metric programs are untouched (the zero-overhead gate pins it).
+"""
+import threading
+import weakref
+from typing import Any, Dict
+
+from metrics_tpu.observability.histogram import HISTOGRAMS
+from metrics_tpu.observability.registry import TELEMETRY
+
+__all__ = [
+    "SERVING_STATS",
+    "ServingStats",
+    "observe_flush",
+    "observe_ingest",
+    "observe_queue_depth",
+    "summary",
+]
+
+#: canonical fast-path histogram series of the serving plane
+INGEST_SECONDS = "serving_ingest_seconds"
+FLUSH_SECONDS = "serving_flush_seconds"
+QUEUE_DEPTH = "serving_queue_depth"
+
+
+def observe_ingest(seconds: float, policy: str) -> None:
+    """Admission-to-dispatch-complete wall time of one row cohort."""
+    HISTOGRAMS.observe(INGEST_SECONDS, seconds, unit="s", policy=policy)
+
+
+def observe_flush(seconds: float, trigger: str) -> None:
+    """One coalesced dispatch's wall time, labeled by what triggered it
+    (``size`` / ``deadline`` / ``manual`` / ``close``)."""
+    HISTOGRAMS.observe(FLUSH_SECONDS, seconds, unit="s", trigger=trigger)
+
+
+def observe_queue_depth(rows: int) -> None:
+    """Rows resident in the queue at flush time (unit ``count``)."""
+    HISTOGRAMS.observe(QUEUE_DEPTH, float(rows), unit="count")
+
+
+class ServingStats:
+    """Thread-safe counters for the serving plane (one process-global
+    instance, :data:`SERVING_STATS`; private instances supported for
+    tests). ``touched`` stays False until the first queue registers, so an
+    idle process's snapshot omits the section entirely."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._touched = False
+        self._queues: "weakref.WeakSet" = weakref.WeakSet()
+        self._counters: Dict[str, int] = {
+            "submitted_rows": 0,
+            "admitted_rows": 0,
+            "shed_rows": 0,
+            "dispatched_rows": 0,
+            "flushes": 0,
+            "dispatch_errors": 0,
+            "reads": 0,
+            "cache_hits": 0,
+            "cache_misses": 0,
+            "stale_serves": 0,
+            "refreshes": 0,
+            "coalesced_refreshes": 0,
+            "generation_bumps": 0,
+        }
+        self._shed_by_reason: Dict[str, int] = {}
+        self._flushes_by_trigger: Dict[str, int] = {}
+        self._depth_high_water = 0
+
+    # -- recording ----------------------------------------------------------
+
+    def register_queue(self, queue: Any) -> None:
+        with self._lock:
+            self._touched = True
+            self._queues.add(queue)
+
+    def inc(self, counter: str, n: int = 1) -> None:
+        if not TELEMETRY.enabled:
+            return
+        with self._lock:
+            self._touched = True
+            self._counters[counter] = self._counters.get(counter, 0) + int(n)
+
+    def shed(self, reason: str, n: int) -> None:
+        """One shed decision: ``n`` rows under ``reason`` — the per-reason
+        split and the total move together, so the accounting can never
+        drift."""
+        if not TELEMETRY.enabled or n <= 0:
+            return
+        with self._lock:
+            self._touched = True
+            self._counters["shed_rows"] += int(n)
+            self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + int(n)
+
+    def flush(self, trigger: str, rows: int, depth: int) -> None:
+        if not TELEMETRY.enabled:
+            return
+        with self._lock:
+            self._touched = True
+            self._counters["flushes"] += 1
+            self._counters["dispatched_rows"] += int(rows)
+            self._flushes_by_trigger[trigger] = (
+                self._flushes_by_trigger.get(trigger, 0) + 1
+            )
+            if depth > self._depth_high_water:
+                self._depth_high_water = int(depth)
+
+    # -- reading ------------------------------------------------------------
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def summary(self) -> Dict[str, Any]:
+        """The ``snapshot()["serving"]`` section (``{}`` when untouched)."""
+        with self._lock:
+            if not self._touched:
+                return {}
+            queues = list(self._queues)
+            out = {
+                "queues": len(queues),
+                "depth": 0,
+                "depth_high_water": self._depth_high_water,
+                **dict(self._counters),
+                "shed_by_reason": dict(self._shed_by_reason),
+                "flushes_by_trigger": dict(self._flushes_by_trigger),
+            }
+        # depths are read OUTSIDE the stats lock: a queue records stats while
+        # holding its own condition variable, so nesting the other way here
+        # would be an ABBA deadlock
+        depth = 0
+        for q in queues:
+            try:
+                depth += q.depth()
+            except Exception:  # pragma: no cover - a closing queue
+                pass
+        out["depth"] = depth
+        return out
+
+    def reset(self) -> None:
+        """Zero every counter (live queues stay registered — their depths
+        keep reporting)."""
+        with self._lock:
+            for k in self._counters:
+                self._counters[k] = 0
+            self._shed_by_reason.clear()
+            self._flushes_by_trigger.clear()
+            self._depth_high_water = 0
+
+
+#: the process-global serving ledger
+SERVING_STATS = ServingStats()
+
+
+def summary() -> Dict[str, Any]:
+    """Module-level accessor ``observability.snapshot()`` reads."""
+    return SERVING_STATS.summary()

@@ -49,6 +49,7 @@ from metrics_tpu.transport.in_graph import InGraphTransport  # noqa: F401
 from metrics_tpu.transport.gather import (  # noqa: F401
     GatherTransport,
     kvstore_subgroup_allgather,
+    maybe_register_kvstore_channel,
     set_subgroup_allgather,
     subgroup_allgather,
 )

@@ -18,6 +18,12 @@ among an explicit peer set without involving anyone else:
   coordination service get :func:`kvstore_subgroup_allgather` — the
   distributed KV store is point-readable, so healthy members exchange
   payloads without the dead peer ever being contacted);
+* the KV-store channel is the **auto default**: when a coordination-service
+  client is reachable at transport creation (an initialized
+  ``jax.distributed`` runtime), it registers itself automatically — an
+  explicit :func:`set_subgroup_allgather` (including ``None``) and the
+  ``METRICS_TPU_NO_KVSTORE_SUBGROUP=1`` env opt-out both win over the
+  auto-registration;
 * with no channel registered, a subgrouped round falls back to the legacy
   behavior — one global round, subgroup members decoded — and the round
   telemetry records the participant set that was actually touched, so the
@@ -28,6 +34,7 @@ Round telemetry (``sync`` events, ``snapshot()["sync"]``) now carries
 which is what the acceptance tests assert for quorum syncs.
 """
 import base64
+import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -39,24 +46,66 @@ from metrics_tpu.transport.base import Transport
 #: (len(participants), ...) stacked array``, executed by every participant
 #: with identical arguments; non-participants never call it.
 _SUBGROUP_ALLGATHER: Optional[Callable[[np.ndarray, List[int]], np.ndarray]] = None
+#: True once a caller registered (or cleared) the channel EXPLICITLY — an
+#: explicit choice, including "no channel", always wins over auto-default
+_CHANNEL_EXPLICIT = False
 _CHANNEL_LOCK = threading.Lock()
+
+#: env opt-out for the KV-store auto default (set to anything but 0/empty)
+NO_KVSTORE_ENV = "METRICS_TPU_NO_KVSTORE_SUBGROUP"
 
 
 def set_subgroup_allgather(
     fn: Optional[Callable[[np.ndarray, List[int]], np.ndarray]],
 ) -> Optional[Callable]:
     """Register (or clear, with ``None``) the subgroup exchange channel.
-    Returns the previously registered channel."""
-    global _SUBGROUP_ALLGATHER
+    Returns the previously registered channel. An explicit registration —
+    including an explicit ``None`` — disables the KV-store auto-default
+    (:func:`maybe_register_kvstore_channel`) for the rest of the process."""
+    global _SUBGROUP_ALLGATHER, _CHANNEL_EXPLICIT
     with _CHANNEL_LOCK:
         previous = _SUBGROUP_ALLGATHER
         _SUBGROUP_ALLGATHER = fn
+        _CHANNEL_EXPLICIT = True
     return previous
 
 
 def subgroup_allgather() -> Optional[Callable]:
     """The registered subgroup channel, or ``None``."""
     return _SUBGROUP_ALLGATHER
+
+
+def maybe_register_kvstore_channel() -> bool:
+    """Auto-default the production subgroup channel: when a JAX
+    coordination-service client is reachable (an initialized
+    ``jax.distributed`` runtime) and nothing was registered explicitly,
+    install :func:`kvstore_subgroup_allgather` as the subgroup channel.
+
+    Runs at every :class:`GatherTransport` creation (cheap: two attribute
+    reads once registered or opted out). Explicit
+    :func:`set_subgroup_allgather` calls — including an explicit ``None`` —
+    and the ``METRICS_TPU_NO_KVSTORE_SUBGROUP=1`` env opt-out always win.
+    Returns True when the KV-store channel is the registered channel after
+    the call."""
+    global _SUBGROUP_ALLGATHER
+    if _CHANNEL_EXPLICIT:
+        return _SUBGROUP_ALLGATHER is kvstore_subgroup_allgather
+    if _SUBGROUP_ALLGATHER is not None:
+        return _SUBGROUP_ALLGATHER is kvstore_subgroup_allgather
+    if os.environ.get(NO_KVSTORE_ENV, "").strip() not in ("", "0"):
+        return False
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        client = getattr(_jax_distributed.global_state, "client", None)
+    except Exception:  # pragma: no cover - exotic jax builds
+        client = None
+    if client is None:
+        return False
+    with _CHANNEL_LOCK:
+        if _SUBGROUP_ALLGATHER is None and not _CHANNEL_EXPLICIT:
+            _SUBGROUP_ALLGATHER = kvstore_subgroup_allgather
+    return _SUBGROUP_ALLGATHER is kvstore_subgroup_allgather
 
 
 #: per-participant-set monotonic round counters for the KV-store channel —
@@ -151,6 +200,10 @@ class GatherTransport(Transport):
         participants: Optional[Sequence[int]] = None,
         label: Optional[str] = None,
     ) -> None:
+        # transport creation is the auto-default hook: a reachable
+        # coordination-service client registers the KV-store subgroup
+        # channel unless an explicit registration or env opt-out won
+        maybe_register_kvstore_channel()
         self._participants = (
             sorted({int(p) for p in participants}) if participants is not None else None
         )

@@ -193,6 +193,7 @@ class AsyncSyncEngine:
         self._in_flight = 0
         self._generations: Dict[str, int] = {}
         self._last: Dict[str, Any] = {}  # key -> (generation, value)
+        self._pending: Dict[str, SyncFuture] = {}  # key -> newest unresolved future
         self._counters: Dict[str, int] = {
             "submitted": 0,
             "completed": 0,
@@ -202,6 +203,7 @@ class AsyncSyncEngine:
             "stale_serves": 0,
             "quorum_syncs": 0,
             "degraded_rounds": 0,
+            "coalesced": 0,
         }
 
     # -- submission ---------------------------------------------------------
@@ -215,19 +217,36 @@ class AsyncSyncEngine:
         round_timeout_s: Optional[float] = None,
         max_retries: Optional[int] = None,
         backoff_s: Optional[float] = None,
+        coalesce: bool = False,
     ) -> SyncFuture:
         """Queue ``thunk`` (a self-contained sync+compute over a detached
         state snapshot) and return its :class:`SyncFuture`. Per-job
         ``round_timeout_s``/``max_retries``/``backoff_s`` override the engine
-        defaults."""
+        defaults.
+
+        ``coalesce=True`` is the serving-read submission mode: when a job
+        for ``key`` is already queued or running, the existing future is
+        returned instead of enqueueing a duplicate (counted ``coalesced``,
+        no new generation) — N concurrent readers of one metric cost one
+        gather, not N. **Collective discipline caveat**: coalescing makes
+        the submission count depend on local timing, so only use it for
+        single-process or loopback-transport reads (the serving scheduler's
+        case), never for jobs whose thunks issue multi-process
+        collectives."""
         if on_degraded not in POLICIES:
             raise ValueError(
                 f"on_degraded must be one of {POLICIES}, got {on_degraded!r}"
             )
         with self._lock:
+            if coalesce:
+                pending = self._pending.get(key)
+                if pending is not None and not pending.done():
+                    self._counters["coalesced"] += 1
+                    return pending
             generation = self._generations.get(key, 0) + 1
             self._generations[key] = generation
             future = SyncFuture(key, generation, on_degraded)
+            self._pending[key] = future
             self._queue.append(
                 _Job(
                     future,
@@ -267,6 +286,11 @@ class AsyncSyncEngine:
             finally:
                 with self._lock:
                     self._in_flight -= 1
+                    # the coalesce window closes with the job: a LATER
+                    # submission must queue fresh work, never adopt a future
+                    # that already resolved
+                    if self._pending.get(job.future.key) is job.future:
+                        del self._pending[job.future.key]
 
     def _attempt(self, thunk: Callable[[], Any], timeout: Optional[float]) -> Any:
         """One transport attempt under the per-round timeout.
@@ -466,6 +490,7 @@ class AsyncSyncEngine:
         with self._lock:
             self._generations.clear()
             self._last.clear()
+            self._pending.clear()
             for k in self._counters:
                 self._counters[k] = 0
 

@@ -48,6 +48,7 @@ ops when telemetry is off). Scatter corruption is never silent.
 """
 import functools
 import sys
+import threading
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -147,42 +148,71 @@ class _TenantTraffic:
     telemetry is enabled (~16 bytes/tenant), so a disabled stack pays one
     ``enabled`` read. Invalid ids are dropped here exactly as the scatter's
     discard bucket drops them.
+
+    Thread-safe: the serving-layer ingest path feeds this ledger from the
+    admission queue's flusher while ``tenant_report()`` readers run on
+    other threads; every mutation and read runs under one lock (numpy's
+    in-place ``+=`` releases the GIL mid-ufunc, so unlocked concurrent
+    notes could tear counts — and a torn ledger breaks the soak harness's
+    exact zero-lost-updates accounting). The lock never serializes a
+    compiled dispatch: ``note`` runs after the update is already in flight.
     """
 
-    __slots__ = ("n", "rows", "last_seen")
+    __slots__ = ("n", "rows", "last_seen", "_lock")
 
     def __init__(self, n: int) -> None:
         self.n = int(n)
         self.rows: Optional[np.ndarray] = None
         self.last_seen: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+
+    def __getstate__(self) -> dict:
+        # the lock is process-local (checkpoints/clones recreate it fresh)
+        return {"n": self.n, "rows": self.rows, "last_seen": self.last_seen}
+
+    def __setstate__(self, state: dict) -> None:
+        self.n = state["n"]
+        self.rows = state["rows"]
+        self.last_seen = state["last_seen"]
+        self._lock = threading.Lock()
 
     def note(self, ids: Any) -> None:
         concrete = np.asarray(ids).reshape(-1)
         valid = concrete[(concrete >= 0) & (concrete < self.n)]
         if valid.size == 0:
             return
-        if self.rows is None:
-            self.rows = np.zeros(self.n, dtype=np.int64)
-            self.last_seen = np.full(self.n, np.nan)
-        self.rows += np.bincount(valid, minlength=self.n)
-        self.last_seen[np.unique(valid)] = time.time()
+        counts = np.bincount(valid, minlength=self.n)
+        stamp = time.time()
+        touched = np.unique(valid)
+        with self._lock:
+            if self.rows is None:
+                self.rows = np.zeros(self.n, dtype=np.int64)
+                self.last_seen = np.full(self.n, np.nan)
+            self.rows += counts
+            self.last_seen[touched] = stamp
 
     def clear(self, ids: Optional[Any] = None) -> None:
-        if self.rows is None:
-            return
-        if ids is None:
-            self.rows = None
-            self.last_seen = None
-            return
-        idx = np.asarray(ids).reshape(-1)
-        self.rows[idx] = 0
-        self.last_seen[idx] = np.nan
+        with self._lock:
+            if self.rows is None:
+                return
+            if ids is None:
+                self.rows = None
+                self.last_seen = None
+                return
+            idx = np.asarray(ids).reshape(-1)
+            self.rows[idx] = 0
+            self.last_seen[idx] = np.nan
 
     def report(self, top_k: int, invalid: int) -> Dict[str, Any]:
-        """The drill-down dict (see ``KeyedMetric.tenant_report``)."""
+        """The drill-down dict (see ``KeyedMetric.tenant_report``); computed
+        from one consistent copy of the ledger, so a concurrent writer can
+        never tear a report mid-build."""
         now = time.time()
         n = self.n
-        rows = self.rows if self.rows is not None else np.zeros(n, dtype=np.int64)
+        with self._lock:
+            tracking = self.rows is not None
+            rows = self.rows.copy() if tracking else np.zeros(n, dtype=np.int64)
+            last_seen = self.last_seen.copy() if tracking else None
         active_mask = rows > 0
         active = int(active_mask.sum())
         rows_total = int(rows.sum())
@@ -195,8 +225,8 @@ class _TenantTraffic:
             ]
         staleness: Dict[str, Any] = {"p50": None, "p95": None, "max": None}
         stalest: List[Dict[str, Any]] = []
-        if active and self.last_seen is not None:
-            ages = now - self.last_seen[active_mask]
+        if active and last_seen is not None:
+            ages = now - last_seen[active_mask]
             staleness = {
                 "p50": round(float(np.percentile(ages, 50)), 6),
                 "p95": round(float(np.percentile(ages, 95)), 6),
@@ -211,7 +241,7 @@ class _TenantTraffic:
         routed_plus_invalid = rows_total + int(invalid)
         return {
             "tenants": n,
-            "tracking": self.rows is not None,
+            "tracking": tracking,
             "rows_routed": rows_total,
             "occupancy": {
                 "active": active,
@@ -344,6 +374,17 @@ class KeyedMetric(Metric):
         self._keyed_update_fn: Optional[CompiledDispatch] = None
         self._keyed_update_copy_fn: Optional[CompiledDispatch] = None
         self._traffic = _TenantTraffic(self.num_tenants)
+
+    def _serial_lock(self) -> "threading.RLock":
+        """The stateful-update serialization lock (lazy, process-local —
+        excluded from pickles/clones). Concurrent serving-layer ingest
+        threads calling ``update``/``update_many`` interleave their
+        read-modify-write of the stacked state without it; the pure
+        ``apply_update`` path never touches this."""
+        lock = self.__dict__.get("_ingest_lock")
+        if lock is None:
+            lock = self.__dict__.setdefault("_ingest_lock", threading.RLock())
+        return lock
 
     def _note_tenant_traffic(self, ids: Any) -> None:
         """Host-side drill-down ledger feed (rows + staleness per tenant)."""
@@ -483,13 +524,15 @@ class KeyedMetric(Metric):
         ids = self._canonical_ids(tenant_ids)
         if self.validate_ids:
             self._validate_ids_eager(ids)
-        state = self._get_states()
-        donatable = True
-        if self._jit_forward_donate:
-            state, donatable = self._donation_safe_state(state)
-        fn = self._keyed_dispatch(donatable)
-        start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
-        new_state, _ = fn(state, ids, *args, **kwargs)
+        with self._serial_lock():
+            state = self._get_states()
+            donatable = True
+            if self._jit_forward_donate:
+                state, donatable = self._donation_safe_state(state)
+            fn = self._keyed_dispatch(donatable)
+            start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
+            new_state, _ = fn(state, ids, *args, **kwargs)
+            self._set_states(new_state)
         if start is not None:
             dur = time.perf_counter() - start
             key = self.telemetry_key
@@ -512,7 +555,6 @@ class KeyedMetric(Metric):
                     compiled_this_call=bool(fn.last_compiled),
                     donated=fn.donate_state,
                 )
-        self._set_states(new_state)
 
     def update_many(self, tenant_ids: Any, *stacked: Any, **stacked_kwargs: Any) -> None:
         """K stacked keyed micro-batches in ONE compiled dispatch
@@ -524,7 +566,8 @@ class KeyedMetric(Metric):
             self._validate_ids_eager(ids.reshape(-1))
         if TELEMETRY.enabled:
             self._note_tenant_traffic(ids)
-        super().update_many(ids, *stacked, **stacked_kwargs)
+        with self._serial_lock():
+            super().update_many(ids, *stacked, **stacked_kwargs)
 
     def warmup(self, tenant_ids: Any, *sample_batch: Any, **kwargs: Any) -> Dict[str, Any]:
         """AOT lower+compile the keyed update executable for this batch shape
@@ -663,7 +706,7 @@ class KeyedMetric(Metric):
 
     def __getstate__(self) -> dict:
         state = super().__getstate__()
-        for k in ("_keyed_update_fn", "_keyed_update_copy_fn"):
+        for k in ("_keyed_update_fn", "_keyed_update_copy_fn", "_ingest_lock"):
             state.pop(k, None)
         return state
 
@@ -724,6 +767,14 @@ class MultiTenantCollection:
         self._update_many_copy_fn: Optional[CompiledDispatch] = None
         self._donation_warned = False
         self._traffic = _TenantTraffic(self.num_tenants)
+
+    def _serial_lock(self) -> "threading.RLock":
+        """Stateful-update serialization (see
+        :meth:`KeyedMetric._serial_lock`); lazy and process-local."""
+        lock = self.__dict__.get("_ingest_lock")
+        if lock is None:
+            lock = self.__dict__.setdefault("_ingest_lock", threading.RLock())
+        return lock
 
     def _note_tenant_traffic(self, ids: Any) -> None:
         """Host-side drill-down ledger feed (rows + staleness per tenant)."""
@@ -945,13 +996,15 @@ class MultiTenantCollection:
         ids = self._canonical_ids(tenant_ids)
         if self.validate_ids:
             next(iter(self._keyed.values()))._validate_ids_eager(ids)
-        state = self._collect_state()
-        donatable = True
-        if self._donate:
-            state, donatable = self._donation_safe_state(state)
-        fn = self._dispatch(donatable)
-        start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
-        new_state, _ = fn(state, ids, *args, **kwargs)
+        with self._serial_lock():
+            state = self._collect_state()
+            donatable = True
+            if self._donate:
+                state, donatable = self._donation_safe_state(state)
+            fn = self._dispatch(donatable)
+            start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
+            new_state, _ = fn(state, ids, *args, **kwargs)
+            self._writeback(new_state)
         if start is not None:
             dur = time.perf_counter() - start
             key = self.telemetry_key
@@ -980,7 +1033,6 @@ class MultiTenantCollection:
                     compiled_this_call=bool(fn.last_compiled),
                     donated=fn.donate_state,
                 )
-        self._writeback(new_state)
 
     def _scan_update_many(
         self, state: Dict[str, StateDict], stacked: Tuple, stacked_kwargs: Dict
@@ -1015,23 +1067,25 @@ class MultiTenantCollection:
         k = _microbatch_len((ids,) + stacked, stacked_kwargs)
         if self.validate_ids:
             next(iter(self._keyed.values()))._validate_ids_eager(ids.reshape(-1))
-        state = self._collect_state()
-        donatable = True
-        if self._donate:
-            state, donatable = self._donation_safe_state(state)
-        if donatable and self._donate:
-            if self._update_many_fn is None:
-                self._update_many_fn = CompiledDispatch(
-                    self._scan_update_many, donate_state=True, context_fn=self._layout_signature
-                )
-            fn = self._update_many_fn
-        else:
-            if self._update_many_copy_fn is None:
-                self._update_many_copy_fn = CompiledDispatch(
-                    self._scan_update_many, donate_state=False, context_fn=self._layout_signature
-                )
-            fn = self._update_many_copy_fn
-        new_state = fn(state, (ids,) + stacked, stacked_kwargs)
+        with self._serial_lock():
+            state = self._collect_state()
+            donatable = True
+            if self._donate:
+                state, donatable = self._donation_safe_state(state)
+            if donatable and self._donate:
+                if self._update_many_fn is None:
+                    self._update_many_fn = CompiledDispatch(
+                        self._scan_update_many, donate_state=True, context_fn=self._layout_signature
+                    )
+                fn = self._update_many_fn
+            else:
+                if self._update_many_copy_fn is None:
+                    self._update_many_copy_fn = CompiledDispatch(
+                        self._scan_update_many, donate_state=False, context_fn=self._layout_signature
+                    )
+                fn = self._update_many_copy_fn
+            new_state = fn(state, (ids,) + stacked, stacked_kwargs)
+            self._writeback(new_state)
         if TELEMETRY.enabled:
             key = self.telemetry_key
             TELEMETRY.inc(key, "update_many_calls")
@@ -1040,7 +1094,6 @@ class MultiTenantCollection:
             _note_compiled_dispatch(
                 self, fn, (ids,) + stacked, stacked_kwargs, counter="update_many_dispatches"
             )
-        self._writeback(new_state)
 
     def warmup(self, tenant_ids: Any, *sample_batch: Any, **kwargs: Any) -> Dict[str, Any]:
         """AOT lower+compile the single keyed dispatch for this batch shape
@@ -1217,6 +1270,7 @@ class MultiTenantCollection:
                 "_telemetry_key",
                 "_jit_cache_seen",
                 "_donation_warned",
+                "_ingest_lock",
             )
         }
 

@@ -105,7 +105,11 @@ def load_round(path: str) -> Tuple[int, Dict[str, Dict[str, Any]]]:
         doc = json.loads(text)
     except json.JSONDecodeError:
         doc = None
-    if isinstance(doc, dict):
+    if isinstance(doc, dict) and "metric" in doc:
+        # a single bare record (a one-config partial capture, e.g. the
+        # serving-soak round) is its own round
+        records = [doc]
+    elif isinstance(doc, dict):
         number = doc.get("n")
         records = _iter_json_lines(doc.get("tail", ""))
         parsed = doc.get("parsed")

@@ -1,0 +1,321 @@
+"""Serving-layer soak harness: sustained synthetic QPS over 10k+ tenants.
+
+Drives the whole service plane as one system — PR-6 keyed tenant scatter
+fed by the admission queue, PR-7 tenant reports as the ingest ledger, PR-9
+``compute_async``-style background reads through the SLO scheduler — under
+sustained synthetic load for a bounded wall clock, and records:
+
+* **p50/p99 ingest latency** (admission → dispatch-complete, from the
+  ``serving_ingest_seconds`` log2 histogram, measured-window only);
+* **flushes/sec** and the flush-trigger split (size vs deadline);
+* **shed fraction** with the per-reason split;
+* the **zero-lost-updates invariant**, exactly:
+  ``rows submitted − rows shed == rows dispatched ==
+  tenant_report()["rows_routed"]`` — every event row either reached tenant
+  state or is accounted under a shed reason, nothing in between;
+* that the queue's exact ledger **matches the telemetry counters**
+  (``snapshot()["serving"]``) — the observability plane cannot drift from
+  the ground truth.
+
+The dispatch side pads flush cohorts to power-of-two buckets
+(``pad_to_bucket``) against a ``validate_ids=False`` keyed metric, so the
+aval-keyed executable cache stays bounded regardless of traffic shape; all
+buckets are pre-compiled in a warmup phase OUTSIDE the measured window.
+
+Run: ``python scripts/soak.py [--tenants 10000] [--duration-s 60]
+[--qps 20000] [--out SOAK.json]`` (CI smoke: ``make soak`` /
+``bench_serving_soak`` in ``bench_suite.py`` with env knobs).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+#: default soak shape (the official capture: >=60 s over >=10k tenants)
+DEFAULT_TENANTS = 10_000
+DEFAULT_DURATION_S = 60.0
+DEFAULT_QPS = 20_000
+DEFAULT_PRODUCERS = 4
+DEFAULT_ROWS_PER_SUBMIT = 64
+DEFAULT_MAX_BATCH = 2048
+DEFAULT_MAX_DELAY_MS = 5.0
+DEFAULT_POLICY = "shed_oldest"
+DEFAULT_READ_INTERVAL_S = 1.0
+DEFAULT_MAX_STALENESS_S = 1.0
+#: ingest-latency SLO target the record's vs_baseline is judged against
+SLO_P99_MS = 100.0
+
+
+def _producer(svc, stop, seed, tenants, rows_per_submit, rate_rows_s, counters):
+    """One ingest thread: paced synthetic traffic until ``stop``."""
+    rng = np.random.RandomState(seed)
+    interval = rows_per_submit / rate_rows_s if rate_rows_s > 0 else 0.0
+    next_at = time.perf_counter()
+    while not stop.is_set():
+        ids = rng.randint(0, tenants, rows_per_submit)
+        preds = rng.rand(rows_per_submit).astype(np.float32)
+        target = (rng.rand(rows_per_submit) < preds).astype(np.int32)
+        admitted = svc.submit_many(ids, preds, target)
+        counters["submitted"] += rows_per_submit
+        counters["admitted"] += admitted
+        next_at += interval
+        delay = next_at - time.perf_counter()
+        if delay > 0:
+            stop.wait(delay)
+        elif delay < -1.0:
+            next_at = time.perf_counter()  # fell behind; do not burst-compensate
+
+
+def _reader(svc, stop, tenants, interval_s, max_staleness_s, counters):
+    """One dashboard thread: SLO-governed reads of a rotating tenant slice."""
+    rng = np.random.RandomState(10_007)
+    while not stop.is_set():
+        ids = rng.randint(0, tenants, 16)
+        t0 = time.perf_counter()
+        try:
+            svc.read(ids, max_staleness_s=max_staleness_s)
+            counters["reads"] += 1
+            counters["read_seconds"] += time.perf_counter() - t0
+        except Exception as err:  # pragma: no cover - recorded, not fatal
+            counters["read_errors"] += 1
+            counters["last_read_error"] = f"{type(err).__name__}: {err}"
+        stop.wait(interval_s)
+
+
+def run_soak(
+    *,
+    tenants: int = DEFAULT_TENANTS,
+    duration_s: float = DEFAULT_DURATION_S,
+    qps: int = DEFAULT_QPS,
+    producers: int = DEFAULT_PRODUCERS,
+    rows_per_submit: int = DEFAULT_ROWS_PER_SUBMIT,
+    max_batch: int = DEFAULT_MAX_BATCH,
+    max_delay_ms: float = DEFAULT_MAX_DELAY_MS,
+    capacity_rows: int = None,
+    policy: str = DEFAULT_POLICY,
+    read_interval_s: float = DEFAULT_READ_INTERVAL_S,
+    max_staleness_s: float = DEFAULT_MAX_STALENESS_S,
+    seed: int = 0,
+) -> dict:
+    """One full soak run; returns the JSON-serializable record."""
+    from metrics_tpu import Accuracy, KeyedMetric, observability
+    from metrics_tpu.observability.histogram import HISTOGRAMS
+    from metrics_tpu.serving import SLOScheduler
+
+    observability.reset()  # ONE queue in the ledger: telemetry == ground truth
+    # the pow2 bucket warmup compiles log2(max_batch)+1 shapes BY DESIGN;
+    # the retrace monitor would (correctly) flag that churn on a plain
+    # metric, so raise its threshold past the bucket count for this process
+    prev_threshold = observability.get_retrace_threshold()
+    observability.set_retrace_threshold(
+        max(prev_threshold, int(np.log2(max(2, max_batch))) + 8)
+    )
+    metric = KeyedMetric(Accuracy(), num_tenants=int(tenants), validate_ids=False)
+    svc = SLOScheduler(
+        metric,
+        max_staleness_s=float(max_staleness_s),
+        max_batch=int(max_batch),
+        max_delay_ms=float(max_delay_ms),
+        capacity_rows=int(capacity_rows) if capacity_rows else None,
+        policy=policy,
+        pad_to_bucket=True,
+    )
+
+    # -- warmup: pre-compile every pow2 dispatch bucket outside the window
+    rng = np.random.RandomState(seed)
+    warm_t0 = time.perf_counter()
+    b = 1
+    while b <= max_batch:
+        ids = rng.randint(0, tenants, b)
+        preds = rng.rand(b).astype(np.float32)
+        svc.submit_many(ids, preds, (preds > 0.5).astype(np.int32))
+        svc.queue.flush()
+        b *= 2
+    svc.read(max_staleness_s=0.0)  # compile the per-tenant compute fan-out
+    warmup_s = time.perf_counter() - warm_t0
+
+    # the measured window reads DELTAS against this baseline (the warmup
+    # traffic stays inside the invariant: totals are conserved end to end)
+    base_stats = svc.queue.stats()
+    HISTOGRAMS.reset()  # latency percentiles cover the window only
+
+    stop = threading.Event()
+    counters = {
+        "submitted": 0, "admitted": 0, "reads": 0, "read_errors": 0,
+        "read_seconds": 0.0,
+    }
+    rate = qps / max(1, producers)
+    threads = [
+        threading.Thread(
+            target=_producer,
+            args=(svc, stop, seed + 1 + i, tenants, rows_per_submit, rate, counters),
+            name=f"soak-producer-{i}",
+        )
+        for i in range(producers)
+    ]
+    threads.append(
+        threading.Thread(
+            target=_reader,
+            args=(svc, stop, tenants, read_interval_s, max_staleness_s, counters),
+            name="soak-reader",
+        )
+    )
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(float(duration_s))
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    drained = svc.drain(timeout=60.0)
+    elapsed = time.perf_counter() - t0
+
+    # -- the measured-window ledger (deltas) and the whole-run invariant
+    stats = svc.queue.stats()
+    window = {
+        k: stats[k] - base_stats[k]
+        for k in ("submitted", "admitted", "shed", "dispatched", "flushes")
+    }
+    shed_by_reason = {
+        r: stats["shed_by_reason"].get(r, 0) - base_stats["shed_by_reason"].get(r, 0)
+        for r in set(stats["shed_by_reason"]) | set(base_stats["shed_by_reason"])
+    }
+    ingested = metric.tenant_report()["rows_routed"]
+    # zero-lost-updates, EXACT and whole-run: every submitted row either
+    # reached tenant state or is accounted under a shed reason
+    zero_lost = (
+        stats["submitted"] - stats["shed"] == stats["dispatched"] == ingested
+        and stats["resident"] == 0
+    )
+    snap = observability.snapshot()
+    serving = snap.get("serving", {})
+    telemetry_matches = (
+        serving.get("shed_rows") == stats["shed"]
+        and serving.get("admitted_rows") == stats["admitted"]
+        and serving.get("dispatched_rows") == stats["dispatched"]
+        and serving.get("shed_by_reason") == {
+            k: v for k, v in stats["shed_by_reason"].items() if v
+        }
+    )
+
+    hists = snap.get("histograms", {})
+    ingest_key = f"serving_ingest_seconds{{policy={policy}}}"
+    ingest = hists.get(ingest_key, {})
+    flush_keys = [k for k in hists if k.startswith("serving_flush_seconds")]
+    flush_count = sum(hists[k].get("count", 0) for k in flush_keys)
+
+    record = {
+        "metric": "serving_soak_step",
+        "value": round(float(ingest.get("p99", 0.0)) * 1e6, 3),
+        "unit": "us/ingest-p99",
+        "vs_baseline": (
+            round(SLO_P99_MS * 1e3 / (ingest["p99"] * 1e6), 3)
+            if ingest.get("p99")
+            else None
+        ),
+        "tenants": int(tenants),
+        "duration_s": round(elapsed, 3),
+        "warmup_s": round(warmup_s, 3),
+        "target_qps": int(qps),
+        "achieved_qps": round(window["submitted"] / elapsed, 1) if elapsed else None,
+        "policy": policy,
+        "max_batch": int(max_batch),
+        "max_delay_ms": float(max_delay_ms),
+        "rows": {
+            "submitted": window["submitted"],
+            "admitted": window["admitted"],
+            "shed": window["shed"],
+            "dispatched": window["dispatched"],
+            "ingested_total": int(ingested),
+        },
+        "shed_fraction": (
+            round(window["shed"] / window["submitted"], 6) if window["submitted"] else 0.0
+        ),
+        "shed_by_reason": {k: v for k, v in shed_by_reason.items() if v},
+        "flushes": window["flushes"],
+        "flushes_per_s": round(window["flushes"] / elapsed, 3) if elapsed else None,
+        "flush_triggers": dict(serving.get("flushes_by_trigger", {})),
+        "ingest_ms": {
+            "p50": round(float(ingest.get("p50", 0.0)) * 1e3, 4),
+            "p99": round(float(ingest.get("p99", 0.0)) * 1e3, 4),
+            "count": int(ingest.get("count", 0)),
+        },
+        "reads": {
+            "served": counters["reads"],
+            "errors": counters["read_errors"],
+            "mean_ms": (
+                round(counters["read_seconds"] / counters["reads"] * 1e3, 3)
+                if counters["reads"]
+                else None
+            ),
+            "cache_hits": serving.get("cache_hits", 0),
+            "stale_serves": serving.get("stale_serves", 0),
+            "refreshes": serving.get("refreshes", 0),
+            "coalesced_refreshes": serving.get("coalesced_refreshes", 0),
+        },
+        "drained": bool(drained),
+        "zero_lost_updates": bool(zero_lost),
+        "shed_matches_telemetry": bool(telemetry_matches),
+        "generation": svc.generation,
+        "slo_p99_ms": SLO_P99_MS,
+    }
+    if counters.get("last_read_error"):
+        record["last_read_error"] = counters["last_read_error"]
+    svc.close()
+    observability.set_retrace_threshold(prev_threshold)
+    return record
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tenants", type=int, default=DEFAULT_TENANTS)
+    parser.add_argument("--duration-s", type=float, default=DEFAULT_DURATION_S)
+    parser.add_argument("--qps", type=int, default=DEFAULT_QPS)
+    parser.add_argument("--producers", type=int, default=DEFAULT_PRODUCERS)
+    parser.add_argument("--rows-per-submit", type=int, default=DEFAULT_ROWS_PER_SUBMIT)
+    parser.add_argument("--max-batch", type=int, default=DEFAULT_MAX_BATCH)
+    parser.add_argument("--max-delay-ms", type=float, default=DEFAULT_MAX_DELAY_MS)
+    parser.add_argument("--capacity-rows", type=int, default=None)
+    parser.add_argument(
+        "--policy", default=DEFAULT_POLICY,
+        choices=("block", "shed_oldest", "shed_tenant_over_quota"),
+    )
+    parser.add_argument("--read-interval-s", type=float, default=DEFAULT_READ_INTERVAL_S)
+    parser.add_argument("--max-staleness-s", type=float, default=DEFAULT_MAX_STALENESS_S)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", default=None, help="also write the record to this path")
+    args = parser.parse_args(argv)
+    record = run_soak(
+        tenants=args.tenants,
+        duration_s=args.duration_s,
+        qps=args.qps,
+        producers=args.producers,
+        rows_per_submit=args.rows_per_submit,
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        capacity_rows=args.capacity_rows,
+        policy=args.policy,
+        read_interval_s=args.read_interval_s,
+        max_staleness_s=args.max_staleness_s,
+        seed=args.seed,
+    )
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(record, fh, indent=2)
+    ok = record["zero_lost_updates"] and record["shed_matches_telemetry"]
+    if not ok:
+        print("# SOAK FAILED: accounting invariant violated", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -352,3 +352,50 @@ def test_compute_sync_path_untouched_and_counter_recorded(two_proc):
     coll.compute_async().result(timeout=10.0)
     counters = observability.snapshot()["metrics"][coll.telemetry_key]["counters"]
     assert counters["compute_async_calls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# coalesced submissions (the serving scheduler's shared-refresh contract)
+# ---------------------------------------------------------------------------
+
+
+def test_submit_coalesce_returns_pending_future():
+    engine = AsyncSyncEngine()
+    gate = threading.Event()
+    ran = []
+
+    def slow():
+        gate.wait(5.0)
+        ran.append(1)
+        return "value"
+
+    try:
+        first = engine.submit("k", slow, coalesce=True)
+        second = engine.submit("k", slow, coalesce=True)
+        assert second is first  # joined the in-flight job, no new generation
+        assert first.generation == 1
+        gate.set()
+        assert first.result(timeout=5.0) == "value"
+        assert len(ran) == 1
+        # the window closes with the job: a later coalescing submit queues
+        # fresh work under the next generation
+        third = engine.submit("k", lambda: "fresh", coalesce=True)
+        assert third is not first and third.generation == 2
+        assert third.result(timeout=5.0) == "fresh"
+        assert engine.summary()["coalesced"] == 1
+        assert engine.summary()["submitted"] == 2
+    finally:
+        gate.set()
+        engine.shutdown()
+
+
+def test_submit_without_coalesce_always_queues():
+    engine = AsyncSyncEngine()
+    try:
+        a = engine.submit("k", lambda: 1)
+        b = engine.submit("k", lambda: 2)
+        assert a is not b and (a.generation, b.generation) == (1, 2)
+        assert a.result(timeout=5.0) == 1 and b.result(timeout=5.0) == 2
+        assert engine.summary()["coalesced"] == 0
+    finally:
+        engine.shutdown()

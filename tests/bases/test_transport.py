@@ -747,3 +747,114 @@ def test_kvstore_channel_runs_full_gather_round(monkeypatch):
     # deferred cleanup: the payload round (seq 1) deleted the descriptor
     # round's (seq 0) keys; the final round's keys remain readable
     assert sorted(client.store) == [f"mtpu_subgroup/0-1-2/1/{r}" for r in healthy]
+
+
+# ---------------------------------------------------------------------------
+# KV-store channel auto-default (ROADMAP open-item-1 follow-up): a reachable
+# coordination-service client promotes kvstore_subgroup_allgather from
+# opt-in to the registered subgroup channel at transport creation —
+# explicit set_subgroup_allgather and the env opt-out win.
+# ---------------------------------------------------------------------------
+
+
+def _fresh_channel_state(monkeypatch):
+    from metrics_tpu.transport import gather as gather_mod
+
+    monkeypatch.setattr(gather_mod, "_SUBGROUP_ALLGATHER", None)
+    monkeypatch.setattr(gather_mod, "_CHANNEL_EXPLICIT", False)
+    monkeypatch.delenv(gather_mod.NO_KVSTORE_ENV, raising=False)
+    return gather_mod
+
+
+def test_kvstore_channel_auto_registers_at_transport_creation(monkeypatch):
+    from metrics_tpu.transport.gather import GatherTransport, kvstore_subgroup_allgather
+
+    gather_mod = _fresh_channel_state(monkeypatch)
+    client = _BlockingKVClient()
+    _install_kv_client(monkeypatch, client)
+    assert gather_mod.subgroup_allgather() is None
+    GatherTransport()
+    assert gather_mod.subgroup_allgather() is kvstore_subgroup_allgather
+    # and the auto-registered channel actually works against the fake
+    # blocking client: rank 0 exchanges with itself through the store
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    out = gather_mod.subgroup_allgather()(np.arange(4, dtype=np.uint8), [0])
+    np.testing.assert_array_equal(out[0], np.arange(4, dtype=np.uint8))
+
+
+def test_kvstore_auto_default_skips_without_runtime(monkeypatch):
+    from jax._src import distributed as jax_distributed
+
+    from metrics_tpu.transport.gather import GatherTransport
+
+    gather_mod = _fresh_channel_state(monkeypatch)
+    monkeypatch.setattr(jax_distributed.global_state, "client", None, raising=False)
+    GatherTransport()
+    assert gather_mod.subgroup_allgather() is None
+
+
+def test_kvstore_auto_default_env_opt_out(monkeypatch):
+    from metrics_tpu.transport.gather import GatherTransport
+
+    gather_mod = _fresh_channel_state(monkeypatch)
+    _install_kv_client(monkeypatch, _BlockingKVClient())
+    monkeypatch.setenv(gather_mod.NO_KVSTORE_ENV, "1")
+    GatherTransport()
+    assert gather_mod.subgroup_allgather() is None
+    # "0"/empty do NOT opt out
+    monkeypatch.setenv(gather_mod.NO_KVSTORE_ENV, "0")
+    GatherTransport()
+    assert gather_mod.subgroup_allgather() is not None
+
+
+def test_explicit_registration_beats_auto_default(monkeypatch):
+    from metrics_tpu.transport.gather import GatherTransport, set_subgroup_allgather
+
+    gather_mod = _fresh_channel_state(monkeypatch)
+    _install_kv_client(monkeypatch, _BlockingKVClient())
+    sentinel = lambda buf, participants: np.stack([buf])  # noqa: E731
+    set_subgroup_allgather(sentinel)
+    GatherTransport()
+    assert gather_mod.subgroup_allgather() is sentinel
+    # an explicit CLEAR also wins: the auto default must not resurrect
+    set_subgroup_allgather(None)
+    GatherTransport()
+    assert gather_mod.subgroup_allgather() is None
+
+
+def test_auto_registered_channel_carries_subgroup_gather_round(monkeypatch):
+    """End to end on the fake blocking client: transports created with a
+    reachable client auto-register the KV-store channel, and a quorum-style
+    subgroup round then runs through the store (the global primitive never
+    fires)."""
+    from metrics_tpu.transport import gather as gather_mod
+    from metrics_tpu.transport.gather import GatherTransport
+
+    _fresh_channel_state(monkeypatch)
+    client = _BlockingKVClient()
+    _install_kv_client(monkeypatch, client)
+
+    class _PerThreadRounds(dict):
+        def get(self, key, default=0):
+            return super().get((threading.get_ident(), key), default)
+
+        def __setitem__(self, key, value):
+            super().__setitem__((threading.get_ident(), key), value)
+
+    monkeypatch.setattr(gather_mod, "_KV_ROUNDS", _PerThreadRounds())
+    healthy = [0, 1]
+
+    def make_rank(rank):
+        def run():
+            sub = GatherTransport().subgroup(healthy)  # auto-registers
+            return sub.gather_pytrees([{"x": jnp.asarray(rank, jnp.int32)}])[0]
+
+        return run
+
+    results, errors, calls = run_rank_fns(
+        [make_rank(r) for r in range(3)], dead=[2]
+    )
+    assert errors[:2] == [None, None], errors
+    assert calls == [0, 0, 0], calls  # the global primitive never ran
+    for r in healthy:
+        assert [int(np.asarray(x)) for x in results[r]["x"]] == healthy

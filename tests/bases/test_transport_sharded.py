@@ -156,3 +156,100 @@ def test_sharded_confusion_sync_collective_counts():
     counts = sharded_confusion_sync()
     assert counts["sharded_confusion_sync"] == {"psum": 1}
     assert counts["sharded_confusion_sync_multi_dtype"] == {"psum": 2, "pmax": 1}
+
+
+# ---------------------------------------------------------------------------
+# Coverage beyond confusion matrices (ROADMAP open-item-1 follow-up): the
+# PR-10 sketch grids and the PR-6 keyed tenant axis run device-sharded end
+# to end, bit-identical (integer states) / <=1-ulp (float folds) to the
+# replicated path.
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_sketched_auroc_histogram_grid_parity():
+    """A multiclass sketched AUROC's (C, bins) histogram grids live sharded
+    over the class axis; sync keeps them sharded and compute matches the
+    replicated metric to <=1 ulp per class."""
+    from metrics_tpu import AUROC
+
+    nc, bins, n = 8, 256, 4096
+    rng = np.random.RandomState(0)
+    logits = rng.rand(n, nc).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, nc, n))
+
+    plain = AUROC(num_classes=nc, sketched=True, num_bins=bins, average=None)
+    plain.update(preds, target)
+    want = np.asarray(plain.compute())
+
+    t = ShardedTransport(_mesh_1d(), "shard")
+    sharded = AUROC(num_classes=nc, sketched=True, num_bins=bins, average=None)
+    sharded.update(preds, target)
+    t.adopt(sharded)
+    # the (C, bins) grids shard over the class axis: 1/8 per device
+    assert t.max_shard_fraction(sharded.pos_hist) == pytest.approx(1 / 8)
+    assert t.max_shard_fraction(sharded.neg_hist) == pytest.approx(1 / 8)
+    # the histogram COUNTS are integers: sharded placement must not have
+    # perturbed a single bin
+    np.testing.assert_array_equal(
+        np.asarray(plain.pos_hist), np.asarray(sharded.pos_hist)
+    )
+    with sharded.sync_context(distributed_available=lambda: True):
+        got = np.asarray(sharded.compute())
+    # float fold over identical integer histograms: <=1 ulp per class
+    np.testing.assert_array_almost_equal_nulp(got, want, nulp=1)
+    # the live grids are STILL sharded after the synced compute
+    assert t.max_shard_fraction(sharded.pos_hist) == pytest.approx(1 / 8)
+
+
+def test_sharded_keyed_stat_scores_bundle_parity():
+    """A keyed(N) stat-scores bundle — the PR-6 stacked (N, C) tp/fp/tn/fn
+    quartet — runs with the tenant axis sharded over the mesh; keyed
+    scatter updates land in the owning shard, sync is the in-place
+    identity, and per-tenant compute matches the replicated KeyedMetric bit
+    for bit (integer counts)."""
+    from metrics_tpu import KeyedMetric, StatScores
+
+    tenants, nc, rows = 64, 4, 8192
+    rng = np.random.RandomState(1)
+    ids = jnp.asarray(rng.randint(0, tenants, rows))
+    logits = rng.rand(rows, nc).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, nc, rows))
+
+    plain = KeyedMetric(StatScores(reduce="macro", num_classes=nc), tenants)
+    plain.update(ids, preds, target)
+    want = np.asarray(plain.compute())
+
+    t = ShardedTransport(_mesh_1d(), "shard")
+    sharded = KeyedMetric(StatScores(reduce="macro", num_classes=nc), tenants)
+    t.adopt(sharded)  # shard FIRST: the scatter then updates sharded buffers
+    sharded.update(ids, preds, target)
+    for leaf in ("tp", "fp", "tn", "fn"):
+        assert t.max_shard_fraction(getattr(sharded, leaf)) <= 1 / 8 + 1e-9, leaf
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sharded, leaf)), np.asarray(getattr(plain, leaf))
+        )
+    with sharded.sync_context(distributed_available=lambda: True):
+        got = np.asarray(sharded.compute())
+    np.testing.assert_array_equal(got[~np.isnan(got)], want[~np.isnan(want)])
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+
+
+def test_sharded_keyed_stat_scores_update_keeps_sharding():
+    """Donated keyed scatters preserve the tenant-axis sharding across
+    steps — no silent re-replication after the first dispatch."""
+    from metrics_tpu import KeyedMetric, StatScores
+
+    tenants, nc = 32, 4
+    rng = np.random.RandomState(2)
+    t = ShardedTransport(_mesh_1d(), "shard")
+    m = KeyedMetric(StatScores(reduce="macro", num_classes=nc), tenants)
+    t.adopt(m)
+    for _ in range(3):
+        ids = jnp.asarray(rng.randint(0, tenants, 512))
+        logits = rng.rand(512, nc).astype(np.float32)
+        preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+        target = jnp.asarray(rng.randint(0, nc, 512))
+        m.update(ids, preds, target)
+    assert t.max_shard_fraction(m.tp) <= 1 / 8 + 1e-9

@@ -1,0 +1,399 @@
+"""AdmissionQueue: micro-batch coalescing, backpressure policies, and the
+exact shed accounting the zero-lost-updates invariant stands on.
+
+The queue is host-side threading code; these tests drive it with a recording
+target (no jax needed for the mechanics) and with a real ``KeyedMetric`` for
+the end-to-end ingest ledger, and pin:
+
+* size- AND deadline-triggered flushes — a full ``max_batch`` dispatches at
+  once, a lone row dispatches within ``max_delay_ms``;
+* each policy's capacity behavior with per-reason shed accounting
+  (``block`` waits/sheds on timeout, ``shed_oldest`` evicts the oldest
+  resident rows, ``shed_tenant_over_quota`` isolates hot tenants);
+* the internal invariant ``admitted == dispatched + shed_dispatch_error +
+  resident`` at every quiescent point, including through dispatch errors;
+* the ``serving.*`` snapshot/Prometheus/event surfaces.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu import observability
+from metrics_tpu.serving import AdmissionQueue, QueueClosedError
+from metrics_tpu.serving.policy import AdmissionPolicy, resolve_policy
+
+
+class _Recorder:
+    """Flush target that records every dispatched cohort."""
+
+    def __init__(self, fail_times: int = 0, delay_s: float = 0.0):
+        self.calls = []
+        self.fail_times = fail_times
+        self.delay_s = delay_s
+        self.lock = threading.Lock()
+
+    def __call__(self, ids, *cols):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self.lock:
+            if self.fail_times > 0:
+                self.fail_times -= 1
+                raise RuntimeError("injected dispatch failure")
+            self.calls.append((np.asarray(ids).copy(), [np.asarray(c).copy() for c in cols]))
+
+    @property
+    def rows(self):
+        with self.lock:
+            return sum(len(ids) for ids, _ in self.calls)
+
+
+def _assert_invariant(q):
+    """The conservation laws of the exact ledger (see ``stats()``)."""
+    s = q.stats()
+    post_admission = s["shed_by_reason"].get("dispatch_error", 0) + s[
+        "shed_by_reason"
+    ].get("shed_oldest", 0)
+    # rows shed AFTER admission are the only gap between admitted and
+    # dispatched+resident ...
+    assert s["admitted"] == s["dispatched"] + s["resident"] + post_admission, s
+    # ... and end to end: submitted − shed(total) == dispatched + resident
+    assert s["submitted"] - s["shed"] == s["dispatched"] + s["resident"], s
+
+
+# ---------------------------------------------------------------- policies
+
+
+def test_resolve_policy_validates():
+    with pytest.raises(ValueError, match="one of"):
+        resolve_policy("drop_everything")
+    with pytest.raises(ValueError, match="block_timeout_s"):
+        AdmissionPolicy("block", block_timeout_s=-1)
+    with pytest.raises(ValueError, match="tenant_quota_rows"):
+        AdmissionPolicy("shed_tenant_over_quota", tenant_quota_rows=0)
+    with pytest.raises(ValueError, match="inside the AdmissionPolicy"):
+        resolve_policy(AdmissionPolicy("block"), block_timeout_s=1.0)
+    assert "shed_oldest" in repr(AdmissionPolicy("shed_oldest"))
+
+
+def test_queue_constructor_validates():
+    with pytest.raises(TypeError, match="callable"):
+        AdmissionQueue(None)
+    with pytest.raises(ValueError, match="max_batch"):
+        AdmissionQueue(lambda *a: None, max_batch=0)
+    with pytest.raises(ValueError, match="max_delay_ms"):
+        AdmissionQueue(lambda *a: None, max_delay_ms=0)
+    with pytest.raises(ValueError, match="capacity_rows"):
+        AdmissionQueue(lambda *a: None, max_batch=8, capacity_rows=4)
+
+
+# ---------------------------------------------------------------- triggers
+
+
+def test_size_triggered_flush_coalesces_exactly_max_batch():
+    rec = _Recorder()
+    q = AdmissionQueue(rec, max_batch=8, max_delay_ms=10_000.0, start=False)
+    admitted = q.submit_many(np.arange(8), np.arange(8, dtype=np.float32))
+    assert admitted == 8
+    assert q._flush_once("size") == 8
+    ids, cols = rec.calls[0]
+    np.testing.assert_array_equal(ids, np.arange(8))
+    np.testing.assert_array_equal(cols[0], np.arange(8, dtype=np.float32))
+    _assert_invariant(q)
+    assert q.stats()["flushes"] == 1
+
+
+def test_deadline_triggered_flush_dispatches_partial_batch():
+    rec = _Recorder()
+    q = AdmissionQueue(rec, max_batch=1024, max_delay_ms=20.0)
+    q.submit(3, np.float32(0.5))
+    deadline = time.monotonic() + 5.0
+    while rec.rows < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert rec.rows == 1  # one row flushed without ever reaching max_batch
+    s = q.stats()
+    assert s["flushes"] == 1 and s["resident"] == 0
+    _assert_invariant(q)
+    q.close()
+
+
+def test_size_trigger_fires_before_deadline():
+    rec = _Recorder()
+    q = AdmissionQueue(rec, max_batch=4, max_delay_ms=60_000.0)
+    q.submit_many(np.arange(4), np.zeros(4, np.float32))
+    deadline = time.monotonic() + 5.0
+    while rec.rows < 4 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert rec.rows == 4  # the deadline (60 s) can not have fired
+    q.close()
+
+
+def test_submit_many_validates_column_shapes():
+    q = AdmissionQueue(_Recorder(), start=False)
+    with pytest.raises(ValueError, match="one entry per row"):
+        q.submit_many([1, 2], np.zeros(3))
+    assert q.submit_many([], np.zeros(0)) == 0
+
+
+# ---------------------------------------------------------------- policies @ capacity
+
+
+def test_block_policy_waits_for_room():
+    rec = _Recorder()
+    q = AdmissionQueue(rec, max_batch=4, max_delay_ms=5.0, capacity_rows=4, policy="block")
+    # 8 rows through a 4-row queue: the producer blocks until the flusher
+    # drains room; nothing is ever shed
+    admitted = q.submit_many(np.arange(8) % 4, np.zeros(8, np.float32))
+    assert admitted == 8
+    assert q.drain(5.0)
+    s = q.stats()
+    assert s["shed"] == 0 and s["dispatched"] == 8
+    _assert_invariant(q)
+    q.close()
+
+
+def test_block_policy_timeout_sheds_exactly():
+    rec = _Recorder()
+    q = AdmissionQueue(
+        rec, max_batch=4, max_delay_ms=10_000.0, capacity_rows=4,
+        policy="block", block_timeout_s=0.05, start=False,
+    )
+    assert q.submit_many(np.arange(4), np.zeros(4, np.float32)) == 4
+    t0 = time.monotonic()
+    assert q.submit(0, np.float32(0.0)) is False  # full, no flusher: times out
+    assert time.monotonic() - t0 >= 0.04
+    s = q.stats()
+    assert s["shed_by_reason"] == {"block_timeout": 1}
+    assert s["admitted"] == 4 and s["shed"] == 1
+    _assert_invariant(q)
+
+
+def test_shed_oldest_evicts_oldest_rows():
+    rec = _Recorder()
+    q = AdmissionQueue(
+        rec, max_batch=4, max_delay_ms=10_000.0, capacity_rows=4,
+        policy="shed_oldest", start=False,
+    )
+    q.submit_many([0, 1, 2, 3], np.arange(4, dtype=np.float32))
+    q.submit_many([4, 5], np.asarray([4.0, 5.0], np.float32))
+    s = q.stats()
+    # rows 0 and 1 (the oldest) were evicted to admit 4 and 5
+    assert s["shed_by_reason"] == {"shed_oldest": 2}
+    assert s["admitted"] == 6 and s["resident"] == 4
+    q.flush()
+    ids, cols = rec.calls[0]
+    np.testing.assert_array_equal(ids, [2, 3, 4, 5])
+    np.testing.assert_array_equal(cols[0], [2.0, 3.0, 4.0, 5.0])
+    _assert_invariant(q)
+
+
+def test_shed_tenant_over_quota_isolates_hot_tenant():
+    rec = _Recorder()
+    q = AdmissionQueue(
+        rec, max_batch=64, max_delay_ms=10_000.0, capacity_rows=64,
+        policy="shed_tenant_over_quota", tenant_quota_rows=3, start=False,
+    )
+    # tenant 7 floods; tenants 1..3 trickle — the flood is capped at quota,
+    # the trickle is untouched
+    admitted_hot = q.submit_many(np.full(10, 7), np.zeros(10, np.float32))
+    admitted_cold = q.submit_many([1, 2, 3], np.zeros(3, np.float32))
+    assert admitted_hot == 3 and admitted_cold == 3
+    s = q.stats()
+    assert s["shed_by_reason"] == {"tenant_over_quota": 7}
+    q.flush()
+    ids, _ = rec.calls[0]
+    assert (ids == 7).sum() == 3
+    _assert_invariant(q)
+
+
+def test_shed_tenant_over_quota_full_queue_sheds_incoming():
+    q = AdmissionQueue(
+        _Recorder(), max_batch=4, max_delay_ms=10_000.0, capacity_rows=4,
+        policy="shed_tenant_over_quota", tenant_quota_rows=2, start=False,
+    )
+    q.submit_many([0, 1, 2, 3], np.zeros(4, np.float32))
+    assert q.submit(4, np.float32(0.0)) is False
+    assert q.stats()["shed_by_reason"] == {"queue_full": 1}
+    _assert_invariant(q)
+
+
+def test_quota_default_derived_from_capacity():
+    q = AdmissionQueue(
+        _Recorder(), max_batch=4, capacity_rows=64,
+        policy="shed_tenant_over_quota", start=False,
+    )
+    assert q.policy.tenant_quota_rows == 8  # capacity_rows // 8
+
+
+# ---------------------------------------------------------------- errors / lifecycle
+
+
+def test_dispatch_error_rows_are_accounted_shed():
+    rec = _Recorder(fail_times=1)
+    q = AdmissionQueue(rec, max_batch=4, max_delay_ms=10_000.0, start=False)
+    q.submit_many(np.arange(4), np.zeros(4, np.float32))
+    with pytest.warns(UserWarning, match="dispatch failed"):
+        q.flush()
+    q.submit_many(np.arange(4), np.zeros(4, np.float32))
+    q.flush()  # second cohort succeeds
+    s = q.stats()
+    assert s["shed_by_reason"] == {"dispatch_error": 4}
+    assert s["dispatched"] == 4 and s["admitted"] == 8
+    assert "injected dispatch failure" in s["last_error"]
+    _assert_invariant(q)
+
+
+def test_closed_queue_rejects_submissions():
+    q = AdmissionQueue(_Recorder(), max_batch=4)
+    q.submit(0, np.float32(1.0))
+    q.close()
+    with pytest.raises(QueueClosedError):
+        q.submit(0, np.float32(1.0))
+    s = q.stats()
+    assert s["closed"] is True and s["resident"] == 0 and s["dispatched"] == 1
+
+
+def test_close_flushes_residue():
+    rec = _Recorder()
+    q = AdmissionQueue(rec, max_batch=1024, max_delay_ms=60_000.0)
+    q.submit_many(np.arange(5), np.zeros(5, np.float32))
+    q.close()
+    assert rec.rows == 5
+
+
+def test_drain_timeout_returns_false():
+    rec = _Recorder(delay_s=0.5)
+    q = AdmissionQueue(rec, max_batch=2, max_delay_ms=1.0)
+    q.submit_many([0, 1], np.zeros(2, np.float32))
+    assert q.drain(0.05) is False
+    assert q.drain(5.0) is True
+    q.close()
+
+
+def test_concurrent_producers_lose_nothing():
+    rec = _Recorder()
+    q = AdmissionQueue(rec, max_batch=64, max_delay_ms=2.0, capacity_rows=512, policy="block")
+    threads = [
+        threading.Thread(
+            target=lambda: [q.submit(i % 32, np.float32(i)) for i in range(200)]
+        )
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert q.drain(10.0)
+    s = q.stats()
+    assert s["admitted"] == 800 and s["shed"] == 0
+    assert rec.rows == 800
+    _assert_invariant(q)
+    q.close()
+
+
+# ---------------------------------------------------------------- telemetry
+
+
+def test_serving_snapshot_events_and_prometheus():
+    observability.reset()
+    rec = _Recorder()
+    q = AdmissionQueue(
+        rec, max_batch=4, max_delay_ms=10_000.0, capacity_rows=4,
+        policy="shed_oldest", start=False,
+    )
+    q.submit_many(np.arange(6), np.zeros(6, np.float32))  # 2 evictions
+    q.flush()
+    snap = observability.snapshot()
+    serving = snap["serving"]
+    assert serving["admitted_rows"] >= 6
+    assert serving["shed_by_reason"].get("shed_oldest", 0) >= 2
+    assert serving["flushes_by_trigger"].get("manual", 0) >= 1
+    assert serving["shed_rows"] == sum(serving["shed_by_reason"].values())
+    # fast-path histograms materialized with the serving series
+    hists = snap["histograms"]
+    assert any(k.startswith("serving_flush_seconds") for k in hists)
+    assert any(k.startswith("serving_ingest_seconds") for k in hists)
+    assert any(k.startswith("serving_queue_depth") for k in hists)
+    # serving events landed on the timeline
+    kinds = [e.kind for e in observability.EVENTS.events()]
+    assert "serving" in kinds
+    text = observability.render_prometheus(snap)
+    assert "metrics_tpu_serving_admitted_rows_total" in text
+    assert 'metrics_tpu_serving_shed_by_reason_total{reason="shed_oldest"}' in text
+    assert 'metrics_tpu_serving_flushes_by_trigger_total{trigger="manual"}' in text
+    import json
+
+    assert json.loads(json.dumps(snap))["serving"] == serving
+
+
+def test_serving_section_merges_by_declared_rules():
+    from metrics_tpu.observability.aggregate import leaf_reduction, merge_snapshots
+
+    assert leaf_reduction(("serving", "admitted_rows")) == "sum"
+    assert leaf_reduction(("serving", "shed_by_reason", "shed_oldest")) == "sum"
+    assert leaf_reduction(("serving", "depth_high_water")) == "max"
+    a = {"serving": {"admitted_rows": 5, "depth_high_water": 9,
+                     "shed_by_reason": {"shed_oldest": 2}}}
+    b = {"serving": {"admitted_rows": 7, "depth_high_water": 3,
+                     "shed_by_reason": {"shed_oldest": 1, "queue_full": 4}}}
+    merged = merge_snapshots([a, b])["serving"]
+    assert merged["admitted_rows"] == 12
+    assert merged["depth_high_water"] == 9
+    assert merged["shed_by_reason"] == {"shed_oldest": 3, "queue_full": 4}
+
+
+def test_count_unit_histogram_layout():
+    from metrics_tpu.observability.histogram import Log2Histogram
+
+    h = Log2Histogram("count")
+    assert h.bounds()[0] == 1.0 and h.bounds()[-1] == 2.0**20
+    h.observe(5.0)  # -> bucket with upper bound 8
+    h.observe(1.0)  # exact power of two: le semantics, bound 1
+    d = h.to_dict()
+    assert d["buckets"]["le_1"] == 1 and d["buckets"]["le_8"] == 1
+
+
+def test_pad_to_bucket_dispatches_pow2_cohorts_with_discard_rows():
+    rec = _Recorder()
+    q = AdmissionQueue(
+        rec, max_batch=8, max_delay_ms=10_000.0, pad_to_bucket=True, start=False
+    )
+    q.submit_many([4, 2, 9], np.asarray([1.0, 2.0, 3.0], np.float32))
+    q.flush()
+    ids, cols = rec.calls[0]
+    assert len(ids) == 4  # 3 rows -> pow2 bucket of 4
+    np.testing.assert_array_equal(ids, [4, 2, 9, -1])  # discard row appended
+    np.testing.assert_array_equal(cols[0], [1.0, 2.0, 3.0, 0.0])
+    s = q.stats()
+    assert s["dispatched"] == 3  # padding rows are NOT accounted as traffic
+    _assert_invariant(q)
+    # a full batch is never padded
+    q.submit_many(np.arange(8), np.zeros(8, np.float32))
+    q.flush()
+    ids, _ = rec.calls[1]
+    assert len(ids) == 8 and (ids >= 0).all()
+
+
+def test_pad_to_bucket_end_to_end_with_clip_and_drop_keyed_metric():
+    """The padding contract end to end: a KeyedMetric built with
+    validate_ids=False drops the -1 discard rows inside the compiled
+    scatter, the ledger counts only real rows, and the executable cache
+    stays bounded at one program per pow2 bucket."""
+    from metrics_tpu import Accuracy, KeyedMetric
+
+    m = KeyedMetric(Accuracy(), num_tenants=8, validate_ids=False)
+    q = AdmissionQueue(m.update, max_batch=8, max_delay_ms=10_000.0,
+                       pad_to_bucket=True, start=False)
+    rng = np.random.RandomState(0)
+    for n in (1, 3, 5, 7, 2, 6):  # six distinct cohort sizes...
+        ids = rng.randint(0, 8, n)
+        preds = rng.rand(n).astype(np.float32)
+        q.submit_many(ids, preds, (preds > 0.5).astype(np.int32))
+        q.flush()
+    total = 1 + 3 + 5 + 7 + 2 + 6
+    assert m.tenant_report()["rows_routed"] == total
+    # ...but only 4 distinct dispatch shapes (pow2 buckets 1, 2, 4, 8)
+    fn = m._keyed_update_fn or m._keyed_update_copy_fn
+    assert fn._cache_size() <= 4
+    _assert_invariant(q)

@@ -1,0 +1,214 @@
+"""SLOScheduler: generation-invalidated result cache, stale-serving within
+the SLO budget, refresh coalescing, and arbitration against the queue.
+
+Driven both with a fake metric (deterministic compute counts, injectable
+latency) and end-to-end with a real ``KeyedMetric``/``MultiTenantCollection``
+over the PR-9 background engine.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, KeyedMetric, MultiTenantCollection, Precision, observability
+from metrics_tpu.serving import SLOScheduler
+from metrics_tpu.serving.telemetry import SERVING_STATS
+
+
+class _FakeMetric:
+    """Metric-shaped double: per-tenant running sums; the compute counter is
+    SHARED with clones (the scheduler computes on detached snapshots, and
+    the tests count those)."""
+
+    def __init__(self, n=8, compute_delay_s=0.0, sums=None, counter=None):
+        self.n = n
+        self.compute_delay_s = compute_delay_s
+        self.sums = np.zeros(n) if sums is None else sums.copy()
+        self._computes = counter if counter is not None else [0]
+        self.lock = threading.Lock()
+
+    @property
+    def computes(self):
+        return self._computes[0]
+
+    def update(self, tenant_ids, values):
+        with self.lock:
+            np.add.at(self.sums, np.asarray(tenant_ids), np.asarray(values))
+
+    def compute(self):
+        if self.compute_delay_s:
+            time.sleep(self.compute_delay_s)
+        with self.lock:
+            self._computes[0] += 1
+            return self.sums.copy()
+
+    def clone(self):
+        with self.lock:
+            return _FakeMetric(self.n, self.compute_delay_s, self.sums, self._computes)
+
+
+def test_scheduler_validates_metric():
+    with pytest.raises(TypeError, match="update"):
+        SLOScheduler(object())
+    with pytest.raises(ValueError, match="max_staleness_s"):
+        SLOScheduler(_FakeMetric(), max_staleness_s=-1)
+
+
+def test_read_miss_then_fresh_hit():
+    m = _FakeMetric()
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    svc.submit(2, 5.0)
+    v = svc.read(max_staleness_s=0.0)  # miss: flush + recompute
+    assert v[2] == 5.0
+    before = SERVING_STATS.counter("cache_hits")
+    v2 = svc.read([2])
+    assert v2[0] == 5.0
+    assert SERVING_STATS.counter("cache_hits") == before + 1
+    svc.close()
+
+
+def test_generation_bump_invalidates_cache():
+    """No stale cache is ever served after a generation bump when the read
+    demands freshness — the invariant the concurrency battery leans on."""
+    m = _FakeMetric()
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    svc.submit(1, 1.0)
+    assert svc.read(max_staleness_s=0.0)[1] == 1.0
+    gen1 = svc.generation
+    svc.submit(1, 2.0)
+    svc.queue.flush()
+    assert svc.generation == gen1 + 1
+    assert svc.read(max_staleness_s=0.0)[1] == 3.0  # recomputed, never cached
+    assert svc.report()["cache_fresh"] is True
+    svc.close()
+
+
+def test_resident_rows_defeat_cache_freshness():
+    """A cache entry at the current generation is NOT fresh while rows sit
+    undispatched in the queue — read-your-writes demands the flush."""
+    m = _FakeMetric()
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    svc.submit(0, 1.0)
+    assert svc.read(max_staleness_s=0.0)[0] == 1.0
+    svc.submit(0, 1.0)  # resident, generation unchanged
+    assert svc.read(max_staleness_s=0.0)[0] == 2.0  # flushed + recomputed
+    svc.close()
+
+
+def test_stale_within_budget_serves_and_refreshes_in_background():
+    m = _FakeMetric()
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    svc.submit(3, 1.0)
+    assert svc.read(max_staleness_s=0.0)[3] == 1.0
+    svc.submit(3, 1.0)
+    svc.queue.flush()  # generation bumped: the cache is now one gen behind
+    before = SERVING_STATS.counter("stale_serves")
+    v = svc.read(max_staleness_s=60.0)  # within budget: stale value, now
+    assert v[3] == 1.0  # the PREVIOUS generation, served immediately
+    assert SERVING_STATS.counter("stale_serves") == before + 1
+    fut = svc.refresh()  # the background refresh was scheduled; join it
+    fut.result(timeout=10.0)
+    svc.refresh(wait=True)
+    assert svc.read(max_staleness_s=60.0)[3] == 2.0  # cache caught up
+    svc.close()
+
+
+def test_concurrent_stale_reads_coalesce_one_refresh():
+    m = _FakeMetric(compute_delay_s=0.2)
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    svc.submit(0, 1.0)
+    results = []
+    threads = [
+        threading.Thread(target=lambda: results.append(svc.read(max_staleness_s=0.0)))
+        for _ in range(4)
+    ]
+    before = SERVING_STATS.counter("coalesced_refreshes")
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 4 and all(r[0] == 1.0 for r in results)
+    # all four blocking reads resolved from AT MOST two computes (one
+    # refresh per generation; late arrivals join the in-flight one)
+    assert m.computes <= 2
+    assert SERVING_STATS.counter("coalesced_refreshes") >= before + 2
+    svc.close()
+
+
+def test_updates_keep_flowing_during_inflight_read():
+    """Arbitration: an epoch read (slow compute) never blocks the write
+    path — flushes dispatch while the refresh is in flight."""
+    m = _FakeMetric(compute_delay_s=0.3)
+    svc = SLOScheduler(m, max_batch=4, max_delay_ms=5.0)
+    svc.submit(0, 1.0)
+    svc.drain(5.0)
+    fut = svc.refresh()  # slow compute in flight on the engine
+    t0 = time.monotonic()
+    svc.submit_many(np.arange(4), np.ones(4))
+    assert svc.drain(5.0)  # dispatched well before the compute resolves
+    dispatched_in = time.monotonic() - t0
+    assert dispatched_in < 0.25, dispatched_in
+    fut.result(timeout=10.0)
+    svc.close()
+
+
+def test_keyed_metric_end_to_end():
+    observability.reset()
+    m = KeyedMetric(Accuracy(), num_tenants=16)
+    svc = SLOScheduler(m, max_batch=32, max_delay_ms=5.0, max_staleness_s=0.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 16, 128)
+    preds = rng.rand(128).astype(np.float32)
+    target = (preds > 0.5).astype(np.int32)  # all-correct stream
+    assert svc.submit_many(ids, preds, target) == 128
+    values = svc.read()
+    seen = np.unique(ids)
+    np.testing.assert_allclose(np.asarray(values)[seen], 1.0)
+    # the ledger agrees with the queue: zero-lost-updates
+    s = svc.queue.stats()
+    assert m.tenant_report()["rows_routed"] == s["admitted"] - s["shed"]
+    svc.close()
+
+
+def test_multitenant_collection_reads_select_per_member():
+    coll = MultiTenantCollection(
+        [Accuracy(), Precision(num_classes=2, average="macro", multiclass=True)], 8
+    )
+    svc = SLOScheduler(coll, max_batch=16, max_delay_ms=5.0, max_staleness_s=0.0)
+    preds = np.asarray([0.9, 0.8, 0.2], np.float32)
+    target = np.asarray([1, 1, 0], np.int32)
+    svc.submit_many([2, 2, 5], preds, target)
+    out = svc.read([2, 5])
+    assert set(out) == {"Accuracy", "Precision"}
+    np.testing.assert_allclose(out["Accuracy"], [1.0, 1.0])
+    svc.close()
+
+
+def test_refresh_rides_the_async_engine_generations():
+    from metrics_tpu.utilities.async_sync import get_engine
+
+    m = KeyedMetric(Accuracy(), num_tenants=4)
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    svc.submit(0, np.float32(0.9), np.int32(1))
+    svc.read(max_staleness_s=0.0)
+    assert get_engine().last_generation(m.telemetry_key) >= 1
+    snap = observability.snapshot()
+    assert snap["async_sync"]["submitted"] >= 1
+    svc.close()
+
+
+def test_scheduler_report_shape():
+    m = _FakeMetric()
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    rep = svc.report()
+    assert rep["cache_generation"] is None and rep["cache_fresh"] is False
+    svc.submit(0, 1.0)
+    svc.read(max_staleness_s=0.0)
+    rep = svc.report()
+    assert rep["generation"] == 1 and rep["cache_generation"] == 1
+    assert rep["queue"]["admitted"] == 1
+    import json
+
+    json.dumps(rep)
+    svc.close()

@@ -373,3 +373,38 @@ def test_sharded_state_sync_bench_record_round_trips(monkeypatch):
         assert giant["sharded_sync_payload_bytes"] == 0
         assert giant["replicated_sync_payload_bytes"] == giant["state_bytes"]
     assert "bench_sharded_state_sync" in bench_suite.CONFIG_META
+
+
+def test_serving_soak_bench_record_round_trips(monkeypatch):
+    """The serving-soak config's record must survive json round-trips and
+    carry the acceptance evidence: the zero-lost-updates invariant held
+    exactly (rows submitted − rows shed == rows dispatched == rows the
+    tenant ledger ingested), the queue's exact ledger matched the
+    ``serving.*`` telemetry counters, and the p50/p99 ingest latency rode
+    the record."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "SOAK_TENANTS", 128)
+    monkeypatch.setattr(bench_suite, "SOAK_DURATION_S", 1.5)
+    monkeypatch.setattr(bench_suite, "SOAK_QPS", 1000)
+    monkeypatch.setattr(bench_suite, "SOAK_MAX_BATCH", 64)
+
+    line = bench_suite.run_config(bench_suite.bench_serving_soak, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "serving_soak_step" and line["unit"] == "us/ingest-p99"
+    assert line["zero_lost_updates"] is True  # the acceptance pin
+    assert line["shed_matches_telemetry"] is True
+    assert line["tenants"] == 128
+    rows = line["rows"]
+    assert rows["submitted"] - rows["shed"] == rows["dispatched"]
+    assert rows["submitted"] > 0 and line["flushes"] > 0
+    # one ingest-latency observation per dispatched row, window-exact
+    assert line["ingest_ms"]["count"] == rows["dispatched"]
+    assert line["ingest_ms"]["p99"] >= line["ingest_ms"]["p50"] >= 0
+    assert line["shed_fraction"] == (
+        round(rows["shed"] / rows["submitted"], 6) if rows["submitted"] else 0.0
+    )
+    assert line["drained"] is True
+    assert "telemetry" in line and "serving" in line["telemetry"]
+    assert "bench_serving_soak" in bench_suite.CONFIG_META

@@ -281,3 +281,60 @@ def test_transport_layer_documented_and_cross_linked():
     ):
         assert phrase in obs, phrase
     assert "performance.md#transport-layer" in obs
+
+
+def test_serving_layer_documented_and_cross_linked():
+    """The serving layer's user contract lives in three places: its own
+    guide (queue/scheduler/policy knobs, SLO guidance, shed accounting,
+    the soak harness), the performance guide (cost model + cross-link),
+    and the observability guide (the serving.* telemetry family) — all
+    cross-linked, plus the README quickstart snippet."""
+    with open(f"{DOCS_DIR}/serving.md") as fh:
+        serving = fh.read()
+    for phrase in (
+        "AdmissionQueue",
+        "SLOScheduler",
+        "max_batch",
+        "max_delay_ms",
+        "capacity_rows",
+        "block_timeout_s",
+        "tenant_quota_rows",
+        "pad_to_bucket",
+        "shed_oldest",
+        "shed_tenant_over_quota",
+        "block_timeout",
+        "queue_full",
+        "dispatch_error",
+        "max_staleness_s",
+        "stale_serves",
+        "coalesced_refreshes",
+        "zero-lost-updates",
+        "tenant_report",
+        "make soak",
+        "BENCH_r07.json",
+        "SLO guidance",
+        "observability.md#serving-telemetry",
+    ):
+        assert phrase in serving, phrase
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "## Serving layer" in perf
+    for phrase in ("serving.md", "serving_soak_step", "observability.md#serving-telemetry"):
+        assert phrase in perf, phrase
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "## Serving telemetry" in obs
+    for phrase in (
+        "serving_ingest_seconds",
+        "serving_flush_seconds",
+        "serving_queue_depth",
+        "shed_by_reason",
+        "flushes_by_trigger",
+        "generation_bumps",
+        "metrics_tpu_serving_",
+        "coalesce=True",
+    ):
+        assert phrase in obs, phrase
+    with open(os.path.join(os.path.dirname(DOCS_DIR), "README.md")) as fh:
+        readme = fh.read()
+    assert "docs/serving.md" in readme and "SLOScheduler" in readme
