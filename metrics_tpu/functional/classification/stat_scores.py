@@ -43,6 +43,17 @@ def _stat_scores(
     else:
         raise ValueError(f"The `reduce` {reduce} is not valid.")
 
+    if reduce == "macro" and preds.ndim == 2:
+        # the Pallas fused tp/fp/tn/fn kernel owns this shape on TPU; on any
+        # other backend (or past the shape gates) it returns None and the
+        # pre-existing compare chain below runs byte-identically (the
+        # zero-overhead gate pins the kernels-off lowering)
+        from metrics_tpu.kernels.stat_scores import stat_scores_counts_auto
+
+        fused = stat_scores_counts_auto(preds, target)
+        if fused is not None:
+            return fused
+
     true_pred = target == preds
     false_pred = target != preds
     pos_pred = preds == 1

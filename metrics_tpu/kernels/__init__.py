@@ -11,7 +11,21 @@ the portable fallback used on CPU and for any shape the kernel does not cover.
 Dispatch contract: every kernel module exposes ``<op>(...)`` (auto: Pallas on
 TPU when the shape qualifies, XLA otherwise) plus ``<op>_pallas`` /
 ``<op>_xla`` for explicit selection and testing (``interpret=True`` runs the
-Pallas path on CPU).
+Pallas path on CPU). Every auto-dispatch decision lands on the
+``kernel.dispatch`` telemetry counter (``snapshot()["kernels"]`` /
+``metrics_tpu_kernel_dispatch_total{op=...,path=...}``), and with the Pallas
+paths gated off the traced hot programs are byte-identical to the
+pre-kernel lowerings (pinned by ``scripts/check_zero_overhead.py``).
+
+The suite (gates documented in ``docs/performance.md#pallas-kernels``):
+
+* ``confmat_counts`` — confusion-matrix counting via MXU one-hot matmul;
+* ``segment_scatter_add`` — the multi-tenant segment-scatter: bucketing,
+  clip-and-drop, and scatter-accumulate fused into one VMEM pass;
+* ``label_score_histograms`` — the ``sketched=True`` histogram build:
+  bucketize + per-class segment-sum in one VMEM pass;
+* ``stat_scores_counts`` — fused tp/fp/tn/fn counting for the stat-scores
+  quintet.
 """
 from metrics_tpu.kernels.confusion_matrix import (  # noqa: F401
     confmat_counts,
@@ -22,6 +36,18 @@ from metrics_tpu.kernels.binned_counts import (  # noqa: F401
     binned_tp_fp_fn,
     binned_tp_fp_fn_xla,
     label_score_histograms,
+    label_score_histograms_pallas,
+    label_score_histograms_xla,
+)
+from metrics_tpu.kernels.segment_scatter import (  # noqa: F401
+    segment_scatter_add,
+    segment_scatter_add_pallas,
+    segment_scatter_add_xla,
+)
+from metrics_tpu.kernels.stat_scores import (  # noqa: F401
+    stat_scores_counts,
+    stat_scores_counts_pallas,
+    stat_scores_counts_xla,
 )
 from metrics_tpu.kernels.sketches import (  # noqa: F401
     bounded_priority_keep,

@@ -1,5 +1,18 @@
 """Shared plumbing for the Pallas kernel modules: availability probe,
-alignment helper, and the common part of the auto-dispatch predicate."""
+alignment helper, the common part of the auto-dispatch predicate, and the
+``kernel.dispatch`` decision counters.
+
+The dispatch counters are host-side bookkeeping in the health-guard style:
+recording happens where the auto-dispatch decision is made (eagerly, or once
+per trace when the ``<op>`` wrapper runs under ``jit``), never inside the
+compiled program — the zero-overhead gate's byte-identical-jaxpr discipline
+is untouched. They surface as ``observability.snapshot()["kernels"]`` and
+the ``metrics_tpu_kernel_dispatch_total{op=...,path=...}`` Prometheus
+family.
+"""
+import threading
+from typing import Any, Dict
+
 import jax
 
 try:  # pltpu import fails on builds without TPU support compiled in
@@ -26,3 +39,48 @@ def pallas_auto_ok(num_elems: int) -> bool:
         and jax.default_backend() == "tpu"
         and 0 < num_elems <= _MAX_PALLAS_SAMPLES
     )
+
+
+# --------------------------------------------------------------------------
+# kernel.dispatch decision counters
+# --------------------------------------------------------------------------
+
+_DISPATCH_LOCK = threading.Lock()
+#: ``{op: {"pallas": n, "xla": n}}`` — auto-dispatch decisions per kernel op
+_DISPATCH_COUNTS: Dict[str, Dict[str, int]] = {}
+
+
+def note_kernel_dispatch(op: str, path: str) -> None:
+    """Record one auto-dispatch decision (``path`` ∈ ``pallas``/``xla``).
+
+    Gated on the lock-free telemetry-enabled read like every other call
+    site; a disabled stack pays one attribute read. Host-side only — when
+    the ``<op>`` wrapper runs inside a trace this records once per trace,
+    which is exactly when the decision is made (the compiled program replays
+    it for free).
+    """
+    from metrics_tpu.observability.registry import TELEMETRY
+
+    if not TELEMETRY.enabled:
+        return
+    with _DISPATCH_LOCK:
+        by_path = _DISPATCH_COUNTS.setdefault(op, {})
+        by_path[path] = by_path.get(path, 0) + 1
+
+
+def dispatch_summary() -> Dict[str, Any]:
+    """The ``snapshot()["kernels"]`` section: per-op dispatch-path counts."""
+    with _DISPATCH_LOCK:
+        return {"dispatch": {op: dict(paths) for op, paths in _DISPATCH_COUNTS.items()}}
+
+
+def dispatch_count(op: str, path: str) -> int:
+    """Point read of one decision counter (test/assert helper)."""
+    with _DISPATCH_LOCK:
+        return _DISPATCH_COUNTS.get(op, {}).get(path, 0)
+
+
+def reset_dispatch_counters() -> None:
+    """Zero the decision counters (tests; production counters are monotonic)."""
+    with _DISPATCH_LOCK:
+        _DISPATCH_COUNTS.clear()

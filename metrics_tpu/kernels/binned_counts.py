@@ -1,4 +1,4 @@
-"""Fused binned precision-recall counts.
+"""Fused binned precision-recall counts and label/score sketch histograms.
 
 Computes the per-threshold confusion counts behind
 :class:`~metrics_tpu.classification.binned_precision_recall.BinnedPrecisionRecallCurve`:
@@ -6,18 +6,158 @@ Computes the per-threshold confusion counts behind
 state the reference fills with a Python loop over thresholds,
 ``classification/binned_precision_recall.py:135-153``).
 
-The formulation is one broadcast compare ``(N, C, 1) >= (T,)`` reduced over
-N. XLA fuses the compare-and-reduce without materializing the ``(N, C, T)``
-boolean — on a real v5e chip this beat a hand-written Pallas histogram
-kernel at every measured size (5x at best, 1000x at small sizes; the
-histogram's one-hot-contraction bincount does ``N·C²·T`` work, a factor C
-more than the fused compare, so it can never win). The kernel was removed;
-the compiler's fusion is the right tool here.
+The per-threshold formulation is one broadcast compare ``(N, C, 1) >= (T,)``
+reduced over N. XLA fuses the compare-and-reduce without materializing the
+``(N, C, T)`` boolean — on a real v5e chip this beat a hand-written Pallas
+histogram kernel at every measured size (5x at best, 1000x at small sizes;
+the histogram's one-hot-contraction bincount does ``N·C²·T`` work, a factor
+C more than the fused compare, so it can never win). That kernel was removed;
+the compiler's fusion is the right tool there.
+
+:func:`label_score_histograms` — the bounded-memory O(N·C) sketch build that
+feeds every ``sketched=True`` state — is a different economy: its cost does
+NOT scale with the threshold resolution, so a hand-fused bucketize +
+per-class segment-sum in one VMEM-resident pass wins where the per-threshold
+kernel lost. It follows the kernels dispatch contract
+(:mod:`metrics_tpu.kernels`): ``label_score_histograms`` auto-dispatches,
+``label_score_histograms_pallas`` takes ``interpret=`` for CPU testing,
+``label_score_histograms_xla`` is the portable scatter-add formulation.
 """
-from typing import Tuple
+import functools
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from metrics_tpu.kernels._common import (
+    _PALLAS_TPU_AVAILABLE,
+    _round_up,
+    note_kernel_dispatch,
+    pallas_auto_ok,
+    pltpu,
+)
+
+#: largest histogram resolution the Pallas path handles: VMEM must hold the
+#: (TILE, B̃) one-hot tile (B̃=4096 at TILE=256 -> 4 MB, in budget)
+_MAX_PALLAS_BINS = 4096
+_TILE = 256
+
+
+def label_score_pallas_ok(num_rows: int, num_classes: int, num_bins: int) -> bool:
+    """True when the auto dispatch would select the Pallas sketch kernel for
+    this shape: TPU backend plus the per-kernel VMEM shape limits."""
+    return (
+        pallas_auto_ok(num_rows * max(num_classes, 1))
+        and num_classes >= 1
+        and 1 <= num_bins <= _MAX_PALLAS_BINS
+    )
+
+
+def label_score_histograms_xla(
+    preds: jax.Array,
+    target: jax.Array,
+    num_bins: int,
+    lo: float = 0.0,
+    hi: float = 1.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Scatter-add formulation of the label/score sketch histograms."""
+    span = hi - lo
+    x = preds.astype(jnp.float32)
+    idx = jnp.clip(
+        jnp.floor((x - lo) / span * num_bins), 0, num_bins - 1
+    ).astype(jnp.int32)
+    pos = (target == 1).astype(jnp.float32)
+    clipped = jnp.sum((x < lo) | (x > hi)).astype(jnp.float32)
+
+    def one_column(ix: jax.Array, p: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        zeros = jnp.zeros((num_bins,), jnp.float32)
+        return zeros.at[ix].add(p), zeros.at[ix].add(1.0 - p)
+
+    pos_hist, neg_hist = jax.vmap(one_column, in_axes=(1, 1), out_axes=0)(idx, pos)
+    return pos_hist, neg_hist, clipped
+
+
+def _hist_kernel(x_ref, pos_ref, neg_ref, pos_out, neg_out, clip_out, *, num_bins, lo, hi):
+    col, step = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(step == 0)
+    def _():
+        pos_out[:] = jnp.zeros_like(pos_out)
+        neg_out[:] = jnp.zeros_like(neg_out)
+
+    @pl.when((col == 0) & (step == 0))
+    def _():
+        clip_out[:] = jnp.zeros_like(clip_out)
+
+    bpad = pos_out.shape[1]
+    x = x_ref[:]  # (TILE, 1) scores; padded rows carry lo (in-range, zero label mass)
+    span = hi - lo
+    idx = jnp.clip(jnp.floor((x - lo) / span * num_bins), 0, num_bins - 1).astype(jnp.int32)
+    bins = jax.lax.broadcasted_iota(jnp.int32, (1, bpad), 1)
+    onehot = (idx == bins).astype(jnp.float32)  # (TILE, B̃) built in VMEM
+    contract = (((0,), (0,)), ((), ()))  # over the tile axis
+    pos_out[:] += jax.lax.dot_general(
+        pos_ref[:], onehot, dimension_numbers=contract, preferred_element_type=jnp.float32
+    )
+    neg_out[:] += jax.lax.dot_general(
+        neg_ref[:], onehot, dimension_numbers=contract, preferred_element_type=jnp.float32
+    )
+    clip_out[:] += jnp.sum(((x < lo) | (x > hi)).astype(jnp.float32)).reshape(1, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "lo", "hi", "interpret"))
+def label_score_histograms_pallas(
+    preds: jax.Array,
+    target: jax.Array,
+    num_bins: int,
+    lo: float = 0.0,
+    hi: float = 1.0,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """VMEM-fused formulation: bucketize + per-class segment-sum in one pass.
+
+    Per grid step one ``(TILE,)`` score column bucketizes in VMEM (iota
+    compare — no materialized index array in HBM) and both label histograms
+    accumulate by one MXU contraction each into the resident ``(1, B̃)``
+    output rows. ``interpret=True`` runs the Pallas interpreter (CPU
+    testing). Requires ``num_classes >= 1``.
+    """
+    n, c = preds.shape
+    x = preds.astype(jnp.float32)
+    pos = (target == 1).astype(jnp.float32)
+    neg = 1.0 - pos
+    npad = _round_up(max(n, _TILE), _TILE)
+    bpad = _round_up(num_bins, 128)
+    pad_rows = lambda a, v: jnp.pad(  # noqa: E731
+        a, ((0, npad - n), (0, 0)), constant_values=v
+    )
+
+    grid = (c, npad // _TILE)
+    vmem = pltpu.VMEM if _PALLAS_TPU_AVAILABLE else None
+    col_block = lambda: pl.BlockSpec(  # noqa: E731
+        (_TILE, 1), lambda col, step: (step, col), memory_space=vmem
+    )
+    hist_block = lambda: pl.BlockSpec(  # noqa: E731
+        (1, bpad), lambda col, step: (col, 0), memory_space=vmem
+    )
+    pos_hist, neg_hist, clipped = pl.pallas_call(
+        functools.partial(_hist_kernel, num_bins=num_bins, lo=lo, hi=hi),
+        grid=grid,
+        in_specs=[col_block(), col_block(), col_block()],
+        out_specs=[
+            hist_block(),
+            hist_block(),
+            pl.BlockSpec((1, 1), lambda col, step: (0, 0), memory_space=vmem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((c, bpad), jnp.float32),
+            jax.ShapeDtypeStruct((c, bpad), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(pad_rows(x, lo), pad_rows(pos, 0.0), pad_rows(neg, 0.0))
+    return pos_hist[:, :num_bins], neg_hist[:, :num_bins], clipped[0, 0]
 
 
 def label_score_histograms(
@@ -26,6 +166,7 @@ def label_score_histograms(
     num_bins: int,
     lo: float = 0.0,
     hi: float = 1.0,
+    use_pallas: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Per-bin score counts split by label: two ``(C, B)`` float32 histograms.
 
@@ -42,21 +183,17 @@ def label_score_histograms(
     counted in the returned scalar); ``target`` is ``(N, C)`` binary
     {0, 1}. Returns ``(pos_hist, neg_hist, clipped)``. Counts are float32 —
     exact integers far below 2**24, and psum/merge-reducible by ``+``.
+
+    ``use_pallas=None`` selects the fused Pallas kernel on a TPU backend
+    when the shape fits the VMEM gates and the XLA scatter otherwise; the
+    decision lands on the ``kernel.dispatch`` telemetry counter either way.
     """
-    span = hi - lo
-    x = preds.astype(jnp.float32)
-    idx = jnp.clip(
-        jnp.floor((x - lo) / span * num_bins), 0, num_bins - 1
-    ).astype(jnp.int32)
-    pos = (target == 1).astype(jnp.float32)
-    clipped = jnp.sum((x < lo) | (x > hi)).astype(jnp.float32)
-
-    def one_column(ix: jax.Array, p: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        zeros = jnp.zeros((num_bins,), jnp.float32)
-        return zeros.at[ix].add(p), zeros.at[ix].add(1.0 - p)
-
-    pos_hist, neg_hist = jax.vmap(one_column, in_axes=(1, 1), out_axes=0)(idx, pos)
-    return pos_hist, neg_hist, clipped
+    if use_pallas is None:
+        use_pallas = label_score_pallas_ok(preds.shape[0], preds.shape[1], num_bins)
+    note_kernel_dispatch("label_score_histograms", "pallas" if use_pallas else "xla")
+    if use_pallas:
+        return label_score_histograms_pallas(preds, target, num_bins, lo, hi)
+    return label_score_histograms_xla(preds, target, num_bins, lo, hi)
 
 
 def binned_tp_fp_fn(

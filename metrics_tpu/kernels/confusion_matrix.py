@@ -24,6 +24,7 @@ from jax.experimental import pallas as pl
 from metrics_tpu.kernels._common import (
     _PALLAS_TPU_AVAILABLE,
     _round_up,
+    note_kernel_dispatch,
     pallas_auto_ok,
     pltpu,
 )
@@ -101,6 +102,7 @@ def confmat_counts(
     """
     if use_pallas is None:
         use_pallas = pallas_auto_ok(preds.size) and num_classes <= _MAX_PALLAS_CLASSES
+    note_kernel_dispatch("confmat_counts", "pallas" if use_pallas else "xla")
     if use_pallas:
         return confmat_counts_pallas(preds, target, num_classes)
     return confmat_counts_xla(preds, target, num_classes)

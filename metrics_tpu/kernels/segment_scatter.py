@@ -1,0 +1,156 @@
+"""Fused segment-scatter accumulation kernel — the multi-tenant hot path.
+
+The keyed tenant update (:mod:`metrics_tpu.wrappers.multitenant`) routes one
+mixed event batch to N tenants' stacked states: bucket each row by tenant id,
+clip-and-drop invalid ids, and scatter-accumulate the per-row state deltas
+into the ``(N, ...)`` bundle. Two TPU-native formulations:
+
+* **XLA fallback** — ``jax.ops.segment_sum`` over ids clipped to a discard
+  bucket (row ``N`` of an ``N+1``-segment reduction that is sliced away).
+  Portable, but the scatter serializes on TPU and each state leaf pays its
+  own gather/scatter round-trip through HBM.
+* **Pallas kernel** — the MXU formulation ``onehot(ids)ᵀ @ rows`` with the
+  one-hot built inside the kernel (iota-compare in VMEM), the whole packed
+  row-delta bundle contracted in ONE kernel: per grid step one
+  ``(TILE, Ñ)ᵀ @ (TILE, D̃)`` accumulates into the ``(Ñ, D̃)`` output block
+  kept resident in VMEM. Bucketing, clip-and-drop (invalid ids build an
+  all-zero one-hot row — they can never scatter into a real segment), and
+  the scatter-accumulate fuse into one VMEM-resident pass; a ones column
+  smuggled into the padded row matrix yields the per-segment row counts from
+  the same contraction.
+
+Dispatch contract (see :mod:`metrics_tpu.kernels`): ``segment_scatter_add``
+auto-dispatches, ``segment_scatter_add_pallas`` takes ``interpret=`` for CPU
+testing, ``segment_scatter_add_xla`` is the portable formulation. Sums are
+float32 — bit-identical to the XLA path for integer-valued data below 2^24
+(the auto gate's sample cap), last-ulp reassociation tolerance for arbitrary
+floats.
+"""
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from metrics_tpu.kernels._common import (
+    _PALLAS_TPU_AVAILABLE,
+    _round_up,
+    note_kernel_dispatch,
+    pallas_auto_ok,
+    pltpu,
+)
+
+#: largest segment count the Pallas path handles: VMEM must hold the
+#: (TILE, Ñ) one-hot tile plus the (Ñ, D̃) f32 accumulator
+_MAX_PALLAS_SEGMENTS = 1024
+#: largest packed feature width (D̃ = D + 1 for the smuggled counts column,
+#: rounded to the 128-lane boundary)
+_MAX_PALLAS_FEATURES = 511
+_TILE = 256
+
+
+def segment_scatter_pallas_ok(num_rows: int, num_segments: int, num_features: int) -> bool:
+    """True when the auto dispatch would select the Pallas kernel for this
+    shape: TPU backend plus the per-kernel VMEM shape limits."""
+    return (
+        pallas_auto_ok(num_rows * max(num_features, 1))
+        and 1 <= num_segments <= _MAX_PALLAS_SEGMENTS
+        and 1 <= num_features <= _MAX_PALLAS_FEATURES
+    )
+
+
+def segment_scatter_add_xla(
+    rows: jax.Array, segment_ids: jax.Array, num_segments: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter-add formulation: ``((S, D) float32 sums, (S,) int32 counts)``.
+
+    Invalid ids (negative or ``>= num_segments``) clip to a discard bucket
+    and contribute to neither output.
+    """
+    ids = segment_ids.reshape(-1).astype(jnp.int32)
+    valid = (ids >= 0) & (ids < num_segments)
+    safe = jnp.where(valid, ids, num_segments)
+    sums = jax.ops.segment_sum(
+        rows.astype(jnp.float32), safe, num_segments=num_segments + 1
+    )[:num_segments]
+    counts = jax.ops.segment_sum(
+        valid.astype(jnp.int32), safe, num_segments=num_segments + 1
+    )[:num_segments]
+    return sums, counts
+
+
+def _scatter_kernel(ids_ref, data_ref, out_ref):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    segs = jax.lax.broadcasted_iota(jnp.int32, (1, out_ref.shape[0]), 1)
+    # invalid / padded ids (-1, or >= the real segment count) either match no
+    # column or match a padding row sliced away by the caller: clip-and-drop
+    onehot = (ids_ref[:] == segs).astype(jnp.float32)  # (TILE, Ñ)
+    out_ref[:] += jax.lax.dot_general(
+        onehot,
+        data_ref[:],
+        dimension_numbers=(((0,), (0,)), ((), ())),  # contract over the tile axis
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def segment_scatter_add_pallas(
+    rows: jax.Array, segment_ids: jax.Array, num_segments: int, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    """MXU one-hot-contraction formulation of :func:`segment_scatter_add_xla`.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU testing).
+    """
+    r, d = rows.shape
+    spad = _round_up(num_segments, 128)
+    dpad = _round_up(d + 1, 128)  # +1: the smuggled per-segment counts column
+    npad = _round_up(max(r, _TILE), _TILE)
+
+    ids = segment_ids.reshape(-1).astype(jnp.int32)
+    ids_p = jnp.pad(ids, (0, npad - r), constant_values=-1).reshape(npad, 1)
+    data = jnp.zeros((npad, dpad), jnp.float32)
+    data = data.at[:r, :d].set(rows.astype(jnp.float32))
+    data = data.at[:r, d].set(1.0)
+
+    grid = npad // _TILE
+    vmem = pltpu.VMEM if _PALLAS_TPU_AVAILABLE else None
+    out = pl.pallas_call(
+        _scatter_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_TILE, 1), lambda i: (i, 0), memory_space=vmem),
+            pl.BlockSpec((_TILE, dpad), lambda i: (i, 0), memory_space=vmem),
+        ],
+        out_specs=pl.BlockSpec((spad, dpad), lambda i: (0, 0), memory_space=vmem),
+        out_shape=jax.ShapeDtypeStruct((spad, dpad), jnp.float32),
+        interpret=interpret,
+    )(ids_p, data)
+    return out[:num_segments, :d], out[:num_segments, d].astype(jnp.int32)
+
+
+def segment_scatter_add(
+    rows: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Segment-scatter accumulation with automatic backend dispatch.
+
+    ``rows`` is ``(R, D)`` per-row values, ``segment_ids`` the rank-1 routing
+    vector; returns ``((S, D) float32 sums, (S,) int32 valid-row counts)``.
+    ``use_pallas=None`` selects the Pallas kernel on a TPU backend when the
+    shape fits the VMEM gates and the XLA scatter otherwise; the decision
+    lands on the ``kernel.dispatch`` telemetry counter either way.
+    """
+    if use_pallas is None:
+        use_pallas = segment_scatter_pallas_ok(rows.shape[0], num_segments, rows.shape[1])
+    note_kernel_dispatch("segment_scatter_add", "pallas" if use_pallas else "xla")
+    if use_pallas:
+        return segment_scatter_add_pallas(rows, segment_ids, num_segments)
+    return segment_scatter_add_xla(rows, segment_ids, num_segments)

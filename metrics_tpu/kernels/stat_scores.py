@@ -1,0 +1,161 @@
+"""Fused tp/fp/tn/fn counting kernel — the stat-scores family's inner loop.
+
+The per-class confusion counts behind the Precision/Recall/F1/Specificity/
+StatScores quintet (the compute-group flagship) reduce canonical binary
+``(N, C)`` inputs with four masked sums
+(``functional/classification/stat_scores.py::_stat_scores``, parity with the
+reference's ``stat_scores.py:29-75``). Two TPU-native formulations:
+
+* **XLA fallback** — the one-hot compare chain: four boolean masks, four
+  reductions. XLA fuses them, but each mask/reduce pair walks the ``(N, C)``
+  operands again.
+* **Pallas kernel** — all four counts in ONE VMEM-resident pass: per grid
+  step one ``(TILE, C̃)`` block of preds/target builds the four masks in
+  VMEM and accumulates four rows of the resident ``(8, C̃)`` output block
+  (rows 4–7 are sublane padding). Padded rows carry the sentinel pair
+  ``preds=-1, target=-2``, which satisfies none of the four masks — they can
+  never count.
+
+Dispatch contract (see :mod:`metrics_tpu.kernels`): ``stat_scores_counts``
+auto-dispatches, ``stat_scores_counts_pallas`` takes ``interpret=`` for CPU
+testing, ``stat_scores_counts_xla`` is the portable formulation. Counts are
+int32 and bit-identical between the two paths (f32 accumulation is exact
+below 2^24, the auto gate's sample cap).
+"""
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from metrics_tpu.kernels._common import (
+    _PALLAS_TPU_AVAILABLE,
+    _round_up,
+    note_kernel_dispatch,
+    pallas_auto_ok,
+    pltpu,
+)
+
+#: largest C the Pallas path handles: VMEM holds two (TILE, C̃) int blocks
+#: plus the (8, C̃) f32 accumulator
+_MAX_PALLAS_CLASSES = 2048
+_TILE = 256
+
+
+def stat_scores_pallas_ok(num_rows: int, num_classes: int) -> bool:
+    """True when the auto dispatch would select the Pallas kernel for this
+    shape: TPU backend plus the per-kernel VMEM shape limits."""
+    return (
+        pallas_auto_ok(num_rows * max(num_classes, 1))
+        and 1 <= num_classes <= _MAX_PALLAS_CLASSES
+    )
+
+
+def stat_scores_counts_xla(
+    preds: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One-hot compare-chain formulation: four ``(C,)`` int32 count vectors
+    over canonical binary ``(N, C)`` inputs (the ``reduce="macro"`` sums of
+    ``functional/classification/stat_scores.py::_stat_scores``)."""
+    true_pred = target == preds
+    false_pred = target != preds
+    pos_pred = preds == 1
+    neg_pred = preds == 0
+    tp = jnp.sum(true_pred & pos_pred, axis=0)
+    fp = jnp.sum(false_pred & pos_pred, axis=0)
+    tn = jnp.sum(true_pred & neg_pred, axis=0)
+    fn = jnp.sum(false_pred & neg_pred, axis=0)
+    dtype = jnp.int32
+    return tp.astype(dtype), fp.astype(dtype), tn.astype(dtype), fn.astype(dtype)
+
+
+def _stat_scores_kernel(p_ref, t_ref, out_ref):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    p = p_ref[:]
+    t = t_ref[:]
+    eq = t == p
+    pos = p == 1
+    neg = p == 0
+
+    def count(mask):  # (TILE, C̃) -> (1, C̃) f32 partial sums
+        return jnp.sum(mask.astype(jnp.float32), axis=0, keepdims=True)
+
+    tp, fp = count(eq & pos), count(jnp.logical_not(eq) & pos)
+    tn, fn = count(eq & neg), count(jnp.logical_not(eq) & neg)
+    pad = jnp.zeros((4, tp.shape[1]), jnp.float32)  # sublane-align to 8 rows
+    out_ref[:] += jnp.concatenate([tp, fp, tn, fn, pad], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def stat_scores_counts_pallas(
+    preds: jax.Array, target: jax.Array, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused four-mask formulation of :func:`stat_scores_counts_xla`.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU testing).
+    """
+    n, c = preds.shape
+    cpad = _round_up(c, 128)
+    npad = _round_up(max(n, _TILE), _TILE)
+
+    def pad(a: jax.Array, sentinel: int) -> jax.Array:
+        a = a.astype(jnp.int32)
+        return jnp.pad(
+            a, ((0, npad - n), (0, cpad - c)), constant_values=sentinel
+        )
+
+    grid = npad // _TILE
+    vmem = pltpu.VMEM if _PALLAS_TPU_AVAILABLE else None
+    block = lambda: pl.BlockSpec((_TILE, cpad), lambda i: (i, 0), memory_space=vmem)  # noqa: E731
+    out = pl.pallas_call(
+        _stat_scores_kernel,
+        grid=(grid,),
+        in_specs=[block(), block()],
+        out_specs=pl.BlockSpec((8, cpad), lambda i: (0, 0), memory_space=vmem),
+        out_shape=jax.ShapeDtypeStruct((8, cpad), jnp.float32),
+        interpret=interpret,
+    )(pad(preds, -1), pad(target, -2))
+    counts = out[:4, :c].astype(jnp.int32)
+    return counts[0], counts[1], counts[2], counts[3]
+
+
+def stat_scores_counts(
+    preds: jax.Array, target: jax.Array, use_pallas: Optional[bool] = None
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-class tp/fp/tn/fn counts with automatic backend dispatch.
+
+    Inputs are canonical binary ``(N, C)`` arrays (the
+    ``_input_format_classification`` output); returns four ``(C,)`` int32
+    vectors. ``use_pallas=None`` selects the Pallas kernel on a TPU backend
+    when the shape fits the VMEM gates and the XLA compare chain otherwise;
+    the decision lands on the ``kernel.dispatch`` telemetry counter either
+    way.
+    """
+    if use_pallas is None:
+        use_pallas = stat_scores_pallas_ok(preds.shape[0], preds.shape[1])
+    note_kernel_dispatch("stat_scores_counts", "pallas" if use_pallas else "xla")
+    if use_pallas:
+        return stat_scores_counts_pallas(preds, target)
+    return stat_scores_counts_xla(preds, target)
+
+
+def stat_scores_counts_auto(
+    preds: jax.Array, target: jax.Array
+) -> Optional[Tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
+    """The seam :func:`~metrics_tpu.functional.classification.stat_scores._stat_scores`
+    consults on its macro 2-D path: the fused kernel's counts when the auto
+    gate selects Pallas, ``None`` otherwise — the caller then runs its own
+    (pre-existing) XLA lowering, byte-identical to the kernels-off program
+    (the zero-overhead discipline). The decision is recorded either way.
+    """
+    if stat_scores_pallas_ok(preds.shape[0], preds.shape[1]):
+        note_kernel_dispatch("stat_scores_counts", "pallas")
+        return stat_scores_counts_pallas(preds, target)
+    note_kernel_dispatch("stat_scores_counts", "xla")
+    return None

@@ -91,6 +91,8 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     # processes (fleet-resident rows), the high-water mark maxes
     ("serving.depth_high_water", "max"),
     ("serving.*", "sum"),
+    # Pallas kernel suite: dispatch-decision counters sum across processes
+    ("kernels.*", "sum"),
     # fast-path histograms (percentiles recomputed after the bucket merge)
     ("histograms.*.buckets.*", "sum"),
     ("histograms.*.count", "sum"),

@@ -94,6 +94,8 @@ _HELP: Dict[str, str] = {
     "serving_ingest_seconds": "Admission-to-dispatch-complete wall time per event row.",
     "serving_flush_seconds": "One coalesced keyed dispatch's wall time.",
     "serving_queue_depth": "Rows resident at flush time (log2 count histogram).",
+    "serving_tenant_cache_hits_total": "Reads served from cache by per-tenant generation freshness (global generation moved, requested tenants untouched).",
+    "kernel_dispatch_total": "Pallas-vs-XLA auto-dispatch decisions per kernel op.",
 }
 
 
@@ -160,6 +162,10 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
     # snapshot and its import graph clean
     serving_mod = _sys.modules.get("metrics_tpu.serving.telemetry")
     snap["serving"] = serving_mod.summary() if serving_mod is not None else {}
+    # same discipline for the Pallas kernel suite's dispatch-decision
+    # counters: {} until the kernels package is imported
+    kernels_mod = _sys.modules.get("metrics_tpu.kernels._common")
+    snap["kernels"] = kernels_mod.dispatch_summary() if kernels_mod is not None else {}
     return snap
 
 
@@ -354,6 +360,7 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
             "cache_hits",
             "cache_misses",
             "stale_serves",
+            "tenant_cache_hits",
             "refreshes",
             "coalesced_refreshes",
             "generation_bumps",
@@ -370,6 +377,15 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
                 {**base, "trigger": trigger},
                 n,
                 "counter",
+            )
+
+    kernels = snap.get("kernels", {})
+    for op, paths in sorted(kernels.get("dispatch", {}).items()):
+        # the Pallas suite's auto-dispatch decisions, one series per
+        # (kernel op, chosen path) — how often each shape gate fired
+        for path, n in sorted(paths.items()):
+            out.emit(
+                "kernel_dispatch_total", {**base, "op": op, "path": path}, n, "counter"
             )
 
     events = snap.get("events", {})

@@ -16,13 +16,19 @@ both and arbitrates by one explicit contract, the **staleness SLO**:
 * **reads are served from a hot result cache** keyed by the scheduler's
   **write generation** — a counter bumped once per dispatched flush (the
   per-key generation discipline the async engine already applies to its
-  retained values). A cache entry is *fresh* when its generation matches
-  and nothing is resident in the queue; *servable* when younger than the
-  read's ``max_staleness_s`` budget (served immediately, counted
-  ``stale_serves``, with a background refresh scheduled); otherwise the
-  read flushes the queue (read-your-writes), submits a refresh, and blocks
-  on the future. ``max_staleness_s=0`` therefore guarantees a read NEVER
-  observes a value older than the latest generation — the
+  retained values). Generations are additionally tracked **per tenant id**
+  (each flush stamps only the tenants it actually touched), so a cache
+  entry serves a tenant-scoped read (``read([ids])``) whenever NONE of the
+  requested tenants changed since it was computed — a flush touching
+  tenants {A, B} no longer fans a refresh out to every hot reader of
+  tenant C (counted ``tenant_cache_hits``). A cache entry is *fresh*
+  globally when its generation matches and nothing is resident in the
+  queue; *servable* when younger than the read's ``max_staleness_s``
+  budget (served immediately, counted ``stale_serves``, with a background
+  refresh scheduled); otherwise the read flushes the queue
+  (read-your-writes), submits a refresh, and blocks on the future.
+  ``max_staleness_s=0`` therefore guarantees a read NEVER observes a value
+  older than the requested tenants' latest write — the
   no-stale-cache-after-a-generation-bump invariant the concurrency tests
   pin.
 * **refreshes coalesce.** Any number of concurrent stale reads share one
@@ -96,6 +102,10 @@ class SLOScheduler:
         self.round_timeout_s = round_timeout_s
         self._lock = threading.Lock()
         self._generation = 0
+        #: tenant id -> generation of its last dispatched write (only touched
+        #: tenants present; an absent tenant has never been written, i.e.
+        #: generation 0) — the per-tenant cache-invalidation ledger
+        self._tenant_gen: Dict[int, int] = {}
         #: {"generation", "values", "at"} — the hot per-tenant result cache
         self._cache: Optional[Dict[str, Any]] = None
         self._refresh_future: Optional[Any] = None
@@ -109,10 +119,15 @@ class SLOScheduler:
 
     def _dispatch(self, tenant_ids: Any, *cols: Any) -> None:
         """The queue's flush target: ONE keyed update dispatch, then a
-        generation bump — the cache-invalidation edge."""
+        generation bump — the cache-invalidation edge. Only the tenants the
+        flush actually touched are stamped in the per-tenant ledger, so an
+        untouched tenant's cached value stays servable."""
         self._metric.update(tenant_ids, *cols)
+        touched = np.unique(np.asarray(tenant_ids).reshape(-1))
         with self._lock:
             self._generation += 1
+            for t in touched:
+                self._tenant_gen[int(t)] = self._generation
         SERVING_STATS.inc("generation_bumps")
 
     def submit(self, tenant_id: int, *args: Any) -> bool:
@@ -144,24 +159,43 @@ class SLOScheduler:
 
         ``tenant_ids=None`` returns the full per-tenant vector (or
         ``{member: vector}`` for a collection); an index array selects
-        rows. ``max_staleness_s`` overrides the scheduler default for this
-        read; ``0`` forces read-your-writes freshness (flush + recompute
-        when anything changed)."""
+        rows — and scopes freshness to those tenants: the cache serves the
+        read (``tenant_cache_hits``) when none of them changed since it was
+        computed, even if OTHER tenants' flushes moved the global
+        generation. ``max_staleness_s`` overrides the scheduler default for
+        this read; ``0`` forces read-your-writes freshness for the
+        requested tenants (flush + recompute when any of them changed)."""
         SERVING_STATS.inc("reads")
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, "reads")
         budget = self.max_staleness_s if max_staleness_s is None else float(max_staleness_s)
         now = time.monotonic()
+        ids = None if tenant_ids is None else np.asarray(tenant_ids).reshape(-1)
         with self._lock:
             cache = self._cache
             generation = self._generation
-        if (
-            cache is not None
-            and cache["generation"] == generation
-            and self.queue.depth() == 0
-        ):
-            SERVING_STATS.inc("cache_hits")
-            return _select(cache["values"], tenant_ids)
+            tenant_scoped_fresh = (
+                cache is not None
+                and cache["generation"] != generation
+                and ids is not None
+                and all(
+                    self._tenant_gen.get(int(t), 0) <= cache["generation"]
+                    for t in ids
+                )
+            )
+        if cache is not None and self.queue.depth() == 0:
+            if cache["generation"] == generation:
+                SERVING_STATS.inc("cache_hits")
+                return _select(cache["values"], tenant_ids)
+            if tenant_scoped_fresh:
+                # other tenants' flushes moved the generation, but every
+                # requested tenant is unchanged since the cache computed —
+                # their cached values ARE the latest, no refresh fan-out
+                SERVING_STATS.inc("cache_hits")
+                SERVING_STATS.inc("tenant_cache_hits")
+                if TELEMETRY.enabled:
+                    TELEMETRY.inc(self.telemetry_key, "tenant_cache_hits")
+                return _select(cache["values"], tenant_ids)
         if cache is not None and (now - cache["at"]) <= budget:
             # within the SLO: serve the stale generation immediately and
             # refresh in the background — a dashboard value a moment old
@@ -270,6 +304,7 @@ class SLOScheduler:
                     round(time.monotonic() - cache["at"], 6) if cache else None
                 ),
                 "cache_fresh": bool(cache and cache["generation"] == self._generation),
+                "tenant_generations_tracked": len(self._tenant_gen),
                 "max_staleness_s": self.max_staleness_s,
                 "on_degraded": self.on_degraded,
             }

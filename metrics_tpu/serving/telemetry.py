@@ -82,6 +82,7 @@ class ServingStats:
             "cache_hits": 0,
             "cache_misses": 0,
             "stale_serves": 0,
+            "tenant_cache_hits": 0,
             "refreshes": 0,
             "coalesced_refreshes": 0,
             "generation_bumps": 0,

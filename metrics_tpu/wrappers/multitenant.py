@@ -427,6 +427,59 @@ class KeyedMetric(Metric):
     # the segment-scatter program (pure)
     # ------------------------------------------------------------------
 
+    #: leaf dtypes the fused Pallas scatter can accumulate exactly in f32
+    _FUSED_SCATTER_DTYPES = ("float32", "int32", "bfloat16")
+
+    def _fused_scatter_ok(self, per_row: StateDict) -> bool:
+        """True when the Pallas segment-scatter kernel owns this dispatch:
+        every leaf is a ``"sum"`` reduction of an f32-exact dtype and the
+        packed ``(rows, Σ leaf widths)`` bundle fits the kernel's shape
+        gates (TPU backend only — on any other backend the pre-existing XLA
+        lowering below runs byte-identically, the zero-overhead discipline).
+        """
+        from metrics_tpu.kernels.segment_scatter import segment_scatter_pallas_ok
+
+        child = self._child
+        if any(fx != "sum" for fx in child._reductions.values()):
+            return False
+        width, rows_n = 0, 0
+        for name in child._reductions:
+            leaf = per_row[name]
+            if str(leaf.dtype) not in self._FUSED_SCATTER_DTYPES:
+                return False
+            rows_n = leaf.shape[0]
+            width += int(np.prod(leaf.shape[1:], dtype=np.int64)) if leaf.ndim > 1 else 1
+        return segment_scatter_pallas_ok(rows_n, self.num_tenants, width)
+
+    def _fused_segment_scatter(
+        self, state: StateDict, ids: Array, per_row: StateDict
+    ) -> StateDict:
+        """One Pallas kernel for the whole bundle: every sum leaf's per-row
+        delta flattens into one packed ``(rows, D)`` matrix, the kernel
+        buckets + clips + scatter-accumulates it in a single VMEM pass, and
+        the ``(N, D)`` sums split back onto the stacked leaves."""
+        from metrics_tpu.kernels.segment_scatter import segment_scatter_add
+
+        child = self._child
+        n = self.num_tenants
+        layout, columns = [], []
+        for name in child._reductions:
+            default = jnp.asarray(child._defaults[name])
+            delta_rows = per_row[name] - default
+            flat = delta_rows.reshape(delta_rows.shape[0], -1).astype(jnp.float32)
+            layout.append((name, delta_rows.shape[1:], flat.shape[1]))
+            columns.append(flat)
+        sums, _ = segment_scatter_add(
+            jnp.concatenate(columns, axis=1), ids, n, use_pallas=True
+        )
+        new: StateDict = {}
+        offset = 0
+        for name, shape, width in layout:
+            delta = sums[:, offset : offset + width].reshape((n,) + shape)
+            new[name] = state[name] + delta.astype(state[name].dtype)
+            offset += width
+        return new
+
     def _segment_scatter(
         self, state: StateDict, tenant_ids: Any, args: Tuple, kwargs: Dict
     ) -> Tuple[StateDict, Array]:
@@ -434,7 +487,11 @@ class KeyedMetric(Metric):
 
         Invalid ids (negative / >= N) are clipped to a discard bucket — row
         ``N`` of an ``N+1``-segment reduction that is sliced away — so they
-        can never scatter into a real tenant.
+        can never scatter into a real tenant. On a TPU backend with an
+        all-``"sum"`` bundle inside the kernel shape gates, the routing runs
+        the fused Pallas segment-scatter instead of the per-leaf
+        ``segment_sum`` chain; gated off, the lowering below is byte-identical
+        to the pre-kernel program.
         """
         child = self._child
         n = self.num_tenants
@@ -442,6 +499,13 @@ class KeyedMetric(Metric):
         valid = (ids >= 0) & (ids < n)
         safe = jnp.where(valid, ids, n)
         per_row = row_states(child, args, kwargs)
+        if self._fused_scatter_ok(per_row):
+            new = self._fused_segment_scatter(state, ids, per_row)
+            invalid = jnp.sum(jnp.logical_not(valid)).astype(jnp.int32)
+            return new, invalid
+        from metrics_tpu.kernels._common import note_kernel_dispatch
+
+        note_kernel_dispatch("segment_scatter_add", "xla")
         counts = jax.ops.segment_sum(
             valid.astype(jnp.int32), safe, num_segments=n + 1
         )[:n]

@@ -19,6 +19,11 @@ Decision rules (each unit-tested in ``tests/test_bench_regress.py``):
 * **The baseline is the median of prior healthy rounds** (at least
   ``--min-history`` of them; configs with less history are reported, not
   judged — a brand-new config cannot fail the gate on its first capture).
+* **Dispatch paths never cross-compare.** Kernel-suite records carry
+  ``dispatch_path`` (``pallas`` on TPU, ``xla`` on the CPU fallback); a
+  record only votes into — and is only judged against — history with the
+  SAME path, so a CPU capture can never become the baseline a TPU pallas
+  round is judged by (or vice versa).
 * **Lower is better** for every recorded unit (``us/step``, ``us/tenant``,
   ``us/epoch``, ``pct``): the latest value regresses when
   ``latest > baseline * (1 + tolerance)``.
@@ -185,6 +190,18 @@ def _healthy_value(rec: Optional[Dict[str, Any]]) -> Optional[float]:
     return float(rec["value"])
 
 
+def _same_dispatch_path(rec: Optional[Dict[str, Any]], want_path: Optional[str]) -> bool:
+    """Kernel-suite records carry ``dispatch_path`` (``pallas``/``xla`` —
+    which backend the auto dispatch actually timed). A pallas record must
+    never be judged against an xla baseline (or vice versa): they measure
+    different programs, so the comparison is apples-to-oranges, not a
+    regression. Records without the key (every non-kernel config) always
+    match."""
+    if rec is None:
+        return True
+    return rec.get("dispatch_path") == want_path
+
+
 def parse_tolerance(text: str) -> float:
     """One tolerance value: a fraction (``0.5``) or a percent (``50%``)."""
     text = text.strip()
@@ -253,8 +270,14 @@ def check_trajectory(
         latest_n, latest = rounds[rec_idx]
         prior = rounds[:rec_idx]
         rec = latest[metric]
+        want_path = rec.get("dispatch_path")
         history = [
-            v for v in (_healthy_value(by_metric.get(metric)) for _, by_metric in prior)
+            v
+            for v in (
+                _healthy_value(by_metric.get(metric))
+                for _, by_metric in prior
+                if _same_dispatch_path(by_metric.get(metric), want_path)
+            )
             if v is not None
         ]
         config_tolerance = overrides.get(metric, tolerance)

@@ -1854,6 +1854,156 @@ def bench_sharded_state_sync():
 bench_sharded_state_sync._force_cpu = True
 
 
+# ------------------------------------------------ Pallas kernel suite
+#: shapes for the kernel-suite configs (monkeypatched down in tests). Each
+#: config measures the AUTO dispatch path (pallas on TPU, the XLA fallback
+#: elsewhere — a CPU capture records dispatch_path="xla" so bench_regress.py
+#: never compares a pallas record against an xla baseline) against its own
+#: explicit XLA formulation as the baseline: vs_baseline IS the vs_xla ratio.
+PALLAS_KERNEL_STEPS = 200
+PALLAS_SCATTER_ROWS = 4096
+PALLAS_SCATTER_TENANTS = 512
+PALLAS_SCATTER_FEATURES = 8
+PALLAS_SKETCH_ROWS = 2048
+PALLAS_SKETCH_CLASSES = 4
+PALLAS_SKETCH_BINS = 512
+PALLAS_STAT_ROWS = 2048
+PALLAS_STAT_CLASSES = 64
+
+
+def _pallas_kernel_config(name, path, fused_update, xla_update, init, inputs, extra):
+    """Shared shape of the three kernel configs: cross-check the fused path
+    against the XLA formulation on one batch, then time both with the scan
+    harness. ``vs_baseline`` = xla_time / fused_time (1.0-ish on CPU where
+    the auto dispatch IS the XLA path)."""
+    import jax
+
+    if path == "pallas":
+        fused0 = jax.tree.leaves(fused_update(init(), *(x[0] for x in inputs)))
+        xla0 = jax.tree.leaves(xla_update(init(), *(x[0] for x in inputs)))
+        if not all(np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(fused0, xla0)):
+            print(f"# {name}: pallas MISMATCHES xla on this backend — not timing a wrong kernel", file=sys.stderr)
+            return name, float("nan"), lambda *a: float("nan"), "us/step", extra
+    ours = _time_scan_epoch(inputs, init, fused_update)
+
+    def ref(torchmetrics, torch):  # our own XLA formulation is the baseline
+        return _time_scan_epoch(inputs, init, xla_update)
+
+    return name, ours, ref, "us/step", extra
+
+
+def bench_pallas_scatter():
+    """The fused segment-scatter tenant-update kernel (bucketing +
+    clip-and-drop + scatter-accumulate in one VMEM pass) vs the XLA
+    ``segment_sum`` formulation, at the multi-tenant hot-path shape."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.kernels.segment_scatter import (
+        segment_scatter_add_pallas,
+        segment_scatter_add_xla,
+        segment_scatter_pallas_ok,
+    )
+
+    steps, r = PALLAS_KERNEL_STEPS, PALLAS_SCATTER_ROWS
+    n, d = PALLAS_SCATTER_TENANTS, PALLAS_SCATTER_FEATURES
+    rng = np.random.RandomState(0)
+    rows = jnp.asarray(rng.randint(0, 4, (steps, r, d)).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, n, (steps, r)))
+    path = "pallas" if segment_scatter_pallas_ok(r, n, d) else "xla"
+    fused = segment_scatter_add_pallas if path == "pallas" else segment_scatter_add_xla
+
+    def update_with(fn):
+        def update(acc, rw, ix):
+            sums, _ = fn(rw, ix, n)
+            return acc + sums
+
+        return update
+
+    return _pallas_kernel_config(
+        "pallas_scatter_step",
+        path,
+        update_with(fused),
+        update_with(segment_scatter_add_xla),
+        lambda: jnp.zeros((n, d), jnp.float32),
+        (rows, ids),
+        {"dispatch_path": path, "rows": r, "tenants": n, "features": d},
+    )
+
+
+def bench_pallas_sketch_build():
+    """The fused binned label/score sketch kernel (bucketize + per-class
+    segment-sum in one VMEM pass — the O(N·C) build behind every
+    ``sketched=True`` state) vs the XLA scatter-add formulation."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.kernels.binned_counts import (
+        label_score_pallas_ok,
+        label_score_histograms_pallas,
+        label_score_histograms_xla,
+    )
+
+    steps, r = PALLAS_KERNEL_STEPS, PALLAS_SKETCH_ROWS
+    c, bins = PALLAS_SKETCH_CLASSES, PALLAS_SKETCH_BINS
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(steps, r, c).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, (steps, r, c)))
+    path = "pallas" if label_score_pallas_ok(r, c, bins) else "xla"
+    fused = label_score_histograms_pallas if path == "pallas" else label_score_histograms_xla
+
+    def update_with(fn):
+        def update(acc, p, t):
+            pos, neg, _ = fn(p, t, bins)
+            return acc + pos + neg
+
+        return update
+
+    return _pallas_kernel_config(
+        "pallas_sketch_build_step",
+        path,
+        update_with(fused),
+        update_with(label_score_histograms_xla),
+        lambda: jnp.zeros((c, bins), jnp.float32),
+        (preds, target),
+        {"dispatch_path": path, "rows": r, "classes": c, "bins": bins},
+    )
+
+
+def bench_pallas_stat_scores():
+    """The fused tp/fp/tn/fn kernel (all four masks in one VMEM pass — the
+    stat-scores quintet's inner loop) vs the XLA one-hot compare chain."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.kernels.stat_scores import (
+        stat_scores_counts_pallas,
+        stat_scores_counts_xla,
+        stat_scores_pallas_ok,
+    )
+
+    steps, r, c = PALLAS_KERNEL_STEPS, PALLAS_STAT_ROWS, PALLAS_STAT_CLASSES
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.randint(0, 2, (steps, r, c)))
+    target = jnp.asarray(rng.randint(0, 2, (steps, r, c)))
+    path = "pallas" if stat_scores_pallas_ok(r, c) else "xla"
+    fused = stat_scores_counts_pallas if path == "pallas" else stat_scores_counts_xla
+
+    def update_with(fn):
+        def update(acc, p, t):
+            tp, fp, tn, fn_ = fn(p, t)
+            return acc + tp + fp + tn + fn_
+
+        return update
+
+    return _pallas_kernel_config(
+        "pallas_stat_scores_step",
+        path,
+        update_with(fused),
+        update_with(stat_scores_counts_xla),
+        lambda: jnp.zeros((c,), jnp.int32),
+        (preds, target),
+        {"dispatch_path": path, "rows": r, "classes": c},
+    )
+
+
 # ------------------------------------------------ serving-layer soak
 #: soak shape knobs (env-overridable so the CI smoke leg stays short; the
 #: official capture runs the defaults in scripts/soak.py — >=60 s, >=10k
@@ -1912,6 +2062,9 @@ CONFIG_META = {
     "bench_auroc_compute": ("auroc_epoch_compute_200k", "us/step"),
     "bench_fid_compute": ("fid_epoch_compute_2048d", "us/step"),
     "bench_pallas_confmat": ("confmat_pallas_vs_xla_step", "us/step"),
+    "bench_pallas_scatter": ("pallas_scatter_step", "us/step"),
+    "bench_pallas_sketch_build": ("pallas_sketch_build_step", "us/step"),
+    "bench_pallas_stat_scores": ("pallas_stat_scores_step", "us/step"),
     "bench_train_overhead": ("train_step_metric_overhead", "pct"),
     "bench_eager_forward": ("stateful_forward_step_cpu", "us/step"),
     "bench_stateful_forward_donated": ("stateful_forward_donated_step", "us/step"),
@@ -1937,6 +2090,9 @@ CONFIGS = [
     bench_auroc_compute,
     bench_fid_compute,
     bench_pallas_confmat,
+    bench_pallas_scatter,
+    bench_pallas_sketch_build,
+    bench_pallas_stat_scores,
     bench_train_overhead,
     bench_eager_forward,
     bench_stateful_forward_donated,

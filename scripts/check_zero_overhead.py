@@ -60,6 +60,14 @@ constructed, its worker running, and a job completed, the hot-path jaxprs
 must stay byte-identical — ``compute_async`` takes work off the step path,
 it must never add to it.
 
+Fifth pin: **kernels-off lowerings**. The Pallas kernel suite
+(``metrics_tpu/kernels/``) forks the keyed segment-scatter, the sketched
+histogram build, and the stat-scores macro counts at trace time; with the
+kernels gated off (any non-TPU backend, or shapes past the gates) the traced
+programs must be byte-identical to the pre-kernel lowerings. Their digests
+are pinned under the ``kernels_off`` baseline key — added additively (every
+pre-existing key byte-identical at the regeneration that introduced it).
+
 Fourth pin: **compute-group fusion**. The canonical stat-scores collection
 (``Precision/Recall/F1/Specificity/StatScores``, same config) must
 trace-fingerprint into ONE compute group, so its compiled step runs exactly
@@ -518,6 +526,64 @@ def compute_group_fusion() -> Dict[str, Dict]:
     }
 
 
+def kernels_off_programs() -> Dict[str, str]:
+    """Jaxpr text of the hot programs the Pallas kernel suite can divert —
+    the keyed segment-scatter update, the sketched histogram build, and the
+    stat-scores macro counts — traced on a backend where the auto gate
+    selects the XLA lowering (CPU here), observability disabled.
+
+    Pinning their digests (baseline key ``kernels_off``, additive — every
+    pre-existing key kept byte-identical) proves the kernel dispatch seam is
+    a pure trace-time fork: with the kernels gated off, the hot programs are
+    the pre-kernel lowerings, byte for byte.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, observability
+
+    jax.config.update("jax_enable_x64", True)
+    prev_enabled = observability.TELEMETRY.enabled
+    prev_policy = observability.get_health_policy()
+    observability.set_health_policy("off")
+    observability.disable()
+    try:
+        preds = jnp.zeros((8, 3), jnp.float32)
+        target = jnp.zeros((8,), jnp.int32)
+
+        from metrics_tpu.wrappers import KeyedMetric
+
+        km = KeyedMetric(Accuracy(), 16)
+        ids = jnp.zeros((8,), jnp.int32)
+        keyed = str(jax.make_jaxpr(km.apply_update)(km.init_state(), ids, preds, target))
+
+        from metrics_tpu.kernels.binned_counts import label_score_histograms
+
+        hist = str(
+            jax.make_jaxpr(lambda p, t: label_score_histograms(p, t, 64))(
+                jnp.zeros((8, 2), jnp.float32), jnp.zeros((8, 2), jnp.int32)
+            )
+        )
+
+        from metrics_tpu.functional.classification.stat_scores import _stat_scores
+
+        stat = str(
+            jax.make_jaxpr(lambda p, t: _stat_scores(p, t, "macro"))(
+                jnp.zeros((8, 3), jnp.int32), jnp.zeros((8, 3), jnp.int32)
+            )
+        )
+    finally:
+        observability.set_health_policy(prev_policy)
+        observability.TELEMETRY.enable(prev_enabled)
+        observability.EVENTS.enable(prev_enabled)
+        observability.TRACER.enable(prev_enabled)
+    return {
+        "keyed_segment_scatter_update": keyed,
+        "label_score_histograms_build": hist,
+        "stat_scores_macro_counts": stat,
+    }
+
+
 def current_jaxprs() -> Dict[str, str]:
     """Jaxpr text per pinned program in the disabled-observability state
     (which the identity check proves equals the enabled state)."""
@@ -776,6 +842,24 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                         " collectives). If intentional, regenerate with"
                         " `python scripts/check_zero_overhead.py --update`."
                     )
+        # the kernels-off lowerings are jaxpr-text pins like the primary
+        # programs: compare only on the baseline's jax version
+        pinned_kernels_off = baseline.get("kernels_off")
+        if pinned_kernels_off is None:
+            violations.append("kernels_off missing from baseline (run --update)")
+        elif baseline.get("jax_version") == jax.__version__:
+            for name, text in kernels_off_programs().items():
+                want = pinned_kernels_off.get(name)
+                if want is None:
+                    violations.append(f"{name}: kernels-off program missing from baseline (run --update)")
+                elif want["sha256"] != _sha256(text):
+                    violations.append(
+                        f"{name}: kernels-off jaxpr digest drifted from the pinned"
+                        " baseline — the Pallas dispatch seam altered the gated-off"
+                        " hot program (it must stay byte-identical to the pre-kernel"
+                        " lowering). If intentional, regenerate with"
+                        " `python scripts/check_zero_overhead.py --update`."
+                    )
         # donated-lowering aliasing counts are version-independent too: pin
         # them so a layout change that sheds aliased buffers is conscious
         pinned_donation = baseline.get("donation_aliasing")
@@ -831,6 +915,13 @@ def update_baseline(baseline_path: str = BASELINE_PATH) -> str:
         # ONE update program over ONE donated 4-leaf bundle, syncing as one
         # collective; a dedup regression inflates these
         "compute_groups": compute_group_fusion(),
+        # Pallas-kernels-OFF lowerings (keyed scatter, sketch build,
+        # stat-scores macro): the dispatch seam must be a pure trace-time
+        # fork — gated off, these are the pre-kernel programs byte for byte
+        "kernels_off": {
+            name: {"sha256": _sha256(text), "jaxpr": text}
+            for name, text in kernels_off_programs().items()
+        },
     }
     with open(baseline_path, "w") as fh:
         json.dump(payload, fh, indent=1)

@@ -1,4 +1,5 @@
 """Pallas kernels vs their XLA formulations (interpreter mode on the CPU mesh)."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -6,9 +7,23 @@ import pytest
 from metrics_tpu.functional import confusion_matrix
 from metrics_tpu.kernels import (
     binned_tp_fp_fn,
+    confmat_counts,
     confmat_counts_pallas,
     confmat_counts_xla,
+    label_score_histograms,
+    label_score_histograms_pallas,
+    label_score_histograms_xla,
+    segment_scatter_add,
+    segment_scatter_add_pallas,
+    segment_scatter_add_xla,
+    stat_scores_counts,
+    stat_scores_counts_pallas,
+    stat_scores_counts_xla,
 )
+from metrics_tpu.kernels import _common
+from metrics_tpu.kernels.binned_counts import label_score_pallas_ok
+from metrics_tpu.kernels.segment_scatter import segment_scatter_pallas_ok
+from metrics_tpu.kernels.stat_scores import stat_scores_pallas_ok
 
 _rng = np.random.RandomState(3)
 
@@ -89,3 +104,320 @@ class TestBinnedCounts:
         thresholds = jnp.asarray([0.25, 0.5], jnp.float32)
         tp, _, _ = binned_tp_fp_fn(preds, target, thresholds)
         np.testing.assert_array_equal(np.asarray(tp), [[2.0, 1.0]])
+
+
+class TestSegmentScatterKernel:
+    """The fused tenant-scatter kernel vs the XLA ``segment_sum`` formulation:
+    integer-valued data must be bit-identical (f32 accumulation is exact below
+    2^24), arbitrary floats within reassociation tolerance."""
+
+    @pytest.mark.parametrize("r,s,d", [(100, 8, 4), (700, 512, 8), (7, 3, 1), (256, 128, 16)])
+    def test_integer_data_bit_identical(self, r, s, d):
+        rows = jnp.asarray(_rng.randint(0, 5, (r, d)).astype(np.float32))
+        ids = jnp.asarray(_rng.randint(0, s, r))
+        sums_p, counts_p = segment_scatter_add_pallas(rows, ids, s, interpret=True)
+        sums_x, counts_x = segment_scatter_add_xla(rows, ids, s)
+        np.testing.assert_array_equal(np.asarray(sums_p), np.asarray(sums_x))
+        np.testing.assert_array_equal(np.asarray(counts_p), np.asarray(counts_x))
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_float_data_parity_fuzz(self, seed):
+        rng = np.random.RandomState(seed)
+        r, s, d = rng.randint(1, 400), rng.randint(1, 64), rng.randint(1, 12)
+        rows = jnp.asarray(rng.randn(r, d).astype(np.float32))
+        ids = jnp.asarray(rng.randint(-2, s + 2, r))  # includes invalid ids
+        sums_p, counts_p = segment_scatter_add_pallas(rows, ids, s, interpret=True)
+        sums_x, counts_x = segment_scatter_add_xla(rows, ids, s)
+        np.testing.assert_allclose(np.asarray(sums_p), np.asarray(sums_x), rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(counts_p), np.asarray(counts_x))
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.int32, "bfloat16"])
+    def test_dtypes(self, dtype):
+        raw = _rng.randint(0, 3, (64, 4))
+        rows = jnp.asarray(raw).astype(jnp.bfloat16) if dtype == "bfloat16" else jnp.asarray(raw.astype(dtype))
+        ids = jnp.asarray(_rng.randint(0, 8, 64))
+        sums_p, counts_p = segment_scatter_add_pallas(rows, ids, 8, interpret=True)
+        sums_x, counts_x = segment_scatter_add_xla(rows, ids, 8)
+        assert sums_p.dtype == jnp.float32 == sums_x.dtype
+        np.testing.assert_array_equal(np.asarray(sums_p), np.asarray(sums_x))
+        np.testing.assert_array_equal(np.asarray(counts_p), np.asarray(counts_x))
+
+    def test_empty_batch(self):
+        sums, counts = segment_scatter_add_pallas(
+            jnp.zeros((0, 3), jnp.float32), jnp.zeros((0,), jnp.int32), 4, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(sums), np.zeros((4, 3)))
+        np.testing.assert_array_equal(np.asarray(counts), np.zeros((4,), np.int32))
+
+    def test_single_row(self):
+        sums, counts = segment_scatter_add_pallas(
+            jnp.asarray([[2.0, 3.0]]), jnp.asarray([1]), 3, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(sums), [[0, 0], [2, 3], [0, 0]])
+        np.testing.assert_array_equal(np.asarray(counts), [0, 1, 0])
+
+    def test_invalid_ids_clipped_identically(self):
+        """Negative and >=S ids must be clip-and-dropped EXACTLY as the XLA
+        discard bucket drops them — contributing to neither sums nor counts."""
+        rows = jnp.ones((10, 2), jnp.float32)
+        ids = jnp.asarray([-5, -1, 0, 1, 2, 3, 4, 5, 99, 2**30])
+        sums_p, counts_p = segment_scatter_add_pallas(rows, ids, 4, interpret=True)
+        sums_x, counts_x = segment_scatter_add_xla(rows, ids, 4)
+        np.testing.assert_array_equal(np.asarray(sums_p), np.asarray(sums_x))
+        np.testing.assert_array_equal(np.asarray(counts_p), np.asarray(counts_x))
+        assert int(jnp.sum(counts_p)) == 4  # only ids 0..3 are valid
+
+    def test_segment_capacity_boundary(self):
+        from metrics_tpu.kernels.segment_scatter import _MAX_PALLAS_SEGMENTS
+
+        s = _MAX_PALLAS_SEGMENTS
+        rows = jnp.asarray(_rng.randint(0, 2, (32, 2)).astype(np.float32))
+        ids = jnp.asarray(np.array([0, s - 1] * 16))
+        sums_p, counts_p = segment_scatter_add_pallas(rows, ids, s, interpret=True)
+        sums_x, counts_x = segment_scatter_add_xla(rows, ids, s)
+        np.testing.assert_array_equal(np.asarray(sums_p), np.asarray(sums_x))
+        np.testing.assert_array_equal(np.asarray(counts_p), np.asarray(counts_x))
+
+
+class TestSketchHistogramKernel:
+    """The fused bucketize + per-class segment-sum kernel vs the XLA
+    scatter-add: float32 counts of 0/1 masses are exact, so parity is
+    bit-identical at any tested size."""
+
+    @pytest.mark.parametrize("n,c,b", [(64, 1, 16), (300, 4, 64), (1000, 3, 256), (7, 2, 2048)])
+    def test_parity_bit_identical(self, n, c, b):
+        preds = jnp.asarray(_rng.rand(n, c).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 2, (n, c)))
+        got = label_score_histograms_pallas(preds, target, b, interpret=True)
+        want = label_score_histograms_xla(preds, target, b)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_out_of_range_clip_parity_fuzz(self, seed):
+        rng = np.random.RandomState(seed)
+        n, c, b = rng.randint(1, 300), rng.randint(1, 5), int(rng.choice([8, 64, 500]))
+        preds = jnp.asarray((rng.rand(n, c) * 2.0 - 0.5).astype(np.float32))  # spills [0,1]
+        target = jnp.asarray(rng.randint(0, 2, (n, c)))
+        got = label_score_histograms_pallas(preds, target, b, interpret=True)
+        want = label_score_histograms_xla(preds, target, b)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+        assert float(got[2]) > 0  # the sweep actually exercised clipping
+
+    def test_custom_range(self):
+        preds = jnp.asarray((_rng.randn(200, 2) * 3).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 2, (200, 2)))
+        got = label_score_histograms_pallas(preds, target, 32, -2.0, 2.0, interpret=True)
+        want = label_score_histograms_xla(preds, target, 32, -2.0, 2.0)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    @pytest.mark.parametrize("pdtype", ["float32", "bfloat16"])
+    @pytest.mark.parametrize("tdtype", [np.int32, np.float32])
+    def test_dtypes(self, pdtype, tdtype):
+        preds = jnp.asarray(_rng.rand(64, 2).astype(np.float32)).astype(pdtype)
+        target = jnp.asarray(_rng.randint(0, 2, (64, 2)).astype(tdtype))
+        got = label_score_histograms_pallas(preds, target, 16, interpret=True)
+        want = label_score_histograms_xla(preds, target, 16)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_empty_batch(self):
+        got = label_score_histograms_pallas(
+            jnp.zeros((0, 3), jnp.float32), jnp.zeros((0, 3), jnp.int32), 8, interpret=True
+        )
+        for arr, shape in zip(got, [(3, 8), (3, 8), ()]):
+            assert arr.shape == shape
+            np.testing.assert_array_equal(np.asarray(arr), 0.0)
+
+    def test_single_row_and_mass_conservation(self):
+        preds = jnp.asarray([[0.5]])
+        target = jnp.asarray([[1]])
+        pos, neg, clipped = label_score_histograms_pallas(preds, target, 4, interpret=True)
+        assert float(jnp.sum(pos)) == 1.0 and float(jnp.sum(neg)) == 0.0 and float(clipped) == 0.0
+
+    def test_bins_boundary(self):
+        from metrics_tpu.kernels.binned_counts import _MAX_PALLAS_BINS
+
+        preds = jnp.asarray(_rng.rand(16, 1).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 2, (16, 1)))
+        got = label_score_histograms_pallas(preds, target, _MAX_PALLAS_BINS, interpret=True)
+        want = label_score_histograms_xla(preds, target, _MAX_PALLAS_BINS)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+class TestStatScoresKernel:
+    """The fused tp/fp/tn/fn kernel vs the one-hot compare chain — integer
+    counts, bit-identical — including the functional ``_stat_scores`` macro
+    path it can replace."""
+
+    @pytest.mark.parametrize("n,c", [(100, 3), (512, 10), (1000, 130), (7, 2), (256, 1)])
+    def test_parity_bit_identical(self, n, c):
+        preds = jnp.asarray(_rng.randint(0, 2, (n, c)))
+        target = jnp.asarray(_rng.randint(0, 2, (n, c)))
+        got = stat_scores_counts_pallas(preds, target, interpret=True)
+        want = stat_scores_counts_xla(preds, target)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_matches_functional_stat_scores_macro(self, seed):
+        from metrics_tpu.functional.classification.stat_scores import _stat_scores
+
+        rng = np.random.RandomState(seed)
+        n, c = rng.randint(1, 400), rng.randint(1, 16)
+        preds = jnp.asarray(rng.randint(0, 2, (n, c)))
+        target = jnp.asarray(rng.randint(0, 2, (n, c)))
+        got = stat_scores_counts_pallas(preds, target, interpret=True)
+        want = _stat_scores(preds, target, reduce="macro")
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    @pytest.mark.parametrize("dtype", [np.int32, np.float32])
+    def test_dtypes(self, dtype):
+        preds = jnp.asarray(_rng.randint(0, 2, (64, 4)).astype(dtype))
+        target = jnp.asarray(_rng.randint(0, 2, (64, 4)).astype(dtype))
+        got = stat_scores_counts_pallas(preds, target, interpret=True)
+        want = stat_scores_counts_xla(preds, target)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_empty_batch(self):
+        got = stat_scores_counts_pallas(
+            jnp.zeros((0, 3), jnp.int32), jnp.zeros((0, 3), jnp.int32), interpret=True
+        )
+        for arr in got:
+            assert arr.shape == (3,) and arr.dtype == jnp.int32
+            np.testing.assert_array_equal(np.asarray(arr), 0)
+
+    def test_single_row(self):
+        got = stat_scores_counts_pallas(
+            jnp.asarray([[1, 0, 1]]), jnp.asarray([[1, 1, 0]]), interpret=True
+        )
+        tp, fp, tn, fn = (np.asarray(a) for a in got)
+        np.testing.assert_array_equal(tp, [1, 0, 0])
+        np.testing.assert_array_equal(fp, [0, 0, 1])
+        np.testing.assert_array_equal(tn, [0, 0, 0])
+        np.testing.assert_array_equal(fn, [0, 1, 0])
+
+    def test_class_capacity_boundary(self):
+        from metrics_tpu.kernels.stat_scores import _MAX_PALLAS_CLASSES
+
+        c = _MAX_PALLAS_CLASSES
+        preds = jnp.asarray(_rng.randint(0, 2, (8, c)))
+        target = jnp.asarray(_rng.randint(0, 2, (8, c)))
+        got = stat_scores_counts_pallas(preds, target, interpret=True)
+        want = stat_scores_counts_xla(preds, target)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_total_count_preserved(self):
+        n, c = 333, 5
+        preds = jnp.asarray(_rng.randint(0, 2, (n, c)))
+        target = jnp.asarray(_rng.randint(0, 2, (n, c)))
+        got = stat_scores_counts_pallas(preds, target, interpret=True)
+        assert int(sum(jnp.sum(a) for a in got)) == n * c  # padding never counts
+
+
+class TestAutoDispatch:
+    """CPU backend ⇒ the auto wrapper picks the XLA path, returns its exact
+    result, and the ``kernel.dispatch`` decision counter increments on the
+    right (op, path) label."""
+
+    def _delta(self, op, path, fn):
+        before = _common.dispatch_count(op, path)
+        out = fn()
+        return out, _common.dispatch_count(op, path) - before
+
+    def test_segment_scatter_auto_is_xla_on_cpu(self):
+        rows = jnp.asarray(_rng.rand(32, 3).astype(np.float32))
+        ids = jnp.asarray(_rng.randint(0, 4, 32))
+        assert not segment_scatter_pallas_ok(32, 4, 3)
+        (sums, counts), d = self._delta(
+            "segment_scatter_add", "xla", lambda: segment_scatter_add(rows, ids, 4)
+        )
+        assert d == 1
+        want_sums, want_counts = segment_scatter_add_xla(rows, ids, 4)
+        np.testing.assert_array_equal(np.asarray(sums), np.asarray(want_sums))
+        np.testing.assert_array_equal(np.asarray(counts), np.asarray(want_counts))
+
+    def test_label_score_auto_is_xla_on_cpu(self):
+        preds = jnp.asarray(_rng.rand(32, 2).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 2, (32, 2)))
+        assert not label_score_pallas_ok(32, 2, 16)
+        got, d = self._delta(
+            "label_score_histograms", "xla", lambda: label_score_histograms(preds, target, 16)
+        )
+        assert d == 1
+        want = label_score_histograms_xla(preds, target, 16)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_stat_scores_auto_is_xla_on_cpu(self):
+        preds = jnp.asarray(_rng.randint(0, 2, (32, 4)))
+        target = jnp.asarray(_rng.randint(0, 2, (32, 4)))
+        assert not stat_scores_pallas_ok(32, 4)
+        got, d = self._delta(
+            "stat_scores_counts", "xla", lambda: stat_scores_counts(preds, target)
+        )
+        assert d == 1
+        want = stat_scores_counts_xla(preds, target)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_confmat_auto_is_xla_on_cpu(self):
+        preds = jnp.asarray(_rng.randint(0, 4, 64))
+        target = jnp.asarray(_rng.randint(0, 4, 64))
+        got, d = self._delta(
+            "confmat_counts", "xla", lambda: confmat_counts(preds, target, 4)
+        )
+        assert d == 1
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(confmat_counts_xla(preds, target, 4))
+        )
+
+    def test_use_pallas_override_forces_kernel(self):
+        """``use_pallas=True`` forces the kernel path regardless of backend
+        (it will fail on CPU only past the interpreter; the dispatch counter
+        must record the forced decision)."""
+        rows = jnp.asarray(_rng.rand(8, 2).astype(np.float32))
+        ids = jnp.asarray(_rng.randint(0, 3, 8))
+        before = _common.dispatch_count("segment_scatter_add", "pallas")
+        try:
+            segment_scatter_add(rows, ids, 3, use_pallas=True)
+        except Exception:
+            pass  # a CPU build without the TPU interpreter may reject the lowering
+        assert _common.dispatch_count("segment_scatter_add", "pallas") == before + 1
+
+    def test_dispatch_counters_surface_in_snapshot_and_prometheus(self):
+        from metrics_tpu import observability
+
+        segment_scatter_add(
+            jnp.ones((4, 1), jnp.float32), jnp.zeros((4,), jnp.int32), 2
+        )
+        snap = observability.snapshot()
+        assert snap["kernels"]["dispatch"]["segment_scatter_add"]["xla"] >= 1
+        text = observability.render_prometheus(snap)
+        assert 'metrics_tpu_kernel_dispatch_total{op="segment_scatter_add",path="xla"}' in text
+
+    def test_keyed_metric_scatter_stays_xla_on_cpu(self):
+        """The multitenant fused-scatter gate must refuse on a CPU backend —
+        the keyed update keeps its pre-kernel lowering (the zero-overhead
+        baseline pins the jaxpr byte-identically) and records the decision."""
+        from metrics_tpu import Accuracy
+        from metrics_tpu.wrappers import KeyedMetric
+
+        km = KeyedMetric(Accuracy(), 4)
+        per_row_probe = {"correct": jnp.zeros((8,), jnp.float32), "total": jnp.zeros((8,), jnp.float32)}
+        assert km._fused_scatter_ok(per_row_probe) is False
+        before = _common.dispatch_count("segment_scatter_add", "xla")
+        km.update(
+            jnp.asarray([0, 1, 2, 3]),
+            jnp.asarray([0.9, 0.2, 0.7, 0.4]),
+            jnp.asarray([1, 0, 1, 1]),
+        )
+        assert _common.dispatch_count("segment_scatter_add", "xla") == before + 1
+        vals = km.compute()
+        np.testing.assert_allclose(np.asarray(vals), [1.0, 1.0, 1.0, 0.0])

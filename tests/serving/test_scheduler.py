@@ -203,12 +203,61 @@ def test_scheduler_report_shape():
     svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
     rep = svc.report()
     assert rep["cache_generation"] is None and rep["cache_fresh"] is False
+    assert rep["tenant_generations_tracked"] == 0
     svc.submit(0, 1.0)
     svc.read(max_staleness_s=0.0)
     rep = svc.report()
     assert rep["generation"] == 1 and rep["cache_generation"] == 1
     assert rep["queue"]["admitted"] == 1
+    assert rep["tenant_generations_tracked"] == 1
     import json
 
     json.dumps(rep)
+    svc.close()
+
+
+def test_untouched_tenant_cache_survives_other_tenants_flush():
+    """The per-tenant generation ledger (PR-12 follow-up): a flush touching
+    tenant 2 bumps the GLOBAL write generation, but tenant 1's cached
+    compute() value is still the latest value tenant 1 has — a tenant-scoped
+    read must serve it from cache (no refresh fan-out), counted under
+    ``tenant_cache_hits``; a read of the TOUCHED tenant must still
+    recompute."""
+    m = _FakeMetric()
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    svc.submit(1, 1.0)
+    svc.submit(2, 5.0)
+    assert svc.read(max_staleness_s=0.0)[1] == 1.0  # cache installed
+    computes = m.computes
+    svc.submit(2, 1.0)
+    svc.queue.flush()  # touches ONLY tenant 2; global generation moves
+    assert svc.report()["cache_fresh"] is False
+    before = SERVING_STATS.counter("tenant_cache_hits")
+    v = svc.read([1], max_staleness_s=0.0)  # untouched tenant: cache survives
+    assert v[0] == 1.0
+    assert m.computes == computes  # no refresh was scheduled for this read
+    assert SERVING_STATS.counter("tenant_cache_hits") == before + 1
+    # the touched tenant still observes read-your-writes freshness
+    assert svc.read([2], max_staleness_s=0.0)[0] == 6.0
+    assert m.computes == computes + 1
+    # a FULL-vector strict read can never ride the tenant-scoped path
+    svc.submit(2, 1.0)
+    svc.queue.flush()
+    assert svc.read(max_staleness_s=0.0)[2] == 7.0
+    svc.close()
+
+
+def test_never_written_tenant_reads_from_cache():
+    """A tenant with no writes at all (absent from the ledger) counts as
+    unchanged: its cached default value serves under the strictest
+    budget."""
+    m = _FakeMetric()
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    svc.submit(0, 1.0)
+    assert svc.read(max_staleness_s=0.0)[0] == 1.0
+    computes = m.computes
+    svc.submit(0, 1.0)
+    svc.queue.flush()
+    assert svc.read([7], max_staleness_s=0.0)[0] == 0.0  # never written
+    assert m.computes == computes
     svc.close()

@@ -408,3 +408,36 @@ def test_serving_soak_bench_record_round_trips(monkeypatch):
     assert line["drained"] is True
     assert "telemetry" in line and "serving" in line["telemetry"]
     assert "bench_serving_soak" in bench_suite.CONFIG_META
+
+
+def test_pallas_kernel_bench_records_round_trip(monkeypatch):
+    """The kernel-suite configs' records must survive json round-trips and
+    carry the dispatch evidence: ``dispatch_path`` ∈ {pallas, xla} (the
+    backend the auto dispatch actually timed — on the CPU test backend the
+    XLA fallback), the shape knobs, and ``vs_baseline`` as the vs-XLA ratio."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "PALLAS_KERNEL_STEPS", 8)
+    monkeypatch.setattr(bench_suite, "PALLAS_SCATTER_ROWS", 64)
+    monkeypatch.setattr(bench_suite, "PALLAS_SKETCH_ROWS", 64)
+    monkeypatch.setattr(bench_suite, "PALLAS_SKETCH_BINS", 32)
+    monkeypatch.setattr(bench_suite, "PALLAS_STAT_ROWS", 64)
+    monkeypatch.setattr(bench_suite, "PALLAS_STAT_CLASSES", 8)
+
+    expectations = {
+        "bench_pallas_scatter": ("pallas_scatter_step", {"rows", "tenants", "features"}),
+        "bench_pallas_sketch_build": ("pallas_sketch_build_step", {"rows", "classes", "bins"}),
+        "bench_pallas_stat_scores": ("pallas_stat_scores_step", {"rows", "classes"}),
+    }
+    import jax
+
+    want_path = "pallas" if jax.default_backend() == "tpu" else "xla"
+    for cfg_name, (metric, shape_keys) in expectations.items():
+        line = bench_suite.run_config(getattr(bench_suite, cfg_name), probe=False)
+        assert json.loads(json.dumps(line)) == line
+        assert line["metric"] == metric and line["unit"] == "us/step"
+        assert line["dispatch_path"] == want_path
+        assert shape_keys <= set(line)
+        assert "telemetry" in line
+        assert line["telemetry"]["kernels"]["dispatch"]  # decisions recorded
+        assert cfg_name in bench_suite.CONFIG_META

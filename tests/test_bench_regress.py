@@ -371,3 +371,38 @@ def test_partial_latest_round_still_judges_absent_configs(tmp_path):
     # "new": first appearance — reported, not judged
     assert by_metric["new"]["status"] == bench_regress.SKIPPED_NO_HISTORY
     assert bench_regress.main(paths + ["--check"]) == 1
+
+
+def test_dispatch_path_never_cross_compares():
+    """A pallas record must not be judged against xla history (and vice
+    versa): the trajectory here would read as a 100x regression if the paths
+    cross-compared, but the xla rounds are simply a different program."""
+    import bench_regress
+
+    def rec(value, path=None, metric="pallas_scatter_step"):
+        out = {"metric": metric, "value": value, "unit": "us/step"}
+        if path is not None:
+            out["dispatch_path"] = path
+        return out
+
+    rounds = [
+        (1, {"pallas_scatter_step": rec(100.0, "xla")}),
+        (2, {"pallas_scatter_step": rec(102.0, "xla")}),
+        (3, {"pallas_scatter_step": rec(98.0, "xla")}),
+        # the first TPU capture: 10x faster AND a different program
+        (4, {"pallas_scatter_step": rec(9.0, "pallas")}),
+    ]
+    rows = bench_regress.check_trajectory(rounds, min_history=2)
+    (row,) = rows
+    # no xla round votes into the pallas baseline: insufficient same-path history
+    assert row["status"] == bench_regress.SKIPPED_NO_HISTORY
+    assert row["history"] == 0
+
+    # same-path history judges normally
+    rounds.append((5, {"pallas_scatter_step": rec(9.5, "pallas")}))
+    rounds.append((6, {"pallas_scatter_step": rec(9.2, "pallas")}))
+    rounds.append((7, {"pallas_scatter_step": rec(9.4, "pallas")}))
+    rows = bench_regress.check_trajectory(rounds, min_history=2)
+    (row,) = rows
+    assert row["status"] == bench_regress.OK
+    assert row["history"] == 3  # only the pallas rounds (r4-r6) vote

@@ -283,6 +283,65 @@ def test_transport_layer_documented_and_cross_linked():
     assert "performance.md#transport-layer" in obs
 
 
+def test_pallas_kernels_documented_and_cross_linked():
+    """The Pallas kernel suite's user contract lives in three places: the
+    performance guide (dispatch contract, shape gates, force/disable,
+    tolerance table), the observability guide (the kernel.dispatch counter
+    + Prometheus family), and the modules reference (one row per exported
+    trio) — cross-linked both ways."""
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "## Pallas kernels" in perf
+    for phrase in (
+        "use_pallas=True",
+        "use_pallas=False",
+        "interpret=True",
+        "segment_scatter_add",
+        "label_score_histograms",
+        "stat_scores_counts",
+        "confmat_counts",
+        "kernel.dispatch",
+        "kernels_off",
+        "pallas_scatter_step",
+        "pallas_sketch_build_step",
+        "pallas_stat_scores_step",
+        "dispatch_path",
+    ):
+        assert phrase in perf, phrase
+    assert "observability.md#kernel-dispatch-telemetry" in perf
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "## Kernel dispatch telemetry" in obs
+    for phrase in (
+        "metrics_tpu_kernel_dispatch_total",
+        'snapshot()["kernels"]',
+        "kernels_off",
+        "dispatch_path",
+    ):
+        assert phrase in obs, phrase
+    assert "performance.md#pallas-kernels" in obs
+    with open(f"{DOCS_DIR}/modules.md") as fh:
+        mods = fh.read()
+    import metrics_tpu.kernels as kernels_pkg
+
+    for op in ("confmat_counts", "segment_scatter_add", "label_score_histograms", "stat_scores_counts"):
+        # the contract trio must exist in code AND have a modules row
+        for suffix in ("", "_pallas", "_xla"):
+            assert hasattr(kernels_pkg, op + suffix), op + suffix
+        assert f"`metrics_tpu.kernels.{op}`" in mods, op
+
+
+def test_tenant_scoped_cache_documented():
+    """The per-tenant generation ledger (SLOScheduler) must be documented in
+    the serving counters table and the performance guide's serving section."""
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "tenant_cache_hits" in obs
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "tenant_cache_hits" in perf
+
+
 def test_serving_layer_documented_and_cross_linked():
     """The serving layer's user contract lives in three places: its own
     guide (queue/scheduler/policy knobs, SLO guidance, shed accounting,
