@@ -1,8 +1,8 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check soak
+.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check soak checkpoint-smoke
 
-ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check
+ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check checkpoint-smoke
 
 # Full suite on the virtual 8-device CPU mesh (tests/conftest.py), including
 # the real 2-process jax.distributed sync test (tests/bases/test_multiprocess.py).
@@ -61,6 +61,14 @@ bench-regress:
 # Full benchmark suite on the default backend (the real TPU chip under axon).
 bench:
 	python bench.py
+
+# Checkpoint save→crash→restore smoke (scripts/checkpoint_smoke.py): full +
+# O(k)-delta snapshots, a save killed at every injectable protocol step with
+# restore pinned to the last COMPLETE snapshot, topology/capacity-flexible
+# restore bit-identity, and an async save overlapping live updates. Exit 1
+# on any violation. The durability plane's CI leg.
+checkpoint-smoke:
+	JAX_PLATFORMS=cpu python scripts/checkpoint_smoke.py
 
 # Serving-layer soak (scripts/soak.py): sustained synthetic QPS over 10k
 # tenants for 60 s, p50/p99 ingest latency + the zero-lost-updates invariant

@@ -72,6 +72,8 @@ from metrics_tpu.retrieval import (  # noqa: F401 E402
 from metrics_tpu.wrappers import BootStrapper, KeyedMetric, MultiTenantCollection  # noqa: F401 E402
 from metrics_tpu import serving  # noqa: F401 E402
 from metrics_tpu.serving import AdmissionQueue, SLOScheduler  # noqa: F401 E402
+from metrics_tpu import durability  # noqa: F401 E402
+from metrics_tpu.durability import CheckpointManager, TenantSpiller  # noqa: F401 E402
 
 __all__ = [
     "AUC",
@@ -85,6 +87,7 @@ __all__ = [
     "BinnedRecallAtFixedPrecision",
     "BootStrapper",
     "BufferOverflowError",
+    "CheckpointManager",
     "CohenKappa",
     "CompositionalMetric",
     "ConfusionMatrix",
@@ -131,4 +134,5 @@ __all__ = [
     "Specificity",
     "SpearmanCorrcoef",
     "StatScores",
+    "TenantSpiller",
 ]

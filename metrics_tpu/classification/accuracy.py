@@ -180,6 +180,19 @@ class Accuracy(StatScores):
 
             self._accumulate(tp, fp, tn, fn)
 
+    def _restore_derived(self, state) -> None:
+        """Decode the learned data mode from a restored ``mode_code`` state
+        (checkpoint restore into a fresh instance — see
+        :meth:`Metric._restore_derived`). The eager max over the possibly
+        tenant-stacked codes mirrors the ``dist_reduce_fx="max"`` sync."""
+        if self.mode is not None or "mode_code" not in state:
+            return
+        import numpy as np
+
+        code = int(np.max(np.atleast_1d(np.asarray(state["mode_code"]))))
+        if code:
+            self.mode = _MODE_CODES[code]
+
     def _effective_mode(self):
         """The data mode for compute(): locally learned, or — when this rank
         never updated — decoded from the synced ``mode_code`` (concrete on

@@ -1,0 +1,64 @@
+"""Durability plane: the state lifecycle between serving and transport.
+
+Three coupled capabilities for a metrics service that runs for weeks (see
+``docs/durability.md``):
+
+* **Incremental checkpointing**
+  (:mod:`~metrics_tpu.durability.checkpoint`) —
+  :class:`CheckpointManager` writes mergeable snapshots over the packed
+  byte-bundle encoding with a manifest + atomic-rename protocol (a crash
+  mid-save always leaves the previous complete snapshot restorable), delta
+  saves stamping only the tenants touched since the last save (O(k)
+  payload, asserted from the manifest), and asynchronous saves overlapping
+  update traffic on the durability lane of the PR-9 background engine.
+* **Topology-flexible restore** — a snapshot saved on one mesh/process
+  topology restores onto a different one (8-way → 4-way, replicated ↔
+  :class:`~metrics_tpu.transport.ShardedTransport` via
+  ``Transport.place_state``, different tenant-capacity padding): restore
+  is a re-reduce of mergeable shards, bit-identical for integer/extremal
+  states by construction.
+* **Elastic capacity + cold-tenant spill** —
+  :meth:`KeyedMetric.grow <metrics_tpu.wrappers.KeyedMetric.grow>` /
+  :meth:`compact <metrics_tpu.wrappers.KeyedMetric.compact>` resize the
+  keyed axis with pow2-padded capacities (at most ``log2(max N) + 1``
+  keyed programs, ever), and :class:`TenantSpiller` LRU-evicts idle
+  tenants' rows to host memory on the PR-7 staleness signal, faulting
+  them back transparently on the next update/read with exact conservation
+  (``resident_active + spilled == active``).
+
+Everything is host-side: with durability features unused, every
+pre-existing hot-path jaxpr is byte-identical
+(``scripts/check_zero_overhead.py``, the ``durability_off`` digests). The
+``durability.*`` telemetry family
+(:mod:`~metrics_tpu.durability.telemetry`) surfaces in
+``observability.snapshot()["durability"]``, the
+``metrics_tpu_durability_*`` Prometheus series, ``durability`` timeline
+events, and the save/restore/fault-back log2 histograms.
+"""
+from metrics_tpu.durability.checkpoint import (  # noqa: F401
+    CheckpointCrash,
+    CheckpointError,
+    CheckpointManager,
+    inject_crash,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from metrics_tpu.durability.spill import TenantSpiller  # noqa: F401
+from metrics_tpu.durability.telemetry import (  # noqa: F401
+    DURABILITY_STATS,
+    DurabilityStats,
+    summary,
+)
+
+__all__ = [
+    "CheckpointCrash",
+    "CheckpointError",
+    "CheckpointManager",
+    "DURABILITY_STATS",
+    "DurabilityStats",
+    "TenantSpiller",
+    "inject_crash",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "summary",
+]

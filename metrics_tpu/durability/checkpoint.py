@@ -1,0 +1,909 @@
+"""Incremental checkpointing: mergeable snapshots with a crash-safe manifest
+protocol.
+
+A snapshot is a directory of **payload shards** plus one ``MANIFEST.json``,
+riding the packed-bundle byte encoding the eager gather transport already
+uses (``utilities/distributed.py``): every leaf is a contiguous raw-byte
+span of one shard file, and the descriptors — name, shape, dtype, declared
+reduction, byte offset — live in the manifest instead of an int64 descriptor
+row. Three properties fall out of that encoding:
+
+* **Mergeable by construction.** A shard holds one participant's *partial*
+  state under the leaves' declared reductions; restoring a multi-shard
+  snapshot re-reduces the shards (``sum`` adds, ``max``/``min`` fold) —
+  bit-identical for integer and extremal states, exactly like the packed
+  transport's collectives. A single-process save is the one-shard special
+  case.
+* **Topology-flexible restore.** The payload carries host bytes, never
+  device layouts: a snapshot saved on an 8-way mesh restores onto a 4-way
+  mesh, onto a :class:`~metrics_tpu.transport.sharded.ShardedTransport`
+  placement (``Transport.place_state``), or into a metric with a different
+  padded tenant capacity — only the logical ``[:num_tenants]`` rows are
+  ever saved, so the physical padding is the *target's* business.
+* **Delta checkpoints.** A save stamps only the tenants whose per-tenant
+  write marks moved since the previous save — the serving scheduler's
+  per-tenant generation ledger when one is attached, the PR-7 traffic
+  ledger's row counts otherwise — so touching k of N tenants writes an
+  O(k) payload (assertable from ``MANIFEST.json``: ``payload_bytes`` and
+  ``len(tenants)``). Restore replays the chain: full snapshot, then each
+  delta's rows in order.
+
+**Crash consistency** is the atomic-rename protocol: shards are written and
+fsynced into a dot-prefixed temp directory, the manifest is written last
+(also fsynced), the whole directory is renamed into place with one atomic
+``os.replace``, and only then does the ``LATEST`` pointer move (itself via
+write-temp + rename). A crash at ANY step leaves the previous complete
+snapshot restorable: temp directories are invisible to restore, a snapshot
+without a checksum-valid manifest+shards never enters a restore chain, and
+``LATEST`` is an optimization — restore falls back to scanning for the
+newest snapshot whose full parent chain validates. The
+:func:`inject_crash` hook lets the fault-injection tests kill a save at
+every one of those steps.
+
+Saves run synchronously (:meth:`CheckpointManager.save`) or on the
+durability lane of the PR-9 background engine
+(:meth:`CheckpointManager.save_async` —
+``get_engine("durability")``), overlapping serialization and disk writes
+with live update traffic: the state snapshot is a set of immutable device
+array references taken under the metric's ingest lock (consistent by
+construction, even mid-soak), and the donation audit routes concurrent
+updates through the copying executable while those references are held.
+"""
+import hashlib
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from metrics_tpu.durability.telemetry import (
+    DURABILITY_STATS,
+    observe_restore,
+    observe_save,
+)
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.registry import TELEMETRY
+
+__all__ = [
+    "CheckpointCrash",
+    "CheckpointError",
+    "CheckpointManager",
+    "inject_crash",
+    "list_snapshots",
+    "load_manifest",
+    "merge_shard_states",
+    "read_snapshot_state",
+    "resolve_chain",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "write_snapshot",
+]
+
+#: manifest schema version (bumped on incompatible layout changes)
+MANIFEST_SCHEMA = 1
+MANIFEST_NAME = "MANIFEST.json"
+LATEST_NAME = "LATEST"
+#: the ledger pseudo-bundle: per-tenant routed-row counts ride the payload
+#: so delta marks survive a restore (never a metric state leaf)
+LEDGER_BUNDLE = "__ledger__"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint operation failed (no restorable snapshot, layout
+    mismatch, target too small)."""
+
+
+class CheckpointCrash(RuntimeError):
+    """Raised by the fault-injection hook to simulate a crash mid-save."""
+
+
+#: armed crash points (fault-injection tests only; empty in production)
+_CRASH_POINTS: set = set()
+
+#: the protocol steps a save walks, in order — each is injectable
+CRASH_POINTS = (
+    "before_shard",
+    "after_shard",
+    "before_manifest",
+    "after_manifest",
+    "before_rename",
+    "after_rename",
+    "before_latest",
+)
+
+
+def _maybe_crash(point: str) -> None:
+    if point in _CRASH_POINTS:
+        raise CheckpointCrash(f"injected crash at {point!r}")
+
+
+@contextmanager
+def inject_crash(point: str):
+    """Arm one crash point for the duration of the block (the
+    fault-injection tests' hook). Raises ``ValueError`` on an unknown
+    point so a typo cannot silently test nothing."""
+    if point not in CRASH_POINTS:
+        raise ValueError(f"unknown crash point {point!r}; one of {CRASH_POINTS}")
+    _CRASH_POINTS.add(point)
+    try:
+        yield
+    finally:
+        _CRASH_POINTS.discard(point)
+
+
+# ---------------------------------------------------------------------------
+# payload encoding (the packed-bundle byte contract, descriptors in JSON)
+# ---------------------------------------------------------------------------
+
+
+def _encode_payload(
+    leaves: Sequence[Tuple[str, str, np.ndarray, Any]]
+) -> Tuple[bytes, List[Dict[str, Any]]]:
+    """Pack ``(bundle, name, array, reduction)`` leaves into one contiguous
+    byte payload + the manifest layout rows describing each span."""
+    parts: List[bytes] = []
+    layout: List[Dict[str, Any]] = []
+    offset = 0
+    for bundle, name, arr, reduction in leaves:
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        layout.append(
+            {
+                "bundle": bundle,
+                "name": name,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "reduction": reduction if isinstance(reduction, str) else None,
+                "offset": offset,
+                "nbytes": len(raw),
+            }
+        )
+        parts.append(raw)
+        offset += len(raw)
+    return b"".join(parts), layout
+
+
+def _decode_payload(
+    payload: bytes, layout: Sequence[Dict[str, Any]]
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """The inverse of :func:`_encode_payload`: ``{bundle: {name: array}}``."""
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for row in layout:
+        raw = payload[row["offset"] : row["offset"] + row["nbytes"]]
+        arr = np.frombuffer(raw, dtype=np.dtype(row["dtype"])).reshape(row["shape"])
+        out.setdefault(row["bundle"], {})[row["name"]] = arr.copy()
+    return out
+
+
+def merge_shard_states(
+    shard_states: Sequence[Dict[str, Dict[str, np.ndarray]]],
+    layout: Sequence[Dict[str, Any]],
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Re-reduce per-shard partial states into one state by each leaf's
+    declared reduction — the restore-side analogue of the packed
+    collectives: ``sum`` adds shard contributions, ``max``/``min`` fold
+    elementwise (bit-identical for integer/extremal leaves), a leaf with no
+    declared reduction takes the first shard's value."""
+    if len(shard_states) == 1:
+        return shard_states[0]
+    reductions = {(r["bundle"], r["name"]): r.get("reduction") for r in layout}
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for bundle, leaves in shard_states[0].items():
+        out[bundle] = {}
+        for name, first in leaves.items():
+            fx = reductions.get((bundle, name))
+            acc = first.copy()
+            for other in shard_states[1:]:
+                contrib = other[bundle][name]
+                if fx == "sum" or fx == "mean":
+                    acc = acc + contrib
+                elif fx == "max":
+                    acc = np.maximum(acc, contrib)
+                elif fx == "min":
+                    acc = np.minimum(acc, contrib)
+                # no declared reduction: first shard wins (replicated leaf)
+            if fx == "mean":
+                acc = acc / len(shard_states)
+            out[bundle][name] = acc
+    return out
+
+
+# ---------------------------------------------------------------------------
+# on-disk protocol
+# ---------------------------------------------------------------------------
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platform without dir fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_snapshot(
+    directory: str,
+    manifest: Dict[str, Any],
+    shard_payloads: Sequence[bytes],
+) -> Dict[str, Any]:
+    """Write one snapshot atomically: shards + manifest into a temp dir,
+    one ``os.replace`` into place, then the ``LATEST`` pointer. Returns the
+    completed manifest. The caller provides ``manifest`` WITHOUT the
+    ``shards`` section — checksums and byte counts are computed here so the
+    manifest can never disagree with the bytes on disk."""
+    name = manifest["name"]
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(directory, f".tmp-{name}")
+    final = os.path.join(directory, name)
+    if os.path.exists(tmp):
+        import shutil
+
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    shards: List[Dict[str, Any]] = []
+    _maybe_crash("before_shard")
+    for i, payload in enumerate(shard_payloads):
+        fn = f"shard-{i:05d}.bin"
+        path = os.path.join(tmp, fn)
+        with open(path, "wb") as fh:
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        shards.append(
+            {
+                "file": fn,
+                "bytes": len(payload),
+                "sha256": hashlib.sha256(payload).hexdigest(),
+            }
+        )
+    _maybe_crash("after_shard")
+
+    manifest = dict(manifest)
+    manifest["shards"] = shards
+    manifest["payload_bytes"] = int(sum(s["bytes"] for s in shards))
+    manifest["complete"] = True
+    _maybe_crash("before_manifest")
+    mpath = os.path.join(tmp, MANIFEST_NAME)
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    _maybe_crash("after_manifest")
+
+    _maybe_crash("before_rename")
+    os.replace(tmp, final)
+    _fsync_dir(directory)
+    _maybe_crash("after_rename")
+
+    _maybe_crash("before_latest")
+    latest_tmp = os.path.join(directory, f".{LATEST_NAME}.tmp")
+    with open(latest_tmp, "w") as fh:
+        fh.write(name + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(latest_tmp, os.path.join(directory, LATEST_NAME))
+    _fsync_dir(directory)
+    return manifest
+
+
+def list_snapshots(directory: str) -> List[str]:
+    """Snapshot directory names present on disk (complete or not),
+    ascending; temp dirs and pointer files are invisible."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        d
+        for d in os.listdir(directory)
+        if d.startswith("snap-") and os.path.isdir(os.path.join(directory, d))
+    )
+
+
+def load_manifest(directory: str, name: str) -> Optional[Dict[str, Any]]:
+    """The snapshot's manifest, checksum-verified against its shard files;
+    ``None`` for anything torn, truncated, or tampered — an invalid
+    snapshot simply does not exist as far as restore is concerned."""
+    path = os.path.join(directory, name, MANIFEST_NAME)
+    try:
+        with open(path) as fh:
+            manifest = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(manifest, dict) or not manifest.get("complete"):
+        return None
+    if manifest.get("schema") != MANIFEST_SCHEMA:
+        return None
+    for shard in manifest.get("shards", []):
+        spath = os.path.join(directory, name, shard["file"])
+        try:
+            with open(spath, "rb") as fh:
+                payload = fh.read()
+        except OSError:
+            return None
+        if len(payload) != shard["bytes"]:
+            return None
+        if hashlib.sha256(payload).hexdigest() != shard["sha256"]:
+            return None
+    return manifest
+
+
+def resolve_chain(directory: str) -> List[Dict[str, Any]]:
+    """The newest restorable chain, full snapshot first: the latest valid
+    snapshot whose whole parent ancestry validates. The ``LATEST`` pointer
+    is consulted first; a stale/missing/torn pointer degrades to a scan.
+    Returns ``[]`` when nothing restorable exists."""
+    # newest-first scan: a crash between the snapshot rename and the LATEST
+    # pointer update leaves the pointer one snapshot behind — the completed
+    # (renamed) snapshot is restorable and must win, so the pointer is never
+    # trusted over a newer on-disk candidate (it only serves tooling)
+    ordered = list(reversed(list_snapshots(directory)))
+
+    manifests: Dict[str, Optional[Dict[str, Any]]] = {}
+
+    def valid(name: str) -> Optional[Dict[str, Any]]:
+        if name not in manifests:
+            manifests[name] = load_manifest(directory, name)
+        return manifests[name]
+
+    for head in ordered:
+        chain: List[Dict[str, Any]] = []
+        cursor: Optional[str] = head
+        ok = True
+        while cursor is not None:
+            manifest = valid(cursor)
+            if manifest is None:
+                ok = False
+                break
+            chain.append(manifest)
+            cursor = manifest.get("parent")
+            if manifest["kind"] == "full":
+                cursor = None
+        if ok and chain and chain[-1]["kind"] == "full":
+            return list(reversed(chain))
+    return []
+
+
+def read_snapshot_state(
+    directory: str, manifest: Dict[str, Any]
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Decode one snapshot's payload into ``{bundle: {leaf: array}}``,
+    re-reducing multi-shard payloads by the declared reductions."""
+    shard_states = []
+    for shard in manifest["shards"]:
+        with open(os.path.join(directory, manifest["name"], shard["file"]), "rb") as fh:
+            payload = fh.read()
+        DURABILITY_STATS.inc("bytes_read", len(payload))
+        shard_states.append(_decode_payload(payload, manifest["layout"]))
+    return merge_shard_states(shard_states, manifest["layout"])
+
+
+#: lazily-jitted fused row gather: ALL of a bundle's leaves gather their
+#: dirty rows in ONE dispatch (a per-leaf gather pays one XLA dispatch per
+#: state leaf — dispatch overhead dominating the O(k) payload is exactly
+#: the cost profile delta saves exist to avoid). jit's own aval/treedef
+#: cache bounds executables: one per (bundle layout, dirty-count) pair.
+_ROW_GATHER = None
+
+
+def _gather_bundle_rows(state: Dict[str, Any], dirty: np.ndarray) -> Dict[str, np.ndarray]:
+    global _ROW_GATHER
+    import jax
+    import jax.numpy as jnp
+
+    if _ROW_GATHER is None:
+        _ROW_GATHER = jax.jit(lambda s, ids: {k: v[ids] for k, v in s.items()})
+    out = _ROW_GATHER(state, jnp.asarray(dirty))
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# metric adapters
+# ---------------------------------------------------------------------------
+
+
+def _unwrap(metric: Any) -> Tuple[Any, Optional[Any]]:
+    """``(state-owning metric, scheduler-or-None)`` — accepts a bare
+    metric/wrapper or a serving ``SLOScheduler`` (duck-typed: the scheduler
+    owns the per-tenant write-generation ledger the delta marks prefer)."""
+    if hasattr(metric, "tenant_generations") and hasattr(metric, "_metric"):
+        return metric._metric, metric
+    return metric, None
+
+
+def _fault_back_all(metric: Any) -> None:
+    hooks = getattr(metric, "__dict__", {}).get("_durability_hooks")
+    if hooks is not None:
+        hooks.before_snapshot()
+
+
+def _is_collection(metric: Any) -> bool:
+    return hasattr(metric, "_require_built") and hasattr(metric, "_keyed")
+
+
+def _is_keyed(metric: Any) -> bool:
+    return hasattr(metric, "num_tenants") and hasattr(metric, "_segment_scatter")
+
+
+def _serial_lock(metric: Any):
+    lock = getattr(metric, "_serial_lock", None)
+    if callable(lock):
+        return lock()
+    return threading.RLock()
+
+
+def _bundles(metric: Any) -> Dict[str, Any]:
+    """``{bundle key: keyed-or-plain metric}`` — the state owners a
+    snapshot serializes. List ("cat") states are refused: durable snapshots
+    target fixed-shape mergeable states (use ``state_dict`` for unbounded
+    accumulators)."""
+    if _is_collection(metric):
+        return dict(metric._require_built())
+    owners = {"": metric}
+    for name, value in metric._get_states().items():
+        if isinstance(value, (list, tuple)):
+            hint = getattr(metric, "_sketch_hint", None)
+            raise CheckpointError(
+                f"{type(metric).__name__} holds unbounded list state `{name}`;"
+                " durable snapshots need fixed-shape mergeable states."
+                + (f" {hint}" if hint else "")
+            )
+    return owners
+
+
+# ---------------------------------------------------------------------------
+# the manager
+# ---------------------------------------------------------------------------
+
+
+class CheckpointManager:
+    """Own one metric's snapshot trail under ``directory``.
+
+    ``metric`` is a :class:`~metrics_tpu.wrappers.KeyedMetric`, a
+    :class:`~metrics_tpu.wrappers.MultiTenantCollection`, a plain
+    :class:`~metrics_tpu.Metric` with fixed-shape states, or a serving
+    :class:`~metrics_tpu.serving.SLOScheduler` (saves its metric; delta
+    marks ride the scheduler's per-tenant write generations).
+
+    ``history`` bounds retained snapshots: after a successful FULL save,
+    older snapshots beyond the newest ``history`` are deleted (a delta's
+    ancestry is never broken — pruning only ever happens behind a full).
+    """
+
+    def __init__(self, directory: str, metric: Any, *, history: Optional[int] = None):
+        self.directory = str(directory)
+        self._target, self._scheduler = _unwrap(metric)
+        self.history = None if history is None else int(history)
+        self._lock = threading.Lock()
+        self._last_marks: Optional[Tuple[str, Any]] = None
+        self._last_meta: Optional[Dict[str, Any]] = None
+        existing = resolve_chain(self.directory)
+        if existing:
+            self._last_meta = {
+                "name": existing[-1]["name"],
+                "num_tenants": existing[-1].get("num_tenants"),
+            }
+        self.telemetry_key = TELEMETRY.register(self)
+
+    # -- marks (the delta dirty-set source) ---------------------------------
+
+    def _current_marks(self) -> Optional[Tuple[str, Any]]:
+        if self._scheduler is not None:
+            return ("gen", dict(self._scheduler.tenant_generations()))
+        traffic = getattr(self._target, "_traffic", None)
+        if traffic is not None:
+            rows, _ = traffic.arrays()
+            if rows is not None:
+                return ("rows", rows)
+        return None
+
+    @staticmethod
+    def _dirty_tenants(
+        prev: Tuple[str, Any], cur: Tuple[str, Any]
+    ) -> Optional[np.ndarray]:
+        """Tenants whose write marks moved between two snapshots; ``None``
+        when the mark kinds/shapes are incomparable (falls back to full)."""
+        if prev[0] != cur[0]:
+            return None
+        if cur[0] == "gen":
+            prev_map, cur_map = prev[1], cur[1]
+            dirty = [t for t, g in cur_map.items() if g > prev_map.get(t, 0)]
+            return np.asarray(sorted(dirty), dtype=np.int64)
+        prev_rows, cur_rows = prev[1], cur[1]
+        if prev_rows.shape != cur_rows.shape:
+            return None
+        return np.nonzero(cur_rows != prev_rows)[0].astype(np.int64)
+
+    # -- save ---------------------------------------------------------------
+
+    def _next_name(self) -> str:
+        existing = list_snapshots(self.directory)
+        seq = 0
+        for name in existing:
+            try:
+                seq = max(seq, int(name.split("-", 1)[1]))
+            except (IndexError, ValueError):
+                continue
+        return f"snap-{seq + 1:08d}"
+
+    def _snapshot_refs(self) -> Tuple[Dict[str, Any], Optional[Tuple[str, Any]], Dict[str, Any]]:
+        """Under the metric's ingest lock: immutable device-array references
+        for every bundle leaf (+ the ledger), the current write marks, and
+        the keyed-geometry metadata — one consistent cut, even mid-soak."""
+        metric = self._target
+        with _serial_lock(metric):
+            _fault_back_all(metric)
+            bundles = _bundles(metric)
+            refs: Dict[str, Any] = {
+                key: dict(owner._get_states()) for key, owner in bundles.items()
+            }
+            marks = self._current_marks()
+            meta: Dict[str, Any] = {"metric": type(metric).__name__}
+            if _is_keyed(metric) or _is_collection(metric):
+                meta["keyed"] = True
+                meta["num_tenants"] = int(metric.num_tenants)
+                meta["capacity"] = int(getattr(metric, "capacity", metric.num_tenants))
+                traffic = getattr(metric, "_traffic", None)
+                rows = traffic.arrays()[0] if traffic is not None else None
+                if rows is not None:
+                    refs[LEDGER_BUNDLE] = {"rows": rows}
+            else:
+                meta["keyed"] = False
+        return refs, marks, meta
+
+    def save(self, *, delta: Optional[bool] = None) -> Dict[str, Any]:
+        """Write one snapshot synchronously and return its manifest.
+
+        ``delta=None`` (default) writes a delta when one is possible — a
+        prior snapshot exists, the write marks are comparable, and the
+        keyed geometry did not change — and a full snapshot otherwise;
+        ``True`` forces delta (raises when impossible), ``False`` forces
+        full."""
+        refs, marks, meta = self._snapshot_refs()
+        return self._write(refs, marks, meta, delta=delta)
+
+    def save_async(self, *, delta: Optional[bool] = None) -> Any:
+        """Queue the snapshot write on the durability lane of the
+        background engine (``get_engine("durability")``) and return its
+        :class:`~metrics_tpu.utilities.async_sync.SyncFuture` (resolves to
+        the manifest). The state cut happens NOW, on the caller thread,
+        under the ingest lock — everything after (host transfer,
+        serialization, fsync, rename) overlaps live traffic."""
+        from metrics_tpu.utilities.async_sync import get_engine
+
+        refs, marks, meta = self._snapshot_refs()
+        return get_engine("durability").submit(
+            f"checkpoint:{self.telemetry_key}",
+            lambda: self._write(refs, marks, meta, delta=delta),
+        )
+
+    def _write(
+        self,
+        refs: Dict[str, Any],
+        marks: Optional[Tuple[str, Any]],
+        meta: Dict[str, Any],
+        *,
+        delta: Optional[bool],
+    ) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        start = time.perf_counter()
+        with self._lock:
+            kind = "full"
+            dirty: Optional[np.ndarray] = None
+            parent = self._last_meta["name"] if self._last_meta else None
+            can_delta = (
+                meta.get("keyed", False)
+                and parent is not None
+                and marks is not None
+                and self._last_marks is not None
+                and self._last_meta.get("num_tenants") == meta.get("num_tenants")
+            )
+            if can_delta:
+                dirty = self._dirty_tenants(self._last_marks, marks)
+            if delta is True and (not can_delta or dirty is None):
+                raise CheckpointError(
+                    "delta save impossible: no comparable prior snapshot/marks"
+                    " (geometry changed, first save, or no write ledger)"
+                )
+            if delta is not False and can_delta and dirty is not None:
+                kind = "delta"
+
+            n = meta.get("num_tenants")
+            leaves: List[Tuple[str, str, np.ndarray, Any]] = []
+            try:
+                for bundle, state in refs.items():
+                    if bundle == LEDGER_BUNDLE:
+                        rows = state["rows"]
+                        if kind == "delta":
+                            rows = rows[dirty]
+                        leaves.append((bundle, "rows", np.asarray(rows), None))
+                        continue
+                    owner = self._bundle_owner(bundle)
+                    reductions = getattr(owner, "_reductions", {})
+                    if kind == "delta":
+                        gathered = _gather_bundle_rows(state, dirty)
+                    for name, leaf in state.items():
+                        if kind == "delta":
+                            rows = gathered[name]
+                        elif meta.get("keyed", False):
+                            # capacity padding is never saved; the slice is
+                            # skipped entirely when there is none (no XLA
+                            # dispatch for the common exact-capacity case)
+                            rows = (
+                                np.asarray(leaf)
+                                if leaf.shape[0] == n
+                                else np.asarray(leaf[:n])
+                            )
+                        else:
+                            rows = np.asarray(leaf)
+                        leaves.append((bundle, name, rows, reductions.get(name)))
+
+                payload, layout = _encode_payload(leaves)
+                manifest = {
+                    "schema": MANIFEST_SCHEMA,
+                    "name": self._next_name(),
+                    "kind": kind,
+                    "parent": parent if kind == "delta" else None,
+                    "created_unix_s": round(time.time(), 3),
+                    "layout": layout,
+                    "tenants": (
+                        [int(t) for t in dirty] if kind == "delta" else None
+                    ),
+                    **meta,
+                }
+                manifest = write_snapshot(self.directory, manifest, [payload])
+            except BaseException:
+                DURABILITY_STATS.inc("save_errors")
+                if EVENTS.enabled:
+                    EVENTS.record(
+                        "durability", self.telemetry_key, path="save_error", snapshot_kind=kind
+                    )
+                raise
+            # marks advance only on a COMPLETED snapshot: a crashed save
+            # must leave the dirty set intact for the retry
+            self._last_marks = marks
+            self._last_meta = {
+                "name": manifest["name"],
+                "num_tenants": meta.get("num_tenants"),
+            }
+            if kind == "full" and self.history is not None:
+                self._prune(keep=self.history)
+
+        dur = time.perf_counter() - start
+        DURABILITY_STATS.inc("saves")
+        if kind == "delta":
+            DURABILITY_STATS.inc("delta_saves")
+            DURABILITY_STATS.inc("tenants_stamped", int(len(dirty)))
+        DURABILITY_STATS.inc("bytes_written", manifest["payload_bytes"])
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "saves")
+            observe_save(dur, kind)
+        if EVENTS.enabled:
+            EVENTS.record(
+                "durability",
+                self.telemetry_key,
+                dur_s=dur,
+                t_start=start,
+                path="save",
+                snapshot_kind=kind,
+                snapshot=manifest["name"],
+                payload_bytes=manifest["payload_bytes"],
+                tenants_stamped=(len(dirty) if kind == "delta" else None),
+            )
+        return manifest
+
+    def _bundle_owner(self, bundle: str) -> Any:
+        if bundle == "" or not _is_collection(self._target):
+            return getattr(self._target, "_child", self._target)
+        return self._target._require_built()[bundle]._child
+
+    def _prune(self, keep: int) -> None:
+        """Drop snapshots older than the newest ``keep`` — called only
+        behind a completed FULL save, so no surviving delta's ancestry can
+        dangle."""
+        import shutil
+
+        names = list_snapshots(self.directory)
+        for name in names[: max(0, len(names) - keep)]:
+            shutil.rmtree(os.path.join(self.directory, name), ignore_errors=True)
+
+    # -- restore ------------------------------------------------------------
+
+    def restore(
+        self,
+        metric: Optional[Any] = None,
+        *,
+        transport: Optional[Any] = None,
+    ) -> Any:
+        """Restore the newest complete chain into ``metric`` (default: the
+        managed metric) and return it.
+
+        The assembled host state is re-placed for the TARGET's topology:
+        ``transport.place_state`` when a transport is given (e.g. a
+        :class:`~metrics_tpu.transport.sharded.ShardedTransport` shards the
+        tenant axis), else the target's own ``tenant_sharding``, else plain
+        device arrays — restore never assumes the saving topology. A keyed
+        target needs ``num_tenants >=`` the saved logical count; extra
+        capacity rows stay at the defaults."""
+        start = time.perf_counter()
+        target = self._target if metric is None else _unwrap(metric)[0]
+        chain = resolve_chain(self.directory)
+        if not chain:
+            DURABILITY_STATS.inc("restore_errors")
+            raise CheckpointError(
+                f"no restorable snapshot under {self.directory!r} (nothing"
+                " complete, or every chain has a torn ancestor)"
+            )
+        state = read_snapshot_state(self.directory, chain[0])
+        if chain[0].get("keyed") and LEDGER_BUNDLE not in state:
+            # the full snapshot predates any routed row (ledger untracked at
+            # its cut) but a later delta carries ledger rows: zero base
+            state[LEDGER_BUNDLE] = {
+                "rows": np.zeros(int(chain[0]["num_tenants"]), np.int64)
+            }
+        for manifest in chain[1:]:
+            delta = read_snapshot_state(self.directory, manifest)
+            ids = np.asarray(manifest["tenants"], dtype=np.int64)
+            for bundle, leaves in delta.items():
+                for name, rows in leaves.items():
+                    base = state[bundle][name]
+                    base[ids] = rows
+        self._install(target, chain[-1], state, transport)
+
+        dur = time.perf_counter() - start
+        DURABILITY_STATS.inc("restores")
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "restores")
+            observe_restore(dur)
+        if EVENTS.enabled:
+            EVENTS.record(
+                "durability",
+                self.telemetry_key,
+                dur_s=dur,
+                t_start=start,
+                path="restore",
+                snapshot=chain[-1]["name"],
+                chain=len(chain),
+            )
+        # restored state == last completed snapshot: the next delta's dirty
+        # set is "everything touched since that snapshot"
+        with self._lock:
+            if target is self._target:
+                self._last_marks = self._current_marks()
+                self._last_meta = {
+                    "name": chain[-1]["name"],
+                    "num_tenants": chain[-1].get("num_tenants"),
+                }
+        return target
+
+    def _install(
+        self,
+        target: Any,
+        manifest: Dict[str, Any],
+        state: Dict[str, Dict[str, np.ndarray]],
+        transport: Optional[Any],
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        ledger = state.pop(LEDGER_BUNDLE, None)
+        saved_n = manifest.get("num_tenants")
+        keyed = bool(manifest.get("keyed"))
+
+        targets: Dict[str, Any]
+        if _is_collection(target):
+            owners = target._require_built()
+            missing = set(state) - set(owners)
+            if missing:
+                raise CheckpointError(
+                    f"restore target collection lacks state bundles {sorted(missing)}"
+                    " — build() it with the same members/groups as the saved one"
+                )
+            targets = {k: owners[k] for k in state}
+        else:
+            if set(state) != {""}:
+                raise CheckpointError(
+                    "snapshot holds a collection's bundles"
+                    f" ({sorted(state)}); the restore target is a single metric"
+                )
+            targets = {"": target}
+
+        for bundle, owner in targets.items():
+            leaves = state[bundle]
+            if set(leaves) != set(owner._defaults):
+                raise CheckpointError(
+                    f"snapshot leaves {sorted(leaves)} do not match the target's"
+                    f" states {sorted(owner._defaults)} (bundle {bundle!r})"
+                )
+            new_state: Dict[str, Any] = {}
+            if keyed:
+                if owner.num_tenants < saved_n:
+                    raise CheckpointError(
+                        f"restore target has num_tenants={owner.num_tenants} <"
+                        f" saved {saved_n}; grow() the target first"
+                    )
+                for name, rows in leaves.items():
+                    leaf = jnp.asarray(owner._defaults[name]).at[:saved_n].set(
+                        jnp.asarray(rows)
+                    )
+                    new_state[name] = leaf
+            else:
+                for name, arr in leaves.items():
+                    new_state[name] = jnp.asarray(arr)
+            if transport is not None:
+                new_state = transport.place_state(new_state)
+            elif getattr(owner, "tenant_sharding", None) is not None:
+                new_state = {
+                    k: jax.device_put(v, owner.tenant_sharding)
+                    for k, v in new_state.items()
+                }
+            owner._set_states(new_state)
+            owner._computed = None
+            owner._forward_cache = None
+            owner._update_called = True
+            # metrics that learn config from data (Accuracy.mode, ...)
+            # decode it from the restored states — a fresh restore target
+            # never saw a batch, so the clone/pickle channel is absent
+            derived_host = getattr(owner, "_child", owner)
+            derived_host._restore_derived(leaves)
+
+        wrapper = target
+        traffic = getattr(wrapper, "_traffic", None)
+        if ledger is not None and traffic is not None and keyed:
+            rows = np.zeros(wrapper.num_tenants, dtype=np.int64)
+            saved_rows = ledger["rows"]
+            rows[: min(len(saved_rows), len(rows))] = saved_rows[: len(rows)]
+            with traffic._lock:
+                traffic.rows = rows
+                traffic.last_seen = np.full(wrapper.num_tenants, np.nan)
+
+    # -- introspection ------------------------------------------------------
+
+    def latest(self) -> Optional[str]:
+        """Name of the newest restorable snapshot (``None`` when nothing
+        restorable exists)."""
+        chain = resolve_chain(self.directory)
+        return chain[-1]["name"] if chain else None
+
+    def report(self) -> Dict[str, Any]:
+        chain = resolve_chain(self.directory)
+        return {
+            "directory": self.directory,
+            "snapshots_on_disk": len(list_snapshots(self.directory)),
+            "restorable_chain": [m["name"] for m in chain],
+            "latest": chain[-1]["name"] if chain else None,
+            "latest_kind": chain[-1]["kind"] if chain else None,
+            "payload_bytes_latest": chain[-1]["payload_bytes"] if chain else None,
+        }
+
+
+# ---------------------------------------------------------------------------
+# one-shot helpers
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(directory: str, metric: Any, **kwargs: Any) -> Dict[str, Any]:
+    """One full snapshot of ``metric`` under ``directory`` (a throwaway
+    :class:`CheckpointManager`; keep a manager for delta trails)."""
+    return CheckpointManager(directory, metric).save(**kwargs)
+
+
+def restore_checkpoint(directory: str, metric: Any, **kwargs: Any) -> Any:
+    """Restore the newest complete chain under ``directory`` into
+    ``metric`` and return it."""
+    return CheckpointManager(directory, metric).restore(metric, **kwargs)

@@ -1,0 +1,362 @@
+"""Cold-tenant spill: LRU-evict idle tenants' rows to host memory.
+
+A weeks-long multi-tenant service accumulates state for every tenant that
+EVER appeared; device HBM pays for all of them forever even though traffic
+is heavily skewed. :class:`TenantSpiller` bounds the device-resident
+working set: tenants idle longest (the PR-7 staleness ledger's
+``last_seen`` is the signal; the spiller keeps its own stamp as a fallback
+so eviction works with telemetry disabled) are **evicted** — their rows of
+every stacked leaf copy to host numpy and the device rows reset to the
+child defaults — and **fault back transparently**:
+
+* an ``update``/``update_many`` naming a spilled tenant faults its rows
+  back BEFORE the dispatch (under the metric's ingest lock), so every
+  routable reduction accumulates exactly — no merge arithmetic, no drift;
+* a ``compute()``/rollup/clone/checkpoint faults back every spilled tenant
+  first (``before_read``/``before_snapshot``), so reads are bit-identical
+  to a never-evicted metric.
+
+The spiller installs itself as the metric's durability hooks
+(``metric._durability_hooks``) — the wrappers call ``before_update``/
+``after_update``/``before_read``/``before_snapshot``/``on_resize`` from
+their stateful paths; the pure ``apply_update`` path and every compiled
+program are untouched (the zero-overhead ``durability_off`` digests pin
+it). Eviction/fault-back scatters pad their tenant cohorts to power-of-two
+buckets (ids repeated — an idempotent row write), so the executable cache
+stays log2-bounded exactly like the serving queue's ``pad_to_bucket``.
+
+**Conservation law** (checked by :meth:`report`, pinned by the spill soak):
+``resident_active + spilled == active_total`` — every tenant that ever
+received a row is either device-resident or host-spilled, never both,
+never neither — and the serving ledger's
+``submitted − shed == dispatched == rows_routed`` invariant is untouched
+because fault-back precedes every dispatch.
+"""
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from metrics_tpu.durability.telemetry import (
+    DURABILITY_STATS,
+    observe_faultback,
+)
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.registry import TELEMETRY
+
+__all__ = ["TenantSpiller"]
+
+
+def _pad_pow2(ids: np.ndarray) -> np.ndarray:
+    """Pad a tenant cohort to the next power-of-two length by repeating the
+    last id — duplicate scatter-writes of the same row value are
+    idempotent, and the padded shapes bound the executable cache."""
+    n = len(ids)
+    bucket = 1 << max(0, n - 1).bit_length()
+    if bucket == n:
+        return ids
+    return np.concatenate([ids, np.full(bucket - n, ids[-1], ids.dtype)])
+
+
+class TenantSpiller:
+    """Bound a keyed metric's device-resident tenant rows.
+
+    Args:
+        metric: a :class:`~metrics_tpu.wrappers.KeyedMetric` or
+            :class:`~metrics_tpu.wrappers.MultiTenantCollection` (a
+            collection spills the same tenant's rows across EVERY state
+            bundle together — a tenant is resident or spilled as a unit).
+        resident_cap: target bound on device-resident ACTIVE tenants;
+            ``maybe_evict`` (run automatically after every update when
+            ``auto=True``) evicts the coldest active tenants down to it.
+        min_idle_s: never evict a tenant updated more recently than this
+            (hot tenants stay resident even over the cap).
+        auto: evict automatically after each update dispatch.
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        *,
+        resident_cap: int,
+        min_idle_s: float = 0.0,
+        auto: bool = True,
+    ) -> None:
+        if int(resident_cap) < 1:
+            raise ValueError(f"resident_cap must be >= 1, got {resident_cap}")
+        existing = metric.__dict__.get("_durability_hooks")
+        if existing is not None:
+            raise ValueError(
+                f"{type(metric).__name__} already has durability hooks"
+                f" ({type(existing).__name__}); detach() the old spiller first"
+            )
+        self._metric = metric
+        self.resident_cap = int(resident_cap)
+        self.min_idle_s = float(min_idle_s)
+        self.auto = bool(auto)
+        n = int(metric.num_tenants)
+        #: tenant -> {bundle -> {leaf -> host row}} (the spilled rows)
+        self._spilled: Dict[int, Dict[str, Dict[str, np.ndarray]]] = {}
+        #: own touch stamps/active mask: correct even with telemetry off
+        self._last_touch = np.full(n, -np.inf)
+        self._touched = np.zeros(n, dtype=bool)
+        # seed from the PR-7 traffic ledger so tenants active BEFORE the
+        # spiller attached are eviction candidates from the first pass
+        traffic = getattr(metric, "_traffic", None)
+        if traffic is not None:
+            rows, last_seen = traffic.arrays()
+            if rows is not None:
+                k = min(n, len(rows))
+                self._touched[:k] = rows[:k] > 0
+                seen = last_seen[:k] - time.time() + time.monotonic()
+                self._last_touch[:k] = np.where(np.isnan(last_seen[:k]), -np.inf, seen)
+        self._spilled_bytes = 0
+        self.telemetry_key = TELEMETRY.register(self)
+        metric.__dict__["_durability_hooks"] = self
+        DURABILITY_STATS.register_spiller(self)
+
+    # ------------------------------------------------------------------
+    # hook protocol (called by the wrappers' stateful paths)
+    # ------------------------------------------------------------------
+
+    def before_update(self, ids: np.ndarray) -> None:
+        """Fault back any spilled tenant named in this batch (exactness:
+        the dispatch must accumulate onto the true rows)."""
+        if self._spilled:
+            hit = sorted({int(t) for t in np.unique(ids) if int(t) in self._spilled})
+            if hit:
+                self._fault_back_ids(hit)
+
+    def after_update(self, ids: np.ndarray) -> None:
+        now = time.monotonic()
+        valid = ids[(ids >= 0) & (ids < len(self._last_touch))]
+        if valid.size:
+            self._last_touch[valid] = now
+            self._touched[valid] = True
+        if self.auto:
+            self.maybe_evict()
+
+    def before_read(self) -> None:
+        """Full-residency barrier for reads: every spilled tenant faults
+        back so per-tenant values are bit-identical to never-evicted."""
+        self.fault_back()
+
+    def before_snapshot(self) -> None:
+        """Same barrier for clones/pickles/checkpoints."""
+        self.fault_back()
+
+    def on_resize(self, num_tenants: int) -> None:
+        n = int(num_tenants)
+        old = len(self._last_touch)
+        keep = min(old, n)
+        last, touched = self._last_touch, self._touched
+        self._last_touch = np.full(n, -np.inf)
+        self._touched = np.zeros(n, dtype=bool)
+        self._last_touch[:keep] = last[:keep]
+        self._touched[:keep] = touched[:keep]
+        for t in [t for t in self._spilled if t >= n]:
+            entry = self._spilled.pop(t)
+            self._spilled_bytes -= sum(
+                r.nbytes for leaves in entry.values() for r in leaves.values()
+            )
+
+    # ------------------------------------------------------------------
+    # the spill mechanics
+    # ------------------------------------------------------------------
+
+    def _bundles(self) -> Dict[str, Any]:
+        m = self._metric
+        if hasattr(m, "_require_built"):
+            return dict(m._require_built())
+        return {"": m}
+
+    def _evict_ids(self, ids: List[int]) -> None:
+        import jax.numpy as jnp
+
+        padded = _pad_pow2(np.asarray(sorted(ids), dtype=np.int64))
+        idx = jnp.asarray(padded)
+        for t in ids:
+            self._spilled[t] = {}
+        for bundle, owner in self._bundles().items():
+            defaults = owner._child._defaults
+            new_state: Dict[str, Any] = {}
+            for name in owner._defaults:
+                leaf = getattr(owner, name)
+                rows = np.asarray(leaf[idx])
+                for i, t in enumerate(sorted(ids)):
+                    row = rows[i].copy()
+                    self._spilled[t].setdefault(bundle, {})[name] = row
+                    self._spilled_bytes += row.nbytes
+                new_state[name] = leaf.at[idx].set(jnp.asarray(defaults[name]))
+            owner._set_states(new_state)
+            owner._computed = None
+            owner._forward_cache = None
+        DURABILITY_STATS.inc("evictions", len(ids))
+        DURABILITY_STATS.note_spill_occupancy(len(self._spilled))
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "evictions", len(ids))
+        if EVENTS.enabled:
+            EVENTS.record(
+                "durability",
+                self.telemetry_key,
+                path="evict",
+                tenants=len(ids),
+                spilled=len(self._spilled),
+            )
+
+    def _fault_back_ids(self, ids: List[int]) -> None:
+        import jax.numpy as jnp
+
+        start = time.perf_counter()
+        ordered = sorted(ids)
+        padded = _pad_pow2(np.asarray(ordered, dtype=np.int64))
+        idx = jnp.asarray(padded)
+        pad_tail = len(padded) - len(ordered)
+        for bundle, owner in self._bundles().items():
+            new_state: Dict[str, Any] = {}
+            for name in owner._defaults:
+                rows = np.stack(
+                    [self._spilled[t][bundle][name] for t in ordered]
+                    + [self._spilled[ordered[-1]][bundle][name]] * pad_tail
+                )
+                new_state[name] = getattr(owner, name).at[idx].set(jnp.asarray(rows))
+            owner._set_states(new_state)
+            owner._computed = None
+            owner._forward_cache = None
+        for t in ordered:
+            entry = self._spilled.pop(t)
+            self._spilled_bytes -= sum(
+                r.nbytes for leaves in entry.values() for r in leaves.values()
+            )
+        dur = time.perf_counter() - start
+        DURABILITY_STATS.inc("fault_backs", len(ordered))
+        DURABILITY_STATS.note_spill_occupancy(len(self._spilled))
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "fault_backs", len(ordered))
+            observe_faultback(dur)
+        if EVENTS.enabled:
+            EVENTS.record(
+                "durability",
+                self.telemetry_key,
+                dur_s=dur,
+                t_start=start,
+                path="fault_back",
+                tenants=len(ordered),
+                spilled=len(self._spilled),
+            )
+
+    # ------------------------------------------------------------------
+    # public control plane
+    # ------------------------------------------------------------------
+
+    def _lock(self):
+        return self._metric._serial_lock()
+
+    def _stamps(self) -> np.ndarray:
+        """Eviction signal: the metric's staleness ledger when it is
+        tracking (PR-7), the spiller's own touch stamps otherwise."""
+        traffic = getattr(self._metric, "_traffic", None)
+        if traffic is not None:
+            rows, last_seen = traffic.arrays()
+            if last_seen is not None:
+                stamps = np.where(np.isnan(last_seen), -np.inf, last_seen)
+                # ledger stamps are wall-clock; shift into the monotonic
+                # frame the min_idle_s comparison uses
+                return stamps - time.time() + time.monotonic()
+        return self._last_touch
+
+    def maybe_evict(self) -> int:
+        """Evict the coldest eligible active tenants down to
+        ``resident_cap``; returns tenants evicted. Called automatically
+        after each update when ``auto=True``."""
+        with self._lock():
+            active = np.nonzero(self._touched)[0]
+            resident = [int(t) for t in active if int(t) not in self._spilled]
+            excess = len(resident) - self.resident_cap
+            if excess <= 0:
+                return 0
+            stamps = self._stamps()
+            now = time.monotonic()
+            eligible = [
+                t for t in resident if now - stamps[t] >= self.min_idle_s
+            ]
+            if not eligible:
+                return 0
+            eligible.sort(key=lambda t: stamps[t])
+            victims = eligible[: min(excess, len(eligible))]
+            if victims:
+                self._evict_ids(victims)
+            return len(victims)
+
+    def evict(self, tenant_ids: Optional[Any] = None) -> int:
+        """Evict ``tenant_ids`` (or run one :meth:`maybe_evict` pass);
+        already-spilled / never-active ids are skipped. Returns tenants
+        evicted."""
+        if tenant_ids is None:
+            return self.maybe_evict()
+        with self._lock():
+            ids = [
+                int(t)
+                for t in np.asarray(tenant_ids).reshape(-1)
+                if 0 <= int(t) < len(self._touched)
+                and self._touched[int(t)]
+                and int(t) not in self._spilled
+            ]
+            if ids:
+                self._evict_ids(ids)
+            return len(ids)
+
+    def fault_back(self, tenant_ids: Optional[Any] = None) -> int:
+        """Fault spilled tenants back to the device (all of them by
+        default). Returns tenants restored."""
+        with self._lock():
+            if tenant_ids is None:
+                ids = list(self._spilled)
+            else:
+                ids = [
+                    int(t)
+                    for t in np.asarray(tenant_ids).reshape(-1)
+                    if int(t) in self._spilled
+                ]
+            if ids:
+                self._fault_back_ids(ids)
+            return len(ids)
+
+    def occupancy(self) -> Dict[str, int]:
+        """Point-in-time occupancy (the durability snapshot's gauge feed)."""
+        active = int(self._touched.sum())
+        spilled = len(self._spilled)
+        return {
+            "active": active,
+            "spilled": spilled,
+            "resident_active": active - spilled,
+            "spilled_bytes": int(self._spilled_bytes),
+        }
+
+    def report(self) -> Dict[str, Any]:
+        """Occupancy + the conservation check:
+        ``resident_active + spilled == active`` exactly."""
+        occ = self.occupancy()
+        return {
+            **occ,
+            "resident_cap": self.resident_cap,
+            "min_idle_s": self.min_idle_s,
+            "auto": self.auto,
+            "conservation_ok": occ["resident_active"] + occ["spilled"] == occ["active"],
+            "resident_under_cap": occ["resident_active"] <= self.resident_cap,
+        }
+
+    def detach(self) -> None:
+        """Fault everything back and uninstall the hooks (the metric
+        reverts to plain always-resident behavior)."""
+        self.fault_back()
+        if self._metric.__dict__.get("_durability_hooks") is self:
+            del self._metric.__dict__["_durability_hooks"]
+
+    def __repr__(self) -> str:
+        occ = self.occupancy()
+        return (
+            f"TenantSpiller({type(self._metric).__name__},"
+            f" resident_cap={self.resident_cap}, spilled={occ['spilled']})"
+        )

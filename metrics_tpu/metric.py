@@ -1451,6 +1451,18 @@ class Metric(ABC):
     def clone(self) -> "Metric":
         return deepcopy(self)
 
+    def _restore_derived(self, state: StateDict) -> None:
+        """Refresh update-derived Python attributes from a restored state.
+
+        Some metrics learn configuration from their first batch (e.g.
+        ``Accuracy.mode``) and keep it as a plain attribute alongside a
+        synced bookkeeping state. A clone/pickle carries the attribute, but
+        a checkpoint restored into a FRESH instance does not — the
+        durability plane calls this hook after installing restored states
+        so such metrics can decode their derived attributes eagerly
+        (``state`` holds the restored leaves, possibly tenant-stacked:
+        decode with reductions over the leading axes). Default: no-op."""
+
     def keyed(self, num_tenants: int, **kwargs: Any) -> "Metric":
         """An N-tenant stacked view of this metric: one
         :class:`~metrics_tpu.wrappers.multitenant.KeyedMetric` holding the

@@ -115,8 +115,8 @@ def disable() -> None:
 def reset() -> None:
     """Clear all recorded counters, timers, sync stats, retrace ledgers,
     events, histograms, collective spans, async-sync engine counters,
-    serving-plane counters, and health records (enablement, policy, step
-    tag survive). Span-id sequence counters and async generations reset
+    serving-plane counters, durability-plane counters, and health records
+    (enablement, policy, step tag survive). Span-id sequence counters and async generations reset
     too — like any collective, reset on every process together or on
     none."""
     import sys as _sys
@@ -134,6 +134,9 @@ def reset() -> None:
     serving_mod = _sys.modules.get("metrics_tpu.serving.telemetry")
     if serving_mod is not None:
         serving_mod.SERVING_STATS.reset()
+    durability_mod = _sys.modules.get("metrics_tpu.durability.telemetry")
+    if durability_mod is not None:
+        durability_mod.DURABILITY_STATS.reset()
 
 
 __all__ = [

@@ -93,6 +93,11 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     ("serving.*", "sum"),
     # Pallas kernel suite: dispatch-decision counters sum across processes
     ("kernels.*", "sum"),
+    # durability plane: checkpoint/spill/elastic counters sum; spill
+    # occupancy gauges sum across processes (fleet-resident/spilled
+    # totals), the high-water mark maxes
+    ("durability.spilled_high_water", "max"),
+    ("durability.*", "sum"),
     # fast-path histograms (percentiles recomputed after the bucket merge)
     ("histograms.*.buckets.*", "sum"),
     ("histograms.*.count", "sum"),

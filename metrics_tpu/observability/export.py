@@ -96,6 +96,26 @@ _HELP: Dict[str, str] = {
     "serving_queue_depth": "Rows resident at flush time (log2 count histogram).",
     "serving_tenant_cache_hits_total": "Reads served from cache by per-tenant generation freshness (global generation moved, requested tenants untouched).",
     "kernel_dispatch_total": "Pallas-vs-XLA auto-dispatch decisions per kernel op.",
+    "durability_saves_total": "Checkpoint snapshots written (full + delta).",
+    "durability_delta_saves_total": "Delta checkpoints (only dirty tenants stamped).",
+    "durability_save_errors_total": "Snapshot writes that failed (crash/IO) before completing.",
+    "durability_restores_total": "Checkpoint chains restored.",
+    "durability_restore_errors_total": "Restores that found no complete snapshot.",
+    "durability_bytes_written_total": "Checkpoint payload bytes written (post-encoding).",
+    "durability_bytes_read_total": "Checkpoint payload bytes read at restore.",
+    "durability_tenants_stamped_total": "Tenant rows written by delta checkpoints (the O(k) evidence).",
+    "durability_evictions_total": "Tenants spilled to host memory (cold-tenant eviction).",
+    "durability_fault_backs_total": "Spilled tenants faulted back to the device.",
+    "durability_grows_total": "Elastic tenant-axis grows (pow2-padded capacity).",
+    "durability_compactions_total": "Elastic tenant-axis compactions.",
+    "durability_spillers": "Live tenant spillers in the durability plane.",
+    "durability_spilled_tenants": "Tenants currently spilled to host memory.",
+    "durability_resident_tenants": "Active tenants currently device-resident.",
+    "durability_spilled_bytes": "Host bytes held by spilled tenant rows.",
+    "durability_spilled_high_water": "Peak spilled-tenant count observed.",
+    "durability_save_seconds": "One checkpoint snapshot write's wall time.",
+    "durability_restore_seconds": "One checkpoint chain restore's wall time.",
+    "durability_faultback_seconds": "One spill fault-back cohort's wall time.",
 }
 
 
@@ -166,6 +186,10 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
     # counters: {} until the kernels package is imported
     kernels_mod = _sys.modules.get("metrics_tpu.kernels._common")
     snap["kernels"] = kernels_mod.dispatch_summary() if kernels_mod is not None else {}
+    # and for the durability plane (checkpoint/spill/elastic ledger): {}
+    # until metrics_tpu.durability is imported AND touched
+    durability_mod = _sys.modules.get("metrics_tpu.durability.telemetry")
+    snap["durability"] = durability_mod.summary() if durability_mod is not None else {}
     return snap
 
 
@@ -378,6 +402,37 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
                 n,
                 "counter",
             )
+
+    durability = snap.get("durability", {})
+    if durability:
+        # the durability plane's family: checkpoint/spill/elastic outcomes
+        # are counters, spill occupancy gauges (the save/restore/fault-back
+        # latency histograms ride the regular histograms section)
+        for field in (
+            "saves",
+            "delta_saves",
+            "save_errors",
+            "restores",
+            "restore_errors",
+            "bytes_written",
+            "bytes_read",
+            "tenants_stamped",
+            "evictions",
+            "fault_backs",
+            "grows",
+            "compactions",
+        ):
+            if field in durability:
+                out.emit(f"durability_{field}_total", base, durability[field], "counter")
+        for gauge in (
+            "spillers",
+            "spilled_tenants",
+            "resident_tenants",
+            "spilled_bytes",
+            "spilled_high_water",
+        ):
+            if gauge in durability:
+                out.emit(f"durability_{gauge}", base, durability[gauge])
 
     kernels = snap.get("kernels", {})
     for op, paths in sorted(kernels.get("dispatch", {}).items()):

@@ -106,6 +106,12 @@ class SLOScheduler:
         #: tenants present; an absent tenant has never been written, i.e.
         #: generation 0) — the per-tenant cache-invalidation ledger
         self._tenant_gen: Dict[int, int] = {}
+        #: the metric's tenant count the ledger was last pruned against —
+        #: an elastic shrink/compaction changes it, and entries for tenants
+        #: that no longer exist must not leak in a weeks-long service
+        self._pruned_for_tenants: Optional[int] = getattr(
+            metric, "num_tenants", None
+        )
         #: {"generation", "values", "at"} — the hot per-tenant result cache
         self._cache: Optional[Dict[str, Any]] = None
         self._refresh_future: Optional[Any] = None
@@ -129,6 +135,41 @@ class SLOScheduler:
             for t in touched:
                 self._tenant_gen[int(t)] = self._generation
         SERVING_STATS.inc("generation_bumps")
+        self.prune_tenant_generations()
+
+    def prune_tenant_generations(self) -> int:
+        """Drop ledger entries for tenants past the metric's CURRENT tenant
+        count; returns entries dropped.
+
+        The per-tenant generation map only ever gained entries — after an
+        elastic shrink/compaction (``KeyedMetric.compact``) the dropped
+        tenants' entries would sit there forever, a slow leak in a
+        weeks-long service, and a stale entry could even mark a FUTURE
+        tenant reusing the id as already-written. Runs opportunistically
+        after every dispatched flush, but only does work when the metric's
+        tenant count actually changed since the last prune (O(1) steady
+        state, O(ledger) once per resize)."""
+        n = getattr(self._metric, "num_tenants", None)
+        if n is None:
+            return 0
+        with self._lock:
+            if n == self._pruned_for_tenants:
+                return 0
+            stale = [t for t in self._tenant_gen if t >= n]
+            for t in stale:
+                del self._tenant_gen[t]
+            self._pruned_for_tenants = n
+        if stale and TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, "tenant_generations_pruned", len(stale))
+        return len(stale)
+
+    def tenant_generations(self) -> Dict[int, int]:
+        """One consistent copy of the per-tenant write-generation ledger —
+        the durability plane's preferred delta-checkpoint dirty-set source
+        (``CheckpointManager``)."""
+        self.prune_tenant_generations()
+        with self._lock:
+            return dict(self._tenant_gen)
 
     def submit(self, tenant_id: int, *args: Any) -> bool:
         """Admit one event row (see :meth:`AdmissionQueue.submit`)."""

@@ -101,6 +101,17 @@ class Transport:
         handle."""
         return None
 
+    # -- placement (the durability plane's restore seam) -------------------
+
+    def place_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Place a restored (host-assembled) state dict for THIS
+        transport's topology. The base transports hold state replicated, so
+        the default is the identity; :class:`ShardedTransport` overrides it
+        to shard each leaf's leading axis across its mesh — which is what
+        makes a checkpoint saved replicated restorable device-sharded (and
+        vice versa) without the snapshot knowing either topology."""
+        return state
+
     # -- capability / topology --------------------------------------------
 
     @property

@@ -113,6 +113,12 @@ class ShardedTransport(Transport):
         metric._set_states(self.shard_state(metric._get_states()))
         return metric
 
+    def place_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Restore-time placement (``Transport.place_state``): shard every
+        leaf's leading axis over the mesh — a replicated-saved checkpoint
+        restores device-sharded without the snapshot knowing the topology."""
+        return self.shard_state(state)
+
     # -- eager sync: in-place sharded reduction ----------------------------
 
     def reduce_states(

@@ -508,11 +508,31 @@ class AsyncSyncEngine:
 #: thread-free for the zero-overhead discipline)
 _ENGINE: Optional[AsyncSyncEngine] = None
 _ENGINE_LOCK = threading.Lock()
+#: named auxiliary engines (lanes): work that must not queue behind the
+#: default lane's FIFO — e.g. the durability plane's checkpoint writes,
+#: which can take seconds and would otherwise stall every serving-read
+#: refresh submitted after them — runs on its own single-worker engine
+_NAMED_ENGINES: Dict[str, AsyncSyncEngine] = {}
 
 
-def get_engine() -> AsyncSyncEngine:
-    """The process-global background sync engine (created on first use)."""
+def get_engine(name: str = "default") -> AsyncSyncEngine:
+    """The process-global background sync engine (created on first use).
+
+    ``name`` selects an engine LANE: ``"default"`` is the engine
+    ``compute_async`` and the serving scheduler share (its FIFO is the
+    collective-discipline guarantee); any other name returns a dedicated
+    single-worker engine created on first use — FIFO within the lane,
+    independent of the default lane. Named lanes are for host-only work
+    (disk writes, serialization); jobs that issue multi-process
+    collectives belong on the default lane, where submission order is the
+    cross-process contract."""
     global _ENGINE
+    if name != "default":
+        with _ENGINE_LOCK:
+            engine = _NAMED_ENGINES.get(name)
+            if engine is None:
+                engine = _NAMED_ENGINES[name] = AsyncSyncEngine()
+            return engine
     if _ENGINE is None:
         with _ENGINE_LOCK:
             if _ENGINE is None:

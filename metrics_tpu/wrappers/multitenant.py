@@ -83,6 +83,14 @@ __all__ = ["KeyedMetric", "MultiTenantCollection"]
 _SEGMENT_REDUCTIONS = ("sum", "max", "min")
 
 
+def _pow2_at_least(n: int) -> int:
+    """The smallest power of two >= ``n`` (>= 1) — the padded-capacity
+    discipline: every elastic resize lands on a pow2 physical capacity, so
+    the aval-keyed executable cache holds at most ``log2(max N) + 1``
+    distinct keyed programs over a metric's whole elastic lifetime."""
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
 def _keyed_gate(metric: Metric, what: str = "base_metric") -> None:
     """Raise a descriptive ``ValueError`` when ``metric`` cannot be keyed.
 
@@ -190,6 +198,30 @@ class _TenantTraffic:
                 self.last_seen = np.full(self.n, np.nan)
             self.rows += counts
             self.last_seen[touched] = stamp
+
+    def resize(self, new_n: int) -> None:
+        """Resize the ledger to ``new_n`` tenants, keeping the overlapping
+        prefix's counts/stamps (the elastic grow/compact path); tenants at or
+        past ``new_n`` are dropped exactly as compaction drops their rows."""
+        new_n = int(new_n)
+        with self._lock:
+            old_rows, old_seen, keep = self.rows, self.last_seen, min(self.n, new_n)
+            self.n = new_n
+            if old_rows is None:
+                return
+            self.rows = np.zeros(new_n, dtype=np.int64)
+            self.last_seen = np.full(new_n, np.nan)
+            self.rows[:keep] = old_rows[:keep]
+            self.last_seen[:keep] = old_seen[:keep]
+
+    def arrays(self) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """One consistent ``(rows, last_seen)`` copy (``(None, None)`` when
+        nothing was recorded) — the dirty-set / staleness feed the durability
+        plane (checkpoint deltas, the cold-tenant spiller) reads."""
+        with self._lock:
+            if self.rows is None:
+                return None, None
+            return self.rows.copy(), self.last_seen.copy()
 
     def clear(self, ids: Optional[Any] = None) -> None:
         with self._lock:
@@ -323,6 +355,14 @@ class KeyedMetric(Metric):
         compute_on_step: default ``False`` — per-step per-tenant values are
             rarely wanted and cost a full compute fan-out; ``True`` restores
             the usual ``forward`` contract (batch-local per-tenant values).
+        capacity: physical tenant-axis size of the stacked leaves (default:
+            exactly ``num_tenants`` — byte-identical to the pre-elastic
+            programs). Rows in ``[num_tenants, capacity)`` are padding:
+            never routable (ids validate against ``num_tenants``), sliced
+            off every compute fan-out. The elastic API (:meth:`grow` /
+            :meth:`compact`) keeps capacity a power of two so the aval-keyed
+            executable cache holds at most ``log2(max N) + 1`` keyed
+            programs over a metric's whole elastic lifetime.
 
     Example::
 
@@ -348,6 +388,7 @@ class KeyedMetric(Metric):
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
     ) -> None:
         super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
         _keyed_gate(base_metric)
@@ -355,11 +396,16 @@ class KeyedMetric(Metric):
             raise ValueError(f"num_tenants must be >= 1, got {num_tenants}")
         self._child = base_metric.clone()
         self.num_tenants = int(num_tenants)
+        self._capacity = int(capacity) if capacity is not None else self.num_tenants
+        if self._capacity < self.num_tenants:
+            raise ValueError(
+                f"capacity ({self._capacity}) must be >= num_tenants ({num_tenants})"
+            )
         self.validate_ids = bool(validate_ids)
         self._jit_forward_donate = bool(donate)
         self.tenant_sharding = tenant_sharding
         stacked_defaults = broadcast_stack(
-            {k: v for k, v in self._child._defaults.items()}, self.num_tenants
+            {k: v for k, v in self._child._defaults.items()}, self._capacity
         )
         for name, stacked in stacked_defaults.items():
             if tenant_sharding is not None:
@@ -449,7 +495,7 @@ class KeyedMetric(Metric):
                 return False
             rows_n = leaf.shape[0]
             width += int(np.prod(leaf.shape[1:], dtype=np.int64)) if leaf.ndim > 1 else 1
-        return segment_scatter_pallas_ok(rows_n, self.num_tenants, width)
+        return segment_scatter_pallas_ok(rows_n, self._capacity, width)
 
     def _fused_segment_scatter(
         self, state: StateDict, ids: Array, per_row: StateDict
@@ -461,7 +507,7 @@ class KeyedMetric(Metric):
         from metrics_tpu.kernels.segment_scatter import segment_scatter_add
 
         child = self._child
-        n = self.num_tenants
+        n = self._capacity
         layout, columns = [], []
         for name in child._reductions:
             default = jnp.asarray(child._defaults[name])
@@ -494,8 +540,16 @@ class KeyedMetric(Metric):
         to the pre-kernel program.
         """
         child = self._child
-        n = self.num_tenants
+        n = self._capacity
         ids = jnp.asarray(tenant_ids)
+        # the compiled program's id clip is the PHYSICAL capacity: a padded
+        # metric's program carries no trace of the logical tenant count, so
+        # logical grows inside one pow2 capacity never retrace (the log2
+        # recompile bound). The logical bound stays host-side — the eager
+        # validate_ids raise; with validate_ids=False an id in the padding
+        # band [num_tenants, capacity) lands in a padding row, which every
+        # compute slices off and every resize resets. At capacity ==
+        # num_tenants this is the pre-elastic program, byte for byte.
         valid = (ids >= 0) & (ids < n)
         safe = jnp.where(valid, ids, n)
         per_row = row_states(child, args, kwargs)
@@ -588,7 +642,14 @@ class KeyedMetric(Metric):
         ids = self._canonical_ids(tenant_ids)
         if self.validate_ids:
             self._validate_ids_eager(ids)
+        hooks = self.__dict__.get("_durability_hooks")
         with self._serial_lock():
+            if hooks is not None:
+                # spilled tenants named in this batch fault back BEFORE the
+                # dispatch reads the stacked state (exact for every routable
+                # reduction); runs under the serial lock so no other ingest
+                # thread can interleave a dispatch mid-fault-back
+                hooks.before_update(np.asarray(ids))
             state = self._get_states()
             donatable = True
             if self._jit_forward_donate:
@@ -597,6 +658,8 @@ class KeyedMetric(Metric):
             start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
             new_state, _ = fn(state, ids, *args, **kwargs)
             self._set_states(new_state)
+            if hooks is not None:
+                hooks.after_update(np.asarray(ids))
         if start is not None:
             dur = time.perf_counter() - start
             key = self.telemetry_key
@@ -630,8 +693,13 @@ class KeyedMetric(Metric):
             self._validate_ids_eager(ids.reshape(-1))
         if TELEMETRY.enabled:
             self._note_tenant_traffic(ids)
+        hooks = self.__dict__.get("_durability_hooks")
         with self._serial_lock():
+            if hooks is not None:
+                hooks.before_update(np.asarray(ids).reshape(-1))
             super().update_many(ids, *stacked, **stacked_kwargs)
+            if hooks is not None:
+                hooks.after_update(np.asarray(ids).reshape(-1))
 
     def warmup(self, tenant_ids: Any, *sample_batch: Any, **kwargs: Any) -> Dict[str, Any]:
         """AOT lower+compile the keyed update executable for this batch shape
@@ -678,11 +746,26 @@ class KeyedMetric(Metric):
     # compute fan-out + rollups
     # ------------------------------------------------------------------
 
+    def _visible_state(self, state: StateDict) -> StateDict:
+        """The logical-tenant view of a stacked state: the ``[:num_tenants]``
+        prefix when the physical capacity carries padding rows, the state
+        itself (no traced ops added) otherwise."""
+        if self._capacity == self.num_tenants:
+            return state
+        return {k: v[: self.num_tenants] for k, v in state.items()}
+
     def compute(self) -> Any:
         """Per-tenant values: the child's compute fanned out over the tenant
         axis of the (synced) stacked state. Tenants that never received a row
-        compute on the default state — typically NaN for ratio metrics."""
-        return vmap_compute(self._child, axis_name=None)(self._get_states())
+        compute on the default state — typically NaN for ratio metrics.
+        Padding rows past ``num_tenants`` are sliced off; spilled tenants
+        fault back first (see :mod:`metrics_tpu.durability.spill`)."""
+        hooks = self.__dict__.get("_durability_hooks")
+        if hooks is not None:
+            hooks.before_read()
+        return vmap_compute(self._child, axis_name=None)(
+            self._visible_state(self._get_states())
+        )
 
     def _scalar_values(self, key: Optional[str] = None) -> Array:
         vals = self.compute()
@@ -744,6 +827,118 @@ class KeyedMetric(Metric):
         return report
 
     # ------------------------------------------------------------------
+    # elastic tenant capacity (durability plane, ROADMAP item 4)
+    # ------------------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Physical tenant-axis size of the stacked leaves (>=
+        ``num_tenants``; the surplus is padding rows no id can route to)."""
+        return self._capacity
+
+    def _resize(self, num_tenants: int, new_capacity: int) -> None:
+        """Re-stack every leaf to ``new_capacity`` rows (logical size
+        ``num_tenants``), keeping the overlapping tenant prefix's
+        accumulation and re-applying the tenant sharding. Spilled tenants
+        fault back first so no accumulation is stranded on the host;
+        executables are dropped only when the physical capacity changed (the
+        aval is part of every dispatch-cache key)."""
+        num_tenants, new_capacity = int(num_tenants), int(new_capacity)
+        if num_tenants < 1:
+            raise ValueError(f"num_tenants must be >= 1, got {num_tenants}")
+        if new_capacity < num_tenants:
+            raise ValueError(
+                f"capacity ({new_capacity}) must be >= num_tenants ({num_tenants})"
+            )
+        hooks = self.__dict__.get("_durability_hooks")
+        with self._serial_lock():
+            if hooks is not None:
+                hooks.before_snapshot()
+            old_capacity = self._capacity
+            keep = min(self.num_tenants, num_tenants)
+            if new_capacity != old_capacity:
+                new_defaults = broadcast_stack(
+                    {k: v for k, v in self._child._defaults.items()}, new_capacity
+                )
+                new_state: StateDict = {}
+                for name, stacked_default in new_defaults.items():
+                    old = getattr(self, name)
+                    leaf = jnp.asarray(stacked_default).at[:keep].set(old[:keep])
+                    if self.tenant_sharding is not None:
+                        leaf = jax.device_put(leaf, self.tenant_sharding)
+                    new_state[name] = leaf
+                    self._defaults[name] = (
+                        jax.device_put(stacked_default, self.tenant_sharding)
+                        if self.tenant_sharding is not None
+                        else stacked_default
+                    )
+                self._set_states(new_state)
+                # the aval carries the capacity, so stale executables could
+                # never serve the new layout — drop them explicitly anyway
+                # (the defaults the donation audit aliases against changed)
+                self._drop_compiled_dispatch()
+            else:
+                # same physical capacity: reset the rows leaving (shrink) or
+                # entering (grow) the logical band — either way they must be
+                # pristine defaults, not leftover padding-band accumulation
+                lo, hi = keep, max(self.num_tenants, num_tenants)
+                if hi > lo:
+                    band = jnp.arange(lo, hi)
+                    new_state = {}
+                    for name, default in self._child._defaults.items():
+                        new_state[name] = getattr(self, name).at[band].set(
+                            jnp.asarray(default)
+                        )
+                    self._set_states(new_state)
+            self.num_tenants = num_tenants
+            self._capacity = new_capacity
+            self._traffic.resize(num_tenants)
+            self._computed = None
+            self._forward_cache = None
+            if hooks is not None:
+                hooks.on_resize(num_tenants)
+
+    def grow(self, num_tenants: int) -> int:
+        """Grow the logical tenant axis to ``num_tenants`` (monotone; a
+        smaller value is a no-op), keeping every existing tenant's
+        accumulation. The physical capacity pads to the next power of two —
+        doubling, never incrementing — so an elastic service recompiles its
+        keyed programs at most ``log2(max N) + 1`` times, ever. Returns the
+        new physical capacity."""
+        target = int(num_tenants)
+        if target <= self.num_tenants:
+            return self._capacity
+        new_capacity = max(self._capacity, _pow2_at_least(target))
+        self._resize(target, new_capacity)
+        from metrics_tpu.durability.telemetry import note_resize
+
+        note_resize(self.telemetry_key, "grow", target, new_capacity)
+        return self._capacity
+
+    def compact(self, num_tenants: Optional[int] = None) -> int:
+        """Shrink the tenant axis to ``num_tenants`` (default: the highest
+        tenant that ever received a row, +1), dropping the tail tenants'
+        accumulation and compacting the physical capacity back to the
+        smallest power of two that holds the survivors. Returns the new
+        physical capacity."""
+        if num_tenants is None:
+            rows, _ = self._traffic.arrays()
+            active = np.nonzero(rows)[0] if rows is not None else np.array([], np.int64)
+            num_tenants = int(active[-1]) + 1 if active.size else 1
+        target = int(num_tenants)
+        if target > self.num_tenants:
+            raise ValueError(
+                f"compact target ({target}) exceeds the current tenant count"
+                f" ({self.num_tenants}); use grow() to add tenants"
+            )
+        new_capacity = _pow2_at_least(target)
+        self._resize(target, new_capacity)
+        from metrics_tpu.durability.telemetry import note_resize
+
+        note_resize(self.telemetry_key, "compact", target, new_capacity)
+        return self._capacity
+
+    # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
 
@@ -769,8 +964,15 @@ class KeyedMetric(Metric):
             TELEMETRY.inc(self.telemetry_key, "reset_calls")
 
     def __getstate__(self) -> dict:
+        # a snapshot (clone / pickle / checkpoint) must see every spilled
+        # tenant's rows resident — fault back first, then drop the
+        # process-local machinery (the spiller stays with the live instance)
+        hooks = self.__dict__.get("_durability_hooks")
+        if hooks is not None:
+            hooks.before_snapshot()
         state = super().__getstate__()
-        for k in ("_keyed_update_fn", "_keyed_update_copy_fn", "_ingest_lock"):
+        for k in ("_keyed_update_fn", "_keyed_update_copy_fn", "_ingest_lock",
+                  "_durability_hooks"):
             state.pop(k, None)
         return state
 
@@ -808,6 +1010,7 @@ class MultiTenantCollection:
         compute_groups: bool = True,
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
+        capacity: Optional[int] = None,
     ) -> None:
         if isinstance(metrics, MetricCollection):
             self._collection = metrics.clone(prefix=prefix, postfix=postfix)
@@ -820,6 +1023,11 @@ class MultiTenantCollection:
         if int(num_tenants) < 1:
             raise ValueError(f"num_tenants must be >= 1, got {num_tenants}")
         self.num_tenants = int(num_tenants)
+        self._capacity = int(capacity) if capacity is not None else self.num_tenants
+        if self._capacity < self.num_tenants:
+            raise ValueError(
+                f"capacity ({self._capacity}) must be >= num_tenants ({num_tenants})"
+            )
         self.validate_ids = bool(validate_ids)
         self._donate = bool(donate)
         self.tenant_sharding = tenant_sharding
@@ -880,6 +1088,7 @@ class MultiTenantCollection:
                 validate_ids=False,  # the collection validates once, up front
                 donate=self._donate,
                 tenant_sharding=self.tenant_sharding,
+                capacity=self._capacity,
             )
         groups = {o: list(ns) for o, ns in self._layout if len(ns) > 1}
         if TELEMETRY.enabled:
@@ -973,7 +1182,7 @@ class MultiTenantCollection:
         for owner, names in self._layout:
             keyed = self._require_built()[owner]
             axis = keyed.process_group if axis_name is AXIS_UNSET else axis_name
-            synced = keyed.sync_state(state[owner], axis)
+            synced = keyed._visible_state(keyed.sync_state(state[owner], axis))
             for n in names:
                 member = self._collection[n]
                 out[self._collection._set_name(n)] = vmap_compute(member, axis_name=None)(synced)
@@ -1060,7 +1269,10 @@ class MultiTenantCollection:
         ids = self._canonical_ids(tenant_ids)
         if self.validate_ids:
             next(iter(self._keyed.values()))._validate_ids_eager(ids)
+        hooks = self.__dict__.get("_durability_hooks")
         with self._serial_lock():
+            if hooks is not None:
+                hooks.before_update(np.asarray(ids))
             state = self._collect_state()
             donatable = True
             if self._donate:
@@ -1069,6 +1281,8 @@ class MultiTenantCollection:
             start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
             new_state, _ = fn(state, ids, *args, **kwargs)
             self._writeback(new_state)
+            if hooks is not None:
+                hooks.after_update(np.asarray(ids))
         if start is not None:
             dur = time.perf_counter() - start
             key = self.telemetry_key
@@ -1131,7 +1345,10 @@ class MultiTenantCollection:
         k = _microbatch_len((ids,) + stacked, stacked_kwargs)
         if self.validate_ids:
             next(iter(self._keyed.values()))._validate_ids_eager(ids.reshape(-1))
+        hooks = self.__dict__.get("_durability_hooks")
         with self._serial_lock():
+            if hooks is not None:
+                hooks.before_update(np.asarray(ids).reshape(-1))
             state = self._collect_state()
             donatable = True
             if self._donate:
@@ -1150,6 +1367,8 @@ class MultiTenantCollection:
                 fn = self._update_many_copy_fn
             new_state = fn(state, (ids,) + stacked, stacked_kwargs)
             self._writeback(new_state)
+            if hooks is not None:
+                hooks.after_update(np.asarray(ids).reshape(-1))
         if TELEMETRY.enabled:
             key = self.telemetry_key
             TELEMETRY.inc(key, "update_many_calls")
@@ -1214,12 +1433,15 @@ class MultiTenantCollection:
         syncs once (eager cross-process gather of the stacked leaves) and
         fans out to every member's own compute, vmapped over the tenant
         axis."""
+        hooks = self.__dict__.get("_durability_hooks")
+        if hooks is not None:
+            hooks.before_read()
         out: Dict[str, Any] = {}
         keyed = self._require_built()
         for owner, names in self._layout:
             km = keyed[owner]
             with km.sync_context(dist_sync_fn=km.dist_sync_fn):
-                state = km._get_states()
+                state = km._visible_state(km._get_states())
                 for n in names:
                     member = self._collection[n]
                     out[self._collection._set_name(n)] = vmap_compute(
@@ -1245,8 +1467,11 @@ class MultiTenantCollection:
         owner = next(o for o, ns in self._layout if metric in ns)
         km = keyed[owner]
         member = self._collection[metric]
+        hooks = self.__dict__.get("_durability_hooks")
+        if hooks is not None:
+            hooks.before_read()
         with km.sync_context(dist_sync_fn=km.dist_sync_fn):
-            vals = vmap_compute(member, axis_name=None)(km._get_states())
+            vals = vmap_compute(member, axis_name=None)(km._visible_state(km._get_states()))
         if isinstance(vals, dict):
             if key is None:
                 raise ValueError(
@@ -1293,6 +1518,58 @@ class MultiTenantCollection:
             km.reset(tenant_ids)
         self._traffic.clear(None if tenant_ids is None else np.asarray(tenant_ids))
 
+    # ------------------------------------------------------------------
+    # elastic tenant capacity (durability plane)
+    # ------------------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Physical tenant-axis size shared by every state bundle."""
+        return self._capacity
+
+    def grow(self, num_tenants: int) -> int:
+        """Grow every bundle's logical tenant axis to ``num_tenants`` (see
+        :meth:`KeyedMetric.grow` — pow2 padded capacity, accumulation kept).
+        Returns the new physical capacity."""
+        target = int(num_tenants)
+        if target <= self.num_tenants:
+            return self._capacity
+        with self._serial_lock():
+            for km in (self._keyed or {}).values():
+                km.grow(target)
+            self.num_tenants = target
+            self._capacity = max(self._capacity, _pow2_at_least(target))
+            self._traffic.resize(target)
+            hooks = self.__dict__.get("_durability_hooks")
+            if hooks is not None:
+                hooks.on_resize(target)
+        return self._capacity
+
+    def compact(self, num_tenants: Optional[int] = None) -> int:
+        """Compact every bundle's tenant axis (see
+        :meth:`KeyedMetric.compact`); default target is the highest tenant
+        that ever received a row, +1. Returns the new physical capacity."""
+        if num_tenants is None:
+            rows, _ = self._traffic.arrays()
+            active = np.nonzero(rows)[0] if rows is not None else np.array([], np.int64)
+            num_tenants = int(active[-1]) + 1 if active.size else 1
+        target = int(num_tenants)
+        if target > self.num_tenants:
+            raise ValueError(
+                f"compact target ({target}) exceeds the current tenant count"
+                f" ({self.num_tenants}); use grow() to add tenants"
+            )
+        with self._serial_lock():
+            for km in (self._keyed or {}).values():
+                km.compact(target)
+            self.num_tenants = target
+            self._capacity = _pow2_at_least(target)
+            self._traffic.resize(target)
+            hooks = self.__dict__.get("_durability_hooks")
+            if hooks is not None:
+                hooks.on_resize(target)
+        return self._capacity
+
     def tenant_report(self, top_k: int = 10) -> Dict[str, Any]:
         """Per-tenant drill-down for the whole collection (one ledger — every
         member sees the same routed rows): occupancy, top-``top_k``
@@ -1322,6 +1599,9 @@ class MultiTenantCollection:
         return len(self._collection)
 
     def __getstate__(self) -> dict:
+        hooks = self.__dict__.get("_durability_hooks")
+        if hooks is not None:
+            hooks.before_snapshot()
         return {
             k: v
             for k, v in self.__dict__.items()
@@ -1335,6 +1615,7 @@ class MultiTenantCollection:
                 "_jit_cache_seen",
                 "_donation_warned",
                 "_ingest_lock",
+                "_durability_hooks",
             )
         }
 

@@ -2053,6 +2053,190 @@ def bench_serving_soak():
 bench_serving_soak._force_cpu = True
 
 
+# ------------------------------------------------ durability plane
+#: checkpoint/spill bench shape knobs (env-overridable so CI smoke stays
+#: short; the official capture runs the defaults)
+CKPT_TENANTS = int(os.environ.get("METRICS_TPU_BENCH_CKPT_TENANTS", "4096"))
+CKPT_TOUCH = int(os.environ.get("METRICS_TPU_BENCH_CKPT_TOUCH", "64"))
+CKPT_ROUNDS = int(os.environ.get("METRICS_TPU_BENCH_CKPT_ROUNDS", "5"))
+#: per-tenant state width: a keyed (C, C) confusion grid — 4·C² bytes per
+#: tenant, the realistic service-state shape where the full-snapshot
+#: transfer dominates and the O(k) delta pays off
+CKPT_CLASSES = int(os.environ.get("METRICS_TPU_BENCH_CKPT_CLASSES", "16"))
+SPILL_TENANTS = int(os.environ.get("METRICS_TPU_BENCH_SPILL_TENANTS", "2048"))
+SPILL_COHORT = int(os.environ.get("METRICS_TPU_BENCH_SPILL_COHORT", "64"))
+
+
+def bench_checkpoint_save():
+    """Incremental checkpointing (durability plane): one DELTA snapshot —
+    k touched tenants of N — against the FULL-snapshot baseline.
+    ``value`` is the delta save's wall time, ``vs_baseline`` the full/delta
+    ratio (>1 = the dirty-set stamping pays off), and the record carries the
+    O(k) evidence straight from the manifests (payload bytes, tenants
+    stamped) plus the async-save overlap fraction (updates continuing while
+    the snapshot writes)."""
+    import shutil
+    import tempfile
+    from statistics import median
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import ConfusionMatrix, KeyedMetric
+    from metrics_tpu.durability import CheckpointManager
+
+    n, k, rounds = CKPT_TENANTS, min(CKPT_TOUCH, CKPT_TENANTS), CKPT_ROUNDS
+    nc = CKPT_CLASSES
+    rng = np.random.RandomState(0)
+    m = KeyedMetric(ConfusionMatrix(num_classes=nc), num_tenants=n, validate_ids=False)
+
+    def batch(ids):
+        rows = len(ids)
+        logits = rng.rand(rows, nc).astype(np.float32)
+        return (
+            jnp.asarray(np.asarray(ids, np.int32)),
+            jnp.asarray(logits / logits.sum(-1, keepdims=True)),
+            jnp.asarray(rng.randint(0, nc, rows)),
+        )
+
+    m.update(*batch(rng.randint(0, n, max(2 * n, 1024))))
+    directory = tempfile.mkdtemp(prefix="bench-ckpt-")
+    try:
+        mgr = CheckpointManager(directory, m)
+        mgr.save()  # warm: first full (also the delta chain's base)
+        full_times, delta_times = [], []
+        full_manifest = delta_manifest = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            full_manifest = mgr.save(delta=False)
+            full_times.append(time.perf_counter() - t0)
+            m.update(*batch(rng.choice(n, k, replace=False)))
+            t0 = time.perf_counter()
+            delta_manifest = mgr.save()
+            delta_times.append(time.perf_counter() - t0)
+        assert delta_manifest["kind"] == "delta", delta_manifest["kind"]
+
+        # async overlap: updates keep landing while the snapshot writes
+        future = mgr.save_async()
+        busy, t0 = 0.0, time.perf_counter()
+        steps_during_flight = 0
+        while not future.done():
+            u0 = time.perf_counter()
+            m.update(*batch(rng.randint(0, n, 256)))
+            busy += time.perf_counter() - u0
+            steps_during_flight += 1
+        future.result(timeout=60.0)
+        save_wall = time.perf_counter() - t0
+        overlap = min(1.0, busy / save_wall) if save_wall > 0 else 0.0
+
+        ours = median(delta_times)
+        full_s = median(full_times)
+        extra = {
+            "tenants": n,
+            "classes": nc,
+            "touched": k,
+            "touched_fraction": round(k / n, 6),
+            "full_save_us": round(full_s * 1e6, 3),
+            "payload_full_bytes": full_manifest["payload_bytes"],
+            "payload_delta_bytes": delta_manifest["payload_bytes"],
+            "payload_ratio": round(
+                full_manifest["payload_bytes"] / max(1, delta_manifest["payload_bytes"]), 3
+            ),
+            "tenants_stamped": len(delta_manifest["tenants"]),
+            "delta_payload_o_k": bool(
+                delta_manifest["payload_bytes"]
+                <= full_manifest["payload_bytes"] * k / n + 256
+            ),
+            "overlap_fraction": round(overlap, 4),
+            "steps_during_flight": steps_during_flight,
+        }
+    finally:
+        shutil.rmtree(directory, ignore_errors=True)
+
+    def ref(torchmetrics, torch):  # the FULL snapshot is the baseline
+        return full_s
+
+    return "checkpoint_save_step", ours, ref, "us/save", extra
+
+
+#: host-side disk/serialization harness; the tunnel backend would charge a
+#: device round-trip per leaf transfer (see bench_serving_soak)
+bench_checkpoint_save._force_cpu = True
+
+
+def bench_tenant_spill():
+    """Cold-tenant spill (durability plane): fault one evicted cohort back
+    to the device. ``value`` is the amortized per-tenant fault-back time;
+    the baseline is the per-tenant EVICTION time (the reverse transfer), so
+    ``vs_baseline`` ≈ 1 means the spill round-trip is symmetric. The record
+    pins the acceptance evidence: resident held under the cap, exact
+    conservation, and fault-back reads bit-identical to a never-evicted
+    control fed identical traffic."""
+    from statistics import median
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, KeyedMetric
+    from metrics_tpu.durability import TenantSpiller
+
+    n, cohort = SPILL_TENANTS, min(SPILL_COHORT, SPILL_TENANTS // 4)
+    rng_a, rng_b = np.random.RandomState(0), np.random.RandomState(0)
+    m = KeyedMetric(Accuracy(), num_tenants=n, validate_ids=False)
+    control = KeyedMetric(Accuracy(), num_tenants=n, validate_ids=False)
+    rows = max(4 * n, 1024)
+    for metric, rng in ((m, rng_a), (control, rng_b)):
+        metric.update(
+            jnp.asarray(rng.randint(0, n, rows)),
+            jnp.asarray(rng.rand(rows).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 2, rows)),
+        )
+    sp = TenantSpiller(m, resident_cap=max(1, n // 8), auto=False)
+    sp.maybe_evict()  # hold the cap; also warms the pow2 scatter shapes
+    occupancy_after_evict = sp.report()
+
+    pick = np.random.RandomState(7)
+    evict_times, faultback_times = [], []
+    for _ in range(ROUNDS):
+        spilled = sorted(sp._spilled)
+        ids = pick.choice(spilled, cohort, replace=False)
+        t0 = time.perf_counter()
+        sp.fault_back(ids)
+        faultback_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        sp.evict(ids)
+        evict_times.append(time.perf_counter() - t0)
+
+    # bit-identity vs the never-evicted control (the acceptance pin)
+    got = np.asarray(m.compute())  # faults back everything
+    want = np.asarray(control.compute())
+    mask = ~np.isnan(want)
+    bit_identical = bool(
+        np.array_equal(got[mask], want[mask])
+        and np.array_equal(np.isnan(got), np.isnan(want))
+    )
+
+    ours = median(faultback_times) / cohort
+    evict_s = median(evict_times) / cohort
+    extra = {
+        "tenants": n,
+        "cohort": cohort,
+        "resident_cap": sp.resident_cap,
+        "evict_us_per_tenant": round(evict_s * 1e6, 3),
+        "resident_under_cap": bool(occupancy_after_evict["resident_under_cap"]),
+        "conservation_ok": bool(occupancy_after_evict["conservation_ok"]),
+        "spilled_after_evict": occupancy_after_evict["spilled"],
+        "spilled_bytes_after_evict": occupancy_after_evict["spilled_bytes"],
+        "faultback_bit_identical": bit_identical,
+    }
+
+    def ref(torchmetrics, torch):  # the reverse transfer is the baseline
+        return evict_s
+
+    return "tenant_spill_faultback", ours, ref, "us/tenant", extra
+
+
+bench_tenant_spill._force_cpu = True
+
+
 CONFIG_META = {
     "bench_accuracy": ("accuracy_update_step", "us/step"),
     "bench_collection": ("metric_collection_update_step_fused", "us/step"),
@@ -2079,6 +2263,8 @@ CONFIG_META = {
     "bench_transport_dispatch_overhead": ("transport_dispatch_overhead", "us/call"),
     "bench_sharded_state_sync": ("sharded_state_sync_step", "us/step"),
     "bench_serving_soak": ("serving_soak_step", "us/ingest-p99"),
+    "bench_checkpoint_save": ("checkpoint_save_step", "us/save"),
+    "bench_tenant_spill": ("tenant_spill_faultback", "us/tenant"),
 }
 
 #: driver order — the flagship collection config LAST (the driver's headline)
@@ -2107,6 +2293,8 @@ CONFIGS = [
     bench_transport_dispatch_overhead,
     bench_sharded_state_sync,
     bench_serving_soak,
+    bench_checkpoint_save,
+    bench_tenant_spill,
     bench_collection,
 ]
 

@@ -584,6 +584,74 @@ def kernels_off_programs() -> Dict[str, str]:
     }
 
 
+def durability_off_programs() -> Dict[str, str]:
+    """Jaxpr text of the hot programs the durability plane could touch,
+    with its machinery ACTIVE but unused — a :class:`TenantSpiller`
+    attached (hooks installed, nothing spilled) and a pow2-grown elastic
+    capacity — observability disabled (the kernels-off discipline).
+
+    Two pins, both additive (every pre-existing baseline key byte-identical
+    at the regeneration that introduced them):
+
+    * ``keyed_update_spiller_attached`` must be BYTE-IDENTICAL to the plain
+      keyed update (the spiller is host-side hooks on the stateful path;
+      the compiled program carries zero trace of it) — asserted here
+      directly, then pinned;
+    * ``keyed_update_grown_capacity`` is the elastic program (capacity 16,
+      logical 10): its id clip is the PHYSICAL capacity only, so logical
+      grows inside one pow2 never retrace — pinned so any change to the
+      elastic lowering is a conscious regeneration.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, observability
+
+    jax.config.update("jax_enable_x64", True)
+    prev_enabled = observability.TELEMETRY.enabled
+    prev_policy = observability.get_health_policy()
+    observability.set_health_policy("off")
+    observability.disable()
+    try:
+        preds = jnp.zeros((8, 3), jnp.float32)
+        target = jnp.zeros((8,), jnp.int32)
+        ids = jnp.zeros((8,), jnp.int32)
+
+        from metrics_tpu.durability import TenantSpiller
+        from metrics_tpu.wrappers import KeyedMetric
+
+        plain = KeyedMetric(Accuracy(), 16)
+        plain_text = str(
+            jax.make_jaxpr(plain.apply_update)(plain.init_state(), ids, preds, target)
+        )
+
+        spilled = KeyedMetric(Accuracy(), 16)
+        TenantSpiller(spilled, resident_cap=16, auto=False)
+        spiller_text = str(
+            jax.make_jaxpr(spilled.apply_update)(spilled.init_state(), ids, preds, target)
+        )
+        if spiller_text != plain_text:
+            raise AssertionError(
+                "keyed update jaxpr differs with a TenantSpiller attached —"
+                " the durability hooks leaked traced ops into the hot path"
+            )
+
+        grown = KeyedMetric(Accuracy(), 8)
+        grown.grow(10)  # capacity 16, logical 10
+        grown_text = str(
+            jax.make_jaxpr(grown.apply_update)(grown.init_state(), ids, preds, target)
+        )
+    finally:
+        observability.set_health_policy(prev_policy)
+        observability.TELEMETRY.enable(prev_enabled)
+        observability.EVENTS.enable(prev_enabled)
+        observability.TRACER.enable(prev_enabled)
+    return {
+        "keyed_update_spiller_attached": spiller_text,
+        "keyed_update_grown_capacity": grown_text,
+    }
+
+
 def current_jaxprs() -> Dict[str, str]:
     """Jaxpr text per pinned program in the disabled-observability state
     (which the identity check proves equals the enabled state)."""
@@ -668,6 +736,43 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                 f"{name}: jaxpr differs with the async sync engine running —"
                 " the background engine leaked traced ops into the hot path"
             )
+
+    # the DURABILITY PLANE must be host-side only: with its machinery
+    # constructed and exercised — a checkpoint saved, a spiller attached and
+    # idle, an elastic grow/compact cycle run — every hot-path jaxpr must be
+    # byte-identical to the durability-free state (the plane sits BETWEEN
+    # serving and transport, never inside a compiled program)
+    import tempfile as _tempfile
+
+    from metrics_tpu import Accuracy as _Acc
+    from metrics_tpu.durability import CheckpointManager as _CkptMgr
+    from metrics_tpu.durability import TenantSpiller as _Spiller
+    from metrics_tpu.wrappers import KeyedMetric as _Keyed
+
+    with _tempfile.TemporaryDirectory() as _d:
+        _probe = _Keyed(_Acc(), 8)
+        import jax.numpy as _jnp
+
+        _probe.update(
+            _jnp.zeros((4,), _jnp.int32),
+            _jnp.zeros((4,), _jnp.float32),
+            _jnp.zeros((4,), _jnp.int32),
+        )
+        _CkptMgr(_d, _probe).save()
+        _Spiller(_probe, resident_cap=8, auto=False)
+        _elastic = _Keyed(_Acc(), 8)
+        _elastic.grow(12)
+        _elastic.compact(8)
+        for name, thunk in programs.items():
+            if thunk() != texts[name]:
+                violations.append(
+                    f"{name}: jaxpr differs with the durability plane active —"
+                    " checkpoint/spill/elastic machinery leaked traced ops into"
+                    " the hot path"
+                )
+    # the spiller-attached keyed program must equal the plain one (asserted
+    # inside durability_off_programs; a mismatch raises there)
+    durability_off = durability_off_programs()
 
     # the TRANSPORT SEAM must be free: with the in-graph / gather strategy
     # backends explicitly installed as the process-global transport (the
@@ -860,6 +965,24 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                         " lowering). If intentional, regenerate with"
                         " `python scripts/check_zero_overhead.py --update`."
                     )
+        # the durability-off lowerings are jaxpr-text pins like the primary
+        # programs: compare only on the baseline's jax version
+        pinned_durability = baseline.get("durability_off")
+        if pinned_durability is None:
+            violations.append("durability_off missing from baseline (run --update)")
+        elif baseline.get("jax_version") == jax.__version__:
+            for name, text in durability_off.items():
+                want = pinned_durability.get(name)
+                if want is None:
+                    violations.append(f"{name}: durability-off program missing from baseline (run --update)")
+                elif want["sha256"] != _sha256(text):
+                    violations.append(
+                        f"{name}: durability-off jaxpr digest drifted from the pinned"
+                        " baseline — the durability plane altered a hot program (an"
+                        " idle spiller / the elastic capacity lowering must stay"
+                        " byte-stable). If intentional, regenerate with"
+                        " `python scripts/check_zero_overhead.py --update`."
+                    )
         # donated-lowering aliasing counts are version-independent too: pin
         # them so a layout change that sheds aliased buffers is conscious
         pinned_donation = baseline.get("donation_aliasing")
@@ -921,6 +1044,14 @@ def update_baseline(baseline_path: str = BASELINE_PATH) -> str:
         "kernels_off": {
             name: {"sha256": _sha256(text), "jaxpr": text}
             for name, text in kernels_off_programs().items()
+        },
+        # durability-plane-OFF lowerings (spiller-attached keyed update ==
+        # the plain program, byte for byte; the elastic pow2-capacity
+        # program pinned) — added additively, every pre-existing key kept
+        # byte-identical at the regeneration that introduced it
+        "durability_off": {
+            name: {"sha256": _sha256(text), "jaxpr": text}
+            for name, text in durability_off_programs().items()
         },
     }
     with open(baseline_path, "w") as fh:

@@ -54,13 +54,23 @@ DEFAULT_MAX_STALENESS_S = 1.0
 SLO_P99_MS = 100.0
 
 
-def _producer(svc, stop, seed, tenants, rows_per_submit, rate_rows_s, counters):
+def _draw_ids(rng, tenants, rows, skew):
+    """Tenant ids for one cohort: uniform (``skew=0``) or Zipf-skewed
+    (``skew>1`` — the spill variant's heavy-head traffic shape, where a few
+    tenants stay hot and the long tail goes cold)."""
+    if not skew:
+        return rng.randint(0, tenants, rows)
+    return (rng.zipf(float(skew), rows) - 1) % tenants
+
+
+def _producer(svc, stop, seed, tenants, rows_per_submit, rate_rows_s, counters,
+              skew=0.0):
     """One ingest thread: paced synthetic traffic until ``stop``."""
     rng = np.random.RandomState(seed)
     interval = rows_per_submit / rate_rows_s if rate_rows_s > 0 else 0.0
     next_at = time.perf_counter()
     while not stop.is_set():
-        ids = rng.randint(0, tenants, rows_per_submit)
+        ids = _draw_ids(rng, tenants, rows_per_submit, skew)
         preds = rng.rand(rows_per_submit).astype(np.float32)
         target = (rng.rand(rows_per_submit) < preds).astype(np.int32)
         admitted = svc.submit_many(ids, preds, target)
@@ -104,8 +114,17 @@ def run_soak(
     read_interval_s: float = DEFAULT_READ_INTERVAL_S,
     max_staleness_s: float = DEFAULT_MAX_STALENESS_S,
     seed: int = 0,
+    spill_cap: int = None,
+    skew: float = 0.0,
 ) -> dict:
-    """One full soak run; returns the JSON-serializable record."""
+    """One full soak run; returns the JSON-serializable record.
+
+    ``spill_cap`` arms the durability plane's cold-tenant spiller
+    (ROADMAP item 4): device-resident active tenants are held at or under
+    the cap by LRU eviction to host memory, while the zero-lost-updates
+    invariant must keep holding EXACTLY (fault-back precedes every
+    dispatch). ``skew`` > 1 draws Zipf-skewed tenant ids — the realistic
+    heavy-head traffic shape a spiller exists for."""
     from metrics_tpu import Accuracy, KeyedMetric, observability
     from metrics_tpu.observability.histogram import HISTOGRAMS
     from metrics_tpu.serving import SLOScheduler
@@ -119,6 +138,11 @@ def run_soak(
         max(prev_threshold, int(np.log2(max(2, max_batch))) + 8)
     )
     metric = KeyedMetric(Accuracy(), num_tenants=int(tenants), validate_ids=False)
+    spiller = None
+    if spill_cap is not None:
+        from metrics_tpu.durability import TenantSpiller
+
+        spiller = TenantSpiller(metric, resident_cap=int(spill_cap))
     svc = SLOScheduler(
         metric,
         max_staleness_s=float(max_staleness_s),
@@ -156,7 +180,8 @@ def run_soak(
     threads = [
         threading.Thread(
             target=_producer,
-            args=(svc, stop, seed + 1 + i, tenants, rows_per_submit, rate, counters),
+            args=(svc, stop, seed + 1 + i, tenants, rows_per_submit, rate, counters,
+                  skew),
             name=f"soak-producer-{i}",
         )
         for i in range(producers)
@@ -267,6 +292,33 @@ def run_soak(
         "generation": svc.generation,
         "slo_p99_ms": SLO_P99_MS,
     }
+    if skew:
+        record["skew"] = float(skew)
+    if spiller is not None:
+        # the spill acceptance evidence: the resident working set held the
+        # cap under skewed traffic, conservation stayed exact, and a
+        # fault-back read is bit-identical to the live (fully-resident)
+        # state — all while the zero-lost invariant above held
+        spill_report = spiller.report()
+        durability = snap.get("durability", {})
+        values_spilled = np.asarray(svc.read(max_staleness_s=0.0))
+        spiller.fault_back()
+        values_resident = np.asarray(metric.compute())
+        faultback_identical = bool(
+            np.array_equal(
+                values_spilled[~np.isnan(values_resident)],
+                values_resident[~np.isnan(values_resident)],
+            )
+            and np.array_equal(np.isnan(values_spilled), np.isnan(values_resident))
+        )
+        record["spill"] = {
+            "resident_cap": spiller.resident_cap,
+            **spill_report,
+            "evictions": durability.get("evictions", 0),
+            "fault_backs": durability.get("fault_backs", 0),
+            "spilled_high_water": durability.get("spilled_high_water", 0),
+            "faultback_reads_bit_identical": faultback_identical,
+        }
     if counters.get("last_read_error"):
         record["last_read_error"] = counters["last_read_error"]
     svc.close()
@@ -291,6 +343,15 @@ def main(argv=None) -> int:
     parser.add_argument("--read-interval-s", type=float, default=DEFAULT_READ_INTERVAL_S)
     parser.add_argument("--max-staleness-s", type=float, default=DEFAULT_MAX_STALENESS_S)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--spill-cap", type=int, default=None,
+        help="arm the cold-tenant spiller: hold device-resident active"
+        " tenants at this cap (durability plane, ROADMAP item 4)",
+    )
+    parser.add_argument(
+        "--skew", type=float, default=0.0,
+        help="Zipf exponent (>1) for skewed tenant traffic; 0 = uniform",
+    )
     parser.add_argument("--out", default=None, help="also write the record to this path")
     args = parser.parse_args(argv)
     record = run_soak(
@@ -306,12 +367,21 @@ def main(argv=None) -> int:
         read_interval_s=args.read_interval_s,
         max_staleness_s=args.max_staleness_s,
         seed=args.seed,
+        spill_cap=args.spill_cap,
+        skew=args.skew,
     )
     print(json.dumps(record), flush=True)
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(record, fh, indent=2)
     ok = record["zero_lost_updates"] and record["shed_matches_telemetry"]
+    spill = record.get("spill")
+    if spill is not None:
+        ok = ok and (
+            spill["resident_under_cap"]
+            and spill["conservation_ok"]
+            and spill["faultback_reads_bit_identical"]
+        )
     if not ok:
         print("# SOAK FAILED: accounting invariant violated", file=sys.stderr)
     return 0 if ok else 1

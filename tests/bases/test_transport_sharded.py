@@ -253,3 +253,86 @@ def test_sharded_keyed_stat_scores_update_keeps_sharding():
         target = jnp.asarray(rng.randint(0, nc, 512))
         m.update(ids, preds, target)
     assert t.max_shard_fraction(m.tp) <= 1 / 8 + 1e-9
+
+
+def test_sharded_fid_feature_bank_round_trip():
+    """A streaming FID's linear-moment feature banks — the (d,) sums and
+    (d, d) outer-product accumulators — live sharded over the feature axis;
+    updates land in the owning shards, sync is the in-place identity, and
+    compute matches the replicated metric bit for bit on the integer count
+    and exactly on the f32 moment states (identical update programs — the
+    sharding is placement, not arithmetic)."""
+    from metrics_tpu.image.fid import FID
+
+    d, n = 64, 96
+    feats = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :d]  # noqa: E731
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.rand(n, 3, 8, 8).astype(np.float32))
+
+    plain = FID(feature=feats, streaming=True, feature_dim=d)
+    plain.update(imgs, real=True)
+    plain.update(imgs * 0.9, real=False)
+
+    t = ShardedTransport(_mesh_1d(), "shard")
+    sharded = FID(feature=feats, streaming=True, feature_dim=d)
+    t.adopt(sharded)  # shard FIRST: updates accumulate into sharded banks
+    sharded.update(imgs, real=True)
+    sharded.update(imgs * 0.9, real=False)
+
+    for side in ("real", "fake"):
+        outer = getattr(sharded, f"{side}_outer")
+        assert t.max_shard_fraction(outer) == pytest.approx(1 / 8), side
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sharded, f"{side}_n")),
+            np.asarray(getattr(plain, f"{side}_n")),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(outer), np.asarray(getattr(plain, f"{side}_outer"))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sharded, f"{side}_sum")),
+            np.asarray(getattr(plain, f"{side}_sum")),
+        )
+    with sharded.sync_context(distributed_available=lambda: True):
+        got = float(sharded.compute())
+    np.testing.assert_allclose(got, float(plain.compute()), rtol=1e-5)
+    # the banks are STILL sharded after the synced compute
+    assert t.max_shard_fraction(sharded.real_outer) == pytest.approx(1 / 8)
+
+
+def test_sharded_keyed_sketch_grid_round_trip():
+    """A keyed(N) SKETCHED metric — the PR-10 bounded-memory histogram
+    grids stacked on the PR-6 tenant axis — runs with the tenant axis
+    sharded: the (N, bins) integer histogram grids place 1/8 per device,
+    keyed scatter updates land in the owning shards, and per-tenant compute
+    matches the replicated keyed metric to <=1 ulp (identical integer
+    grids, float fold)."""
+    from metrics_tpu import AUROC, KeyedMetric
+
+    tenants, bins, rows = 64, 128, 8192
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(rng.randint(0, tenants, rows))
+    preds = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, rows))
+
+    plain = KeyedMetric(AUROC(sketched=True, num_bins=bins), tenants)
+    plain.update(ids, preds, target)
+    want = np.asarray(plain.compute())
+
+    t = ShardedTransport(_mesh_1d(), "shard")
+    sharded = KeyedMetric(AUROC(sketched=True, num_bins=bins), tenants)
+    t.adopt(sharded)
+    sharded.update(ids, preds, target)
+    for leaf in ("pos_hist", "neg_hist"):
+        assert t.max_shard_fraction(getattr(sharded, leaf)) <= 1 / 8 + 1e-9, leaf
+        # the histogram COUNTS are integers: sharded placement must not
+        # have perturbed a single bin of a single tenant
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sharded, leaf)), np.asarray(getattr(plain, leaf))
+        )
+    with sharded.sync_context(distributed_available=lambda: True):
+        got = np.asarray(sharded.compute())
+    mask = ~np.isnan(want)
+    np.testing.assert_array_almost_equal_nulp(got[mask], want[mask], nulp=1)
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+    assert t.max_shard_fraction(sharded.pos_hist) <= 1 / 8 + 1e-9

@@ -1,0 +1,310 @@
+"""Incremental checkpointing: round trips, delta O(k) payloads, shard
+re-reduction, topology-flexible restore (metrics_tpu/durability)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import Accuracy, KeyedMetric, MultiTenantCollection, Precision, Recall, StatScores
+from metrics_tpu.durability import (
+    CheckpointError,
+    CheckpointManager,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from metrics_tpu.durability.checkpoint import (
+    _encode_payload,
+    list_snapshots,
+    load_manifest,
+    merge_shard_states,
+    read_snapshot_state,
+    resolve_chain,
+    write_snapshot,
+)
+
+N, NC, ROWS = 16, 3, 512
+
+
+def _batch(rng, rows=ROWS, tenants=N):
+    ids = jnp.asarray(rng.randint(0, tenants, rows))
+    logits = rng.rand(rows, NC).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NC, rows))
+    return ids, preds, target
+
+
+def _keyed(rng=None, tenants=N):
+    m = KeyedMetric(StatScores(reduce="macro", num_classes=NC), tenants)
+    if rng is not None:
+        m.update(*_batch(rng, tenants=tenants))
+    return m
+
+
+def test_full_save_restore_bit_identical_integer_states(tmp_path):
+    rng = np.random.RandomState(0)
+    m = _keyed(rng)
+    mgr = CheckpointManager(tmp_path, m)
+    manifest = mgr.save()
+    assert manifest["kind"] == "full" and manifest["complete"]
+
+    fresh = _keyed()
+    mgr.restore(fresh)
+    for leaf in ("tp", "fp", "tn", "fn"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fresh, leaf)), np.asarray(getattr(m, leaf))
+        )
+
+
+def test_delta_save_writes_o_k_payload_not_o_n(tmp_path):
+    rng = np.random.RandomState(1)
+    m = _keyed(rng)
+    mgr = CheckpointManager(tmp_path, m)
+    full = mgr.save()
+
+    touched = [2, 5, 11]
+    ids = jnp.asarray(np.array(touched, np.int32))
+    m.update(ids, *_batch(rng, rows=3)[1:])
+    delta = mgr.save()
+    assert delta["kind"] == "delta" and delta["parent"] == full["name"]
+    # the manifest is the evidence: exactly the touched tenants stamped,
+    # and the payload is k/N of the full payload (+ the tiny ledger row)
+    assert delta["tenants"] == touched
+    per_tenant_full = full["payload_bytes"] / N
+    assert delta["payload_bytes"] <= per_tenant_full * len(touched) + 64
+    # restore == live, bit for bit (integer states)
+    fresh = _keyed()
+    mgr.restore(fresh)
+    for leaf in ("tp", "fp", "tn", "fn"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fresh, leaf)), np.asarray(getattr(m, leaf))
+        )
+
+
+def test_delta_chain_replays_in_order(tmp_path):
+    rng = np.random.RandomState(2)
+    m = _keyed(rng)
+    mgr = CheckpointManager(tmp_path, m)
+    mgr.save()
+    for k in (1, 2, 3):
+        ids = jnp.asarray(np.array([k, k + 4], np.int32))
+        m.update(ids, *_batch(rng, rows=2)[1:])
+        assert mgr.save()["kind"] == "delta"
+    chain = resolve_chain(str(tmp_path))
+    assert [c["kind"] for c in chain] == ["full", "delta", "delta", "delta"]
+    fresh = _keyed()
+    mgr.restore(fresh)
+    np.testing.assert_array_equal(np.asarray(fresh.tp), np.asarray(m.tp))
+
+
+def test_restore_into_larger_capacity_padding(tmp_path):
+    """Different tenant-capacity padding: a snapshot restores into a grown
+    (pow2-padded) target; extra rows stay at the defaults."""
+    rng = np.random.RandomState(3)
+    m = _keyed(rng)
+    mgr = CheckpointManager(tmp_path, m)
+    mgr.save()
+    big = _keyed(tenants=N)
+    big.grow(N + 9)
+    assert big.capacity == 32
+    mgr.restore(big)
+    np.testing.assert_array_equal(np.asarray(big.tp)[:N], np.asarray(m.tp))
+    assert not np.asarray(big.tp)[N:].any()
+
+
+def test_restore_into_smaller_target_raises(tmp_path):
+    rng = np.random.RandomState(4)
+    m = _keyed(rng)
+    mgr = CheckpointManager(tmp_path, m)
+    mgr.save()
+    small = _keyed(tenants=N // 2)
+    with pytest.raises(CheckpointError, match="grow"):
+        mgr.restore(small)
+
+
+def test_ledger_rows_survive_restore_and_delta_continues(tmp_path):
+    rng = np.random.RandomState(5)
+    m = _keyed(rng)
+    rows_before = m._traffic.arrays()[0].copy()
+    mgr = CheckpointManager(tmp_path, m)
+    mgr.save()
+    fresh = _keyed()
+    mgr2 = CheckpointManager(tmp_path, fresh)
+    mgr2.restore()
+    np.testing.assert_array_equal(fresh._traffic.arrays()[0], rows_before)
+    # a manager whose OWN restore installed the snapshot can take a DELTA
+    # against the restored baseline (a fresh-process resume, not a re-save)
+    fresh.update(jnp.asarray(np.array([7], np.int32)), *_batch(rng, rows=1)[1:])
+    man = mgr2.save()
+    assert man["kind"] == "delta" and man["tenants"] == [7]
+
+
+def test_collection_bundles_round_trip(tmp_path):
+    rng = np.random.RandomState(6)
+    kw = dict(average="macro", num_classes=NC)
+    mtc = MultiTenantCollection([Precision(**kw), Recall(**kw)], N)
+    ids, preds, target = _batch(rng)
+    mtc.update(ids, preds, target)
+    want = {k: np.asarray(v) for k, v in mtc.compute().items()}
+
+    mgr = CheckpointManager(tmp_path, mtc)
+    mgr.save()
+    fresh = MultiTenantCollection([Precision(**kw), Recall(**kw)], N)
+    fresh.build(preds, target)
+    mgr.restore(fresh)
+    got = {k: np.asarray(v) for k, v in fresh.compute().items()}
+    for k in want:
+        np.testing.assert_array_equal(
+            got[k][~np.isnan(want[k])], want[k][~np.isnan(want[k])]
+        )
+
+
+def test_plain_metric_full_round_trip_and_list_state_refusal(tmp_path):
+    m = Accuracy()
+    m.update(jnp.asarray([0.9, 0.2, 0.7]), jnp.asarray([1, 0, 0]))
+    save_checkpoint(tmp_path / "plain", m)
+    fresh = Accuracy()
+    restore_checkpoint(tmp_path / "plain", fresh)
+    np.testing.assert_allclose(float(fresh.compute()), float(m.compute()))
+
+    from metrics_tpu import AUROC
+
+    unbounded = AUROC()  # list "cat" states
+    with pytest.raises(CheckpointError, match="list state"):
+        save_checkpoint(tmp_path / "nope", unbounded)
+
+
+def test_restore_derived_mode_survives_fresh_target(tmp_path):
+    """Accuracy learns its data mode from the first batch; a fresh restore
+    target must decode it from the restored mode_code state so keyed
+    compute (vmapped — the code is a tracer there) matches the live metric."""
+    rng = np.random.RandomState(7)
+    m = KeyedMetric(Accuracy(), 8)
+    ids = jnp.asarray(rng.randint(0, 8, 64))
+    m.update(ids, jnp.asarray(rng.rand(64).astype(np.float32)),
+             jnp.asarray(rng.randint(0, 2, 64)))
+    mgr = CheckpointManager(tmp_path, m)
+    mgr.save()
+    fresh = KeyedMetric(Accuracy(), 8)
+    mgr.restore(fresh)
+    assert fresh._child.mode == m._child.mode
+    np.testing.assert_array_equal(np.asarray(fresh.compute()), np.asarray(m.compute()))
+
+
+def test_multi_shard_snapshot_re_reduces_by_declared_reduction(tmp_path):
+    """Mergeable-by-construction: a snapshot whose shards hold per-process
+    PARTIAL states restores as their re-reduction — bit-identical for the
+    integer sum states (the packed-collective contract on disk)."""
+    rng = np.random.RandomState(8)
+    parts = [rng.randint(0, 100, (N, NC)).astype(np.int64) for _ in range(3)]
+    leaves = lambda arr: [("", "tp", arr, "sum")]  # noqa: E731
+    payloads, layout = [], None
+    for p in parts:
+        payload, layout = _encode_payload(leaves(p))
+        payloads.append(payload)
+    manifest = {
+        "schema": 1,
+        "name": "snap-00000001",
+        "kind": "full",
+        "parent": None,
+        "layout": layout,
+        "keyed": False,
+        "created_unix_s": 0.0,
+    }
+    manifest = write_snapshot(str(tmp_path), manifest, payloads)
+    state = read_snapshot_state(str(tmp_path), manifest)
+    np.testing.assert_array_equal(state[""]["tp"], sum(parts))
+    # extremal reductions fold too
+    merged = merge_shard_states(
+        [{"": {"m": p}} for p in parts],
+        [{"bundle": "", "name": "m", "reduction": "max"}],
+    )
+    np.testing.assert_array_equal(merged[""]["m"], np.maximum.reduce(parts))
+
+
+def test_history_pruning_keeps_chain_restorable(tmp_path):
+    rng = np.random.RandomState(9)
+    m = _keyed(rng)
+    mgr = CheckpointManager(tmp_path, m, history=2)
+    for _ in range(4):
+        mgr.save(delta=False)
+    assert len(list_snapshots(str(tmp_path))) == 2
+    fresh = _keyed()
+    mgr.restore(fresh)
+    np.testing.assert_array_equal(np.asarray(fresh.tp), np.asarray(m.tp))
+
+
+def test_save_async_overlaps_and_snapshots_the_cut_moment(tmp_path):
+    """An async save captures the state at submission: updates landing
+    while the write is in flight are NOT in the snapshot, and the save
+    completes without blocking them."""
+    rng = np.random.RandomState(10)
+    m = _keyed(rng)
+    tp_at_cut = np.asarray(m.tp).copy()
+    mgr = CheckpointManager(tmp_path, m)
+    future = mgr.save_async()
+    # keep updating while the write is in flight
+    for _ in range(3):
+        m.update(*_batch(rng, rows=64))
+    manifest = future.result(timeout=30.0)
+    assert manifest["kind"] == "full"
+    fresh = _keyed()
+    mgr.restore(fresh)
+    np.testing.assert_array_equal(np.asarray(fresh.tp), tp_at_cut)
+    assert not np.array_equal(tp_at_cut, np.asarray(m.tp))
+
+
+def test_latest_pointer_and_report(tmp_path):
+    rng = np.random.RandomState(11)
+    m = _keyed(rng)
+    mgr = CheckpointManager(tmp_path, m)
+    assert mgr.latest() is None
+    man = mgr.save()
+    assert mgr.latest() == man["name"]
+    report = mgr.report()
+    assert report["latest_kind"] == "full"
+    assert report["restorable_chain"] == [man["name"]]
+    assert load_manifest(str(tmp_path), man["name"])["payload_bytes"] > 0
+
+
+def test_restore_without_snapshot_raises(tmp_path):
+    m = _keyed()
+    with pytest.raises(CheckpointError, match="no restorable snapshot"):
+        CheckpointManager(tmp_path / "empty", m).restore()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the virtual 8-device mesh")
+def test_topology_flexible_restore_8way_to_4way_and_sharded(tmp_path):
+    """The acceptance pin: save with the tenant axis sharded over 8 devices,
+    restore onto a 4-device mesh and onto a ShardedTransport placement —
+    integer states bit-identical in every topology."""
+    from jax.sharding import Mesh
+
+    from metrics_tpu.transport import ShardedTransport
+    from metrics_tpu.utilities.distributed import tenant_axis_sharding
+
+    rng = np.random.RandomState(12)
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("t",))
+    m = KeyedMetric(
+        StatScores(reduce="macro", num_classes=NC), N,
+        tenant_sharding=tenant_axis_sharding(mesh8, "t"),
+    )
+    m.update(*_batch(rng))
+    mgr = CheckpointManager(tmp_path, m)
+    mgr.save()
+
+    # 8-way -> 4-way mesh
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("t",))
+    four = KeyedMetric(
+        StatScores(reduce="macro", num_classes=NC), N,
+        tenant_sharding=tenant_axis_sharding(mesh4, "t"),
+    )
+    mgr.restore(four)
+    np.testing.assert_array_equal(np.asarray(four.tp), np.asarray(m.tp))
+    assert len(four.tp.sharding.device_set) == 4
+
+    # sharded-transport placement (replicated-save -> device-sharded restore)
+    t = ShardedTransport(mesh8, "t")
+    sharded = KeyedMetric(StatScores(reduce="macro", num_classes=NC), N)
+    mgr.restore(sharded, transport=t)
+    np.testing.assert_array_equal(np.asarray(sharded.tp), np.asarray(m.tp))
+    assert t.max_shard_fraction(sharded.tp) == pytest.approx(1 / 8)

@@ -1,0 +1,158 @@
+"""Crash-consistency fault injection: a save killed at EVERY protocol step
+leaves the last complete snapshot restorable — never a torn one — and a
+save under concurrent ingest keeps the serving conservation law exact."""
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import Accuracy, KeyedMetric
+from metrics_tpu.durability import (
+    CheckpointCrash,
+    CheckpointManager,
+    inject_crash,
+)
+from metrics_tpu.durability.checkpoint import CRASH_POINTS, resolve_chain
+
+N = 8
+
+#: crash points BEFORE the snapshot directory rename: the new snapshot must
+#: not exist; points after: the new snapshot is complete and restorable
+_TORN_POINTS = (
+    "before_shard", "after_shard", "before_manifest", "after_manifest",
+    "before_rename",
+)
+_COMPLETE_POINTS = ("after_rename", "before_latest")
+
+
+def _update(m, rng, rows=64):
+    ids = jnp.asarray(rng.randint(0, N, rows))
+    preds = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray((rng.rand(rows) < 0.5).astype(np.int32))
+    m.update(ids, preds, target)
+
+
+def test_crash_point_registry_is_exhaustive():
+    assert set(_TORN_POINTS) | set(_COMPLETE_POINTS) == set(CRASH_POINTS)
+    with pytest.raises(ValueError, match="unknown crash point"):
+        with inject_crash("nonsense"):
+            pass
+
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_crashed_save_always_leaves_a_complete_restorable_snapshot(tmp_path, point):
+    rng = np.random.RandomState(CRASH_POINTS.index(point))
+    m = KeyedMetric(Accuracy(), N)
+    _update(m, rng)
+    mgr = CheckpointManager(tmp_path, m)
+    base = mgr.save()
+    state_at_base = np.asarray(m.tp).copy()
+
+    _update(m, rng)
+    state_at_crash = np.asarray(m.tp).copy()
+    with pytest.raises(CheckpointCrash):
+        with inject_crash(point):
+            mgr.save()
+
+    chain = resolve_chain(str(tmp_path))
+    assert chain, "a crashed save must never leave zero restorable snapshots"
+    fresh = KeyedMetric(Accuracy(), N)
+    mgr.restore(fresh)
+    if point in _TORN_POINTS:
+        # the new snapshot never completed: restore yields the base
+        assert [c["name"] for c in chain] == [base["name"]]
+        np.testing.assert_array_equal(np.asarray(fresh.tp), state_at_base)
+    else:
+        # rename happened: the new snapshot IS complete (LATEST may lag —
+        # restore must not trust it)
+        assert len(chain) == 2
+        np.testing.assert_array_equal(np.asarray(fresh.tp), state_at_crash)
+
+
+def test_save_retry_after_crash_produces_consistent_delta(tmp_path):
+    """The dirty marks must NOT advance on a crashed save: the retry's
+    delta covers everything since the last COMPLETE snapshot."""
+    rng = np.random.RandomState(99)
+    m = KeyedMetric(Accuracy(), N)
+    _update(m, rng)
+    mgr = CheckpointManager(tmp_path, m)
+    mgr.save()
+    _update(m, rng)
+    with pytest.raises(CheckpointCrash):
+        with inject_crash("before_manifest"):
+            mgr.save()
+    man = mgr.save()  # the retry
+    assert man["kind"] == "delta"
+    fresh = KeyedMetric(Accuracy(), N)
+    mgr.restore(fresh)
+    np.testing.assert_array_equal(np.asarray(fresh.tp), np.asarray(m.tp))
+
+
+def test_torn_manifest_and_corrupt_shard_are_invisible(tmp_path):
+    rng = np.random.RandomState(7)
+    m = KeyedMetric(Accuracy(), N)
+    _update(m, rng)
+    mgr = CheckpointManager(tmp_path, m)
+    good = mgr.save()
+    _update(m, rng)
+    bad = mgr.save(delta=False)
+
+    # corrupt the newest shard ON DISK: its checksum no longer matches, so
+    # the whole snapshot must drop out of the restorable set
+    shard = tmp_path / bad["name"] / bad["shards"][0]["file"]
+    raw = bytearray(shard.read_bytes())
+    raw[0] ^= 0xFF
+    shard.write_bytes(bytes(raw))
+    chain = resolve_chain(str(tmp_path))
+    assert [c["name"] for c in chain] == [good["name"]]
+
+    # a torn manifest is equally invisible
+    (tmp_path / bad["name"] / "MANIFEST.json").write_text('{"truncated": ')
+    assert [c["name"] for c in resolve_chain(str(tmp_path))] == [good["name"]]
+
+
+def test_save_under_concurrent_ingest_holds_conservation(tmp_path):
+    """Async saves racing live serving ingest: the queue's exact ledger
+    still conserves (submitted − shed == dispatched == rows_routed), every
+    checkpoint completes, and the final restore equals the final state."""
+    from metrics_tpu.serving import SLOScheduler
+
+    metric = KeyedMetric(Accuracy(), 64, validate_ids=False)
+    svc = SLOScheduler(metric, max_batch=128, max_delay_ms=2.0, policy="block")
+    mgr = CheckpointManager(tmp_path, svc)
+
+    rng = np.random.RandomState(0)
+    stop = threading.Event()
+    submitted = [0]
+
+    def producer():
+        r = np.random.RandomState(123)
+        while not stop.is_set():
+            ids = r.randint(0, 64, 32)
+            preds = r.rand(32).astype(np.float32)
+            target = (r.rand(32) < 0.5).astype(np.int32)
+            submitted[0] += svc.submit_many(ids, preds, target)
+
+    threads = [threading.Thread(target=producer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    futures = [mgr.save_async() for _ in range(4)]
+    manifests = [f.result(timeout=60.0) for f in futures]
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert svc.drain(timeout=30.0)
+
+    assert all(man["complete"] for man in manifests)
+    stats = svc.queue.stats()
+    routed = metric.tenant_report()["rows_routed"]
+    assert stats["submitted"] - stats["shed"] == stats["dispatched"] == routed
+
+    # one final save: restore == live, exactly
+    final = mgr.save()
+    fresh = KeyedMetric(Accuracy(), 64, validate_ids=False)
+    CheckpointManager(tmp_path, fresh).restore(fresh)
+    np.testing.assert_array_equal(np.asarray(fresh.tp), np.asarray(metric.tp))
+    assert final["complete"]
+    svc.close()

@@ -1,0 +1,199 @@
+"""Elastic tenant capacity: pow2-padded grow/compact without recompile
+storms (wrappers/multitenant.py, durability plane)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import Accuracy, KeyedMetric, MultiTenantCollection, Precision, Recall, StatScores
+from metrics_tpu.wrappers.multitenant import _pow2_at_least
+
+NC = 3
+
+
+def _batch(rng, rows, tenants):
+    ids = jnp.asarray(rng.randint(0, tenants, rows))
+    logits = rng.rand(rows, NC).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NC, rows))
+    return ids, preds, target
+
+
+def test_pow2_at_least():
+    assert [_pow2_at_least(n) for n in (1, 2, 3, 4, 5, 1000)] == [1, 2, 4, 4, 8, 1024]
+
+
+def test_default_construction_has_exact_capacity():
+    m = KeyedMetric(Accuracy(), 10)
+    assert m.capacity == 10 and m.num_tenants == 10  # pre-elastic layout
+
+
+def test_grow_keeps_accumulation_and_pads_capacity():
+    rng = np.random.RandomState(0)
+    m = KeyedMetric(StatScores(reduce="macro", num_classes=NC), 10)
+    m.update(*_batch(rng, 256, 10))
+    tp_before = np.asarray(m.tp).copy()
+    m.grow(13)
+    assert (m.num_tenants, m.capacity) == (13, 16)
+    np.testing.assert_array_equal(np.asarray(m.tp)[:10], tp_before)
+    assert not np.asarray(m.tp)[10:].any()
+    # the new tenants are routable immediately (every event row lands NC
+    # counts across the tp/fp/tn/fn quartet)
+    m.update(jnp.asarray([12], dtype=jnp.int32), *_batch(rng, 1, 13)[1:])
+    quartet = sum(
+        int(np.asarray(getattr(m, leaf))[12].sum()) for leaf in ("tp", "fp", "tn", "fn")
+    )
+    assert quartet == NC
+    # compute fans out over the LOGICAL size: padding rows are sliced off
+    assert np.asarray(m.compute()).shape[0] == 13
+
+
+def test_grow_is_monotone_and_idempotent():
+    m = KeyedMetric(Accuracy(), 8)
+    assert m.grow(4) == 8  # no-op below the current size
+    assert m.grow(8) == 8
+    m.grow(9)
+    assert (m.num_tenants, m.capacity) == (9, 16)
+
+
+def test_logical_grows_within_one_capacity_never_recompile():
+    """The log2 recompile bound: after the first grow past the pow2
+    boundary, logical grows inside the same capacity reuse the SAME
+    compiled executable — no drop, no retrace."""
+    rng = np.random.RandomState(1)
+    m = KeyedMetric(StatScores(reduce="macro", num_classes=NC), 8)
+    m.grow(9)  # capacity 16
+    m.update(*_batch(rng, 64, 9))
+    fn = m._keyed_update_fn
+    assert fn is not None and fn.last_compiled
+    compiled_sizes = set()
+    for n in range(10, 17):
+        m.grow(n)
+        assert m.capacity == 16
+        assert m._keyed_update_fn is fn  # dispatcher survived the grow
+        m.update(*_batch(rng, 64, n))
+        assert not fn.last_compiled  # same executable, cache hit
+        compiled_sizes.add(m.capacity)
+    assert compiled_sizes == {16}
+
+
+def test_distinct_capacities_are_log2_bounded():
+    m = KeyedMetric(Accuracy(), 1)
+    caps = set()
+    for n in range(2, 1025):
+        m.grow(n)
+        caps.add(m.capacity)
+    assert caps == {2, 4, 8, 16, 32, 64, 128, 256, 512, 1024}
+
+
+def test_compact_drops_tail_and_shrinks_capacity():
+    rng = np.random.RandomState(2)
+    m = KeyedMetric(StatScores(reduce="macro", num_classes=NC), 16)
+    m.update(*_batch(rng, 256, 16))
+    tp_before = np.asarray(m.tp).copy()
+    m.compact(5)
+    assert (m.num_tenants, m.capacity) == (5, 8)
+    np.testing.assert_array_equal(np.asarray(m.tp)[:5], tp_before[:5])
+    assert np.asarray(m.compute()).shape[0] == 5
+    # dropped ids now fail eager validation
+    with pytest.raises(ValueError, match="outside the valid range"):
+        m.update(jnp.asarray([7], dtype=jnp.int32), *_batch(rng, 1, 5)[1:])
+    # the traffic ledger shrank with the axis
+    rows, _ = m._traffic.arrays()
+    assert rows is None or len(rows) == 5
+
+
+def test_compact_default_targets_highest_active_tenant():
+    rng = np.random.RandomState(3)
+    m = KeyedMetric(Accuracy(), 32)
+    ids = jnp.asarray(np.array([0, 3, 6], np.int32))
+    m.update(ids, jnp.asarray(rng.rand(3).astype(np.float32)),
+             jnp.asarray(rng.randint(0, 2, 3)))
+    m.compact()
+    assert (m.num_tenants, m.capacity) == (7, 8)
+
+
+def test_compact_above_current_size_raises():
+    m = KeyedMetric(Accuracy(), 8)
+    with pytest.raises(ValueError, match="exceeds the current tenant count"):
+        m.compact(9)
+
+
+def test_grow_compact_round_trip_preserves_survivors():
+    rng = np.random.RandomState(4)
+    m = KeyedMetric(StatScores(reduce="macro", num_classes=NC), 6)
+    m.update(*_batch(rng, 128, 6))
+    want = np.asarray(m.compute())
+    m.grow(20)
+    m.compact(6)
+    got = np.asarray(m.compute())
+    np.testing.assert_array_equal(got[~np.isnan(want)], want[~np.isnan(want)])
+
+
+def test_padding_band_rows_reset_between_shrink_and_grow():
+    """A compact followed by a grow must expose pristine default rows —
+    never resurrected padding-band accumulation."""
+    rng = np.random.RandomState(5)
+    m = KeyedMetric(StatScores(reduce="macro", num_classes=NC), 8)
+    m.update(*_batch(rng, 128, 8))
+    m.compact(4)  # capacity 4
+    m.grow(8)
+    assert not np.asarray(m.tp)[4:].any()
+
+
+def test_collection_grow_compact_parity():
+    rng = np.random.RandomState(6)
+    kw = dict(average="macro", num_classes=NC)
+    mtc = MultiTenantCollection([Precision(**kw), Recall(**kw)], 8)
+    ids, preds, target = _batch(rng, 256, 8)
+    mtc.update(ids, preds, target)
+    want = {k: np.asarray(v) for k, v in mtc.compute().items()}
+    mtc.grow(12)
+    assert mtc.capacity == 16
+    for km in mtc._keyed.values():
+        assert (km.num_tenants, km.capacity) == (12, 16)
+    got = {k: np.asarray(v) for k, v in mtc.compute().items()}
+    for k in want:
+        np.testing.assert_array_equal(
+            got[k][:8][~np.isnan(want[k])], want[k][~np.isnan(want[k])]
+        )
+    mtc.compact(8)
+    assert (mtc.num_tenants, mtc.capacity) == (8, 8)
+    back = {k: np.asarray(v) for k, v in mtc.compute().items()}
+    for k in want:
+        np.testing.assert_array_equal(
+            back[k][~np.isnan(want[k])], want[k][~np.isnan(want[k])]
+        )
+
+
+def test_explicit_capacity_constructor_and_validation():
+    m = KeyedMetric(Accuracy(), 5, capacity=8)
+    assert (m.num_tenants, m.capacity) == (5, 8)
+    assert np.asarray(m.compute()).shape == (5,)
+    with pytest.raises(ValueError, match="capacity"):
+        KeyedMetric(Accuracy(), 5, capacity=4)
+    with pytest.raises(ValueError, match="capacity"):
+        MultiTenantCollection([Accuracy()], 5, capacity=4)
+
+
+def test_rollups_respect_logical_size_after_grow():
+    rng = np.random.RandomState(7)
+    m = KeyedMetric(Accuracy(), 6)
+    ids = jnp.asarray(rng.randint(0, 6, 128))
+    m.update(ids, jnp.asarray(rng.rand(128).astype(np.float32)),
+             jnp.asarray(rng.randint(0, 2, 128)))
+    m.grow(10)
+    vals, top_ids = m.compute_topk(3)
+    assert top_ids.shape == (3,) and int(jnp.max(top_ids)) < 10
+    assert np.isfinite(float(m.compute_percentiles(50)))
+
+
+def test_resize_telemetry_counters():
+    from metrics_tpu.durability.telemetry import DURABILITY_STATS
+
+    grows0 = DURABILITY_STATS.counter("grows")
+    compactions0 = DURABILITY_STATS.counter("compactions")
+    m = KeyedMetric(Accuracy(), 4)
+    m.grow(9)
+    m.compact(4)
+    assert DURABILITY_STATS.counter("grows") == grows0 + 1
+    assert DURABILITY_STATS.counter("compactions") == compactions0 + 1

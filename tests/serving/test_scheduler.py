@@ -261,3 +261,44 @@ def test_never_written_tenant_reads_from_cache():
     assert svc.read([7], max_staleness_s=0.0)[0] == 0.0  # never written
     assert m.computes == computes
     svc.close()
+
+
+def test_tenant_generation_map_prunes_after_compaction():
+    """Satellite fix: the per-tenant generation ledger must drop entries for
+    tenants that no longer exist after an elastic shrink — it only ever
+    GREW before, a slow leak in a weeks-long service (and a stale entry
+    could mark a future tenant reusing the id as already-written)."""
+    m = KeyedMetric(Accuracy(), 16, validate_ids=False)
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    for t in range(16):
+        svc.submit(t, np.float32(0.9), np.int32(1))
+    svc.queue.flush()
+    assert svc.report()["tenant_generations_tracked"] == 16
+
+    m.compact(5)
+    # the prune is opportunistic-on-dispatch AND explicit
+    assert svc.prune_tenant_generations() == 11
+    assert svc.report()["tenant_generations_tracked"] == 5
+    assert set(svc.tenant_generations()) <= set(range(5))
+    # a second call is a no-op (O(1) steady state)
+    assert svc.prune_tenant_generations() == 0
+
+    # the next dispatched flush also prunes without an explicit call
+    m.grow(16)
+    m.compact(3)
+    svc.submit(1, np.float32(0.5), np.int32(0))
+    svc.queue.flush()
+    assert svc.report()["tenant_generations_tracked"] <= 3
+    svc.close()
+
+
+def test_tenant_generations_accessor_is_consistent_copy():
+    m = KeyedMetric(Accuracy(), 4, validate_ids=False)
+    svc = SLOScheduler(m, max_batch=8, max_delay_ms=10_000.0, start=False)
+    svc.submit(2, np.float32(0.9), np.int32(1))
+    svc.queue.flush()
+    gens = svc.tenant_generations()
+    assert gens == {2: 1}
+    gens[3] = 99  # mutating the copy never touches the ledger
+    assert svc.tenant_generations() == {2: 1}
+    svc.close()

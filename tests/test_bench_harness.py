@@ -441,3 +441,49 @@ def test_pallas_kernel_bench_records_round_trip(monkeypatch):
         assert "telemetry" in line
         assert line["telemetry"]["kernels"]["dispatch"]  # decisions recorded
         assert cfg_name in bench_suite.CONFIG_META
+
+
+def test_checkpoint_save_bench_record_round_trips(monkeypatch):
+    """The checkpoint config's record must survive json round-trips and
+    carry the durability evidence: the delta manifest stamped exactly the
+    touched tenants with an O(k) payload (``delta_payload_o_k``), the
+    full/delta payload ratio, and the async-save overlap fraction."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "CKPT_TENANTS", 128)
+    monkeypatch.setattr(bench_suite, "CKPT_TOUCH", 8)
+    monkeypatch.setattr(bench_suite, "CKPT_ROUNDS", 2)
+
+    line = bench_suite.run_config(bench_suite.bench_checkpoint_save, probe=False)
+    assert json.loads(json.dumps(line)) == line
+    assert line["metric"] == "checkpoint_save_step" and line["unit"] == "us/save"
+    assert line["tenants"] == 128 and line["tenants_stamped"] == 8
+    assert line["delta_payload_o_k"] is True  # the O(k) acceptance pin
+    assert line["payload_delta_bytes"] < line["payload_full_bytes"]
+    assert line["payload_ratio"] > 1.0
+    assert 0.0 <= line["overlap_fraction"] <= 1.0
+    assert "telemetry" in line and line["telemetry"]["durability"]["saves"] > 0
+    assert "bench_checkpoint_save" in bench_suite.CONFIG_META
+
+
+def test_tenant_spill_bench_record_round_trips(monkeypatch):
+    """The spill config's record must survive json round-trips and carry
+    the acceptance evidence: resident held under the cap with exact
+    conservation, and fault-back reads bit-identical to a never-evicted
+    control fed identical traffic."""
+    import json
+
+    monkeypatch.setattr(bench_suite, "SPILL_TENANTS", 128)
+    monkeypatch.setattr(bench_suite, "SPILL_COHORT", 8)
+    monkeypatch.setattr(bench_suite, "ROUNDS", 2)
+
+    line = bench_suite.run_config(bench_suite.bench_tenant_spill, probe=False)
+    assert json.loads(json.dumps(line)) == line
+    assert line["metric"] == "tenant_spill_faultback" and line["unit"] == "us/tenant"
+    assert line["tenants"] == 128 and line["cohort"] == 8
+    assert line["resident_under_cap"] is True
+    assert line["conservation_ok"] is True
+    assert line["faultback_bit_identical"] is True  # the acceptance pin
+    assert line["evict_us_per_tenant"] > 0
+    assert "telemetry" in line and line["telemetry"]["durability"]["evictions"] > 0
+    assert "bench_tenant_spill" in bench_suite.CONFIG_META

@@ -397,3 +397,82 @@ def test_serving_layer_documented_and_cross_linked():
     with open(os.path.join(os.path.dirname(DOCS_DIR), "README.md")) as fh:
         readme = fh.read()
     assert "docs/serving.md" in readme and "SLOScheduler" in readme
+
+
+def test_durability_documented_and_cross_linked():
+    """The durability plane's user contract lives in four places: its own
+    guide (checkpoint protocol, restore topology matrix, eviction knobs,
+    conservation laws), the performance guide (cost model + cross-link),
+    the observability guide (the durability.* telemetry family), and the
+    serving guide (the millions-of-tenants hand-off) — all cross-linked,
+    plus modules rows for the top-level exports."""
+    with open(f"{DOCS_DIR}/durability.md") as fh:
+        durability = fh.read()
+    for phrase in (
+        # checkpoint protocol
+        "MANIFEST.json",
+        "atomic",
+        "os.replace",
+        "LATEST",
+        "sha256",
+        "inject_crash",
+        "make checkpoint-smoke",
+        "save_async",
+        "tenant_generations",
+        "O(k)",
+        # restore topology matrix
+        "## Restore topology matrix",
+        "place_state",
+        "ShardedTransport",
+        "re-reduce of mergeable shards",
+        "bit-identical",
+        # elasticity
+        "grow(",
+        "compact(",
+        "log2(max N) + 1",
+        "prune_tenant_generations",
+        # eviction knobs
+        "resident_cap",
+        "min_idle_s",
+        "fault-back",
+        # conservation laws
+        "## Conservation laws",
+        "resident_active + spilled == active",
+        "submitted − shed == dispatched",
+        "--spill-cap",
+        # telemetry + gates
+        "durability_off",
+        "checkpoint_save_step",
+        "tenant_spill_faultback",
+        "observability.md#durability-telemetry",
+    ):
+        assert phrase in durability, phrase
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "## Durability & elasticity" in perf
+    for phrase in ("durability.md", "CheckpointManager", "TenantSpiller",
+                   "checkpoint_save_step", "tenant_spill_faultback"):
+        assert phrase in perf, phrase
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "## Durability telemetry" in obs
+    for phrase in (
+        "delta_saves",
+        "tenants_stamped",
+        "fault_backs",
+        "spilled_high_water",
+        "metrics_tpu_durability_",
+        "durability_save_seconds",
+        "durability_faultback_seconds",
+        "tenant_generations_pruned",
+        "durability_off",
+    ):
+        assert phrase in obs, phrase
+    with open(f"{DOCS_DIR}/serving.md") as fh:
+        serving = fh.read()
+    assert "durability.md" in serving and "--spill-cap" in serving
+    with open(f"{DOCS_DIR}/modules.md") as fh:
+        mods = fh.read()
+    assert "`metrics_tpu.CheckpointManager`" in mods
+    assert "`metrics_tpu.TenantSpiller`" in mods
+    assert "`metrics_tpu.durability`" in mods
