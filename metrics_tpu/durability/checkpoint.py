@@ -54,6 +54,7 @@ import json
 import os
 import threading
 import time
+import weakref
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -63,6 +64,8 @@ from metrics_tpu.durability.telemetry import (
     DURABILITY_STATS,
     observe_restore,
     observe_save,
+    pin_tenant_traffic,
+    unpin_tenant_traffic,
 )
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.registry import TELEMETRY
@@ -498,6 +501,16 @@ class CheckpointManager:
                 "num_tenants": existing[-1].get("num_tenants"),
             }
         self.telemetry_key = TELEMETRY.register(self)
+        # rows marks read the traffic ledger as ground truth, so hold it
+        # open for the manager's lifetime: with the ledger fed only behind
+        # TELEMETRY.enabled, a telemetry toggle between two saves would
+        # freeze the rows and silently drop those tenants from the next
+        # delta's dirty set
+        if getattr(self._target, "_traffic", None) is not None:
+            pin_tenant_traffic(self._target)
+            self._traffic_unpin = weakref.finalize(
+                self, unpin_tenant_traffic, self._target
+            )
 
     # -- marks (the delta dirty-set source) ---------------------------------
 
@@ -505,7 +518,13 @@ class CheckpointManager:
         if self._scheduler is not None:
             return ("gen", dict(self._scheduler.tenant_generations()))
         traffic = getattr(self._target, "_traffic", None)
-        if traffic is not None:
+        if traffic is not None and (
+            TELEMETRY.enabled
+            or self._target.__dict__.get("_durability_traffic_pin")
+        ):
+            # a dead ledger (no pin, telemetry off) must force a full save:
+            # its rows can be arbitrarily stale, and a delta diffed against
+            # frozen rows drops data from the snapshot chain
             rows, _ = traffic.arrays()
             if rows is not None:
                 return ("rows", rows)
@@ -763,7 +782,15 @@ class CheckpointManager:
                 for name, rows in leaves.items():
                     base = state[bundle][name]
                     base[ids] = rows
-        self._install(target, chain[-1], state, transport)
+        marks: Optional[Tuple[str, Any]] = None
+        with _serial_lock(target):
+            self._install(target, chain[-1], state, transport)
+            if target is self._target:
+                # cut the marks baseline atomically with the install: an
+                # update slipping in between would be invisible to the next
+                # delta's dirty set (the serial lock is reentrant, so the
+                # nested acquisition inside _install is free)
+                marks = self._current_marks()
 
         dur = time.perf_counter() - start
         DURABILITY_STATS.inc("restores")
@@ -784,7 +811,7 @@ class CheckpointManager:
         # set is "everything touched since that snapshot"
         with self._lock:
             if target is self._target:
-                self._last_marks = self._current_marks()
+                self._last_marks = marks
                 self._last_meta = {
                     "name": chain[-1]["name"],
                     "num_tenants": chain[-1].get("num_tenants"),
@@ -805,72 +832,87 @@ class CheckpointManager:
         saved_n = manifest.get("num_tenants")
         keyed = bool(manifest.get("keyed"))
 
-        targets: Dict[str, Any]
-        if _is_collection(target):
-            owners = target._require_built()
-            missing = set(state) - set(owners)
-            if missing:
-                raise CheckpointError(
-                    f"restore target collection lacks state bundles {sorted(missing)}"
-                    " — build() it with the same members/groups as the saved one"
-                )
-            targets = {k: owners[k] for k in state}
-        else:
-            if set(state) != {""}:
-                raise CheckpointError(
-                    "snapshot holds a collection's bundles"
-                    f" ({sorted(state)}); the restore target is a single metric"
-                )
-            targets = {"": target}
-
-        for bundle, owner in targets.items():
-            leaves = state[bundle]
-            if set(leaves) != set(owner._defaults):
-                raise CheckpointError(
-                    f"snapshot leaves {sorted(leaves)} do not match the target's"
-                    f" states {sorted(owner._defaults)} (bundle {bundle!r})"
-                )
-            new_state: Dict[str, Any] = {}
-            if keyed:
-                if owner.num_tenants < saved_n:
+        # the whole installation — state swap, ledger overwrite, spiller
+        # invalidation — is one cut under the target's ingest lock, exactly
+        # like _snapshot_refs on the save side: a restore concurrent with
+        # live ingest must never interleave an update's read-modify-write
+        with _serial_lock(target):
+            targets: Dict[str, Any]
+            if _is_collection(target):
+                owners = target._require_built()
+                missing = set(state) - set(owners)
+                if missing:
                     raise CheckpointError(
-                        f"restore target has num_tenants={owner.num_tenants} <"
-                        f" saved {saved_n}; grow() the target first"
+                        f"restore target collection lacks state bundles {sorted(missing)}"
+                        " — build() it with the same members/groups as the saved one"
                     )
-                for name, rows in leaves.items():
-                    leaf = jnp.asarray(owner._defaults[name]).at[:saved_n].set(
-                        jnp.asarray(rows)
-                    )
-                    new_state[name] = leaf
+                targets = {k: owners[k] for k in state}
             else:
-                for name, arr in leaves.items():
-                    new_state[name] = jnp.asarray(arr)
-            if transport is not None:
-                new_state = transport.place_state(new_state)
-            elif getattr(owner, "tenant_sharding", None) is not None:
-                new_state = {
-                    k: jax.device_put(v, owner.tenant_sharding)
-                    for k, v in new_state.items()
-                }
-            owner._set_states(new_state)
-            owner._computed = None
-            owner._forward_cache = None
-            owner._update_called = True
-            # metrics that learn config from data (Accuracy.mode, ...)
-            # decode it from the restored states — a fresh restore target
-            # never saw a batch, so the clone/pickle channel is absent
-            derived_host = getattr(owner, "_child", owner)
-            derived_host._restore_derived(leaves)
+                if set(state) != {""}:
+                    raise CheckpointError(
+                        "snapshot holds a collection's bundles"
+                        f" ({sorted(state)}); the restore target is a single metric"
+                    )
+                targets = {"": target}
 
-        wrapper = target
-        traffic = getattr(wrapper, "_traffic", None)
-        if ledger is not None and traffic is not None and keyed:
-            rows = np.zeros(wrapper.num_tenants, dtype=np.int64)
-            saved_rows = ledger["rows"]
-            rows[: min(len(saved_rows), len(rows))] = saved_rows[: len(rows)]
-            with traffic._lock:
-                traffic.rows = rows
-                traffic.last_seen = np.full(wrapper.num_tenants, np.nan)
+            for bundle, owner in targets.items():
+                leaves = state[bundle]
+                if set(leaves) != set(owner._defaults):
+                    raise CheckpointError(
+                        f"snapshot leaves {sorted(leaves)} do not match the target's"
+                        f" states {sorted(owner._defaults)} (bundle {bundle!r})"
+                    )
+                new_state: Dict[str, Any] = {}
+                if keyed:
+                    if owner.num_tenants < saved_n:
+                        raise CheckpointError(
+                            f"restore target has num_tenants={owner.num_tenants} <"
+                            f" saved {saved_n}; grow() the target first"
+                        )
+                    for name, rows in leaves.items():
+                        leaf = jnp.asarray(owner._defaults[name]).at[:saved_n].set(
+                            jnp.asarray(rows)
+                        )
+                        new_state[name] = leaf
+                else:
+                    for name, arr in leaves.items():
+                        new_state[name] = jnp.asarray(arr)
+                if transport is not None:
+                    new_state = transport.place_state(new_state)
+                elif getattr(owner, "tenant_sharding", None) is not None:
+                    new_state = {
+                        k: jax.device_put(v, owner.tenant_sharding)
+                        for k, v in new_state.items()
+                    }
+                owner._set_states(new_state)
+                owner._computed = None
+                owner._forward_cache = None
+                owner._update_called = True
+                # metrics that learn config from data (Accuracy.mode, ...)
+                # decode it from the restored states — a fresh restore target
+                # never saw a batch, so the clone/pickle channel is absent
+                derived_host = getattr(owner, "_child", owner)
+                derived_host._restore_derived(leaves)
+
+            wrapper = target
+            traffic = getattr(wrapper, "_traffic", None)
+            if ledger is not None and traffic is not None and keyed:
+                rows = np.zeros(wrapper.num_tenants, dtype=np.int64)
+                saved_rows = ledger["rows"]
+                rows[: min(len(saved_rows), len(rows))] = saved_rows[: len(rows)]
+                with traffic._lock:
+                    traffic.rows = rows
+                    traffic.last_seen = np.full(wrapper.num_tenants, np.nan)
+
+            # every device row was just replaced: host rows a spiller still
+            # holds predate the restore, and the next fault-back would
+            # scatter them over the restored tenants — the hooks drop them
+            # and re-seed activity from the restored ledger (the save side's
+            # _fault_back_all counterpart)
+            hooks = getattr(target, "__dict__", {}).get("_durability_hooks")
+            on_restore = getattr(hooks, "on_restore", None)
+            if on_restore is not None:
+                on_restore()
 
     # -- introspection ------------------------------------------------------
 

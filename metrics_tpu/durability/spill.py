@@ -19,9 +19,12 @@ child defaults — and **fault back transparently**:
 The spiller installs itself as the metric's durability hooks
 (``metric._durability_hooks``) — the wrappers call ``before_update``/
 ``after_update``/``before_read``/``before_snapshot``/``on_resize`` from
-their stateful paths; the pure ``apply_update`` path and every compiled
-program are untouched (the zero-overhead ``durability_off`` digests pin
-it). Eviction/fault-back scatters pad their tenant cohorts to power-of-two
+their stateful paths, and the checkpoint plane calls ``on_restore`` after
+installing a snapshot (spilled host rows predate the restored state and
+must be dropped, never faulted back); the pure ``apply_update`` path and
+every compiled program are untouched (the zero-overhead ``durability_off``
+digests pin it).
+Eviction/fault-back scatters pad their tenant cohorts to power-of-two
 buckets (ids repeated — an idempotent row write), so the executable cache
 stays log2-bounded exactly like the serving queue's ``pad_to_bucket``.
 
@@ -34,6 +37,7 @@ because fault-back precedes every dispatch.
 """
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -41,6 +45,8 @@ import numpy as np
 from metrics_tpu.durability.telemetry import (
     DURABILITY_STATS,
     observe_faultback,
+    pin_tenant_traffic,
+    unpin_tenant_traffic,
 )
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.registry import TELEMETRY
@@ -113,6 +119,15 @@ class TenantSpiller:
                 self._last_touch[:k] = np.where(np.isnan(last_seen[:k]), -np.inf, seen)
         self._spilled_bytes = 0
         self.telemetry_key = TELEMETRY.register(self)
+        # the eviction signal prefers the traffic ledger's staleness stamps,
+        # so hold the ledger open: a telemetry toggle must not freeze it
+        # (frozen stamps would evict hot tenants / keep cold ones resident)
+        self._traffic_unpin = None
+        if traffic is not None:
+            pin_tenant_traffic(metric)
+            self._traffic_unpin = weakref.finalize(
+                self, unpin_tenant_traffic, metric
+            )
         metric.__dict__["_durability_hooks"] = self
         DURABILITY_STATS.register_spiller(self)
 
@@ -160,6 +175,25 @@ class TenantSpiller:
             self._spilled_bytes -= sum(
                 r.nbytes for leaves in entry.values() for r in leaves.values()
             )
+
+    def on_restore(self) -> None:
+        """Restore invalidation — the checkpoint plane calls this under the
+        metric's serial lock right after installing a snapshot. Every
+        device row was just replaced, so all spilled host rows predate the
+        restore: faulting them back would silently corrupt the restored
+        tenants. Drop them and re-seed the activity set from the restored
+        traffic ledger (restored tenants are active and immediately
+        eviction-eligible — their stamps start at cold)."""
+        self._spilled.clear()
+        self._spilled_bytes = 0
+        self._last_touch.fill(-np.inf)
+        self._touched.fill(False)
+        traffic = getattr(self._metric, "_traffic", None)
+        if traffic is not None:
+            rows, _ = traffic.arrays()
+            if rows is not None:
+                k = min(len(self._touched), len(rows))
+                self._touched[:k] = rows[:k] > 0
 
     # ------------------------------------------------------------------
     # the spill mechanics
@@ -324,19 +358,27 @@ class TenantSpiller:
             return len(ids)
 
     def occupancy(self) -> Dict[str, int]:
-        """Point-in-time occupancy (the durability snapshot's gauge feed)."""
-        active = int(self._touched.sum())
-        spilled = len(self._spilled)
+        """Point-in-time occupancy (the durability snapshot's gauge feed).
+        ``resident_active`` is counted independently of ``spilled`` —
+        touched tenants whose ids are NOT in the spill table — so the
+        conservation law :meth:`report` checks is falsifiable: a stranded
+        or duplicated spill entry (a spilled tenant outside the active set)
+        breaks ``resident_active + spilled == active`` instead of hiding in
+        derived arithmetic."""
+        spilled_map = self._spilled
+        active_ids = np.nonzero(self._touched)[0]
+        resident_active = sum(1 for t in active_ids if int(t) not in spilled_map)
         return {
-            "active": active,
-            "spilled": spilled,
-            "resident_active": active - spilled,
+            "active": int(active_ids.size),
+            "spilled": len(spilled_map),
+            "resident_active": int(resident_active),
             "spilled_bytes": int(self._spilled_bytes),
         }
 
     def report(self) -> Dict[str, Any]:
         """Occupancy + the conservation check:
-        ``resident_active + spilled == active`` exactly."""
+        ``resident_active + spilled == active`` exactly (both sides counted
+        independently — see :meth:`occupancy`)."""
         occ = self.occupancy()
         return {
             **occ,
@@ -353,6 +395,8 @@ class TenantSpiller:
         self.fault_back()
         if self._metric.__dict__.get("_durability_hooks") is self:
             del self._metric.__dict__["_durability_hooks"]
+        if self._traffic_unpin is not None:
+            self._traffic_unpin()
 
     def __repr__(self) -> str:
         occ = self.occupancy()

@@ -40,8 +40,32 @@ __all__ = [
     "observe_faultback",
     "observe_restore",
     "observe_save",
+    "pin_tenant_traffic",
     "summary",
+    "unpin_tenant_traffic",
 ]
+
+def pin_tenant_traffic(metric: Any) -> None:
+    """Hold ``metric``'s per-tenant traffic ledger OPEN (refcounted): while
+    at least one pin is held, the keyed wrappers feed the ledger on every
+    update even with ``TELEMETRY`` disabled. A durability actor that reads
+    the ledger as ground truth — the checkpoint delta dirty set, the
+    spiller's staleness stamps — MUST pin it: a ledger frozen by a telemetry
+    toggle would silently drop touched tenants from the next delta save and
+    stale the eviction signal."""
+    d = metric.__dict__
+    d["_durability_traffic_pin"] = int(d.get("_durability_traffic_pin", 0)) + 1
+
+
+def unpin_tenant_traffic(metric: Any) -> None:
+    """Release one :func:`pin_tenant_traffic` hold."""
+    d = metric.__dict__
+    n = int(d.get("_durability_traffic_pin", 0)) - 1
+    if n > 0:
+        d["_durability_traffic_pin"] = n
+    else:
+        d.pop("_durability_traffic_pin", None)
+
 
 #: canonical fast-path histogram series of the durability plane
 SAVE_SECONDS = "durability_save_seconds"

@@ -660,13 +660,17 @@ class KeyedMetric(Metric):
             self._set_states(new_state)
             if hooks is not None:
                 hooks.after_update(np.asarray(ids))
+        if TELEMETRY.enabled or self.__dict__.get("_durability_traffic_pin"):
+            # a durability pin (checkpoint delta trail, cold-tenant spiller)
+            # keeps the ledger fed with telemetry off: frozen rows would
+            # silently drop tenants from the next delta's dirty set
+            self._note_tenant_traffic(ids)
         if start is not None:
             dur = time.perf_counter() - start
             key = self.telemetry_key
             if TELEMETRY.enabled:
                 TELEMETRY.inc(key, "keyed_update_rows", int(ids.shape[0]))
                 observe_dispatch(dur, "keyed_scatter")
-                self._note_tenant_traffic(ids)
                 _note_compiled_dispatch(
                     self, fn, (ids,) + args, kwargs, counter="keyed_update_dispatches"
                 )
@@ -691,7 +695,7 @@ class KeyedMetric(Metric):
         ids = jnp.asarray(tenant_ids)
         if self.validate_ids:
             self._validate_ids_eager(ids.reshape(-1))
-        if TELEMETRY.enabled:
+        if TELEMETRY.enabled or self.__dict__.get("_durability_traffic_pin"):
             self._note_tenant_traffic(ids)
         hooks = self.__dict__.get("_durability_hooks")
         with self._serial_lock():
@@ -972,7 +976,7 @@ class KeyedMetric(Metric):
             hooks.before_snapshot()
         state = super().__getstate__()
         for k in ("_keyed_update_fn", "_keyed_update_copy_fn", "_ingest_lock",
-                  "_durability_hooks"):
+                  "_durability_hooks", "_durability_traffic_pin"):
             state.pop(k, None)
         return state
 
@@ -1283,6 +1287,10 @@ class MultiTenantCollection:
             self._writeback(new_state)
             if hooks is not None:
                 hooks.after_update(np.asarray(ids))
+        if TELEMETRY.enabled or self.__dict__.get("_durability_traffic_pin"):
+            # durability pins keep the ledger fed with telemetry off (see
+            # KeyedMetric.update)
+            self._note_tenant_traffic(ids)
         if start is not None:
             dur = time.perf_counter() - start
             key = self.telemetry_key
@@ -1290,7 +1298,6 @@ class MultiTenantCollection:
                 TELEMETRY.inc(key, "update_calls")
                 TELEMETRY.inc(key, "keyed_update_rows", int(ids.shape[0]))
                 observe_dispatch(dur, "keyed_scatter")
-                self._note_tenant_traffic(ids)
                 skipped = sum(len(ns) - 1 for _, ns in self._layout)
                 if skipped:
                     TELEMETRY.inc(key, "update_dedup_skipped", skipped)
@@ -1369,11 +1376,12 @@ class MultiTenantCollection:
             self._writeback(new_state)
             if hooks is not None:
                 hooks.after_update(np.asarray(ids).reshape(-1))
+        if TELEMETRY.enabled or self.__dict__.get("_durability_traffic_pin"):
+            self._note_tenant_traffic(ids)
         if TELEMETRY.enabled:
             key = self.telemetry_key
             TELEMETRY.inc(key, "update_many_calls")
             TELEMETRY.inc(key, "update_many_batches", k)
-            self._note_tenant_traffic(ids)
             _note_compiled_dispatch(
                 self, fn, (ids,) + stacked, stacked_kwargs, counter="update_many_dispatches"
             )
@@ -1616,6 +1624,7 @@ class MultiTenantCollection:
                 "_donation_warned",
                 "_ingest_lock",
                 "_durability_hooks",
+                "_durability_traffic_pin",
             )
         }
 

@@ -308,3 +308,63 @@ def test_topology_flexible_restore_8way_to_4way_and_sharded(tmp_path):
     mgr.restore(sharded, transport=t)
     np.testing.assert_array_equal(np.asarray(sharded.tp), np.asarray(m.tp))
     assert t.max_shard_fraction(sharded.tp) == pytest.approx(1 / 8)
+
+
+def test_restore_invalidates_stale_spilled_rows(tmp_path):
+    """Regression: a TenantSpiller's host rows cut BEFORE a restore predate
+    the restored state — the restore must drop them (the save side faults
+    back; the restore side invalidates), or the next read's fault-back
+    scatters stale rows over the restored tenants."""
+    from metrics_tpu.durability import TenantSpiller
+
+    rng = np.random.RandomState(21)
+    m = _keyed(rng)
+    mgr = CheckpointManager(tmp_path, m)
+    mgr.save()
+    want = {
+        leaf: np.asarray(getattr(m, leaf)).copy()
+        for leaf in ("tp", "fp", "tn", "fn")
+    }
+
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    # diverge from the snapshot, then spill: the host rows are now NEWER
+    # than the snapshot but OLDER than the restore about to happen
+    m.update(*_batch(rng))
+    assert sp.maybe_evict() > 0
+    assert sp.occupancy()["spilled"] > 0
+
+    mgr.restore()
+    assert sp.occupancy()["spilled"] == 0
+    m.compute()  # the read barrier faults back anything still spilled
+    for leaf, arr in want.items():
+        np.testing.assert_array_equal(np.asarray(getattr(m, leaf)), arr)
+    assert sp.report()["conservation_ok"]
+
+
+def test_delta_dirty_set_survives_telemetry_toggle(tmp_path):
+    """Regression: disabling telemetry between two saves must not freeze
+    the rows-based dirty set — the manager pins the traffic ledger open, so
+    tenants touched while telemetry is off still land in the next delta."""
+    from metrics_tpu.observability.registry import TELEMETRY
+
+    rng = np.random.RandomState(22)
+    m = _keyed(rng)  # telemetry on: the ledger is populated
+    mgr = CheckpointManager(tmp_path, m)
+    mgr.save()
+    touched = [1, 8]
+    try:
+        TELEMETRY.disable()
+        ids = jnp.asarray(np.array(touched, np.int32))
+        m.update(ids, *_batch(rng, rows=2)[1:])
+        manifest = mgr.save()
+    finally:
+        TELEMETRY.enable()
+    assert manifest["kind"] == "delta"
+    assert manifest["tenants"] == touched
+
+    fresh = _keyed()
+    mgr.restore(fresh)
+    for leaf in ("tp", "fp", "tn", "fn"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fresh, leaf)), np.asarray(getattr(m, leaf))
+        )

@@ -192,3 +192,39 @@ def test_spill_telemetry_counters_and_snapshot():
     text = observability.render_prometheus()
     assert "metrics_tpu_durability_evictions_total" in text
     assert "metrics_tpu_durability_spilled_tenants" in text
+
+
+def test_conservation_check_detects_stranded_spill_entry():
+    """The conservation law must be falsifiable: resident_active is counted
+    independently of the spill table, so a spilled tenant outside the
+    active set (a stranded/duplicated entry) breaks the invariant instead
+    of cancelling out of derived arithmetic."""
+    m, _ = _pair(rng_seed=11)
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    assert sp.maybe_evict() > 0
+    assert sp.report()["conservation_ok"]
+    t = next(iter(sp._spilled))
+    sp._touched[t] = False  # strand the entry
+    assert not sp.report()["conservation_ok"]
+    sp._touched[t] = True
+    assert sp.report()["conservation_ok"]
+
+
+def test_spiller_pins_traffic_ledger_and_detach_releases():
+    """The eviction signal reads the traffic ledger, so the spiller holds
+    it open: updates keep feeding it even with telemetry disabled, and
+    detach() releases the pin."""
+    from metrics_tpu.observability.registry import TELEMETRY
+
+    m, _ = _pair(rng_seed=12)
+    sp = TenantSpiller(m, resident_cap=4, auto=False)
+    assert m.__dict__.get("_durability_traffic_pin") == 1
+    rows0 = int(m._traffic.arrays()[0].sum())
+    try:
+        TELEMETRY.disable()
+        m.update(*_batch(np.random.RandomState(13), 32, 16))
+    finally:
+        TELEMETRY.enable()
+    assert int(m._traffic.arrays()[0].sum()) == rows0 + 32
+    sp.detach()
+    assert "_durability_traffic_pin" not in m.__dict__
