@@ -1,8 +1,8 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check soak checkpoint-smoke
+.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check soak checkpoint-smoke chaos-smoke
 
-ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check checkpoint-smoke
+ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check checkpoint-smoke chaos-smoke
 
 # Full suite on the virtual 8-device CPU mesh (tests/conftest.py), including
 # the real 2-process jax.distributed sync test (tests/bases/test_multiprocess.py).
@@ -77,6 +77,18 @@ checkpoint-smoke:
 # runs a short variant via bench_suite.py --config bench_serving_soak.
 soak:
 	JAX_PLATFORMS=cpu python scripts/soak.py --out SOAK.json
+
+# Chaos soak smoke (scripts/soak.py --chaos): the resilience plane's
+# end-to-end acceptance on a short seeded schedule — a killed peer, a
+# dropped payload round, a hung channel get, injected dispatch errors,
+# poisoned rows, and a mid-save checkpoint crash, with serving ingest +
+# auto-saved checkpoints + background reads running simultaneously. Exits 1
+# unless submitted − shed == dispatched == rows_routed EXACTLY, the last
+# checkpoint restores bit-identical, no poison leaked, failover MTTR was
+# measured, and nothing deadlocked.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python scripts/soak.py --chaos --tenants 256 \
+	  --duration-s 4 --qps 4000 --max-batch 256
 
 # Convert a torchvision Inception3 checkpoint into the .npz the Flax
 # extractor loads: make export-weights CKPT=inception_v3.pth OUT=weights.npz

@@ -74,6 +74,16 @@ from metrics_tpu import serving  # noqa: F401 E402
 from metrics_tpu.serving import AdmissionQueue, SLOScheduler  # noqa: F401 E402
 from metrics_tpu import durability  # noqa: F401 E402
 from metrics_tpu.durability import CheckpointManager, TenantSpiller  # noqa: F401 E402
+from metrics_tpu import resilience  # noqa: F401 E402
+from metrics_tpu.resilience import (  # noqa: F401 E402
+    CircuitBreaker,
+    DeadlineBudget,
+    FailureDetector,
+    FaultPlan,
+    FaultSpec,
+    Membership,
+    RetryPolicy,
+)
 
 __all__ = [
     "AUC",
@@ -88,12 +98,17 @@ __all__ = [
     "BootStrapper",
     "BufferOverflowError",
     "CheckpointManager",
+    "CircuitBreaker",
     "CohenKappa",
     "CompositionalMetric",
     "ConfusionMatrix",
     "CosineSimilarity",
+    "DeadlineBudget",
     "ExplainedVariance",
     "F1",
+    "FailureDetector",
+    "FaultPlan",
+    "FaultSpec",
     "FBeta",
     "FID",
     "HammingDistance",
@@ -109,6 +124,7 @@ __all__ = [
     "MeanAbsolutePercentageError",
     "MeanSquaredError",
     "MeanSquaredLogError",
+    "Membership",
     "Metric",
     "MetricCollection",
     "MultiTenantCollection",
@@ -126,6 +142,7 @@ __all__ = [
     "RetrievalNormalizedDCG",
     "RetrievalPrecision",
     "RetrievalRecall",
+    "RetryPolicy",
     "SI_SDR",
     "SI_SNR",
     "SLOScheduler",

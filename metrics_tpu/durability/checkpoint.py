@@ -121,6 +121,19 @@ CRASH_POINTS = (
 def _maybe_crash(point: str) -> None:
     if point in _CRASH_POINTS:
         raise CheckpointCrash(f"injected crash at {point!r}")
+    # the unified resilience seams subsume the legacy hook: a FaultPlan spec
+    # armed at ``checkpoint.<point>`` (any raising mode — crash/error/drop)
+    # kills the save exactly where inject_crash would, translated to the
+    # protocol's native CheckpointCrash so every crash-consistency test and
+    # the chaos soak share one vocabulary (metrics_tpu/resilience/faults.py)
+    try:
+        from metrics_tpu.resilience.faults import FaultInjected, maybe_fault
+    except Exception:  # pragma: no cover - resilience plane optional
+        return
+    try:
+        maybe_fault(f"checkpoint.{point}")
+    except FaultInjected as err:
+        raise CheckpointCrash(f"injected crash at {point!r} ({err})") from err
 
 
 @contextmanager
@@ -501,6 +514,17 @@ class CheckpointManager:
                 "num_tenants": existing[-1].get("num_tenants"),
             }
         self.telemetry_key = TELEMETRY.register(self)
+        #: wall clock of the last COMPLETED save (the auto-save interval
+        #: trigger's reference point; starts at construction so an idle
+        #: manager's first auto save still waits one full interval)
+        self._last_save_at = time.monotonic()
+        # background auto-save state (enable_auto_save)
+        self._auto_stop: Optional[threading.Event] = None
+        self._auto_thread: Optional[threading.Thread] = None
+        self._auto_future: Optional[Any] = None
+        self._auto_failures = 0
+        self._auto_saves = 0
+        self._auto_skipped_inflight = 0
         # rows marks read the traffic ledger as ground truth, so hold it
         # open for the manager's lifetime: with the ledger fed only behind
         # TELEMETRY.enabled, a telemetry toggle between two saves would
@@ -610,6 +634,152 @@ class CheckpointManager:
             lambda: self._write(refs, marks, meta, delta=delta),
         )
 
+    # -- background auto-save policy ----------------------------------------
+
+    def dirty_count(self) -> Optional[int]:
+        """Tenants whose write marks moved since the last completed save
+        (``None`` when unknowable: no marks source, no prior save, or
+        incomparable marks — the cases a save resolves as a full)."""
+        cur = self._current_marks()
+        if cur is None:
+            return None
+        with self._lock:
+            prev = self._last_marks
+        if prev is None:
+            # no marks baseline (first save predated any traffic): every
+            # tenant with ANY write mark is dirty relative to that save
+            if cur[0] == "rows":
+                return int(np.count_nonzero(cur[1]))
+            return int(len(cur[1]))
+        dirty = self._dirty_tenants(prev, cur)
+        return None if dirty is None else int(len(dirty))
+
+    def enable_auto_save(
+        self,
+        *,
+        interval_s: Optional[float] = None,
+        dirty_threshold: Optional[int] = None,
+        delta: Optional[bool] = None,
+        retry_policy: Optional[Any] = None,
+        tick_s: Optional[float] = None,
+    ) -> None:
+        """Arm the background auto-save policy: a daemon thread triggers
+        :meth:`save_async` on the durability lane whenever
+
+        * ``interval_s`` elapsed since the last completed save, OR
+        * at least ``dirty_threshold`` tenants' write marks moved since the
+          last completed save (the delta dirty set — so the trigger scales
+          with actual write pressure, not wall time)
+
+        (either trigger alone is allowed; at least one is required). At
+        most ONE auto save is in flight at a time — a tick that finds the
+        previous save still writing skips (counted); a tick after a FAILED
+        save backs off through ``retry_policy`` (default: the unified
+        ``checkpoint`` plane policy,
+        :func:`metrics_tpu.resilience.policies.retry_policy_for`) — a
+        crashed save never advances the marks, so the retry re-covers its
+        dirty set by construction. Idempotent: re-enabling reconfigures."""
+        if interval_s is None and dirty_threshold is None:
+            raise ValueError("enable_auto_save needs interval_s and/or dirty_threshold")
+        if interval_s is not None and float(interval_s) <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        if dirty_threshold is not None and int(dirty_threshold) < 1:
+            raise ValueError(f"dirty_threshold must be >= 1, got {dirty_threshold}")
+        from metrics_tpu.resilience.policies import retry_policy_for
+
+        self.disable_auto_save()
+        retry = retry_policy if retry_policy is not None else retry_policy_for("checkpoint")
+        if tick_s is None:
+            candidates = [0.25]
+            if interval_s is not None:
+                candidates.append(float(interval_s) / 4.0)
+            tick_s = max(0.005, min(candidates))
+        stop = threading.Event()
+        self._auto_stop = stop
+        self._auto_config = {
+            "interval_s": None if interval_s is None else float(interval_s),
+            "dirty_threshold": None if dirty_threshold is None else int(dirty_threshold),
+            "delta": delta,
+            "tick_s": float(tick_s),
+        }
+
+        def loop() -> None:
+            backoff_until = 0.0
+            while not stop.wait(tick_s):
+                try:
+                    # settle the previous save first: its outcome gates the
+                    # single-flight and failure-backoff rules
+                    future = self._auto_future
+                    if future is not None:
+                        if not future.done():
+                            if self._auto_due():
+                                self._auto_skipped_inflight += 1
+                            continue
+                        self._auto_future = None
+                        if future.exception(timeout=0) is None:
+                            self._auto_failures = 0
+                        else:
+                            # save_errors already counted by _write; the
+                            # unified policy spaces the re-attempts
+                            self._auto_failures += 1
+                            backoff_until = time.monotonic() + retry.backoff(
+                                self._auto_failures
+                            )
+                    if time.monotonic() < backoff_until or not self._auto_due():
+                        continue
+                    self._auto_saves += 1
+                    DURABILITY_STATS.inc("auto_saves")
+                    self._auto_future = self.save_async(delta=delta)
+                except Exception:  # pragma: no cover - the policy must survive
+                    self._auto_failures += 1
+                    backoff_until = time.monotonic() + retry.backoff(self._auto_failures)
+
+        self._auto_thread = threading.Thread(
+            target=loop, name="metrics-tpu-auto-save", daemon=True
+        )
+        self._auto_thread.start()
+
+    def _auto_due(self) -> bool:
+        cfg = getattr(self, "_auto_config", None)
+        if cfg is None:
+            return False
+        if cfg["interval_s"] is not None and (
+            time.monotonic() - self._last_save_at >= cfg["interval_s"]
+        ):
+            return True
+        if cfg["dirty_threshold"] is not None:
+            dirty = self.dirty_count()
+            # unknowable marks ask for a (full) save only when traffic is
+            # possible at all — a plain metric with no ledger would
+            # otherwise save every tick
+            if dirty is not None and dirty >= cfg["dirty_threshold"]:
+                return True
+        return False
+
+    def disable_auto_save(self, timeout: Optional[float] = 2.0) -> None:
+        """Stop the auto-save thread (waits for it; an in-flight save
+        finishes on the durability lane regardless). Idempotent."""
+        stop, thread = self._auto_stop, self._auto_thread
+        self._auto_stop = None
+        self._auto_thread = None
+        if stop is not None:
+            stop.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout)
+
+    def auto_save_report(self) -> Dict[str, Any]:
+        """The auto-save policy's state: config, saves triggered, ticks
+        skipped on an in-flight save, consecutive failures."""
+        cfg = getattr(self, "_auto_config", None)
+        return {
+            "enabled": bool(self._auto_thread is not None and self._auto_thread.is_alive()),
+            "config": dict(cfg) if cfg else None,
+            "auto_saves": self._auto_saves,
+            "skipped_in_flight": self._auto_skipped_inflight,
+            "consecutive_failures": self._auto_failures,
+            "dirty_count": self.dirty_count(),
+        }
+
     def _write(
         self,
         refs: Dict[str, Any],
@@ -700,6 +870,7 @@ class CheckpointManager:
                 "name": manifest["name"],
                 "num_tenants": meta.get("num_tenants"),
             }
+            self._last_save_at = time.monotonic()
             if kind == "full" and self.history is not None:
                 self._prune(keep=self.history)
 

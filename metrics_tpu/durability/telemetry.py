@@ -102,6 +102,7 @@ class DurabilityStats:
         self._counters: Dict[str, int] = {
             "saves": 0,
             "delta_saves": 0,
+            "auto_saves": 0,
             "save_errors": 0,
             "restores": 0,
             "restore_errors": 0,

@@ -137,6 +137,9 @@ def reset() -> None:
     durability_mod = _sys.modules.get("metrics_tpu.durability.telemetry")
     if durability_mod is not None:
         durability_mod.DURABILITY_STATS.reset()
+    resilience_mod = _sys.modules.get("metrics_tpu.resilience.telemetry")
+    if resilience_mod is not None:
+        resilience_mod.RESILIENCE_STATS.reset()
 
 
 __all__ = [

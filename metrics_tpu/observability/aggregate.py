@@ -98,6 +98,10 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     # totals), the high-water mark maxes
     ("durability.spilled_high_water", "max"),
     ("durability.*", "sum"),
+    # resilience plane: counters sum; the membership epoch is a version —
+    # the fleet view is the newest epoch any process has seen
+    ("resilience.epoch", "max"),
+    ("resilience.*", "sum"),
     # fast-path histograms (percentiles recomputed after the bucket merge)
     ("histograms.*.buckets.*", "sum"),
     ("histograms.*.count", "sum"),

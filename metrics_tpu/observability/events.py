@@ -39,10 +39,13 @@ from typing import Any, Dict, Iterator, List, NamedTuple, Optional
 #: straggler report flagging a persistently-slow process
 #: (:mod:`~metrics_tpu.observability.tracing`); ``serving`` marks the
 #: service plane's activity — admission-queue flushes/shed decisions and
-#: scheduler cache refreshes (:mod:`metrics_tpu.serving`)
+#: scheduler cache refreshes (:mod:`metrics_tpu.serving`); ``durability``
+#: marks checkpoint/spill/elastic activity (:mod:`metrics_tpu.durability`);
+#: ``resilience`` marks injected faults and membership epoch transitions
+#: (:mod:`metrics_tpu.resilience`)
 EVENT_KINDS = (
     "update", "forward", "compute", "sync", "retrace", "health", "compile",
-    "tenant_report", "straggler", "serving",
+    "tenant_report", "straggler", "serving", "durability", "resilience",
 )
 
 #: default bound on retained events; ~100 bytes each, so the default log

@@ -116,6 +116,18 @@ _HELP: Dict[str, str] = {
     "durability_save_seconds": "One checkpoint snapshot write's wall time.",
     "durability_restore_seconds": "One checkpoint chain restore's wall time.",
     "durability_faultback_seconds": "One spill fault-back cohort's wall time.",
+    "durability_auto_saves_total": "Background auto-save policy triggers (interval/dirty-threshold).",
+    "resilience_faults_injected_total": "Faults fired by the installed FaultPlan (all seams).",
+    "resilience_faults_by_seam_total": "Injected faults split by (seam, mode).",
+    "resilience_detector_suspects_total": "Peers the phi-accrual detector promoted to failed.",
+    "resilience_peer_failures_total": "Membership transitions marking a peer failed.",
+    "resilience_peer_rejoins_total": "Membership transitions re-admitting a recovered peer.",
+    "resilience_epoch_transitions_total": "Membership epoch bumps (failures + rejoins).",
+    "resilience_policy_retries_total": "Backoff sleeps taken through the unified RetryPolicy.",
+    "resilience_deadline_exhausted_total": "DeadlineBudget expiries surfaced to callers.",
+    "resilience_breaker_opens_total": "Circuit breakers tripped open by consecutive failures.",
+    "resilience_breaker_short_circuits_total": "Calls refused by an open circuit breaker.",
+    "resilience_membership_epoch": "Current membership epoch (fleet view takes the max).",
 }
 
 
@@ -190,6 +202,10 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
     # until metrics_tpu.durability is imported AND touched
     durability_mod = _sys.modules.get("metrics_tpu.durability.telemetry")
     snap["durability"] = durability_mod.summary() if durability_mod is not None else {}
+    # and for the resilience plane (fault injection / detector / membership
+    # epoch / policy decisions): {} until first touched
+    resilience_mod = _sys.modules.get("metrics_tpu.resilience.telemetry")
+    snap["resilience"] = resilience_mod.summary() if resilience_mod is not None else {}
     return snap
 
 
@@ -411,6 +427,7 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
         for field in (
             "saves",
             "delta_saves",
+            "auto_saves",
             "save_errors",
             "restores",
             "restore_errors",
@@ -433,6 +450,34 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
         ):
             if gauge in durability:
                 out.emit(f"durability_{gauge}", base, durability[gauge])
+
+    resilience = snap.get("resilience", {})
+    if resilience:
+        # the resilience plane's family: fault/detector/policy outcomes are
+        # counters, the membership epoch is a gauge (fleet view maxes it)
+        for field in (
+            "faults_injected",
+            "detector_suspects",
+            "peer_failures",
+            "peer_rejoins",
+            "epoch_transitions",
+            "policy_retries",
+            "deadline_exhausted",
+            "breaker_opens",
+            "breaker_short_circuits",
+        ):
+            if field in resilience:
+                out.emit(f"resilience_{field}_total", base, resilience[field], "counter")
+        if "epoch" in resilience:
+            out.emit("resilience_membership_epoch", base, resilience["epoch"])
+        for key, n in sorted(resilience.get("faults_by_seam", {}).items()):
+            seam, _, mode = key.rpartition(":")
+            out.emit(
+                "resilience_faults_by_seam_total",
+                {**base, "seam": seam, "mode": mode},
+                n,
+                "counter",
+            )
 
     kernels = snap.get("kernels", {})
     for op, paths in sorted(kernels.get("dispatch", {}).items()):

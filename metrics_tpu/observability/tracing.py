@@ -587,6 +587,17 @@ def straggler_report(
                     lag_p95_s=entry["lag_p95_s"],
                     collectives=collectives,
                 )
+        if flagged:
+            # feed the resilience plane's failure detector: a published
+            # straggler verdict is one strike of evidence toward demoting
+            # the peer out of the membership epoch (guarded — the detector
+            # must never break a report)
+            try:
+                from metrics_tpu.resilience.detector import note_straggler_report
+
+                note_straggler_report(flagged)
+            except Exception:  # pragma: no cover - resilience plane optional
+                pass
     return report
 
 

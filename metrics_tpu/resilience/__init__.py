@@ -1,0 +1,123 @@
+"""The resilience plane: cross-cutting fault injection, detection, and policy.
+
+Every other plane grew its own defenses (PR-9 retry/stale/quorum, PR-11
+true subgroups, PR-12 backpressure, PR-14 crash-safe checkpoints); this
+package is the layer that makes them COMPOSE and makes their composition
+testable:
+
+* :mod:`~metrics_tpu.resilience.faults` — one seeded, deterministic
+  :class:`FaultPlan` (delay / drop / error / corrupt / crash at named
+  seams) consulted by the gather transport rounds, the subgroup channel,
+  the async-engine worker, the admission-queue dispatch, and every
+  checkpoint protocol step — the API the unit tests and the chaos soak
+  (``scripts/soak.py --chaos``) share.
+* :mod:`~metrics_tpu.resilience.detector` /
+  :mod:`~metrics_tpu.resilience.membership` — a phi-accrual failure
+  detector fed by the PR-8 straggler signals and gather-round outcomes,
+  promoting peer health from a per-attempt hint into a **versioned
+  membership epoch** consumed by transport subgroups, async-engine quorum
+  and the serving scheduler; every transition (failure AND explicit
+  rejoin) bumps the epoch and is recorded.
+* :mod:`~metrics_tpu.resilience.policies` — the unified
+  :class:`RetryPolicy` / :class:`DeadlineBudget` / :class:`CircuitBreaker`
+  vocabulary replacing the per-plane hand-rolled backoff loops, with
+  per-plane overrides.
+* :mod:`~metrics_tpu.resilience.telemetry` — the ``resilience.*`` family
+  (snapshot section, merge rules, ``metrics_tpu_resilience_*`` Prometheus,
+  timeline events).
+
+Everything is host-side: with no plan installed and the detector idle the
+plane adds zero traced ops (pinned by ``scripts/check_zero_overhead.py``'s
+resilience-off sweep) and one attribute read per seam.
+
+See ``docs/resilience.md`` for the seam table, the policy vocabulary, the
+epoch semantics, and the chaos-soak invariants.
+"""
+from metrics_tpu.resilience.detector import (  # noqa: F401
+    DETECTOR,
+    FailureDetector,
+    note_round_outcome,
+    note_straggler_report,
+)
+from metrics_tpu.resilience.faults import (  # noqa: F401
+    MODES,
+    SEAMS,
+    CrashFault,
+    DroppedFault,
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+    current_fault_plan,
+    fault_plan,
+    install_fault_plan,
+    maybe_fault,
+)
+from metrics_tpu.resilience.membership import (  # noqa: F401
+    MEMBERSHIP,
+    Membership,
+    MembershipView,
+    alive_processes,
+    current_epoch,
+    current_view,
+    dead_processes,
+)
+from metrics_tpu.resilience.policies import (  # noqa: F401
+    PLANE_POLICIES,
+    CircuitBreaker,
+    DeadlineBudget,
+    DeadlineExhausted,
+    RetryPolicy,
+    retry_policy_for,
+    set_retry_policy,
+)
+from metrics_tpu.resilience.telemetry import (  # noqa: F401
+    RESILIENCE_STATS,
+    ResilienceStats,
+    summary,
+)
+
+__all__ = [
+    "DETECTOR",
+    "MEMBERSHIP",
+    "MODES",
+    "PLANE_POLICIES",
+    "RESILIENCE_STATS",
+    "SEAMS",
+    "CircuitBreaker",
+    "CrashFault",
+    "DeadlineBudget",
+    "DeadlineExhausted",
+    "DroppedFault",
+    "FailureDetector",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
+    "Membership",
+    "MembershipView",
+    "ResilienceStats",
+    "RetryPolicy",
+    "alive_processes",
+    "current_epoch",
+    "current_fault_plan",
+    "current_view",
+    "dead_processes",
+    "fault_plan",
+    "install_fault_plan",
+    "maybe_fault",
+    "note_round_outcome",
+    "note_straggler_report",
+    "retry_policy_for",
+    "set_retry_policy",
+    "summary",
+]
+
+
+def reset() -> None:
+    """Reset the whole plane for tests: uninstall any fault plan, clear the
+    detector's evidence, return the membership to epoch 0 and zero the
+    counters. Like any cross-process state: on every process together or
+    on none."""
+    install_fault_plan(None)
+    DETECTOR.reset()
+    MEMBERSHIP.reset()
+    RESILIENCE_STATS.reset()

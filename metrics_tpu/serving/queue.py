@@ -31,6 +31,7 @@ running (``scripts/check_zero_overhead.py``).
 """
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -52,6 +53,20 @@ __all__ = ["AdmissionQueue", "QueueClosedError"]
 DEFAULT_MAX_BATCH = 4096
 #: default flush deadline: a row waits at most this long before dispatch
 DEFAULT_MAX_DELAY_MS = 5.0
+#: retained poisoned rows (the dead-letter sample an operator inspects);
+#: the COUNT is exact regardless — it rides the shed ledger
+DEAD_LETTER_CAP = 32
+
+
+def _consult_fault_seam(seam: str, **ctx: Any) -> Any:
+    """Consult the resilience fault plan (import-guarded only — a raise
+    from the plan IS the injected dispatch failure, absorbed by the exact
+    shed accounting below)."""
+    try:
+        from metrics_tpu.resilience.faults import maybe_fault
+    except Exception:  # pragma: no cover - resilience plane optional
+        return None
+    return maybe_fault(seam, **ctx)
 
 
 class QueueClosedError(RuntimeError):
@@ -91,6 +106,23 @@ class AdmissionQueue:
             :class:`~metrics_tpu.wrappers.KeyedMetric` with
             ``validate_ids=False`` (the discard-bucket path; dropped
             padding rows are counted under ``invalid_tenant_ids``).
+        quarantine: poisoned-row quarantine mode. A single NaN/Inf event
+            row poisons every float "sum" state its flush touches — one bad
+            producer corrupts a whole cohort's tenants. ``"auto"`` (default)
+            quarantines whenever the PR-2 health policy is armed
+            (``observability.set_health_policy`` != ``"off"`` — the policy
+            that already declares NaN/Inf an error); ``"on"``/``"off"``
+            force it. Quarantined rows are SHED with the exact reason
+            ``"poisoned"`` (the conservation laws extend to it), counted as
+            dead letters, and a bounded sample is retained for inspection
+            (:meth:`dead_letters`); the rest of the cohort dispatches
+            clean.
+        breaker: optional
+            :class:`~metrics_tpu.resilience.policies.CircuitBreaker`
+            fronting the dispatch: while open, cohorts shed immediately
+            under the exact reason ``"breaker_open"`` instead of burning a
+            doomed dispatch per flush; a half-open probe dispatch closes it
+            again on success.
         start: start the flusher thread immediately (tests pass ``False``
             to drive flushes by hand).
     """
@@ -106,10 +138,18 @@ class AdmissionQueue:
         block_timeout_s: Optional[float] = None,
         tenant_quota_rows: Optional[int] = None,
         pad_to_bucket: bool = False,
+        quarantine: str = "auto",
+        breaker: Optional[Any] = None,
         start: bool = True,
     ) -> None:
         if not callable(target):
             raise TypeError(f"target must be callable, got {target!r}")
+        if quarantine not in ("auto", "on", "off"):
+            raise ValueError(
+                f"quarantine must be 'auto', 'on' or 'off', got {quarantine!r}"
+            )
+        self.quarantine = quarantine
+        self.breaker = breaker
         if int(max_batch) < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if float(max_delay_ms) <= 0:
@@ -164,6 +204,9 @@ class AdmissionQueue:
         self._shed_by_reason: Dict[str, int] = {}
         self._dispatched = 0
         self._flushes = 0
+        #: bounded sample of quarantined rows (tenant, args); the exact
+        #: dead-letter COUNT rides shed_by_reason["poisoned"]
+        self._dead_letters: deque = deque(maxlen=DEAD_LETTER_CAP)
         self.telemetry_key = TELEMETRY.register(self)
         SERVING_STATS.register_queue(self)
         if start:
@@ -339,27 +382,59 @@ class AdmissionQueue:
                         self._per_tenant.pop(tenant, None)
                 self._in_dispatch += 1
                 self._cv.notify_all()  # room freed: wake blocked producers
+            popped = len(rows)
             try:
                 t0 = time.perf_counter()
                 ids = np.asarray([r[0] for r in rows], dtype=np.int32)
                 ncols = len(rows[0][1])
                 cols = [np.stack([r[1][j] for r in rows]) for j in range(ncols)]
-                if self.pad_to_bucket and len(rows) < self.max_batch:
-                    bucket = min(1 << max(0, len(rows) - 1).bit_length(), self.max_batch)
-                    pad = bucket - len(rows)
-                    if pad > 0:
-                        ids = np.concatenate([ids, np.full(pad, -1, ids.dtype)])
-                        cols = [
-                            np.concatenate(
-                                [c, np.zeros((pad,) + c.shape[1:], c.dtype)]
-                            )
-                            for c in cols
-                        ]
+                # poisoned-row quarantine: one NaN/Inf event row would
+                # corrupt every float "sum" state the whole flush touches —
+                # quarantined rows are shed under the EXACT reason
+                # "poisoned" (a dead-letter, sampled for inspection) and
+                # the rest of the cohort dispatches clean
+                if self._quarantine_active():
+                    mask = np.zeros(popped, dtype=bool)
+                    for c in cols:
+                        if np.issubdtype(c.dtype, np.floating):
+                            mask |= ~np.isfinite(c).reshape(popped, -1).all(axis=1)
+                    if mask.any():
+                        keep = np.nonzero(~mask)[0]
+                        self._shed_rows(
+                            "poisoned",
+                            [rows[i] for i in np.nonzero(mask)[0]],
+                            dead_letter=True,
+                        )
+                        rows = [rows[i] for i in keep]
+                        ids = ids[~mask]
+                        cols = [c[~mask] for c in cols]
+                # circuit breaker: while open, a doomed dispatch is not
+                # even attempted — the cohort sheds under "breaker_open"
+                if rows and self.breaker is not None and not self.breaker.allow():
+                    self._shed_rows("breaker_open", rows)
+                    rows = []
                 error: Optional[BaseException] = None
-                try:
-                    self._target(ids, *cols)
-                except Exception as err:  # noqa: BLE001 - accounted below
-                    error = err
+                if rows:
+                    if self.pad_to_bucket and len(rows) < self.max_batch:
+                        bucket = min(1 << max(0, len(rows) - 1).bit_length(), self.max_batch)
+                        pad = bucket - len(rows)
+                        if pad > 0:
+                            ids = np.concatenate([ids, np.full(pad, -1, ids.dtype)])
+                            cols = [
+                                np.concatenate(
+                                    [c, np.zeros((pad,) + c.shape[1:], c.dtype)]
+                                )
+                                for c in cols
+                            ]
+                    try:
+                        _consult_fault_seam("serving.dispatch", rows=len(rows))
+                        self._target(ids, *cols)
+                        if self.breaker is not None:
+                            self.breaker.record_success()
+                    except Exception as err:  # noqa: BLE001 - accounted below
+                        error = err
+                        if self.breaker is not None:
+                            self.breaker.record_failure()
                 dur = time.perf_counter() - t0
                 end = time.perf_counter()
                 self._note_flush(trigger, rows, depth_before, dur, end, error)
@@ -367,7 +442,57 @@ class AdmissionQueue:
                 with self._cv:
                     self._in_dispatch -= 1
                     self._cv.notify_all()
-        return len(rows)
+        return popped
+
+    def _quarantine_active(self) -> bool:
+        """Quarantine is armed explicitly (``"on"``) or — the ``"auto"``
+        default — whenever the PR-2 health policy declares NaN/Inf an
+        anomaly (``set_health_policy`` != ``"off"``): the same switch that
+        arms the on-device guard arms the ingest-side quarantine."""
+        if self.quarantine == "on":
+            return True
+        if self.quarantine == "off":
+            return False
+        try:
+            from metrics_tpu.observability.health import get_health_policy
+
+            return get_health_policy() != "off"
+        except Exception:  # pragma: no cover - health plane optional
+            return False
+
+    def _shed_rows(
+        self,
+        reason: str,
+        rows: List[Tuple[int, Tuple, float]],
+        *,
+        dead_letter: bool = False,
+    ) -> None:
+        """Shed already-admitted rows at dispatch time under an exact
+        ``reason`` (quarantine, open breaker) — the conservation laws keep
+        holding because every such row moves from resident to shed."""
+        n = len(rows)
+        if n == 0:
+            return
+        with self._cv:
+            self._shed += n
+            self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + n
+            if dead_letter:
+                self._dead_letters.extend((r[0], r[1]) for r in rows)
+        SERVING_STATS.shed(reason, n)
+        if TELEMETRY.enabled:
+            TELEMETRY.inc(self.telemetry_key, f"shed_{reason}", n)
+        if EVENTS.enabled:
+            EVENTS.record(
+                "serving", self.telemetry_key, path="shed", policy=self.policy.name,
+                **{f"shed_{reason}": n},
+            )
+
+    def dead_letters(self) -> List[Tuple[int, Tuple]]:
+        """The retained sample of quarantined ``(tenant_id, args)`` rows
+        (newest last, bounded at ``DEAD_LETTER_CAP``); the exact total is
+        ``stats()["shed_by_reason"]["poisoned"]``."""
+        with self._cv:
+            return list(self._dead_letters)
 
     def _note_flush(
         self,
@@ -483,7 +608,9 @@ class AdmissionQueue:
         zero-lost-updates invariant's left-hand side:
 
         * ``admitted == dispatched + resident + shed(shed_oldest) +
-          shed(dispatch_error)`` (rows shed AFTER admission);
+          shed(dispatch_error) + shed(poisoned) + shed(breaker_open)``
+          (rows shed AFTER admission — the quarantine and the open
+          breaker shed exactly like a failed dispatch does);
         * ``submitted − shed(total) == dispatched + resident`` — so at
           drain, submitted − shed equals exactly what the keyed state
           ingested (``tenant_report()["rows_routed"]``)."""
@@ -500,6 +627,7 @@ class AdmissionQueue:
                 "dispatched": self._dispatched,
                 "flushes": self._flushes,
                 "resident": len(self._pending),
+                "dead_letter_rows": self._shed_by_reason.get("poisoned", 0),
                 "closed": self._closed,
                 "last_error": (
                     f"{type(self._last_error).__name__}: {self._last_error}"

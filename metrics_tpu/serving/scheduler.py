@@ -55,6 +55,17 @@ __all__ = ["SLOScheduler"]
 
 #: default read staleness budget (seconds): a cached per-tenant value this
 #: young is served without touching the state
+def _membership_epoch() -> int:
+    """The resilience plane's current membership epoch (0 while idle or
+    absent) — the scheduler's fleet-level cache-invalidation edge."""
+    try:
+        from metrics_tpu.resilience.membership import current_epoch
+
+        return current_epoch()
+    except Exception:  # pragma: no cover - resilience plane optional
+        return 0
+
+
 DEFAULT_MAX_STALENESS_S = 1.0
 #: default bound on a blocking (cache-miss) read
 DEFAULT_READ_TIMEOUT_S = 30.0
@@ -212,8 +223,16 @@ class SLOScheduler:
         budget = self.max_staleness_s if max_staleness_s is None else float(max_staleness_s)
         now = time.monotonic()
         ids = None if tenant_ids is None else np.asarray(tenant_ids).reshape(-1)
+        # the membership epoch is a cache-invalidation edge like a write
+        # generation: a value computed under an older epoch's peer set (a
+        # since-failed peer contributing, a rejoined peer missing) must not
+        # be served as current — it expires outright and the next read
+        # refreshes under the new epoch
+        epoch = _membership_epoch()
         with self._lock:
             cache = self._cache
+            if cache is not None and cache.get("epoch", 0) != epoch:
+                cache = None
             generation = self._generation
             tenant_scoped_fresh = (
                 cache is not None
@@ -327,6 +346,7 @@ class SLOScheduler:
                     "generation": generation,
                     "values": values,
                     "at": time.monotonic(),
+                    "epoch": _membership_epoch(),
                 }
 
     # ------------------------------------------------------------------
@@ -348,6 +368,8 @@ class SLOScheduler:
                 "tenant_generations_tracked": len(self._tenant_gen),
                 "max_staleness_s": self.max_staleness_s,
                 "on_degraded": self.on_degraded,
+                "membership_epoch": _membership_epoch(),
+                "cache_epoch": cache.get("epoch", 0) if cache else None,
             }
         out["queue"] = self.queue.stats()
         tenant_report = getattr(self._metric, "tenant_report", None)

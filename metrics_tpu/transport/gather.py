@@ -42,6 +42,16 @@ import numpy as np
 
 from metrics_tpu.transport.base import Transport
 
+
+def _consult_fault_seam(seam: str, **ctx: Any) -> Any:
+    """Consult the resilience fault plan at ``seam`` (import-guarded only —
+    a raise from the plan IS the injected fault and must propagate)."""
+    try:
+        from metrics_tpu.resilience.faults import maybe_fault
+    except Exception:  # pragma: no cover - resilience plane optional
+        return None
+    return maybe_fault(seam, **ctx)
+
 #: the registered subgroup channel: ``fn(buf: np.ndarray, participants) ->
 #: (len(participants), ...) stacked array``, executed by every participant
 #: with identical arguments; non-participants never call it.
@@ -116,6 +126,35 @@ _KV_ROUNDS: Dict[Any, int] = {}
 _KV_LOCK = threading.Lock()
 
 
+def consume_subgroup_round(participants: Sequence[int]) -> bool:
+    """Advance the registered subgroup channel's round counter WITHOUT
+    running an exchange — the consistency hook for a process that must skip
+    a round its peers still run (an injected payload fault, a hard error
+    between the descriptor and payload rounds; see
+    ``utilities/distributed.py::_gather_all_leaves``).
+
+    A channel object exposing ``consume_round(participants)`` gets it
+    called (the test harness's in-process channel); the KV-store channel's
+    module-level counter is bumped directly. Returns True when a counter
+    was advanced, False when no channel (or an uncounted one) is
+    registered. Without this, a channel whose per-peer-set sequence lags
+    by one round rendezvouses every subsequent exchange over that peer set
+    under mismatched keys — a permanent desync from one transient fault."""
+    channel = _SUBGROUP_ALLGATHER
+    if channel is None:
+        return False
+    consume = getattr(channel, "consume_round", None)
+    if consume is not None:
+        consume(list(participants))
+        return True
+    if channel is kvstore_subgroup_allgather:
+        key_set = tuple(sorted(int(p) for p in participants))
+        with _KV_LOCK:
+            _KV_ROUNDS[key_set] = _KV_ROUNDS.get(key_set, 0) + 1
+        return True
+    return False
+
+
 def kvstore_subgroup_allgather(
     buf: np.ndarray, participants: List[int], *, timeout_ms: int = 60_000
 ) -> np.ndarray:
@@ -159,13 +198,30 @@ def kvstore_subgroup_allgather(
     with _KV_LOCK:
         seq = _KV_ROUNDS.get(key_set, 0)
         _KV_ROUNDS[key_set] = seq + 1
+    # the resilience seam: a consult is one attribute read with no plan
+    # installed; an armed ``subgroup.exchange`` spec may sleep here (the
+    # hung-channel-get chaos case — the DeadlineBudget below still bounds
+    # the whole round) or raise the injected failure. Fired only AFTER the
+    # round counter advanced, so an injected error never desyncs the
+    # sequence this process shares with its peers.
+    from metrics_tpu.resilience.policies import DeadlineBudget
+
+    _consult_fault_seam("subgroup.exchange", process=int(rank), peers=len(key_set))
     peers = "-".join(map(str, key_set))
     prefix = f"mtpu_subgroup/{peers}/{seq}"
     payload = np.ascontiguousarray(buf)
     client.key_value_set(f"{prefix}/{rank}", base64.b64encode(payload.tobytes()).decode())
+    # ONE wall-clock budget for the whole round: the legacy behavior
+    # charged ``timeout_ms`` PER peer read, so a round over N peers could
+    # wait N x the budget before surfacing the failure
+    budget = DeadlineBudget(timeout_ms / 1e3)
     rows = []
     for peer in key_set:
-        raw = base64.b64decode(client.blocking_key_value_get(f"{prefix}/{peer}", timeout_ms))
+        raw = base64.b64decode(
+            client.blocking_key_value_get(
+                f"{prefix}/{peer}", budget.remaining_ms(floor_ms=1.0)
+            )
+        )
         if len(raw) != payload.nbytes:
             raise RuntimeError(
                 f"kvstore_subgroup_allgather: peer {peer} published {len(raw)} bytes"

@@ -141,27 +141,70 @@ class SyncFuture:
 
 
 class _Job:
-    __slots__ = (
-        "future", "thunk", "on_degraded", "round_timeout_s", "max_retries", "backoff_s"
-    )
+    __slots__ = ("future", "thunk", "on_degraded", "round_timeout_s", "retry")
 
-    def __init__(self, future, thunk, on_degraded, round_timeout_s, max_retries, backoff_s):
+    def __init__(self, future, thunk, on_degraded, round_timeout_s, retry):
         self.future = future
         self.thunk = thunk
         self.on_degraded = on_degraded
         self.round_timeout_s = round_timeout_s
-        self.max_retries = max_retries
-        self.backoff_s = backoff_s
+        #: the unified RetryPolicy (metrics_tpu/resilience/policies.py) —
+        #: what was a hand-rolled ``backoff_s * 2**(k-1)`` loop here
+        self.retry = retry
 
 
 def _degraded() -> List[int]:
-    """The PR-8 straggler trigger, guarded (tracing is optional here)."""
+    """Peers the engine must treat as sick before an attempt: the union of
+    the PR-8 per-attempt straggler hint and the resilience plane's
+    versioned membership epoch (a peer the current epoch excludes is dead
+    until an explicit rejoin bumps the epoch — the hint can narrow the
+    healthy set further, never resurrect a dead peer). Both sources are
+    guarded: diagnostics must not break a sync."""
+    out: set = set()
     try:
         from metrics_tpu.observability.tracing import degraded_processes
 
-        return degraded_processes()
+        out.update(int(p) for p in degraded_processes())
     except Exception:  # pragma: no cover - diagnostics must not break a sync
-        return []
+        pass
+    try:
+        from metrics_tpu.resilience.membership import dead_processes
+
+        out.update(int(p) for p in dead_processes())
+    except Exception:  # pragma: no cover - resilience plane optional
+        pass
+    return sorted(out)
+
+
+def _membership_epoch() -> int:
+    """The current membership epoch (0 when the resilience plane is idle or
+    absent) — stamped on every finished job's event."""
+    try:
+        from metrics_tpu.resilience.membership import current_epoch
+
+        return current_epoch()
+    except Exception:  # pragma: no cover - resilience plane optional
+        return 0
+
+
+def _consult_fault_seam(seam: str, **ctx: Any) -> Any:
+    """Consult the resilience fault plan (import-guarded only — a raise
+    from the plan IS the injected fault, absorbed by the job's policy)."""
+    try:
+        from metrics_tpu.resilience.faults import maybe_fault
+    except Exception:  # pragma: no cover - resilience plane optional
+        return None
+    return maybe_fault(seam, **ctx)
+
+
+def _note_round_outcome(peers: List[int], ok: bool) -> None:
+    """Feed the failure detector one round outcome (guarded)."""
+    try:
+        from metrics_tpu.resilience.detector import note_round_outcome
+
+        note_round_outcome(peers, ok)
+    except Exception:  # pragma: no cover - diagnostics must not break a sync
+        pass
 
 
 class AsyncSyncEngine:
@@ -181,9 +224,20 @@ class AsyncSyncEngine:
         max_retries: int = DEFAULT_MAX_RETRIES,
         backoff_s: float = DEFAULT_BACKOFF_S,
         round_timeout_s: Optional[float] = None,
+        retry_policy: Optional[Any] = None,
     ) -> None:
-        self.max_retries = int(max_retries)
-        self.backoff_s = float(backoff_s)
+        from metrics_tpu.resilience.policies import retry_policy_for
+
+        # one retry vocabulary across planes: the legacy knobs construct a
+        # RetryPolicy from the async_sync plane default; an explicit policy
+        # wins outright
+        if retry_policy is None:
+            retry_policy = retry_policy_for("async_sync").with_overrides(
+                max_retries=int(max_retries), backoff_s=float(backoff_s)
+            )
+        self.retry_policy = retry_policy
+        self.max_retries = int(retry_policy.max_retries)
+        self.backoff_s = float(retry_policy.backoff_s)
         self.round_timeout_s = round_timeout_s
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -253,8 +307,9 @@ class AsyncSyncEngine:
                     thunk,
                     on_degraded,
                     self.round_timeout_s if round_timeout_s is None else round_timeout_s,
-                    self.max_retries if max_retries is None else int(max_retries),
-                    self.backoff_s if backoff_s is None else float(backoff_s),
+                    self.retry_policy.with_overrides(
+                        max_retries=max_retries, backoff_s=backoff_s
+                    ),
                 )
             )
             self._counters["submitted"] += 1
@@ -366,6 +421,12 @@ class AsyncSyncEngine:
                     quorum = self._healthy_subgroup(degraded)
             try:
                 future.attempts = attempt + 1
+                # the resilience seam: an armed ``async.attempt`` spec
+                # raises/delays HERE, inside the policy loop, exactly like a
+                # failed transport attempt would
+                _consult_fault_seam(
+                    "async.attempt", key=future.key, attempt=attempt + 1
+                )
                 from metrics_tpu.transport import resolve_transport, use_transport
                 from metrics_tpu.utilities.distributed import transport_overrides
 
@@ -387,15 +448,18 @@ class AsyncSyncEngine:
                     with transport_overrides(transport_label="dcn"):
                         value = self._attempt(job.thunk, job.round_timeout_s)
             except BaseException as err:  # noqa: BLE001 - the policy decides
+                _note_round_outcome(degraded, ok=False)
                 if job.on_degraded == "stale" and self._serve_stale(
                     job, reason=f"{type(err).__name__}: {err}"
                 ):
                     return
-                if job.on_degraded in ("retry", "quorum") and attempt < job.max_retries:
+                if job.on_degraded in ("retry", "quorum") and job.retry.should_retry(
+                    attempt + 1
+                ):
                     attempt += 1
                     with self._lock:
                         self._counters["retries"] += 1
-                    time.sleep(job.backoff_s * (2 ** (attempt - 1)))
+                    job.retry.sleep(attempt)
                     continue
                 with self._lock:
                     self._counters["failed"] += 1
@@ -416,6 +480,10 @@ class AsyncSyncEngine:
                 # a late round never overwrites a newer completed generation
                 if prev is None or prev[0] < future.generation:
                     self._last[future.key] = (future.generation, value)
+            # a completed round is a heartbeat for every peer it spanned
+            _note_round_outcome(
+                quorum if quorum is not None else self._all_processes(), ok=True
+            )
             future._resolve(value)
             self._record_event(
                 job,
@@ -423,6 +491,12 @@ class AsyncSyncEngine:
                 quorum=quorum,
             )
             return
+
+    @staticmethod
+    def _all_processes() -> List[int]:
+        from metrics_tpu.utilities.distributed import world_size
+
+        return list(range(world_size()))
 
     @staticmethod
     def _healthy_subgroup(degraded: List[int]) -> List[int]:
@@ -448,6 +522,7 @@ class AsyncSyncEngine:
                     generation=job.future.generation,
                     attempts=job.future.attempts,
                     stale=job.future.stale,
+                    membership_epoch=_membership_epoch(),
                     **{k: v for k, v in payload.items() if v is not None},
                 )
         except Exception:  # pragma: no cover - telemetry must not break a sync

@@ -539,6 +539,7 @@ def _gather_all_leaves(
     # index in the exchanged arrays. Default: all processes, global rounds.
     ranks = list(range(nprocs))
     exchange = _process_allgather
+    uses_channel = False
     local_rank = int(jax.process_index()) if nprocs > 1 else 0
     if participants is not None:
         want = sorted({int(p) for p in participants if 0 <= int(p) < nprocs})
@@ -548,6 +549,7 @@ def _gather_all_leaves(
                 # true subgroup: rounds touch ONLY these peers (callers
                 # outside the set publish-and-read without contributing)
                 ranks = want
+                uses_channel = True
 
                 def exchange(x, _channel=channel, _want=tuple(want)):
                     return np.asarray(_channel(np.asarray(x), list(_want)))
@@ -580,6 +582,11 @@ def _gather_all_leaves(
             local_error = local_error or err  # empty contribution rides the rounds
         else:
             local_parts.append(np.ascontiguousarray(np.asarray(arr)).tobytes())
+    # the resilience seams: a consult is a single attribute read with no
+    # fault plan installed; an armed seam may sleep (delay) or raise
+    # (drop/error) — the raise is the injected failure the surrounding
+    # policy must absorb (metrics_tpu/resilience/faults.py)
+    _consult_fault_seam("transport.descriptor", process=local_rank, leaves=num_leaves)
     d_span = tracer.begin("gather", group=group_label, bucket="descriptor") if tracer else None
     desc_start = time.perf_counter()
     all_desc = np.asarray(exchange(desc))  # (nslots, num_leaves, 10)
@@ -615,9 +622,33 @@ def _gather_all_leaves(
         buf = np.zeros(max_bytes, dtype=np.uint8)
         local_bytes = np.frombuffer(b"".join(local_parts), np.uint8)
         buf[: local_bytes.size] = local_bytes
-        p_span = tracer.begin("gather", group=group_label, bucket="payload") if tracer else None
-        payload_start = time.perf_counter()
-        gathered = np.asarray(exchange(buf))  # (nslots, max_bytes)
+        # Anything that raises AFTER the descriptor round but BEFORE this
+        # process enters the payload exchange (an injected payload fault, a
+        # hard host error) must still CONSUME the subgroup channel's round:
+        # the peers, having seen this rank's descriptors, will run the
+        # payload round regardless, and a channel whose per-peer-set round
+        # counter lags by one desyncs every subsequent sync over that peer
+        # set (the rounds would rendezvous under mismatched keys forever).
+        # A raise from INSIDE the exchange is already consistent — the
+        # channel advances its counter on entry.
+        payload_round_pending = uses_channel
+        try:
+            _consult_fault_seam(
+                "transport.payload", process=local_rank, bytes=max_bytes
+            )
+            p_span = tracer.begin("gather", group=group_label, bucket="payload") if tracer else None
+            payload_start = time.perf_counter()
+            payload_round_pending = False
+            gathered = np.asarray(exchange(buf))  # (nslots, max_bytes)
+        except BaseException:
+            if payload_round_pending:
+                _consume_subgroup_round(ranks)
+            if tracer:
+                try:
+                    tracer.end(t_span, leaves=num_leaves, error=True)
+                except Exception:  # pragma: no cover - diagnostics only
+                    pass
+            raise
         payload_dur = time.perf_counter() - payload_start
         if tracer:
             tracer.end(p_span, leaves=num_leaves, bytes=nslots * max_bytes)
@@ -681,6 +712,29 @@ def _subgroup_channel():
         return subgroup_allgather()
     except Exception:  # pragma: no cover - the seam must never break a sync
         return None
+
+
+def _consult_fault_seam(seam: str, **ctx: Any) -> Any:
+    """Consult the resilience plane's fault plan at ``seam``. Only the
+    IMPORT is guarded — a raise from the plan itself IS the injected fault
+    and must propagate (metrics_tpu/resilience/faults.py)."""
+    try:
+        from metrics_tpu.resilience.faults import maybe_fault
+    except Exception:  # pragma: no cover - resilience plane optional
+        return None
+    return maybe_fault(seam, **ctx)
+
+
+def _consume_subgroup_round(participants: Sequence[int]) -> bool:
+    """Advance the registered subgroup channel's round counter for a round
+    this process is skipping while its peers still run it (see the payload
+    fault path in :func:`_gather_all_leaves`)."""
+    try:
+        from metrics_tpu.transport.gather import consume_subgroup_round
+
+        return consume_subgroup_round(participants)
+    except Exception:  # pragma: no cover - consistency is best-effort here
+        return False
 
 
 def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]:

@@ -2237,6 +2237,81 @@ def bench_tenant_spill():
 bench_tenant_spill._force_cpu = True
 
 
+# ------------------------------------------------ resilience plane
+#: chaos-soak shape knobs (env-overridable so CI smoke stays short; the
+#: official capture runs the defaults)
+CHAOS_TENANTS = int(os.environ.get("METRICS_TPU_CHAOS_TENANTS", "2048"))
+CHAOS_DURATION_S = float(os.environ.get("METRICS_TPU_CHAOS_SECONDS", "10"))
+CHAOS_QPS = int(os.environ.get("METRICS_TPU_CHAOS_QPS", "8000"))
+CHAOS_MAX_BATCH = int(os.environ.get("METRICS_TPU_CHAOS_MAX_BATCH", "512"))
+CHAOS_SEED = int(os.environ.get("METRICS_TPU_CHAOS_SEED", "1234"))
+
+
+def bench_chaos_soak():
+    """The whole system under a seeded fault schedule (scripts/soak.py
+    --chaos): serving ingest + background refreshes + interval-triggered
+    auto-saves while the FaultPlan injects a killed peer, a dropped payload
+    round, a hung channel get, dispatch errors, poisoned rows and a
+    mid-save checkpoint crash. ``value`` is the p99 ingest latency under
+    chaos (the SLO target is the baseline); the record carries the
+    acceptance INVARIANTS as booleans — ``zero_lost_updates`` (submitted −
+    shed == dispatched == rows_routed, exact, with the shed/poisoned
+    accounting split), ``chaos.ok`` (fault schedule fired, quarantine
+    exact, restore bit-identical, no deadlocks), and the fleet evidence
+    (payload-drop recovery, round-counter consistency, failover MTTR)."""
+    from soak import SLO_P99_MS, run_soak
+
+    record = run_soak(
+        tenants=CHAOS_TENANTS,
+        duration_s=CHAOS_DURATION_S,
+        qps=CHAOS_QPS,
+        max_batch=CHAOS_MAX_BATCH,
+        chaos=True,
+        chaos_seed=CHAOS_SEED,
+    )
+    ours = record["value"] / 1e6 if record["value"] else float("nan")
+    extra = {
+        k: v
+        for k, v in record.items()
+        if k not in ("metric", "value", "unit", "vs_baseline")
+    }
+
+    def ref(torchmetrics, torch):  # the latency SLO target is the baseline
+        return SLO_P99_MS / 1e3
+
+    return "chaos_soak_step", ours, ref, "us/ingest-p99", extra
+
+
+bench_chaos_soak._force_cpu = True
+
+
+def bench_failover_mttr():
+    """Mean time to recovery from an injected peer death: the fleet phase
+    kills rank 1, the phi-accrual detector's strikes promote the failure
+    into a membership epoch bump, and the measurement closes at the first
+    successful degraded sync over the healthy subgroup. ``value`` is the
+    measured MTTR in ms; the baseline is the ``FAILOVER_BUDGET_MS`` target
+    (vs_baseline > 1 means recovery beat the budget). The record carries
+    the epoch-transition evidence and the full fault report."""
+    from soak import FAILOVER_BUDGET_MS, run_chaos_fleet
+
+    fleet = run_chaos_fleet(CHAOS_SEED)
+    mttr_ms = fleet.get("failover_mttr_ms")
+    ours = (mttr_ms / 1e6) if mttr_ms else float("nan")
+    extra = {
+        "failover_budget_ms": FAILOVER_BUDGET_MS,
+        **{k: v for k, v in fleet.items() if k != "failover_mttr_ms"},
+    }
+
+    def ref(torchmetrics, torch):  # the recovery budget is the baseline
+        return FAILOVER_BUDGET_MS / 1e6
+
+    return "failover_mttr", ours, ref, "ms/failover", extra
+
+
+bench_failover_mttr._force_cpu = True
+
+
 CONFIG_META = {
     "bench_accuracy": ("accuracy_update_step", "us/step"),
     "bench_collection": ("metric_collection_update_step_fused", "us/step"),
@@ -2265,6 +2340,8 @@ CONFIG_META = {
     "bench_serving_soak": ("serving_soak_step", "us/ingest-p99"),
     "bench_checkpoint_save": ("checkpoint_save_step", "us/save"),
     "bench_tenant_spill": ("tenant_spill_faultback", "us/tenant"),
+    "bench_chaos_soak": ("chaos_soak_step", "us/ingest-p99"),
+    "bench_failover_mttr": ("failover_mttr", "ms/failover"),
 }
 
 #: driver order — the flagship collection config LAST (the driver's headline)
@@ -2295,6 +2372,8 @@ CONFIGS = [
     bench_serving_soak,
     bench_checkpoint_save,
     bench_tenant_spill,
+    bench_chaos_soak,
+    bench_failover_mttr,
     bench_collection,
 ]
 

@@ -774,6 +774,47 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
     # inside durability_off_programs; a mismatch raises there)
     durability_off = durability_off_programs()
 
+    # the RESILIENCE PLANE must be host-side only — the resilience-off
+    # sweep: with the plane imported and exercised (a fault plan installed
+    # AND fired at a host seam, a detector promotion, membership epoch
+    # bumps, a policy retry), and then again with the plan uninstalled
+    # (fault injection DISABLED — the production state), every pre-existing
+    # hot-path jaxpr must be byte-identical: fault seams, detection and
+    # epochs live at the transport/serving/durability seams, never inside a
+    # compiled program
+    import metrics_tpu.resilience as _res
+
+    _plan = _res.FaultPlan(
+        7, [_res.FaultSpec("serving.dispatch", "error", at=[0], times=1)]
+    )
+    _prev_plan = _res.install_fault_plan(_plan)
+    try:
+        try:
+            _res.maybe_fault("serving.dispatch")
+        except _res.FaultInjected:
+            pass
+        _membership = _res.Membership(world=4)
+        _detector = _res.FailureDetector(membership=_membership, fail_after=1)
+        _detector.observe_round([3], ok=False)
+        _detector.promote()
+        _membership.mark_recovered(3)
+        _res.RetryPolicy(1, 0.0).sleep(1)
+        for name, thunk in programs.items():
+            if thunk() != texts[name]:
+                violations.append(
+                    f"{name}: jaxpr differs with the resilience plane active —"
+                    " fault injection/detector/membership leaked traced ops"
+                    " into the hot path"
+                )
+    finally:
+        _res.install_fault_plan(_prev_plan)
+    for name, thunk in programs.items():
+        if thunk() != texts[name]:
+            violations.append(
+                f"{name}: jaxpr differs with fault injection disabled —"
+                " the resilience-off state altered a hot program"
+            )
+
     # the TRANSPORT SEAM must be free: with the in-graph / gather strategy
     # backends explicitly installed as the process-global transport (the
     # dispatch every sync now routes through), every hot-path jaxpr must be

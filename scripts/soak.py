@@ -27,9 +27,11 @@ Run: ``python scripts/soak.py [--tenants 10000] [--duration-s 60]
 ``bench_serving_soak`` in ``bench_suite.py`` with env knobs).
 """
 import argparse
+import contextlib
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -54,6 +56,256 @@ DEFAULT_MAX_STALENESS_S = 1.0
 SLO_P99_MS = 100.0
 
 
+#: chaos defaults (the seeded fault schedule; see run_soak(chaos=...))
+DEFAULT_CHAOS_SEED = 1234
+#: failover budget the bench's failover_mttr vs_baseline is judged against
+FAILOVER_BUDGET_MS = 5000.0
+
+
+# ---------------------------------------------------------------------------
+# chaos fleet simulation (3-rank world, 2 live: subgroup-channel rounds)
+# ---------------------------------------------------------------------------
+
+
+class _MiniSubgroupChannel:
+    """In-process subgroup byte exchange with PER-RANK round counters — the
+    same sequencing model as the production KV-store channel
+    (``transport/gather.py::kvstore_subgroup_allgather``): each rank
+    advances its own ``(peer set) -> seq`` counter on entry, and a
+    rendezvous only completes when every participant deposits under the
+    SAME sequence number. A rank whose counter lags its peers' by one —
+    the exact hole a payload-round fault used to open — times out every
+    subsequent round, which is what the ``consume_round`` consistency hook
+    (and its ``_gather_all_leaves`` caller) exists to prevent."""
+
+    def __init__(self, rank_of_thread, timeout_s: float = 1.0) -> None:
+        self._rank_of = rank_of_thread
+        self.timeout_s = float(timeout_s)
+        self._cv = threading.Condition()
+        self._seq = {}  # (want, rank) -> next round index
+        self._slots = {}  # (want, seq) -> {rank: buf}
+
+    def _rank(self) -> int:
+        return self._rank_of[threading.get_ident()]
+
+    def __call__(self, buf, participants):
+        rank = self._rank()
+        want = tuple(sorted(int(p) for p in participants))
+        # honor the subgroup.exchange seam exactly like the production
+        # channel (the hung-channel-get chaos case sleeps here)
+        from metrics_tpu.resilience.faults import maybe_fault
+
+        maybe_fault("subgroup.exchange", process=rank, peers=len(want))
+        with self._cv:
+            seq = self._seq.get((want, rank), 0)
+            self._seq[(want, rank)] = seq + 1
+            key = (want, seq)
+            slot = self._slots.setdefault(key, {})
+            slot[rank] = np.asarray(buf).copy()
+            self._cv.notify_all()
+            deadline = time.monotonic() + self.timeout_s
+            while len(self._slots.get(key, {})) < len(want):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"subgroup round {key} timed out waiting for peers"
+                        " (hung channel get)"
+                    )
+                self._cv.wait(remaining)
+            stacked = np.stack([self._slots[key][r] for r in want])
+        return stacked
+
+    def consume_round(self, participants):
+        """The consistency hook: advance THIS rank's counter for a round it
+        is skipping while its peers still run it."""
+        rank = self._rank()
+        want = tuple(sorted(int(p) for p in participants))
+        with self._cv:
+            self._seq[(want, rank)] = self._seq.get((want, rank), 0) + 1
+
+
+@contextlib.contextmanager
+def _sim_fleet(world, rank_of_thread, channel):
+    """Patch the distributed seams so N threads act as N processes whose
+    subgroup rounds ride ``channel``; any all-process global round raises
+    (the sim's world includes a permanently-dead rank, so a global round
+    would be a deadlock bug, not a fallback)."""
+    import metrics_tpu.utilities.distributed as dist_mod
+    from metrics_tpu.transport.gather import set_subgroup_allgather
+
+    def no_global_round(x):
+        raise AssertionError(
+            "global all-process round attempted in the subgroup-only fleet sim"
+        )
+
+    orig = (
+        dist_mod._process_allgather,
+        dist_mod.distributed_available,
+        dist_mod.world_size,
+        dist_mod.jax.process_index,
+    )
+    dist_mod._process_allgather = no_global_round
+    dist_mod.distributed_available = lambda: True
+    dist_mod.world_size = lambda: world
+    dist_mod.jax.process_index = lambda: rank_of_thread[threading.get_ident()]
+    prev = set_subgroup_allgather(channel)
+    try:
+        yield
+    finally:
+        set_subgroup_allgather(prev)
+        (
+            dist_mod._process_allgather,
+            dist_mod.distributed_available,
+            dist_mod.world_size,
+            dist_mod.jax.process_index,
+        ) = orig
+
+
+def run_chaos_fleet(seed: int = DEFAULT_CHAOS_SEED, *, channel_timeout_s: float = 0.5) -> dict:
+    """The chaos soak's fleet phase: a 3-rank world (rank 2 dead from the
+    start — every round is a TRUE subgroup round over [0, 1]) driven
+    through a seeded fault schedule covering the fault classes the serving
+    window cannot express in one process:
+
+    * **dropped payload round** — rank 1 drops its first payload round at
+      the ``transport.payload`` seam; the consistency hook must leave its
+      channel round counter aligned, so the NEXT gather over the same peer
+      set succeeds (``round_counter_consistent``);
+    * **hung channel get** — a ``subgroup.exchange`` delay on rank 0,
+      absorbed within the round deadline (``hung_get_absorbed``);
+    * **peer death + failover MTTR** — rank 1 stops participating; rank 0's
+      failed rounds feed the phi-accrual detector, which promotes the
+      failure into a membership epoch bump; the first successful degraded
+      sync over the healthy subgroup [0] closes the measurement
+      (``failover_mttr_ms``), and the recovered peer rejoins with an
+      explicit second epoch bump.
+    """
+    import jax.numpy as jnp
+
+    import metrics_tpu.resilience as res
+    from metrics_tpu.transport.gather import GatherTransport
+
+    res.MEMBERSHIP.reset(world=3)
+    detector = res.FailureDetector(
+        membership=res.MEMBERSHIP, fail_after=2, phi_threshold=8.0
+    )
+    rank_of: dict = {}
+    channel = _MiniSubgroupChannel(rank_of, timeout_s=channel_timeout_s)
+    plan = res.FaultPlan(
+        seed,
+        [
+            res.FaultSpec("transport.payload", "drop", at=[0], process=1),
+            res.FaultSpec(
+                "subgroup.exchange", "delay", at=[4], process=0, delay_s=0.2
+            ),
+        ],
+    )
+    out = {
+        "payload_drop_recovered": False,
+        "round_counter_consistent": False,
+        "hung_get_absorbed": False,
+        "failover_mttr_ms": None,
+        "epoch_final": None,
+        "epoch_transitions": 0,
+    }
+    errors: dict = {}
+    barrier = threading.Barrier(2, timeout=30.0)
+
+    def tree(rank, k):
+        return {"v": jnp.asarray([rank, k], dtype=jnp.int32)}
+
+    def rank1():
+        transport = GatherTransport(participants=[0, 1])
+        # A: the armed payload drop — this rank abandons the round
+        try:
+            transport.gather_pytrees([tree(1, 0)])
+            errors["rank1_drop"] = "payload drop did not fire"
+        except res.DroppedFault:
+            pass
+        barrier.wait()
+        # A2: recovery — counters must still be aligned with rank 0's
+        transport.gather_pytrees([tree(1, 1)])
+        barrier.wait()
+        # B: healthy heartbeat rounds, then death (return)
+        for k in range(3):
+            transport.gather_pytrees([tree(1, 2 + k)])
+
+    def rank0():
+        transport = GatherTransport(participants=[0, 1])
+        try:
+            transport.gather_pytrees([tree(0, 0)])
+            errors["rank0_drop"] = "expected a timed-out round"
+        except Exception:
+            pass  # rank 1 dropped its payload; this rank's round timed out
+        barrier.wait()
+        got = transport.gather_pytrees([tree(0, 1)])
+        members = got[0]["v"]
+        out["round_counter_consistent"] = bool(
+            len(members) == 2
+            and np.array_equal(np.asarray(members[0]), [0, 1])
+            and np.array_equal(np.asarray(members[1]), [1, 1])
+        )
+        out["payload_drop_recovered"] = out["round_counter_consistent"]
+        barrier.wait()
+        # healthy rounds: the first one carries the injected 0.2s hung get
+        t0 = time.monotonic()
+        transport.gather_pytrees([tree(0, 2)])
+        out["hung_get_absorbed"] = (time.monotonic() - t0) >= 0.18
+        detector.observe_round([1], ok=True)
+        for k in range(2):
+            transport.gather_pytrees([tree(0, 3 + k)])
+            detector.observe_round([1], ok=True)
+        # B: rank 1 is now dead — every further round over [0, 1] times
+        # out; the detector's strikes promote the failure into an epoch
+        t_death = time.monotonic()
+        for _ in range(detector.fail_after + 2):
+            if 1 in res.MEMBERSHIP.dead():
+                break
+            try:
+                transport.gather_pytrees([tree(0, 9)])
+                detector.observe_round([0, 1], ok=True)
+            except Exception:
+                detector.observe_round([1], ok=False)
+                detector.promote()
+        if 1 not in res.MEMBERSHIP.dead():
+            errors["rank0_detector"] = "detector never promoted the dead peer"
+            return
+        # first successful DEGRADED sync: the healthy subgroup [0]
+        degraded = transport.subgroup([0])
+        degraded.gather_pytrees([tree(0, 10)])
+        out["failover_mttr_ms"] = round((time.monotonic() - t_death) * 1e3, 3)
+
+    with res.fault_plan(plan), _sim_fleet(3, rank_of, channel):
+        threads = [
+            threading.Thread(target=_named_rank(rank_of, 0, rank0, errors), name="chaos-rank0"),
+            threading.Thread(target=_named_rank(rank_of, 1, rank1, errors), name="chaos-rank1"),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+    # the recovered peer rejoins with an EXPLICIT epoch bump
+    res.MEMBERSHIP.mark_recovered(1, reason="chaos-rejoin")
+    view = res.MEMBERSHIP.current()
+    out["epoch_final"] = view.epoch
+    out["epoch_transitions"] = len(res.MEMBERSHIP.transitions())
+    out["faults"] = plan.report()
+    if errors:
+        out["errors"] = {k: str(v) for k, v in errors.items()}
+    return out
+
+
+def _named_rank(rank_of, rank, fn, errors):
+    def run():
+        rank_of[threading.get_ident()] = rank
+        try:
+            fn()
+        except Exception as err:  # surfaced in the chaos record
+            errors[f"rank{rank}"] = f"{type(err).__name__}: {err}"
+
+    return run
+
+
 def _draw_ids(rng, tenants, rows, skew):
     """Tenant ids for one cohort: uniform (``skew=0``) or Zipf-skewed
     (``skew>1`` — the spill variant's heavy-head traffic shape, where a few
@@ -64,15 +316,22 @@ def _draw_ids(rng, tenants, rows, skew):
 
 
 def _producer(svc, stop, seed, tenants, rows_per_submit, rate_rows_s, counters,
-              skew=0.0):
-    """One ingest thread: paced synthetic traffic until ``stop``."""
+              skew=0.0, poison_every=0):
+    """One ingest thread: paced synthetic traffic until ``stop``.
+    ``poison_every`` > 0 injects one NaN-pred row every that many cohorts
+    (the chaos soak's poisoned-producer fault; counted exactly)."""
     rng = np.random.RandomState(seed)
     interval = rows_per_submit / rate_rows_s if rate_rows_s > 0 else 0.0
     next_at = time.perf_counter()
+    cohort = 0
     while not stop.is_set():
         ids = _draw_ids(rng, tenants, rows_per_submit, skew)
         preds = rng.rand(rows_per_submit).astype(np.float32)
         target = (rng.rand(rows_per_submit) < preds).astype(np.int32)
+        cohort += 1
+        if poison_every and cohort % poison_every == 0:
+            preds[int(rng.randint(rows_per_submit))] = np.nan
+            counters["poisoned_injected"] += 1
         admitted = svc.submit_many(ids, preds, target)
         counters["submitted"] += rows_per_submit
         counters["admitted"] += admitted
@@ -116,6 +375,8 @@ def run_soak(
     seed: int = 0,
     spill_cap: int = None,
     skew: float = 0.0,
+    chaos: bool = False,
+    chaos_seed: int = DEFAULT_CHAOS_SEED,
 ) -> dict:
     """One full soak run; returns the JSON-serializable record.
 
@@ -124,12 +385,32 @@ def run_soak(
     the cap by LRU eviction to host memory, while the zero-lost-updates
     invariant must keep holding EXACTLY (fault-back precedes every
     dispatch). ``skew`` > 1 draws Zipf-skewed tenant ids — the realistic
-    heavy-head traffic shape a spiller exists for."""
+    heavy-head traffic shape a spiller exists for.
+
+    ``chaos`` runs the resilience plane's end-to-end acceptance: the fleet
+    phase (:func:`run_chaos_fleet` — a killed peer, a dropped payload
+    round, a hung channel get, the failover MTTR) followed by the serving
+    window under a seeded :class:`~metrics_tpu.resilience.FaultPlan`
+    (injected dispatch errors, a mid-save checkpoint crash) with poisoned
+    producers, quarantine armed, and the background auto-save policy
+    writing checkpoints instead of hand-timed saves. At exit the record
+    must show ``submitted − shed == dispatched == rows_routed`` EXACTLY,
+    the last completed checkpoint restoring bit-identical, no poison
+    leaked into tenant state, and no future deadlocked."""
     from metrics_tpu import Accuracy, KeyedMetric, observability
     from metrics_tpu.observability.histogram import HISTOGRAMS
     from metrics_tpu.serving import SLOScheduler
 
     observability.reset()  # ONE queue in the ledger: telemetry == ground truth
+    fleet = None
+    ckpt_dir = None
+    ckpt_mgr = None
+    window_plan = None
+    if chaos:
+        import metrics_tpu.resilience as res
+
+        res.reset()
+        fleet = run_chaos_fleet(chaos_seed)
     # the pow2 bucket warmup compiles log2(max_batch)+1 shapes BY DESIGN;
     # the retrace monitor would (correctly) flag that churn on a plain
     # metric, so raise its threshold past the bucket count for this process
@@ -151,6 +432,9 @@ def run_soak(
         capacity_rows=int(capacity_rows) if capacity_rows else None,
         policy=policy,
         pad_to_bucket=True,
+        # chaos arms the poisoned-row quarantine explicitly (no dependence
+        # on the ambient health-policy setting)
+        quarantine="on" if chaos else "auto",
     )
 
     # -- warmup: pre-compile every pow2 dispatch bucket outside the window
@@ -171,17 +455,44 @@ def run_soak(
     base_stats = svc.queue.stats()
     HISTOGRAMS.reset()  # latency percentiles cover the window only
 
+    if chaos:
+        import metrics_tpu.resilience as res
+        from metrics_tpu.durability import CheckpointManager
+
+        # the durability leg rides the BACKGROUND auto-save policy, not
+        # hand-timed saves: one full root before the faults arm, then
+        # interval-triggered delta saves on the durability lane throughout
+        ckpt_dir = tempfile.mkdtemp(prefix="metrics-tpu-chaos-ckpt-")
+        ckpt_mgr = CheckpointManager(ckpt_dir, svc)
+        ckpt_mgr.save(delta=False)
+        # the seeded window schedule: two dispatch errors (whole cohorts
+        # shed under dispatch_error, exactly accounted) and a mid-save
+        # crash at the before_manifest protocol step (the second auto save;
+        # the engine-level retry policy re-runs the write, whose marks the
+        # crash never advanced)
+        window_plan = res.FaultPlan(
+            chaos_seed + 1,
+            [
+                res.FaultSpec("serving.dispatch", "error", at=[3, 9]),
+                res.FaultSpec("checkpoint.before_manifest", "error", at=[1]),
+            ],
+        )
+        res.install_fault_plan(window_plan)
+        ckpt_mgr.enable_auto_save(
+            interval_s=min(0.8, max(0.2, float(duration_s) / 5.0)), tick_s=0.05
+        )
+
     stop = threading.Event()
     counters = {
         "submitted": 0, "admitted": 0, "reads": 0, "read_errors": 0,
-        "read_seconds": 0.0,
+        "read_seconds": 0.0, "poisoned_injected": 0,
     }
     rate = qps / max(1, producers)
     threads = [
         threading.Thread(
             target=_producer,
             args=(svc, stop, seed + 1 + i, tenants, rows_per_submit, rate, counters,
-                  skew),
+                  skew, 7 if chaos else 0),
             name=f"soak-producer-{i}",
         )
         for i in range(producers)
@@ -202,6 +513,16 @@ def run_soak(
         t.join(timeout=30.0)
     drained = svc.drain(timeout=60.0)
     elapsed = time.perf_counter() - t0
+
+    durability_drained = True
+    if chaos:
+        import metrics_tpu.resilience as res
+        from metrics_tpu.utilities.async_sync import get_engine
+
+        auto_report = ckpt_mgr.auto_save_report()
+        ckpt_mgr.disable_auto_save()
+        durability_drained = get_engine("durability").drain(timeout=30.0)
+        res.install_fault_plan(None)  # the post-run saves run clean
 
     # -- the measured-window ledger (deltas) and the whole-run invariant
     stats = svc.queue.stats()
@@ -321,9 +642,97 @@ def run_soak(
         }
     if counters.get("last_read_error"):
         record["last_read_error"] = counters["last_read_error"]
+    if chaos:
+        import shutil
+
+        from metrics_tpu.durability import CheckpointManager
+
+        # mid-save-crash evidence + the strongest durability statement the
+        # run can make: after the faults, a final CLEAN full save restores
+        # BIT-IDENTICAL into a fresh metric
+        durability = snap.get("durability", {})
+        final_manifest = ckpt_mgr.save(delta=False)
+        fresh = KeyedMetric(
+            Accuracy(), num_tenants=int(tenants), validate_ids=False
+        )
+        CheckpointManager(ckpt_dir, fresh).restore(fresh)
+        restore_ok = _states_equal(metric, fresh)
+        # no poison leaked: every tenant that ingested rows computes finite
+        values = np.asarray(metric.compute())
+        routed_rows = metric._traffic.arrays()[0]
+        touched = (
+            routed_rows[: values.shape[0]] > 0
+            if routed_rows is not None
+            else np.zeros(values.shape[0], dtype=bool)
+        )
+        none_leaked = bool(np.all(np.isfinite(values[touched])))
+        poisoned_quarantined = int(stats["shed_by_reason"].get("poisoned", 0))
+        chaos_block = {
+            "seed": int(chaos_seed),
+            "fleet": fleet,
+            "window_faults": window_plan.report(),
+            "poisoned": {
+                "injected": int(counters["poisoned_injected"]),
+                "quarantined": poisoned_quarantined,
+                "none_leaked": none_leaked,
+            },
+            "checkpoint": {
+                "auto_saves": auto_report["auto_saves"],
+                "save_errors": int(durability.get("save_errors", 0)),
+                "mid_save_crash_injected": durability.get("save_errors", 0) >= 1,
+                "restore_bit_identical": restore_ok,
+                "last_snapshot": final_manifest["name"],
+            },
+            "no_deadlocks": bool(drained and durability_drained),
+            "resilience": snap.get("resilience", {}),
+        }
+        fleet_ok = bool(
+            fleet
+            and not fleet.get("errors")
+            and fleet["payload_drop_recovered"]
+            and fleet["round_counter_consistent"]
+            and fleet["hung_get_absorbed"]
+            and fleet["failover_mttr_ms"] is not None
+            and fleet["epoch_transitions"] >= 2
+        )
+        chaos_block["ok"] = bool(
+            fleet_ok
+            and zero_lost
+            and telemetry_matches
+            and chaos_block["no_deadlocks"]
+            and none_leaked
+            and poisoned_quarantined >= 1
+            and poisoned_quarantined <= counters["poisoned_injected"]
+            and chaos_block["checkpoint"]["mid_save_crash_injected"]
+            and chaos_block["checkpoint"]["auto_saves"] >= 2
+            and restore_ok
+            and stats["shed_by_reason"].get("dispatch_error", 0) >= 1
+        )
+        record["chaos"] = chaos_block
+        record["metric"] = "chaos_soak_step"
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
     svc.close()
     observability.set_retrace_threshold(prev_threshold)
     return record
+
+
+def _states_equal(a, b) -> bool:
+    """Leaf-for-leaf bit identity between two metrics' state bundles (the
+    restore acceptance check)."""
+    from metrics_tpu.durability.checkpoint import _bundles
+
+    bundles_a, bundles_b = _bundles(a), _bundles(b)
+    if set(bundles_a) != set(bundles_b):
+        return False
+    for key in bundles_a:
+        sa = bundles_a[key]._get_states()
+        sb = bundles_b[key]._get_states()
+        if set(sa) != set(sb):
+            return False
+        for name in sa:
+            if not np.array_equal(np.asarray(sa[name]), np.asarray(sb[name])):
+                return False
+    return True
 
 
 def main(argv=None) -> int:
@@ -352,6 +761,17 @@ def main(argv=None) -> int:
         "--skew", type=float, default=0.0,
         help="Zipf exponent (>1) for skewed tenant traffic; 0 = uniform",
     )
+    parser.add_argument(
+        "--chaos", action="store_true",
+        help="run the resilience plane's end-to-end chaos acceptance: the"
+        " fleet phase (killed peer, dropped payload round, hung channel"
+        " get, failover MTTR) plus the serving window under a seeded fault"
+        " schedule with poisoned producers and auto-saved checkpoints",
+    )
+    parser.add_argument(
+        "--chaos-seed", type=int, default=DEFAULT_CHAOS_SEED,
+        help="FaultPlan seed — a chaos failure reproduces from this alone",
+    )
     parser.add_argument("--out", default=None, help="also write the record to this path")
     args = parser.parse_args(argv)
     record = run_soak(
@@ -369,6 +789,8 @@ def main(argv=None) -> int:
         seed=args.seed,
         spill_cap=args.spill_cap,
         skew=args.skew,
+        chaos=args.chaos,
+        chaos_seed=args.chaos_seed,
     )
     print(json.dumps(record), flush=True)
     if args.out:
@@ -382,6 +804,9 @@ def main(argv=None) -> int:
             and spill["conservation_ok"]
             and spill["faultback_reads_bit_identical"]
         )
+    chaos = record.get("chaos")
+    if chaos is not None:
+        ok = ok and chaos["ok"]
     if not ok:
         print("# SOAK FAILED: accounting invariant violated", file=sys.stderr)
     return 0 if ok else 1

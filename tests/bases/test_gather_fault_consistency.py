@@ -1,0 +1,196 @@
+"""Regression: a GatherTransport round that raises AFTER the descriptor
+round but BEFORE the payload round must leave the subgroup channel's round
+counter consistent for the next sync.
+
+The production KV-store channel sequences rounds with a PER-PROCESS
+``(peer set) -> seq`` counter; the channel here models exactly that (each
+rank advances its own counter on entry, a rendezvous completes only when
+every participant deposits under the SAME sequence). Before the fix, a rank
+that faulted between the descriptor and payload rounds kept a counter one
+behind its peers' — every subsequent exchange over that peer set then
+rendezvoused under mismatched keys and timed out forever. The fix
+(``transport/gather.py::consume_subgroup_round``, called from the payload
+fault path in ``_gather_all_leaves``) consumes the skipped round.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import metrics_tpu.resilience as res
+import metrics_tpu.utilities.distributed as dist_mod
+from metrics_tpu.transport.gather import (
+    GatherTransport,
+    consume_subgroup_round,
+    set_subgroup_allgather,
+)
+
+
+class PerRankSeqChannel:
+    """Subgroup rendezvous with per-rank round counters (the KV-store
+    channel's sequencing model) and the ``consume_round`` consistency
+    hook."""
+
+    def __init__(self, rank_of_thread, timeout_s=1.0):
+        self._rank_of = rank_of_thread
+        self.timeout_s = timeout_s
+        self._cv = threading.Condition()
+        self._seq = {}
+        self._slots = {}
+
+    def _advance(self, want):
+        rank = self._rank_of[threading.get_ident()]
+        with self._cv:
+            seq = self._seq.get((want, rank), 0)
+            self._seq[(want, rank)] = seq + 1
+        return rank, seq
+
+    def __call__(self, buf, participants):
+        want = tuple(sorted(int(p) for p in participants))
+        rank, seq = self._advance(want)
+        key = (want, seq)
+        with self._cv:
+            self._slots.setdefault(key, {})[rank] = np.asarray(buf).copy()
+            self._cv.notify_all()
+            deadline = time.monotonic() + self.timeout_s
+            while len(self._slots.get(key, {})) < len(want):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(f"subgroup round {key} timed out")
+                self._cv.wait(remaining)
+            return np.stack([self._slots[key][r] for r in want])
+
+    def consume_round(self, participants):
+        self._advance(tuple(sorted(int(p) for p in participants)))
+
+    def seqs(self, want):
+        want = tuple(sorted(want))
+        with self._cv:
+            return {r: s for (w, r), s in self._seq.items() if w == want}
+
+
+@pytest.fixture()
+def fleet(monkeypatch):
+    """3-process world, ranks 0/1 live on threads, rank 2 permanently dead
+    — every gather is a TRUE subgroup round over [0, 1] through the
+    channel."""
+    rank_of = {}
+    channel = PerRankSeqChannel(rank_of, timeout_s=1.0)
+
+    def no_global_round(x):
+        raise AssertionError("global round attempted in subgroup-only fleet")
+
+    monkeypatch.setattr(dist_mod, "_process_allgather", no_global_round)
+    monkeypatch.setattr(dist_mod, "distributed_available", lambda: True)
+    monkeypatch.setattr(dist_mod, "world_size", lambda: 3)
+    monkeypatch.setattr(
+        dist_mod.jax, "process_index", lambda: rank_of[threading.get_ident()]
+    )
+    prev = set_subgroup_allgather(channel)
+    try:
+        yield rank_of, channel
+    finally:
+        set_subgroup_allgather(prev)
+
+
+def _run_ranks(rank_of, fns):
+    results = {}
+    errors = {}
+
+    def worker(rank, fn):
+        rank_of[threading.get_ident()] = rank
+        try:
+            results[rank] = fn()
+        except Exception as err:
+            errors[rank] = err
+
+    threads = [
+        threading.Thread(target=worker, args=(r, fn)) for r, fn in enumerate(fns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    return results, errors
+
+
+def test_payload_fault_leaves_round_counter_consistent(fleet):
+    """Gather #1: rank 1 drops its payload round (injected fault between
+    the rounds); both ranks' rounds fail. Gather #2 over the SAME peer set
+    must succeed on both ranks with correct values — impossible unless the
+    faulting rank consumed the skipped round."""
+    rank_of, channel = fleet
+    plan = res.FaultPlan(
+        0, [res.FaultSpec("transport.payload", "drop", at=[0], process=1)]
+    )
+    # both ranks settle their FAILED first gather before the recovery round
+    # begins (rank 0 spends the full channel timeout failing; without the
+    # barrier rank 1's recovery descriptor would race that timeout)
+    recovered = threading.Barrier(2, timeout=30.0)
+
+    def make_rank(rank):
+        def run():
+            transport = GatherTransport(participants=[0, 1])
+            outcome = {}
+            try:
+                transport.gather_pytrees(
+                    [{"v": np.asarray([rank, 100], np.int64)}]
+                )
+                outcome["first"] = "ok"
+            except Exception as err:
+                outcome["first"] = type(err).__name__
+            recovered.wait()
+            got = transport.gather_pytrees(
+                [{"v": np.asarray([rank, 200], np.int64)}]
+            )
+            outcome["second"] = [np.asarray(m).tolist() for m in got[0]["v"]]
+            return outcome
+
+        return run
+
+    with res.fault_plan(plan):
+        results, errors = _run_ranks(rank_of, [make_rank(0), make_rank(1)])
+    assert not errors, errors
+    # gather #1 failed on both sides — the drop on rank 1, the timeout on 0
+    assert results[1]["first"] == "DroppedFault"
+    assert results[0]["first"] != "ok"
+    # gather #2 recovered on BOTH ranks with both contributions intact
+    assert results[0]["second"] == [[0, 200], [1, 200]]
+    assert results[1]["second"] == [[0, 200], [1, 200]]
+    # and the per-rank round counters ended aligned
+    seqs = channel.seqs((0, 1))
+    assert seqs[0] == seqs[1], seqs
+
+
+def test_consume_subgroup_round_prefers_channel_hook(fleet):
+    rank_of, channel = fleet
+    rank_of[threading.get_ident()] = 0
+    assert channel.seqs((0, 1)) == {}
+    assert consume_subgroup_round([0, 1]) is True
+    assert channel.seqs((0, 1)) == {0: 1}
+
+
+def test_consume_subgroup_round_without_channel_is_a_noop():
+    prev = set_subgroup_allgather(None)
+    try:
+        assert consume_subgroup_round([0, 1]) is False
+    finally:
+        set_subgroup_allgather(prev)
+
+
+def test_consume_subgroup_round_bumps_kvstore_counter():
+    from metrics_tpu.transport import gather as gather_mod
+
+    prev = set_subgroup_allgather(gather_mod.kvstore_subgroup_allgather)
+    key = (0, 1, 2)
+    with gather_mod._KV_LOCK:
+        before = gather_mod._KV_ROUNDS.get(key, 0)
+    try:
+        assert consume_subgroup_round([2, 0, 1]) is True
+        with gather_mod._KV_LOCK:
+            assert gather_mod._KV_ROUNDS.get(key, 0) == before + 1
+    finally:
+        set_subgroup_allgather(prev)
+        with gather_mod._KV_LOCK:
+            gather_mod._KV_ROUNDS[key] = before

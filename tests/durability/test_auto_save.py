@@ -1,0 +1,176 @@
+"""CheckpointManager background auto-save: interval- and dirty-threshold-
+triggered save_async on the durability lane, single-flight, failure
+backoff through the unified checkpoint RetryPolicy."""
+import time
+
+import numpy as np
+import pytest
+
+import metrics_tpu.resilience as res
+from metrics_tpu import Accuracy, KeyedMetric, observability
+from metrics_tpu.durability import CheckpointManager
+from metrics_tpu.utilities.async_sync import get_engine
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    observability.reset()
+    res.reset()
+    yield
+    res.reset()
+    observability.reset()
+
+
+def _metric(n=16):
+    return KeyedMetric(Accuracy(), num_tenants=n, validate_ids=False)
+
+
+def _feed(metric, tenants):
+    ids = np.asarray(tenants, np.int32)
+    metric.update(ids, np.full(len(ids), 0.9, np.float32), np.ones(len(ids), np.int32))
+
+
+def _wait(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_enable_requires_a_trigger_and_validates_knobs(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), _metric())
+    with pytest.raises(ValueError, match="interval_s and/or dirty_threshold"):
+        mgr.enable_auto_save()
+    with pytest.raises(ValueError, match="interval_s"):
+        mgr.enable_auto_save(interval_s=0)
+    with pytest.raises(ValueError, match="dirty_threshold"):
+        mgr.enable_auto_save(dirty_threshold=0)
+
+
+def test_interval_trigger_saves_periodically(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), _metric())
+    mgr.enable_auto_save(interval_s=0.08, tick_s=0.02)
+    try:
+        assert _wait(lambda: mgr.auto_save_report()["auto_saves"] >= 2)
+    finally:
+        mgr.disable_auto_save()
+    get_engine("durability").drain(10.0)
+    assert mgr.latest() is not None
+    report = mgr.auto_save_report()
+    assert report["enabled"] is False
+    assert report["config"]["interval_s"] == 0.08
+
+
+def test_dirty_threshold_triggers_on_write_pressure_not_wall_time(tmp_path):
+    metric = _metric()
+    mgr = CheckpointManager(str(tmp_path), metric)
+    mgr.save()  # baseline full
+    mgr.enable_auto_save(dirty_threshold=4, tick_s=0.02)
+    try:
+        # below the threshold: no save, however long we wait
+        _feed(metric, [0, 1])
+        time.sleep(0.15)
+        assert mgr.auto_save_report()["auto_saves"] == 0
+        # crossing it triggers
+        _feed(metric, [2, 3, 4, 5])
+        assert _wait(lambda: mgr.auto_save_report()["auto_saves"] >= 1)
+        get_engine("durability").drain(10.0)
+        # once the save completes, the dirty set drains below the threshold:
+        # no save storm
+        assert _wait(lambda: (mgr.dirty_count() or 0) < 4)
+        saves_now = mgr.auto_save_report()["auto_saves"]
+        time.sleep(0.15)
+        assert mgr.auto_save_report()["auto_saves"] == saves_now
+    finally:
+        mgr.disable_auto_save()
+
+
+def test_auto_save_counts_into_durability_telemetry(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), _metric())
+    mgr.enable_auto_save(interval_s=0.05, tick_s=0.02)
+    try:
+        assert _wait(lambda: mgr.auto_save_report()["auto_saves"] >= 1)
+    finally:
+        mgr.disable_auto_save()
+    get_engine("durability").drain(10.0)
+    snap = observability.snapshot()["durability"]
+    assert snap["auto_saves"] >= 1
+    assert snap["saves"] >= 1
+
+
+def test_crashed_auto_save_backs_off_and_recovers(tmp_path):
+    """A mid-save crash (the checkpoint.before_manifest fault seam armed to
+    exhaust the engine's retries) must not advance the marks; the policy
+    backs off through the checkpoint RetryPolicy and the next trigger's
+    save re-covers the dirty set — the chain always ends restorable."""
+    metric = _metric()
+    mgr = CheckpointManager(str(tmp_path), metric)
+    mgr.save(delta=False)
+    _feed(metric, [0, 1, 2, 3])
+    # the engine retries a failed thunk 3x by default; fail them all so the
+    # auto-save loop SEES a failed future, then recover
+    plan = res.FaultPlan(
+        0, [res.FaultSpec("checkpoint.before_manifest", "error", at=[0, 1, 2])]
+    )
+    with res.fault_plan(plan):
+        mgr.enable_auto_save(
+            dirty_threshold=2,
+            tick_s=0.02,
+            retry_policy=res.RetryPolicy(max_retries=5, backoff_s=0.01),
+        )
+        try:
+            assert _wait(
+                lambda: observability.snapshot()["durability"].get("save_errors", 0) >= 3
+            )
+            # the retried save eventually lands clean (hits past the schedule)
+            assert _wait(lambda: (mgr.dirty_count() or 0) < 2, timeout=15.0)
+        finally:
+            mgr.disable_auto_save()
+    get_engine("durability").drain(10.0)
+    report = mgr.report()
+    assert report["latest"] is not None
+    # the crashed saves left the chain restorable and the retry re-covered
+    # the dirty tenants: a fresh restore equals the live state
+    fresh = _metric()
+    CheckpointManager(str(tmp_path), fresh).restore(fresh)
+    assert np.array_equal(
+        np.asarray(metric.compute()), np.asarray(fresh.compute()), equal_nan=True
+    )
+
+
+def test_single_flight_skips_while_a_save_is_in_writing(tmp_path):
+    metric = _metric()
+    mgr = CheckpointManager(str(tmp_path), metric)
+    # a slow durability lane: block the engine with a long job so the
+    # auto-save future stays pending across several ticks
+    engine = get_engine("durability")
+    gate = {"open": False}
+
+    def slow():
+        while not gate["open"]:
+            time.sleep(0.01)
+
+    engine.submit("block-lane", slow)
+    mgr.enable_auto_save(interval_s=0.03, tick_s=0.01)
+    try:
+        assert _wait(lambda: mgr.auto_save_report()["auto_saves"] == 1)
+        assert _wait(lambda: mgr.auto_save_report()["skipped_in_flight"] >= 1)
+        assert mgr.auto_save_report()["auto_saves"] == 1  # still single-flight
+    finally:
+        gate["open"] = True
+        mgr.disable_auto_save()
+        engine.drain(10.0)
+
+
+def test_disable_is_idempotent_and_stops_the_thread(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), _metric())
+    mgr.enable_auto_save(interval_s=0.05, tick_s=0.02)
+    assert mgr.auto_save_report()["enabled"] is True
+    mgr.disable_auto_save()
+    mgr.disable_auto_save()
+    assert mgr.auto_save_report()["enabled"] is False
+    saves = mgr.auto_save_report()["auto_saves"]
+    time.sleep(0.12)
+    assert mgr.auto_save_report()["auto_saves"] == saves

@@ -1,0 +1,93 @@
+"""The chaos soak's acceptance evidence, in-suite.
+
+The quick test runs the fleet phase alone (killed peer, dropped payload
+round, hung channel get, failover MTTR — a couple of seconds); the full
+serving-window soak is the ``slow``-marked variant mirroring the
+``make chaos-smoke`` CI leg.
+"""
+import os
+import sys
+
+import pytest
+
+import metrics_tpu.resilience as res
+
+SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "scripts",
+)
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    res.reset()
+    yield
+    res.reset()
+
+
+def test_chaos_fleet_phase_end_to_end():
+    """One seeded fleet run must produce ALL the acceptance evidence: the
+    dropped payload round recovered with the channel round counters still
+    aligned, the hung channel get absorbed inside the round deadline, the
+    dead peer promoted into a membership epoch bump, the degraded sync
+    closing the MTTR measurement, and the explicit rejoin bumping again."""
+    from soak import run_chaos_fleet
+
+    out = run_chaos_fleet(seed=4242, channel_timeout_s=0.5)
+    assert "errors" not in out, out
+    assert out["payload_drop_recovered"] is True
+    assert out["round_counter_consistent"] is True
+    assert out["hung_get_absorbed"] is True
+    assert out["failover_mttr_ms"] is not None and out["failover_mttr_ms"] > 0
+    assert out["epoch_final"] == 2  # failure + explicit rejoin
+    assert out["epoch_transitions"] == 2
+    fired = out["faults"]["fired_by_seam"]
+    assert fired == {
+        "transport.payload:drop": 1,
+        "subgroup.exchange:delay": 1,
+    }
+    # the telemetry ledger saw the same story
+    snap = res.RESILIENCE_STATS.summary()
+    assert snap["peer_failures"] == 1 and snap["peer_rejoins"] == 1
+    assert snap["epoch"] == 2
+    assert snap["faults_injected"] == 2
+
+
+def test_chaos_fleet_is_seed_reproducible():
+    from soak import run_chaos_fleet
+
+    first = run_chaos_fleet(seed=99, channel_timeout_s=0.5)
+    res.reset()
+    second = run_chaos_fleet(seed=99, channel_timeout_s=0.5)
+    assert first["faults"]["fired_by_seam"] == second["faults"]["fired_by_seam"]
+    assert first["epoch_final"] == second["epoch_final"]
+
+
+@pytest.mark.slow
+def test_chaos_soak_serving_window():
+    """The full --chaos soak on a short window: conservation exact, every
+    injected poisoned row quarantined with none leaked, the mid-save crash
+    fired and the last checkpoint restored bit-identical, no deadlocks."""
+    from soak import run_soak
+
+    record = run_soak(
+        tenants=128,
+        duration_s=3.0,
+        qps=2000,
+        max_batch=128,
+        chaos=True,
+        chaos_seed=77,
+    )
+    assert record["metric"] == "chaos_soak_step"
+    assert record["zero_lost_updates"] is True
+    assert record["shed_matches_telemetry"] is True
+    chaos = record["chaos"]
+    assert chaos["ok"] is True, chaos
+    assert chaos["poisoned"]["quarantined"] >= 1
+    assert chaos["poisoned"]["none_leaked"] is True
+    assert chaos["checkpoint"]["mid_save_crash_injected"] is True
+    assert chaos["checkpoint"]["restore_bit_identical"] is True
+    assert chaos["no_deadlocks"] is True
+    assert chaos["fleet"]["failover_mttr_ms"] is not None

@@ -1,0 +1,249 @@
+"""FailureDetector + Membership: phi-accrual verdicts promoted into
+versioned membership epochs, with explicit rejoin semantics."""
+import pytest
+
+import metrics_tpu.resilience as res
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    res.reset()
+    yield
+    res.reset()
+
+
+def _fed_detector(membership, peer=1, n=20, dt=0.1, **kwargs):
+    det = res.FailureDetector(membership=membership, **kwargs)
+    t = 0.0
+    for _ in range(n):
+        det.heartbeat(peer, at=t)
+        t += dt
+    return det, t
+
+
+# ---------------------------------------------------------------------------
+# membership epochs
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_bumps_on_failure_and_explicit_rejoin():
+    m = res.Membership(world=4)
+    assert m.current() == res.MembershipView(0, (0, 1, 2, 3), ())
+    v1 = m.mark_failed(2, reason="test")
+    assert v1 == res.MembershipView(1, (0, 1, 3), (2,))
+    # idempotent: re-marking neither bumps nor records
+    assert m.mark_failed(2).epoch == 1
+    assert len(m.transitions()) == 1
+    # recovery is EXPLICIT and bumps again
+    v2 = m.rejoin(2)
+    assert v2 == res.MembershipView(2, (0, 1, 2, 3), ())
+    assert m.mark_recovered(2).epoch == 2  # idempotent
+    kinds = [t["kind"] for t in m.transitions()]
+    assert kinds == ["failure", "rejoin"]
+
+
+def test_membership_never_empties_the_alive_set():
+    m = res.Membership(world=2)
+    m.mark_failed(1)
+    with pytest.raises(ValueError, match="alive set would be empty"):
+        m.mark_failed(0)
+    with pytest.raises(ValueError, match="outside world"):
+        m.mark_failed(7)
+
+
+def test_transitions_are_counted_unconditionally():
+    """The epoch is correctness-bearing: transitions count even with
+    telemetry disabled (unlike diagnostic counters)."""
+    from metrics_tpu import observability
+
+    observability.disable()
+    try:
+        m = res.Membership(world=3)
+        m.mark_failed(1)
+        m.rejoin(1)
+    finally:
+        observability.enable()
+    snap = res.RESILIENCE_STATS.summary()
+    assert snap["epoch_transitions"] == 2
+    assert snap["peer_failures"] == 1 and snap["peer_rejoins"] == 1
+    assert snap["epoch"] == 2
+
+
+def test_global_membership_accessors():
+    res.MEMBERSHIP.reset(world=3)
+    assert res.current_epoch() == 0
+    assert res.alive_processes() == [0, 1, 2] and res.dead_processes() == []
+    res.MEMBERSHIP.mark_failed(2)
+    assert res.current_epoch() == 1
+    assert res.dead_processes() == [2]
+    assert res.current_view().alive == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# phi-accrual verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_phi_low_while_heartbeats_flow_high_after_silence():
+    m = res.Membership(world=3)
+    det, t = _fed_detector(m, peer=1, dt=0.1)
+    last_beat = t - 0.1  # _fed_detector advances t past the final heartbeat
+    assert det.phi(1, now=last_beat + 0.05) < 1.0  # inside its own rhythm
+    assert det.phi(1, now=last_beat + 5.0) > det.phi_threshold  # long silence
+    assert det.suspects(now=last_beat + 0.05) == []
+    assert det.suspects(now=last_beat + 5.0) == [1]
+
+
+def test_phi_scales_with_the_peers_own_regularity():
+    """A jittery peer needs a LONGER silence than a metronomic one to reach
+    the same suspicion — the whole point of accrual detection."""
+    m = res.Membership(world=3)
+    regular, t1 = _fed_detector(m, peer=1, dt=0.1)
+    jittery = res.FailureDetector(membership=m)
+    t = 0.0
+    for i in range(20):
+        jittery.heartbeat(2, at=t)
+        t += 0.05 if i % 2 else 0.4  # mean ~0.22, high variance
+    silence_at = 0.8
+    assert regular.phi(1, now=t1 + silence_at) > jittery.phi(2, now=t + silence_at)
+
+
+def test_never_seen_peer_is_judged_by_strikes_not_statistics():
+    m = res.Membership(world=3)
+    det = res.FailureDetector(membership=m, fail_after=3)
+    assert det.phi(1) == 0.0
+    det.observe_round([1], ok=False)
+    det.observe_round([1], ok=False)
+    assert det.suspects() == []
+    det.observe_round([1], ok=False)
+    assert det.suspects() == [1]
+
+
+def test_heartbeat_clears_strikes():
+    m = res.Membership(world=3)
+    det = res.FailureDetector(membership=m, fail_after=2)
+    det.observe_round([1], ok=False)
+    det.observe_round([1], ok=True)  # success = heartbeat = absolution
+    det.observe_round([1], ok=False)
+    assert det.suspects() == []
+
+
+def test_promote_marks_failed_and_never_convicts_self():
+    """Promotion applies the verdicts to the membership with one epoch bump
+    per new suspect — but a process never convicts ITSELF (jax.process_index
+    is 0 on the test backend, so a silent peer 0 must survive)."""
+    m = res.Membership(world=3)
+    det = res.FailureDetector(membership=m, fail_after=2)
+    det.observe_round([0, 1], ok=False)
+    det.observe_round([0, 1], ok=False)
+    assert set(det.suspects()) == {0, 1}
+    view = det.promote()
+    assert view.dead == (1,)  # peer 0 == self, spared
+    assert view.epoch == 1
+    assert res.RESILIENCE_STATS.counter("detector_suspects") == 1
+    # re-promotion is stable
+    assert det.promote().epoch == 1
+
+
+def test_straggler_report_feeds_strikes():
+    m = res.Membership(world=4)
+    det = res.FailureDetector(membership=m, fail_after=2)
+    prev = res.DETECTOR
+    try:
+        res.detector.DETECTOR = det
+        res.note_straggler_report([2])
+        res.note_straggler_report([2])
+    finally:
+        res.detector.DETECTOR = prev
+    assert det.suspects() == [2]
+
+
+def test_published_straggler_report_reaches_the_global_detector():
+    """The PR-8 path end to end: straggler_report(publish=True) must charge
+    the flagged process a strike on the global detector."""
+    from metrics_tpu.observability import tracing
+
+    res.DETECTOR.reset()
+    fleet = {
+        "processes": [
+            {
+                "process": p,
+                "spans": [
+                    {
+                        "span_id": f"gather:metric:{i}",
+                        "kind": "gather",
+                        "bucket": "transport",
+                        "enter_s": i * 1.0 + (0.5 if p == 1 else 0.0),
+                        "exit_s": i * 1.0 + 0.6,
+                    }
+                    for i in range(4)
+                ],
+            }
+            for p in (0, 1)
+        ],
+        "clock": {"uncertainty_s": 0.0},
+    }
+    report = tracing.straggler_report(fleet, publish=True, min_spans=2, min_lag_s=0.0)
+    assert report["flagged"] == [1]
+    assert res.DETECTOR.report()["peers"][1]["strikes"] >= 1
+
+
+def test_auto_rejoin_requires_positive_evidence():
+    m = res.Membership(world=3)
+    det = res.FailureDetector(membership=m, fail_after=1, auto_rejoin=True)
+    det.observe_round([1], ok=False)
+    view = det.promote(now=0.0)
+    assert view.dead == (1,)
+    # silence alone never rejoins; a fresh heartbeat does
+    det.heartbeat(1, at=1.0)
+    view = det.promote(now=1.01)
+    assert view.dead == ()
+    assert view.epoch == 2
+
+
+def test_async_engine_unions_membership_dead_into_degraded():
+    from metrics_tpu import observability
+    from metrics_tpu.utilities.async_sync import _degraded
+
+    observability.reset()  # drop any published fleet report (the PR-8 hint)
+    res.MEMBERSHIP.reset(world=4)
+    assert _degraded() == []
+    res.MEMBERSHIP.mark_failed(3)
+    assert 3 in _degraded()
+    res.MEMBERSHIP.rejoin(3)
+    assert _degraded() == []
+
+
+def test_scheduler_cache_expires_on_epoch_transition():
+    """A cached serving read computed under an older membership epoch must
+    not be served — the epoch is a fleet-level cache-invalidation edge."""
+    import numpy as np
+
+    from metrics_tpu import Accuracy, KeyedMetric
+    from metrics_tpu.serving import SLOScheduler
+
+    res.MEMBERSHIP.reset(world=2)
+    metric = KeyedMetric(Accuracy(), num_tenants=4)
+    svc = SLOScheduler(metric, max_staleness_s=60.0, start=False)
+    try:
+        svc.submit_many(
+            np.array([0, 1]), np.array([0.9, 0.2], np.float32), np.array([1, 0], np.int32)
+        )
+        svc.queue.flush()
+        svc.read(max_staleness_s=0.0)
+        report = svc.report()
+        assert report["cache_epoch"] == 0 and report["membership_epoch"] == 0
+        before = svc.report()["queue"]["dispatched"]
+        from metrics_tpu.serving.telemetry import SERVING_STATS
+
+        hits_before = SERVING_STATS.counter("cache_hits")
+        svc.read()  # fresh cache, same epoch: a cache hit
+        assert SERVING_STATS.counter("cache_hits") == hits_before + 1
+        res.MEMBERSHIP.mark_failed(1)  # epoch bump
+        misses_before = SERVING_STATS.counter("cache_misses")
+        svc.read()  # the old-epoch cache must NOT serve
+        assert SERVING_STATS.counter("cache_misses") == misses_before + 1
+        assert svc.report()["cache_epoch"] == 1
+        assert svc.report()["queue"]["dispatched"] == before  # no new rows
+    finally:
+        svc.close()

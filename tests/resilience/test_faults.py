@@ -1,0 +1,201 @@
+"""FaultPlan: the unified, seeded fault-injection vocabulary.
+
+Determinism is the contract under test: a plan built from ``(seed, specs)``
+must fire the same faults at the same seam hit counts on every run, so a
+chaos-soak failure reproduces from its seed alone.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import metrics_tpu.resilience as res
+from metrics_tpu.resilience.faults import _PLAN  # noqa: F401 - module sanity
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    res.reset()
+    yield
+    res.reset()
+
+
+def test_unknown_seam_and_mode_raise():
+    with pytest.raises(ValueError, match="unknown seam"):
+        res.FaultSpec("nonsense.seam", "error")
+    with pytest.raises(ValueError, match="unknown fault mode"):
+        res.FaultSpec("serving.dispatch", "explode")
+    with pytest.raises(ValueError, match="not both"):
+        res.FaultSpec("serving.dispatch", "error", at=[0], prob=0.5)
+    with pytest.raises(ValueError, match="prob"):
+        res.FaultSpec("serving.dispatch", "error", prob=1.5)
+
+
+def test_no_plan_installed_is_a_noop():
+    assert res.current_fault_plan() is None
+    assert res.maybe_fault("serving.dispatch") is None  # no raise, no count
+
+
+def test_at_schedule_fires_exactly_on_hit_indices():
+    plan = res.FaultPlan(0, [res.FaultSpec("serving.dispatch", "error", at=[1, 3])])
+    with res.fault_plan(plan):
+        assert res.maybe_fault("serving.dispatch") is None  # hit 0
+        with pytest.raises(res.FaultInjected):
+            res.maybe_fault("serving.dispatch")  # hit 1
+        assert res.maybe_fault("serving.dispatch") is None  # hit 2
+        with pytest.raises(res.FaultInjected):
+            res.maybe_fault("serving.dispatch")  # hit 3
+        assert res.maybe_fault("serving.dispatch") is None  # hit 4
+    assert [h for _, _, h in plan.fired()] == [1, 3]
+
+
+def test_drop_and_crash_modes_raise_their_types():
+    plan = res.FaultPlan(
+        0,
+        [
+            res.FaultSpec("transport.payload", "drop", at=[0]),
+            res.FaultSpec("checkpoint.before_rename", "crash", at=[0]),
+        ],
+    )
+    with res.fault_plan(plan):
+        with pytest.raises(res.DroppedFault):
+            res.maybe_fault("transport.payload")
+        with pytest.raises(res.CrashFault):
+            res.maybe_fault("checkpoint.before_rename")
+    # both subclass FaultInjected: one except clause catches the family
+    assert issubclass(res.DroppedFault, res.FaultInjected)
+    assert issubclass(res.CrashFault, res.FaultInjected)
+
+
+def test_delay_mode_sleeps_and_returns_none():
+    import time
+
+    plan = res.FaultPlan(
+        0, [res.FaultSpec("subgroup.exchange", "delay", at=[0], delay_s=0.05)]
+    )
+    with res.fault_plan(plan):
+        t0 = time.perf_counter()
+        assert res.maybe_fault("subgroup.exchange") is None
+        assert time.perf_counter() - t0 >= 0.045
+
+
+def test_corrupt_mode_returns_deterministic_corruptor():
+    plan = res.FaultPlan(3, [res.FaultSpec("transport.payload", "corrupt", at=[0])])
+    data = np.arange(4096, dtype=np.int32)
+    with res.fault_plan(plan):
+        action = res.maybe_fault("transport.payload")
+    assert action is not None and action.mode == "corrupt"
+    corrupted = action.corrupt(data)
+    assert corrupted.shape == data.shape and corrupted.dtype == data.dtype
+    assert not np.array_equal(corrupted, data)
+    # deterministic: the same fire index corrupts the same bytes
+    plan2 = res.FaultPlan(3, [res.FaultSpec("transport.payload", "corrupt", at=[0])])
+    with res.fault_plan(plan2):
+        action2 = res.maybe_fault("transport.payload")
+    assert np.array_equal(action2.corrupt(data), corrupted)
+
+
+def test_times_caps_total_fires():
+    plan = res.FaultPlan(0, [res.FaultSpec("async.attempt", "error", times=2)])
+    fired = 0
+    with res.fault_plan(plan):
+        for _ in range(5):
+            try:
+                res.maybe_fault("async.attempt")
+            except res.FaultInjected:
+                fired += 1
+    assert fired == 2
+
+
+def test_prob_schedule_is_seed_deterministic():
+    def firing_pattern(seed):
+        plan = res.FaultPlan(seed, [res.FaultSpec("async.attempt", "error", prob=0.5)])
+        pattern = []
+        with res.fault_plan(plan):
+            for _ in range(32):
+                try:
+                    res.maybe_fault("async.attempt")
+                    pattern.append(0)
+                except res.FaultInjected:
+                    pattern.append(1)
+        return pattern
+
+    assert firing_pattern(7) == firing_pattern(7)
+    assert firing_pattern(7) != firing_pattern(8)
+    assert 0 < sum(firing_pattern(7)) < 32
+
+
+def test_process_scoped_specs_count_hits_per_process():
+    """``at=[0], process=1`` must name process 1's OWN first hit — the
+    per-(seam, process) counters keep multi-rank schedules deterministic
+    regardless of thread interleaving."""
+    plan = res.FaultPlan(
+        0, [res.FaultSpec("transport.payload", "drop", at=[0], process=1)]
+    )
+    with res.fault_plan(plan):
+        # process 0 hammers the seam first — must never fire the spec
+        for _ in range(5):
+            assert res.maybe_fault("transport.payload", process=0) is None
+        with pytest.raises(res.DroppedFault):
+            res.maybe_fault("transport.payload", process=1)
+        assert res.maybe_fault("transport.payload", process=1) is None
+    assert plan.hits("transport.payload@0") == 5
+    assert plan.hits("transport.payload@1") == 2
+
+
+def test_custom_exception_class():
+    class MyFault(RuntimeError):
+        def __init__(self, seam):
+            super().__init__(seam)
+
+    plan = res.FaultPlan(
+        0, [res.FaultSpec("serving.dispatch", "error", at=[0], exc=MyFault)]
+    )
+    with res.fault_plan(plan):
+        with pytest.raises(MyFault):
+            res.maybe_fault("serving.dispatch")
+
+
+def test_fault_plan_context_restores_previous():
+    outer = res.FaultPlan(1)
+    res.install_fault_plan(outer)
+    inner = res.FaultPlan(2)
+    with res.fault_plan(inner):
+        assert res.current_fault_plan() is inner
+    assert res.current_fault_plan() is outer
+    res.install_fault_plan(None)
+    assert res.current_fault_plan() is None
+    with pytest.raises(TypeError):
+        res.install_fault_plan("not a plan")
+
+
+def test_fired_faults_are_counted_in_telemetry():
+    from metrics_tpu import observability
+
+    plan = res.FaultPlan(0, [res.FaultSpec("serving.dispatch", "error", at=[0])])
+    with res.fault_plan(plan):
+        with pytest.raises(res.FaultInjected):
+            res.maybe_fault("serving.dispatch")
+    snap = observability.snapshot()["resilience"]
+    assert snap["faults_injected"] == 1
+    assert snap["faults_by_seam"] == {"serving.dispatch:error": 1}
+    report = plan.report()
+    assert report["fired"] == 1 and report["fired_by_seam"] == {
+        "serving.dispatch:error": 1
+    }
+
+
+def test_concurrent_hits_never_lose_counts():
+    plan = res.FaultPlan(0, [res.FaultSpec("async.attempt", "error", at=[10_000])])
+    with res.fault_plan(plan):
+
+        def hammer():
+            for _ in range(200):
+                res.maybe_fault("async.attempt")
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert plan.hits("async.attempt") == 1600

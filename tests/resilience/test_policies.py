@@ -1,0 +1,237 @@
+"""RetryPolicy / DeadlineBudget / CircuitBreaker: the unified policy
+vocabulary and its consumers (async engine backoff, queue breaker,
+per-plane overrides)."""
+import time
+
+import pytest
+
+import metrics_tpu.resilience as res
+from metrics_tpu.resilience.policies import PLANE_POLICIES
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    res.reset()
+    yield
+    res.reset()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_backoff_schedule_and_cap():
+    p = res.RetryPolicy(max_retries=5, backoff_s=0.1, multiplier=2.0, max_backoff_s=0.35)
+    assert [p.backoff(k) for k in (1, 2, 3, 4)] == [0.1, 0.2, 0.35, 0.35]
+    assert p.should_retry(5) and not p.should_retry(6)
+    with pytest.raises(ValueError):
+        res.RetryPolicy(max_retries=-1)
+    with pytest.raises(ValueError):
+        res.RetryPolicy(multiplier=0.5)
+
+
+def test_with_overrides_maps_legacy_knobs():
+    base = res.RetryPolicy(2, 0.05)
+    assert base.with_overrides() is base
+    tweaked = base.with_overrides(max_retries=4)
+    assert tweaked.max_retries == 4 and tweaked.backoff_s == 0.05
+    assert tweaked == res.RetryPolicy(4, 0.05)
+
+
+def test_retry_sleep_counts_into_telemetry():
+    res.RetryPolicy(1, 0.0).sleep(1)
+    assert res.RESILIENCE_STATS.counter("policy_retries") == 1
+
+
+def test_plane_registry_overrides():
+    prev = res.retry_policy_for("checkpoint")
+    try:
+        res.set_retry_policy("checkpoint", res.RetryPolicy(9, 0.01))
+        assert res.retry_policy_for("checkpoint").max_retries == 9
+        # unknown planes fall back to the async_sync default
+        assert res.retry_policy_for("nonsense") == PLANE_POLICIES["async_sync"]
+        with pytest.raises(TypeError):
+            res.set_retry_policy("checkpoint", "fast")
+    finally:
+        res.set_retry_policy("checkpoint", prev)
+
+
+def test_async_engine_runs_on_the_unified_retry_policy():
+    """The engine's hand-rolled backoff loop is gone: the legacy
+    max_retries/backoff_s knobs construct a RetryPolicy, retries follow its
+    schedule, and each backoff counts into resilience.policy_retries."""
+    from metrics_tpu.utilities.async_sync import AsyncSyncEngine
+
+    engine = AsyncSyncEngine(max_retries=2, backoff_s=0.0)
+    assert engine.retry_policy == res.RetryPolicy(2, 0.0)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    future = engine.submit("unified-retry", flaky, on_degraded="retry")
+    assert future.result(timeout=10.0) == "ok"
+    assert len(calls) == 3 and future.attempts == 3
+    assert res.RESILIENCE_STATS.counter("policy_retries") == 2
+    assert engine.summary()["retries"] == 2
+    engine.shutdown()
+
+    explicit = AsyncSyncEngine(retry_policy=res.RetryPolicy(0, 0.0))
+    failing = explicit.submit("no-retries", lambda: 1 / 0, on_degraded="retry")
+    with pytest.raises(Exception):
+        failing.result(timeout=10.0)
+    assert failing.attempts == 1  # zero retries honored
+    explicit.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# DeadlineBudget
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_budget_is_shared_across_steps():
+    budget = res.DeadlineBudget(0.2)
+    first = budget.remaining()
+    time.sleep(0.05)
+    second = budget.remaining()
+    assert second < first <= 0.2
+    assert budget.remaining_ms(floor_ms=1.0) >= 1
+    assert not budget.expired
+    time.sleep(0.2)
+    assert budget.expired
+    assert budget.remaining() == 0.0
+    with pytest.raises(res.DeadlineExhausted):
+        budget.check("subgroup round")
+    assert res.RESILIENCE_STATS.counter("deadline_exhausted") == 1
+
+
+def test_unbounded_budget():
+    budget = res.DeadlineBudget(None)
+    assert budget.remaining() is None and budget.remaining_ms() is None
+    assert not budget.expired
+    budget.check()  # never raises
+    with pytest.raises(ValueError):
+        res.DeadlineBudget(0)
+
+
+def test_kvstore_channel_charges_one_budget_per_round(monkeypatch):
+    """The subgroup channel's N per-peer blocking reads share ONE deadline:
+    the timeouts handed to the client must shrink monotonically instead of
+    re-charging the full budget per peer (the legacy behavior)."""
+    from metrics_tpu.transport import gather as gather_mod
+
+    timeouts = []
+
+    class FakeClient:
+        def key_value_set(self, key, value):
+            pass
+
+        def blocking_key_value_get(self, key, timeout_ms):
+            timeouts.append(timeout_ms)
+            time.sleep(0.02)
+            import base64
+
+            import numpy as np
+
+            return base64.b64encode(np.zeros(4, np.uint8).tobytes()).decode()
+
+        def key_value_delete(self, key):
+            pass
+
+    class FakeState:
+        client = FakeClient()
+
+    import jax
+
+    from jax._src import distributed as jax_distributed
+
+    monkeypatch.setattr(jax_distributed, "global_state", FakeState())
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    import numpy as np
+
+    gather_mod.kvstore_subgroup_allgather(
+        np.zeros(4, np.uint8), [0, 1, 2], timeout_ms=10_000
+    )
+    assert len(timeouts) == 3
+    assert timeouts[0] > timeouts[1] > timeouts[2]
+    assert all(t <= 10_000 for t in timeouts)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trips_after_consecutive_failures_then_half_opens():
+    cb = res.CircuitBreaker(failure_threshold=2, reset_after_s=0.05)
+    assert cb.state == "closed" and cb.allow()
+    cb.record_failure()
+    assert cb.state == "closed" and cb.allow()  # one short of the threshold
+    cb.record_failure()
+    assert cb.state == "open"
+    assert not cb.allow()
+    assert res.RESILIENCE_STATS.counter("breaker_opens") == 1
+    assert res.RESILIENCE_STATS.counter("breaker_short_circuits") == 1
+    time.sleep(0.06)
+    assert cb.state == "half_open"
+    assert cb.allow()  # exactly one probe
+    assert not cb.allow()  # the second caller short-circuits
+    cb.record_success()
+    assert cb.state == "closed" and cb.allow()
+
+
+def test_failed_half_open_probe_rearms_the_timer():
+    cb = res.CircuitBreaker(failure_threshold=1, reset_after_s=0.05)
+    cb.record_failure()
+    time.sleep(0.06)
+    assert cb.allow()
+    cb.record_failure()  # the probe failed
+    assert not cb.allow()  # immediately open again
+    time.sleep(0.06)
+    assert cb.allow()  # a fresh probe after another full window
+
+
+def test_success_resets_the_consecutive_count():
+    cb = res.CircuitBreaker(failure_threshold=2, reset_after_s=1.0)
+    cb.record_failure()
+    cb.record_success()
+    cb.record_failure()
+    assert cb.state == "closed"  # never two CONSECUTIVE failures
+
+
+def test_queue_breaker_sheds_with_exact_reason():
+    """An open breaker sheds whole cohorts under ``breaker_open`` without
+    calling the dispatch target; the first half-open success closes it and
+    dispatch resumes — conservation exact throughout."""
+    import numpy as np
+
+    from metrics_tpu.serving.queue import AdmissionQueue
+
+    calls = []
+    fail = [True]
+
+    def target(ids, *cols):
+        calls.append(len(ids))
+        if fail[0]:
+            raise RuntimeError("downstream sick")
+
+    cb = res.CircuitBreaker(failure_threshold=1, reset_after_s=0.05)
+    q = AdmissionQueue(target, max_batch=4, quarantine="off", breaker=cb, start=False)
+    q.submit_many([0, 1], np.array([0.1, 0.2], np.float32))
+    q.flush()  # dispatch fails -> breaker opens
+    q.submit_many([2, 3], np.array([0.3, 0.4], np.float32))
+    q.flush()  # breaker open -> shed without dispatching
+    stats = q.stats()
+    assert stats["shed_by_reason"] == {"dispatch_error": 2, "breaker_open": 2}
+    assert len(calls) == 1
+    fail[0] = False
+    time.sleep(0.06)  # half-open window
+    q.submit_many([4, 5], np.array([0.5, 0.6], np.float32))
+    q.flush()  # the probe dispatch succeeds -> closed
+    stats = q.stats()
+    assert stats["dispatched"] == 2 and cb.state == "closed"
+    assert stats["submitted"] - stats["shed"] == stats["dispatched"]

@@ -1,0 +1,87 @@
+"""The resilience.* telemetry family: lazy snapshot section, merge rules,
+Prometheus rendering, reset discipline."""
+import json
+
+import pytest
+
+import metrics_tpu.resilience as res
+from metrics_tpu import observability
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    res.reset()
+    observability.reset()
+    yield
+    res.reset()
+    observability.reset()
+
+
+def test_snapshot_section_is_lazy_and_json_round_trips():
+    assert observability.snapshot()["resilience"] == {}
+    res.Membership(world=3).mark_failed(1)
+    snap = observability.snapshot()["resilience"]
+    assert snap["epoch"] == 1 and snap["peer_failures"] == 1
+    assert json.loads(json.dumps(snap)) == snap
+
+
+def test_observability_reset_clears_the_family():
+    res.RESILIENCE_STATS.inc("policy_retries")
+    assert observability.snapshot()["resilience"]["policy_retries"] == 1
+    observability.reset()
+    assert observability.snapshot()["resilience"] == {}
+
+
+def test_merge_rules_sum_counters_and_max_epoch():
+    from metrics_tpu.observability.aggregate import merge_snapshots
+
+    a = {"resilience": {"faults_injected": 2, "epoch": 1, "peer_failures": 1}}
+    b = {"resilience": {"faults_injected": 3, "epoch": 4, "peer_failures": 0}}
+    merged = merge_snapshots([a, b])["resilience"]
+    assert merged["faults_injected"] == 5
+    assert merged["epoch"] == 4  # the fleet view is the NEWEST epoch
+    assert merged["peer_failures"] == 1
+    # associative with the empty-snapshot identity
+    assert merge_snapshots([a, {}])["resilience"] == a["resilience"]
+
+
+def test_prometheus_renders_the_family_with_help_and_type():
+    plan = res.FaultPlan(0, [res.FaultSpec("serving.dispatch", "error", at=[0])])
+    with res.fault_plan(plan):
+        with pytest.raises(res.FaultInjected):
+            res.maybe_fault("serving.dispatch")
+    res.Membership(world=2).mark_failed(1)
+    out = observability.render_prometheus()
+    assert "# HELP metrics_tpu_resilience_faults_injected_total" in out
+    assert "# TYPE metrics_tpu_resilience_faults_injected_total counter" in out
+    assert "metrics_tpu_resilience_faults_injected_total 1" in out
+    assert (
+        'metrics_tpu_resilience_faults_by_seam_total{seam="serving.dispatch",mode="error"} 1'
+        in out
+    )
+    assert "metrics_tpu_resilience_membership_epoch 1" in out
+    assert "metrics_tpu_resilience_peer_failures_total 1" in out
+
+
+def test_fault_and_transition_events_land_on_the_timeline():
+    from metrics_tpu.observability.events import EVENTS
+
+    plan = res.FaultPlan(0, [res.FaultSpec("async.attempt", "error", at=[0])])
+    with res.fault_plan(plan):
+        with pytest.raises(res.FaultInjected):
+            res.maybe_fault("async.attempt")
+    res.Membership(world=2).mark_failed(1, reason="unit-test")
+    kinds = [
+        (e.kind, e.payload.get("path"))
+        for e in EVENTS.events()
+        if e.kind == "resilience"
+    ]
+    assert ("resilience", "fault") in kinds
+    assert ("resilience", "failure") in kinds
+    transition = next(
+        e for e in EVENTS.events()
+        if e.kind == "resilience" and e.payload.get("path") == "failure"
+    )
+    assert transition.payload["peer"] == 1
+    assert transition.payload["reason"] == "unit-test"
+    assert transition.payload["epoch"] == 1

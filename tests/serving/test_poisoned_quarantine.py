@@ -1,0 +1,130 @@
+"""Poisoned-row quarantine: NaN/Inf event rows are shed with the exact
+reason ``"poisoned"`` (dead-lettered) instead of corrupting a whole flush,
+and the conservation laws extend to the new reason."""
+import numpy as np
+import pytest
+
+from metrics_tpu import observability
+from metrics_tpu.serving.queue import DEAD_LETTER_CAP, AdmissionQueue
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    observability.reset()
+    yield
+    observability.set_health_policy("off")
+    observability.reset()
+
+
+def _recording_queue(**kwargs):
+    got = []
+
+    def target(ids, *cols):
+        got.append((np.asarray(ids).copy(), [np.asarray(c).copy() for c in cols]))
+
+    return AdmissionQueue(target, max_batch=8, start=False, **kwargs), got
+
+
+def test_poisoned_rows_shed_exactly_and_clean_rows_dispatch():
+    q, got = _recording_queue(quarantine="on")
+    preds = np.array([0.1, np.nan, 0.3, np.inf, -np.inf, 0.6], np.float32)
+    target = np.array([1, 0, 1, 1, 0, 1], np.int32)
+    assert q.submit_many(np.arange(6), preds, target) == 6
+    q.flush()
+    stats = q.stats()
+    assert stats["shed_by_reason"] == {"poisoned": 3}
+    assert stats["dead_letter_rows"] == 3
+    assert stats["dispatched"] == 3
+    # the conservation law extends to the quarantine
+    assert stats["submitted"] - stats["shed"] == stats["dispatched"]
+    # only the finite rows reached the target, in admission order
+    ids, cols = got[0]
+    assert ids.tolist() == [0, 2, 5]
+    assert np.all(np.isfinite(cols[0]))
+    # the dead-letter sample retains the poisoned rows' tenants
+    assert [t for t, _ in q.dead_letters()] == [1, 3, 4]
+
+
+def test_quarantine_auto_follows_the_health_policy():
+    # health policy off: NaN rows pass through (the pre-quarantine behavior)
+    q, got = _recording_queue(quarantine="auto")
+    q.submit_many([0, 1], np.array([0.1, np.nan], np.float32))
+    q.flush()
+    assert q.stats()["shed"] == 0 and len(got) == 1
+    # armed health policy arms the quarantine
+    observability.set_health_policy("record")
+    q2, got2 = _recording_queue(quarantine="auto")
+    q2.submit_many([0, 1], np.array([0.1, np.nan], np.float32))
+    q2.flush()
+    assert q2.stats()["shed_by_reason"] == {"poisoned": 1}
+    assert got2[0][0].tolist() == [0]
+
+
+def test_quarantine_off_disables_scanning():
+    q, got = _recording_queue(quarantine="off")
+    observability.set_health_policy("record")
+    q.submit_many([0, 1], np.array([0.1, np.nan], np.float32))
+    q.flush()
+    assert q.stats()["shed"] == 0 and len(got) == 1
+
+
+def test_invalid_quarantine_mode_raises():
+    with pytest.raises(ValueError, match="quarantine"):
+        AdmissionQueue(lambda *a: None, quarantine="maybe", start=False)
+
+
+def test_all_poisoned_cohort_dispatches_nothing_but_drains():
+    q, got = _recording_queue(quarantine="on")
+    q.submit_many([0, 1], np.full(2, np.nan, np.float32))
+    assert q.flush() == 2  # the popped rows count, so flush() terminates
+    assert got == []
+    stats = q.stats()
+    assert stats["shed_by_reason"] == {"poisoned": 2}
+    assert stats["resident"] == 0
+    assert stats["submitted"] - stats["shed"] == stats["dispatched"] == 0
+
+
+def test_integer_columns_are_never_scanned():
+    q, got = _recording_queue(quarantine="on")
+    q.submit_many([0, 1], np.array([7, 9], np.int32))
+    q.flush()
+    assert q.stats()["shed"] == 0
+    assert got[0][1][0].tolist() == [7, 9]
+
+
+def test_dead_letter_sample_is_bounded_while_count_stays_exact():
+    q, _ = _recording_queue(quarantine="on")
+    n = DEAD_LETTER_CAP + 8
+    q.submit_many(np.arange(n) % 4, np.full(n, np.nan, np.float32))
+    q.flush()
+    assert len(q.dead_letters()) == DEAD_LETTER_CAP
+    assert q.stats()["dead_letter_rows"] == n  # the COUNT never truncates
+
+
+def test_quarantine_telemetry_matches_the_ledger():
+    q, _ = _recording_queue(quarantine="on")
+    q.submit_many([0, 1, 2], np.array([np.nan, 0.5, np.nan], np.float32))
+    q.flush()
+    serving = observability.snapshot()["serving"]
+    assert serving["shed_by_reason"].get("poisoned") == 2
+    assert serving["shed_rows"] == 2
+    assert serving["dispatched_rows"] == 1
+
+
+def test_poisoned_rows_never_corrupt_keyed_state():
+    """End to end through a real KeyedMetric: with quarantine on, a NaN row
+    cannot poison the float sum states — every touched tenant still
+    computes finite, and rows_routed matches dispatched exactly."""
+    from metrics_tpu import Accuracy, KeyedMetric
+
+    metric = KeyedMetric(Accuracy(), num_tenants=4, validate_ids=False)
+    q = AdmissionQueue(metric.update, max_batch=8, quarantine="on", start=False)
+    preds = np.array([0.9, np.nan, 0.8, 0.7], np.float32)
+    target = np.array([1, 1, 1, 0], np.int32)
+    q.submit_many([0, 1, 2, 3], preds, target)
+    q.flush()
+    stats = q.stats()
+    assert stats["shed_by_reason"] == {"poisoned": 1}
+    assert metric.tenant_report()["rows_routed"] == stats["dispatched"] == 3
+    values = np.asarray(metric.compute())
+    assert np.all(np.isfinite(values[[0, 2, 3]]))

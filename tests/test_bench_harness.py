@@ -487,3 +487,70 @@ def test_tenant_spill_bench_record_round_trips(monkeypatch):
     assert line["evict_us_per_tenant"] > 0
     assert "telemetry" in line and line["telemetry"]["durability"]["evictions"] > 0
     assert "bench_tenant_spill" in bench_suite.CONFIG_META
+
+
+def test_chaos_soak_bench_record_round_trips(monkeypatch):
+    """The chaos-soak config's record must survive json round-trips and
+    carry the resilience acceptance evidence as booleans: conservation
+    exact under injected faults (with the shed/poisoned accounting split),
+    every injected poisoned row quarantined and none leaked, the mid-save
+    crash fired with the last checkpoint restoring bit-identical, the
+    fleet-phase recovery facts, and the failover MTTR."""
+    import json
+
+    import metrics_tpu.resilience as res
+
+    monkeypatch.setattr(bench_suite, "CHAOS_TENANTS", 128)
+    monkeypatch.setattr(bench_suite, "CHAOS_DURATION_S", 2.5)
+    monkeypatch.setattr(bench_suite, "CHAOS_QPS", 2000)
+    monkeypatch.setattr(bench_suite, "CHAOS_MAX_BATCH", 128)
+    try:
+        line = bench_suite.run_config(bench_suite.bench_chaos_soak, probe=False)
+    finally:
+        res.reset()
+    assert json.loads(json.dumps(line)) == line
+    assert line["metric"] == "chaos_soak_step" and line["unit"] == "us/ingest-p99"
+    assert line["zero_lost_updates"] is True
+    assert line["shed_matches_telemetry"] is True
+    rows = line["rows"]
+    assert rows["submitted"] - rows["shed"] == rows["dispatched"]
+    chaos = line["chaos"]
+    assert chaos["ok"] is True, chaos
+    assert chaos["poisoned"]["quarantined"] >= 1
+    assert chaos["poisoned"]["none_leaked"] is True
+    assert line["shed_by_reason"].get("poisoned") == chaos["poisoned"]["quarantined"]
+    assert chaos["checkpoint"]["mid_save_crash_injected"] is True
+    assert chaos["checkpoint"]["restore_bit_identical"] is True
+    assert chaos["checkpoint"]["auto_saves"] >= 2
+    assert chaos["fleet"]["round_counter_consistent"] is True
+    assert chaos["fleet"]["failover_mttr_ms"] > 0
+    assert chaos["no_deadlocks"] is True
+    assert "bench_chaos_soak" in bench_suite.CONFIG_META
+
+
+def test_failover_mttr_bench_record_round_trips():
+    """The failover config's record must survive json round-trips and carry
+    the recovery evidence: the measured MTTR in ms (vs the recovery
+    budget), the epoch-transition count, and the seeded fault report."""
+    import json
+
+    import metrics_tpu.resilience as res
+
+    try:
+        line = bench_suite.run_config(bench_suite.bench_failover_mttr, probe=False)
+    finally:
+        res.reset()
+    assert json.loads(json.dumps(line)) == line
+    assert line["metric"] == "failover_mttr" and line["unit"] == "ms/failover"
+    assert line["value"] > 0
+    assert line["vs_baseline"] is not None  # budget / measured
+    from soak import FAILOVER_BUDGET_MS
+
+    assert line["failover_budget_ms"] == FAILOVER_BUDGET_MS
+    assert abs(line["vs_baseline"] - round(FAILOVER_BUDGET_MS / line["value"], 3)) < 0.01
+    assert line["payload_drop_recovered"] is True
+    assert line["round_counter_consistent"] is True
+    assert line["hung_get_absorbed"] is True
+    assert line["epoch_transitions"] >= 2
+    assert line["faults"]["fired"] == 2
+    assert "bench_failover_mttr" in bench_suite.CONFIG_META

@@ -476,3 +476,93 @@ def test_durability_documented_and_cross_linked():
     assert "`metrics_tpu.CheckpointManager`" in mods
     assert "`metrics_tpu.TenantSpiller`" in mods
     assert "`metrics_tpu.durability`" in mods
+
+
+def test_resilience_documented_and_cross_linked():
+    """The resilience plane's user contract lives in five places: its own
+    guide (the fault-seam table, the policy vocabulary, membership-epoch
+    semantics, the chaos-soak invariants), the performance guide (cost
+    model + cross-link), the observability guide (the resilience.*
+    family), the durability guide (auto-save + seam subsumption), and the
+    serving guide (quarantine + breaker shed accounting) — all
+    cross-linked, plus modules rows for the top-level exports."""
+    with open(f"{DOCS_DIR}/resilience.md") as fh:
+        res = fh.read()
+    for phrase in (
+        # fault seams
+        "## Fault seams",
+        "FaultPlan",
+        "transport.payload",
+        "subgroup.exchange",
+        "async.attempt",
+        "serving.dispatch",
+        "checkpoint.<point>",
+        "inject_crash",
+        "consume_subgroup_round",
+        # detection + epochs
+        "phi-accrual",
+        "epoch bump",
+        "rejoin",
+        "convicts itself",
+        # policy vocabulary
+        "RetryPolicy",
+        "DeadlineBudget",
+        "CircuitBreaker",
+        "PLANE_POLICIES",
+        # quarantine + auto-save satellites
+        '"poisoned"',
+        "dead_letters",
+        "enable_auto_save",
+        "dirty_threshold",
+        # chaos soak invariants
+        "## The chaos soak",
+        "--chaos",
+        "make chaos-smoke",
+        "submitted − shed == dispatched ==",
+        "bit-identical",
+        "failover_mttr",
+        "chaos_soak_step",
+        # zero-overhead statement
+        "zero traced ops",
+    ):
+        assert phrase in res, phrase
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "## Resilience plane" in perf
+    for phrase in ("resilience.md", "FaultPlan", "membership",
+                   "chaos_soak_step", "failover_mttr"):
+        assert phrase in perf, phrase
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "## Resilience telemetry" in obs
+    for phrase in (
+        "faults_injected",
+        "faults_by_seam",
+        "epoch_transitions",
+        "metrics_tpu_resilience_",
+        "membership_epoch",
+        '"poisoned"',
+        "auto_saves",
+    ):
+        assert phrase in obs, phrase
+    with open(f"{DOCS_DIR}/durability.md") as fh:
+        durability = fh.read()
+    assert "resilience.md" in durability
+    assert "enable_auto_save" in durability
+    with open(f"{DOCS_DIR}/serving.md") as fh:
+        serving = fh.read()
+    assert "resilience.md" in serving
+    assert "quarantine" in serving and "breaker_open" in serving
+    with open(f"{DOCS_DIR}/modules.md") as fh:
+        mods = fh.read()
+    for export in (
+        "`metrics_tpu.FaultPlan`",
+        "`metrics_tpu.FaultSpec`",
+        "`metrics_tpu.FailureDetector`",
+        "`metrics_tpu.Membership`",
+        "`metrics_tpu.RetryPolicy`",
+        "`metrics_tpu.DeadlineBudget`",
+        "`metrics_tpu.CircuitBreaker`",
+        "`metrics_tpu.resilience`",
+    ):
+        assert export in mods, export
