@@ -1,8 +1,8 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check soak checkpoint-smoke chaos-smoke
+.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check soak checkpoint-smoke chaos-smoke slo-smoke
 
-ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check checkpoint-smoke chaos-smoke
+ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check checkpoint-smoke chaos-smoke slo-smoke
 
 # Full suite on the virtual 8-device CPU mesh (tests/conftest.py), including
 # the real 2-process jax.distributed sync test (tests/bases/test_multiprocess.py).
@@ -89,6 +89,21 @@ soak:
 chaos-smoke:
 	JAX_PLATFORMS=cpu python scripts/soak.py --chaos --tenants 256 \
 	  --duration-s 4 --qps 4000 --max-batch 256
+
+# SLO-plane smoke (scripts/soak.py --slo): the breach watchdog's end-to-end
+# acceptance as a control + fault pair. The control run declares ingest-p99
+# and read-staleness SLOs and must finish breach-free with its error budget
+# intact; the fault run installs a seeded dispatch-delay FaultPlan and must
+# DETECT the breach (burn-rate > 1 on both windows) within one fast window
+# of the first bad observation, with breaches()/snapshot()["slo"]/
+# Prometheus/timeline all naming the same SLO. Exit 1 on either failure.
+slo-smoke:
+	JAX_PLATFORMS=cpu python scripts/soak.py --slo --tenants 200 \
+	  --duration-s 4 --qps 2000 --producers 2 --max-batch 256 \
+	  --read-interval-s 0.2 --max-staleness-s 0.5
+	JAX_PLATFORMS=cpu python scripts/soak.py --slo --slo-fault --tenants 200 \
+	  --duration-s 4 --qps 2000 --producers 2 --max-batch 256 \
+	  --read-interval-s 0.2 --max-staleness-s 0.5
 
 # Convert a torchvision Inception3 checkpoint into the .npz the Flax
 # extractor loads: make export-weights CKPT=inception_v3.pth OUT=weights.npz

@@ -1,6 +1,6 @@
 """Runtime telemetry for the metric lifecycle (see ``docs/observability.md``).
 
-Ten pieces, one snapshot:
+Eleven pieces, one snapshot:
 
 * :mod:`~metrics_tpu.observability.registry` — thread-safe per-metric
   counters (update/forward/compute/reset/sync, eager vs. compiled path) and
@@ -34,6 +34,11 @@ Ten pieces, one snapshot:
   (:func:`straggler_report` / :func:`degraded_processes`);
   ``timeline.export_fleet(path)`` merges every process's timeline into ONE
   clock-aligned Perfetto trace with cross-process flow arrows.
+* :mod:`~metrics_tpu.observability.slo` — SLO declarations over the windowed
+  histogram views: multi-window burn-rate / error-budget accounting
+  (:data:`SLO_REGISTRY`), the machine-readable ``breaches()`` hook, and the
+  tick-driven breach watchdog (:data:`WATCHDOG`) that rotates the window
+  rings and emits edge-triggered ``slo`` timeline events.
 * :mod:`~metrics_tpu.observability.export` — :func:`snapshot` (JSON dict) and
   :func:`render_prometheus` (text exposition format; ``aggregated=True``
   renders the fleet view with ``process`` labels).
@@ -59,6 +64,7 @@ from metrics_tpu.observability.cost import program_cost, pytree_nbytes  # noqa: 
 from metrics_tpu.observability.histogram import (  # noqa: F401
     HISTOGRAMS,
     HistogramRegistry,
+    HistogramWindow,
     Log2Histogram,
 )
 from metrics_tpu.observability.events import (  # noqa: F401
@@ -94,6 +100,14 @@ from metrics_tpu.observability.retrace import (  # noqa: F401
     get_retrace_threshold,
     set_retrace_threshold,
 )
+from metrics_tpu.observability.slo import (  # noqa: F401
+    SLO,
+    SLO_REGISTRY,
+    SLORegistry,
+    SLOWatchdog,
+    WATCHDOG,
+    burn_rate,
+)
 
 
 def enable(on: bool = True) -> None:
@@ -114,7 +128,8 @@ def disable() -> None:
 
 def reset() -> None:
     """Clear all recorded counters, timers, sync stats, retrace ledgers,
-    events, histograms, collective spans, async-sync engine counters,
+    events, histograms (window rings included), collective spans, SLO
+    declarations and watchdog state, async-sync engine counters,
     serving-plane counters, durability-plane counters, and health records
     (enablement, policy, step tag survive). Span-id sequence counters and async generations reset
     too — like any collective, reset on every process together or on
@@ -127,6 +142,8 @@ def reset() -> None:
     HEALTH.reset()
     HISTOGRAMS.reset()
     TRACER.clear()
+    SLO_REGISTRY.reset()
+    WATCHDOG.reset()
     from metrics_tpu.utilities import async_sync as _async_sync
 
     if _async_sync._ENGINE is not None:
@@ -151,17 +168,24 @@ __all__ = [
     "HISTOGRAMS",
     "HealthMonitor",
     "HistogramRegistry",
+    "HistogramWindow",
     "Log2Histogram",
     "MONITOR",
     "MetricHealthError",
     "RetraceMonitor",
+    "SLO",
+    "SLORegistry",
+    "SLOWatchdog",
+    "SLO_REGISTRY",
     "SpanTracker",
     "TELEMETRY",
     "TRACER",
     "TelemetryRegistry",
+    "WATCHDOG",
     "aggregate_snapshots",
     "apply_pytree",
     "arg_signature",
+    "burn_rate",
     "degraded_processes",
     "disable",
     "dumps",

@@ -102,7 +102,10 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     # the fleet view is the newest epoch any process has seen
     ("resilience.epoch", "max"),
     ("resilience.*", "sum"),
-    # fast-path histograms (percentiles recomputed after the bucket merge)
+    # fast-path histograms (percentiles recomputed after the bucket merge;
+    # the patterns span the nested ``window`` sub-dict too — windowed bucket
+    # deltas sum elementwise exactly like the cumulative table, and windowed
+    # percentiles are recomputed from the summed window buckets)
     ("histograms.*.buckets.*", "sum"),
     ("histograms.*.count", "sum"),
     ("histograms.*.sum", "sum"),
@@ -110,6 +113,23 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     ("histograms.*.p95", "recompute"),
     ("histograms.*.p99", "recompute"),
     ("histograms.*.*", "last"),
+    # SLO plane: event tallies (good/bad observations, breach transitions,
+    # watchdog ticks) sum across processes; burn rates / budget / breach
+    # state are DERIVED from the summed tallies after the merge — a fleet
+    # burn rate is recomputed from fleet bad/total, never averaged; the
+    # attained percentile takes the worst process pending recompute; declared
+    # config (series, threshold, objective, windows) is identical everywhere
+    # so the last writer wins
+    ("slo.ticks", "sum"),
+    ("slo.breaches_total", "sum"),
+    ("slo.*.breaches_total", "sum"),
+    ("slo.*.total", "sum"),
+    ("slo.*.bad", "sum"),
+    ("slo.*.burn_rate", "recompute"),
+    ("slo.*.budget_remaining", "recompute"),
+    ("slo.*.breached", "recompute"),
+    ("slo.*.window_p", "max"),
+    ("slo.*", "last"),
     # top level
     ("enabled", "any"),
     ("schema", "last"),
@@ -170,12 +190,14 @@ def _merge_trees(snaps: List[Any], path: Tuple[str, ...]) -> Any:
     return _merge_leaves(leaf_reduction(path), snaps)
 
 
-def _recompute_percentiles(entry: Dict[str, Any]) -> None:
+def _recompute_percentiles(entry: Dict[str, Any], unit: Optional[str] = None) -> None:
     """Refresh a merged histogram entry's p50/p95/p99 from its (summed)
-    bucket table — percentiles do not merge, buckets do."""
+    bucket table — percentiles do not merge, buckets do. Recurses into the
+    ``window`` sub-dict so merged *windowed* percentiles are likewise the
+    percentiles of the elementwise-summed window buckets."""
     from metrics_tpu.observability.histogram import Log2Histogram
 
-    unit = entry.get("unit", "s")
+    unit = entry.get("unit", unit or "s")
     buckets = entry.get("buckets")
     if not isinstance(buckets, dict):
         return
@@ -189,6 +211,41 @@ def _recompute_percentiles(entry: Dict[str, Any]) -> None:
     entry["p50"] = round(hist.percentile(50.0), 9)
     entry["p95"] = round(hist.percentile(95.0), 9)
     entry["p99"] = round(hist.percentile(99.0), 9)
+    window = entry.get("window")
+    if isinstance(window, dict):
+        _recompute_percentiles(window, unit)
+
+
+def _recompute_slo(slo_section: Dict[str, Any]) -> None:
+    """Refresh a merged SLO section's derived fields from its (summed) event
+    tallies — a fleet burn rate is bad/total over the *fleet* window, not an
+    average of per-process rates, and the breach verdict follows from the
+    recomputed rates."""
+    from metrics_tpu.observability.slo import burn_rate
+
+    for status in slo_section.get("slos", {}).values():
+        if not isinstance(status, dict):
+            continue
+        objective = float(status.get("objective", 0.99))
+        for window in ("fast", "slow"):
+            stats = status.get(window)
+            if isinstance(stats, dict):
+                stats["burn_rate"] = round(
+                    burn_rate(
+                        float(stats.get("bad", 0)), float(stats.get("total", 0)), objective
+                    ),
+                    6,
+                )
+        fast = status.get("fast", {}) if isinstance(status.get("fast"), dict) else {}
+        slow = status.get("slow", {}) if isinstance(status.get("slow"), dict) else {}
+        status["budget_remaining"] = round(
+            max(0.0, 1.0 - float(slow.get("burn_rate", 0.0))), 6
+        )
+        status["breached"] = bool(
+            float(fast.get("burn_rate", 0.0)) > 1.0
+            and float(slow.get("burn_rate", 0.0)) > 1.0
+            and int(fast.get("total", 0)) > 0
+        )
 
 
 def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -205,6 +262,8 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
     for entry in merged.get("histograms", {}).values():
         if isinstance(entry, dict):
             _recompute_percentiles(entry)
+    if isinstance(merged.get("slo"), dict):
+        _recompute_slo(merged["slo"])
     for entry in merged.get("metrics", {}).values():
         for timer in (entry or {}).get("timers", {}).values():
             if isinstance(timer, dict) and "sum_s" in timer:
@@ -293,6 +352,8 @@ def apply_pytree(snap: Dict[str, Any], state: Dict[str, Any]) -> Dict[str, Any]:
     for entry in out.get("histograms", {}).values():
         if isinstance(entry, dict):
             _recompute_percentiles(entry)
+    if isinstance(out.get("slo"), dict):
+        _recompute_slo(out["slo"])
     return out
 
 

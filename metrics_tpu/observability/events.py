@@ -45,7 +45,7 @@ from typing import Any, Dict, Iterator, List, NamedTuple, Optional
 #: (:mod:`metrics_tpu.resilience`)
 EVENT_KINDS = (
     "update", "forward", "compute", "sync", "retrace", "health", "compile",
-    "tenant_report", "straggler", "serving", "durability", "resilience",
+    "tenant_report", "straggler", "serving", "durability", "resilience", "slo",
 )
 
 #: default bound on retained events; ~100 bytes each, so the default log

@@ -92,8 +92,16 @@ _HELP: Dict[str, str] = {
     "serving_coalesced_refreshes_total": "Stale reads that joined an in-flight refresh.",
     "serving_generation_bumps_total": "Write-generation bumps (one per dispatched flush).",
     "serving_ingest_seconds": "Admission-to-dispatch-complete wall time per event row.",
+    "serving_queue_wait_seconds": "Submit-to-flush-start wall time per event row (host-queue component of ingest).",
+    "serving_dispatch_seconds": "Flush-start-to-dispatch-complete wall time per event row (device component of ingest).",
+    "serving_read_staleness_seconds": "Cache-generation age observed by scheduler reads (0 for fresh hits).",
     "serving_flush_seconds": "One coalesced keyed dispatch's wall time.",
     "serving_queue_depth": "Rows resident at flush time (log2 count histogram).",
+    "slo_budget_remaining": "Error budget left over the SLO's slow window (1 = untouched, 0 = exhausted).",
+    "slo_burn_rate": "Error-budget burn rate per evaluation window (>1 exhausts the budget early).",
+    "slo_breaches_total": "Transitions into breach per SLO (edge-triggered by the watchdog).",
+    "slo_breached": "1 while the SLO is currently breached (both windows burning past budget).",
+    "slo_window_p": "The SLO's target percentile estimated over its fast window.",
     "serving_tenant_cache_hits_total": "Reads served from cache by per-tenant generation freshness (global generation moved, requested tenants untouched).",
     "kernel_dispatch_total": "Pallas-vs-XLA auto-dispatch decisions per kernel op.",
     "durability_saves_total": "Checkpoint snapshots written (full + delta).",
@@ -168,11 +176,19 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
                       "dispatched_rows": int, "flushes": int,
                       "flushes_by_trigger": {...}, "reads": int,
                       "cache_hits": int, "stale_serves": int, ...},
+          "slo": {"window_epoch_s": float, "breaches_total": int,
+                  "ticks": int,
+                  "slos": {name: {"series": str, "threshold": float,
+                           "fast": {"burn_rate": float, ...},
+                           "slow": {"burn_rate": float, ...},
+                           "budget_remaining": float, "breached": bool,
+                           "breaches_total": int, ...}}},
         }
 
     ``async_sync`` is ``{}`` until the first ``compute_async`` constructs
     the background engine; ``serving`` is ``{}`` until the first admission
-    queue is built (:mod:`metrics_tpu.serving`). Always JSON-serializable
+    queue is built (:mod:`metrics_tpu.serving`); ``slo`` is ``{}`` until
+    the first :class:`~metrics_tpu.observability.slo.SLO` is declared. Always JSON-serializable
     (``json.dumps(snapshot())`` round-trips), and mergeable across processes
     by the declared reductions — see
     :func:`~metrics_tpu.observability.aggregate.aggregate_snapshots`.
@@ -206,6 +222,10 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
     # epoch / policy decisions): {} until first touched
     resilience_mod = _sys.modules.get("metrics_tpu.resilience.telemetry")
     snap["resilience"] = resilience_mod.summary() if resilience_mod is not None else {}
+    # the SLO plane: {} until the first SLO is declared
+    from metrics_tpu.observability import slo as _slo
+
+    snap["slo"] = _slo.summary()
     return snap
 
 
@@ -478,6 +498,24 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
                 n,
                 "counter",
             )
+
+    slo = snap.get("slo", {})
+    if slo:
+        # the SLO plane's family: per-declaration budget/burn gauges plus
+        # the edge-triggered breach transition counter — the same evidence
+        # snapshot()["slo"] and SLORegistry.breaches() report
+        for name, st in sorted(slo.get("slos", {}).items()):
+            labels = {**base, "slo": name, "series": str(st.get("series", ""))}
+            out.emit("slo_budget_remaining", labels, st.get("budget_remaining", 1.0))
+            for window in ("fast", "slow"):
+                out.emit(
+                    "slo_burn_rate",
+                    {**labels, "window": window},
+                    st.get(window, {}).get("burn_rate", 0.0),
+                )
+            out.emit("slo_window_p", labels, st.get("window_p", 0.0))
+            out.emit("slo_breached", labels, 1 if st.get("breached") else 0)
+            out.emit("slo_breaches_total", labels, st.get("breaches_total", 0), "counter")
 
     kernels = snap.get("kernels", {})
     for op, paths in sorted(kernels.get("dispatch", {}).items()):

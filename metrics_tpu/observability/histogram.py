@@ -28,10 +28,22 @@ Exported views: :meth:`Log2Histogram.to_dict` carries the bucket table plus
 ``histograms`` key); the Prometheus renderer emits each series in the proper
 histogram exposition form (cumulative ``_bucket{le=...}`` + ``_sum`` +
 ``_count``).
+
+**Windowed views.** Cumulative-since-reset percentiles cannot detect a
+regression that started seconds ago, so every histogram additionally keeps a
+ring of per-epoch bucket *deltas*: :meth:`HistogramRegistry.rotate` (driven by
+the SLO watchdog tick — never a background thread) snapshots
+``current - previous`` bucket counts into the ring, and
+:meth:`Log2Histogram.window` sums the newest epochs (plus the in-progress
+partial epoch) into a :class:`HistogramWindow` view with its own
+p50/p95/p99. The ring lives entirely off the hot path: ``observe`` itself is
+unchanged, byte for byte, and rotation costs one bucket-array copy per series
+per epoch.
 """
 import math
 import threading
-from typing import Any, Dict, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -54,6 +66,105 @@ UNIT_EXP_RANGES = {
     "count": COUNT_EXP_RANGE,
 }
 
+#: ring capacity in epochs — with the default 1 s epoch the longest windowed
+#: view spans ~64 s, enough for a fast (1 min) SRE burn-rate window
+WINDOW_RING_EPOCHS = 64
+#: default epoch length between :meth:`HistogramRegistry.rotate` ticks
+DEFAULT_WINDOW_EPOCH_S = 1.0
+#: default sliding-window length the snapshot view reports
+DEFAULT_WINDOW_S = 30.0
+
+
+def _percentile_from(counts: np.ndarray, min_exp: int, q: float) -> float:
+    """Percentile estimate over a bucket-count array (shared by the live
+    histogram, window views, and the aggregation recompute): linear
+    interpolation inside the covering bucket, clamped at the last finite
+    bound when the rank lands in ``+inf``. 0.0 when empty."""
+    total = int(counts.sum())
+    if total == 0:
+        return 0.0
+    rank = q / 100.0 * total
+    cum = 0
+    for i in range(counts.shape[0]):
+        prev = cum
+        cum += int(counts[i])
+        if cum >= rank and cum > 0:
+            hi = 2.0 ** (min_exp + i)
+            if i == counts.shape[0] - 1:  # +inf bucket: clamp
+                return 2.0 ** (min_exp + i - 1)
+            lo = 2.0 ** (min_exp + i - 1) if i > 0 else 0.0
+            inside = int(counts[i])
+            frac = (rank - prev) / inside if inside else 1.0
+            return float(lo + (hi - lo) * min(max(frac, 0.0), 1.0))
+    return 2.0 ** (min_exp + counts.shape[0] - 2)  # pragma: no cover
+
+
+def _bucket_table(counts: np.ndarray, min_exp: int) -> Dict[str, int]:
+    """The JSON bucket table (``le_<bound>`` -> count, then ``le_inf``)."""
+    buckets = {}
+    for i in range(counts.shape[0] - 1):
+        bound = 2.0 ** (min_exp + i)
+        buckets[f"le_{bound:.9g}"] = int(counts[i])
+    buckets["le_inf"] = int(counts[-1])
+    return buckets
+
+
+class HistogramWindow:
+    """A sliding-window view over a :class:`Log2Histogram`: the elementwise
+    sum of the newest ring epochs plus the in-progress partial epoch.
+
+    Immutable once built; ``count`` is derived from the bucket sum so the
+    triple (buckets, count, sum) is internally consistent even when built
+    while writers race (see :meth:`Log2Histogram.window`)."""
+
+    __slots__ = ("unit", "seconds", "epochs", "_min_exp", "_counts", "_sum")
+
+    def __init__(
+        self,
+        unit: str,
+        min_exp: int,
+        counts: np.ndarray,
+        sum_: float,
+        seconds: float,
+        epochs: int,
+    ) -> None:
+        self.unit = unit
+        self.seconds = float(seconds)
+        self.epochs = int(epochs)
+        self._min_exp = min_exp
+        self._counts = counts
+        self._sum = float(sum_)
+
+    @property
+    def count(self) -> int:
+        return int(self._counts.sum())
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def min_exp(self) -> int:
+        return self._min_exp
+
+    def bucket_counts(self) -> np.ndarray:
+        return self._counts.copy()
+
+    def percentile(self, q: float) -> float:
+        return _percentile_from(self._counts, self._min_exp, q)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "seconds": round(self.seconds, 9),
+            "epochs": self.epochs,
+            "count": self.count,
+            "sum": round(self._sum, 9),
+            "buckets": _bucket_table(self._counts, self._min_exp),
+            "p50": round(self.percentile(50.0), 9),
+            "p95": round(self.percentile(95.0), 9),
+            "p99": round(self.percentile(99.0), 9),
+        }
+
 
 class Log2Histogram:
     """Preallocated fixed-bucket histogram with power-of-two bounds.
@@ -65,9 +176,18 @@ class Log2Histogram:
     and never locks.
     """
 
-    __slots__ = ("unit", "_min_exp", "_counts", "_totals")
+    __slots__ = (
+        "unit",
+        "_min_exp",
+        "_counts",
+        "_totals",
+        "_win_epoch_s",
+        "_win_prev_counts",
+        "_win_prev_sum",
+        "_win_ring",
+    )
 
-    def __init__(self, unit: str = "s") -> None:
+    def __init__(self, unit: str = "s", window_epoch_s: float = DEFAULT_WINDOW_EPOCH_S) -> None:
         if unit not in UNIT_EXP_RANGES:
             raise ValueError(f"unknown histogram unit {unit!r}; known: {sorted(UNIT_EXP_RANGES)}")
         self.unit = unit
@@ -77,6 +197,12 @@ class Log2Histogram:
         self._counts = np.zeros(max_exp - min_exp + 2, dtype=np.int64)
         # [count, sum] — kept in one buffer so observe touches two arrays total
         self._totals = np.zeros(2, dtype=np.float64)
+        # windowing state: previous rotation snapshot + ring of epoch deltas.
+        # Touched only by rotate()/window() — never by observe().
+        self._win_epoch_s = float(window_epoch_s)
+        self._win_prev_counts = np.zeros_like(self._counts)
+        self._win_prev_sum = 0.0
+        self._win_ring: deque = deque(maxlen=WINDOW_RING_EPOCHS)
 
     # -- recording (the fast path) ------------------------------------------
 
@@ -116,32 +242,73 @@ class Log2Histogram:
             2.0 ** (self._min_exp + i) for i in range(self._counts.shape[0] - 1)
         )
 
+    def _consistent_read(self) -> Tuple[np.ndarray, float]:
+        """A tear-resistant ``(bucket copy, sum)`` pair under racing writers.
+
+        ``observe`` writes the bucket first and the sum last, so reading the
+        sum *before* copying the buckets guarantees every observation counted
+        in the returned sum is also present in the returned buckets. Deriving
+        the count from the bucket copy (rather than the separately-raced
+        ``_totals[0]``) then makes the (buckets, count, sum) triple internally
+        consistent: ``count == sum(buckets)`` exactly, and ``sum`` covers a
+        subset of those counted observations."""
+        sum_ = float(self._totals[1])
+        return self._counts.copy(), sum_
+
     def percentile(self, q: float) -> float:
         """Estimate the ``q``-th percentile (``q`` in [0, 100]) from the
         buckets: linear interpolation inside the covering bucket, its upper
         bound when the rank lands in ``+inf``. 0.0 when empty."""
-        total = int(self._totals[0])
-        if total == 0:
-            return 0.0
-        rank = q / 100.0 * total
-        cum = 0
-        for i in range(self._counts.shape[0]):
-            prev = cum
-            cum += int(self._counts[i])
-            if cum >= rank and cum > 0:
-                hi = 2.0 ** (self._min_exp + i)
-                if i == self._counts.shape[0] - 1:  # +inf bucket: clamp
-                    return 2.0 ** (self._min_exp + i - 1)
-                lo = 2.0 ** (self._min_exp + i - 1) if i > 0 else 0.0
-                inside = self._counts[i]
-                frac = (rank - prev) / inside if inside else 1.0
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-        return 2.0 ** (self._min_exp + self._counts.shape[0] - 2)  # pragma: no cover
+        counts, _ = self._consistent_read()
+        return _percentile_from(counts, self._min_exp, q)
 
     def bucket_counts(self) -> np.ndarray:
         """The raw per-bucket counts (finite buckets then +inf) — the
         sum-reducible leaf the aggregation pytree ships."""
         return self._counts.copy()
+
+    # -- windowing -----------------------------------------------------------
+
+    def rotate(self) -> None:
+        """Close the in-progress epoch: push the delta since the previous
+        rotation onto the ring and advance the rotation snapshot. Driven by
+        :meth:`HistogramRegistry.rotate`; never called from the hot path."""
+        counts, sum_ = self._consistent_read()
+        self._win_ring.append((counts - self._win_prev_counts, sum_ - self._win_prev_sum))
+        self._win_prev_counts = counts
+        self._win_prev_sum = sum_
+
+    def window(self, seconds: float) -> HistogramWindow:
+        """A sliding-window view spanning roughly the last ``seconds``: the
+        elementwise sum of the newest ``ceil(seconds / epoch)`` ring deltas
+        plus the in-progress partial epoch. The covered span is quantised to
+        whole epochs (plus the partial), so a window slightly wider than
+        requested is normal; a ring shorter than the request covers what it
+        has."""
+        epochs = max(1, int(math.ceil(float(seconds) / self._win_epoch_s)))
+        counts, sum_ = self._consistent_read()
+        win_counts = counts - self._win_prev_counts  # in-progress partial epoch
+        win_sum = sum_ - self._win_prev_sum
+        taken = 0
+        for delta_counts, delta_sum in list(self._win_ring)[::-1]:
+            if taken >= epochs:
+                break
+            win_counts = win_counts + delta_counts
+            win_sum += delta_sum
+            taken += 1
+        return HistogramWindow(
+            self.unit, self._min_exp, win_counts, win_sum, seconds, taken
+        )
+
+    def reset_window(self, window_epoch_s: Optional[float] = None) -> None:
+        """Drop all window state (and optionally re-epoch); the cumulative
+        counts are untouched."""
+        if window_epoch_s is not None:
+            self._win_epoch_s = float(window_epoch_s)
+        self._win_ring.clear()
+        counts, sum_ = self._consistent_read()
+        self._win_prev_counts = counts
+        self._win_prev_sum = sum_
 
     def merge_counts(self, counts: Any, count: float, sum_: float) -> None:
         """Fold another histogram's raw buckets/totals into this one (the
@@ -155,23 +322,25 @@ class Log2Histogram:
         self._totals[0] += float(count)
         self._totals[1] += float(sum_)
 
-    def to_dict(self) -> Dict[str, Any]:
+    def to_dict(self, window_seconds: Optional[float] = None) -> Dict[str, Any]:
         """JSON view: bucket table (``le_<bound>`` -> count), totals, and the
-        p50/p95/p99 estimates."""
-        buckets = {}
-        for i in range(self._counts.shape[0] - 1):
-            bound = 2.0 ** (self._min_exp + i)
-            buckets[f"le_{bound:.9g}"] = int(self._counts[i])
-        buckets["le_inf"] = int(self._counts[-1])
-        return {
+        p50/p95/p99 estimates, all derived from one consistent bucket copy
+        (count == bucket total even under racing writers). With
+        ``window_seconds`` the view additionally carries a ``window``
+        sub-dict (the sliding-window buckets and percentiles)."""
+        counts, sum_ = self._consistent_read()
+        out = {
             "unit": self.unit,
-            "count": self.count,
-            "sum": round(self.sum, 9),
-            "buckets": buckets,
-            "p50": round(self.percentile(50.0), 9),
-            "p95": round(self.percentile(95.0), 9),
-            "p99": round(self.percentile(99.0), 9),
+            "count": int(counts.sum()),
+            "sum": round(sum_, 9),
+            "buckets": _bucket_table(counts, self._min_exp),
+            "p50": round(_percentile_from(counts, self._min_exp, 50.0), 9),
+            "p95": round(_percentile_from(counts, self._min_exp, 95.0), 9),
+            "p99": round(_percentile_from(counts, self._min_exp, 99.0), 9),
         }
+        if window_seconds is not None:
+            out["window"] = self.window(window_seconds).to_dict()
+        return out
 
 
 def _series_key(name: str, labels: Dict[str, str]) -> str:
@@ -194,6 +363,10 @@ class HistogramRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._series: Dict[str, Tuple[Log2Histogram, Dict[str, str], str]] = {}
+        self._win_epoch_s = DEFAULT_WINDOW_EPOCH_S
+        self.window_seconds = DEFAULT_WINDOW_S
+        self._win_last_rotate: Optional[float] = None
+        self._win_rotations = 0
 
     def get(self, name: str, unit: str = "s", **labels: str) -> Log2Histogram:
         """The series' histogram, created (under the lock) on first use."""
@@ -203,22 +376,82 @@ class HistogramRegistry:
             with self._lock:
                 entry = self._series.get(key)
                 if entry is None:
-                    entry = (Log2Histogram(unit), dict(labels), name)
+                    entry = (
+                        Log2Histogram(unit, window_epoch_s=self._win_epoch_s),
+                        dict(labels),
+                        name,
+                    )
                     self._series[key] = entry
         return entry[0]
 
     def observe(self, name: str, value: float, unit: str = "s", **labels: str) -> None:
         self.get(name, unit=unit, **labels).observe(float(value))
 
+    # -- windowing -----------------------------------------------------------
+
+    @property
+    def window_epoch_s(self) -> float:
+        return self._win_epoch_s
+
+    def set_window_epoch(self, epoch_s: float, window_seconds: Optional[float] = None) -> None:
+        """Re-epoch the window ring for every series (dropping existing
+        window state — the cumulative buckets are untouched) and optionally
+        change the default window length :meth:`snapshot` reports."""
+        if epoch_s <= 0.0:
+            raise ValueError(f"window epoch must be positive, got {epoch_s!r}")
+        with self._lock:
+            self._win_epoch_s = float(epoch_s)
+            if window_seconds is not None:
+                self.window_seconds = float(window_seconds)
+            self._win_last_rotate = None
+            self._win_rotations = 0
+            items = list(self._series.values())
+        for hist, _, _ in items:
+            hist.reset_window(window_epoch_s=epoch_s)
+
+    def rotate(self, now: float) -> int:
+        """Advance every series' window ring to ``now`` (a monotonic-clock
+        reading): one rotation per elapsed epoch, capped at the ring length
+        so a long-idle process catches up in bounded work. Returns the number
+        of rotations performed (0 when within the current epoch)."""
+        with self._lock:
+            if self._win_last_rotate is None:
+                self._win_last_rotate = float(now)
+                return 0
+            elapsed = float(now) - self._win_last_rotate
+            if elapsed < self._win_epoch_s:
+                return 0
+            pending = int(elapsed // self._win_epoch_s)
+            self._win_last_rotate += pending * self._win_epoch_s
+            pending = min(pending, WINDOW_RING_EPOCHS)
+            self._win_rotations += pending
+            items = list(self._series.values())
+        for hist, _, _ in items:
+            # the first rotation absorbs the full delta; extra catch-up
+            # rotations push empty epochs so window spans stay honest
+            for _ in range(pending):
+                hist.rotate()
+        return pending
+
+    def series_items(self) -> List[Tuple[str, Log2Histogram, Dict[str, str], str]]:
+        """A consistent ``(key, histogram, labels, name)`` listing — the
+        selector surface :mod:`~metrics_tpu.observability.slo` matches SLO
+        declarations against."""
+        with self._lock:
+            items = list(self._series.items())
+        return [(key, hist, dict(labels), name) for key, (hist, labels, name) in items]
+
     def snapshot(self) -> Dict[str, Any]:
         """JSON view keyed by series: bucket tables, totals, percentiles,
-        and the series' name/labels split back out (for renderers)."""
+        the sliding-window view (``window_seconds`` long), and the series'
+        name/labels split back out (for renderers)."""
         out: Dict[str, Any] = {}
         # snapshot iterates a live dict: take a consistent key list first
         with self._lock:
             items = list(self._series.items())
+            window_s = self.window_seconds
         for key, (hist, labels, name) in items:
-            entry = hist.to_dict()
+            entry = hist.to_dict(window_seconds=window_s)
             entry["name"] = name
             if labels:
                 entry["labels"] = dict(labels)
@@ -228,6 +461,10 @@ class HistogramRegistry:
     def reset(self) -> None:
         with self._lock:
             self._series.clear()
+            self._win_epoch_s = DEFAULT_WINDOW_EPOCH_S
+            self.window_seconds = DEFAULT_WINDOW_S
+            self._win_last_rotate = None
+            self._win_rotations = 0
 
 
 #: the process-global fast-path histogram registry

@@ -39,6 +39,10 @@ GLOBAL_TRACK = "<global>"
 #: track name collective spans render on (per process in the fleet view)
 COLLECTIVES_TRACK = "<collectives>"
 
+#: track name the request-scoped serving spans render on (submit →
+#: enqueue-wait → dispatch → read, joined by flow arrows)
+SERVING_TRACK = "<serving>"
+
 
 def _json_safe(value: Any) -> Any:
     """Best-effort coercion of payload values the recorders hand us (tuples,
@@ -121,14 +125,114 @@ def _append_events(
         trace.append(record)
 
 
+def _append_serving_spans(
+    trace: List[Dict[str, Any]], pid: int, tid_for: Any, spans: Sequence[Any]
+) -> None:
+    """Render the ``serving``-kind spans as a ``<serving>`` track of slices
+    plus request-scoped flow arrows:
+
+    * **submit → dispatch**: a dispatch span's payload carries the cohort
+      (submit-span) ids it coalesced; each cohort present in the ledger gets
+      one flow start at its submit slice and a finish at every dispatch
+      slice that drained rows from it.
+    * **dispatch → read**: a read span's ``flush_span`` payload references
+      the dispatch that produced the cache it served; each referenced
+      dispatch gets one flow start at its exit and a finish at every such
+      read.
+
+    Starts and finishes are emitted together, only for chains whose BOTH
+    endpoints survive in the bounded span ledger — a dangling flow is the
+    silent-drop failure mode ``check_trace.py`` exists to catch."""
+    serving = [s for s in spans if s.kind == "serving"]
+    if not serving:
+        return
+    tid = tid_for(SERVING_TRACK)
+    by_id = {s.span_id: s for s in serving}
+    for s in sorted(serving, key=lambda s: (s.enter_s, s.seq)):
+        args = {str(k): _json_safe(v) for k, v in s.payload.items()}
+        args.update(span_id=s.span_id, group=s.group, seq=s.seq)
+        if s.step is not None:
+            args["step"] = s.step
+        trace.append(
+            {
+                "ph": "X",
+                "name": f"serving.{s.bucket}",
+                "cat": "serving",
+                "pid": pid,
+                "tid": tid,
+                "ts": round(s.enter_s * 1e6, 3),
+                "dur": round(max(0.0, s.exit_s - s.enter_s) * 1e6, 3),
+                "args": args,
+            }
+        )
+    # chain id -> (start ts_s, [finish ts_s, ...]); ids are span ids, which
+    # are unique per chain kind (submit ids vs dispatch ids)
+    chains: Dict[str, Any] = {}
+    for s in serving:
+        if s.bucket == "dispatch":
+            for cohort in s.payload.get("cohorts") or []:
+                sub = by_id.get(cohort)
+                if sub is not None:
+                    chains.setdefault(cohort, (sub.enter_s, []))[1].append(
+                        max(s.enter_s, sub.enter_s)
+                    )
+        elif s.bucket == "read":
+            flush = s.payload.get("flush_span")
+            disp = by_id.get(flush) if flush else None
+            if disp is not None:
+                # the read ends after the cache its flush fed was installed,
+                # so the finish lands at the read's exit (never before the
+                # dispatch's own exit — a miss overlaps its refresh)
+                chains.setdefault(flush, (disp.exit_s, []))[1].append(
+                    max(s.exit_s, disp.exit_s)
+                )
+    for chain_id in sorted(chains):
+        start_ts, finishes = chains[chain_id]
+        trace.append(
+            {
+                "ph": "s",
+                "name": "serving_request",
+                "cat": "serving_flow",
+                "id": chain_id,
+                "pid": pid,
+                "tid": tid,
+                "ts": round(start_ts * 1e6, 3),
+                "args": {"span_id": chain_id},
+            }
+        )
+        for f_ts in sorted(finishes):
+            trace.append(
+                {
+                    "ph": "f",
+                    "bp": "e",
+                    "name": "serving_request",
+                    "cat": "serving_flow",
+                    "id": chain_id,
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": round(f_ts * 1e6, 3),
+                    "args": {"span_id": chain_id},
+                }
+            )
+
+
 def to_chrome_trace(
-    events: Optional[Sequence[Event]] = None, log: Optional[EventLog] = None
+    events: Optional[Sequence[Event]] = None,
+    log: Optional[EventLog] = None,
+    tracker: Optional[Any] = None,
 ) -> Dict[str, Any]:
     """Build the Chrome-trace dict (``{"traceEvents": [...], ...}``) from
-    ``events`` (default: the global log's retained events)."""
+    ``events`` (default: the global log's retained events) plus the serving
+    track (``tracker`` defaults to the global
+    :data:`~metrics_tpu.observability.tracing.TRACER`; its ``serving``-kind
+    spans render as slices with request flow arrows)."""
+    from metrics_tpu.observability.tracing import TRACER
+
     log = EVENTS if log is None else log
     if events is None:
         events = log.events()
+    if tracker is None:
+        tracker = TRACER
     pid = os.getpid()
 
     trace: List[Dict[str, Any]] = [
@@ -140,7 +244,9 @@ def to_chrome_trace(
             "args": {"name": "metrics_tpu"},
         }
     ]
-    _append_events(trace, pid, events, _track_allocator(trace, pid))
+    tid_for = _track_allocator(trace, pid)
+    _append_events(trace, pid, events, tid_for)
+    _append_serving_spans(trace, pid, tid_for, tracker.records())
 
     return {
         "traceEvents": trace,
@@ -154,7 +260,10 @@ def to_chrome_trace(
 
 
 def export(
-    path: str, events: Optional[Sequence[Event]] = None, log: Optional[EventLog] = None
+    path: str,
+    events: Optional[Sequence[Event]] = None,
+    log: Optional[EventLog] = None,
+    tracker: Optional[Any] = None,
 ) -> str:
     """Write the Chrome-trace JSON to ``path`` and return ``path``. The file
     loads directly in ``chrome://tracing`` and https://ui.perfetto.dev.
@@ -167,7 +276,7 @@ def export(
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    trace = to_chrome_trace(events, log=log)
+    trace = to_chrome_trace(events, log=log, tracker=tracker)
     with open(path, "w") as fh:
         json.dump(trace, fh)
     return path

@@ -222,6 +222,32 @@ class SpanTracker:
             return None
         return self._append(span, span.enter_s, payload)
 
+    def record_span(
+        self,
+        kind: str,
+        group: str = "all",
+        bucket: str = "-",
+        *,
+        enter_ago_s: float = 0.0,
+        exit_ago_s: float = 0.0,
+        **payload: Any,
+    ) -> Optional[str]:
+        """Record an already-elapsed interval retroactively: the span entered
+        ``enter_ago_s`` seconds before now and exited ``exit_ago_s`` seconds
+        before now (``enter_ago_s >= exit_ago_s >= 0``). The serving plane
+        uses this at flush time — enqueue-wait and dispatch intervals are
+        only known once the batch completes, but their endpoints were stamped
+        on the monotonic clock as they happened. Returns the span id."""
+        if not self._enabled:
+            return None
+        span = self.begin(kind, group=group, bucket=bucket)
+        if span is None:  # pragma: no cover - disabled race
+            return None
+        now = span.enter_s
+        enter_s = now - max(float(enter_ago_s), 0.0)
+        exit_s = now - min(max(float(exit_ago_s), 0.0), max(float(enter_ago_s), 0.0))
+        return self._append(span._replace(enter_s=enter_s), exit_s, payload)
+
     # -- reading ------------------------------------------------------------
 
     def records(self) -> List[CollectiveSpan]:

@@ -38,12 +38,15 @@ import numpy as np
 
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.observability.tracing import TRACER
 from metrics_tpu.serving.policy import AdmissionPolicy, resolve_policy
 from metrics_tpu.serving.telemetry import (
     SERVING_STATS,
+    observe_dispatch_latency,
     observe_flush,
     observe_ingest,
     observe_queue_depth,
+    observe_queue_wait,
 )
 from metrics_tpu.utilities.prints import rank_zero_warn
 
@@ -56,6 +59,9 @@ DEFAULT_MAX_DELAY_MS = 5.0
 #: retained poisoned rows (the dead-letter sample an operator inspects);
 #: the COUNT is exact regardless — it rides the shed ledger
 DEAD_LETTER_CAP = 32
+#: distinct submit-cohort ids carried on one dispatch span's payload (a
+#: flush can coalesce thousands of rows; the trace stays bounded)
+SPAN_COHORT_CAP = 64
 
 
 def _consult_fault_seam(seam: str, **ctx: Any) -> Any:
@@ -185,8 +191,10 @@ class AdmissionQueue:
             )
 
         self._cv = threading.Condition()
-        #: resident rows, oldest first: (tenant, args, t_submit)
-        self._pending: List[Tuple[int, Tuple, float]] = []
+        #: resident rows, oldest first: (tenant, args, t_submit, cohort) —
+        #: cohort is the submit span id joining this row's serving trace
+        #: (None while the tracer is disabled)
+        self._pending: List[Tuple[int, Tuple, float, Optional[str]]] = []
         self._per_tenant: Dict[int, int] = {}
         self._closed = False
         self._flush_now = False
@@ -207,6 +215,10 @@ class AdmissionQueue:
         #: bounded sample of quarantined rows (tenant, args); the exact
         #: dead-letter COUNT rides shed_by_reason["poisoned"]
         self._dead_letters: deque = deque(maxlen=DEAD_LETTER_CAP)
+        #: newest successful dispatch span id — the scheduler stamps it on
+        #: the cache it installs so read spans can point at the flush that
+        #: produced the values they serve
+        self._last_dispatch_span: Optional[str] = None
         self.telemetry_key = TELEMETRY.register(self)
         SERVING_STATS.register_queue(self)
         if start:
@@ -238,16 +250,22 @@ class AdmissionQueue:
         n = int(ids.shape[0])
         if n == 0:
             return 0
+        # the submit span: one per cohort (this call), its deterministic id
+        # carried on every admitted row as the trace-correlation key the
+        # dispatch span's flow arrow points back to
+        span = TRACER.begin("serving", group=self.telemetry_key, bucket="submit")
+        cohort = span.span_id if span is not None else None
         now = time.perf_counter()
         admitted = 0
         shed: Dict[str, int] = {}
         with self._cv:
             if self._closed:
+                TRACER.end(span, rows=n, error="queue_closed")
                 raise QueueClosedError("AdmissionQueue is closed")
             self._note_submitted(n)
             for i in range(n):
                 tenant = int(ids[i])
-                row = (tenant, tuple(c[i] for c in ncols), now)
+                row = (tenant, tuple(c[i] for c in ncols), now, cohort)
                 reason = self._admit_locked(row)
                 if reason is None:
                     admitted += 1
@@ -256,6 +274,7 @@ class AdmissionQueue:
             self._cv.notify_all()
         if shed:
             self._account_shed(shed)
+        TRACER.end(span, rows=n, admitted=admitted, shed=n - admitted)
         return admitted
 
     def _note_submitted(self, n: int) -> None:
@@ -264,7 +283,7 @@ class AdmissionQueue:
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, "submitted_rows", n)
 
-    def _admit_locked(self, row: Tuple[int, Tuple, float]) -> Optional[str]:
+    def _admit_locked(self, row: Tuple[int, Tuple, float, Optional[str]]) -> Optional[str]:
         """Admit ``row`` under the lock, or return the shed reason."""
         policy = self.policy
         if policy.name == "shed_tenant_over_quota":
@@ -374,7 +393,7 @@ class AdmissionQueue:
                 del self._pending[: self.max_batch]
                 if not self._pending:
                     self._flush_now = False
-                for tenant, _, _ in rows:
+                for tenant, _, _, _ in rows:
                     left = self._per_tenant.get(tenant, 0) - 1
                     if left > 0:
                         self._per_tenant[tenant] = left
@@ -463,7 +482,7 @@ class AdmissionQueue:
     def _shed_rows(
         self,
         reason: str,
-        rows: List[Tuple[int, Tuple, float]],
+        rows: List[Tuple[int, Tuple, float, Optional[str]]],
         *,
         dead_letter: bool = False,
     ) -> None:
@@ -497,7 +516,7 @@ class AdmissionQueue:
     def _note_flush(
         self,
         trigger: str,
-        rows: List[Tuple[int, Tuple, float]],
+        rows: List[Tuple[int, Tuple, float, Optional[str]]],
         depth_before: int,
         dur: float,
         end: float,
@@ -529,14 +548,57 @@ class AdmissionQueue:
                     UserWarning,
                 )
         SERVING_STATS.flush(trigger, n if error is None else 0, depth_before)
+        t_start = end - dur  # flush start on the same perf_counter clock
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, "flushes")
             if error is None:
                 TELEMETRY.inc(self.telemetry_key, "dispatched_rows", n)
             observe_flush(dur, trigger)
             observe_queue_depth(depth_before)
-            for _, _, t_submit in rows:
+            for _, _, t_submit, _ in rows:
                 observe_ingest(end - t_submit, self.policy.name)
+                # the two components of ingest: host-queue wait (submit →
+                # flush start) and device dispatch (flush start → complete,
+                # row-weighted so counts line up across the three series)
+                observe_queue_wait(max(0.0, t_start - t_submit), self.policy.name)
+                observe_dispatch_latency(dur, self.policy.name)
+        if rows and TRACER.enabled:
+            # retro-dated serving spans: the enqueue-wait interval (oldest
+            # submit → flush start) and the dispatch interval (flush start →
+            # complete) are only known now, but their endpoints were stamped
+            # on the perf_counter clock as they happened
+            pc_now = time.perf_counter()
+            cohorts: List[str] = []
+            for _, _, _, cohort in rows:
+                if cohort is not None and cohort not in cohorts:
+                    cohorts.append(cohort)
+            dropped_cohorts = max(0, len(cohorts) - SPAN_COHORT_CAP)
+            cohorts = cohorts[:SPAN_COHORT_CAP]
+            oldest_submit = min(r[2] for r in rows)
+            TRACER.record_span(
+                "serving",
+                group=self.telemetry_key,
+                bucket="wait",
+                enter_ago_s=pc_now - oldest_submit,
+                exit_ago_s=pc_now - t_start,
+                rows=n,
+                trigger=trigger,
+            )
+            dispatch_span = TRACER.record_span(
+                "serving",
+                group=self.telemetry_key,
+                bucket="dispatch",
+                enter_ago_s=pc_now - t_start,
+                exit_ago_s=pc_now - end,
+                rows=n,
+                trigger=trigger,
+                cohorts=cohorts,
+                dropped_cohorts=dropped_cohorts,
+                error=(f"{type(error).__name__}: {error}" if error else None),
+            )
+            if error is None and dispatch_span is not None:
+                with self._cv:
+                    self._last_dispatch_span = dispatch_span
         if EVENTS.enabled:
             EVENTS.record(
                 "serving",
@@ -599,6 +661,13 @@ class AdmissionQueue:
         """Rows currently resident (point-in-time)."""
         with self._cv:
             return len(self._pending)
+
+    def last_dispatch_span(self) -> Optional[str]:
+        """The newest successful dispatch span id (``None`` before the
+        first traced flush) — the scheduler stamps it on installed caches
+        so read spans can reference the flush they serve from."""
+        with self._cv:
+            return self._last_dispatch_span
 
     def stats(self) -> Dict[str, Any]:
         """The queue's exact ledger: submitted/admitted/shed (by reason)/

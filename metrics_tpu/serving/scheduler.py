@@ -48,8 +48,9 @@ import numpy as np
 
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.registry import TELEMETRY
+from metrics_tpu.observability.tracing import TRACER
 from metrics_tpu.serving.queue import AdmissionQueue
-from metrics_tpu.serving.telemetry import SERVING_STATS
+from metrics_tpu.serving.telemetry import SERVING_STATS, observe_read_staleness
 
 __all__ = ["SLOScheduler"]
 
@@ -216,10 +217,36 @@ class SLOScheduler:
         computed, even if OTHER tenants' flushes moved the global
         generation. ``max_staleness_s`` overrides the scheduler default for
         this read; ``0`` forces read-your-writes freshness for the
-        requested tenants (flush + recompute when any of them changed)."""
+        requested tenants (flush + recompute when any of them changed).
+
+        Every read records a ``serving`` read span (outcome, staleness, and
+        cache-generation evidence; ``flush_span`` references the dispatch
+        span whose flush produced the served cache) and feeds the
+        ``serving_read_staleness_seconds`` histogram the staleness SLO
+        evaluates."""
         SERVING_STATS.inc("reads")
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, "reads")
+        span = TRACER.begin("serving", group=self.telemetry_key, bucket="read")
+        try:
+            values, outcome, evidence = self._read_once(tenant_ids, max_staleness_s)
+        except BaseException as err:
+            TRACER.end(span, outcome="error", error=f"{type(err).__name__}: {err}")
+            raise
+        if TELEMETRY.enabled:
+            observe_read_staleness(evidence.get("staleness_s", 0.0), outcome)
+        TRACER.end(span, outcome=outcome, **evidence)
+        return values
+
+    def _read_once(
+        self, tenant_ids: Optional[Any], max_staleness_s: Optional[float]
+    ) -> Any:
+        """One read's control flow; returns ``(selected values, outcome,
+        evidence)`` where evidence is the JSON payload the read span and the
+        staleness histogram share. ``staleness_s`` is the served cache's age
+        for stale serves and 0 otherwise — a fresh (generation-matched)
+        value is current no matter how old, so an idle service does not
+        false-breach its staleness SLO."""
         budget = self.max_staleness_s if max_staleness_s is None else float(max_staleness_s)
         now = time.monotonic()
         ids = None if tenant_ids is None else np.asarray(tenant_ids).reshape(-1)
@@ -243,10 +270,19 @@ class SLOScheduler:
                     for t in ids
                 )
             )
+
+        def _evidence(entry: Optional[Dict[str, Any]], staleness: float) -> Dict[str, Any]:
+            return {
+                "staleness_s": round(max(0.0, staleness), 9),
+                "generation": generation,
+                "cache_generation": entry["generation"] if entry else None,
+                "flush_span": entry.get("span") if entry else None,
+            }
+
         if cache is not None and self.queue.depth() == 0:
             if cache["generation"] == generation:
                 SERVING_STATS.inc("cache_hits")
-                return _select(cache["values"], tenant_ids)
+                return _select(cache["values"], tenant_ids), "cache_hit", _evidence(cache, 0.0)
             if tenant_scoped_fresh:
                 # other tenants' flushes moved the generation, but every
                 # requested tenant is unchanged since the cache computed —
@@ -255,7 +291,11 @@ class SLOScheduler:
                 SERVING_STATS.inc("tenant_cache_hits")
                 if TELEMETRY.enabled:
                     TELEMETRY.inc(self.telemetry_key, "tenant_cache_hits")
-                return _select(cache["values"], tenant_ids)
+                return (
+                    _select(cache["values"], tenant_ids),
+                    "tenant_cache_hit",
+                    _evidence(cache, 0.0),
+                )
         if cache is not None and (now - cache["at"]) <= budget:
             # within the SLO: serve the stale generation immediately and
             # refresh in the background — a dashboard value a moment old
@@ -263,12 +303,18 @@ class SLOScheduler:
             # stale-serving trade, applied to the result cache)
             SERVING_STATS.inc("stale_serves")
             self._ensure_refresh()
-            return _select(cache["values"], tenant_ids)
+            return (
+                _select(cache["values"], tenant_ids),
+                "stale_serve",
+                _evidence(cache, now - cache["at"]),
+            )
         SERVING_STATS.inc("cache_misses")
         future, target = self._ensure_refresh()
         values = future.result(timeout=self.read_timeout_s)
         self._install_cache(target, values)
-        return _select(values, tenant_ids)
+        with self._lock:
+            installed = self._cache
+        return _select(values, tenant_ids), "cache_miss", _evidence(installed, 0.0)
 
     def refresh(self, wait: bool = False) -> Any:
         """Schedule (or join) a cache refresh; returns the refresh's
@@ -340,6 +386,9 @@ class SLOScheduler:
         return future, target
 
     def _install_cache(self, generation: int, values: Any) -> None:
+        # the newest successful dispatch span joins the cache entry so read
+        # spans can point a flow arrow at the flush that fed their values
+        flush_span = self.queue.last_dispatch_span()
         with self._lock:
             if self._cache is None or self._cache["generation"] <= generation:
                 self._cache = {
@@ -347,6 +396,7 @@ class SLOScheduler:
                     "values": values,
                     "at": time.monotonic(),
                     "epoch": _membership_epoch(),
+                    "span": flush_span,
                 }
 
     # ------------------------------------------------------------------

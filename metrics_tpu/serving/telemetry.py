@@ -14,10 +14,16 @@ async-sync engine's family:
 * the ``metrics_tpu_serving_*`` Prometheus series
   (:func:`~metrics_tpu.observability.export.render_prometheus`).
 * fast-path log2 histograms: ``serving_ingest_seconds`` (admission →
-  dispatch-complete wall time per row batch), ``serving_flush_seconds``
-  (one coalesced dispatch), and ``serving_queue_depth`` (rows resident at
-  flush time, unit ``count``) — mergeable bucket tables like every other
-  histogram family.
+  dispatch-complete wall time per row batch) and its two components —
+  ``serving_queue_wait_seconds`` (submit → flush start, host-queue time) and
+  ``serving_dispatch_seconds`` (flush start → dispatch complete, device
+  time) — so a p99 regression attributes to queueing vs dispatch;
+  ``serving_flush_seconds`` (one coalesced dispatch),
+  ``serving_queue_depth`` (rows resident at flush time, unit ``count``),
+  and ``serving_read_staleness_seconds`` (age of the cache generation a
+  stale read served) — mergeable bucket tables like every other histogram
+  family, each with sliding-window percentiles the SLO plane
+  (:mod:`~metrics_tpu.observability.slo`) evaluates burn rates over.
 
 Everything here is host-side bookkeeping behind the same lock-free
 ``TELEMETRY.enabled`` gate the rest of the observability stack uses; the
@@ -33,21 +39,47 @@ from metrics_tpu.observability.registry import TELEMETRY
 __all__ = [
     "SERVING_STATS",
     "ServingStats",
+    "observe_dispatch_latency",
     "observe_flush",
     "observe_ingest",
     "observe_queue_depth",
+    "observe_queue_wait",
+    "observe_read_staleness",
     "summary",
 ]
 
 #: canonical fast-path histogram series of the serving plane
 INGEST_SECONDS = "serving_ingest_seconds"
+QUEUE_WAIT_SECONDS = "serving_queue_wait_seconds"
+DISPATCH_SECONDS = "serving_dispatch_seconds"
 FLUSH_SECONDS = "serving_flush_seconds"
 QUEUE_DEPTH = "serving_queue_depth"
+READ_STALENESS_SECONDS = "serving_read_staleness_seconds"
 
 
 def observe_ingest(seconds: float, policy: str) -> None:
     """Admission-to-dispatch-complete wall time of one row cohort."""
     HISTOGRAMS.observe(INGEST_SECONDS, seconds, unit="s", policy=policy)
+
+
+def observe_queue_wait(seconds: float, policy: str) -> None:
+    """Submit → flush-start wall time of one row: the host-queue component
+    of :data:`INGEST_SECONDS`."""
+    HISTOGRAMS.observe(QUEUE_WAIT_SECONDS, seconds, unit="s", policy=policy)
+
+
+def observe_dispatch_latency(seconds: float, policy: str) -> None:
+    """Flush-start → dispatch-complete wall time of one row's cohort: the
+    device component of :data:`INGEST_SECONDS` (row-weighted — every row in
+    a cohort records the cohort's dispatch time, so counts line up with the
+    ingest series)."""
+    HISTOGRAMS.observe(DISPATCH_SECONDS, seconds, unit="s", policy=policy)
+
+
+def observe_read_staleness(seconds: float, outcome: str) -> None:
+    """Cache-generation age a scheduler read observed (0 for fresh hits;
+    the served age for stale serves)."""
+    HISTOGRAMS.observe(READ_STALENESS_SECONDS, seconds, unit="s", outcome=outcome)
 
 
 def observe_flush(seconds: float, trigger: str) -> None:

@@ -2312,6 +2312,74 @@ def bench_failover_mttr():
 bench_failover_mttr._force_cpu = True
 
 
+def bench_slo_overhead():
+    """The SLO plane's steady-state cost on the instrumented eager update
+    loop: the identical step measured with the plane idle (telemetry on,
+    nothing declared) and then fully active — 8 declared SLOs over the
+    fast-path ``dispatch_seconds`` series with a watchdog tick (window
+    rotation + full multi-window evaluation) EVERY step, a far harsher
+    cadence than any real scrape loop. ``value`` is the active per-step
+    time; the idle loop is the baseline, so ``vs_baseline`` close to 1
+    means the watchdog is effectively free at serving cadence. The record
+    carries the split (idle vs active, overhead per step) and the tick /
+    evaluation counts."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, observability
+    from metrics_tpu.observability.histogram import HISTOGRAMS
+    from metrics_tpu.observability.slo import SLO_REGISTRY, WATCHDOG
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH,)))
+
+    observability.reset()
+    observability.enable()
+    metric = Accuracy()
+
+    def step():
+        metric.update(preds, target)
+
+    off_s = _time_eager_loop(step)
+
+    HISTOGRAMS.set_window_epoch(0.25)
+    for i in range(8):
+        SLO_REGISTRY.declare(
+            name=f"dispatch-p{50 + 6 * i}",
+            series="dispatch_seconds",
+            threshold=0.05 * (i + 1),
+            percentile=50.0 + 6.0 * i,
+        )
+
+    def step_active():
+        metric.update(preds, target)
+        WATCHDOG.tick()
+
+    on_s = _time_eager_loop(step_active)
+    ticks = int(WATCHDOG.ticks)
+    evaluated = len(SLO_REGISTRY.evaluate())
+    observability.reset()
+
+    extra = {
+        "slos": 8,
+        "ticks": ticks,
+        "evaluated_slos": evaluated,
+        "slo_idle_us": round(off_s * 1e6, 3),
+        "slo_active_us": round(on_s * 1e6, 3),
+        "overhead_us_per_step": round((on_s - off_s) * 1e6, 3),
+        "overhead_pct": round((on_s - off_s) / off_s * 100.0, 2) if off_s else None,
+    }
+
+    def ref(torchmetrics, torch):  # the SLO-idle loop is the baseline
+        return off_s
+
+    return "slo_overhead_step", on_s, ref, "us/step", extra
+
+
+#: host-side watchdog arithmetic; the device does not participate
+bench_slo_overhead._force_cpu = True
+
+
 CONFIG_META = {
     "bench_accuracy": ("accuracy_update_step", "us/step"),
     "bench_collection": ("metric_collection_update_step_fused", "us/step"),
@@ -2342,6 +2410,7 @@ CONFIG_META = {
     "bench_tenant_spill": ("tenant_spill_faultback", "us/tenant"),
     "bench_chaos_soak": ("chaos_soak_step", "us/ingest-p99"),
     "bench_failover_mttr": ("failover_mttr", "ms/failover"),
+    "bench_slo_overhead": ("slo_overhead_step", "us/step"),
 }
 
 #: driver order — the flagship collection config LAST (the driver's headline)
@@ -2374,6 +2443,7 @@ CONFIGS = [
     bench_tenant_spill,
     bench_chaos_soak,
     bench_failover_mttr,
+    bench_slo_overhead,
     bench_collection,
 ]
 

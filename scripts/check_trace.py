@@ -21,10 +21,16 @@ the exporters promise:
   unpaired flows are the precise failure mode that silently loses the
   cross-process arrows ``export_fleet`` exists to draw.
 
+Additionally, :func:`validate_serving_trace` checks the serving-track
+contract: an exported trace that carries serving spans must name the
+``<serving>`` track, hold the full request-scoped slice chain (submit →
+wait → dispatch → read), and draw at least one ``serving_flow`` arrow.
+
 Run modes: ``python scripts/check_trace.py FILE...`` validates existing
 trace files (exit 1 on any violation); ``--selftest`` exports fresh traces —
-a never-written log, an exercised single-process timeline, and a
-(single-process) fleet export — and validates those, which is what ``make
+a never-written log, an exercised single-process timeline, a
+(single-process) fleet export, and a serving-plane trace exercised through
+a real ``SLOScheduler`` — and validates those, which is what ``make
 trace-check`` (wired into ``make ci``) runs. The test suite imports
 :func:`validate_chrome_trace` directly over both exporters' output.
 """
@@ -119,6 +125,46 @@ def validate_chrome_trace(doc: Any) -> List[str]:
     return errors
 
 
+def validate_serving_trace(doc: Any) -> List[str]:
+    """Serving-track contract over an exported trace that should carry
+    serving spans: the ``<serving>`` thread is named, every request-scoped
+    slice kind is present (submit / wait / dispatch / read), and at least
+    one ``serving_flow`` arrow joins them. Returns violations (empty when
+    valid)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict) or not isinstance(doc.get("traceEvents"), list):
+        return ["serving trace document is missing the 'traceEvents' list"]
+    events = doc["traceEvents"]
+    named = any(
+        ev.get("ph") == "M"
+        and ev.get("name") == "thread_name"
+        and isinstance(ev.get("args"), dict)
+        and ev["args"].get("name") == "<serving>"
+        for ev in events
+        if isinstance(ev, dict)
+    )
+    if not named:
+        errors.append("no '<serving>' thread_name metadata — the serving track is missing")
+    slices = {
+        ev.get("name")
+        for ev in events
+        if isinstance(ev, dict) and ev.get("ph") == "X" and ev.get("cat") == "serving"
+    }
+    for stage in ("submit", "wait", "dispatch", "read"):
+        if f"serving.{stage}" not in slices:
+            errors.append(f"serving track has no 'serving.{stage}' slice")
+    flows = [
+        ev
+        for ev in events
+        if isinstance(ev, dict) and ev.get("cat") == "serving_flow"
+    ]
+    if not any(ev.get("ph") == "s" for ev in flows):
+        errors.append("no serving_flow start event — request flow arrows are missing")
+    if not any(ev.get("ph") == "f" for ev in flows):
+        errors.append("no serving_flow finish event — request flow arrows are missing")
+    return errors
+
+
 def validate_file(path: str) -> List[str]:
     """Parse ``path`` and validate; unreadable/unparseable files are a
     violation, not a crash."""
@@ -167,6 +213,34 @@ def selftest(workdir: str) -> List[str]:
     timeline.export_fleet(fleet)
     errors += validate_file(fleet)
 
+    # 4. the serving track: a real scheduler exercised submit → flush →
+    # read, exported and held to both the generic chrome-trace contract and
+    # the serving-specific one (slices + flow arrows present)
+    observability.reset()
+    observability.enable()
+    from metrics_tpu.serving import SLOScheduler
+
+    class _ServeMetric:
+        def update(self, tenant_ids, *cols):
+            pass
+
+        def compute(self):
+            return jnp.zeros((4,), jnp.float32)
+
+        def clone(self):
+            return self
+
+    sched = SLOScheduler(_ServeMetric(), max_batch=4, max_delay_ms=50.0, start=False)
+    sched.submit_many([0, 1, 2], [1.0, 2.0, 3.0])
+    sched.queue.flush()
+    sched.read()
+    sched.close()
+    serving = os.path.join(workdir, "serving.json")
+    timeline.export(serving)
+    errors += validate_file(serving)
+    with open(serving) as fh:
+        errors += [f"{serving}: {e}" for e in validate_serving_trace(json.load(fh))]
+
     observability.reset()
     return errors
 
@@ -196,7 +270,7 @@ def main(argv=None) -> int:
         for e in errors:
             print(f"VIOLATION: {e}")
         return 1
-    n = len(args.paths) + (3 if args.selftest else 0)
+    n = len(args.paths) + (4 if args.selftest else 0)
     print(f"trace-check: OK ({n} trace{'s' if n != 1 else ''} valid)")
     return 0
 

@@ -77,6 +77,12 @@ group count, per-step update count, donated leaf/alias counts, and packed
 collective counts are pinned (``compute_groups`` in the baseline) — a dedup
 regression (members falling out of the group, extra donated bundles,
 per-member collectives reappearing) fails ``make zero-overhead``.
+
+The identity sweep also covers the **SLO plane**: with objectives declared
+on the global registry, the watchdog ticking (window-ring rotation, burn
+rate evaluation, breach events), and a serving queue emitting
+request-scoped spans, the hot-path jaxprs must stay byte-identical — and a
+watchdog tick with telemetry disabled must be a strict no-op.
 """
 import argparse
 import hashlib
@@ -814,6 +820,58 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                 f"{name}: jaxpr differs with fault injection disabled —"
                 " the resilience-off state altered a hot program"
             )
+
+    # the SLO PLANE must be host-side only: with objectives declared on the
+    # global registry, the watchdog ticking (histogram window rings
+    # rotating, burn rates evaluating, an edge-triggered breach event
+    # recorded), and a serving queue emitting request-scoped spans, every
+    # hot-path jaxpr must be byte-identical to the plane-idle state —
+    # windowed burn-rate accounting and span bookkeeping live beside the
+    # host dispatch sites, never inside a compiled program
+    import numpy as _np
+
+    from metrics_tpu.serving import AdmissionQueue as _AdmissionQueue
+
+    _slo_reg = observability.SLO_REGISTRY
+    try:
+        _slo_reg.declare(
+            name="zero_overhead_probe",
+            series="serving_ingest_seconds",
+            threshold=1e-9,  # everything is a bad event: forces a breach
+            fast_window_s=0.05,
+            slow_window_s=0.1,
+        )
+        _slo_q = _AdmissionQueue(lambda *a: None, max_batch=8, start=False)
+        _slo_q.submit_many(_np.arange(4), _np.zeros(4, _np.float32))
+        _slo_q._flush_once("manual")
+        observability.WATCHDOG.tick()
+        observability.WATCHDOG.tick()
+        for name, thunk in programs.items():
+            if thunk() != texts[name]:
+                violations.append(
+                    f"{name}: jaxpr differs with the SLO plane active —"
+                    " windowed burn-rate accounting / serving request spans"
+                    " leaked traced ops into the hot path"
+                )
+        # the disabled path: a watchdog tick with telemetry off is a no-op
+        # and must leave the hot programs untouched too
+        observability.disable()
+        if observability.WATCHDOG.tick() != {}:
+            violations.append(
+                "SLOWatchdog.tick: returned statuses with telemetry disabled —"
+                " the disabled path is not a no-op"
+            )
+        for name, thunk in programs.items():
+            if thunk() != texts[name]:
+                violations.append(
+                    f"{name}: jaxpr differs after a disabled-telemetry watchdog"
+                    " tick — the SLO plane's disabled path altered a hot program"
+                )
+    finally:
+        observability.TELEMETRY.enable(prev_enabled)
+        observability.EVENTS.enable(prev_enabled)
+        observability.TRACER.enable(prev_enabled)
+        _slo_reg.clear()
 
     # the TRANSPORT SEAM must be free: with the in-graph / gather strategy
     # backends explicitly installed as the process-global transport (the

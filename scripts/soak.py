@@ -25,6 +25,11 @@ buckets are pre-compiled in a warmup phase OUTSIDE the measured window.
 Run: ``python scripts/soak.py [--tenants 10000] [--duration-s 60]
 [--qps 20000] [--out SOAK.json]`` (CI smoke: ``make soak`` /
 ``bench_serving_soak`` in ``bench_suite.py`` with env knobs).
+``--slo`` arms the SLO plane's acceptance (declared ingest-p99 +
+read-staleness objectives, watchdog ticking through the window);
+``--slo-fault`` adds the seeded dispatch-delay schedule the breach
+gate must detect within one fast window (``make slo-smoke`` runs the
+control + fault pair).
 """
 import argparse
 import contextlib
@@ -60,6 +65,21 @@ SLO_P99_MS = 100.0
 DEFAULT_CHAOS_SEED = 1234
 #: failover budget the bench's failover_mttr vs_baseline is judged against
 FAILOVER_BUDGET_MS = 5000.0
+
+#: SLO soak shape (the ``--slo`` variant): short windows so the breach
+#: watchdog's detection latency is measurable inside a CI smoke — the
+#: fast window is the detection budget the gate enforces
+SLO_WINDOW_EPOCH_S = 0.25
+SLO_FAST_WINDOW_S = 1.0
+SLO_SLOW_WINDOW_S = 3.0
+#: ingest threshold: far above the natural (warmed-up) CPU dispatch p99,
+#: far below the injected delay — the control run must stay breach-free
+SLO_INGEST_THRESHOLD_S = 0.15
+SLO_OBJECTIVE = 0.95
+#: watchdog tick cadence during the measured window
+SLO_TICK_S = 0.05
+#: injected dispatch delay (>> threshold, so every delayed cohort is bad)
+SLO_DELAY_S = 0.4
 
 
 # ---------------------------------------------------------------------------
@@ -343,6 +363,41 @@ def _producer(svc, stop, seed, tenants, rows_per_submit, rate_rows_s, counters,
             next_at = time.perf_counter()  # fell behind; do not burst-compensate
 
 
+def _slo_agreement():
+    """Cross-surface agreement, captured AT detection time: the registry's
+    ``breaches()`` hook, ``snapshot()["slo"]``, the Prometheus rendering,
+    and the ``slo`` timeline events must all name the same breached SLOs.
+    ``breaches()`` runs first so the snapshot reads the status it wrote."""
+    import re
+
+    from metrics_tpu import observability
+
+    hook = sorted(observability.SLO_REGISTRY.breaches())
+    snap = observability.snapshot()
+    snap_breached = sorted(
+        name
+        for name, st in snap.get("slo", {}).get("slos", {}).items()
+        if st.get("breached")
+    )
+    text = observability.render_prometheus(snap)
+    prom = sorted(
+        m.group(1)
+        for m in re.finditer(
+            r'^metrics_tpu_slo_breached\{slo="([^"]+)".*\} 1(?:\.0)?$', text, re.M
+        )
+    )
+    events = int(snap.get("events", {}).get("by_kind", {}).get("slo", 0))
+    return {
+        "breaches_hook": hook,
+        "snapshot_breached": snap_breached,
+        "prometheus_breached": prom,
+        "slo_events": events,
+        "consistent": bool(
+            hook == snap_breached == prom and (events >= len(hook) or not hook)
+        ),
+    }
+
+
 def _reader(svc, stop, tenants, interval_s, max_staleness_s, counters):
     """One dashboard thread: SLO-governed reads of a rotating tenant slice."""
     rng = np.random.RandomState(10_007)
@@ -377,6 +432,9 @@ def run_soak(
     skew: float = 0.0,
     chaos: bool = False,
     chaos_seed: int = DEFAULT_CHAOS_SEED,
+    slo: bool = False,
+    slo_fault: bool = False,
+    slo_seed: int = DEFAULT_CHAOS_SEED,
 ) -> dict:
     """One full soak run; returns the JSON-serializable record.
 
@@ -396,11 +454,25 @@ def run_soak(
     writing checkpoints instead of hand-timed saves. At exit the record
     must show ``submitted − shed == dispatched == rows_routed`` EXACTLY,
     the last completed checkpoint restoring bit-identical, no poison
-    leaked into tenant state, and no future deadlocked."""
+    leaked into tenant state, and no future deadlocked.
+
+    ``slo`` arms the SLO plane's end-to-end acceptance: ingest-p99 and
+    read-staleness SLOs are declared over short windows, the breach
+    watchdog ticks on the harness's own cadence through the measured
+    window, and the record carries the detection evidence.
+    ``slo_fault`` additionally installs a seeded dispatch-delay
+    :class:`~metrics_tpu.resilience.FaultPlan` at the ``serving.dispatch``
+    seam — the injected latency must surface as a detected breach
+    (burn-rate > 1 on both windows) within ONE fast window of the first
+    bad observation, with ``breaches()`` / ``snapshot()["slo"]`` /
+    Prometheus / the ``slo`` timeline events all in agreement; without it
+    the control run must stay breach-free."""
     from metrics_tpu import Accuracy, KeyedMetric, observability
     from metrics_tpu.observability.histogram import HISTOGRAMS
     from metrics_tpu.serving import SLOScheduler
 
+    if slo and chaos:
+        raise ValueError("--slo and --chaos are separate soak variants")
     observability.reset()  # ONE queue in the ledger: telemetry == ground truth
     fleet = None
     ckpt_dir = None
@@ -455,6 +527,43 @@ def run_soak(
     base_stats = svc.queue.stats()
     HISTOGRAMS.reset()  # latency percentiles cover the window only
 
+    slo_plan = None
+    slo_monitor = None
+    if slo:
+        import metrics_tpu.resilience as res
+        from metrics_tpu.observability.slo import SLO_REGISTRY
+
+        # short window epochs so the soak's fast/slow windows hold several
+        # rotations; declared AFTER the histogram reset so the window rings
+        # cover the measured traffic only
+        HISTOGRAMS.set_window_epoch(SLO_WINDOW_EPOCH_S)
+        SLO_REGISTRY.declare(
+            name="serving-ingest-p99",
+            series="serving_ingest_seconds",
+            threshold=SLO_INGEST_THRESHOLD_S,
+            objective=SLO_OBJECTIVE,
+            fast_window_s=SLO_FAST_WINDOW_S,
+            slow_window_s=SLO_SLOW_WINDOW_S,
+        )
+        SLO_REGISTRY.declare(
+            name="serving-read-staleness-p99",
+            series="serving_read_staleness_seconds",
+            threshold=max(2.0 * float(max_staleness_s), 1.0),
+            objective=SLO_OBJECTIVE,
+            fast_window_s=SLO_FAST_WINDOW_S,
+            slow_window_s=SLO_SLOW_WINDOW_S,
+        )
+        if slo_fault:
+            slo_plan = res.FaultPlan(
+                slo_seed,
+                [
+                    res.FaultSpec(
+                        "serving.dispatch", "delay", delay_s=SLO_DELAY_S, times=30
+                    )
+                ],
+            )
+            res.install_fault_plan(slo_plan)
+
     if chaos:
         import metrics_tpu.resilience as res
         from metrics_tpu.durability import CheckpointManager
@@ -507,11 +616,51 @@ def run_soak(
     t0 = time.perf_counter()
     for t in threads:
         t.start()
-    time.sleep(float(duration_s))
+    if slo:
+        # the harness owns the watchdog cadence (there is no background
+        # thread in the library): tick through the measured window and
+        # record first-bad / first-breach offsets per SLO, capturing the
+        # cross-surface agreement at the instant of detection
+        from metrics_tpu.observability.slo import WATCHDOG
+
+        slo_monitor = {"first_bad": {}, "first_breach": {}, "agreement": None}
+        t_end = t0 + float(duration_s)
+        while time.perf_counter() < t_end:
+            statuses = WATCHDOG.tick()
+            now_off = time.perf_counter() - t0
+            for name, st in statuses.items():
+                if st["fast"]["bad"] > 0 and name not in slo_monitor["first_bad"]:
+                    slo_monitor["first_bad"][name] = round(now_off, 3)
+                if st["breached"] and name not in slo_monitor["first_breach"]:
+                    slo_monitor["first_breach"][name] = {
+                        "offset_s": round(now_off, 3),
+                        "burn_fast": st["fast"]["burn_rate"],
+                        "burn_slow": st["slow"]["burn_rate"],
+                        "budget_remaining": st["budget_remaining"],
+                        "window_p": st["window_p"],
+                    }
+                    if slo_monitor["agreement"] is None:
+                        slo_monitor["agreement"] = _slo_agreement()
+            remaining = t_end - time.perf_counter()
+            if remaining > 0:
+                time.sleep(min(SLO_TICK_S, remaining))
+    else:
+        time.sleep(float(duration_s))
     stop.set()
     for t in threads:
         t.join(timeout=30.0)
+    if slo_plan is not None:
+        # the breach is on record; the drain flushes run clean
+        import metrics_tpu.resilience as res
+
+        res.install_fault_plan(None)
     drained = svc.drain(timeout=60.0)
+    # settle the default async lane too: a refresh still in flight on the
+    # daemon worker at interpreter exit dies mid-XLA-call and aborts the
+    # process (terminate without an active exception)
+    from metrics_tpu.utilities.async_sync import get_engine
+
+    get_engine().drain(timeout=30.0)
     elapsed = time.perf_counter() - t0
 
     durability_drained = True
@@ -555,6 +704,8 @@ def run_soak(
     hists = snap.get("histograms", {})
     ingest_key = f"serving_ingest_seconds{{policy={policy}}}"
     ingest = hists.get(ingest_key, {})
+    queue_wait = hists.get(f"serving_queue_wait_seconds{{policy={policy}}}", {})
+    dispatch = hists.get(f"serving_dispatch_seconds{{policy={policy}}}", {})
     flush_keys = [k for k in hists if k.startswith("serving_flush_seconds")]
     flush_count = sum(hists[k].get("count", 0) for k in flush_keys)
 
@@ -593,6 +744,18 @@ def run_soak(
             "p50": round(float(ingest.get("p50", 0.0)) * 1e3, 4),
             "p99": round(float(ingest.get("p99", 0.0)) * 1e3, 4),
             "count": int(ingest.get("count", 0)),
+        },
+        # the ingest split: enqueue wait (admission -> flush start) and the
+        # device component (flush start -> dispatch complete), per event row
+        "queue_wait_ms": {
+            "p50": round(float(queue_wait.get("p50", 0.0)) * 1e3, 4),
+            "p99": round(float(queue_wait.get("p99", 0.0)) * 1e3, 4),
+            "count": int(queue_wait.get("count", 0)),
+        },
+        "dispatch_ms": {
+            "p50": round(float(dispatch.get("p50", 0.0)) * 1e3, 4),
+            "p99": round(float(dispatch.get("p99", 0.0)) * 1e3, 4),
+            "count": int(dispatch.get("count", 0)),
         },
         "reads": {
             "served": counters["reads"],
@@ -711,6 +874,43 @@ def run_soak(
         record["chaos"] = chaos_block
         record["metric"] = "chaos_soak_step"
         shutil.rmtree(ckpt_dir, ignore_errors=True)
+    if slo:
+        slo_summary = snap.get("slo", {})
+        breached_names = sorted(slo_monitor["first_breach"])
+        detection = {}
+        for name in breached_names:
+            first_bad = slo_monitor["first_bad"].get(name)
+            first_breach = slo_monitor["first_breach"][name]["offset_s"]
+            detection[name] = (
+                round(first_breach - first_bad, 3) if first_bad is not None else None
+            )
+        record["slo"] = {
+            "declared": sorted(slo_summary.get("slos", {})),
+            "window_epoch_s": slo_summary.get("window_epoch_s"),
+            "fast_window_s": SLO_FAST_WINDOW_S,
+            "slow_window_s": SLO_SLOW_WINDOW_S,
+            "threshold_s": SLO_INGEST_THRESHOLD_S,
+            "objective": SLO_OBJECTIVE,
+            "fault_injected": bool(slo_fault),
+            "fault_report": slo_plan.report() if slo_plan is not None else None,
+            "ticks": slo_summary.get("ticks", 0),
+            "breaches_total": slo_summary.get("breaches_total", 0),
+            "breached": breached_names,
+            "first_bad_offset_s": slo_monitor["first_bad"],
+            "first_breach": slo_monitor["first_breach"],
+            "detection_latency_s": detection,
+            "final_status": {
+                name: {
+                    "breached": st.get("breached"),
+                    "budget_remaining": st.get("budget_remaining"),
+                    "burn_fast": st.get("fast", {}).get("burn_rate"),
+                    "burn_slow": st.get("slow", {}).get("burn_rate"),
+                }
+                for name, st in slo_summary.get("slos", {}).items()
+            },
+            "agreement": slo_monitor["agreement"],
+        }
+        record["metric"] = "slo_soak_step"
     svc.close()
     observability.set_retrace_threshold(prev_threshold)
     return record
@@ -772,6 +972,25 @@ def main(argv=None) -> int:
         "--chaos-seed", type=int, default=DEFAULT_CHAOS_SEED,
         help="FaultPlan seed — a chaos failure reproduces from this alone",
     )
+    parser.add_argument(
+        "--slo", action="store_true",
+        help="arm the SLO plane's end-to-end acceptance: declare ingest-p99"
+        " and read-staleness SLOs over short windows, tick the breach"
+        " watchdog through the measured window, and gate on the control run"
+        " staying breach-free",
+    )
+    parser.add_argument(
+        "--slo-fault", action="store_true",
+        help="with --slo: install the seeded dispatch-delay FaultPlan at the"
+        " serving.dispatch seam; the gate then REQUIRES a detected"
+        " ingest-p99 breach (burn-rate > 1 on both windows) within one fast"
+        " window of the first bad observation, with every export surface in"
+        " agreement",
+    )
+    parser.add_argument(
+        "--slo-seed", type=int, default=DEFAULT_CHAOS_SEED,
+        help="seed for the --slo-fault delay schedule",
+    )
     parser.add_argument("--out", default=None, help="also write the record to this path")
     args = parser.parse_args(argv)
     record = run_soak(
@@ -791,6 +1010,9 @@ def main(argv=None) -> int:
         skew=args.skew,
         chaos=args.chaos,
         chaos_seed=args.chaos_seed,
+        slo=args.slo,
+        slo_fault=args.slo_fault,
+        slo_seed=args.slo_seed,
     )
     print(json.dumps(record), flush=True)
     if args.out:
@@ -807,6 +1029,28 @@ def main(argv=None) -> int:
     chaos = record.get("chaos")
     if chaos is not None:
         ok = ok and chaos["ok"]
+    slo_block = record.get("slo")
+    if slo_block is not None:
+        if args.slo_fault:
+            detection = slo_block["detection_latency_s"].get("serving-ingest-p99")
+            first = slo_block["first_breach"].get("serving-ingest-p99", {})
+            agreement = slo_block.get("agreement") or {}
+            ok = ok and (
+                "serving-ingest-p99" in slo_block["breached"]
+                and detection is not None
+                and detection <= SLO_FAST_WINDOW_S
+                and first.get("burn_fast", 0.0) > 1.0
+                and first.get("burn_slow", 0.0) > 1.0
+                and slo_block["breaches_total"] >= 1
+                and bool(agreement.get("consistent"))
+            )
+        else:
+            ingest_final = slo_block["final_status"].get("serving-ingest-p99", {})
+            ok = ok and (
+                not slo_block["breached"]
+                and slo_block["breaches_total"] == 0
+                and float(ingest_final.get("budget_remaining") or 0.0) > 0.5
+            )
     if not ok:
         print("# SOAK FAILED: accounting invariant violated", file=sys.stderr)
     return 0 if ok else 1

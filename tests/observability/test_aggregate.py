@@ -410,6 +410,147 @@ def test_aggregated_snapshot_is_json_round_trippable():
     assert json.loads(json.dumps(agg)) == agg
 
 
+def test_merged_window_subdicts_sum_elementwise_with_recomputed_percentiles():
+    """Tentpole: the nested ``window`` sub-dict merges exactly like the
+    cumulative table — bucket deltas sum elementwise, and the merged
+    windowed p50/p95/p99 equal the percentiles OF THE SUMMED WINDOW
+    BUCKETS. Distinct per-process window distributions (fast vs slow)
+    make an averaging bug unmistakable."""
+    from metrics_tpu.observability.histogram import (
+        Log2Histogram,
+        _percentile_from,
+    )
+
+    fast, slow = Log2Histogram("s"), Log2Histogram("s")
+    for _ in range(1000):
+        fast.observe(1e-3)  # pre-window history on the fast process
+    fast.rotate()
+    fast.rotate()  # the history leaves the window
+    for _ in range(90):
+        fast.observe(2e-6)
+    for _ in range(10):
+        slow.observe(0.5)
+
+    def snap_of(hist):
+        entry = hist.to_dict(window_seconds=1.0)
+        entry["name"] = "serving_ingest_seconds"
+        return {"histograms": {"serving_ingest_seconds": entry}}
+
+    merged = merge_snapshots([snap_of(fast), snap_of(slow)])
+    entry = merged["histograms"]["serving_ingest_seconds"]
+    win = entry["window"]
+
+    # window counts/sums/buckets summed — NOT the cumulative table's
+    assert win["count"] == 100
+    assert entry["count"] == 1100
+    ref_counts = fast.window(1.0).bucket_counts() + slow.window(1.0).bucket_counts()
+    assert sum(win["buckets"].values()) == int(ref_counts.sum())
+    assert win["sum"] == pytest.approx(90 * 2e-6 + 10 * 0.5, rel=1e-6)
+    # merged windowed percentiles == percentiles of the summed window buckets
+    for q, key in ((50.0, "p50"), (95.0, "p95"), (99.0, "p99")):
+        want = round(float(_percentile_from(ref_counts, fast.window(1.0).min_exp, q)), 9)
+        assert win[key] == want, key
+    # the fleet window p50 sits in the fast band, p99 in the slow band —
+    # and neither equals the cumulative percentiles (different history)
+    assert win["p50"] < 1e-4 < 0.1 < win["p99"]
+    assert win["p50"] != entry["p50"]
+    assert json.loads(json.dumps(merged)) == merged
+
+
+def _slo_section(total, bad, *, window_p, ticks=3, breaches_total=1, objective=0.95):
+    from metrics_tpu.observability.slo import burn_rate
+
+    burn = round(burn_rate(float(bad), float(total), objective), 6)
+    return {
+        "window_epoch_s": 0.25,
+        "breaches_total": breaches_total,
+        "ticks": ticks,
+        "slos": {
+            "ingest-p99": {
+                "series": "serving_ingest_seconds",
+                "percentile": 99.0,
+                "threshold": 0.15,
+                "objective": objective,
+                "fast_window_s": 1.0,
+                "slow_window_s": 3.0,
+                "fast": {"window_s": 1.0, "total": total, "bad": bad, "burn_rate": burn},
+                "slow": {"window_s": 3.0, "total": total, "bad": bad, "burn_rate": burn},
+                "window_p": window_p,
+                "budget_remaining": round(max(0.0, 1.0 - burn), 6),
+                "breached": burn > 1.0 and total > 0,
+                "breaches_total": breaches_total,
+            }
+        },
+    }
+
+
+def test_merged_slo_section_recomputes_burn_from_summed_tallies():
+    """Tentpole: fleet burn rate is (fleet bad / fleet total) over the
+    budget — never an average of per-process burn rates. One breached
+    process (10/100 bad, burn 2.0) merged with a clean one (0/100) yields
+    fleet burn 1.0: averaging would report 1.0 > burn > breach-still-on,
+    while the correct recompute clears the breach verdict."""
+    hot = {"schema": 1, "slo": _slo_section(100.0, 10.0, window_p=0.4)}
+    cold = {
+        "schema": 1,
+        "slo": _slo_section(100.0, 0.0, window_p=0.01, ticks=5, breaches_total=0),
+    }
+    merged = merge_snapshots([hot, cold])["slo"]
+
+    st = merged["slos"]["ingest-p99"]
+    assert st["fast"]["total"] == 200.0 and st["fast"]["bad"] == 10.0
+    # (10/200)/0.05 == 1.0 exactly: at budget, NOT over it
+    assert st["fast"]["burn_rate"] == pytest.approx(1.0)
+    assert st["breached"] is False  # recomputed, not OR-ed/averaged
+    assert st["budget_remaining"] == pytest.approx(0.0)  # 1 - slow burn
+    # tallies sum, the attained percentile takes the worst process
+    assert merged["ticks"] == 8 and merged["breaches_total"] == 1
+    assert st["breaches_total"] == 1
+    assert st["window_p"] == 0.4
+    # declared config survives (identical everywhere, last-wins)
+    assert st["threshold"] == 0.15 and st["objective"] == 0.95
+    assert merged["window_epoch_s"] == 0.25
+
+    # a fleet where the bad fraction stays over budget IS still breached
+    merged_hot = merge_snapshots([hot, hot])["slo"]["slos"]["ingest-p99"]
+    assert merged_hot["fast"]["burn_rate"] == pytest.approx(2.0)
+    assert merged_hot["breached"] is True
+    assert merged_hot["budget_remaining"] == 0.0
+
+
+def test_slo_tallies_ride_the_pytree_and_apply_recomputes_derived():
+    """The in-graph form: SLO event tallies (ticks, breach transitions,
+    window good/bad counts) ride ``snapshot_pytree`` as sums, the attained
+    percentile as max; derived rates/verdicts stay OUT of the pytree and
+    ``apply_pytree`` recomputes them from the reduced tallies."""
+    snap = {"schema": 1, "slo": _slo_section(100.0, 10.0, window_p=0.4)}
+    state, reductions = snapshot_pytree(snap)
+    assert reductions["slo/ticks"] == "sum"
+    assert reductions["slo/breaches_total"] == "sum"
+    assert reductions["slo/slos/ingest-p99/fast/total"] == "sum"
+    assert reductions["slo/slos/ingest-p99/fast/bad"] == "sum"
+    assert reductions["slo/slos/ingest-p99/breaches_total"] == "sum"
+    assert reductions["slo/slos/ingest-p99/window_p"] == "max"
+    # derived values never enter the pytree (they cannot sum or max)
+    assert "slo/slos/ingest-p99/fast/burn_rate" not in state
+    assert "slo/slos/ingest-p99/budget_remaining" not in state
+    assert "slo/slos/ingest-p99/breached" not in state
+
+    # simulate a 2-process psum/pmax of the reduced leaves
+    reduced = {
+        k: (v * 2 if r == "sum" else v)
+        for (k, v), r in zip(state.items(), (reductions[k] for k in state))
+    }
+    fleet = apply_pytree(snap, reduced)
+    st = fleet["slo"]["slos"]["ingest-p99"]
+    assert st["fast"]["total"] == 200.0 and st["fast"]["bad"] == 20.0
+    assert st["fast"]["burn_rate"] == pytest.approx(2.0)  # (20/200)/0.05
+    assert st["breached"] is True
+    assert st["budget_remaining"] == 0.0
+    assert fleet["slo"]["ticks"] == 6
+    assert json.loads(json.dumps(fleet)) == fleet
+
+
 def test_merged_histogram_percentiles_equal_summed_bucket_percentiles():
     """Satellite: a merged histogram's p50/p95/p99 must equal the
     percentiles computed FROM THE SUMMED BUCKETS — never any average of the

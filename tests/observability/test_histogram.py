@@ -177,6 +177,116 @@ def test_histograms_disabled_with_telemetry():
     assert observability.snapshot()["histograms"] == {}
 
 
+def test_window_view_tracks_a_distribution_shift():
+    """Tentpole: after a regression the WINDOWED p99 moves to the new (slow)
+    distribution within one rotation while the cumulative p99 stays pinned
+    by the long healthy history — the whole reason windows exist."""
+    h = Log2Histogram("s", window_epoch_s=1.0)
+    for _ in range(10_000):
+        h.observe(2e-6)  # a long healthy history ~2 µs
+    # prime the window: everything so far falls out of the live epoch
+    h.rotate()
+    h.rotate()
+    for _ in range(100):
+        h.observe(0.5)  # the regression, in the in-progress partial epoch
+    win = h.window(1.0)
+    assert win.count == 100
+    assert 0.25 <= win.percentile(99.0) <= 1.0  # the slow band
+    assert win.percentile(50.0) >= 0.25
+    # cumulative view: 100 of 10100 observations cannot move p99 past the
+    # fast band — a cumulative-only consumer would MISS the regression
+    assert h.percentile(99.0) < 1e-4
+    assert h.count == 10_100  # observe() path unchanged by windowing
+    # the window dict mirrors the view and is JSON-round-trippable
+    d = win.to_dict()
+    assert d["count"] == 100 and d["epochs"] <= 1
+    assert sum(d["buckets"].values()) == 100
+    assert json.loads(json.dumps(d)) == d
+
+
+def test_window_sums_newest_epochs_plus_partial():
+    h = Log2Histogram("s", window_epoch_s=1.0)
+    h.observe(1e-4)
+    h.rotate()  # epoch 1: one observation
+    h.observe(1e-4)
+    h.observe(1e-4)
+    h.rotate()  # epoch 2: two observations
+    h.observe(1e-4)  # in-progress partial epoch: one
+    assert h.window(1.0).count == 3  # newest full epoch + partial
+    assert h.window(2.0).count == 4  # both epochs + partial
+    assert h.window(100.0).count == 4  # a short ring covers what it has
+    assert h.window(2.0).epochs == 2
+    # sum tracks the same slices
+    assert h.window(1.0).sum == pytest.approx(3e-4)
+    # reset_window drops ring + partial, cumulative untouched
+    h.reset_window()
+    assert h.window(10.0).count == 0
+    assert h.count == 4
+
+
+def test_registry_rotate_catches_up_with_empty_epochs():
+    reg = HistogramRegistry()
+    reg.set_window_epoch(1.0)
+    assert reg.rotate(0.0) == 0  # priming call
+    reg.observe("s", 1e-4)
+    # a long-idle process catches up in one call: the first rotation absorbs
+    # the delta, the rest push EMPTY epochs so window spans stay honest
+    assert reg.rotate(5.0) == 5
+    h = reg.get("s")
+    assert h.window(1.0).count == 0  # newest epochs are the empty ones
+    assert h.window(5.0).count == 1
+    assert reg.rotate(5.5) == 0  # within the current epoch
+    with pytest.raises(ValueError, match="positive"):
+        reg.set_window_epoch(0.0)
+
+
+def test_registry_snapshot_carries_window_subdict():
+    reg = HistogramRegistry()
+    reg.set_window_epoch(0.5, window_seconds=2.0)
+    reg.observe("s", 1e-4, path="a")
+    snap = reg.snapshot()
+    win = snap["s{path=a}"]["window"]
+    assert win["seconds"] == 2.0 and win["count"] == 1
+    assert {"p50", "p95", "p99", "buckets", "epochs"} <= set(win)
+    assert json.loads(json.dumps(snap)) == snap
+
+
+def test_snapshot_never_tears_under_racing_writers():
+    """Satellite: the (buckets, count, sum) triple a snapshot returns must
+    be internally consistent while writers race — count equals the bucket
+    total EXACTLY, and sum corresponds to a subset of the counted
+    observations (sum == v*k with k <= count for constant-v writers)."""
+    h = Log2Histogram("s", window_epoch_s=0.05)
+    V = 1e-3
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            for _ in range(200):
+                h.observe(V)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(300):
+            d = h.to_dict(window_seconds=0.2)
+            assert d["count"] == sum(d["buckets"].values())  # never torn
+            k = d["sum"] / V
+            assert k <= d["count"] + 1e-6, (d["sum"], d["count"])
+            w = d["window"]
+            assert w["count"] == sum(w["buckets"].values())
+            if i % 50 == 0:
+                h.rotate()  # rotation races the writers too
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    # all values identical: every percentile lands in v's own bucket
+    for q in (50.0, 99.0):
+        assert 2 ** -11 < h.percentile(q) <= 2 ** -9
+
+
 def test_histograms_add_zero_traced_ops():
     """The hard guarantee: recording rides the host dispatch sites only —
     the traced programs are identical with histograms recording or not."""

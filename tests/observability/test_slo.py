@@ -1,0 +1,300 @@
+"""The SLO plane: declaration validation, burn-rate arithmetic over windowed
+bucket deltas, multi-window breach semantics with edge-triggered accounting,
+the tick-driven watchdog (rotation + ``slo`` timeline events), and the
+snapshot / Prometheus export surfaces."""
+import json
+
+import pytest
+
+from metrics_tpu import observability
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.histogram import HISTOGRAMS, HistogramRegistry
+from metrics_tpu.observability.slo import (
+    SLO,
+    SLO_REGISTRY,
+    SLORegistry,
+    SLOWatchdog,
+    WATCHDOG,
+    _bad_count,
+    burn_rate,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    yield
+    observability.reset()
+    observability.enable()
+
+
+def _private_plane(epoch_s=1.0):
+    """A private histogram registry (re-epoched, rotation clock primed at 0)
+    plus an SLO registry bound to it — fully deterministic, no wall clock."""
+    hists = HistogramRegistry()
+    hists.set_window_epoch(epoch_s)
+    hists.rotate(0.0)  # prime the rotation clock
+    return hists, SLORegistry(histograms=hists)
+
+
+# ---------------------------------------------------------------------------
+# declaration + arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_slo_declaration_validates():
+    ok = SLO("a", "s1", threshold=0.1)
+    assert ok.percentile == 99.0 and ok.objective == 0.99  # percentile/100
+    assert SLO("b", "s1", threshold=0.1, percentile=95.0).objective == 0.95
+    with pytest.raises(ValueError, match="percentile"):
+        SLO("x", "s1", threshold=0.1, percentile=100.0)
+    with pytest.raises(ValueError, match="threshold"):
+        SLO("x", "s1", threshold=0.0)
+    with pytest.raises(ValueError, match="objective"):
+        SLO("x", "s1", threshold=0.1, objective=1.0)
+    with pytest.raises(ValueError, match="windows"):
+        SLO("x", "s1", threshold=0.1, fast_window_s=30.0, slow_window_s=5.0)
+    reg = SLORegistry()
+    with pytest.raises(TypeError, match="not both"):
+        reg.declare(ok, series="s2")
+
+
+def test_burn_rate_is_the_sre_ratio():
+    # bad fraction over budgeted bad fraction; empty window burns nothing
+    assert burn_rate(0.0, 0.0, 0.99) == 0.0
+    assert burn_rate(1.0, 100.0, 0.99) == pytest.approx(1.0)  # exactly at budget
+    assert burn_rate(10.0, 100.0, 0.99) == pytest.approx(10.0)
+    assert burn_rate(5.0, 100.0, 0.95) == pytest.approx(1.0)
+    assert burn_rate(0.0, 100.0, 0.99) == 0.0
+
+
+def test_bad_count_interpolates_the_covering_bucket():
+    import numpy as np
+
+    from metrics_tpu.observability.histogram import LATENCY_EXP_RANGE, Log2Histogram
+
+    h = Log2Histogram("s")
+    for _ in range(10):
+        h.observe(0.09)  # bucket (0.0625, 0.125]
+    counts = h.bucket_counts()
+    min_exp = LATENCY_EXP_RANGE[0]
+    # threshold above the bucket: nothing bad; below it: everything bad
+    assert _bad_count(counts, min_exp, 0.125) == 0.0
+    assert _bad_count(counts, min_exp, 0.0625) == 10.0
+    # mid-bucket threshold: the linear fraction above it — (0.125-0.1)/(0.0625)
+    assert _bad_count(counts, min_exp, 0.1) == pytest.approx(10 * 0.4)
+    # the +inf bucket is always bad regardless of threshold
+    over = Log2Histogram("s")
+    over.observe(1e9)
+    assert _bad_count(over.bucket_counts(), min_exp, 3.9) == 1.0
+    assert _bad_count(np.zeros_like(counts), min_exp, 0.1) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# evaluation: multi-window breach + edge-triggered accounting
+# ---------------------------------------------------------------------------
+
+
+def test_breach_requires_both_windows_burning():
+    hists, reg = _private_plane(epoch_s=1.0)
+    reg.declare(
+        name="ingest-p99", series="ingest_seconds", threshold=0.1,
+        objective=0.95, fast_window_s=1.0, slow_window_s=3.0,
+    )
+    # an idle series is not a breach (fast window empty)
+    st = reg.evaluate()["ingest-p99"]
+    assert st["breached"] is False and st["fast"]["total"] == 0.0
+    assert st["budget_remaining"] == 1.0
+
+    # all-bad observations land in the in-progress partial epoch: both
+    # windows see them, burn >> 1, breach
+    for _ in range(10):
+        hists.observe("ingest_seconds", 0.5)
+    st = reg.evaluate()["ingest-p99"]
+    assert st["fast"]["bad"] == 10.0 and st["fast"]["total"] == 10.0
+    assert st["fast"]["burn_rate"] == pytest.approx(20.0)  # (10/10)/0.05
+    assert st["slow"]["burn_rate"] == pytest.approx(20.0)
+    assert st["breached"] is True and st["transition"] == "breach"
+    assert st["budget_remaining"] == 0.0
+    assert st["window_p"] == pytest.approx(0.5, rel=0.5)  # within the 2x bucket
+
+    # age the bad epoch out of the FAST window only: 2 rotations push it
+    # beyond fast(1 epoch + partial) but keep it inside slow(3 epochs)
+    hists.rotate(2.0)
+    for _ in range(100):
+        hists.observe("ingest_seconds", 0.01)  # healthy traffic resumes
+    st = reg.evaluate()["ingest-p99"]
+    assert st["fast"]["burn_rate"] <= 1.0  # fast window healthy again
+    assert st["slow"]["burn_rate"] > 1.0  # slow window still remembers
+    assert st["breached"] is False  # multi-window: BOTH must burn
+
+
+def test_breaches_total_is_edge_triggered_and_invariant_to_poll_rate():
+    hists, reg = _private_plane(epoch_s=1.0)
+    reg.declare(
+        name="a", series="s1", threshold=0.1, objective=0.95,
+        fast_window_s=1.0, slow_window_s=1.0,
+    )
+    for _ in range(10):
+        hists.observe("s1", 0.5)
+    # ten polls during one continuous breach count ONE transition
+    for _ in range(10):
+        st = reg.evaluate()["a"]
+        assert st["breached"] is True
+    assert st["breaches_total"] == 1
+    assert "transition" not in st  # only the entering evaluation carries it
+
+    # recovery: push the bad epoch out of both windows entirely
+    hists.rotate(10.0)
+    st = reg.evaluate()["a"]
+    assert st["breached"] is False and st["transition"] == "recover"
+    assert reg.breaches() == {}
+
+    # a second distinct breach increments again
+    for _ in range(10):
+        hists.observe("s1", 0.5)
+    assert reg.evaluate()["a"]["breaches_total"] == 2
+    assert "a" in reg.breaches()
+
+
+def test_labels_subset_match_sums_matching_series():
+    hists, reg = _private_plane()
+    for _ in range(10):
+        hists.observe("lat", 0.5, tier="gold", zone="a")
+    for _ in range(90):
+        hists.observe("lat", 0.001, tier="free", zone="a")
+    reg.declare(name="gold", series="lat", threshold=0.1, objective=0.95,
+                labels={"tier": "gold"})
+    reg.declare(name="all", series="lat", threshold=0.1, objective=0.95)
+    reg.declare(name="other", series="lat", threshold=0.1, labels={"tier": "platinum"})
+    statuses = reg.evaluate()
+    # gold narrows to its tier: all 10 observations bad
+    assert statuses["gold"]["fast"]["total"] == 10.0
+    assert statuses["gold"]["breached"] is True
+    # the unlabelled SLO sums BOTH series elementwise: 10 bad of 100
+    assert statuses["all"]["fast"]["total"] == 100.0
+    assert statuses["all"]["fast"]["bad"] == pytest.approx(10.0)
+    # no matching series at all -> idle, not breached
+    assert statuses["other"]["fast"]["total"] == 0.0
+    assert statuses["other"]["breached"] is False
+
+
+def test_redeclare_replaces_and_resets_breach_state():
+    hists, reg = _private_plane()
+    reg.declare(name="a", series="s1", threshold=0.1, objective=0.95)
+    for _ in range(10):
+        hists.observe("s1", 0.5)
+    assert reg.evaluate()["a"]["breached"] is True
+    # redeclaring with a forgiving threshold clears the standing breach flag
+    reg.declare(name="a", series="s1", threshold=10.0, objective=0.95)
+    st = reg.evaluate()["a"]
+    assert st["breached"] is False
+    # and the transition bookkeeping did not emit a spurious "recover"
+    assert "transition" not in st
+    assert st["breaches_total"] == 1  # history survives redeclaration
+
+
+# ---------------------------------------------------------------------------
+# the watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_tick_rotates_evaluates_and_emits_edge_events():
+    hists, reg = _private_plane(epoch_s=1.0)
+    dog = SLOWatchdog(registry=reg)
+    reg.declare(name="a", series="s1", threshold=0.1, objective=0.95,
+                fast_window_s=1.0, slow_window_s=1.0)
+    for _ in range(10):
+        hists.observe("s1", 0.5)
+    statuses = dog.tick(now=0.5)
+    assert statuses["a"]["breached"] is True and dog.ticks == 1
+
+    slo_events = [e for e in EVENTS.events() if e.kind == "slo"]
+    assert len(slo_events) == 1
+    ev = slo_events[0]
+    assert ev.metric == "a" and ev.payload["state"] == "breach"
+    assert ev.payload["series"] == "s1"
+    assert ev.payload["burn_fast"] > 1.0 and ev.payload["burn_slow"] > 1.0
+    assert ev.payload["budget_remaining"] == 0.0
+    assert ev.payload["threshold"] == 0.1
+
+    # a still-breached tick emits nothing new (edge-triggered)
+    dog.tick(now=0.6)
+    assert len([e for e in EVENTS.events() if e.kind == "slo"]) == 1
+
+    # ticks advance the registry's window clock: 10 epochs later the bad
+    # observations age out and the recovery edge fires exactly once
+    dog.tick(now=10.0)
+    slo_events = [e for e in EVENTS.events() if e.kind == "slo"]
+    assert len(slo_events) == 2
+    assert slo_events[-1].payload["state"] == "recover"
+    assert dog.ticks == 3
+
+
+def test_watchdog_is_a_noop_when_telemetry_disabled():
+    hists, reg = _private_plane()
+    dog = SLOWatchdog(registry=reg)
+    reg.declare(name="a", series="s1", threshold=0.1)
+    observability.disable()
+    try:
+        assert dog.tick() == {}
+        assert dog.ticks == 0
+    finally:
+        observability.enable()
+
+
+# ---------------------------------------------------------------------------
+# export surfaces: snapshot()["slo"], Prometheus, reset
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_slo_section_and_prometheus_family():
+    # the plane reports nothing until touched
+    assert observability.snapshot()["slo"] == {}
+    text = observability.render_prometheus()
+    assert "metrics_tpu_slo_" not in text
+
+    HISTOGRAMS.set_window_epoch(0.25)
+    SLO_REGISTRY.declare(
+        name="dispatch-p99", series="dispatch_seconds", threshold=0.1,
+        objective=0.95, fast_window_s=0.5, slow_window_s=1.0,
+    )
+    for _ in range(10):
+        HISTOGRAMS.observe("dispatch_seconds", 0.5, path="compiled")
+    WATCHDOG.tick()
+
+    snap = observability.snapshot()
+    slo = snap["slo"]
+    assert slo["window_epoch_s"] == 0.25
+    assert slo["breaches_total"] == 1 and slo["ticks"] == 1
+    st = slo["slos"]["dispatch-p99"]
+    assert st["breached"] is True and st["series"] == "dispatch_seconds"
+    assert json.loads(json.dumps(snap))["slo"] == slo  # JSON-round-trippable
+
+    text = observability.render_prometheus(snap)
+    labels = 'slo="dispatch-p99",series="dispatch_seconds"'
+    assert f"metrics_tpu_slo_breached{{{labels}}} 1" in text
+    assert f"metrics_tpu_slo_breaches_total{{{labels}}} 1" in text
+    assert f"metrics_tpu_slo_budget_remaining{{{labels}}} 0" in text
+    assert f'metrics_tpu_slo_burn_rate{{{labels},window="fast"}}' in text
+    assert f'metrics_tpu_slo_burn_rate{{{labels},window="slow"}}' in text
+    assert f"metrics_tpu_slo_window_p{{{labels}}}" in text
+    from tests.observability.test_registry import _check_exposition_format
+
+    _check_exposition_format(text)
+
+    # breaches()/snapshot/Prometheus agree on WHICH objective is breached
+    assert sorted(SLO_REGISTRY.breaches()) == ["dispatch-p99"]
+
+
+def test_reset_clears_declarations_windows_and_watchdog():
+    HISTOGRAMS.set_window_epoch(0.25)
+    SLO_REGISTRY.declare(name="a", series="dispatch_seconds", threshold=0.1)
+    HISTOGRAMS.observe("dispatch_seconds", 0.5, path="compiled")
+    WATCHDOG.tick()
+    assert observability.snapshot()["slo"] != {}
+    observability.reset()
+    assert observability.snapshot()["slo"] == {}
+    assert SLO_REGISTRY.slos() == {} and WATCHDOG.ticks == 0
+    assert HISTOGRAMS.window_epoch_s == 1.0  # back to the default epoch

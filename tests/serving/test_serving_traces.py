@@ -1,0 +1,221 @@
+"""Request-scoped serving traces: every ingest runs submit → wait →
+dispatch (→ read) as ``serving`` spans, the dispatch span carries the
+admitted cohorts' submit-span ids as its correlation keys, and
+``timeline.export`` renders the chain on the ``<serving>`` track with flow
+arrows — pinned against the ``check_trace`` serving-trace contract."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from metrics_tpu import observability
+from metrics_tpu.observability import timeline
+from metrics_tpu.observability.tracing import TRACER
+from metrics_tpu.serving import AdmissionQueue, SLOScheduler
+from metrics_tpu.serving.queue import SPAN_COHORT_CAP
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "scripts"
+)
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+import check_trace  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.reset()
+    observability.enable()
+    yield
+    observability.reset()
+    observability.enable()
+
+
+def _serving_spans(bucket=None):
+    spans = [s for s in TRACER.records() if s.kind == "serving"]
+    if bucket is None:
+        return spans
+    return [s for s in spans if s.bucket == bucket]
+
+
+def _drain(q, n):
+    total = 0
+    while total < n:
+        got = q._flush_once("manual")
+        if got == 0:
+            break
+        total += got
+    return total
+
+
+def test_submit_span_carries_admission_accounting():
+    q = AdmissionQueue(lambda *a: None, max_batch=8, start=False, capacity_rows=8,
+                       policy="shed_oldest")
+    q.submit_many(np.arange(12), np.zeros(12, np.float32))
+    (span,) = _serving_spans("submit")
+    assert span.group == q.telemetry_key
+    assert span.payload["rows"] == 12
+    # shed_oldest evicted 4 residents, but all 12 of THIS cohort were let in
+    assert span.payload["admitted"] == 12
+    assert span.payload["shed"] == 0
+    assert span.exit_s >= span.enter_s
+
+
+def test_dispatch_span_links_back_to_its_submit_cohorts():
+    q = AdmissionQueue(lambda *a: None, max_batch=64, start=False)
+    q.submit_many(np.arange(4), np.zeros(4, np.float32))
+    q.submit_many(np.arange(4), np.ones(4, np.float32))
+    assert _drain(q, 8) == 8
+
+    submit_ids = [s.span_id for s in _serving_spans("submit")]
+    assert len(submit_ids) == 2 and len(set(submit_ids)) == 2
+
+    (wait,) = _serving_spans("wait")
+    (dispatch,) = _serving_spans("dispatch")
+    # the correlation key: every admitted cohort's submit span id rides the
+    # dispatch span payload, in admission order, none dropped at this scale
+    assert dispatch.payload["cohorts"] == submit_ids
+    assert dispatch.payload["dropped_cohorts"] == 0
+    assert dispatch.payload["rows"] == 8 and dispatch.payload["error"] is None
+    # the retro-dated chain tiles the ingest interval: submit-enter <=
+    # wait-enter < wait-exit == dispatch-enter <= dispatch-exit
+    assert wait.exit_s == pytest.approx(dispatch.enter_s, abs=5e-3)
+    assert wait.enter_s <= wait.exit_s <= dispatch.exit_s
+    assert q.last_dispatch_span() == dispatch.span_id
+
+
+def test_cohort_list_is_capped_with_explicit_drop_count():
+    q = AdmissionQueue(lambda *a: None, max_batch=1024, start=False,
+                       capacity_rows=4096)
+    n = SPAN_COHORT_CAP + 3
+    for i in range(n):  # one single-row cohort each -> n distinct submit spans
+        q.submit_many([i], np.zeros(1, np.float32))
+    assert _drain(q, n) == n
+    (dispatch,) = _serving_spans("dispatch")
+    assert len(dispatch.payload["cohorts"]) == SPAN_COHORT_CAP
+    assert dispatch.payload["dropped_cohorts"] == 3
+
+
+def test_read_span_references_the_serving_flush():
+    svc = SLOScheduler(_metric(), max_batch=8, max_delay_ms=10_000.0, start=False)
+    try:
+        svc.submit(2, 5.0)
+        svc.read(max_staleness_s=0.0)  # miss: flush + recompute
+        svc.read([2])  # fresh hit off the cache the flush produced
+    finally:
+        svc.close()
+    reads = _serving_spans("read")
+    assert len(reads) >= 2
+    hit = reads[-1]
+    assert hit.payload["outcome"] in ("cache_hit", "fresh_hit", "recompute", "stale_hit")
+    assert "staleness_s" in hit.payload
+    # the read joins the request chain: its flush_span names the dispatch
+    # span whose flush produced the cache it served
+    dispatch_ids = {s.span_id for s in _serving_spans("dispatch")}
+    assert hit.payload["flush_span"] in dispatch_ids
+
+
+def _metric():
+    class _M:
+        def __init__(self, n=8):
+            self.sums = np.zeros(n)
+
+        def update(self, tenant_ids, values):
+            np.add.at(self.sums, np.asarray(tenant_ids), np.asarray(values))
+
+        def compute(self):
+            return self.sums.copy()
+
+        def clone(self):
+            m = _M(len(self.sums))
+            m.sums = self.sums.copy()
+            return m
+
+    return _M()
+
+
+def test_disabled_tracer_records_no_serving_spans():
+    observability.disable()
+    try:
+        q = AdmissionQueue(lambda *a: None, max_batch=8, start=False)
+        q.submit_many(np.arange(4), np.zeros(4, np.float32))
+        _drain(q, 4)
+        assert _serving_spans() == []
+        assert q.last_dispatch_span() is None
+    finally:
+        observability.enable()
+
+
+# ---------------------------------------------------------------------------
+# the exported timeline: serving track + flow arrows, checker-pinned
+# ---------------------------------------------------------------------------
+
+
+def _export_served_timeline(tmp_path):
+    svc = SLOScheduler(_metric(), max_batch=4, max_delay_ms=10_000.0, start=False)
+    try:
+        svc.submit_many(np.arange(4), np.arange(4, dtype=np.float64))
+        svc.read(max_staleness_s=0.0)
+    finally:
+        svc.close()
+    path = timeline.export(str(tmp_path / "serving.json"))
+    with open(path) as fh:
+        return path, json.load(fh)
+
+
+def test_timeline_export_renders_the_serving_track(tmp_path):
+    path, doc = _export_served_timeline(tmp_path)
+    # the general Chrome-trace contract AND the serving-specific one
+    assert check_trace.validate_chrome_trace(doc) == []
+    assert check_trace.validate_serving_trace(doc) == []
+
+    events = doc["traceEvents"]
+    # span slices only — the event-log's serving flush events share the
+    # "serving" category but render on their own per-metric tracks
+    slices = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("cat") == "serving"
+        and str(e.get("name", "")).startswith("serving.")
+    ]
+    names = {e["name"] for e in slices}
+    assert {"serving.submit", "serving.wait", "serving.dispatch", "serving.read"} <= names
+    # every serving slice sits on the named <serving> track
+    tids = {e["tid"] for e in slices}
+    assert len(tids) == 1
+    (tid,) = tids
+    assert any(
+        e.get("ph") == "M" and e.get("name") == "thread_name"
+        and e.get("tid") == tid and e["args"]["name"] == "<serving>"
+        for e in events
+    )
+    # the request chain renders as paired flow arrows (submit -> dispatch)
+    flows = [e for e in events if e.get("cat") == "serving_flow"]
+    starts = [e for e in flows if e["ph"] == "s"]
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert starts and len(starts) == len(finishes)
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+    # slices carry the span payloads as args for the viewer
+    dispatch = next(e for e in slices if e["name"] == "serving.dispatch")
+    assert dispatch["args"]["rows"] == 4
+
+
+def test_validate_serving_trace_flags_missing_stages():
+    # a trace without the serving track at all
+    doc = {"traceEvents": []}
+    errs = check_trace.validate_serving_trace(doc)
+    assert any("<serving>" in e for e in errs)
+    assert any("serving.submit" in e for e in errs)
+    # a named track missing one stage and the flow arrows is still flagged
+    doc = {
+        "traceEvents": [
+            {"ph": "M", "name": "thread_name", "pid": 0, "tid": 9,
+             "args": {"name": "<serving>"}},
+            {"ph": "X", "cat": "serving", "name": "serving.submit",
+             "pid": 0, "tid": 9, "ts": 1.0, "dur": 1.0},
+        ]
+    }
+    errs = check_trace.validate_serving_trace(doc)
+    assert any("serving.dispatch" in e for e in errs)
+    assert not any("serving.submit" in e for e in errs)

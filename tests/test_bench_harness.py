@@ -402,12 +402,41 @@ def test_serving_soak_bench_record_round_trips(monkeypatch):
     # one ingest-latency observation per dispatched row, window-exact
     assert line["ingest_ms"]["count"] == rows["dispatched"]
     assert line["ingest_ms"]["p99"] >= line["ingest_ms"]["p50"] >= 0
+    # the ingest split: host-queue wait + device dispatch, row-weighted so
+    # all three series count every dispatched row
+    for split in ("queue_wait_ms", "dispatch_ms"):
+        assert line[split]["count"] == rows["dispatched"]
+        assert line[split]["p99"] >= line[split]["p50"] >= 0
     assert line["shed_fraction"] == (
         round(rows["shed"] / rows["submitted"], 6) if rows["submitted"] else 0.0
     )
     assert line["drained"] is True
     assert "telemetry" in line and "serving" in line["telemetry"]
     assert "bench_serving_soak" in bench_suite.CONFIG_META
+
+
+def test_slo_overhead_bench_record_round_trips():
+    """The SLO-overhead config's record must survive json round-trips and
+    carry the cost evidence: the idle/active per-step split with the
+    per-step overhead, a watchdog tick per active step (the harsher-than-
+    real cadence), and all 8 declared SLOs evaluated."""
+    import json
+
+    line = bench_suite.run_config(bench_suite.bench_slo_overhead, probe=False)
+    round_tripped = json.loads(json.dumps(line))
+    assert round_tripped == line
+    assert line["metric"] == "slo_overhead_step" and line["unit"] == "us/step"
+    assert line["slos"] == 8 and line["evaluated_slos"] == 8
+    # one tick per active step: the warm call plus every timed step
+    assert line["ticks"] == bench_suite.REF_STEPS + 1
+    assert line["slo_active_us"] == line["value"]
+    assert line["slo_idle_us"] > 0
+    assert line["overhead_us_per_step"] == pytest.approx(
+        line["slo_active_us"] - line["slo_idle_us"], abs=0.01
+    )
+    assert line["overhead_pct"] is not None
+    assert "telemetry" in line
+    assert "bench_slo_overhead" in bench_suite.CONFIG_META
 
 
 def test_pallas_kernel_bench_records_round_trip(monkeypatch):
