@@ -1,8 +1,8 @@
 # Headless CI entry points — `make ci` reproduces the green state locally
 # exactly as .github/workflows/ci.yml runs it.
-.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check soak checkpoint-smoke chaos-smoke slo-smoke
+.PHONY: ci test doctest doctest-docs dryrun examples bench export-weights zero-overhead bench-regress trace-check soak checkpoint-smoke chaos-smoke slo-smoke profile-smoke
 
-ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check checkpoint-smoke chaos-smoke slo-smoke
+ci: test doctest doctest-docs dryrun examples zero-overhead bench-regress trace-check checkpoint-smoke chaos-smoke slo-smoke profile-smoke
 
 # Full suite on the virtual 8-device CPU mesh (tests/conftest.py), including
 # the real 2-process jax.distributed sync test (tests/bases/test_multiprocess.py).
@@ -104,6 +104,15 @@ slo-smoke:
 	JAX_PLATFORMS=cpu python scripts/soak.py --slo --slo-fault --tenants 200 \
 	  --duration-s 4 --qps 2000 --producers 2 --max-batch 256 \
 	  --read-interval-s 0.2 --max-staleness-s 0.5
+
+# Profiling & memory-accounting smoke (scripts/profile_smoke.py): the
+# deterministic sampling law (ceil(steps/N) host-queue/device splits per
+# dispatch path), byte-exact live-buffer conservation through
+# grow/evict/fault-back/compact, a byte-pressure watermark driving real
+# spiller evictions, and the disabled-mode strict no-op. Exit 1 on any
+# violation. The profiling/capacity plane's CI leg.
+profile-smoke:
+	JAX_PLATFORMS=cpu python scripts/profile_smoke.py
 
 # Convert a torchvision Inception3 checkpoint into the .npz the Flax
 # extractor loads: make export-weights CKPT=inception_v3.pth OUT=weights.npz

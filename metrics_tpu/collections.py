@@ -40,6 +40,7 @@ from metrics_tpu.metric import (
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.health import HEALTH, guard_state
 from metrics_tpu.observability.histogram import observe_dispatch
+from metrics_tpu.observability.profiling import PROFILER
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import arg_signature
 from metrics_tpu.observability.tracing import TRACER
@@ -649,10 +650,14 @@ class MetricCollection:
             state, donatable = self._donation_safe_state(state)
             if not donatable:
                 fn = self._forward_copy_dispatch()
+        prof = PROFILER.begin("compiled", state)
         start = time.perf_counter() if (EVENTS.enabled or TELEMETRY.enabled) else None
         new_state, values = fn(state, *args, **kwargs)
+        submitted = time.perf_counter() if (start is not None or prof is not None) else None
+        if prof is not None:
+            PROFILER.finish(prof, new_state, self.telemetry_key, fn, submit_end=submitted)
         if start is not None:
-            dur = time.perf_counter() - start
+            dur = submitted - start
             if TELEMETRY.enabled:
                 observe_dispatch(dur, "compiled")
             if EVENTS.enabled:
@@ -788,10 +793,14 @@ class MetricCollection:
                     self._scan_update_many, donate_state=False, context_fn=self._group_signature
                 )
             fn = self._update_many_copy_fn
+        prof = PROFILER.begin("update_many", state)
         start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
         new_state = fn(state, stacked, stacked_kwargs)
+        submitted = time.perf_counter() if (start is not None or prof is not None) else None
+        if prof is not None:
+            PROFILER.finish(prof, new_state, self.telemetry_key, fn, submit_end=submitted)
         if start is not None:
-            dur = time.perf_counter() - start
+            dur = submitted - start
             key = self.telemetry_key
             if TELEMETRY.enabled:
                 TELEMETRY.inc(key, "update_many_calls")
@@ -1493,6 +1502,11 @@ class MetricCollection:
                     for n in new_names:
                         del self._metrics[n]
                     raise ValueError(f"member {name!r}: {err}") from None
+        # new members mean new state bundles: re-note the memory ledger at
+        # the same seam that invalidated the executables
+        from metrics_tpu.observability.memory import LEDGER
+
+        LEDGER.note(self)
 
     def _add_metrics(
         self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric

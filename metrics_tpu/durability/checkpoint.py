@@ -963,6 +963,13 @@ class CheckpointManager:
                 # nested acquisition inside _install is free)
                 marks = self._current_marks()
 
+        # the restore replaced whole bundles: re-note the memory ledger at
+        # this seam, outside the serial lock (a pressure callback may evict,
+        # which re-takes the target's lock)
+        from metrics_tpu.observability.memory import LEDGER
+
+        LEDGER.note(target)
+
         dur = time.perf_counter() - start
         DURABILITY_STATS.inc("restores")
         if TELEMETRY.enabled:

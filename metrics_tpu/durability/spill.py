@@ -79,6 +79,15 @@ class TenantSpiller:
         min_idle_s: never evict a tenant updated more recently than this
             (hot tenants stay resident even over the cap).
         auto: evict automatically after each update dispatch.
+        pressure_high: optional BYTE watermark — when the memory ledger's
+            tracked device total crosses it, the spiller evicts the coldest
+            ``pressure_fraction`` of resident active tenants (staleness
+            still orders the victims; byte pressure triggers the pass).
+            Arms a :func:`metrics_tpu.observability.memory.on_pressure`
+            subscription; re-arms below ``pressure_low``.
+        pressure_low: re-arm watermark (default ``pressure_high // 2``).
+        pressure_fraction: share of resident active tenants a pressure
+            pass evicts (at least one, never the last resident).
     """
 
     def __init__(
@@ -88,6 +97,9 @@ class TenantSpiller:
         resident_cap: int,
         min_idle_s: float = 0.0,
         auto: bool = True,
+        pressure_high: Optional[int] = None,
+        pressure_low: Optional[int] = None,
+        pressure_fraction: float = 0.5,
     ) -> None:
         if int(resident_cap) < 1:
             raise ValueError(f"resident_cap must be >= 1, got {resident_cap}")
@@ -130,6 +142,23 @@ class TenantSpiller:
             )
         metric.__dict__["_durability_hooks"] = self
         DURABILITY_STATS.register_spiller(self)
+        # memory-ledger integration: the wrapped metric's device bytes are
+        # tracked from attach, and an optional byte watermark turns ledger
+        # pressure into eviction passes (ROADMAP item 1's disk-tier seam)
+        from metrics_tpu.observability.memory import LEDGER
+
+        LEDGER.track(metric)
+        self.pressure_evictions = 0
+        self._pressure_handle = None
+        if pressure_high is not None:
+            if not 0.0 < float(pressure_fraction) <= 1.0:
+                raise ValueError(
+                    f"pressure_fraction must be in (0, 1], got {pressure_fraction}"
+                )
+            self._pressure_fraction = float(pressure_fraction)
+            self._pressure_handle = LEDGER.on_pressure(
+                self._on_pressure, high=int(pressure_high), low=pressure_low
+            )
 
     # ------------------------------------------------------------------
     # hook protocol (called by the wrappers' stateful paths)
@@ -175,6 +204,7 @@ class TenantSpiller:
             self._spilled_bytes -= sum(
                 r.nbytes for leaves in entry.values() for r in leaves.values()
             )
+        self._note_ledger_spilled()
 
     def on_restore(self) -> None:
         """Restore invalidation — the checkpoint plane calls this under the
@@ -186,6 +216,7 @@ class TenantSpiller:
         eviction-eligible — their stamps start at cold)."""
         self._spilled.clear()
         self._spilled_bytes = 0
+        self._note_ledger_spilled()
         self._last_touch.fill(-np.inf)
         self._touched.fill(False)
         traffic = getattr(self._metric, "_traffic", None)
@@ -198,6 +229,14 @@ class TenantSpiller:
     # ------------------------------------------------------------------
     # the spill mechanics
     # ------------------------------------------------------------------
+
+    def _note_ledger_spilled(self) -> None:
+        """Mirror the host-spilled byte gauge into the memory ledger (device
+        bytes are untouched by evict/fault-back — rows reset in place — so
+        this is a spilled-gauge update, never a watermark trigger)."""
+        from metrics_tpu.observability.memory import LEDGER
+
+        LEDGER.note_spilled(self._metric, self._spilled_bytes)
 
     def _bundles(self) -> Dict[str, Any]:
         m = self._metric
@@ -228,6 +267,7 @@ class TenantSpiller:
             owner._forward_cache = None
         DURABILITY_STATS.inc("evictions", len(ids))
         DURABILITY_STATS.note_spill_occupancy(len(self._spilled))
+        self._note_ledger_spilled()
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, "evictions", len(ids))
         if EVENTS.enabled:
@@ -266,6 +306,7 @@ class TenantSpiller:
         dur = time.perf_counter() - start
         DURABILITY_STATS.inc("fault_backs", len(ordered))
         DURABILITY_STATS.note_spill_occupancy(len(self._spilled))
+        self._note_ledger_spilled()
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, "fault_backs", len(ordered))
             observe_faultback(dur)
@@ -323,6 +364,43 @@ class TenantSpiller:
                 self._evict_ids(victims)
             return len(victims)
 
+    def _on_pressure(self, tracked_bytes: int) -> None:
+        """Ledger watermark callback: byte pressure triggers an eviction
+        pass over the coldest ``pressure_fraction`` of resident active
+        tenants (``min_idle_s`` still protects hot tenants, and the last
+        resident tenant never spills). Fires outside the ledger lock; takes
+        the metric's serial lock like every other eviction."""
+        import math
+
+        with self._lock():
+            active = np.nonzero(self._touched)[0]
+            resident = [int(t) for t in active if int(t) not in self._spilled]
+            if len(resident) <= 1:
+                return
+            stamps = self._stamps()
+            now = time.monotonic()
+            eligible = [t for t in resident if now - stamps[t] >= self.min_idle_s]
+            if not eligible:
+                return
+            eligible.sort(key=lambda t: stamps[t])
+            quota = max(1, math.ceil(len(resident) * self._pressure_fraction))
+            quota = min(quota, len(resident) - 1, len(eligible))
+            victims = eligible[:quota]
+            if not victims:
+                return
+            self._evict_ids(victims)
+            self.pressure_evictions += len(victims)
+            if TELEMETRY.enabled:
+                TELEMETRY.inc(self.telemetry_key, "pressure_evictions", len(victims))
+            if EVENTS.enabled:
+                EVENTS.record(
+                    "durability",
+                    self.telemetry_key,
+                    path="pressure_evict",
+                    tenants=len(victims),
+                    tracked_bytes=int(tracked_bytes),
+                )
+
     def evict(self, tenant_ids: Optional[Any] = None) -> int:
         """Evict ``tenant_ids`` (or run one :meth:`maybe_evict` pass);
         already-spilled / never-active ids are skipped. Returns tenants
@@ -378,13 +456,19 @@ class TenantSpiller:
     def report(self) -> Dict[str, Any]:
         """Occupancy + the conservation check:
         ``resident_active + spilled == active`` exactly (both sides counted
-        independently — see :meth:`occupancy`)."""
+        independently — see :meth:`occupancy`), plus the byte view —
+        ``resident_bytes`` is the metric's live device footprint recomputed
+        from aval metadata, ``spilled_bytes`` the host-side rows."""
+        from metrics_tpu.observability.memory import bundle_bytes
+
         occ = self.occupancy()
         return {
             **occ,
+            "resident_bytes": int(bundle_bytes(self._metric)),
             "resident_cap": self.resident_cap,
             "min_idle_s": self.min_idle_s,
             "auto": self.auto,
+            "pressure_evictions": int(self.pressure_evictions),
             "conservation_ok": occ["resident_active"] + occ["spilled"] == occ["active"],
             "resident_under_cap": occ["resident_active"] <= self.resident_cap,
         }
@@ -393,6 +477,9 @@ class TenantSpiller:
         """Fault everything back and uninstall the hooks (the metric
         reverts to plain always-resident behavior)."""
         self.fault_back()
+        if self._pressure_handle is not None:
+            self._pressure_handle.cancel()
+            self._pressure_handle = None
         if self._metric.__dict__.get("_durability_hooks") is self:
             del self._metric.__dict__["_durability_hooks"]
         if self._traffic_unpin is not None:

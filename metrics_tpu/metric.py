@@ -55,6 +55,7 @@ from metrics_tpu.utilities.data import (
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.health import HEALTH, MetricHealthError, guard_state  # noqa: F401
 from metrics_tpu.observability.histogram import observe_dispatch
+from metrics_tpu.observability.profiling import PROFILER
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import MONITOR, arg_signature, is_tracing
 from metrics_tpu.observability.tracing import TRACER
@@ -917,12 +918,16 @@ class Metric(ABC):
             state, donatable = self._donation_safe_state(state)
             if not donatable:
                 fn = self._forward_copy_dispatch()
+        prof = PROFILER.begin("compiled", state)
         start = time.perf_counter() if (EVENTS.enabled or TELEMETRY.enabled) else None
         out = fn(state, *args, **kwargs)
+        submitted = time.perf_counter() if (start is not None or prof is not None) else None
+        if prof is not None:
+            PROFILER.finish(prof, out, self.telemetry_key, fn, submit_end=submitted)
         if start is not None:
             # wall time of the (async) dispatch, not the device step — the
             # device cost lives in the profiler trace this timeline rides next to
-            dur = time.perf_counter() - start
+            dur = submitted - start
             if TELEMETRY.enabled:
                 observe_dispatch(dur, "compiled")
             if EVENTS.enabled:
@@ -1063,10 +1068,14 @@ class Metric(ABC):
         if self._jit_forward_donate:
             state, donatable = self._donation_safe_state(state)
         fn = self._update_many_dispatch(donatable)
+        prof = PROFILER.begin("update_many", state)
         start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
         new_state = fn(state, stacked, stacked_kwargs)
+        submitted = time.perf_counter() if (start is not None or prof is not None) else None
+        if prof is not None:
+            PROFILER.finish(prof, new_state, self.telemetry_key, fn, submit_end=submitted)
         if start is not None:
-            dur = time.perf_counter() - start
+            dur = submitted - start
             key = self.telemetry_key
             if TELEMETRY.enabled:
                 TELEMETRY.inc(key, "update_many_calls")

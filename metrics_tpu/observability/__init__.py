@@ -1,6 +1,6 @@
 """Runtime telemetry for the metric lifecycle (see ``docs/observability.md``).
 
-Eleven pieces, one snapshot:
+Thirteen pieces, one snapshot:
 
 * :mod:`~metrics_tpu.observability.registry` — thread-safe per-metric
   counters (update/forward/compute/reset/sync, eager vs. compiled path) and
@@ -39,6 +39,17 @@ Eleven pieces, one snapshot:
   (:data:`SLO_REGISTRY`), the machine-readable ``breaches()`` hook, and the
   tick-driven breach watchdog (:data:`WATCHDOG`) that rotates the window
   rings and emits edge-triggered ``slo`` timeline events.
+* :mod:`~metrics_tpu.observability.profiling` — sampled device-time
+  attribution for the compiled dispatch sites: :func:`set_profiling` arms an
+  every-Nth-dispatch host-queue/device-time decomposition feeding the
+  ``dispatch_host_queue_seconds`` / ``dispatch_device_seconds`` histogram
+  series, and :func:`profile_report` adds per-executable ``cost_analysis``
+  attribution.
+* :mod:`~metrics_tpu.observability.memory` — the live-buffer memory ledger
+  (:data:`~metrics_tpu.observability.memory.LEDGER`): device-byte accounting
+  of tracked state bundles from aval metadata, high-water tracking,
+  :func:`memory_report`, and :func:`on_pressure` byte watermarks the
+  cold-tenant spiller subscribes to.
 * :mod:`~metrics_tpu.observability.export` — :func:`snapshot` (JSON dict) and
   :func:`render_prometheus` (text exposition format; ``aggregated=True``
   renders the fleet view with ``process`` labels).
@@ -108,6 +119,21 @@ from metrics_tpu.observability.slo import (  # noqa: F401
     WATCHDOG,
     burn_rate,
 )
+from metrics_tpu.observability.memory import (  # noqa: F401
+    LEDGER,
+    MemoryLedger,
+    PressureHandle,
+    bundle_bytes,
+    memory_report,
+    on_pressure,
+)
+from metrics_tpu.observability.profiling import (  # noqa: F401
+    PROFILER,
+    Profiler,
+    get_profiling,
+    profile_report,
+    set_profiling,
+)
 
 
 def enable(on: bool = True) -> None:
@@ -120,18 +146,25 @@ def enable(on: bool = True) -> None:
 
 
 def disable() -> None:
-    """Stop recording; instrumented call sites reduce to attribute reads."""
+    """Stop recording; instrumented call sites reduce to attribute reads.
+    The dispatch profiler disarms (sampling stops) and the memory ledger
+    drops its pending watermark callbacks — a disabled stack must never
+    call back into spill logic."""
     TELEMETRY.disable()
     EVENTS.disable()
     TRACER.disable()
+    PROFILER.disable()
+    LEDGER.disable()
 
 
 def reset() -> None:
     """Clear all recorded counters, timers, sync stats, retrace ledgers,
     events, histograms (window rings included), collective spans, SLO
     declarations and watchdog state, async-sync engine counters,
-    serving-plane counters, durability-plane counters, and health records
-    (enablement, policy, step tag survive). Span-id sequence counters and async generations reset
+    serving-plane counters, durability-plane counters, profiling tallies,
+    memory-ledger high-waters/watermarks, and health records
+    (enablement, policy, step tag, the profiler's sampling stride, and the
+    ledger's tracked owners survive). Span-id sequence counters and async generations reset
     too — like any collective, reset on every process together or on
     none."""
     import sys as _sys
@@ -144,6 +177,8 @@ def reset() -> None:
     TRACER.clear()
     SLO_REGISTRY.reset()
     WATCHDOG.reset()
+    PROFILER.reset()
+    LEDGER.reset()
     from metrics_tpu.utilities import async_sync as _async_sync
 
     if _async_sync._ENGINE is not None:
@@ -169,9 +204,14 @@ __all__ = [
     "HealthMonitor",
     "HistogramRegistry",
     "HistogramWindow",
+    "LEDGER",
     "Log2Histogram",
     "MONITOR",
+    "MemoryLedger",
     "MetricHealthError",
+    "PROFILER",
+    "PressureHandle",
+    "Profiler",
     "RetraceMonitor",
     "SLO",
     "SLORegistry",
@@ -185,6 +225,7 @@ __all__ = [
     "aggregate_snapshots",
     "apply_pytree",
     "arg_signature",
+    "bundle_bytes",
     "burn_rate",
     "degraded_processes",
     "disable",
@@ -192,14 +233,19 @@ __all__ = [
     "enable",
     "estimate_clock_offsets",
     "get_health_policy",
+    "get_profiling",
     "get_retrace_threshold",
     "get_step",
+    "memory_report",
     "merge_snapshots",
+    "on_pressure",
+    "profile_report",
     "program_cost",
     "pytree_nbytes",
     "render_prometheus",
     "reset",
     "set_health_policy",
+    "set_profiling",
     "set_retrace_threshold",
     "set_step",
     "snapshot",

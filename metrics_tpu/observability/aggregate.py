@@ -130,6 +130,18 @@ MERGE_RULES: Tuple[Tuple[str, str], ...] = (
     ("slo.*.breached", "recompute"),
     ("slo.*.window_p", "max"),
     ("slo.*", "last"),
+    # profiling plane: dispatch/sample tallies sum across processes; the
+    # sampling stride is declared config (last writer wins), enablement ORs
+    # (the split-latency histogram series merge under the histograms.*
+    # rules above — buckets sum elementwise, percentiles recompute)
+    ("profiling.enabled", "any"),
+    ("profiling.sample_every", "last"),
+    ("profiling.*", "sum"),
+    # memory ledger: byte gauges sum across processes (fleet HBM footprint),
+    # the high-water marks max — a fleet high-water is the worst process,
+    # not a sum of unsynchronized peaks
+    ("memory.high_water_bytes", "max"),
+    ("memory.*", "sum"),
     # top level
     ("enabled", "any"),
     ("schema", "last"),

@@ -42,10 +42,13 @@ from typing import Any, Dict, Iterator, List, NamedTuple, Optional
 #: scheduler cache refreshes (:mod:`metrics_tpu.serving`); ``durability``
 #: marks checkpoint/spill/elastic activity (:mod:`metrics_tpu.durability`);
 #: ``resilience`` marks injected faults and membership epoch transitions
-#: (:mod:`metrics_tpu.resilience`)
+#: (:mod:`metrics_tpu.resilience`); ``profile`` marks a sampled dispatch's
+#: host-queue/device-time sub-slices
+#: (:mod:`metrics_tpu.observability.profiling`)
 EVENT_KINDS = (
     "update", "forward", "compute", "sync", "retrace", "health", "compile",
     "tenant_report", "straggler", "serving", "durability", "resilience", "slo",
+    "profile",
 )
 
 #: default bound on retained events; ~100 bytes each, so the default log

@@ -136,6 +136,18 @@ _HELP: Dict[str, str] = {
     "resilience_breaker_opens_total": "Circuit breakers tripped open by consecutive failures.",
     "resilience_breaker_short_circuits_total": "Calls refused by an open circuit breaker.",
     "resilience_membership_epoch": "Current membership epoch (fleet view takes the max).",
+    "dispatch_host_queue_seconds": "Sampled dispatch host-enqueue wall time against an idle device (submit window of the profiling split).",
+    "dispatch_device_seconds": "Sampled dispatch device execution window (submit-return to outputs-ready).",
+    "profiling_sample_every": "Sampling stride of the dispatch profiler (0 = disarmed).",
+    "profiling_dispatches_total": "Compiled dispatches counted per path while profiling is armed.",
+    "profiling_samples_total": "Dispatches that paid the host/device decomposition per path.",
+    "memory_owners": "State-bundle owners tracked by the memory ledger.",
+    "memory_tracked_bytes": "Live device bytes across tracked state bundles (aval metadata, no sync).",
+    "memory_high_water_bytes": "Peak tracked device bytes observed (fleet view takes the max).",
+    "memory_spilled_bytes": "Host bytes held by spilled tenant rows across tracked owners.",
+    "memory_updates_total": "Ledger re-accounting events at the executable-invalidation seams.",
+    "memory_pressure_events_total": "Watermark crossings that fired a pressure callback.",
+    "memory_watermarks": "Armed pressure-watermark subscriptions.",
 }
 
 
@@ -183,12 +195,21 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
                            "slow": {"burn_rate": float, ...},
                            "budget_remaining": float, "breached": bool,
                            "breaches_total": int, ...}}},
+          "profiling": {"enabled": bool, "sample_every": int,
+                        "dispatches": {path: int}, "samples": {path: int}},
+          "memory": {"owners": int, "tracked_bytes": int,
+                     "high_water_bytes": int, "spilled_bytes": int,
+                     "updates": int, "pressure_events": int,
+                     "watermarks": int},
         }
 
     ``async_sync`` is ``{}`` until the first ``compute_async`` constructs
     the background engine; ``serving`` is ``{}`` until the first admission
     queue is built (:mod:`metrics_tpu.serving`); ``slo`` is ``{}`` until
-    the first :class:`~metrics_tpu.observability.slo.SLO` is declared. Always JSON-serializable
+    the first :class:`~metrics_tpu.observability.slo.SLO` is declared;
+    ``profiling`` is ``{}`` until :func:`~metrics_tpu.observability.profiling.set_profiling`
+    arms the sampler, and ``memory`` is ``{}`` until the ledger tracks its
+    first owner. Always JSON-serializable
     (``json.dumps(snapshot())`` round-trips), and mergeable across processes
     by the declared reductions — see
     :func:`~metrics_tpu.observability.aggregate.aggregate_snapshots`.
@@ -226,6 +247,12 @@ def snapshot(include_timers: bool = True) -> Dict[str, Any]:
     from metrics_tpu.observability import slo as _slo
 
     snap["slo"] = _slo.summary()
+    # profiling & memory planes: {} until armed / first tracked owner
+    from metrics_tpu.observability import memory as _memory
+    from metrics_tpu.observability import profiling as _profiling
+
+    snap["profiling"] = _profiling.summary()
+    snap["memory"] = _memory.summary()
     return snap
 
 
@@ -516,6 +543,36 @@ def _render_snapshot(snap: Dict[str, Any], base: Dict[str, str], out: _Renderer)
             out.emit("slo_window_p", labels, st.get("window_p", 0.0))
             out.emit("slo_breached", labels, 1 if st.get("breached") else 0)
             out.emit("slo_breaches_total", labels, st.get("breaches_total", 0), "counter")
+
+    profiling = snap.get("profiling", {})
+    if profiling:
+        # the profiling plane's family: sampling stride as a gauge, the
+        # per-path dispatch/sample tallies as counters (the split-latency
+        # histograms ride the regular histograms section below)
+        out.emit("profiling_sample_every", base, profiling.get("sample_every", 0))
+        for field in ("dispatches", "samples"):
+            for path, n in sorted(profiling.get(field, {}).items()):
+                out.emit(
+                    f"profiling_{field}_total", {**base, "path": path}, n, "counter"
+                )
+
+    memory = snap.get("memory", {})
+    if memory:
+        # the memory ledger's family: byte occupancy gauges (tracked /
+        # high-water / spilled), plus the seam re-accounting and watermark
+        # activity counters
+        for gauge in (
+            "owners",
+            "tracked_bytes",
+            "high_water_bytes",
+            "spilled_bytes",
+            "watermarks",
+        ):
+            if gauge in memory:
+                out.emit(f"memory_{gauge}", base, memory[gauge])
+        for field in ("updates", "pressure_events"):
+            if field in memory:
+                out.emit(f"memory_{field}_total", base, memory[field], "counter")
 
     kernels = snap.get("kernels", {})
     for op, paths in sorted(kernels.get("dispatch", {}).items()):

@@ -1,0 +1,321 @@
+"""Device-time attribution for the compiled dispatch sites.
+
+``dispatch_seconds`` (the PR-6 fast-path histogram) measures the HOST wall
+time of a compiled dispatch — submit only, because XLA execution is
+asynchronous: the call returns as soon as the program is enqueued. That
+histogram cannot say *where* a slow ingest goes: a p99 spike is host-side
+queueing (python overhead, donation audits, executable-cache lookups) or
+device time (the program itself), and the two have entirely different
+fixes. This module splits the two **without touching any compiled
+program** (the zero-overhead gate pins the hot-path jaxprs byte-identical
+with profiling on):
+
+* :func:`set_profiling` arms an opt-in **sampled** mode — every Nth
+  dispatch per path pays the measurement, every other dispatch pays one
+  counter increment. A sampled dispatch first drains the device queue
+  (``jax.block_until_ready`` on the state about to be dispatched — the
+  profiling-mode re-dispatch sync), stamps the submit window, then blocks
+  on the outputs:
+
+  - ``host_queue_s = submit_return − submit_start`` — the host-side
+    enqueue cost with an idle device (trace-cache lookup, donation audit,
+    argument flattening, XLA submit);
+  - ``device_dispatch_s = outputs_ready − submit_return`` — the device's
+    own execution window.
+
+  Both feed the log2 histogram series
+  ``dispatch_host_queue_seconds{path=}`` /
+  ``dispatch_device_seconds{path=}`` beside the existing
+  ``dispatch_seconds``, and (with the event log enabled) land as paired
+  ``profile`` timeline sub-slices under the dispatch they decompose.
+* :func:`profile_report` adds per-executable cost attribution — the PR-4
+  ``cost_analysis`` numbers (flops, bytes accessed, output bytes) for
+  every live compiled program a sampled site dispatched through — plus the
+  per-path sample/dispatch tallies and the split-latency percentiles.
+
+Disabled (the default), :meth:`Profiler.begin` is one attribute read
+returning ``None`` — no lock, no counter, no state: the same strict-no-op
+contract every other family honors, pinned by
+``scripts/check_zero_overhead.py``.
+"""
+import math
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.histogram import HISTOGRAMS, _series_key
+
+__all__ = [
+    "DISPATCH_DEVICE_SECONDS",
+    "DISPATCH_HOST_QUEUE_SECONDS",
+    "PROFILER",
+    "Profiler",
+    "get_profiling",
+    "profile_report",
+    "set_profiling",
+    "summary",
+]
+
+#: canonical split-latency series (beside histogram.DISPATCH_SECONDS)
+DISPATCH_HOST_QUEUE_SECONDS = "dispatch_host_queue_seconds"
+DISPATCH_DEVICE_SECONDS = "dispatch_device_seconds"
+
+#: the dispatch paths the library instruments (docs + tests)
+DISPATCH_PATHS = (
+    "compiled", "update_many", "keyed_scatter", "serving_flush",
+)
+
+
+def _block(value: Any) -> None:
+    """Best-effort device sync on a pytree (numpy/python leaves are free)."""
+    try:
+        import jax
+
+        jax.block_until_ready(value)
+    except Exception:  # pragma: no cover - non-jax leaves / torn arrays
+        pass
+
+
+class Profiler:
+    """Sampled host-queue/device-time splitter (one process-global
+    instance, :data:`PROFILER`).
+
+    Call sites bracket each compiled dispatch with
+    :meth:`begin`/:meth:`finish`; when disarmed (``sample_every`` = 0, the
+    default) ``begin`` is a single attribute read returning ``None``.
+    Armed, every dispatch increments a per-path counter under the lock and
+    every ``sample_every``-th one (the 1st, the N+1th, ... — exactly
+    ``ceil(steps / N)`` fires over ``steps`` dispatches) pays the
+    measured decomposition. Nested dispatch sites (a serving flush drives
+    a keyed scatter) suppress the inner sample via a thread-local guard,
+    so one dispatch is never decomposed twice with the inner block
+    polluting the outer split.
+    """
+
+    def __init__(self) -> None:
+        self.sample_every = 0
+        self._lock = threading.Lock()
+        self._active = threading.local()
+        self._dispatches: Dict[str, int] = {}
+        self._samples: Dict[str, int] = {}
+        #: (telemetry_key, path) -> weakref to the CompiledDispatch a
+        #: sampled call went through; cost_analysis runs at report time
+        self._dispatch_refs: Dict[Tuple[str, str], Any] = {}
+        self._touched = False
+
+    # -- arming --------------------------------------------------------------
+
+    def set_sample_every(self, sample_every: Optional[int]) -> None:
+        if sample_every is not None and int(sample_every) < 0:
+            raise ValueError(
+                f"sample_every must be >= 1 (or None/0 to disarm), got {sample_every}"
+            )
+        with self._lock:
+            self.sample_every = int(sample_every or 0)
+            if self.sample_every:
+                self._touched = True
+
+    # -- the dispatch bracket ------------------------------------------------
+
+    def begin(self, path: str, sync: Any = None) -> Optional[Tuple[str, float]]:
+        """Open a dispatch bracket; returns ``None`` unless this dispatch
+        is sampled. ``sync`` (the state about to be dispatched) is blocked
+        on first so the submit window starts against an idle device."""
+        n = self.sample_every
+        if n <= 0:
+            return None
+        if getattr(self._active, "depth", 0):
+            return None  # nested site: the outer bracket owns this dispatch
+        with self._lock:
+            self._touched = True
+            count = self._dispatches.get(path, 0)
+            self._dispatches[path] = count + 1
+            fire = count % n == 0
+            if fire:
+                self._samples[path] = self._samples.get(path, 0) + 1
+        if not fire:
+            return None
+        self._active.depth = 1
+        if sync is not None:
+            _block(sync)
+        return (path, time.perf_counter())
+
+    def finish(
+        self,
+        token: Tuple[str, float],
+        out: Any,
+        key: Optional[str] = None,
+        dispatch: Any = None,
+        submit_end: Optional[float] = None,
+    ) -> None:
+        """Close a sampled bracket: block on ``out``, record the split.
+
+        ``submit_end`` is the wall-clock reading taken right after the
+        dispatch call returned (callers that already stamp it for
+        ``dispatch_seconds`` pass it through so both views agree);
+        ``dispatch`` is the :class:`~metrics_tpu.utilities.aot.CompiledDispatch`
+        whose executables :func:`profile_report` cost-attributes."""
+        path, t0 = token
+        try:
+            t1 = submit_end if submit_end is not None else time.perf_counter()
+            _block(out)
+            t2 = time.perf_counter()
+        finally:
+            self._active.depth = 0
+        host_queue_s = max(0.0, t1 - t0)
+        device_dispatch_s = max(0.0, t2 - t1)
+        HISTOGRAMS.observe(DISPATCH_HOST_QUEUE_SECONDS, host_queue_s, unit="s", path=path)
+        HISTOGRAMS.observe(DISPATCH_DEVICE_SECONDS, device_dispatch_s, unit="s", path=path)
+        if dispatch is not None and key is not None:
+            ref = weakref.ref(dispatch)
+            with self._lock:
+                self._dispatch_refs[(key, path)] = ref
+        if EVENTS.enabled:
+            EVENTS.record(
+                "profile", key, dur_s=host_queue_s, t_start=t0,
+                path=path, phase="host_queue",
+            )
+            EVENTS.record(
+                "profile", key, dur_s=device_dispatch_s, t_start=t1,
+                path=path, phase="device",
+            )
+
+    # -- export --------------------------------------------------------------
+
+    def _split_percentiles(self) -> Dict[str, Dict[str, Any]]:
+        """p50/p99 of both split series per path, read from the live
+        histogram registry (the same numbers the snapshot carries)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for series_name, field in (
+            (DISPATCH_HOST_QUEUE_SECONDS, "host_queue"),
+            (DISPATCH_DEVICE_SECONDS, "device_dispatch"),
+        ):
+            for key, hist, labels, name in HISTOGRAMS.series_items():
+                if name != series_name:
+                    continue
+                path = labels.get("path", "")
+                entry = out.setdefault(path, {})
+                entry[field] = {
+                    "count": hist.count,
+                    "p50_s": hist.percentile(50.0),
+                    "p99_s": hist.percentile(99.0),
+                }
+        return out
+
+    def _executable_costs(self) -> Dict[str, Dict[str, Any]]:
+        from metrics_tpu.observability.cost import executable_cost
+
+        with self._lock:
+            refs = dict(self._dispatch_refs)
+        out: Dict[str, Dict[str, Any]] = {}
+        for (key, path), ref in sorted(refs.items()):
+            fn = ref()
+            if fn is None:
+                continue  # the dispatch (and its executables) were collected
+            programs: List[Dict[str, Any]] = []
+            for compiled in getattr(fn, "_cache", {}).values():
+                programs.append(executable_cost(compiled))
+            available = [p for p in programs if p.get("available")]
+            entry: Dict[str, Any] = {
+                "path": path,
+                "programs": len(programs),
+                "available": bool(available),
+            }
+            if available:
+                for field in ("flops", "bytes_accessed", "output_bytes"):
+                    values = [p.get(field) for p in available if p.get(field) is not None]
+                    if values:
+                        total = float(sum(values))
+                        entry[field] = int(total) if not math.isnan(total) else None
+            out[f"{key}:{path}"] = entry
+        return out
+
+    def report(self) -> Dict[str, Any]:
+        """Sample tallies, split-latency percentiles per path, and per-op
+        cost attribution for every live sampled executable."""
+        with self._lock:
+            dispatches = dict(self._dispatches)
+            samples = dict(self._samples)
+            sample_every = self.sample_every
+        return {
+            "sample_every": sample_every,
+            "enabled": sample_every > 0,
+            "dispatches": dispatches,
+            "samples": samples,
+            "paths": self._split_percentiles(),
+            "executables": self._executable_costs(),
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """The ``snapshot()["profiling"]`` section: ``{}`` until armed or
+        sampled (planes report nothing until touched). Flat tallies only —
+        the split percentiles ride the regular histograms section, the cost
+        attribution stays in :func:`profile_report`."""
+        with self._lock:
+            if not self._touched:
+                return {}
+            return {
+                "enabled": self.sample_every > 0,
+                "sample_every": self.sample_every,
+                "dispatches": dict(self._dispatches),
+                "samples": dict(self._samples),
+            }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def disable(self) -> None:
+        """Stop sampling (``observability.disable()``): armed brackets
+        already past ``begin`` complete; new dispatches reduce to the one
+        attribute read."""
+        with self._lock:
+            self.sample_every = 0
+
+    def reset(self) -> None:
+        """Clear tallies and cost refs (``observability.reset()``); the
+        armed/disarmed setting survives, like telemetry enablement."""
+        with self._lock:
+            self._dispatches.clear()
+            self._samples.clear()
+            self._dispatch_refs.clear()
+            self._touched = self.sample_every > 0
+
+
+#: the process-global dispatch profiler
+PROFILER = Profiler()
+
+
+def set_profiling(sample_every: Optional[int] = None) -> None:
+    """Arm sampled dispatch profiling: every ``sample_every``-th compiled
+    dispatch per path pays the host-queue/device-time decomposition (the
+    1st, N+1th, ... — exactly ``ceil(steps / N)`` samples over ``steps``
+    dispatches); every other dispatch pays one counter increment.
+    ``None``/``0`` disarms. ``sample_every=1`` measures every dispatch —
+    the bench-grade mode; production scrapes want 100+."""
+    PROFILER.set_sample_every(sample_every)
+
+
+def get_profiling() -> int:
+    """The current sampling stride (0 = disarmed)."""
+    return PROFILER.sample_every
+
+
+def profile_report() -> Dict[str, Any]:
+    """The profiling plane's full report — see :meth:`Profiler.report`."""
+    return PROFILER.report()
+
+
+def summary() -> Dict[str, Any]:
+    """The profiling snapshot section (``{}`` until armed or sampled)."""
+    return PROFILER.summary()
+
+
+def split_series_keys(path: str) -> Tuple[str, str]:
+    """The histogram registry keys of the two split series for ``path``
+    (helper for benches/tests reading percentiles out of
+    ``snapshot()["histograms"]``)."""
+    return (
+        _series_key(DISPATCH_HOST_QUEUE_SECONDS, {"path": path}),
+        _series_key(DISPATCH_DEVICE_SECONDS, {"path": path}),
+    )

@@ -29,6 +29,7 @@ across processes by flow arrows, and the straggler report embedded in
 """
 import json
 import os
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from metrics_tpu.observability.events import EVENTS, Event, EventLog
@@ -216,6 +217,33 @@ def _append_serving_spans(
             )
 
 
+def _append_memory_counters(
+    trace: List[Dict[str, Any]], pid: int, log: EventLog
+) -> None:
+    """Render the memory ledger's tracked-bytes samples as a ``"C"``
+    counter track (``memory.tracked_bytes``), so HBM occupancy reads
+    against the dispatch slices. The ledger stamps samples on
+    ``perf_counter`` — the event log's clock — so ``log.now()`` gives the
+    exact offset onto the log's epoch. Empty when nothing is tracked."""
+    from metrics_tpu.observability.memory import LEDGER
+
+    samples = LEDGER.samples()
+    if not samples:
+        return
+    offset = log.now() - time.perf_counter()
+    for ts, tracked in samples:
+        trace.append(
+            {
+                "ph": "C",
+                "name": "memory.tracked_bytes",
+                "pid": pid,
+                "tid": 0,
+                "ts": round((ts + offset) * 1e6, 3),
+                "args": {"tracked_bytes": int(tracked)},
+            }
+        )
+
+
 def to_chrome_trace(
     events: Optional[Sequence[Event]] = None,
     log: Optional[EventLog] = None,
@@ -247,6 +275,7 @@ def to_chrome_trace(
     tid_for = _track_allocator(trace, pid)
     _append_events(trace, pid, events, tid_for)
     _append_serving_spans(trace, pid, tid_for, tracker.records())
+    _append_memory_counters(trace, pid, log)
 
     return {
         "traceEvents": trace,

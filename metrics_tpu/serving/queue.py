@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.profiling import PROFILER
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.tracing import TRACER
 from metrics_tpu.serving.policy import AdmissionPolicy, resolve_policy
@@ -445,6 +446,14 @@ class AdmissionQueue:
                                 )
                                 for c in cols
                             ]
+                    # sampled profiling brackets the target dispatch: the
+                    # owner's state bundles stand in for submit/ready sync
+                    # (the target call itself returns nothing)
+                    owner = getattr(self._target, "__self__", None)
+                    states = getattr(owner, "_get_states", None)
+                    prof = PROFILER.begin(
+                        "serving_flush", states() if states is not None else None
+                    )
                     try:
                         _consult_fault_seam("serving.dispatch", rows=len(rows))
                         self._target(ids, *cols)
@@ -454,6 +463,13 @@ class AdmissionQueue:
                         error = err
                         if self.breaker is not None:
                             self.breaker.record_failure()
+                    finally:
+                        if prof is not None:
+                            PROFILER.finish(
+                                prof,
+                                states() if states is not None else None,
+                                self.telemetry_key,
+                            )
                 dur = time.perf_counter() - t0
                 end = time.perf_counter()
                 self._note_flush(trigger, rows, depth_before, dur, end, error)

@@ -70,6 +70,7 @@ from metrics_tpu.metric import (
 from metrics_tpu.observability.events import EVENTS
 from metrics_tpu.observability.health import HEALTH, guard_state
 from metrics_tpu.observability.histogram import observe_dispatch
+from metrics_tpu.observability.profiling import PROFILER
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.retrace import arg_signature, is_tracing
 from metrics_tpu.utilities.aot import CompiledDispatch
@@ -655,8 +656,12 @@ class KeyedMetric(Metric):
             if self._jit_forward_donate:
                 state, donatable = self._donation_safe_state(state)
             fn = self._keyed_dispatch(donatable)
+            prof = PROFILER.begin("keyed_scatter", state)
             start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
             new_state, _ = fn(state, ids, *args, **kwargs)
+            submitted = time.perf_counter() if (start is not None or prof is not None) else None
+            if prof is not None:
+                PROFILER.finish(prof, new_state, self.telemetry_key, fn, submit_end=submitted)
             self._set_states(new_state)
             if hooks is not None:
                 hooks.after_update(np.asarray(ids))
@@ -666,7 +671,7 @@ class KeyedMetric(Metric):
             # silently drop tenants from the next delta's dirty set
             self._note_tenant_traffic(ids)
         if start is not None:
-            dur = time.perf_counter() - start
+            dur = submitted - start
             key = self.telemetry_key
             if TELEMETRY.enabled:
                 TELEMETRY.inc(key, "keyed_update_rows", int(ids.shape[0]))
@@ -901,6 +906,11 @@ class KeyedMetric(Metric):
             self._forward_cache = None
             if hooks is not None:
                 hooks.on_resize(num_tenants)
+        # re-note the memory ledger OUTSIDE the serial lock: a pressure
+        # callback may evict, and eviction re-takes this same lock
+        from metrics_tpu.observability.memory import LEDGER
+
+        LEDGER.note(self)
 
     def grow(self, num_tenants: int) -> int:
         """Grow the logical tenant axis to ``num_tenants`` (monotone; a
@@ -1282,8 +1292,12 @@ class MultiTenantCollection:
             if self._donate:
                 state, donatable = self._donation_safe_state(state)
             fn = self._dispatch(donatable)
+            prof = PROFILER.begin("keyed_scatter", state)
             start = time.perf_counter() if (TELEMETRY.enabled or EVENTS.enabled) else None
             new_state, _ = fn(state, ids, *args, **kwargs)
+            submitted = time.perf_counter() if (start is not None or prof is not None) else None
+            if prof is not None:
+                PROFILER.finish(prof, new_state, self.telemetry_key, fn, submit_end=submitted)
             self._writeback(new_state)
             if hooks is not None:
                 hooks.after_update(np.asarray(ids))
@@ -1292,7 +1306,7 @@ class MultiTenantCollection:
             # KeyedMetric.update)
             self._note_tenant_traffic(ids)
         if start is not None:
-            dur = time.perf_counter() - start
+            dur = submitted - start
             key = self.telemetry_key
             if TELEMETRY.enabled:
                 TELEMETRY.inc(key, "update_calls")

@@ -2380,6 +2380,131 @@ def bench_slo_overhead():
 bench_slo_overhead._force_cpu = True
 
 
+# ------------------------------------------------ profiling plane
+#: sampling stride for the split-ingest soak (every Nth serving flush pays
+#: the host-queue/device-time decomposition; CI smoke can lower the QPS via
+#: the METRICS_TPU_SOAK_* knobs the shared soak harness already reads)
+SPLIT_SAMPLE_EVERY = int(os.environ.get("METRICS_TPU_BENCH_SPLIT_SAMPLE_EVERY", "2"))
+
+#: one soak feeds both split-ingest configs when the suite runs in-process
+_INGEST_SPLIT_CACHE = None
+
+
+def _ingest_split_soak():
+    """Run ONE serving soak with sampled dispatch profiling armed and read
+    back the ``serving_flush`` split series: host-queue vs device-dispatch
+    p50/p99 plus the sample tallies. Cached so the two judged configs
+    (host-queue and device-dispatch) share a single soak per process."""
+    global _INGEST_SPLIT_CACHE
+    if _INGEST_SPLIT_CACHE is not None:
+        return _INGEST_SPLIT_CACHE
+
+    from metrics_tpu import observability
+    from metrics_tpu.observability.histogram import HISTOGRAMS
+    from metrics_tpu.observability.profiling import split_series_keys
+    from soak import run_soak
+
+    # the stride survives run_soak's observability.reset() — only tallies clear
+    observability.set_profiling(sample_every=SPLIT_SAMPLE_EVERY)
+    try:
+        record = run_soak(
+            tenants=SOAK_TENANTS,
+            duration_s=SOAK_DURATION_S,
+            qps=SOAK_QPS,
+            max_batch=SOAK_MAX_BATCH,
+        )
+        hist = HISTOGRAMS.snapshot()
+        hq_key, dd_key = split_series_keys("serving_flush")
+        host_queue = hist.get(hq_key, {})
+        device = hist.get(dd_key, {})
+        prof = observability.profile_report()
+    finally:
+        observability.set_profiling(0)
+    _INGEST_SPLIT_CACHE = {
+        "record": record,
+        "host_queue": host_queue,
+        "device": device,
+        "sample_every": SPLIT_SAMPLE_EVERY,
+        "dispatches": prof["dispatches"].get("serving_flush", 0),
+        "samples": prof["samples"].get("serving_flush", 0),
+    }
+    return _INGEST_SPLIT_CACHE
+
+
+def _ingest_split_extra(split):
+    """The shared evidence block both split-ingest configs carry."""
+    record, hq, dd = split["record"], split["host_queue"], split["device"]
+    return {
+        "sample_every": split["sample_every"],
+        "flush_dispatches": split["dispatches"],
+        "flush_samples": split["samples"],
+        "host_queue_ms": {
+            "p50": round(hq.get("p50", 0.0) * 1e3, 4),
+            "p99": round(hq.get("p99", 0.0) * 1e3, 4),
+            "count": hq.get("count", 0),
+        },
+        "device_dispatch_ms": {
+            "p50": round(dd.get("p50", 0.0) * 1e3, 4),
+            "p99": round(dd.get("p99", 0.0) * 1e3, 4),
+            "count": dd.get("count", 0),
+        },
+        "ingest_p99_us": record["value"],
+        "zero_lost_updates": record["zero_lost_updates"],
+        "achieved_qps": record["achieved_qps"],
+    }
+
+
+def bench_ingest_latency_split():
+    """Where a slow ingest actually goes, host side: the serving soak from
+    ``bench_serving_soak`` re-run with sampled dispatch profiling armed
+    (every ``SPLIT_SAMPLE_EVERY``-th flush pays the decomposition).
+    ``value`` is the HOST-QUEUE p99 of a serving flush — admission-queue
+    drain, row coalescing, trace-cache lookup, donation audit, XLA submit —
+    measured against an idle device; the baseline is the device-dispatch
+    p99 (the program's own execution window), so ``vs_baseline`` says how
+    host-bound the ingest path is. The paired config
+    ``bench_ingest_device_dispatch`` judges the device half; both carry the
+    full split (p50/p99 of each series in ms) plus the soak's zero-lost
+    evidence."""
+    split = _ingest_split_soak()
+    ours = split["host_queue"].get("p99", 0.0)
+
+    def ref(torchmetrics, torch):  # the device half of the same dispatches
+        return split["device"].get("p99", 0.0)
+
+    return (
+        "ingest_latency_split_step", ours, ref,
+        "us/flush-p99", _ingest_split_extra(split),
+    )
+
+
+#: host-side threading harness around the shared soak (see bench_serving_soak)
+bench_ingest_latency_split._force_cpu = True
+
+
+def bench_ingest_device_dispatch():
+    """The device half of the split ``bench_ingest_latency_split``
+    measures: ``value`` is the DEVICE-DISPATCH p99 of a sampled serving
+    flush (outputs-ready minus submit-return, the compiled scatter's own
+    execution window), judged against the host-queue p99 of the same
+    dispatches as baseline. Together the two configs pin both halves of
+    the ingest path as separately-regressable numbers."""
+    split = _ingest_split_soak()
+    ours = split["device"].get("p99", 0.0)
+
+    def ref(torchmetrics, torch):  # the host half of the same dispatches
+        return split["host_queue"].get("p99", 0.0)
+
+    return (
+        "ingest_device_dispatch_step", ours, ref,
+        "us/flush-p99", _ingest_split_extra(split),
+    )
+
+
+#: host-side threading harness around the shared soak (see bench_serving_soak)
+bench_ingest_device_dispatch._force_cpu = True
+
+
 CONFIG_META = {
     "bench_accuracy": ("accuracy_update_step", "us/step"),
     "bench_collection": ("metric_collection_update_step_fused", "us/step"),
@@ -2411,6 +2536,8 @@ CONFIG_META = {
     "bench_chaos_soak": ("chaos_soak_step", "us/ingest-p99"),
     "bench_failover_mttr": ("failover_mttr", "ms/failover"),
     "bench_slo_overhead": ("slo_overhead_step", "us/step"),
+    "bench_ingest_latency_split": ("ingest_latency_split_step", "us/flush-p99"),
+    "bench_ingest_device_dispatch": ("ingest_device_dispatch_step", "us/flush-p99"),
 }
 
 #: driver order — the flagship collection config LAST (the driver's headline)
@@ -2444,6 +2571,8 @@ CONFIGS = [
     bench_chaos_soak,
     bench_failover_mttr,
     bench_slo_overhead,
+    bench_ingest_latency_split,
+    bench_ingest_device_dispatch,
     bench_collection,
 ]
 

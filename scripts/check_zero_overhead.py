@@ -873,6 +873,80 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
         observability.TRACER.enable(prev_enabled)
         _slo_reg.clear()
 
+    # the PROFILING & MEMORY PLANE must be host-side only: with sampled
+    # profiling ARMED (every 2nd dispatch pays the host-queue/device-time
+    # decomposition), a keyed dispatch actually sampled, a metric tracked
+    # in the live-buffer ledger, and a ledger-noted grow executed, every
+    # pre-existing hot-path jaxpr must be byte-identical to the
+    # profiling-off state — the profiler brackets block and stamp AROUND
+    # the compiled call and the ledger reads aval metadata; neither may
+    # put a traced op inside a program
+    _prev_stride = observability.get_profiling()
+    _prof_probe = _Keyed(_Acc(), 8)
+    try:
+        observability.enable()
+        observability.set_profiling(sample_every=2)
+        observability.LEDGER.track(_prof_probe)
+        for _ in range(3):
+            _prof_probe.update(
+                _jnp.zeros((4,), _jnp.int32),
+                _jnp.zeros((4,), _jnp.float32),
+                _jnp.zeros((4,), _jnp.int32),
+            )
+        _prof_probe.grow(12)  # executable-invalidation seam: re-notes the ledger
+        # the sweep must not pass vacuously: the armed stride has to have
+        # actually sampled a keyed dispatch above
+        _prof = observability.PROFILER.report()
+        if _prof["samples"].get("keyed_scatter", 0) < 1:
+            violations.append(
+                "profiling sweep: sample_every=2 armed but no keyed_scatter"
+                " dispatch was sampled — the identity check is vacuous"
+            )
+        for name, thunk in programs.items():
+            if thunk() != texts[name]:
+                violations.append(
+                    f"{name}: jaxpr differs with sampled profiling armed and the"
+                    " memory ledger tracking — the profiling/memory plane leaked"
+                    " traced ops into the hot path"
+                )
+        # the disabled mode is a STRICT no-op: with the stride back at 0,
+        # begin() must be a single attribute read returning None, and a
+        # real dispatch must leave the tallies exactly where the armed
+        # window left them
+        observability.set_profiling(0)
+        _before = observability.PROFILER.report()
+        if observability.PROFILER.begin("compiled", None) is not None:
+            violations.append(
+                "Profiler.begin: returned a token with profiling disarmed —"
+                " the disabled path is not a strict no-op"
+            )
+        _prof_probe.update(
+            _jnp.zeros((4,), _jnp.int32),
+            _jnp.zeros((4,), _jnp.float32),
+            _jnp.zeros((4,), _jnp.int32),
+        )
+        _after = observability.PROFILER.report()
+        if (_after["dispatches"], _after["samples"]) != (
+            _before["dispatches"], _before["samples"]
+        ):
+            violations.append(
+                "Profiler: dispatch tallies moved with profiling disarmed —"
+                " a call site is counting outside the armed window"
+            )
+        for name, thunk in programs.items():
+            if thunk() != texts[name]:
+                violations.append(
+                    f"{name}: jaxpr differs after the profiling-disarmed window —"
+                    " the disabled profiler altered a hot program"
+                )
+    finally:
+        observability.set_profiling(_prev_stride)
+        observability.PROFILER.reset()
+        observability.LEDGER.untrack(_prof_probe)
+        observability.TELEMETRY.enable(prev_enabled)
+        observability.EVENTS.enable(prev_enabled)
+        observability.TRACER.enable(prev_enabled)
+
     # the TRANSPORT SEAM must be free: with the in-graph / gather strategy
     # backends explicitly installed as the process-global transport (the
     # dispatch every sync now routes through), every hot-path jaxpr must be

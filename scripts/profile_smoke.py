@@ -1,0 +1,235 @@
+"""Profiling & memory-accounting smoke (CI leg: ``make profile-smoke``).
+
+One self-contained pass over the profiling/capacity plane's contract,
+cheap enough for every CI run:
+
+1. arm ``set_profiling(sample_every=2)`` and drive known dispatch counts
+   through the instrumented paths (``compiled``, ``update_many``,
+   ``keyed_scatter``) — assert the deterministic sampling law (exactly
+   ``ceil(steps / N)`` samples per path) and that both split series
+   (``dispatch_host_queue_seconds{path=}`` /
+   ``dispatch_device_seconds{path=}``) carry exactly that many
+   observations, with per-executable cost attribution available in
+   ``profile_report()``;
+2. track a keyed metric in the live-buffer ledger and push it through
+   every byte-changing seam — grow, compact, spill evict, fault-back —
+   asserting the conservation law (``tracked_bytes`` equals the freshly
+   recomputed live bundle bytes, byte-exact) after EVERY transition, and
+   that the spiller's ``resident_bytes``/``spilled_bytes`` agree with the
+   ledger;
+3. byte-pressure: a low watermark must fire the spiller's pressure
+   callback and actually evict, with conservation still intact;
+4. the disabled mode must be a STRICT no-op: with the stride at 0,
+   ``Profiler.begin`` returns ``None`` and real dispatches leave the
+   tallies frozen;
+5. lifecycle: ``observability.reset()`` clears tallies but keeps the
+   stride and tracked owners; ``observability.disable()`` disarms the
+   profiler and drops pending watermarks.
+
+Exit 1 on any violation. Run: ``JAX_PLATFORMS=cpu python
+scripts/profile_smoke.py``.
+"""
+import math
+import os
+import sys
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def run_smoke() -> int:
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, KeyedMetric, StatScores, observability
+    from metrics_tpu.durability import TenantSpiller
+    from metrics_tpu.observability.profiling import split_series_keys
+
+    failures = []
+
+    def check(ok, msg):
+        if not ok:
+            failures.append(msg)
+            print(f"FAIL: {msg}")
+
+    observability.reset()
+    observability.enable()
+    rng = np.random.RandomState(0)
+
+    # -- 1: deterministic sampling across the instrumented paths -----------
+    stride = 2
+    observability.set_profiling(sample_every=stride)
+    steps = 7
+
+    m = Accuracy(num_classes=2)
+    m.jit_forward()
+    for _ in range(steps):
+        m.forward(jnp.asarray(rng.randint(0, 2, 32)), jnp.asarray(rng.randint(0, 2, 32)))
+    m2 = Accuracy(num_classes=2)
+    for _ in range(steps):
+        m2.update_many(
+            jnp.asarray(rng.randint(0, 2, (3, 32))),
+            jnp.asarray(rng.randint(0, 2, (3, 32))),
+        )
+    tenants = 16
+    keyed = KeyedMetric(StatScores(reduce="macro", num_classes=3), tenants)
+    for _ in range(steps):
+        ids = jnp.asarray(rng.randint(0, tenants, 64))
+        logits = rng.rand(64, 3).astype(np.float32)
+        keyed.update(
+            ids,
+            jnp.asarray(logits / logits.sum(-1, keepdims=True)),
+            jnp.asarray(rng.randint(0, 3, 64)),
+        )
+
+    want = math.ceil(steps / stride)
+    report = observability.profile_report()
+    for path in ("compiled", "update_many", "keyed_scatter"):
+        check(
+            report["dispatches"].get(path) == steps,
+            f"{path}: {report['dispatches'].get(path)} dispatches counted, drove {steps}",
+        )
+        check(
+            report["samples"].get(path) == want,
+            f"{path}: {report['samples'].get(path)} samples at stride {stride} over"
+            f" {steps} dispatches, the sampling law says exactly {want}",
+        )
+        hist = observability.HISTOGRAMS.snapshot()
+        for series in split_series_keys(path):
+            count = hist.get(series, {}).get("count")
+            check(
+                count == want,
+                f"{series}: {count} observations, expected {want} (one per sample)",
+            )
+    execs = report["executables"]
+    check(bool(execs), "profile_report()['executables'] is empty after sampled dispatches")
+    check(
+        any(e.get("available") and e.get("flops") for e in execs.values()),
+        "no sampled executable has cost_analysis flops attributed",
+    )
+    snap_prof = observability.snapshot()["profiling"]
+    check(
+        snap_prof.get("enabled") is True and snap_prof.get("sample_every") == stride,
+        f"snapshot()['profiling'] wrong while armed: {snap_prof}",
+    )
+    print(f"# sampling: {want}/{steps} per path across 3 paths, cost attribution OK")
+
+    # -- 2: ledger conservation through every byte-changing seam -----------
+    ledger = observability.LEDGER
+    ledger.track(keyed)
+    spiller = TenantSpiller(keyed, resident_cap=4, auto=False, min_idle_s=0.0)
+
+    def conserved(stage):
+        rep = observability.memory_report()
+        check(
+            rep["conservation_ok"],
+            f"conservation broken after {stage}: tracked {rep['tracked_bytes']}B"
+            f" != recomputed {rep['recomputed_bytes']}B",
+        )
+        return rep
+
+    conserved("track")
+    keyed.grow(2 * tenants)
+    conserved("grow")
+    spiller.maybe_evict()
+    rep = conserved("spill evict")
+    srep = spiller.report()
+    check(
+        srep["resident_bytes"] == rep["tracked_bytes"],
+        f"spiller resident_bytes {srep['resident_bytes']}B != ledger tracked"
+        f" {rep['tracked_bytes']}B (one tracked owner)",
+    )
+    check(
+        srep["spilled_bytes"] == rep["spilled_bytes"],
+        f"spiller spilled_bytes {srep['spilled_bytes']}B != ledger spilled"
+        f" {rep['spilled_bytes']}B",
+    )
+    check(srep["spilled_bytes"] > 0, "spiller evicted nothing at resident_cap=4")
+    spiller.fault_back()
+    rep = conserved("fault-back")
+    check(
+        rep["spilled_bytes"] == 0,
+        f"{rep['spilled_bytes']}B still marked spilled after full fault-back",
+    )
+    keyed.compact(tenants)
+    conserved("compact")
+    print("# conservation: byte-exact through grow/evict/fault-back/compact")
+
+    # -- 3: byte pressure fires the spiller ---------------------------------
+    spiller.detach()  # one set of durability hooks per metric
+    pressure_high = max(1, ledger.tracked_bytes() // 2)
+    spiller2 = TenantSpiller(
+        keyed, resident_cap=tenants, auto=False, min_idle_s=0.0,
+        pressure_high=pressure_high,
+    )
+    keyed.grow(2 * tenants)  # ledger-noted seam: crosses the watermark
+    rep = conserved("pressure evict")
+    check(
+        spiller2.report()["pressure_evictions"] >= 1,
+        "watermark crossed but the spiller's pressure callback evicted nothing",
+    )
+    check(
+        rep["pressure_events"] >= 1,
+        f"ledger recorded {rep['pressure_events']} pressure events, watermark"
+        f" high={pressure_high}B was crossed",
+    )
+    print(f"# pressure: watermark at {pressure_high}B fired, conservation intact")
+
+    # -- 4: disabled mode is a strict no-op ---------------------------------
+    observability.set_profiling(0)
+    before = observability.profile_report()
+    check(
+        observability.PROFILER.begin("compiled", None) is None,
+        "Profiler.begin returned a token while disarmed",
+    )
+    m.forward(jnp.asarray(rng.randint(0, 2, 32)), jnp.asarray(rng.randint(0, 2, 32)))
+    after = observability.profile_report()
+    check(
+        (after["dispatches"], after["samples"]) == (before["dispatches"], before["samples"]),
+        "dispatch tallies moved while profiling was disarmed — the disabled"
+        " path is not a no-op",
+    )
+    print("# disabled mode: strict no-op")
+
+    # -- 5: lifecycle — reset keeps the stride, disable disarms -------------
+    observability.set_profiling(stride)
+    observability.reset()
+    check(
+        observability.get_profiling() == stride,
+        f"reset() dropped the sampling stride ({observability.get_profiling()},"
+        f" armed {stride})",
+    )
+    check(
+        observability.profile_report()["dispatches"] == {},
+        "reset() left dispatch tallies behind",
+    )
+    check(
+        observability.memory_report()["owners"],
+        "reset() dropped the ledger's tracked owners",
+    )
+    observability.disable()
+    check(
+        observability.get_profiling() == 0,
+        "disable() left the profiler armed",
+    )
+    check(
+        not observability.memory_report()["watermarks"],
+        "disable() left pending watermark callbacks registered",
+    )
+    observability.enable()
+    spiller2.detach()
+    ledger.untrack(keyed)
+    observability.set_profiling(0)
+    observability.reset()
+
+    if failures:
+        print(f"\nprofile smoke: {len(failures)} violation(s)")
+        return 1
+    print("\nprofile smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_smoke())

@@ -785,6 +785,20 @@ def run_soak(
         # state — all while the zero-lost invariant above held
         spill_report = spiller.report()
         durability = snap.get("durability", {})
+        # byte-level conservation against the live-buffer ledger (the
+        # spiller's attach tracked the metric): while tenants are spilled,
+        # the ledger's incremental total must equal the freshly recomputed
+        # device bytes AND the spiller's byte view must agree with the
+        # ledger's per-owner entry, byte-exact
+        from metrics_tpu.observability.memory import memory_report
+
+        mem_spilled = memory_report()
+        owner = mem_spilled["owners"].get(metric.telemetry_key, {})
+        bytes_conserved = bool(
+            mem_spilled["conservation_ok"]
+            and owner.get("device_bytes") == spill_report["resident_bytes"]
+            and owner.get("spilled_bytes") == spill_report["spilled_bytes"]
+        )
         values_spilled = np.asarray(svc.read(max_staleness_s=0.0))
         spiller.fault_back()
         values_resident = np.asarray(metric.compute())
@@ -795,6 +809,15 @@ def run_soak(
             )
             and np.array_equal(np.isnan(values_spilled), np.isnan(values_resident))
         )
+        # after the full fault-back the host-spilled gauge must return to
+        # zero with the incremental total still exact
+        mem_resident = memory_report()
+        owner_after = mem_resident["owners"].get(metric.telemetry_key, {})
+        bytes_conserved = bool(
+            bytes_conserved
+            and mem_resident["conservation_ok"]
+            and owner_after.get("spilled_bytes") == 0
+        )
         record["spill"] = {
             "resident_cap": spiller.resident_cap,
             **spill_report,
@@ -802,6 +825,12 @@ def run_soak(
             "fault_backs": durability.get("fault_backs", 0),
             "spilled_high_water": durability.get("spilled_high_water", 0),
             "faultback_reads_bit_identical": faultback_identical,
+            "bytes_conserved": bytes_conserved,
+            "ledger": {
+                "tracked_bytes": mem_spilled["tracked_bytes"],
+                "spilled_bytes": mem_spilled["spilled_bytes"],
+                "high_water_bytes": mem_spilled["high_water_bytes"],
+            },
         }
     if counters.get("last_read_error"):
         record["last_read_error"] = counters["last_read_error"]
@@ -1025,6 +1054,7 @@ def main(argv=None) -> int:
             spill["resident_under_cap"]
             and spill["conservation_ok"]
             and spill["faultback_reads_bit_identical"]
+            and spill["bytes_conserved"]
         )
     chaos = record.get("chaos")
     if chaos is not None:

@@ -590,3 +590,63 @@ def test_merged_histogram_percentiles_equal_summed_bucket_percentiles():
         averaged = (fast.to_dict()[key] + slow.to_dict()[key]) / 2.0
         assert entry[key] != pytest.approx(averaged, rel=0.3), key
     assert entry["p50"] < 1e-4 < 0.1 < entry["p95"]
+
+
+def test_merged_profiling_and_memory_sections_follow_fleet_rules():
+    """Satellite: the profiling section merges with enabled OR-ed
+    (``any``: a fleet with one armed process IS profiling), the stride
+    last-wins (config, not a tally), and the per-path dispatch/sample
+    tallies summed; the memory section sums every byte gauge EXCEPT the
+    high-water, which takes the fleet max — summing peaks that never
+    coexisted would fabricate a fleet peak."""
+    armed = {
+        "schema": 1,
+        "profiling": {
+            "enabled": True,
+            "sample_every": 4,
+            "dispatches": {"compiled": 10, "serving_flush": 6},
+            "samples": {"compiled": 3, "serving_flush": 2},
+        },
+        "memory": {
+            "owners": 2,
+            "tracked_bytes": 1000,
+            "high_water_bytes": 1500,
+            "spilled_bytes": 100,
+            "updates": 5,
+            "pressure_events": 1,
+            "watermarks": 1,
+        },
+    }
+    idle = {
+        "schema": 1,
+        "profiling": {
+            "enabled": False,
+            "sample_every": 0,
+            "dispatches": {"compiled": 7, "keyed_scatter": 4},
+            "samples": {"compiled": 2, "keyed_scatter": 1},
+        },
+        "memory": {
+            "owners": 1,
+            "tracked_bytes": 400,
+            "high_water_bytes": 1200,
+            "spilled_bytes": 0,
+            "updates": 2,
+            "pressure_events": 0,
+            "watermarks": 0,
+        },
+    }
+    merged = merge_snapshots([armed, idle])
+
+    prof = merged["profiling"]
+    assert prof["enabled"] is True  # any: one armed process arms the fleet
+    assert prof["sample_every"] == 0  # last-wins config, like enablement
+    assert prof["dispatches"] == {"compiled": 17, "serving_flush": 6, "keyed_scatter": 4}
+    assert prof["samples"] == {"compiled": 5, "serving_flush": 2, "keyed_scatter": 1}
+
+    mem = merged["memory"]
+    assert mem["tracked_bytes"] == 1400 and mem["spilled_bytes"] == 100
+    assert mem["owners"] == 3 and mem["updates"] == 7
+    assert mem["pressure_events"] == 1 and mem["watermarks"] == 1
+    assert mem["high_water_bytes"] == 1500  # fleet max, never a sum
+
+    assert json.loads(json.dumps(merged)) == merged

@@ -1,0 +1,420 @@
+"""The live-buffer memory ledger: aval-metadata byte accounting, the
+conservation law through every executable-invalidation seam, watermark
+hysteresis, the writer/reader concurrency battery, weakref eviction, the
+Perfetto memory counter track, and the reset()/disable() lifecycle."""
+import gc
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    KeyedMetric,
+    MetricCollection,
+    Precision,
+    Recall,
+    StatScores,
+    observability,
+)
+from metrics_tpu.observability import timeline
+from metrics_tpu.observability.events import EventLog
+from metrics_tpu.observability.memory import (
+    LEDGER,
+    MemoryLedger,
+    bundle_bytes,
+    memory_report,
+)
+
+
+def _drain_global_ledger():
+    """Untrack owners leaked into the process-global ledger by earlier test
+    files (spillers/checkpoints track metrics for life) so the absolute
+    totals asserted below start from zero."""
+    gc.collect()  # run weakref finalizers for already-dead owners
+    for entry in list(LEDGER._entries.values()):
+        owner = entry["ref"]()
+        if owner is not None:
+            LEDGER.untrack(owner)
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    _drain_global_ledger()
+    observability.reset()
+    observability.enable()
+    yield
+    _drain_global_ledger()
+    observability.reset()
+    observability.enable()
+
+
+class _Owner:
+    """Stub owner with a settable byte size (the aval-report shape)."""
+
+    def __init__(self, nbytes, key="stub"):
+        self.nbytes = nbytes
+        self.telemetry_key = key
+
+    def state_memory_report(self):
+        return {"total_bytes": self.nbytes}
+
+
+def _conserved(ledger):
+    rep = ledger.report()
+    assert rep["conservation_ok"], (
+        f"tracked {rep['tracked_bytes']}B != recomputed {rep['recomputed_bytes']}B"
+    )
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# accounting + conservation through the seams
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_bytes_matches_aval_metadata():
+    keyed = KeyedMetric(StatScores(reduce="macro", num_classes=3), 16)
+    assert bundle_bytes(keyed) == keyed.state_memory_report()["total_bytes"]
+
+
+def test_track_note_untrack_roundtrip():
+    ledger = MemoryLedger()
+    owner = _Owner(100)
+    assert ledger.track(owner) == 100
+    assert ledger.tracked_bytes() == 100
+    owner.nbytes = 250
+    ledger.note(owner)
+    assert ledger.tracked_bytes() == 250
+    assert ledger.owner_bytes(owner) == 250
+    _conserved(ledger)
+    ledger.untrack(owner)
+    assert ledger.tracked_bytes() == 0
+    assert ledger.owner_bytes(owner) is None
+
+
+def test_note_on_untracked_owner_is_noop():
+    ledger = MemoryLedger()
+    ledger.note(_Owner(999))
+    assert ledger.tracked_bytes() == 0
+    assert ledger.summary() == {}  # lazy until the first track()
+
+
+def test_track_is_idempotent():
+    ledger = MemoryLedger()
+    owner = _Owner(64)
+    ledger.track(owner)
+    ledger.track(owner)
+    assert ledger.tracked_bytes() == 64
+    assert len(ledger.report()["owners"]) == 1
+
+
+def test_conservation_through_grow_compact_seams():
+    """grow/compact invalidate executables AND change the byte total — the
+    seam note must keep the incremental total byte-exact."""
+    keyed = KeyedMetric(StatScores(reduce="macro", num_classes=3), 8)
+    LEDGER.track(keyed)
+    try:
+        before = LEDGER.tracked_bytes()
+        keyed.grow(32)
+        rep = _conserved(LEDGER)
+        assert rep["tracked_bytes"] == bundle_bytes(keyed) > before
+        keyed.compact(8)
+        rep = _conserved(LEDGER)
+        assert rep["tracked_bytes"] == bundle_bytes(keyed) == before
+        assert rep["high_water_bytes"] > before  # the grown peak survives
+    finally:
+        LEDGER.untrack(keyed)
+
+
+def test_conservation_through_add_metrics_seam():
+    coll = MetricCollection({"p": Precision(num_classes=3), "r": Recall(num_classes=3)})
+    LEDGER.track(coll)
+    try:
+        before = LEDGER.tracked_bytes()
+        coll.add_metrics({"a": Accuracy(num_classes=3)})
+        rep = _conserved(LEDGER)
+        assert rep["tracked_bytes"] == bundle_bytes(coll) > before
+    finally:
+        LEDGER.untrack(coll)
+
+
+def test_spill_evict_and_faultback_bytes_conserved():
+    """The spiller's attach tracks the metric; evict moves bytes to the
+    host-spilled gauge (device bytes unchanged — rows are zeroed in
+    place), fault-back returns them, conservation byte-exact throughout."""
+    from metrics_tpu.durability import TenantSpiller
+
+    rng = np.random.RandomState(0)
+    keyed = KeyedMetric(StatScores(reduce="macro", num_classes=3), 16)
+    for _ in range(4):
+        logits = rng.rand(32, 3).astype(np.float32)
+        keyed.update(
+            jnp.asarray(rng.randint(0, 16, 32)),
+            jnp.asarray(logits / logits.sum(-1, keepdims=True)),
+            jnp.asarray(rng.randint(0, 3, 32)),
+        )
+    spiller = TenantSpiller(keyed, resident_cap=4, auto=False, min_idle_s=0.0)
+    try:
+        rep = _conserved(LEDGER)
+        device_bytes = rep["tracked_bytes"]
+        assert spiller.maybe_evict() > 0
+        rep = _conserved(LEDGER)
+        assert rep["tracked_bytes"] == device_bytes  # in-place zeroing
+        assert rep["spilled_bytes"] == spiller.report()["spilled_bytes"] > 0
+        assert spiller.report()["resident_bytes"] == bundle_bytes(keyed)
+        spiller.fault_back()
+        rep = _conserved(LEDGER)
+        assert rep["spilled_bytes"] == 0
+    finally:
+        spiller.detach()
+        LEDGER.untrack(keyed)
+
+
+def test_weakref_eviction_releases_bytes():
+    ledger = MemoryLedger()
+    owner = _Owner(128)
+    ledger.track(owner)
+    assert ledger.tracked_bytes() == 128
+    del owner
+    gc.collect()
+    assert ledger.tracked_bytes() == 0
+    assert ledger.report()["owners"] == {}
+
+
+# ---------------------------------------------------------------------------
+# watermarks
+# ---------------------------------------------------------------------------
+
+
+def test_watermark_fires_once_with_hysteresis():
+    ledger = MemoryLedger()
+    owner = _Owner(10)
+    ledger.track(owner)
+    fired = []
+    ledger.on_pressure(fired.append, high=100, low=50)
+
+    owner.nbytes = 120
+    ledger.note(owner)
+    assert fired == [120]  # crossed high: one fire, callback sees the total
+    owner.nbytes = 130
+    ledger.note(owner)
+    assert len(fired) == 1  # still above low: NOT re-armed, no storm
+    owner.nbytes = 40
+    ledger.note(owner)
+    assert len(fired) == 1  # fell below low: re-armed silently
+    owner.nbytes = 110
+    ledger.note(owner)
+    assert len(fired) == 2  # second crossing fires again
+    assert ledger.report()["pressure_events"] == 2
+
+
+def test_watermark_cancel_and_validation():
+    ledger = MemoryLedger()
+    owner = _Owner(10)
+    ledger.track(owner)
+    fired = []
+    handle = ledger.on_pressure(fired.append, high=50)
+    handle.cancel()
+    owner.nbytes = 500
+    ledger.note(owner)
+    assert fired == []
+    with pytest.raises(ValueError, match="high watermark"):
+        ledger.on_pressure(fired.append, high=0)
+    with pytest.raises(ValueError, match="low watermark"):
+        ledger.on_pressure(fired.append, high=50, low=50)
+
+
+def test_watermark_callbacks_fire_outside_the_ledger_lock():
+    """A subscriber must be able to call back INTO the ledger (the spiller
+    re-notes after evicting) without deadlocking."""
+    ledger = MemoryLedger()
+    owner = _Owner(10)
+    ledger.track(owner)
+
+    def evict_and_renote(_total):
+        owner.nbytes = 10
+        ledger.note(owner)  # would deadlock if fired under the lock
+
+    ledger.on_pressure(evict_and_renote, high=100)
+    owner.nbytes = 200
+    ledger.note(owner)
+    assert ledger.tracked_bytes() == 10
+
+
+def test_spilled_gauge_never_trips_watermarks():
+    ledger = MemoryLedger()
+    owner = _Owner(10)
+    ledger.track(owner)
+    fired = []
+    ledger.on_pressure(fired.append, high=50)
+    ledger.note_spilled(owner, 500)  # host bytes, not device pressure
+    assert fired == []
+    assert ledger.spilled_bytes() == 500
+
+
+# ---------------------------------------------------------------------------
+# concurrency battery
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_noters_and_readers_conserve():
+    """Writer threads re-noting sizes while readers pull report()/summary():
+    no exception, and the final total is byte-exact."""
+    ledger = MemoryLedger()
+    owners = [_Owner(100, key=f"owner-{i}") for i in range(4)]
+    for o in owners:
+        ledger.track(o)
+    stop = threading.Event()
+    errors = []
+
+    def writer(owner, seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(300):
+                owner.nbytes = int(rng.randint(1, 1000))
+                ledger.note(owner)
+        except Exception as exc:  # pragma: no cover - the failure being tested
+            errors.append(exc)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                rep = ledger.report()
+                assert rep["tracked_bytes"] >= 0
+                ledger.summary()
+                ledger.samples()
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer, args=(o, i)) for i, o in enumerate(owners)]
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers + threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert errors == []
+    rep = _conserved(ledger)
+    assert rep["tracked_bytes"] == sum(o.nbytes for o in owners)
+    assert rep["updates"] == 4 + 4 * 300  # tracks + every note
+
+
+def test_concurrent_track_untrack_stays_consistent():
+    ledger = MemoryLedger()
+    errors = []
+
+    def churn(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(200):
+                o = _Owner(int(rng.randint(1, 100)))
+                ledger.track(o)
+                ledger.note(o)
+                ledger.untrack(o)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=churn, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert ledger.tracked_bytes() == 0 and ledger.spilled_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# export + lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_carries_memory_section():
+    keyed = KeyedMetric(StatScores(reduce="macro", num_classes=3), 8)
+    LEDGER.track(keyed)
+    try:
+        section = observability.snapshot()["memory"]
+        assert section["owners"] >= 1
+        assert section["tracked_bytes"] >= bundle_bytes(keyed)
+        assert section["high_water_bytes"] >= section["tracked_bytes"]
+    finally:
+        LEDGER.untrack(keyed)
+
+
+def test_prometheus_renders_memory_family():
+    keyed = KeyedMetric(StatScores(reduce="macro", num_classes=3), 8)
+    LEDGER.track(keyed)
+    try:
+        text = observability.render_prometheus()
+        assert "metrics_tpu_memory_tracked_bytes" in text
+        assert "metrics_tpu_memory_high_water_bytes" in text
+        assert "metrics_tpu_memory_owners" in text
+    finally:
+        LEDGER.untrack(keyed)
+
+
+def test_timeline_emits_memory_counter_track():
+    """The ledger's sample ring lands as a Perfetto counter track on the
+    event log's clock."""
+    log = EventLog()
+    keyed = KeyedMetric(StatScores(reduce="macro", num_classes=3), 8)
+    LEDGER.track(keyed)
+    try:
+        keyed.grow(16)
+        trace = timeline.to_chrome_trace(log=log)
+        counters = [
+            e for e in trace["traceEvents"]
+            if e.get("ph") == "C" and e.get("name") == "memory.tracked_bytes"
+        ]
+        assert counters, "no memory counter samples in the trace"
+        assert counters[-1]["args"]["tracked_bytes"] == LEDGER.tracked_bytes()
+        assert all(c["ts"] >= 0 for c in counters)
+    finally:
+        LEDGER.untrack(keyed)
+
+
+def test_reset_reseeds_high_water_and_keeps_owners():
+    """PR-17 regression: observability.reset() clears tallies, samples and
+    watermarks but KEEPS tracked owners (registrations, not counters) —
+    the high-water re-seeds at the current total."""
+    owner = _Owner(100)
+    LEDGER.track(owner)
+    try:
+        fired = []
+        LEDGER.on_pressure(fired.append, high=1000)
+        owner.nbytes = 400
+        LEDGER.note(owner)
+        assert LEDGER.high_water_bytes() == 400
+        owner.nbytes = 100
+        LEDGER.note(owner)
+        observability.reset()
+        assert LEDGER.tracked_bytes() == 100  # still tracked
+        assert LEDGER.high_water_bytes() == 100  # re-seeded, not kept
+        assert LEDGER.samples() == []
+        owner.nbytes = 2000
+        LEDGER.note(owner)
+        assert fired == []  # the watermark did NOT survive the reset
+        assert LEDGER.report()["pressure_events"] == 0
+    finally:
+        LEDGER.untrack(owner)
+
+
+def test_disable_drops_watermarks():
+    """PR-17 regression: observability.disable() must drop pending
+    watermark callbacks — a disabled stack never calls into spill logic."""
+    owner = _Owner(10)
+    LEDGER.track(owner)
+    try:
+        fired = []
+        LEDGER.on_pressure(fired.append, high=50)
+        observability.disable()
+        observability.enable()
+        owner.nbytes = 500
+        LEDGER.note(owner)
+        assert fired == []
+        assert LEDGER.report()["watermarks"] == []
+    finally:
+        LEDGER.untrack(owner)

@@ -1,0 +1,231 @@
+"""The dispatch profiler: deterministic every-Nth sampling, the
+host-queue/device-time split series, nested-site suppression, cost
+attribution, the disabled-mode strict no-op, and the reset()/disable()
+lifecycle (the PR-17 regression: a reset or disabled stack must clear and
+stop profiling state)."""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, KeyedMetric, StatScores, observability
+from metrics_tpu.observability.events import EVENTS
+from metrics_tpu.observability.histogram import HISTOGRAMS
+from metrics_tpu.observability.profiling import (
+    DISPATCH_DEVICE_SECONDS,
+    DISPATCH_HOST_QUEUE_SECONDS,
+    PROFILER,
+    Profiler,
+    split_series_keys,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    observability.set_profiling(0)
+    observability.reset()
+    observability.enable()
+    yield
+    observability.set_profiling(0)
+    observability.reset()
+    observability.enable()
+
+
+def _drive_forward(metric, steps, rng):
+    for _ in range(steps):
+        metric.forward(
+            jnp.asarray(rng.randint(0, 2, 32)), jnp.asarray(rng.randint(0, 2, 32))
+        )
+
+
+# ---------------------------------------------------------------------------
+# the sampling law
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stride,steps", [(1, 4), (2, 7), (3, 7), (5, 4)])
+def test_sampling_fires_exactly_ceil_steps_over_stride(stride, steps):
+    """Deterministic, not probabilistic: the 1st, N+1th, ... dispatches
+    sample — exactly ceil(steps/N) fires, and BOTH split series carry one
+    observation per fire."""
+    rng = np.random.RandomState(0)
+    observability.set_profiling(sample_every=stride)
+    m = Accuracy(num_classes=2)
+    m.jit_forward()
+    _drive_forward(m, steps, rng)
+
+    want = math.ceil(steps / stride)
+    report = observability.profile_report()
+    assert report["dispatches"]["compiled"] == steps
+    assert report["samples"]["compiled"] == want
+    hist = HISTOGRAMS.snapshot()
+    for series in split_series_keys("compiled"):
+        assert hist[series]["count"] == want, series
+
+
+def test_keyed_scatter_and_update_many_paths_sampled():
+    rng = np.random.RandomState(0)
+    observability.set_profiling(sample_every=2)
+    keyed = KeyedMetric(StatScores(reduce="macro", num_classes=3), 8)
+    for _ in range(5):
+        logits = rng.rand(16, 3).astype(np.float32)
+        keyed.update(
+            jnp.asarray(rng.randint(0, 8, 16)),
+            jnp.asarray(logits / logits.sum(-1, keepdims=True)),
+            jnp.asarray(rng.randint(0, 3, 16)),
+        )
+    m = Accuracy(num_classes=2)
+    for _ in range(5):
+        m.update_many(
+            jnp.asarray(rng.randint(0, 2, (2, 16))),
+            jnp.asarray(rng.randint(0, 2, (2, 16))),
+        )
+    report = observability.profile_report()
+    for path in ("keyed_scatter", "update_many"):
+        assert report["dispatches"][path] == 5
+        assert report["samples"][path] == 3  # ceil(5/2)
+
+
+def test_nested_dispatch_suppressed_by_thread_local_guard():
+    """A serving flush drives a keyed scatter: the INNER bracket must
+    neither sample nor count — one dispatch is decomposed once, by the
+    outermost site."""
+    prof = Profiler()
+    prof.set_sample_every(1)
+    outer = prof.begin("serving_flush", None)
+    assert outer is not None
+    # nested site on the same thread: suppressed BEFORE counting
+    assert prof.begin("keyed_scatter", None) is None
+    assert "keyed_scatter" not in prof.report()["dispatches"]
+    prof.finish(outer, None)
+    # guard cleared: the next top-level dispatch samples again
+    assert prof.begin("keyed_scatter", None) is not None
+
+
+def test_sampled_split_observations_are_nonnegative_and_paired():
+    rng = np.random.RandomState(0)
+    observability.set_profiling(sample_every=1)
+    m = Accuracy(num_classes=2)
+    m.jit_forward()
+    _drive_forward(m, 3, rng)
+    hist = HISTOGRAMS.snapshot()
+    hq_key, dd_key = split_series_keys("compiled")
+    assert hist[hq_key]["count"] == hist[dd_key]["count"] == 3
+    assert hist[hq_key]["sum"] >= 0 and hist[dd_key]["sum"] >= 0
+    assert hist[hq_key]["name"] == DISPATCH_HOST_QUEUE_SECONDS
+    assert hist[dd_key]["name"] == DISPATCH_DEVICE_SECONDS
+    # paired profile events, one host_queue + one device per sample
+    phases = [e.payload.get("phase") for e in EVENTS.events() if e.kind == "profile"]
+    assert phases.count("host_queue") == 3 and phases.count("device") == 3
+
+
+def test_profile_report_attributes_executable_costs():
+    rng = np.random.RandomState(0)
+    observability.set_profiling(sample_every=1)
+    m = Accuracy(num_classes=2)
+    m.jit_forward()
+    _drive_forward(m, 2, rng)
+    execs = observability.profile_report()["executables"]
+    assert execs, "sampled compiled dispatch left no executable attribution"
+    entry = next(iter(execs.values()))
+    assert entry["path"] == "compiled" and entry["programs"] >= 1
+    if entry["available"]:  # cost_analysis availability is backend-dependent
+        assert entry["flops"] > 0
+
+
+# ---------------------------------------------------------------------------
+# disabled mode + lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_mode_is_strict_noop():
+    rng = np.random.RandomState(0)
+    assert observability.get_profiling() == 0
+    assert PROFILER.begin("compiled", None) is None
+    m = Accuracy(num_classes=2)
+    m.jit_forward()
+    _drive_forward(m, 3, rng)
+    report = observability.profile_report()
+    assert report["dispatches"] == {} and report["samples"] == {}
+    hist = HISTOGRAMS.snapshot()
+    for series in split_series_keys("compiled"):
+        assert series not in hist
+
+
+def test_set_profiling_rejects_negative_stride():
+    with pytest.raises(ValueError, match="sample_every"):
+        observability.set_profiling(-1)
+
+
+def test_snapshot_section_lazy_until_armed():
+    prof = Profiler()
+    assert prof.summary() == {}
+    prof.set_sample_every(4)
+    assert prof.summary() == {
+        "enabled": True, "sample_every": 4, "dispatches": {}, "samples": {},
+    }
+
+
+def test_reset_clears_tallies_but_keeps_stride():
+    """PR-17 regression: observability.reset() must clear profiling state
+    (tallies, cost refs) while the armed stride survives — like telemetry
+    enablement."""
+    rng = np.random.RandomState(0)
+    observability.set_profiling(sample_every=2)
+    m = Accuracy(num_classes=2)
+    m.jit_forward()
+    _drive_forward(m, 4, rng)
+    assert observability.profile_report()["dispatches"]["compiled"] == 4
+    observability.reset()
+    report = observability.profile_report()
+    assert report["dispatches"] == {} and report["samples"] == {}
+    assert report["executables"] == {}
+    assert observability.get_profiling() == 2  # stride survives
+    # and the cleared state still samples deterministically afterwards
+    _drive_forward(m, 4, rng)
+    assert observability.profile_report()["samples"]["compiled"] == 2
+
+
+def test_disable_disarms_profiler():
+    """PR-17 regression: observability.disable() must STOP profiling — a
+    disabled stack pays one attribute read per dispatch, nothing else."""
+    rng = np.random.RandomState(0)
+    observability.set_profiling(sample_every=1)
+    observability.disable()
+    assert observability.get_profiling() == 0
+    assert PROFILER.begin("compiled", None) is None
+    observability.enable()
+    m = Accuracy(num_classes=2)
+    m.jit_forward()
+    _drive_forward(m, 2, rng)
+    assert observability.profile_report()["dispatches"] == {}
+
+
+def test_snapshot_carries_profiling_section():
+    rng = np.random.RandomState(0)
+    snap = observability.snapshot()
+    assert snap["profiling"] == {}  # lazy until armed
+    observability.set_profiling(sample_every=2)
+    m = Accuracy(num_classes=2)
+    m.jit_forward()
+    _drive_forward(m, 3, rng)
+    section = observability.snapshot()["profiling"]
+    assert section["enabled"] is True and section["sample_every"] == 2
+    assert section["dispatches"]["compiled"] == 3
+    assert section["samples"]["compiled"] == 2
+
+
+def test_prometheus_renders_profiling_family():
+    rng = np.random.RandomState(0)
+    observability.set_profiling(sample_every=1)
+    m = Accuracy(num_classes=2)
+    m.jit_forward()
+    _drive_forward(m, 2, rng)
+    text = observability.render_prometheus()
+    assert "metrics_tpu_profiling_sample_every 1" in text
+    assert 'metrics_tpu_profiling_dispatches_total{path="compiled"} 2' in text
+    assert 'metrics_tpu_profiling_samples_total{path="compiled"} 2' in text
+    # the split series ride the regular histogram exposition
+    assert "dispatch_host_queue_seconds" in text
+    assert "dispatch_device_seconds" in text

@@ -566,3 +566,56 @@ def test_resilience_documented_and_cross_linked():
         "`metrics_tpu.resilience`",
     ):
         assert export in mods, export
+
+
+def test_profiling_memory_documented_and_cross_linked():
+    """The profiling & capacity plane's user contract lives in three
+    places: the observability guide (the sampling law, the split series,
+    cost attribution, the ledger's conservation law, pressure watermarks,
+    the Prometheus families, the smoke gate), the performance guide
+    (attribute-before-tuning + the split bench configs), and the
+    durability guide (the pressure_high knob + byte conservation) — all
+    cross-linked, plus a modules row for the observability package."""
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    assert "## Profiling & memory accounting" in obs
+    for phrase in (
+        # the sampled dispatch profiler
+        "set_profiling",
+        "sample_every",
+        "ceil(steps/N)",
+        "dispatch_host_queue_seconds",
+        "dispatch_device_seconds",
+        "serving_flush",
+        "cost_analysis",
+        # the live-buffer memory ledger
+        "bundle_bytes",
+        "memory_report",
+        "conservation",
+        "aval metadata",
+        "on_pressure",
+        "PressureHandle",
+        "pressure_high",
+        "high_water_bytes",
+        # export surfaces + gates
+        "metrics_tpu_profiling_",
+        "metrics_tpu_memory_",
+        "memory.tracked_bytes",
+        "make profile-smoke",
+        "ingest_latency_split_step",
+    ):
+        assert phrase in obs, phrase
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "observability.md#profiling--memory-accounting" in perf
+    for phrase in ("set_profiling", "ingest_latency_split_step",
+                   "ingest_device_dispatch_step"):
+        assert phrase in perf, phrase
+    with open(f"{DOCS_DIR}/durability.md") as fh:
+        durability = fh.read()
+    assert "observability.md#profiling--memory-accounting" in durability
+    assert "pressure_high" in durability
+    assert "byte conservation" in durability
+    with open(f"{DOCS_DIR}/modules.md") as fh:
+        mods = fh.read()
+    assert "`metrics_tpu.observability`" in mods
