@@ -1,4 +1,4 @@
-__version__ = "0.19.0"
+__version__ = "0.20.0"
 __author__ = "metrics-tpu contributors"
 __license__ = "Apache-2.0"
 __docs__ = (

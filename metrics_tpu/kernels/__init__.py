@@ -22,6 +22,9 @@ The suite (gates documented in ``docs/performance.md#pallas-kernels``):
 * ``confmat_counts`` — confusion-matrix counting via MXU one-hot matmul;
 * ``segment_scatter_add`` — the multi-tenant segment-scatter: bucketing,
   clip-and-drop, and scatter-accumulate fused into one VMEM pass;
+* ``segment_scatter_max`` / ``segment_scatter_min`` — the extremal keyed
+  leaves: per-feature masked VPU reductions against the segment iota,
+  bit-identical to the XLA ``segment_max``/``segment_min`` lowering;
 * ``label_score_histograms`` — the ``sketched=True`` histogram build:
   bucketize + per-class segment-sum in one VMEM pass;
 * ``stat_scores_counts`` — fused tp/fp/tn/fn counting for the stat-scores
@@ -43,6 +46,12 @@ from metrics_tpu.kernels.segment_scatter import (  # noqa: F401
     segment_scatter_add,
     segment_scatter_add_pallas,
     segment_scatter_add_xla,
+    segment_scatter_max,
+    segment_scatter_max_pallas,
+    segment_scatter_max_xla,
+    segment_scatter_min,
+    segment_scatter_min_pallas,
+    segment_scatter_min_xla,
 )
 from metrics_tpu.kernels.stat_scores import (  # noqa: F401
     stat_scores_counts,

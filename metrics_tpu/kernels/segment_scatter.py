@@ -25,6 +25,16 @@ testing, ``segment_scatter_add_xla`` is the portable formulation. Sums are
 float32 — bit-identical to the XLA path for integer-valued data below 2^24
 (the auto gate's sample cap), last-ulp reassociation tolerance for arbitrary
 floats.
+
+The **extremal leaves** (``"max"``/``"min"`` keyed reductions) get the same
+three-way contract: ``segment_scatter_max`` / ``segment_scatter_min`` with
+``_pallas`` / ``_xla`` variants. Max/min is not a contraction, so the Pallas
+formulation is the VPU transpose: data arrives feature-major ``(D̃, R̃)``,
+the per-tile one-hot masks each feature row against the segment iota, and a
+lane-wise ``max``/``min`` reduction folds the ``(TILE, S̃)`` masked tile into
+the VMEM-resident ``(D̃, S̃)`` extremum block — empty segments keep the
+∓inf identity, exactly what ``jax.ops.segment_max``/``segment_min`` emit, so
+results are bit-identical (extrema pick, they never reassociate).
 """
 import functools
 from typing import Optional, Tuple
@@ -154,3 +164,193 @@ def segment_scatter_add(
     if use_pallas:
         return segment_scatter_add_pallas(rows, segment_ids, num_segments)
     return segment_scatter_add_xla(rows, segment_ids, num_segments)
+
+
+# ---------------------------------------------------------------------------
+# extremal leaves: masked segment max / min
+# ---------------------------------------------------------------------------
+
+#: widest feature bundle the extremal kernel unrolls (the VPU formulation
+#: statically unrolls one masked reduction per feature row — extremal keyed
+#: leaves are narrow scalars/small vectors, so a tight cap keeps compile
+#: time and VMEM traffic bounded)
+_MAX_EXTREMAL_FEATURES = 16
+
+
+def segment_scatter_extremal_ok(
+    num_rows: int, num_segments: int, num_features: int
+) -> bool:
+    """True when the auto dispatch would select the Pallas extremal kernel:
+    TPU backend plus the per-feature unroll and segment-lane shape gates."""
+    return (
+        pallas_auto_ok(num_rows * max(num_features, 1))
+        and 1 <= num_segments <= _MAX_PALLAS_SEGMENTS
+        and 1 <= num_features <= _MAX_EXTREMAL_FEATURES
+    )
+
+
+def _segment_scatter_extremal_xla(
+    rows: jax.Array, segment_ids: jax.Array, num_segments: int, op: str
+) -> Tuple[jax.Array, jax.Array]:
+    ids = segment_ids.reshape(-1).astype(jnp.int32)
+    valid = (ids >= 0) & (ids < num_segments)
+    safe = jnp.where(valid, ids, num_segments)
+    seg_fn = jax.ops.segment_max if op == "max" else jax.ops.segment_min
+    ext = seg_fn(
+        rows.astype(jnp.float32), safe, num_segments=num_segments + 1
+    )[:num_segments]
+    counts = jax.ops.segment_sum(
+        valid.astype(jnp.int32), safe, num_segments=num_segments + 1
+    )[:num_segments]
+    return ext, counts
+
+
+def segment_scatter_max_xla(
+    rows: jax.Array, segment_ids: jax.Array, num_segments: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked segment max: ``((S, D) float32 extrema, (S,) int32 counts)``.
+
+    Invalid ids clip to the discard bucket; segments with no valid rows hold
+    the ``-inf`` identity, so callers mask with ``counts > 0``.
+    """
+    return _segment_scatter_extremal_xla(rows, segment_ids, num_segments, "max")
+
+
+def segment_scatter_min_xla(
+    rows: jax.Array, segment_ids: jax.Array, num_segments: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked segment min — :func:`segment_scatter_max_xla` with the ``+inf``
+    identity for empty segments."""
+    return _segment_scatter_extremal_xla(rows, segment_ids, num_segments, "min")
+
+
+def _extremal_kernel(op: str, d: int):
+    """Kernel factory: ``op`` and the true feature count are trace-static.
+
+    Row ``d`` of the output block smuggles the per-segment valid-row counts
+    (f32 accumulation — exact below 2^24 rows), mirroring the add kernel's
+    ones column.
+    """
+    fill = float("-inf") if op == "max" else float("inf")
+    combine = jnp.maximum if op == "max" else jnp.minimum
+    reduce_fn = jnp.max if op == "max" else jnp.min
+
+    def kernel(ids_ref, data_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            out_ref[:] = jnp.full(out_ref.shape, fill, out_ref.dtype)
+            out_ref[d, :] = jnp.zeros((out_ref.shape[1],), out_ref.dtype)
+
+        segs = jax.lax.broadcasted_iota(jnp.int32, (1, out_ref.shape[1]), 1)
+        # padded ids (-1) match no lane; ids in the padding band land on a
+        # lane the caller slices away — clip-and-drop, same as the add kernel
+        onehot = ids_ref[:] == segs  # (TILE, S̃) bool
+        out_ref[d, :] += jnp.sum(onehot.astype(jnp.float32), axis=0)
+        for j in range(d):  # static unroll — gated by _MAX_EXTREMAL_FEATURES
+            col = data_ref[j, :].reshape(-1, 1)
+            masked = jnp.where(onehot, col, fill)
+            out_ref[j, :] = combine(out_ref[j, :], reduce_fn(masked, axis=0))
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "op", "interpret"))
+def _segment_scatter_extremal_pallas(
+    rows: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    op: str,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    r, d = rows.shape
+    spad = _round_up(num_segments, 128)
+    kpad = _round_up(d + 1, 8)  # +1: the smuggled counts row; 8 = f32 sublane
+    npad = _round_up(max(r, _TILE), _TILE)
+
+    ids = segment_ids.reshape(-1).astype(jnp.int32)
+    ids_p = jnp.pad(ids, (0, npad - r), constant_values=-1).reshape(npad, 1)
+    data_t = jnp.zeros((kpad, npad), jnp.float32)
+    data_t = data_t.at[:d, :r].set(rows.astype(jnp.float32).T)
+
+    grid = npad // _TILE
+    vmem = pltpu.VMEM if _PALLAS_TPU_AVAILABLE else None
+    out = pl.pallas_call(
+        _extremal_kernel(op, d),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_TILE, 1), lambda i: (i, 0), memory_space=vmem),
+            pl.BlockSpec((kpad, _TILE), lambda i: (0, i), memory_space=vmem),
+        ],
+        out_specs=pl.BlockSpec((kpad, spad), lambda i: (0, 0), memory_space=vmem),
+        out_shape=jax.ShapeDtypeStruct((kpad, spad), jnp.float32),
+        interpret=interpret,
+    )(ids_p, data_t)
+    return out[:d, :num_segments].T, out[d, :num_segments].astype(jnp.int32)
+
+
+def segment_scatter_max_pallas(
+    rows: jax.Array, segment_ids: jax.Array, num_segments: int, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    """VPU masked-reduction formulation of :func:`segment_scatter_max_xla`.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU testing).
+    """
+    return _segment_scatter_extremal_pallas(
+        rows, segment_ids, num_segments, "max", interpret=interpret
+    )
+
+
+def segment_scatter_min_pallas(
+    rows: jax.Array, segment_ids: jax.Array, num_segments: int, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    """VPU masked-reduction formulation of :func:`segment_scatter_min_xla`."""
+    return _segment_scatter_extremal_pallas(
+        rows, segment_ids, num_segments, "min", interpret=interpret
+    )
+
+
+def _segment_scatter_extremal(
+    rows: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    op: str,
+    use_pallas: Optional[bool],
+) -> Tuple[jax.Array, jax.Array]:
+    if use_pallas is None:
+        use_pallas = segment_scatter_extremal_ok(
+            rows.shape[0], num_segments, rows.shape[1]
+        )
+    note_kernel_dispatch(f"segment_scatter_{op}", "pallas" if use_pallas else "xla")
+    if use_pallas:
+        return _segment_scatter_extremal_pallas(rows, segment_ids, num_segments, op)
+    return _segment_scatter_extremal_xla(rows, segment_ids, num_segments, op)
+
+
+def segment_scatter_max(
+    rows: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked segment max with automatic backend dispatch.
+
+    Same contract as :func:`segment_scatter_add`: ``(R, D)`` rows, rank-1
+    routing ids, ``((S, D) float32 extrema, (S,) int32 valid-row counts)``;
+    the dispatch decision lands on ``kernel.dispatch`` telemetry either way.
+    Extrema pick — results are bit-identical across backends, not just for
+    integer data.
+    """
+    return _segment_scatter_extremal(rows, segment_ids, num_segments, "max", use_pallas)
+
+
+def segment_scatter_min(
+    rows: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked segment min with automatic backend dispatch — see
+    :func:`segment_scatter_max`."""
+    return _segment_scatter_extremal(rows, segment_ids, num_segments, "min", use_pallas)

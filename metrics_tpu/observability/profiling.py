@@ -65,6 +65,7 @@ DISPATCH_DEVICE_SECONDS = "dispatch_device_seconds"
 #: the dispatch paths the library instruments (docs + tests)
 DISPATCH_PATHS = (
     "compiled", "update_many", "keyed_scatter", "serving_flush",
+    "serving_stage",
 )
 
 

@@ -21,6 +21,11 @@ ops** — every hot-path jaxpr digest stays byte-identical
   result cache invalidated by write-generation counters, stale-serving
   within a ``max_staleness_s`` budget, refreshes coalesced onto the PR-9
   background engine.
+* :mod:`~metrics_tpu.serving.staging` — the device-resident ingest plane
+  (``AdmissionQueue(staging=True)``): a columnar staging ring written at
+  submit time plus a double-buffered slot pool so the next cohort's host
+  fill + H2D overlaps the current dispatch
+  (``docs/performance.md#device-resident-ingest``).
 * :mod:`~metrics_tpu.serving.telemetry` — the ``serving.*`` family:
   counters + queue-depth/flush-latency/ingest-latency log2 histograms in
   ``observability.snapshot()["serving"]``, ``metrics_tpu_serving_*``
@@ -50,6 +55,12 @@ visible in the ``serving.*`` counters. See ``docs/serving.md``.
 from metrics_tpu.serving.policy import POLICIES, AdmissionPolicy, resolve_policy  # noqa: F401
 from metrics_tpu.serving.queue import AdmissionQueue, QueueClosedError  # noqa: F401
 from metrics_tpu.serving.scheduler import SLOScheduler  # noqa: F401
+from metrics_tpu.serving.staging import (  # noqa: F401
+    StagedCohort,
+    StagedColumn,
+    StagingRing,
+    StagingSlotPool,
+)
 from metrics_tpu.serving.telemetry import SERVING_STATS, ServingStats, summary  # noqa: F401
 
 __all__ = [
@@ -60,6 +71,10 @@ __all__ = [
     "SERVING_STATS",
     "SLOScheduler",
     "ServingStats",
+    "StagedCohort",
+    "StagedColumn",
+    "StagingRing",
+    "StagingSlotPool",
     "resolve_policy",
     "summary",
 ]

@@ -28,11 +28,19 @@ independent of telemetry enablement, which is what the soak harness's
 zero-lost-updates acceptance reads. Zero traced ops: everything here runs
 on the host; the compiled update programs are byte-identical with the queue
 running (``scripts/check_zero_overhead.py``).
+
+With ``staging=True`` the flush path goes device-resident
+(:mod:`metrics_tpu.serving.staging`): submit writes rows into a columnar
+ring, cohort formation is a slice hand-off into a reusable slot, the H2D
+transfer runs ahead of the dispatch on the async ``staging`` lane, and a
+prefetched second slot overlaps cohort ``k+1``'s staging with cohort ``k``'s
+compute. The conservation laws hold unchanged — staged rows move through
+exactly the same ledger transitions; only WHERE the bytes live differs.
 """
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -41,6 +49,13 @@ from metrics_tpu.observability.profiling import PROFILER
 from metrics_tpu.observability.registry import TELEMETRY
 from metrics_tpu.observability.tracing import TRACER
 from metrics_tpu.serving.policy import AdmissionPolicy, resolve_policy
+from metrics_tpu.serving.staging import (
+    StagedCohort,
+    StagingRing,
+    StagingSlotPool,
+    as_staged,
+    stage_layout,
+)
 from metrics_tpu.serving.telemetry import (
     SERVING_STATS,
     observe_dispatch_latency,
@@ -48,6 +63,9 @@ from metrics_tpu.serving.telemetry import (
     observe_ingest,
     observe_queue_depth,
     observe_queue_wait,
+    observe_staging_fill,
+    observe_staging_occupancy,
+    observe_staging_overlap,
 )
 from metrics_tpu.utilities.prints import rank_zero_warn
 
@@ -130,6 +148,21 @@ class AdmissionQueue:
             under the exact reason ``"breaker_open"`` instead of burning a
             doomed dispatch per flush; a half-open probe dispatch closes it
             again on success.
+        staging: device-resident ingest (default off — the unstaged path
+            is byte-identical to the pre-staging queue). Rows are written
+            at submit time into a preallocated columnar
+            :class:`~metrics_tpu.serving.staging.StagingRing`, cohort
+            formation becomes a slice hand-off into a reusable staging
+            slot, and when a full cohort is already resident the next
+            cohort's host fill + H2D transfer runs on the async
+            ``staging`` lane, overlapping the current dispatch
+            (double-buffering). See docs/performance.md
+            "Device-resident ingest".
+        staging_slots: staging-slot pool depth (>= 2; 2 double-buffers).
+        staging_transfer: transfer staged cohorts to the device on the
+            staging lane (``jnp.array`` owning copies) so the serialized
+            dispatch pays no H2D conversion; ``False`` stages host-side
+            only (cohorts hand off as fresh numpy copies).
         start: start the flusher thread immediately (tests pass ``False``
             to drive flushes by hand).
     """
@@ -147,6 +180,9 @@ class AdmissionQueue:
         pad_to_bucket: bool = False,
         quarantine: str = "auto",
         breaker: Optional[Any] = None,
+        staging: bool = False,
+        staging_slots: int = 2,
+        staging_transfer: bool = True,
         start: bool = True,
     ) -> None:
         if not callable(target):
@@ -194,8 +230,11 @@ class AdmissionQueue:
         self._cv = threading.Condition()
         #: resident rows, oldest first: (tenant, args, t_submit, cohort) —
         #: cohort is the submit span id joining this row's serving trace
-        #: (None while the tracer is disabled)
-        self._pending: List[Tuple[int, Tuple, float, Optional[str]]] = []
+        #: (None while the tracer is disabled). Under staging the second
+        #: element is the row's RING SEQUENCE instead of an args tuple (the
+        #: data lives in the columnar ring); pending seqs are always one
+        #: contiguous range, the slice-hand-off invariant.
+        self._pending: List[Tuple[int, Any, float, Optional[str]]] = []
         self._per_tenant: Dict[int, int] = {}
         self._closed = False
         self._flush_now = False
@@ -220,6 +259,34 @@ class AdmissionQueue:
         #: the cache it installs so read spans can point at the flush that
         #: produced the values they serve
         self._last_dispatch_span: Optional[str] = None
+        # -- device-resident ingest (staging ring + double buffer) ---------
+        self.staging = bool(staging)
+        self.staging_transfer = bool(staging_transfer)
+        if self.staging:
+            # ring span bound: resident rows plus every popped-but-uncopied
+            # cohort (a slot is acquired BEFORE the pop, so at most
+            # slots * max_batch rows sit between pop and copy-out)
+            self._ring: Optional[StagingRing] = StagingRing(
+                self.capacity_rows + int(staging_slots) * self.max_batch
+            )
+            self._slots: Optional[StagingSlotPool] = StagingSlotPool(
+                int(staging_slots), self.max_batch
+            )
+        else:
+            self._ring = None
+            self._slots = None
+        #: the prefetched cohort (dict: slot/seq0/n/depth_before/trigger and
+        #: a staging-lane future or an already-staged cohort) — at most one
+        #: outstanding; holds ``_in_dispatch`` elevated from pop to dispatch
+        self._staged_next: Optional[Dict[str, Any]] = None
+        #: (start, end) of the newest dispatch — the overlap ledger
+        #: intersects a prefetched cohort's stage window with it
+        self._last_dispatch_window: Optional[Tuple[float, float]] = None
+        self._stage_seconds = 0.0
+        self._prefetched_stage_seconds = 0.0
+        self._overlap_seconds = 0.0
+        self._staged_cohorts = 0
+        self._prefetched_cohorts = 0
         self.telemetry_key = TELEMETRY.register(self)
         SERVING_STATS.register_queue(self)
         if start:
@@ -263,15 +330,22 @@ class AdmissionQueue:
             if self._closed:
                 TRACER.end(span, rows=n, error="queue_closed")
                 raise QueueClosedError("AdmissionQueue is closed")
+            if self.staging:
+                # schema check raises BEFORE any accounting so a rejected
+                # cohort never skews the conservation ledger
+                self._ensure_staging_layout_locked(ncols)
             self._note_submitted(n)
-            for i in range(n):
-                tenant = int(ids[i])
-                row = (tenant, tuple(c[i] for c in ncols), now, cohort)
-                reason = self._admit_locked(row)
-                if reason is None:
-                    admitted += 1
-                else:
-                    shed[reason] = shed.get(reason, 0) + 1
+            if self.staging:
+                admitted, shed = self._submit_staged_locked(ids, ncols, now, cohort)
+            else:
+                for i in range(n):
+                    tenant = int(ids[i])
+                    row = (tenant, tuple(c[i] for c in ncols), now, cohort)
+                    reason = self._admit_locked(row)
+                    if reason is None:
+                        admitted += 1
+                    else:
+                        shed[reason] = shed.get(reason, 0) + 1
             self._cv.notify_all()
         if shed:
             self._account_shed(shed)
@@ -284,11 +358,93 @@ class AdmissionQueue:
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, "submitted_rows", n)
 
+    def _ensure_staging_layout_locked(self, ncols: List[np.ndarray]) -> None:
+        """Bind (or validate) the staging ring/slot layout for this cohort's
+        column schema. A schema change is only accepted with zero live rows
+        — resident, popped-in-flight, or prefetched rows are all views over
+        the old buffers."""
+        layout = stage_layout(ncols)
+        if self._ring.layout == layout:
+            return
+        if self._ring.layout is not None and (
+            self._pending or self._in_dispatch or self._staged_next is not None
+        ):
+            raise ValueError(
+                "staged submit column schema changed while rows are live —"
+                f" ring layout {self._ring.layout} vs cohort {layout}. Drain"
+                " the queue before submitting a different argument schema,"
+                " or run with staging=False for heterogeneous cohorts."
+            )
+        self._ring.bind(layout)
+        self._slots.bind(layout)
+
+    def _submit_staged_locked(
+        self,
+        ids: np.ndarray,
+        ncols: List[np.ndarray],
+        now: float,
+        cohort: Optional[str],
+    ) -> Tuple[int, Dict[str, int]]:
+        """The staged admission loop (caller holds the cv): policy decision
+        per row, then the row's data lands in the ring — deferred to one
+        bulk columnar write per cohort when the policy never releases the
+        lock (every non-``block`` policy), per row otherwise (a ``block``
+        wait lets a concurrent flush pop rows admitted earlier in this very
+        cohort, so their data must already be resident)."""
+        ring = self._ring
+        can_defer = self.policy.name != "block"
+        admitted = 0
+        first_seq: Optional[int] = None
+        adm_idx: List[int] = []
+        shed: Dict[str, int] = {}
+        n = int(ids.shape[0])
+        for i in range(n):
+            tenant = int(ids[i])
+            reason = self._admission_decision_locked(tenant)
+            if reason is not None:
+                shed[reason] = shed.get(reason, 0) + 1
+                continue
+            seq = ring.alloc()
+            if first_seq is None:
+                first_seq = seq
+            self._append_locked((tenant, seq, now, cohort))
+            if can_defer:
+                adm_idx.append(i)
+            else:
+                ring.write_row(seq, tenant, now, cohort, [c[i] for c in ncols])
+            admitted += 1
+        if can_defer and admitted:
+            # seqs are contiguous (the cv never dropped): 1–2 slice stores
+            # per column, or a single gather when some rows were shed
+            if admitted == n:
+                ring.write_rows(
+                    first_seq, ids.astype(np.int32, copy=False), now, cohort, ncols
+                )
+            else:
+                sel = np.asarray(adm_idx, dtype=np.intp)
+                ring.write_rows(
+                    first_seq,
+                    ids[sel].astype(np.int32, copy=False),
+                    now,
+                    cohort,
+                    [c[sel] for c in ncols],
+                )
+        return admitted, shed
+
     def _admit_locked(self, row: Tuple[int, Tuple, float, Optional[str]]) -> Optional[str]:
         """Admit ``row`` under the lock, or return the shed reason."""
+        reason = self._admission_decision_locked(row[0])
+        if reason is None:
+            self._append_locked(row)
+        return reason
+
+    def _admission_decision_locked(self, tenant: int) -> Optional[str]:
+        """The policy's verdict for one row (caller holds the cv): ``None``
+        admits, else the exact shed reason. ``shed_oldest`` evictions and
+        ``block`` waits happen here."""
         policy = self.policy
         if policy.name == "shed_tenant_over_quota":
-            if self._per_tenant.get(row[0], 0) >= policy.tenant_quota_rows:
+            if self._per_tenant.get(tenant, 0) >= policy.tenant_quota_rows:
                 return "tenant_over_quota"
             if len(self._pending) >= self.capacity_rows:
                 return "queue_full"
@@ -316,6 +472,12 @@ class AdmissionQueue:
                 self._cv.wait(remaining)
             if self._closed:
                 return "block_timeout"
+        return None
+
+    def _append_locked(self, row: Tuple[int, Any, float, Optional[str]]) -> None:
+        """Admission bookkeeping for one accepted row (caller holds the cv).
+        ``row[1]`` is the args tuple (unstaged) or the ring sequence number
+        (staged) — nothing here looks inside it."""
         self._pending.append(row)
         self._per_tenant[row[0]] = self._per_tenant.get(row[0], 0) + 1
         self._admitted += 1
@@ -327,7 +489,6 @@ class AdmissionQueue:
         n_pending = len(self._pending)
         if n_pending == 1 or n_pending >= self.max_batch:
             self._cv.notify_all()
-        return None
 
     def _account_shed(self, shed: Dict[str, int]) -> None:
         with self._cv:
@@ -358,22 +519,30 @@ class AdmissionQueue:
     def _flusher_loop(self) -> None:
         while True:
             with self._cv:
-                while not self._pending and not self._closed:
-                    self._cv.wait()
-                if self._closed and not self._pending:
-                    return
-                deadline = self._pending[0][2] + self.max_delay_s
                 while (
-                    len(self._pending) < self.max_batch
-                    and self._pending
+                    not self._pending
                     and not self._closed
-                    and not self._flush_now
+                    and self._staged_next is None
                 ):
-                    remaining = deadline - time.perf_counter()
-                    if remaining <= 0:
-                        break
-                    self._cv.wait(remaining)
-                if not self._pending:
+                    self._cv.wait()
+                if self._closed and not self._pending and self._staged_next is None:
+                    return
+                if self._pending:
+                    deadline = self._pending[0][2] + self.max_delay_s
+                    while (
+                        len(self._pending) < self.max_batch
+                        and self._pending
+                        and not self._closed
+                        and not self._flush_now
+                        # a prefetched cohort is staged and waiting — do not
+                        # sit out a deadline on top of it
+                        and self._staged_next is None
+                    ):
+                        remaining = deadline - time.perf_counter()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
+                if not self._pending and self._staged_next is None:
                     continue
                 trigger = (
                     "size"
@@ -385,6 +554,8 @@ class AdmissionQueue:
     def _flush_once(self, trigger: str) -> int:
         """Pop up to ``max_batch`` oldest rows and dispatch them as ONE
         target call; returns rows dispatched (0 when nothing was resident)."""
+        if self.staging:
+            return self._flush_once_staged(trigger)
         with self._dispatch_lock:
             with self._cv:
                 if not self._pending:
@@ -403,6 +574,19 @@ class AdmissionQueue:
                 self._in_dispatch += 1
                 self._cv.notify_all()  # room freed: wake blocked producers
             popped = len(rows)
+            # sampled profiling brackets the WHOLE flush-side host work —
+            # cohort formation (the per-flush np.stack coalescing), the
+            # quarantine scan, the pad block, and the target submit — so
+            # the serving_flush host-queue series prices exactly what the
+            # staged path moves off the flush (its bracket covers only the
+            # slice hand-off; formation is serving_stage's window). The
+            # owner's state bundles stand in for submit/ready sync (the
+            # target call itself returns nothing).
+            owner = getattr(self._target, "__self__", None)
+            states = getattr(owner, "_get_states", None)
+            prof = PROFILER.begin(
+                "serving_flush", states() if states is not None else None
+            )
             try:
                 t0 = time.perf_counter()
                 ids = np.asarray([r[0] for r in rows], dtype=np.int32)
@@ -412,18 +596,24 @@ class AdmissionQueue:
                 # corrupt every float "sum" state the whole flush touches —
                 # quarantined rows are shed under the EXACT reason
                 # "poisoned" (a dead-letter, sampled for inspection) and
-                # the rest of the cohort dispatches clean
+                # the rest of the cohort dispatches clean. The mode resolves
+                # ONCE per flush and the scan allocates nothing until a
+                # float column exists to scan.
                 if self._quarantine_active():
-                    mask = np.zeros(popped, dtype=bool)
+                    mask: Optional[np.ndarray] = None
                     for c in cols:
                         if np.issubdtype(c.dtype, np.floating):
-                            mask |= ~np.isfinite(c).reshape(popped, -1).all(axis=1)
-                    if mask.any():
+                            bad = ~np.isfinite(c).reshape(popped, -1).all(axis=1)
+                            mask = bad if mask is None else (mask | bad)
+                    if mask is not None and mask.any():
                         keep = np.nonzero(~mask)[0]
+                        bad_rows = [rows[i] for i in np.nonzero(mask)[0]]
                         self._shed_rows(
                             "poisoned",
-                            [rows[i] for i in np.nonzero(mask)[0]],
-                            dead_letter=True,
+                            len(bad_rows),
+                            dead_letter_samples=[
+                                (r[0], r[1]) for r in bad_rows[-DEAD_LETTER_CAP:]
+                            ],
                         )
                         rows = [rows[i] for i in keep]
                         ids = ids[~mask]
@@ -431,7 +621,7 @@ class AdmissionQueue:
                 # circuit breaker: while open, a doomed dispatch is not
                 # even attempted — the cohort sheds under "breaker_open"
                 if rows and self.breaker is not None and not self.breaker.allow():
-                    self._shed_rows("breaker_open", rows)
+                    self._shed_rows("breaker_open", len(rows))
                     rows = []
                 error: Optional[BaseException] = None
                 if rows:
@@ -446,17 +636,331 @@ class AdmissionQueue:
                                 )
                                 for c in cols
                             ]
-                    # sampled profiling brackets the target dispatch: the
-                    # owner's state bundles stand in for submit/ready sync
-                    # (the target call itself returns nothing)
+                    try:
+                        _consult_fault_seam("serving.dispatch", rows=len(rows))
+                        self._target(ids, *cols)
+                        if self.breaker is not None:
+                            self.breaker.record_success()
+                    except Exception as err:  # noqa: BLE001 - accounted below
+                        error = err
+                        if self.breaker is not None:
+                            self.breaker.record_failure()
+                if prof is not None:
+                    # an all-shed flush still closes its bracket (host-only
+                    # sample: formation + scan, no device window)
+                    PROFILER.finish(
+                        prof,
+                        states() if (states is not None and rows) else None,
+                        self.telemetry_key,
+                    )
+                    prof = None
+                dur = time.perf_counter() - t0
+                end = time.perf_counter()
+                kept = rows
+                self._note_flush(
+                    trigger,
+                    len(kept),
+                    lambda: ((r[2], r[3]) for r in kept),
+                    depth_before,
+                    dur,
+                    end,
+                    error,
+                )
+            finally:
+                if prof is not None:  # formation raised: close the bracket
+                    PROFILER.finish(prof, None, self.telemetry_key)
+                with self._cv:
+                    self._in_dispatch -= 1
+                    self._cv.notify_all()
+        return popped
+
+    # ------------------------------------------------------------------
+    # staged dispatch side (staging=True)
+    # ------------------------------------------------------------------
+
+    def _staged_next_rows_locked(self) -> int:
+        """Rows parked in the prefetched cohort (caller holds the cv).
+        They left ``_pending`` at prefetch time but are still resident in
+        the ledger sense until the flush that consumes them dispatches or
+        sheds — ``depth()``/``stats()`` must count them or the conservation
+        laws show a phantom gap of up to ``max_batch`` rows at quiescence."""
+        entry = self._staged_next
+        return int(entry["n"]) if entry is not None else 0
+
+    def _pop_staged_locked(self) -> Optional[Tuple[int, int, int]]:
+        """Pop up to ``max_batch`` rows off the staged pending window
+        (caller holds the cv AND a staging slot): ``(seq0, n,
+        depth_before)``, or ``None`` when nothing is resident. Marks the
+        dispatch in flight — the rows leave ``resident`` here and reach
+        ``dispatched``/``shed`` in the flush that consumes them."""
+        if not self._pending:
+            return None
+        depth_before = len(self._pending)
+        take = min(depth_before, self.max_batch)
+        seq0 = self._pending[0][1]
+        del self._pending[:take]
+        if not self._pending:
+            self._flush_now = False
+        # pending seqs are contiguous, so the popped ids are exactly the
+        # ring span [seq0, seq0+take): one vectorized unique instead of a
+        # per-row dict pass
+        uniq, counts = np.unique(self._ring.read_ids(seq0, take), return_counts=True)
+        for tenant, cnt in zip(uniq.tolist(), counts.tolist()):
+            left = self._per_tenant.get(tenant, 0) - int(cnt)
+            if left > 0:
+                self._per_tenant[tenant] = left
+            else:
+                self._per_tenant.pop(tenant, None)
+        self._in_dispatch += 1
+        self._cv.notify_all()  # room freed: wake blocked producers
+        return seq0, take, depth_before
+
+    def _stage_cohort(self, slot: Any, seq0: int, n: int) -> StagedCohort:
+        """Ring → slot hand-off: copy the popped span, run the vectorized
+        quarantine scan over the slot columns, fold the pow2 pad in place,
+        and transfer the cohort to the device. Runs on the staging lane
+        (prefetch) or the flushing thread (sync); touches only the slot and
+        the protected ring span, so it races nothing."""
+        t0 = time.perf_counter()
+        prof = PROFILER.begin("serving_stage", None)
+        self._ring.copy_out(seq0, n, slot)
+        m = n
+        if self._quarantine_active():
+            mask: Optional[np.ndarray] = None
+            for buf in slot.cols:
+                if np.issubdtype(buf.dtype, np.floating):
+                    bad = ~np.isfinite(buf[:n]).reshape(n, -1).all(axis=1)
+                    mask = bad if mask is None else (mask | bad)
+            if mask is not None and mask.any():
+                bad_idx = np.nonzero(mask)[0]
+                samples = [
+                    (int(slot.ids[i]), tuple(np.copy(buf[i]) for buf in slot.cols))
+                    for i in bad_idx[-DEAD_LETTER_CAP:]
+                ]
+                self._shed_rows(
+                    "poisoned", int(bad_idx.shape[0]), dead_letter_samples=samples
+                )
+                keep = ~mask
+                m = int(keep.sum())
+                # in-place compaction: fancy-index gathers copy first, so
+                # the overlapping store is safe
+                slot.ids[:m] = slot.ids[:n][keep]
+                slot.t_submit[:m] = slot.t_submit[:n][keep]
+                slot.cohorts[:m] = slot.cohorts[:n][keep]
+                for buf in slot.cols:
+                    buf[:m] = buf[:n][keep]
+        bucket = m
+        if m and self.pad_to_bucket and m < self.max_batch:
+            bucket = min(1 << max(0, m - 1).bit_length(), self.max_batch)
+            if bucket > m:
+                # the pad folds into the preallocated slot (no fresh
+                # blocks): discard ids + zeroed columns, dropped by the
+                # compiled program's validate_ids=False discard bucket
+                slot.ids[m:bucket] = -1
+                for buf in slot.cols:
+                    buf[m:bucket] = 0
+        ids_view: np.ndarray = slot.ids[:bucket]
+        col_views: List[np.ndarray] = [buf[:bucket] for buf in slot.cols]
+        fill_end = time.perf_counter()
+        device = None
+        if m and self.staging_transfer:
+            device = self._transfer_cohort(ids_view, col_views)
+        if device is not None:
+            ids_view = as_staged(ids_view, device[0])
+            col_views = [as_staged(v, d) for v, d in zip(col_views, device[1:])]
+        elif m:
+            # no device twin: hand the target OWNING copies — the slot is
+            # reused the moment the dispatch returns, and a zero-copy
+            # jnp.asarray inside the target could still alias it then
+            ids_view = np.array(ids_view)
+            col_views = [np.array(v) for v in col_views]
+        if prof is not None:
+            # host half = slot fill (submit_end), device half = transfer
+            # completion — the serving_stage split mirrors serving_flush
+            PROFILER.finish(prof, device, self.telemetry_key, submit_end=fill_end)
+        t1 = time.perf_counter()
+        return StagedCohort(
+            slot,
+            m,
+            bucket,
+            ids_view,
+            col_views,
+            slot.t_submit[:m],
+            slot.cohorts[:m],
+            (t0, t1),
+        )
+
+    def _transfer_cohort(
+        self, ids: np.ndarray, cols: List[np.ndarray]
+    ) -> Optional[List[Any]]:
+        """H2D: owning device copies of the cohort (``jnp.array`` always
+        copies, so slot reuse can never alias a live device buffer).
+        Import-guarded with a silent host fallback — staging must degrade,
+        not fail, without jax."""
+        try:
+            import jax.numpy as jnp
+
+            return [jnp.array(ids)] + [jnp.array(c) for c in cols]
+        except Exception:  # pragma: no cover - jax is a hard dep in-repo
+            return None
+
+    def _submit_stage_job(self, slot: Any, seq0: int, n: int) -> Any:
+        from metrics_tpu.utilities.async_sync import staging_lane
+
+        return staging_lane().submit(
+            f"{self.telemetry_key}.stage",
+            lambda: self._stage_cohort(slot, seq0, n),
+            max_retries=0,  # a re-run would double-count quarantine sheds
+        )
+
+    def _maybe_prefetch(self) -> None:
+        """Double-buffer: when a FULL cohort is already resident, pop it now
+        and stage it on the async ``staging`` lane so its host fill + H2D
+        runs under the dispatch this flush is about to start. Popping only
+        at ``max_batch`` preserves batching semantics exactly — these rows
+        would flush on the ``size`` trigger immediately anyway."""
+        with self._cv:
+            if (
+                self._staged_next is not None
+                or self._closed
+                or len(self._pending) < self.max_batch
+            ):
+                return
+        slot = self._slots.try_acquire()
+        if slot is None:
+            return
+        entry: Optional[Dict[str, Any]] = None
+        with self._cv:
+            if self._staged_next is None and len(self._pending) >= self.max_batch:
+                popped = self._pop_staged_locked()
+                if popped is not None:
+                    # a bind racing the try_acquire above leaves a stale
+                    # zero-column slot — re-materialize before staging
+                    slot = self._slots.refresh(slot)
+                    seq0, n, depth_before = popped
+                    entry = {
+                        "slot": slot,
+                        "seq0": seq0,
+                        "n": n,
+                        "depth_before": depth_before,
+                        "trigger": "size",
+                    }
+        if entry is None:
+            self._slots.release(slot)
+            return
+        try:
+            entry["future"] = self._submit_stage_job(slot, entry["seq0"], entry["n"])
+        except Exception:  # pragma: no cover - lane submit is in-process
+            entry["cohort"] = self._stage_cohort(slot, entry["seq0"], entry["n"])
+        with self._cv:
+            self._staged_next = entry
+            self._cv.notify_all()
+
+    def _note_staged(
+        self,
+        cohort: StagedCohort,
+        prefetched: bool,
+        prev_window: Optional[Tuple[float, float]],
+    ) -> None:
+        """The overlap ledger: a prefetched cohort's stage window
+        intersected with the dispatch that ran while it staged."""
+        s0, s1 = cohort.stage_window
+        stage_s = max(0.0, s1 - s0)
+        overlap = 0.0
+        if prefetched and prev_window is not None:
+            d0, d1 = prev_window
+            overlap = max(0.0, min(s1, d1) - max(s0, d0))
+        with self._cv:
+            self._staged_cohorts += 1
+            self._stage_seconds += stage_s
+            if prefetched:
+                self._prefetched_cohorts += 1
+                self._prefetched_stage_seconds += stage_s
+                self._overlap_seconds += overlap
+        SERVING_STATS.inc("staged_cohorts")
+        if prefetched:
+            SERVING_STATS.inc("prefetched_cohorts")
+        if TELEMETRY.enabled:
+            observe_staging_fill(stage_s)
+            if prefetched:
+                observe_staging_overlap(overlap)
+            observe_staging_occupancy(self._slots.in_use())
+
+    def _flush_once_staged(self, trigger: str) -> int:
+        """The staged flush: consume the prefetched cohort when one is
+        waiting, else stage synchronously; kick the NEXT cohort's prefetch;
+        dispatch. The serialized section holds only the device-side
+        hand-off — cohort formation left it entirely."""
+        with self._dispatch_lock:
+            entry: Optional[Dict[str, Any]] = None
+            with self._cv:
+                if self._staged_next is not None:
+                    entry = self._staged_next
+                    self._staged_next = None
+            prefetched = entry is not None
+            if entry is None:
+                # slot BEFORE pop: bounds popped-but-uncopied rows at
+                # slots * max_batch, the ring-span safety argument
+                slot = self._slots.acquire()
+                with self._cv:
+                    popped = self._pop_staged_locked()
+                if popped is None:
+                    self._slots.release(slot)
+                    return 0
+                # the first submit's bind may have raced the acquire above
+                # (slot-before-pop is the ring-span safety ordering) — a
+                # stale slot would stage this cohort with zero columns
+                slot = self._slots.refresh(slot)
+                seq0, n, depth_before = popped
+                entry = {
+                    "slot": slot,
+                    "seq0": seq0,
+                    "n": n,
+                    "depth_before": depth_before,
+                    "trigger": trigger,
+                }
+            popped_n = int(entry["n"])
+            depth_before = int(entry["depth_before"])
+            trigger = entry["trigger"]
+            prev_window = self._last_dispatch_window
+            cohort: Optional[StagedCohort] = None
+            try:
+                t0 = time.perf_counter()
+                error: Optional[BaseException] = None
+                try:
+                    future = entry.get("future")
+                    if future is not None:
+                        cohort = future.result()
+                    elif "cohort" in entry:
+                        cohort = entry["cohort"]
+                    else:
+                        cohort = self._stage_cohort(
+                            entry["slot"], entry["seq0"], entry["n"]
+                        )
+                except Exception as err:  # noqa: BLE001 - accounted below
+                    error = err
+                # kick the next cohort's stage BEFORE dispatching this one —
+                # the overlap the double buffer exists for
+                self._maybe_prefetch()
+                if cohort is not None:
+                    self._note_staged(cohort, prefetched, prev_window)
+                rows_n = cohort.n if cohort is not None else 0
+                if (
+                    rows_n
+                    and self.breaker is not None
+                    and not self.breaker.allow()
+                ):
+                    self._shed_rows("breaker_open", rows_n)
+                    rows_n = 0
+                if rows_n:
                     owner = getattr(self._target, "__self__", None)
                     states = getattr(owner, "_get_states", None)
                     prof = PROFILER.begin(
                         "serving_flush", states() if states is not None else None
                     )
                     try:
-                        _consult_fault_seam("serving.dispatch", rows=len(rows))
-                        self._target(ids, *cols)
+                        _consult_fault_seam("serving.dispatch", rows=rows_n)
+                        self._target(cohort.ids, *cohort.cols)
                         if self.breaker is not None:
                             self.breaker.record_success()
                     except Exception as err:  # noqa: BLE001 - accounted below
@@ -472,12 +976,34 @@ class AdmissionQueue:
                             )
                 dur = time.perf_counter() - t0
                 end = time.perf_counter()
-                self._note_flush(trigger, rows, depth_before, dur, end, error)
+                self._last_dispatch_window = (t0, end)
+                if cohort is None:
+                    # the stage itself failed: the whole popped span sheds
+                    # as a dispatch error (no per-row meta survives)
+                    self._note_flush(
+                        trigger, popped_n, lambda: (), depth_before, dur, end, error
+                    )
+                else:
+                    noted = cohort if rows_n else None
+                    self._note_flush(
+                        trigger,
+                        rows_n,
+                        (
+                            (lambda: zip(noted.t_submits, noted.cohorts))
+                            if noted is not None
+                            else (lambda: ())
+                        ),
+                        depth_before,
+                        dur,
+                        end,
+                        error,
+                    )
             finally:
+                self._slots.release(entry["slot"])
                 with self._cv:
                     self._in_dispatch -= 1
                     self._cv.notify_all()
-        return popped
+        return popped_n
 
     def _quarantine_active(self) -> bool:
         """Quarantine is armed explicitly (``"on"``) or — the ``"auto"``
@@ -498,21 +1024,23 @@ class AdmissionQueue:
     def _shed_rows(
         self,
         reason: str,
-        rows: List[Tuple[int, Tuple, float, Optional[str]]],
+        n: int,
         *,
-        dead_letter: bool = False,
+        dead_letter_samples: Optional[List[Tuple[int, Tuple]]] = None,
     ) -> None:
-        """Shed already-admitted rows at dispatch time under an exact
+        """Shed ``n`` already-admitted rows at dispatch time under an exact
         ``reason`` (quarantine, open breaker) — the conservation laws keep
-        holding because every such row moves from resident to shed."""
-        n = len(rows)
+        holding because every such row moves from resident to shed.
+        ``dead_letter_samples`` is the bounded ``(tenant, args)`` sample
+        retained for inspection (callers pass the NEWEST rows — the deque
+        keeps newest-last either way)."""
         if n == 0:
             return
         with self._cv:
             self._shed += n
             self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + n
-            if dead_letter:
-                self._dead_letters.extend((r[0], r[1]) for r in rows)
+            if dead_letter_samples:
+                self._dead_letters.extend(dead_letter_samples)
         SERVING_STATS.shed(reason, n)
         if TELEMETRY.enabled:
             TELEMETRY.inc(self.telemetry_key, f"shed_{reason}", n)
@@ -532,13 +1060,17 @@ class AdmissionQueue:
     def _note_flush(
         self,
         trigger: str,
-        rows: List[Tuple[int, Tuple, float, Optional[str]]],
+        n: int,
+        row_meta: Callable[[], Iterable[Tuple[float, Optional[str]]]],
         depth_before: int,
         dur: float,
         end: float,
         error: Optional[BaseException],
     ) -> None:
-        n = len(rows)
+        """Ledger + telemetry for one flush of ``n`` rows. ``row_meta`` is a
+        zero-cost factory yielding ``(t_submit, cohort)`` per dispatched row
+        — only iterated under the telemetry/tracer gates, so the hot path
+        never materializes per-row lists for disabled planes."""
         with self._cv:
             self._flushes += 1
             if error is None:
@@ -571,35 +1103,38 @@ class AdmissionQueue:
                 TELEMETRY.inc(self.telemetry_key, "dispatched_rows", n)
             observe_flush(dur, trigger)
             observe_queue_depth(depth_before)
-            for _, _, t_submit, _ in rows:
+            for t_submit, _ in row_meta():
                 observe_ingest(end - t_submit, self.policy.name)
                 # the two components of ingest: host-queue wait (submit →
                 # flush start) and device dispatch (flush start → complete,
                 # row-weighted so counts line up across the three series)
                 observe_queue_wait(max(0.0, t_start - t_submit), self.policy.name)
                 observe_dispatch_latency(dur, self.policy.name)
-        if rows and TRACER.enabled:
+        if n and TRACER.enabled:
             # retro-dated serving spans: the enqueue-wait interval (oldest
             # submit → flush start) and the dispatch interval (flush start →
             # complete) are only known now, but their endpoints were stamped
             # on the perf_counter clock as they happened
             pc_now = time.perf_counter()
             cohorts: List[str] = []
-            for _, _, _, cohort in rows:
+            oldest_submit: Optional[float] = None
+            for t_submit, cohort in row_meta():
+                if oldest_submit is None or t_submit < oldest_submit:
+                    oldest_submit = float(t_submit)
                 if cohort is not None and cohort not in cohorts:
                     cohorts.append(cohort)
             dropped_cohorts = max(0, len(cohorts) - SPAN_COHORT_CAP)
             cohorts = cohorts[:SPAN_COHORT_CAP]
-            oldest_submit = min(r[2] for r in rows)
-            TRACER.record_span(
-                "serving",
-                group=self.telemetry_key,
-                bucket="wait",
-                enter_ago_s=pc_now - oldest_submit,
-                exit_ago_s=pc_now - t_start,
-                rows=n,
-                trigger=trigger,
-            )
+            if oldest_submit is not None:
+                TRACER.record_span(
+                    "serving",
+                    group=self.telemetry_key,
+                    bucket="wait",
+                    enter_ago_s=pc_now - oldest_submit,
+                    exit_ago_s=pc_now - t_start,
+                    rows=n,
+                    trigger=trigger,
+                )
             dispatch_span = TRACER.record_span(
                 "serving",
                 group=self.telemetry_key,
@@ -674,9 +1209,13 @@ class AdmissionQueue:
             thread.join(timeout)
 
     def depth(self) -> int:
-        """Rows currently resident (point-in-time)."""
+        """Rows currently resident (point-in-time). A prefetched cohort
+        parked in the second staging slot is still resident — it has left
+        ``_pending`` but not reached ``dispatched``/``shed``, so without it
+        a manual ``while q.depth(): q._flush_once(...)`` drain loop would
+        strand up to ``max_batch`` rows."""
         with self._cv:
-            return len(self._pending)
+            return len(self._pending) + self._staged_next_rows_locked()
 
     def last_dispatch_span(self) -> Optional[str]:
         """The newest successful dispatch span id (``None`` before the
@@ -700,18 +1239,39 @@ class AdmissionQueue:
           drain, submitted − shed equals exactly what the keyed state
           ingested (``tenant_report()["rows_routed"]``)."""
         with self._cv:
+            staging_block: Dict[str, Any] = {"enabled": self.staging}
+            if self.staging:
+                staging_block.update(
+                    {
+                        "slots": self._slots.num_slots,
+                        "ring_capacity": self._ring.capacity,
+                        "transfer": self.staging_transfer,
+                        "staged_cohorts": self._staged_cohorts,
+                        "prefetched_cohorts": self._prefetched_cohorts,
+                        "stage_seconds": self._stage_seconds,
+                        "overlap_seconds": self._overlap_seconds,
+                        # fraction of PREFETCHED stage time spent under a
+                        # concurrent dispatch — the double-buffer's yield
+                        "overlap_fraction": (
+                            self._overlap_seconds / self._prefetched_stage_seconds
+                            if self._prefetched_stage_seconds > 0
+                            else 0.0
+                        ),
+                    }
+                )
             return {
                 "policy": self.policy.name,
                 "max_batch": self.max_batch,
                 "max_delay_ms": round(self.max_delay_s * 1e3, 6),
                 "capacity_rows": self.capacity_rows,
+                "staging": staging_block,
                 "submitted": self._submitted,
                 "admitted": self._admitted,
                 "shed": self._shed,
                 "shed_by_reason": dict(self._shed_by_reason),
                 "dispatched": self._dispatched,
                 "flushes": self._flushes,
-                "resident": len(self._pending),
+                "resident": len(self._pending) + self._staged_next_rows_locked(),
                 "dead_letter_rows": self._shed_by_reason.get("poisoned", 0),
                 "closed": self._closed,
                 "last_error": (

@@ -1,0 +1,360 @@
+"""Columnar staging for the admission queue — device-resident ingest.
+
+The unstaged :class:`~metrics_tpu.serving.queue.AdmissionQueue` keeps every
+resident row as a Python tuple and pays the cohort-formation bill inside the
+flush: a per-row ``np.stack`` per column, a fresh pad block per bucket, and
+the H2D conversion inside the compiled dispatch — all of it serialized under
+the dispatch lock, all of it host-queue latency. The staged path moves that
+work to where it is cheap:
+
+* **submit time** writes rows straight into a :class:`StagingRing` — one
+  preallocated pow2 circular buffer per update-argument column (plus the id,
+  submit-timestamp, and trace-cohort columns). Admission order IS ring
+  order: the queue pops contiguous sequence ranges, so cohort formation is
+  one or two slice copies per column into a reusable :class:`slot
+  <StagingSlotPool>`, never a per-row pass.
+* **stage time** (a prefetch job on the PR-9 async ``staging`` lane, or the
+  flush thread when nothing was prefetched) runs the vectorized quarantine
+  scan over the slot columns, folds the pow2 pad in place (ids ``-1``,
+  zeroed columns — the compiled program's ``validate_ids=False`` discard
+  bucket drops them), and transfers the cohort to the device ahead of the
+  dispatch (``jnp.array`` — an owning copy, so slot reuse can never alias a
+  live device buffer).
+* **dispatch time** hands the target :class:`StagedColumn` views — ndarray
+  views over the slot carrying their already-transferred ``jax_array``
+  twin. The wrapper layer (duck-typed on the attribute, see
+  ``KeyedMetric.update``) dispatches the twin, so the serialized section
+  pays no H2D conversion; host-side consumers (validation, traffic ledgers,
+  the scheduler's ``np.unique``) read the view without a device sync.
+
+Ring-span safety: sequence numbers are monotonic and the pending window is
+always a contiguous range (admissions append at the head; sheds and pops
+only ever remove from the front), so a live row is overwritten only if the
+span head − oldest-uncopied exceeds the ring capacity. The queue sizes the
+ring at ``pow2(capacity_rows + slots * max_batch)`` and acquires a slot
+*before* popping, which bounds popped-but-uncopied rows at
+``slots * max_batch`` — the span cannot outrun the ring.
+
+Pickle/clone drops every buffer (a staged queue's ring and slots are scratch
+tied to this process's threads and device); the rebuilt object re-binds its
+layout lazily on the first row it sees.
+"""
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "StagedColumn",
+    "StagedCohort",
+    "StagingRing",
+    "StagingSlotPool",
+    "as_staged",
+    "stage_layout",
+]
+
+#: layout entry per staged column: (dtype string, trailing shape)
+Layout = Tuple[Tuple[str, Tuple[int, ...]], ...]
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+class StagedColumn(np.ndarray):
+    """An ndarray view over a staging slot carrying its device twin.
+
+    ``jax_array`` is the already-transferred device copy (``None`` when
+    staging transfer is off or the twin was dropped). Any derived view,
+    copy, or unpickle drops the twin — it is only valid for the exact view
+    the stager attached it to.
+    """
+
+    jax_array: Optional[Any] = None
+
+    def __array_finalize__(self, obj: Optional[np.ndarray]) -> None:
+        # never propagate the twin through slicing/ufuncs/pickle: a derived
+        # array no longer matches the transferred buffer
+        self.jax_array = None
+
+
+def as_staged(host: np.ndarray, device: Optional[Any]) -> np.ndarray:
+    """Wrap ``host`` as a :class:`StagedColumn` carrying ``device``.
+
+    With ``device=None`` the plain host array is returned untouched — the
+    unstaged-transfer path hands the target ordinary numpy and the wrapper
+    layer behaves exactly as before.
+    """
+    if device is None:
+        return host
+    view = host.view(StagedColumn)
+    view.jax_array = device
+    return view
+
+
+def stage_layout(cols: Sequence[np.ndarray]) -> Layout:
+    """The schema key a ring/slot binds to: per-column dtype + trailing
+    (per-row) shape. Rows are compared on this, never on batch length."""
+    return tuple((str(c.dtype), tuple(c.shape[1:])) for c in cols)
+
+
+class StagingRing:
+    """Pow2 columnar ring buffer: one circular array per staged column.
+
+    The caller (the queue, under its admission lock) owns all
+    synchronization of ``alloc``; block writes to disjoint index ranges are
+    plain numpy slice stores and may race with reads of *other* ranges.
+    Layout binds lazily on the first write and re-binds only through
+    :meth:`bind` (the queue allows it only with zero live rows).
+    """
+
+    def __init__(self, capacity_rows: int) -> None:
+        if int(capacity_rows) < 1:
+            raise ValueError(f"capacity_rows must be >= 1, got {capacity_rows}")
+        self.capacity = _pow2_at_least(int(capacity_rows))
+        self._mask = self.capacity - 1
+        self.head = 0  # next sequence number to allocate
+        self.layout: Optional[Layout] = None
+        self.ids: Optional[np.ndarray] = None
+        self.t_submit: Optional[np.ndarray] = None
+        self.cohorts: Optional[np.ndarray] = None
+        self.cols: List[np.ndarray] = []
+
+    @property
+    def bound(self) -> bool:
+        return self.layout is not None
+
+    def bind(self, layout: Layout) -> None:
+        """(Re)allocate every column buffer for ``layout``."""
+        self.layout = layout
+        self.ids = np.empty(self.capacity, dtype=np.int32)
+        self.t_submit = np.empty(self.capacity, dtype=np.float64)
+        self.cohorts = np.empty(self.capacity, dtype=object)
+        self.cols = [
+            np.zeros((self.capacity,) + shape, dtype=dtype) for dtype, shape in layout
+        ]
+
+    def alloc(self, n: int = 1) -> int:
+        """Reserve ``n`` consecutive sequence numbers; returns the first."""
+        seq0 = self.head
+        self.head += n
+        return seq0
+
+    def write_row(
+        self, seq: int, tenant: int, t: float, cohort: Optional[str], values: Sequence[Any]
+    ) -> None:
+        i = seq & self._mask
+        self.ids[i] = tenant
+        self.t_submit[i] = t
+        self.cohorts[i] = cohort
+        for buf, v in zip(self.cols, values):
+            buf[i] = v
+
+    def write_rows(
+        self,
+        seq0: int,
+        tenants: np.ndarray,
+        t: float,
+        cohort: Optional[str],
+        columns: Sequence[np.ndarray],
+    ) -> None:
+        """Bulk write ``len(tenants)`` rows at ``[seq0, seq0 + n)`` — at most
+        two slice stores per column (wraparound split)."""
+        n = int(tenants.shape[0])
+        if n == 0:
+            return
+        i = seq0 & self._mask
+        k = min(n, self.capacity - i)
+        self.ids[i : i + k] = tenants[:k]
+        self.t_submit[i : i + k] = t
+        self.cohorts[i : i + k] = cohort
+        for buf, col in zip(self.cols, columns):
+            buf[i : i + k] = col[:k]
+        if k < n:
+            rest = n - k
+            self.ids[:rest] = tenants[k:]
+            self.t_submit[:rest] = t
+            self.cohorts[:rest] = cohort
+            for buf, col in zip(self.cols, columns):
+                buf[:rest] = col[k:]
+
+    def read_ids(self, seq0: int, n: int) -> np.ndarray:
+        """The id column for ``[seq0, seq0 + n)`` (a copy — callers use it
+        for per-tenant accounting while producers keep writing)."""
+        out = np.empty(n, dtype=np.int32)
+        i = seq0 & self._mask
+        k = min(n, self.capacity - i)
+        out[:k] = self.ids[i : i + k]
+        if k < n:
+            out[k:] = self.ids[: n - k]
+        return out
+
+    def copy_out(self, seq0: int, n: int, slot: "StagingSlot") -> None:
+        """Copy rows ``[seq0, seq0 + n)`` into ``slot``'s leading rows —
+        one or two contiguous slice copies per column."""
+        i = seq0 & self._mask
+        k = min(n, self.capacity - i)
+        slot.ids[:k] = self.ids[i : i + k]
+        slot.t_submit[:k] = self.t_submit[i : i + k]
+        slot.cohorts[:k] = self.cohorts[i : i + k]
+        for dst, src in zip(slot.cols, self.cols):
+            dst[:k] = src[i : i + k]
+        if k < n:
+            rest = n - k
+            slot.ids[k:n] = self.ids[:rest]
+            slot.t_submit[k:n] = self.t_submit[:rest]
+            slot.cohorts[k:n] = self.cohorts[:rest]
+            for dst, src in zip(slot.cols, self.cols):
+                dst[k:n] = src[:rest]
+
+    # -- pickle: buffers are process-local scratch --------------------------
+
+    def __getstate__(self) -> Dict[str, Any]:
+        return {"capacity": self.capacity}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__init__(state["capacity"])
+
+
+class StagingSlot:
+    """One reusable cohort-sized buffer set (``max_batch`` rows per column)."""
+
+    __slots__ = ("index", "generation", "rows", "ids", "t_submit", "cohorts", "cols")
+
+    def __init__(self, index: int, generation: int, rows: int, layout: Layout) -> None:
+        self.index = index
+        self.generation = generation
+        self.rows = rows
+        self.ids = np.empty(rows, dtype=np.int32)
+        self.t_submit = np.empty(rows, dtype=np.float64)
+        self.cohorts = np.empty(rows, dtype=object)
+        self.cols = [np.zeros((rows,) + shape, dtype=dtype) for dtype, shape in layout]
+
+
+class StagingSlotPool:
+    """A bounded pool of :class:`StagingSlot` — the double-buffer depth.
+
+    ``acquire`` blocks until a slot frees (``try_acquire`` never blocks —
+    the prefetcher skips a cycle rather than stall the flusher). Slots
+    materialize lazily against the currently bound layout; a re-bind bumps
+    the generation so stale slots reallocate on next acquire.
+    """
+
+    def __init__(self, num_slots: int, rows: int) -> None:
+        if int(num_slots) < 2:
+            raise ValueError(
+                f"staging needs >= 2 slots to double-buffer, got {num_slots}"
+            )
+        self.num_slots = int(num_slots)
+        self.rows = int(rows)
+        self._cv = threading.Condition()
+        self._free: List[int] = list(range(self.num_slots))
+        self._slots: List[Optional[StagingSlot]] = [None] * self.num_slots
+        self._layout: Optional[Layout] = None
+        self._generation = 0
+
+    def bind(self, layout: Layout) -> None:
+        with self._cv:
+            self._layout = layout
+            self._generation += 1
+
+    def _take_locked(self) -> StagingSlot:
+        idx = self._free.pop()
+        slot = self._slots[idx]
+        if slot is None or slot.generation != self._generation:
+            slot = StagingSlot(idx, self._generation, self.rows, self._layout or ())
+            self._slots[idx] = slot
+        return slot
+
+    def acquire(self, timeout: Optional[float] = None) -> Optional[StagingSlot]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while not self._free:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            return self._take_locked()
+
+    def try_acquire(self) -> Optional[StagingSlot]:
+        with self._cv:
+            if not self._free:
+                return None
+            return self._take_locked()
+
+    def refresh(self, slot: StagingSlot) -> StagingSlot:
+        """Re-materialize a CHECKED-OUT slot against the current layout
+        when a bind raced its acquire. A flusher acquires its slot before
+        popping (the ring-span safety ordering), so the very first
+        submit's bind can land between the two — the slot would carry the
+        pre-bind layout (zero columns) into a real cohort. No-op when the
+        slot is current."""
+        with self._cv:
+            if slot.generation == self._generation:
+                return slot
+            fresh = StagingSlot(
+                slot.index, self._generation, self.rows, self._layout or ()
+            )
+            self._slots[slot.index] = fresh
+            return fresh
+
+    def release(self, slot: StagingSlot) -> None:
+        with self._cv:
+            self._free.append(slot.index)
+            self._cv.notify()
+
+    def in_use(self) -> int:
+        with self._cv:
+            return self.num_slots - len(self._free)
+
+    # -- pickle: slots are process-local scratch ----------------------------
+
+    def __getstate__(self) -> Dict[str, Any]:
+        return {"num_slots": self.num_slots, "rows": self.rows}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__init__(state["num_slots"], state["rows"])
+
+
+class StagedCohort:
+    """One staged-and-ready dispatch: slot-backed views plus the device twin.
+
+    ``ids``/``cols`` are what the target receives (``StagedColumn`` views
+    when the transfer ran, plain slot views otherwise); ``n`` is the
+    post-quarantine row count, ``bucket`` the padded hand-off length.
+    ``stage_window`` is the ``(t0, t1)`` perf-counter interval the staging
+    work occupied — the overlap ledger intersects it with the concurrent
+    dispatch window.
+    """
+
+    __slots__ = (
+        "slot",
+        "n",
+        "bucket",
+        "ids",
+        "cols",
+        "t_submits",
+        "cohorts",
+        "stage_window",
+    )
+
+    def __init__(
+        self,
+        slot: StagingSlot,
+        n: int,
+        bucket: int,
+        ids: np.ndarray,
+        cols: List[np.ndarray],
+        t_submits: np.ndarray,
+        cohorts: Sequence[Optional[str]],
+        stage_window: Tuple[float, float],
+    ) -> None:
+        self.slot = slot
+        self.n = n
+        self.bucket = bucket
+        self.ids = ids
+        self.cols = cols
+        self.t_submits = t_submits
+        self.cohorts = cohorts
+        self.stage_window = stage_window

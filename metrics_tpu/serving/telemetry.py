@@ -45,6 +45,9 @@ __all__ = [
     "observe_queue_depth",
     "observe_queue_wait",
     "observe_read_staleness",
+    "observe_staging_fill",
+    "observe_staging_occupancy",
+    "observe_staging_overlap",
     "summary",
 ]
 
@@ -55,6 +58,13 @@ DISPATCH_SECONDS = "serving_dispatch_seconds"
 FLUSH_SECONDS = "serving_flush_seconds"
 QUEUE_DEPTH = "serving_queue_depth"
 READ_STALENESS_SECONDS = "serving_read_staleness_seconds"
+#: device-resident ingest (the staged flush path, docs/performance.md
+#: "Device-resident ingest"): per-cohort stage time (ring→slot fill +
+#: quarantine + pad + H2D), the portion of a PREFETCHED cohort's stage that
+#: ran under a concurrent dispatch, and slot-pool occupancy at stage time
+STAGING_FILL_SECONDS = "serving_staging_fill_seconds"
+STAGING_OVERLAP_SECONDS = "serving_staging_overlap_seconds"
+STAGING_OCCUPANCY = "serving_staging_occupancy"
 
 
 def observe_ingest(seconds: float, policy: str) -> None:
@@ -93,6 +103,23 @@ def observe_queue_depth(rows: int) -> None:
     HISTOGRAMS.observe(QUEUE_DEPTH, float(rows), unit="count")
 
 
+def observe_staging_fill(seconds: float) -> None:
+    """One staged cohort's total stage time: ring→slot slice copy,
+    vectorized quarantine scan, in-place pad fold, and the H2D transfer."""
+    HISTOGRAMS.observe(STAGING_FILL_SECONDS, seconds, unit="s")
+
+
+def observe_staging_overlap(seconds: float) -> None:
+    """The portion of a PREFETCHED cohort's stage window that ran while the
+    previous cohort's dispatch was in flight — the double-buffer's yield."""
+    HISTOGRAMS.observe(STAGING_OVERLAP_SECONDS, seconds, unit="s")
+
+
+def observe_staging_occupancy(slots: int) -> None:
+    """Staging slots in use at stage-complete time (unit ``count``)."""
+    HISTOGRAMS.observe(STAGING_OCCUPANCY, float(slots), unit="count")
+
+
 class ServingStats:
     """Thread-safe counters for the serving plane (one process-global
     instance, :data:`SERVING_STATS`; private instances supported for
@@ -118,6 +145,8 @@ class ServingStats:
             "refreshes": 0,
             "coalesced_refreshes": 0,
             "generation_bumps": 0,
+            "staged_cohorts": 0,
+            "prefetched_cohorts": 0,
         }
         self._shed_by_reason: Dict[str, int] = {}
         self._flushes_by_trigger: Dict[str, int] = {}

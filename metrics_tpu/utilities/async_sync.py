@@ -615,6 +615,19 @@ def get_engine(name: str = "default") -> AsyncSyncEngine:
     return _ENGINE
 
 
+def staging_lane() -> AsyncSyncEngine:
+    """The serving queue's dedicated host-only staging lane.
+
+    The staged-ingest prefetch (``AdmissionQueue(staging=True)``) fills and
+    transfers the NEXT cohort while the current dispatch is still on device.
+    That fill is pure host work plus a ``device_put``-style transfer — it
+    must never queue behind the default lane's FIFO (where a slow refresh
+    or checkpoint would serialize exactly the overlap the double-buffer
+    exists to create), so it gets its own single-worker lane. FIFO within
+    the lane keeps cohort hand-off order deterministic."""
+    return get_engine("staging")
+
+
 def summary() -> Dict[str, Any]:
     """The global engine's compact view — ``{}`` when nothing ever submitted
     (the snapshot stays clean for processes that never used

@@ -84,6 +84,14 @@ __all__ = ["KeyedMetric", "MultiTenantCollection"]
 _SEGMENT_REDUCTIONS = ("sum", "max", "min")
 
 
+def _unstage(x: Any) -> Any:
+    """Swap a pre-staged host view (``serving/staging.py``) for its device
+    twin; anything else passes through untouched. Duck-typed on the
+    ``jax_array`` attribute so the wrapper layer never imports serving."""
+    staged = getattr(x, "jax_array", None)
+    return x if staged is None else staged
+
+
 def _pow2_at_least(n: int) -> int:
     """The smallest power of two >= ``n`` (>= 1) — the padded-capacity
     discipline: every elastic resize lands on a pow2 physical capacity, so
@@ -445,7 +453,11 @@ class KeyedMetric(Metric):
     # ------------------------------------------------------------------
 
     def _canonical_ids(self, tenant_ids: Any) -> Array:
-        ids = jnp.asarray(tenant_ids)
+        # pre-staged cohorts (serving/staging.py) ride in as ndarray views
+        # carrying their already-transferred device twin — use the twin so
+        # the dispatch pays no second H2D conversion
+        staged = getattr(tenant_ids, "jax_array", None)
+        ids = staged if staged is not None else jnp.asarray(tenant_ids)
         if not jnp.issubdtype(ids.dtype, jnp.integer):
             raise ValueError(
                 f"tenant_ids must be an integer array, got dtype {ids.dtype}"
@@ -527,6 +539,36 @@ class KeyedMetric(Metric):
             offset += width
         return new
 
+    #: leaf dtypes the extremal Pallas kernel picks exactly through f32
+    _EXTREMAL_SCATTER_DTYPES = ("float32", "int32", "bfloat16", "int16", "int8")
+
+    def _extremal_segment(self, rows: Array, ids: Array, n: int, fx: str):
+        """Pallas fast path for one ``"max"``/``"min"`` leaf, or ``None``.
+
+        Only engages on a TPU backend inside the kernel's shape gates
+        (``segment_scatter_extremal_ok``) for dtypes f32 picks exactly —
+        gated off, the XLA lowering in the caller is byte-identical to the
+        pre-kernel program. Extrema select, they never reassociate, so the
+        kernel result matches the XLA ``segment_max``/``segment_min`` bit
+        for bit (empty segments hold the same ∓inf identity; the caller's
+        ``counts > 0`` mask discards them either way).
+        """
+        if str(rows.dtype) not in self._EXTREMAL_SCATTER_DTYPES:
+            return None
+        from metrics_tpu.kernels.segment_scatter import (
+            segment_scatter_extremal_ok,
+            segment_scatter_max,
+            segment_scatter_min,
+        )
+
+        width = int(np.prod(rows.shape[1:], dtype=np.int64)) if rows.ndim > 1 else 1
+        if not segment_scatter_extremal_ok(rows.shape[0], n, width):
+            return None
+        kfn = segment_scatter_max if fx == "max" else segment_scatter_min
+        flat = rows.reshape(rows.shape[0], -1)
+        seg_flat, _ = kfn(flat, ids, n, use_pallas=True)
+        return seg_flat.reshape((n,) + rows.shape[1:])
+
     def _segment_scatter(
         self, state: StateDict, tenant_ids: Any, args: Tuple, kwargs: Dict
     ) -> Tuple[StateDict, Array]:
@@ -572,9 +614,11 @@ class KeyedMetric(Metric):
                 delta = jax.ops.segment_sum(rows - default, safe, num_segments=n + 1)[:n]
                 new[name] = state[name] + delta.astype(state[name].dtype)
             else:
-                seg_fn = jax.ops.segment_max if fx == "max" else jax.ops.segment_min
+                seg = self._extremal_segment(rows, ids, n, fx)
+                if seg is None:
+                    seg_fn = jax.ops.segment_max if fx == "max" else jax.ops.segment_min
+                    seg = seg_fn(rows, safe, num_segments=n + 1)[:n]
                 pick = jnp.maximum if fx == "max" else jnp.minimum
-                seg = seg_fn(rows, safe, num_segments=n + 1)[:n]
                 has_rows = (counts > 0).reshape((n,) + (1,) * (rows.ndim - 1))
                 new[name] = jnp.where(
                     has_rows, pick(state[name], seg.astype(state[name].dtype)), state[name]
@@ -639,10 +683,17 @@ class KeyedMetric(Metric):
         event-row axis of every array argument. With ``validate_ids=True``
         (default) out-of-range ids raise here, host-side, before anything is
         dispatched; with ``False`` they clip-and-drop inside the program.
+
+        Pre-staged cohorts (``serving/staging.py`` views carrying a
+        ``jax_array`` device twin) dispatch the twin directly — the host view
+        keeps validation, traffic, and durability hooks sync-free.
         """
+        host_ids = tenant_ids if getattr(tenant_ids, "jax_array", None) is not None else None
         ids = self._canonical_ids(tenant_ids)
         if self.validate_ids:
-            self._validate_ids_eager(ids)
+            self._validate_ids_eager(ids if host_ids is None else host_ids)
+        args = tuple(_unstage(a) for a in args)
+        kwargs = {k: _unstage(v) for k, v in kwargs.items()}
         hooks = self.__dict__.get("_durability_hooks")
         with self._serial_lock():
             if hooks is not None:
@@ -650,7 +701,7 @@ class KeyedMetric(Metric):
                 # dispatch reads the stacked state (exact for every routable
                 # reduction); runs under the serial lock so no other ingest
                 # thread can interleave a dispatch mid-fault-back
-                hooks.before_update(np.asarray(ids))
+                hooks.before_update(np.asarray(ids if host_ids is None else host_ids))
             state = self._get_states()
             donatable = True
             if self._jit_forward_donate:
@@ -664,12 +715,12 @@ class KeyedMetric(Metric):
                 PROFILER.finish(prof, new_state, self.telemetry_key, fn, submit_end=submitted)
             self._set_states(new_state)
             if hooks is not None:
-                hooks.after_update(np.asarray(ids))
+                hooks.after_update(np.asarray(ids if host_ids is None else host_ids))
         if TELEMETRY.enabled or self.__dict__.get("_durability_traffic_pin"):
             # a durability pin (checkpoint delta trail, cold-tenant spiller)
             # keeps the ledger fed with telemetry off: frozen rows would
             # silently drop tenants from the next delta's dirty set
-            self._note_tenant_traffic(ids)
+            self._note_tenant_traffic(ids if host_ids is None else host_ids)
         if start is not None:
             dur = submitted - start
             key = self.telemetry_key

@@ -68,6 +68,14 @@ DEFAULT_TOLERANCE = 0.5
 #: prior healthy rounds required before a config is judged
 DEFAULT_MIN_HISTORY = 2
 
+#: per-config default tolerance bands (CLI/sidecar overrides win). The
+#: staged-overlap A/B is a p99-of-sampled-flushes under a deliberately
+#: saturated soak — tail noise between healthy rounds runs far hotter than
+#: the steady-state configs the global 0.5 band was calibrated on.
+DEFAULT_TOLERANCE_OVERRIDES: Dict[str, float] = {
+    "ingest_staged_overlap_step": 0.8,
+}
+
 #: record statuses the delta table reports
 OK, REGRESSED, SKIPPED_DEGRADED, SKIPPED_NO_VALUE, SKIPPED_NO_HISTORY = (
     "ok", "REGRESSED", "skipped (degraded)", "skipped (no value)",
@@ -411,6 +419,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (ValueError, OSError, json.JSONDecodeError) as err:
         print(f"bench_regress: {err}", file=sys.stderr)
         return 2
+    # built-in per-config bands sit UNDER both the sidecar and the flags
+    overrides = {**DEFAULT_TOLERANCE_OVERRIDES, **overrides}
     rows = check_trajectory(
         rounds,
         tolerance=args.tolerance,

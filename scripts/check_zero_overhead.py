@@ -658,6 +658,90 @@ def durability_off_programs() -> Dict[str, str]:
     }
 
 
+def staging_off_programs() -> Dict[str, str]:
+    """Hot keyed-update lowerings with the device-resident ingest plane
+    exercised — observability disabled (the kernels-off discipline).
+
+    The staged admission path (``AdmissionQueue(staging=True)``,
+    ``docs/performance.md#device-resident-ingest``) moves cohort formation
+    and the H2D transfer OUT of the dispatch; the compiled keyed-update
+    program must carry zero trace of it. Two pins, both additive:
+
+    * ``keyed_update_staging_off`` — the keyed update after a classic
+      (staging OFF) queue flush drove the metric: must be BYTE-IDENTICAL
+      to the plain keyed update (asserted here directly, then pinned);
+    * ``keyed_update_staged_queue`` — the keyed update after a STAGED
+      queue flush drove the metric with pre-transferred
+      :class:`~metrics_tpu.serving.staging.StagedColumn` cohorts: the
+      wrapper unwraps the device twin before dispatch, so this too must
+      be BYTE-IDENTICAL to the plain program (asserted, then pinned).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu import Accuracy, observability
+    from metrics_tpu.serving import AdmissionQueue
+    from metrics_tpu.wrappers import KeyedMetric
+
+    jax.config.update("jax_enable_x64", True)
+    prev_enabled = observability.TELEMETRY.enabled
+    prev_policy = observability.get_health_policy()
+    observability.set_health_policy("off")
+    observability.disable()
+    try:
+        preds = jnp.zeros((8,), jnp.float32)
+        target = jnp.zeros((8,), jnp.int32)
+        ids = jnp.zeros((8,), jnp.int32)
+
+        plain = KeyedMetric(Accuracy(), 16, validate_ids=False)
+        plain_text = str(
+            jax.make_jaxpr(plain.apply_update)(plain.init_state(), ids, preds, target)
+        )
+
+        off = KeyedMetric(Accuracy(), 16, validate_ids=False)
+        q_off = AdmissionQueue(off.update, max_batch=8, start=False, staging=False)
+        q_off.submit_many(
+            np.arange(8), np.zeros(8, np.float32), np.zeros(8, np.int32)
+        )
+        q_off._flush_once("manual")
+        off_text = str(
+            jax.make_jaxpr(off.apply_update)(off.init_state(), ids, preds, target)
+        )
+        if off_text != plain_text:
+            raise AssertionError(
+                "keyed update jaxpr differs after a staging-OFF queue flush —"
+                " the admission-queue refactor leaked traced ops into the hot"
+                " path"
+            )
+
+        on = KeyedMetric(Accuracy(), 16, validate_ids=False)
+        q_on = AdmissionQueue(on.update, max_batch=8, start=False, staging=True)
+        q_on.submit_many(
+            np.arange(8), np.zeros(8, np.float32), np.zeros(8, np.int32)
+        )
+        q_on._flush_once("manual")
+        on_text = str(
+            jax.make_jaxpr(on.apply_update)(on.init_state(), ids, preds, target)
+        )
+        if on_text != plain_text:
+            raise AssertionError(
+                "keyed update jaxpr differs after a STAGED queue flush — the"
+                " pre-staged device cohorts (StagedColumn twins) altered the"
+                " compiled keyed-update program; the wrapper must unwrap them"
+                " host-side only"
+            )
+    finally:
+        observability.set_health_policy(prev_policy)
+        observability.TELEMETRY.enable(prev_enabled)
+        observability.EVENTS.enable(prev_enabled)
+        observability.TRACER.enable(prev_enabled)
+    return {
+        "keyed_update_staging_off": off_text,
+        "keyed_update_staged_queue": on_text,
+    }
+
+
 def current_jaxprs() -> Dict[str, str]:
     """Jaxpr text per pinned program in the disabled-observability state
     (which the identity check proves equals the enabled state)."""
@@ -1156,6 +1240,25 @@ def check(baseline_path: str = BASELINE_PATH) -> Dict[str, list]:
                         " byte-stable). If intentional, regenerate with"
                         " `python scripts/check_zero_overhead.py --update`."
                     )
+        # the staging-off/staged-queue lowerings are jaxpr-text pins like
+        # the primary programs (the byte-identity asserts run inside the
+        # probe regardless of the version gate)
+        pinned_staging = baseline.get("staging_off")
+        if pinned_staging is None:
+            violations.append("staging_off missing from baseline (run --update)")
+        elif baseline.get("jax_version") == jax.__version__:
+            for name, text in staging_off_programs().items():
+                want = pinned_staging.get(name)
+                if want is None:
+                    violations.append(f"{name}: staging program missing from baseline (run --update)")
+                elif want["sha256"] != _sha256(text):
+                    violations.append(
+                        f"{name}: staging-plane jaxpr digest drifted from the pinned"
+                        " baseline — the device-resident ingest path altered the"
+                        " keyed-update hot program (it must stay byte-identical"
+                        " staged, unstaged, and plain). If intentional, regenerate"
+                        " with `python scripts/check_zero_overhead.py --update`."
+                    )
         # donated-lowering aliasing counts are version-independent too: pin
         # them so a layout change that sheds aliased buffers is conscious
         pinned_donation = baseline.get("donation_aliasing")
@@ -1225,6 +1328,15 @@ def update_baseline(baseline_path: str = BASELINE_PATH) -> str:
         "durability_off": {
             name: {"sha256": _sha256(text), "jaxpr": text}
             for name, text in durability_off_programs().items()
+        },
+        # device-resident-ingest lowerings (keyed update after a staging-OFF
+        # flush == the plain program byte for byte; same after a STAGED
+        # flush with pre-transferred cohorts) — added additively, every
+        # pre-existing key kept byte-identical at the regeneration that
+        # introduced it
+        "staging_off": {
+            name: {"sha256": _sha256(text), "jaxpr": text}
+            for name, text in staging_off_programs().items()
         },
     }
     with open(baseline_path, "w") as fh:

@@ -435,6 +435,7 @@ def run_soak(
     slo: bool = False,
     slo_fault: bool = False,
     slo_seed: int = DEFAULT_CHAOS_SEED,
+    staged: bool = False,
 ) -> dict:
     """One full soak run; returns the JSON-serializable record.
 
@@ -466,7 +467,13 @@ def run_soak(
     (burn-rate > 1 on both windows) within ONE fast window of the first
     bad observation, with ``breaches()`` / ``snapshot()["slo"]`` /
     Prometheus / the ``slo`` timeline events all in agreement; without it
-    the control run must stay breach-free."""
+    the control run must stay breach-free.
+
+    ``staged`` switches the queue onto the device-resident ingest path
+    (columnar staging ring + double-buffered cohort prefetch,
+    ``docs/performance.md#device-resident-ingest``); the record gains a
+    ``staging`` block with the overlap evidence, and every conservation
+    law above must keep holding EXACTLY."""
     from metrics_tpu import Accuracy, KeyedMetric, observability
     from metrics_tpu.observability.histogram import HISTOGRAMS
     from metrics_tpu.serving import SLOScheduler
@@ -507,6 +514,10 @@ def run_soak(
         # chaos arms the poisoned-row quarantine explicitly (no dependence
         # on the ambient health-policy setting)
         quarantine="on" if chaos else "auto",
+        # device-resident ingest: rows land in the columnar staging ring at
+        # submit time and cohorts prefetch+transfer under the previous
+        # dispatch (docs/performance.md#device-resident-ingest)
+        staging=bool(staged),
     )
 
     # -- warmup: pre-compile every pow2 dispatch bucket outside the window
@@ -778,6 +789,23 @@ def run_soak(
     }
     if skew:
         record["skew"] = float(skew)
+    if staged:
+        # the device-resident ingest evidence: how many cohorts staged, how
+        # many prefetched ahead of their dispatch, and what fraction of the
+        # prefetched stage time ran UNDER a concurrent dispatch (the
+        # double-buffer's yield) — beside the same conservation laws, which
+        # must hold exactly on the staged path too
+        staging = dict(stats.get("staging") or {})
+        record["staging"] = {
+            "enabled": bool(staging.get("enabled", False)),
+            "slots": staging.get("slots"),
+            "ring_capacity": staging.get("ring_capacity"),
+            "staged_cohorts": staging.get("staged_cohorts", 0),
+            "prefetched_cohorts": staging.get("prefetched_cohorts", 0),
+            "stage_seconds": round(float(staging.get("stage_seconds", 0.0)), 6),
+            "overlap_seconds": round(float(staging.get("overlap_seconds", 0.0)), 6),
+            "overlap_fraction": round(float(staging.get("overlap_fraction", 0.0)), 4),
+        }
     if spiller is not None:
         # the spill acceptance evidence: the resident working set held the
         # cap under skewed traffic, conservation stayed exact, and a
@@ -1020,6 +1048,12 @@ def main(argv=None) -> int:
         "--slo-seed", type=int, default=DEFAULT_CHAOS_SEED,
         help="seed for the --slo-fault delay schedule",
     )
+    parser.add_argument(
+        "--staged",
+        action="store_true",
+        help="device-resident ingest: columnar staging ring + double-buffered"
+        " cohort prefetch (docs/performance.md#device-resident-ingest)",
+    )
     parser.add_argument("--out", default=None, help="also write the record to this path")
     args = parser.parse_args(argv)
     record = run_soak(
@@ -1042,6 +1076,7 @@ def main(argv=None) -> int:
         slo=args.slo,
         slo_fault=args.slo_fault,
         slo_seed=args.slo_seed,
+        staged=args.staged,
     )
     print(json.dumps(record), flush=True)
     if args.out:

@@ -16,6 +16,12 @@ from metrics_tpu.kernels import (
     segment_scatter_add,
     segment_scatter_add_pallas,
     segment_scatter_add_xla,
+    segment_scatter_max,
+    segment_scatter_max_pallas,
+    segment_scatter_max_xla,
+    segment_scatter_min,
+    segment_scatter_min_pallas,
+    segment_scatter_min_xla,
     stat_scores_counts,
     stat_scores_counts_pallas,
     stat_scores_counts_xla,
@@ -177,6 +183,108 @@ class TestSegmentScatterKernel:
         sums_x, counts_x = segment_scatter_add_xla(rows, ids, s)
         np.testing.assert_array_equal(np.asarray(sums_p), np.asarray(sums_x))
         np.testing.assert_array_equal(np.asarray(counts_p), np.asarray(counts_x))
+
+
+class TestExtremalScatterKernel:
+    """The masked segment max/min leaves vs the XLA ``segment_max``/
+    ``segment_min`` formulation. Extrema SELECT — they never reassociate —
+    so every result must be BIT-IDENTICAL across backends: floats, integers,
+    and dtype-extremal values alike (empty segments hold the same ∓inf
+    identity both ways; callers mask on ``counts > 0``)."""
+
+    def _pair(self, op):
+        if op == "max":
+            return segment_scatter_max_pallas, segment_scatter_max_xla
+        return segment_scatter_min_pallas, segment_scatter_min_xla
+
+    @pytest.mark.parametrize("op", ["max", "min"])
+    @pytest.mark.parametrize("seed", range(5))
+    def test_interpret_fuzz_bit_identical(self, op, seed):
+        rng = np.random.RandomState(100 + seed)
+        r, s, d = rng.randint(1, 400), rng.randint(1, 64), rng.randint(1, 8)
+        rows = jnp.asarray(rng.randn(r, d).astype(np.float32))
+        ids = jnp.asarray(rng.randint(-2, s + 2, r))  # includes invalid ids
+        pfn, xfn = self._pair(op)
+        ext_p, cnt_p = pfn(rows, ids, s, interpret=True)
+        ext_x, cnt_x = xfn(rows, ids, s)
+        np.testing.assert_array_equal(np.asarray(ext_p), np.asarray(ext_x))
+        np.testing.assert_array_equal(np.asarray(cnt_p), np.asarray(cnt_x))
+
+    @pytest.mark.parametrize("op", ["max", "min"])
+    def test_integer_data_bit_identical(self, op):
+        rows = jnp.asarray(
+            _rng.randint(-(2**20), 2**20, (200, 3)).astype(np.float32)
+        )
+        ids = jnp.asarray(_rng.randint(0, 16, 200))
+        pfn, xfn = self._pair(op)
+        ext_p, cnt_p = pfn(rows, ids, 16, interpret=True)
+        ext_x, cnt_x = xfn(rows, ids, 16)
+        np.testing.assert_array_equal(np.asarray(ext_p), np.asarray(ext_x))
+        np.testing.assert_array_equal(np.asarray(cnt_p), np.asarray(cnt_x))
+
+    @pytest.mark.parametrize("op", ["max", "min"])
+    def test_extremal_values_bit_identical(self, op):
+        f = np.finfo(np.float32)
+        rows = jnp.asarray(
+            [[f.max], [f.min], [np.inf], [-np.inf], [0.0], [f.tiny], [-f.tiny]],
+            jnp.float32,
+        )
+        ids = jnp.asarray([0, 0, 1, 1, 2, 2, 2])
+        pfn, xfn = self._pair(op)
+        ext_p, cnt_p = pfn(rows, ids, 4, interpret=True)
+        ext_x, cnt_x = xfn(rows, ids, 4)
+        np.testing.assert_array_equal(np.asarray(ext_p), np.asarray(ext_x))
+        np.testing.assert_array_equal(np.asarray(cnt_p), np.asarray(cnt_x))
+
+    @pytest.mark.parametrize("op", ["max", "min"])
+    def test_empty_segment_identity(self, op):
+        """A segment no valid row routed to holds the reduction identity on
+        BOTH backends (the caller's ``counts > 0`` mask is the contract)."""
+        rows = jnp.asarray([[1.5], [-2.5]], jnp.float32)
+        ids = jnp.asarray([0, 2])
+        pfn, xfn = self._pair(op)
+        ext_p, cnt_p = pfn(rows, ids, 4, interpret=True)
+        ext_x, cnt_x = xfn(rows, ids, 4)
+        np.testing.assert_array_equal(np.asarray(ext_p), np.asarray(ext_x))
+        np.testing.assert_array_equal(np.asarray(cnt_p), [1, 0, 1, 0])
+        identity = -np.inf if op == "max" else np.inf
+        np.testing.assert_array_equal(np.asarray(ext_p)[[1, 3], 0], [identity, identity])
+
+    @pytest.mark.parametrize("op", ["max", "min"])
+    def test_invalid_ids_dropped_identically(self, op):
+        rows = jnp.asarray(_rng.randn(10, 2).astype(np.float32) * 100)
+        ids = jnp.asarray([-5, -1, 0, 1, 2, 3, 3, 5, 99, 2**30])
+        pfn, xfn = self._pair(op)
+        ext_p, cnt_p = pfn(rows, ids, 4, interpret=True)
+        ext_x, cnt_x = xfn(rows, ids, 4)
+        np.testing.assert_array_equal(np.asarray(ext_p), np.asarray(ext_x))
+        np.testing.assert_array_equal(np.asarray(cnt_p), np.asarray(cnt_x))
+        assert int(jnp.sum(cnt_p)) == 5  # only the five in-range ids count
+
+    def test_feature_cap_boundary(self):
+        from metrics_tpu.kernels.segment_scatter import _MAX_EXTREMAL_FEATURES
+
+        d = _MAX_EXTREMAL_FEATURES
+        rows = jnp.asarray(_rng.randn(64, d).astype(np.float32))
+        ids = jnp.asarray(_rng.randint(0, 8, 64))
+        ext_p, cnt_p = segment_scatter_max_pallas(rows, ids, 8, interpret=True)
+        ext_x, cnt_x = segment_scatter_max_xla(rows, ids, 8)
+        np.testing.assert_array_equal(np.asarray(ext_p), np.asarray(ext_x))
+        np.testing.assert_array_equal(np.asarray(cnt_p), np.asarray(cnt_x))
+
+    def test_gate_refuses_on_cpu_and_wide_bundles(self):
+        from metrics_tpu.kernels.segment_scatter import (
+            _MAX_EXTREMAL_FEATURES,
+            _MAX_PALLAS_SEGMENTS,
+            segment_scatter_extremal_ok,
+        )
+
+        # CPU backend: pallas_auto_ok is False, so the gate must refuse
+        assert not segment_scatter_extremal_ok(64, 8, 4)
+        # shape gates are refusals regardless of backend
+        assert not segment_scatter_extremal_ok(64, _MAX_PALLAS_SEGMENTS + 1, 4)
+        assert not segment_scatter_extremal_ok(64, 8, _MAX_EXTREMAL_FEATURES + 1)
+        assert not segment_scatter_extremal_ok(64, 0, 4)
 
 
 class TestSketchHistogramKernel:
@@ -401,6 +509,37 @@ class TestAutoDispatch:
         assert snap["kernels"]["dispatch"]["segment_scatter_add"]["xla"] >= 1
         text = observability.render_prometheus(snap)
         assert 'metrics_tpu_kernel_dispatch_total{op="segment_scatter_add",path="xla"}' in text
+
+    @pytest.mark.parametrize("op", ["max", "min"])
+    def test_extremal_auto_is_xla_on_cpu(self, op):
+        from metrics_tpu.kernels.segment_scatter import segment_scatter_extremal_ok
+
+        rows = jnp.asarray(_rng.randn(32, 3).astype(np.float32))
+        ids = jnp.asarray(_rng.randint(0, 4, 32))
+        assert not segment_scatter_extremal_ok(32, 4, 3)
+        fn = segment_scatter_max if op == "max" else segment_scatter_min
+        xfn = segment_scatter_max_xla if op == "max" else segment_scatter_min_xla
+        (ext, cnt), d = self._delta(
+            f"segment_scatter_{op}", "xla", lambda: fn(rows, ids, 4)
+        )
+        assert d == 1
+        want_ext, want_cnt = xfn(rows, ids, 4)
+        np.testing.assert_array_equal(np.asarray(ext), np.asarray(want_ext))
+        np.testing.assert_array_equal(np.asarray(cnt), np.asarray(want_cnt))
+
+    def test_keyed_extremal_leaf_stays_xla_on_cpu(self):
+        """A keyed metric with ``"min"``/``"max"`` leaves (PSNR's target
+        range) must refuse the extremal kernel on CPU — ``_extremal_segment``
+        returns None and the pre-kernel ``segment_max``/``segment_min``
+        lowering runs (the staging_off baseline pins the keyed jaxpr)."""
+        from metrics_tpu import Accuracy
+        from metrics_tpu.wrappers import KeyedMetric
+
+        km = KeyedMetric(Accuracy(), 4)
+        probe = jnp.zeros((8, 1), jnp.float32)
+        probe_ids = jnp.zeros((8,), jnp.int32)
+        assert km._extremal_segment(probe, probe_ids, 4, "max") is None
+        assert km._extremal_segment(probe, probe_ids, 4, "min") is None
 
     def test_keyed_metric_scatter_stays_xla_on_cpu(self):
         """The multitenant fused-scatter gate must refuse on a CPU backend —

@@ -1,0 +1,548 @@
+"""Device-resident ingest: the columnar staging ring, the double-buffered
+slot pool, and the staged AdmissionQueue flush path.
+
+The staged path moves cohort formation to submit time (ring writes) and the
+H2D transfer ahead of the dispatch (prefetch on the ``staging`` async lane),
+so these tests pin what the refactor must NOT change:
+
+* every conservation law of the exact ledger holds bit-for-bit on the staged
+  path — through racing concurrent writers, dispatch errors, an open
+  breaker, and quarantine sheds;
+* N concurrent writers × racing flushes ingest EXACTLY what a serial
+  referee ingests (integer data: per-tenant sums compare bit-identically
+  even though cohort boundaries differ);
+* pickle/clone drops every staging buffer (rings, slots, device twins) and
+  the rebuilt object re-binds lazily;
+* the staged hand-off semantics: pow2 pad folded in place (ids ``-1``),
+  :class:`StagedColumn` views carrying the device twin only on the exact
+  view the stager attached it to.
+"""
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+from metrics_tpu import observability
+from metrics_tpu.serving import AdmissionQueue
+from metrics_tpu.serving.staging import (
+    StagedColumn,
+    StagingRing,
+    StagingSlotPool,
+    as_staged,
+    stage_layout,
+)
+
+from .test_queue import _Recorder, _assert_invariant
+
+
+def _assert_staged_invariant(q):
+    """All four post-admission shed reasons (test_queue's helper covers only
+    the two its scenarios raise): every admitted row lands in exactly one of
+    dispatched / resident / shed_oldest / dispatch_error / poisoned /
+    breaker_open."""
+    s = q.stats()
+    reasons = s["shed_by_reason"]
+    post = sum(
+        reasons.get(k, 0)
+        for k in ("shed_oldest", "dispatch_error", "poisoned", "breaker_open")
+    )
+    assert s["admitted"] == s["dispatched"] + s["resident"] + post, s
+    assert s["submitted"] - s["shed"] == s["dispatched"] + s["resident"], s
+
+
+# ------------------------------------------------------------- ring
+
+
+class TestStagingRing:
+    def test_capacity_rounds_to_pow2(self):
+        assert StagingRing(1).capacity == 1
+        assert StagingRing(5).capacity == 8
+        assert StagingRing(64).capacity == 64
+        with pytest.raises(ValueError, match="capacity_rows"):
+            StagingRing(0)
+
+    def test_lazy_bind_and_layout(self):
+        r = StagingRing(8)
+        assert not r.bound
+        layout = stage_layout([np.zeros((4,), np.float32), np.zeros((4, 3), np.int32)])
+        assert layout == (("float32", ()), ("int32", (3,)))
+        r.bind(layout)
+        assert r.bound
+        assert r.cols[0].shape == (8,)
+        assert r.cols[1].shape == (8, 3)
+
+    def test_write_read_roundtrip_with_wraparound(self):
+        r = StagingRing(8)
+        r.bind(stage_layout([np.zeros((1,), np.float32)]))
+        # push the head past capacity so the bulk write wraps
+        r.alloc(6)
+        seq0 = r.alloc(4)  # occupies indices 6,7,0,1
+        tenants = np.asarray([10, 11, 12, 13], np.int32)
+        cols = [np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)]
+        r.write_rows(seq0, tenants, 5.0, "c", cols)
+        np.testing.assert_array_equal(r.read_ids(seq0, 4), tenants)
+
+        class Slot:
+            ids = np.empty(4, np.int32)
+            t_submit = np.empty(4, np.float64)
+            cohorts = np.empty(4, object)
+            cols = [np.empty(4, np.float32)]
+
+        r.copy_out(seq0, 4, Slot)
+        np.testing.assert_array_equal(Slot.ids, tenants)
+        np.testing.assert_array_equal(Slot.cols[0], cols[0])
+        np.testing.assert_array_equal(Slot.t_submit, 5.0)
+        assert list(Slot.cohorts) == ["c"] * 4
+
+    def test_per_row_write_matches_bulk(self):
+        bulk, single = StagingRing(8), StagingRing(8)
+        layout = stage_layout([np.zeros((1,), np.float32)])
+        bulk.bind(layout)
+        single.bind(layout)
+        tenants = np.asarray([1, 2, 3], np.int32)
+        col = np.asarray([7.0, 8.0, 9.0], np.float32)
+        s0 = bulk.alloc(3)
+        bulk.write_rows(s0, tenants, 1.0, None, [col])
+        for i in range(3):
+            single.write_row(single.alloc(), int(tenants[i]), 1.0, None, (col[i],))
+        np.testing.assert_array_equal(bulk.ids[:3], single.ids[:3])
+        np.testing.assert_array_equal(bulk.cols[0][:3], single.cols[0][:3])
+
+    def test_pickle_drops_buffers(self):
+        r = StagingRing(16)
+        r.bind(stage_layout([np.zeros((2,), np.float32)]))
+        r.write_rows(
+            r.alloc(2), np.asarray([1, 2], np.int32), 0.0, None,
+            [np.asarray([1.0, 2.0], np.float32)],
+        )
+        clone = pickle.loads(pickle.dumps(r))
+        assert clone.capacity == 16
+        assert not clone.bound  # buffers are process-local scratch
+        assert clone.head == 0
+
+
+# ------------------------------------------------------------- slot pool
+
+
+class TestStagingSlotPool:
+    def test_needs_two_slots(self):
+        with pytest.raises(ValueError, match=">= 2 slots"):
+            StagingSlotPool(1, 8)
+
+    def test_acquire_release_cycle(self):
+        pool = StagingSlotPool(2, 4)
+        pool.bind(stage_layout([np.zeros((1,), np.float32)]))
+        a = pool.acquire()
+        b = pool.try_acquire()
+        assert a is not None and b is not None
+        assert pool.in_use() == 2
+        assert pool.try_acquire() is None  # exhausted: never blocks
+        assert pool.acquire(timeout=0.01) is None  # bounded block
+        pool.release(a)
+        assert pool.in_use() == 1
+        c = pool.acquire()
+        assert c.index == a.index  # the freed slot comes back
+        pool.release(b)
+        pool.release(c)
+
+    def test_rebind_bumps_generation(self):
+        pool = StagingSlotPool(2, 4)
+        pool.bind(stage_layout([np.zeros((1,), np.float32)]))
+        a = pool.acquire()
+        assert a.cols[0].shape == (4,)
+        pool.release(a)
+        pool.bind(stage_layout([np.zeros((1, 3), np.int32)]))
+        b = pool.acquire()
+        assert b.cols[0].shape == (4, 3)  # stale slot reallocated
+        pool.release(b)
+
+    def test_pickle_drops_slots(self):
+        pool = StagingSlotPool(3, 8)
+        pool.bind(stage_layout([np.zeros((1,), np.float32)]))
+        a = pool.acquire()  # leave one slot checked out
+        clone = pickle.loads(pickle.dumps(pool))
+        assert clone.num_slots == 3 and clone.rows == 8
+        assert clone.in_use() == 0  # rebuilt pool is all-free
+        pool.release(a)
+
+
+# ------------------------------------------------------------- staged column
+
+
+class TestStagedColumn:
+    def test_as_staged_none_is_passthrough(self):
+        host = np.arange(4).astype(np.float32)
+        assert as_staged(host, None) is host
+
+    def test_twin_attached_and_dropped_on_derivation(self):
+        host = np.arange(4).astype(np.float32)
+        view = as_staged(host, "DEVICE")
+        assert isinstance(view, StagedColumn)
+        assert view.jax_array == "DEVICE"
+        np.testing.assert_array_equal(np.asarray(view), host)
+        # any derived view no longer matches the transferred buffer
+        assert view[:2].jax_array is None
+        assert (view + 1).jax_array is None
+        assert view.copy().jax_array is None
+
+    def test_pickle_drops_twin(self):
+        view = as_staged(np.arange(3).astype(np.float32), "DEVICE")
+        clone = pickle.loads(pickle.dumps(view))
+        assert clone.jax_array is None
+        np.testing.assert_array_equal(np.asarray(clone), np.asarray(view))
+
+
+# ------------------------------------------------------------- staged queue
+
+
+def _staged_queue(target, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("start", False)
+    kw.setdefault("staging", True)
+    return AdmissionQueue(target, **kw)
+
+
+class TestStagedFlush:
+    def test_rows_dispatch_with_device_twins(self):
+        rec = _Recorder()
+        q = _staged_queue(rec)
+        seen = {}
+
+        def target(ids, *cols):
+            seen["ids_twin"] = getattr(ids, "jax_array", None)
+            seen["col_twins"] = [getattr(c, "jax_array", None) for c in cols]
+            rec(ids, *cols)
+
+        q._target = target
+        for i in range(8):
+            q.submit(i, np.float32(i * 2))
+        assert q._flush_once("manual") == 8
+        _assert_invariant(q)
+        ids, cols = rec.calls[0]
+        np.testing.assert_array_equal(ids, np.arange(8))
+        np.testing.assert_array_equal(cols[0], np.arange(8) * 2.0)
+        # the hand-off carried pre-transferred device twins
+        assert seen["ids_twin"] is not None
+        assert all(t is not None for t in seen["col_twins"])
+        np.testing.assert_array_equal(np.asarray(seen["ids_twin"]), ids)
+        q.close()
+
+    def test_transfer_off_hands_plain_owning_numpy(self):
+        calls = []
+
+        def target(ids, *cols):
+            calls.append((ids, cols))
+
+        q = _staged_queue(target, staging_transfer=False)
+        for i in range(4):
+            q.submit(i, np.float32(i))
+        q._flush_once("manual")
+        first_ids, first_cols = calls[0]
+        assert type(first_ids) is np.ndarray  # no StagedColumn wrapper
+        assert all(type(c) is np.ndarray for c in first_cols)
+        # the hand-off owns its memory: later submits/flushes recycling the
+        # same staging slot must not mutate the first cohort retroactively
+        for i in range(4):
+            q.submit(9, np.float32(99.0))
+        q._flush_once("manual")
+        np.testing.assert_array_equal(first_ids, np.arange(4))
+        np.testing.assert_array_equal(first_cols[0], np.arange(4, dtype=np.float32))
+        q.close()
+
+    def test_pad_folds_into_slot(self):
+        rec = _Recorder()
+        q = _staged_queue(rec, max_batch=8, pad_to_bucket=True)
+        for i in range(3):
+            q.submit(i, np.float32(1.0))
+        q._flush_once("manual")
+        ids, cols = rec.calls[0]
+        assert len(ids) == 4  # pow2 bucket
+        np.testing.assert_array_equal(ids, [0, 1, 2, -1])
+        np.testing.assert_array_equal(cols[0], [1.0, 1.0, 1.0, 0.0])
+        _assert_invariant(q)
+        assert q.stats()["dispatched"] == 3  # the pad row is not a row
+        q.close()
+
+    def test_schema_change_with_resident_rows_raises(self):
+        q = _staged_queue(_Recorder())
+        q.submit(0, np.float32(1.0))
+        with pytest.raises(ValueError, match="schema"):
+            q.submit(1, np.float32(1.0), np.float32(2.0))
+        # the rejected cohort never skewed the ledger
+        s = q.stats()
+        assert s["submitted"] == 1 and s["admitted"] == 1
+        q._flush_once("manual")
+        # drained: the ring re-binds to the new layout
+        assert q.submit(1, np.float32(1.0), np.float32(2.0))
+        q._flush_once("manual")
+        _assert_invariant(q)
+        assert q.stats()["dispatched"] == 2
+        q.close()
+
+    def test_stats_staging_block(self):
+        q = _staged_queue(_Recorder(), staging_slots=3)
+        for i in range(8):
+            q.submit(i, np.float32(i))
+        q._flush_once("manual")
+        st = q.stats()["staging"]
+        assert st["enabled"] is True
+        assert st["slots"] == 3
+        assert st["staged_cohorts"] == 1
+        assert st["stage_seconds"] > 0
+        assert 0.0 <= st["overlap_fraction"] <= 1.0
+        q.close()
+        off = AdmissionQueue(_Recorder(), max_batch=8, start=False)
+        assert off.stats()["staging"]["enabled"] is False
+        off.close()
+
+    def test_dispatch_error_sheds_exactly(self):
+        rec = _Recorder(fail_times=1)
+        q = _staged_queue(rec)
+        for i in range(8):
+            q.submit(i, np.float32(i))
+        q._flush_once("manual")
+        for i in range(4):
+            q.submit(i, np.float32(i))
+        q._flush_once("manual")
+        s = q.stats()
+        assert s["shed_by_reason"]["dispatch_error"] == 8
+        assert s["dispatched"] == 4
+        _assert_invariant(q)
+        q.close()
+
+    def test_breaker_open_sheds_under_exact_reason(self):
+        from metrics_tpu.resilience import CircuitBreaker
+
+        rec = _Recorder(fail_times=2)
+        q = _staged_queue(
+            rec, breaker=CircuitBreaker(failure_threshold=2, reset_after_s=60.0)
+        )
+        for round_rows in (4, 4, 4):
+            for i in range(round_rows):
+                q.submit(i, np.float32(i))
+            q._flush_once("manual")
+        s = q.stats()
+        assert s["shed_by_reason"]["dispatch_error"] == 8  # two failed cohorts
+        assert s["shed_by_reason"]["breaker_open"] == 4  # third never attempted
+        assert s["dispatched"] == 0
+        assert rec.rows == 0
+        _assert_staged_invariant(q)
+        q.close()
+
+    def test_quarantine_sheds_with_dead_letters(self):
+        rec = _Recorder()
+        q = _staged_queue(rec, quarantine="on")
+        vals = np.arange(8, dtype=np.float32)
+        vals[2] = np.nan
+        vals[5] = np.inf
+        for i, v in enumerate(vals):
+            q.submit(i, np.float32(v))
+        q._flush_once("manual")
+        s = q.stats()
+        assert s["shed_by_reason"]["poisoned"] == 2
+        assert s["dispatched"] == 6
+        _assert_staged_invariant(q)
+        ids, cols = rec.calls[0]
+        assert np.isfinite(np.asarray(cols[0], np.float64)).all()
+        dead = q.dead_letters()
+        assert sorted(t for t, _ in dead) == [2, 5]
+        q.close()
+
+    def test_pickled_staged_queue_rebuilds_buffers(self):
+        q = _staged_queue(_Recorder())
+        for i in range(4):
+            q.submit(i, np.float32(i))
+        q._flush_once("manual")
+        ring, slots = pickle.loads(pickle.dumps(q._ring)), pickle.loads(
+            pickle.dumps(q._slots)
+        )
+        assert not ring.bound and ring.head == 0
+        assert slots.in_use() == 0
+        # the live queue keeps working after its scratch was cloned
+        q.submit(7, np.float32(7.0))
+        q._flush_once("manual")
+        _assert_invariant(q)
+        q.close()
+
+
+# ------------------------------------------------------------- concurrency
+
+
+def _per_tenant_sums(calls, tenants):
+    """Bit-exact per-tenant integer sums over every dispatched cohort
+    (pad rows carry id -1 and are discarded, matching validate_ids=False)."""
+    sums = np.zeros(tenants, dtype=np.int64)
+    counts = np.zeros(tenants, dtype=np.int64)
+    for ids, cols in calls:
+        ids = np.asarray(ids)
+        keep = ids >= 0
+        np.add.at(sums, ids[keep], np.asarray(cols[0])[keep].astype(np.int64))
+        np.add.at(counts, ids[keep], 1)
+    return sums, counts
+
+
+class TestConcurrentIngest:
+    TENANTS = 16
+
+    def _writer_rows(self, w, n_rows):
+        rng = np.random.RandomState(1000 + w)
+        ids = rng.randint(0, self.TENANTS, n_rows).astype(np.int64)
+        vals = rng.randint(0, 1000, n_rows).astype(np.float32)  # integer-valued
+        return ids, vals
+
+    @pytest.mark.parametrize("staged", [True, False])
+    def test_racing_writers_match_serial_referee(self, staged):
+        """N writers × racing manual flushes ingest EXACTLY the serial
+        referee's rows: per-tenant sums/counts bit-identical (integer data,
+        so cohort-boundary permutations cannot hide behind float rounding)."""
+        writers, rows_per = 4, 300
+        rec = _Recorder()
+        q = AdmissionQueue(
+            rec, max_batch=32, capacity_rows=writers * rows_per,
+            start=False, staging=staged,
+        )
+        stop = threading.Event()
+
+        def flusher():
+            while not stop.is_set():
+                q._flush_once("manual")
+
+        def writer(w):
+            ids, vals = self._writer_rows(w, rows_per)
+            for t, v in zip(ids, vals):
+                q.submit(int(t), np.float32(v))
+
+        flushers = [threading.Thread(target=flusher) for _ in range(2)]
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(writers)
+        ]
+        for th in flushers + threads:
+            th.start()
+        for th in threads:
+            th.join()
+        stop.set()
+        for th in flushers:
+            th.join()
+        while q.depth():
+            q._flush_once("manual")
+        _assert_invariant(q)
+        s = q.stats()
+        assert s["shed"] == 0 and s["resident"] == 0
+        assert s["dispatched"] == writers * rows_per
+
+        # the serial referee: same rows, one thread, one flush per batch
+        ref_rec = _Recorder()
+        ref = AdmissionQueue(
+            ref_rec, max_batch=32, capacity_rows=writers * rows_per, start=False
+        )
+        for w in range(writers):
+            ids, vals = self._writer_rows(w, rows_per)
+            for t, v in zip(ids, vals):
+                ref.submit(int(t), np.float32(v))
+        while ref.depth():
+            ref._flush_once("manual")
+
+        got = _per_tenant_sums(rec.calls, self.TENANTS)
+        want = _per_tenant_sums(ref_rec.calls, self.TENANTS)
+        np.testing.assert_array_equal(got[0], want[0])
+        np.testing.assert_array_equal(got[1], want[1])
+        q.close()
+        ref.close()
+
+    def test_conservation_through_faults_under_concurrency(self):
+        """Racing writers against a flaky dispatch + armed quarantine: every
+        row lands in exactly one ledger bucket — no loss, no double-count."""
+        writers, rows_per = 4, 200
+        rec = _Recorder(fail_times=3)
+        q = AdmissionQueue(
+            rec, max_batch=16, capacity_rows=writers * rows_per,
+            start=False, staging=True, quarantine="on",
+        )
+        stop = threading.Event()
+
+        def flusher():
+            while not stop.is_set():
+                q._flush_once("manual")
+
+        def writer(w):
+            rng = np.random.RandomState(2000 + w)
+            for i in range(rows_per):
+                v = np.nan if rng.rand() < 0.05 else float(rng.randint(0, 100))
+                q.submit(int(rng.randint(0, self.TENANTS)), np.float32(v))
+
+        flushers = [threading.Thread(target=flusher) for _ in range(2)]
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(writers)
+        ]
+        for th in flushers + threads:
+            th.start()
+        for th in threads:
+            th.join()
+        stop.set()
+        for th in flushers:
+            th.join()
+        while q.depth():
+            q._flush_once("manual")
+        _assert_staged_invariant(q)
+        s = q.stats()
+        assert s["submitted"] == writers * rows_per
+        assert s["resident"] == 0
+        shed = s["shed_by_reason"]
+        assert (
+            s["dispatched"]
+            + shed.get("poisoned", 0)
+            + shed.get("dispatch_error", 0)
+            == s["admitted"]
+        )
+        assert rec.rows == s["dispatched"]
+        q.close()
+
+    def test_staged_background_flusher_end_to_end(self):
+        """The real flusher thread + prefetch lane against racing writers:
+        drain() leaves the ledger exact and the recorder whole."""
+        rec = _Recorder()
+        q = AdmissionQueue(rec, max_batch=32, max_delay_ms=1.0, staging=True)
+        writers, rows_per = 4, 250
+
+        def writer(w):
+            ids, vals = self._writer_rows(w, rows_per)
+            for t, v in zip(ids, vals):
+                q.submit(int(t), np.float32(v))
+
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(writers)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        q.drain()
+        _assert_invariant(q)
+        s = q.stats()
+        assert s["resident"] == 0
+        assert s["dispatched"] + s["shed"] == writers * rows_per
+        assert rec.rows == s["dispatched"]
+        q.close()
+
+
+# ------------------------------------------------------------- telemetry
+
+
+def test_staging_series_and_counters_surface():
+    observability.enable()
+    from metrics_tpu.observability.histogram import HISTOGRAMS
+    from metrics_tpu.serving.telemetry import SERVING_STATS
+
+    base_staged = SERVING_STATS.counter("staged_cohorts")
+    q = _staged_queue(_Recorder())
+    for i in range(8):
+        q.submit(i, np.float32(i))
+    q._flush_once("manual")
+    q.close()
+    assert SERVING_STATS.counter("staged_cohorts") == base_staged + 1
+    snap = HISTOGRAMS.snapshot()
+    fill = snap.get("serving_staging_fill_seconds", {})
+    assert fill.get("count", 0) >= 1
+    occ = snap.get("serving_staging_occupancy", {})
+    assert occ.get("count", 0) >= 1

@@ -399,6 +399,63 @@ def test_serving_layer_documented_and_cross_linked():
     assert "docs/serving.md" in readme and "SLOScheduler" in readme
 
 
+def test_device_resident_ingest_documented_and_cross_linked():
+    """The device-resident ingest path's user contract lives in three
+    places: the serving guide (the staging knobs + StagedColumn hand-off
+    semantics), the performance guide (the staging ring / double-buffer
+    cost model, the A/B bench, the staging-off zero-overhead pin), and the
+    observability guide (the staging telemetry keys + the serving_stage
+    profiler path) — cross-linked all ways, plus the extremal scatter
+    kernels that ride the same PR's dispatch contract."""
+    with open(f"{DOCS_DIR}/serving.md") as fh:
+        serving = fh.read()
+    for phrase in (
+        "## Device-resident ingest (staging)",
+        "staging=True",
+        "staging_slots",
+        "staging_transfer",
+        "StagedColumn",
+        "performance.md#device-resident-ingest",
+    ):
+        assert phrase in serving, phrase
+    with open(f"{DOCS_DIR}/performance.md") as fh:
+        perf = fh.read()
+    assert "### Device-resident ingest" in perf
+    for phrase in (
+        "columnar staging ring",
+        "staging_lane",
+        "overlap_fraction",
+        "ingest_staged_overlap_step",
+        "BENCH_r11",
+        "staging_off",
+        "segment_scatter_max",
+        "segment_scatter_min",
+        "observability.md#serving-telemetry",
+    ):
+        assert phrase in perf, phrase
+    with open(f"{DOCS_DIR}/observability.md") as fh:
+        obs = fh.read()
+    for phrase in (
+        "serving_staging_fill_seconds",
+        "serving_staging_overlap_seconds",
+        "serving_staging_occupancy",
+        "staged_cohorts",
+        "prefetched_cohorts",
+        "serving_stage",
+        "performance.md#device-resident-ingest",
+    ):
+        assert phrase in obs, phrase
+    # the modules reference carries the extremal dispatch trios
+    with open(f"{DOCS_DIR}/modules.md") as fh:
+        mods = fh.read()
+    import metrics_tpu.kernels as kernels_pkg
+
+    for op in ("segment_scatter_max", "segment_scatter_min"):
+        for suffix in ("", "_pallas", "_xla"):
+            assert hasattr(kernels_pkg, op + suffix), op + suffix
+        assert f"`metrics_tpu.kernels.{op}`" in mods, op
+
+
 def test_durability_documented_and_cross_linked():
     """The durability plane's user contract lives in four places: its own
     guide (checkpoint protocol, restore topology matrix, eviction knobs,
